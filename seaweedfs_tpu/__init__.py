@@ -1,0 +1,24 @@
+"""seaweedfs_tpu — a TPU-native distributed object/file store.
+
+A from-scratch re-design of the SeaweedFS capability set (Haystack-style
+blob store: master + volume servers + erasure-coded warm tier + filer)
+whose performance-critical tier — the RS(10,4) GF(2^8) erasure codec —
+runs as JAX/XLA programs on TPU, with bitsliced XOR-matmul kernels that
+ride the MXU, and whose multi-volume batch paths shard over a
+`jax.sharding.Mesh`.
+
+Layering (mirrors SURVEY.md §1):
+    storage/   L1 storage engine: needle format, volumes, needle maps
+    ec/        the EC codec + striping + EC volumes (the north star)
+    topology/  L3 control plane: node tree, layouts, placement
+    server/    L2/L3 HTTP+RPC servers (master, volume)
+    filer/     L5 namespace layer
+    parallel/  mesh/sharding helpers for batched TPU paths
+    util/      cross-cutting codecs, crc, config
+
+On-disk formats are bit-compatible with the reference implementation
+(see SURVEY.md; citations in each module point at
+/root/reference/weed/... file:line for the behavior being matched).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,54 @@
+"""External file ids: "<vid>,<key_hex><cookie_hex>".
+
+Matches reference weed/storage/needle/file_id.go: the key is hex with
+leading zeros stripped (minimum one nibble pair), the cookie is always
+8 hex chars appended.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from seaweedfs_tpu.storage.types import parse_cookie, parse_needle_id
+
+
+@dataclass(frozen=True)
+class FileId:
+    volume_id: int
+    key: int
+    cookie: int
+
+    def __str__(self) -> str:
+        return f"{self.volume_id},{format_needle_id_cookie(self.key, self.cookie)}"
+
+    @staticmethod
+    def parse(fid: str) -> "FileId":
+        comma = fid.find(",")
+        if comma <= 0:
+            raise ValueError(f"unknown file id {fid!r}")
+        vid_str = fid[:comma]
+        if not vid_str.isdigit():
+            raise ValueError(f"unknown volume id in {fid!r}")
+        vid = int(vid_str)
+        key, cookie = parse_needle_id_cookie(fid[comma + 1 :])
+        return FileId(vid, key, cookie)
+
+
+def format_needle_id_cookie(key: int, cookie: int) -> str:
+    """needle.go:173 formatNeedleIdCookie — key hex (zero-stripped,
+    even-length) + 8-char cookie hex."""
+    key_hex = f"{key:016x}"
+    cookie_hex = f"{cookie:08x}"
+    non_zero = 0
+    while non_zero < len(key_hex) - 1 and key_hex[non_zero] == "0":
+        non_zero += 1
+    non_zero -= non_zero & 1  # keep whole byte pairs
+    return key_hex[non_zero:] + cookie_hex
+
+
+def parse_needle_id_cookie(key_cookie: str) -> tuple[int, int]:
+    """needle.go:181 ParseNeedleIdCookie."""
+    if len(key_cookie) <= 8:
+        raise ValueError(f"needle id too short: {key_cookie!r}")
+    split = len(key_cookie) - 8
+    return parse_needle_id(key_cookie[:split]), parse_cookie(key_cookie[split:])

@@ -1,0 +1,55 @@
+"""Replica placement policy: "xyz" digit string.
+
+Bit-compatible with reference weed/storage/super_block/replica_placement.go:
+digit 0 = extra copies in different data centers, digit 1 = different
+racks (same DC), digit 2 = same rack.  Stored in the superblock as the
+decimal byte x*100 + y*10 + z.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ReplicaPlacement:
+    diff_data_center_count: int = 0
+    diff_rack_count: int = 0
+    same_rack_count: int = 0
+
+    @staticmethod
+    def parse(t: str) -> "ReplicaPlacement":
+        counts = [0, 0, 0]
+        for i, c in enumerate(t):
+            v = ord(c) - ord("0")
+            if not 0 <= v <= 2 or i > 2:
+                raise ValueError(f"unknown replication type {t!r}")
+            counts[i] = v
+        return ReplicaPlacement(counts[0], counts[1], counts[2])
+
+    @staticmethod
+    def from_byte(b: int) -> "ReplicaPlacement":
+        return ReplicaPlacement.parse(f"{b:03d}")
+
+    def to_byte(self) -> int:
+        return (
+            self.diff_data_center_count * 100
+            + self.diff_rack_count * 10
+            + self.same_rack_count
+        )
+
+    @property
+    def copy_count(self) -> int:
+        return (
+            self.diff_data_center_count
+            + self.diff_rack_count
+            + self.same_rack_count
+            + 1
+        )
+
+    def __str__(self) -> str:
+        return (
+            f"{self.diff_data_center_count}"
+            f"{self.diff_rack_count}"
+            f"{self.same_rack_count}"
+        )

@@ -1,0 +1,85 @@
+"""Volume superblock: the first 8 bytes of every .dat file.
+
+Bit-compatible with reference weed/storage/super_block/super_block.go:16-67:
+  byte 0     version (1, 2 or 3)
+  byte 1     replica placement byte
+  bytes 2-3  TTL
+  bytes 4-5  compaction revision (big-endian uint16)
+  bytes 6-7  extra-size (uint16) — length of a trailing protobuf blob
+             (we preserve unknown extra bytes opaquely)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
+from seaweedfs_tpu.storage.ttl import TTL
+from seaweedfs_tpu.util import bytesutil
+
+SUPER_BLOCK_SIZE = 8
+
+VERSION1 = 1
+VERSION2 = 2
+VERSION3 = 3
+CURRENT_VERSION = VERSION3
+
+
+@dataclass
+class SuperBlock:
+    version: int = CURRENT_VERSION
+    replica_placement: ReplicaPlacement = field(default_factory=ReplicaPlacement)
+    ttl: TTL = field(default_factory=TTL)
+    compaction_revision: int = 0
+    extra: bytes = b""
+
+    def block_size(self) -> int:
+        if self.version in (VERSION2, VERSION3):
+            return SUPER_BLOCK_SIZE + len(self.extra)
+        return SUPER_BLOCK_SIZE
+
+    def to_bytes(self) -> bytes:
+        header = bytearray(SUPER_BLOCK_SIZE)
+        header[0] = self.version
+        header[1] = self.replica_placement.to_byte()
+        header[2:4] = self.ttl.to_bytes()
+        header[4:6] = bytesutil.put_u16(self.compaction_revision)
+        if self.extra:
+            if len(self.extra) > 256 * 256 - 2:
+                raise ValueError(f"super block extra too large: {len(self.extra)}")
+            header[6:8] = bytesutil.put_u16(len(self.extra))
+            return bytes(header) + self.extra
+        return bytes(header)
+
+    @staticmethod
+    def from_bytes(header: bytes) -> "SuperBlock":
+        """Parse a superblock; `header` must contain the full 8-byte block
+        plus any declared extra bytes (truncation raises)."""
+        if len(header) < SUPER_BLOCK_SIZE:
+            raise ValueError("cannot read volume superblock: file too short")
+        version = header[0]
+        if version not in (VERSION1, VERSION2, VERSION3):
+            raise ValueError(f"unsupported volume version {version}")
+        extra_size = bytesutil.get_u16(header, 6)
+        if len(header) < SUPER_BLOCK_SIZE + extra_size:
+            raise ValueError(
+                f"superblock declares {extra_size} extra bytes but only "
+                f"{len(header) - SUPER_BLOCK_SIZE} present"
+            )
+        return SuperBlock(
+            version=version,
+            replica_placement=ReplicaPlacement.from_byte(header[1]),
+            ttl=TTL.from_bytes(header[2:4]),
+            compaction_revision=bytesutil.get_u16(header, 4),
+            extra=bytes(header[SUPER_BLOCK_SIZE : SUPER_BLOCK_SIZE + extra_size]),
+        )
+
+    @staticmethod
+    def read_from(f) -> "SuperBlock":
+        """Read a superblock from the start of an open binary file."""
+        f.seek(0)
+        header = f.read(SUPER_BLOCK_SIZE)
+        if len(header) != SUPER_BLOCK_SIZE:
+            raise ValueError("cannot read volume superblock: file too short")
+        extra_size = bytesutil.get_u16(header, 6)
+        return SuperBlock.from_bytes(header + (f.read(extra_size) if extra_size else b""))

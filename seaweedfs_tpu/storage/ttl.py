@@ -1,0 +1,82 @@
+"""2-byte (count, unit) volume/needle TTL.
+
+Bit-compatible with reference weed/storage/needle/volume_ttl.go:
+stored as [count, unit] bytes; unit enum Empty..Year; string forms like
+"3m", "4h", "5d", "6w", "7M", "8y" (bare digits imply minutes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+EMPTY = 0
+MINUTE = 1
+HOUR = 2
+DAY = 3
+WEEK = 4
+MONTH = 5
+YEAR = 6
+
+_UNIT_FROM_CHAR = {"m": MINUTE, "h": HOUR, "d": DAY, "w": WEEK, "M": MONTH, "y": YEAR}
+_CHAR_FROM_UNIT = {v: k for k, v in _UNIT_FROM_CHAR.items()}
+
+_UNIT_MINUTES = {
+    MINUTE: 1,
+    HOUR: 60,
+    DAY: 24 * 60,
+    WEEK: 7 * 24 * 60,
+    MONTH: 31 * 24 * 60,
+    YEAR: 365 * 24 * 60,
+}
+
+
+@dataclass(frozen=True)
+class TTL:
+    count: int = 0
+    unit: int = EMPTY
+
+    @staticmethod
+    def parse(ttl_string: str) -> "TTL":
+        if not ttl_string:
+            return TTL()
+        unit_char = ttl_string[-1]
+        if unit_char.isdigit():
+            count_str, unit = ttl_string, MINUTE
+        else:
+            count_str = ttl_string[:-1]
+            if unit_char not in _UNIT_FROM_CHAR:
+                raise ValueError(f"unknown TTL unit {unit_char!r}")
+            unit = _UNIT_FROM_CHAR[unit_char]
+        count = int(count_str)
+        if not 0 <= count <= 255:
+            raise ValueError(f"TTL count {count} out of byte range")
+        return TTL(count, unit)
+
+    @staticmethod
+    def from_bytes(b: bytes) -> "TTL":
+        if b[0] == 0 and b[1] == 0:
+            return TTL()
+        return TTL(b[0], b[1])
+
+    @staticmethod
+    def from_uint32(v: int) -> "TTL":
+        return TTL.from_bytes(bytes([(v >> 8) & 0xFF, v & 0xFF]))
+
+    def to_bytes(self) -> bytes:
+        return bytes([self.count & 0xFF, self.unit & 0xFF])
+
+    def to_uint32(self) -> int:
+        if self.count == 0:
+            return 0
+        return ((self.count & 0xFF) << 8) | (self.unit & 0xFF)
+
+    @property
+    def minutes(self) -> int:
+        if self.count == 0 or self.unit == EMPTY:
+            return 0
+        return self.count * _UNIT_MINUTES[self.unit]
+
+    def __str__(self) -> str:
+        if self.count == 0 or self.unit == EMPTY:
+            return ""
+        return f"{self.count}{_CHAR_FROM_UNIT[self.unit]}"

@@ -1,0 +1,81 @@
+"""Core on-disk ABI constants and the Offset codec.
+
+Bit-compatible with reference weed/storage/types/:
+  needle_types.go:24-32  — sizes, TombstoneFileSize
+  offset_4bytes.go       — 4-byte offset in units of 8-byte padding
+                           (⇒ 32 GB max volume)
+  offset_5bytes.go       — 5-byte variant (⇒ 8 TB); the reference picks
+                           one at *build* time via a build tag; here it
+                           is a per-call parameter defaulting to 4.
+  needle_id_type.go      — 8-byte big-endian needle ids
+"""
+
+from __future__ import annotations
+
+COOKIE_SIZE = 4
+NEEDLE_ID_SIZE = 8
+SIZE_SIZE = 4  # uint32
+NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
+NEEDLE_CHECKSUM_SIZE = 4
+TIMESTAMP_SIZE = 8
+NEEDLE_PADDING_SIZE = 8
+TOMBSTONE_FILE_SIZE = 0xFFFFFFFF  # size==MaxUint32 marks a deleted entry
+
+OFFSET_SIZE = 4  # default build: 4-byte offsets
+NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
+
+# 4-byte offset counts NEEDLE_PADDING_SIZE units: 2^32 * 8 = 32 GB
+MAX_POSSIBLE_VOLUME_SIZE = (1 << (8 * OFFSET_SIZE)) * NEEDLE_PADDING_SIZE
+
+NEEDLE_ID_EMPTY = 0
+
+
+def offset_to_units(actual_offset: int) -> int:
+    """Byte offset → stored offset units (offset_4bytes.go ToOffset)."""
+    return actual_offset // NEEDLE_PADDING_SIZE
+
+
+def units_to_offset(units: int) -> int:
+    """Stored offset units → byte offset (ToAcutalOffset)."""
+    return units * NEEDLE_PADDING_SIZE
+
+
+def offset_to_bytes(units: int, offset_size: int = OFFSET_SIZE) -> bytes:
+    """Offset units → big-endian bytes (OffsetToBytes)."""
+    return units.to_bytes(offset_size, "big")
+
+
+def bytes_to_offset(b: bytes) -> int:
+    """Big-endian offset bytes → offset units (BytesToOffset)."""
+    return int.from_bytes(b, "big")
+
+
+def needle_id_to_bytes(needle_id: int) -> bytes:
+    return (needle_id & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big")
+
+
+def bytes_to_needle_id(b: bytes) -> int:
+    return int.from_bytes(b[:8], "big")
+
+
+_HEX_DIGITS = frozenset("0123456789abcdefABCDEF")
+
+
+def _parse_hex_uint(s: str, bits: int, what: str) -> int:
+    """Strict hex parse matching Go's strconv.ParseUint(s, 16, bits):
+    no sign, no 0x prefix, no underscores, no whitespace."""
+    if not s or not all(c in _HEX_DIGITS for c in s):
+        raise ValueError(f"{what} {s!r} format error")
+    v = int(s, 16)
+    if v >= 1 << bits:
+        raise ValueError(f"{what} {s!r} overflows uint{bits}")
+    return v
+
+
+def parse_needle_id(id_string: str) -> int:
+    """Hex needle-id string → int (needle_id_type.go ParseNeedleId)."""
+    return _parse_hex_uint(id_string, 64, "needle id")
+
+
+def parse_cookie(cookie_string: str) -> int:
+    return _parse_hex_uint(cookie_string, 32, "cookie")

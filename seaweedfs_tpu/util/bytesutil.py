@@ -1,0 +1,37 @@
+"""Big-endian integer codecs.
+
+The entire SeaweedFS on-disk/wire ABI is big-endian
+(reference: weed/util/bytes.go — "// big endian"). These helpers are the
+single place that encodes that choice.
+"""
+
+from __future__ import annotations
+
+
+def put_u64(v: int) -> bytes:
+    return (v & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big")
+
+
+def put_u32(v: int) -> bytes:
+    return (v & 0xFFFFFFFF).to_bytes(4, "big")
+
+
+def put_u16(v: int) -> bytes:
+    return (v & 0xFFFF).to_bytes(2, "big")
+
+
+def get_u64(b: bytes, off: int = 0) -> int:
+    return int.from_bytes(b[off : off + 8], "big")
+
+
+def get_u32(b: bytes, off: int = 0) -> int:
+    return int.from_bytes(b[off : off + 4], "big")
+
+
+def get_u16(b: bytes, off: int = 0) -> int:
+    return int.from_bytes(b[off : off + 2], "big")
+
+
+def get_uint(b: bytes) -> int:
+    """Variable-length big-endian read (any byte length ≥ 1)."""
+    return int.from_bytes(b, "big")

@@ -1,0 +1,88 @@
+"""CRC32-Castagnoli with SeaweedFS value masking.
+
+Matches reference weed/storage/needle/crc.go:
+  - `NewCRC(b)` / `Update` — standard reflected CRC-32C
+    (poly 0x1EDC6F41, reflected 0x82F63B78, init/final-xor 0xFFFFFFFF;
+    Go's crc32.Update with the Castagnoli table).
+  - `Value()` — LevelDB-style masking: rotate-left 17 then
+    + 0xa282ead8 (crc.go:24: `uint32(c>>15|c<<17) + 0xa282ead8`).
+
+The hot path (checksumming needle payloads) is served by the native C
+extension when available (seaweedfs_tpu.native, slicing-by-8); the pure
+Python table fallback keeps the package dependency-free.
+"""
+
+from __future__ import annotations
+
+_POLY_REFLECTED = 0x82F63B78
+
+
+def _make_table() -> list[int]:
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ _POLY_REFLECTED if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_TABLE = _make_table()
+
+# Slicing-by-8 tables for the Python fallback (and for generating the C
+# tables): T[k][b] = crc of byte b advanced k+1 bytes.
+_TABLES8 = [_TABLE]
+for _k in range(7):
+    _prev = _TABLES8[-1]
+    _TABLES8.append([_TABLE[_prev[b] & 0xFF] ^ (_prev[b] >> 8) for b in range(256)])
+
+
+def _crc32c_py(data: bytes, crc: int = 0) -> int:
+    c = crc ^ 0xFFFFFFFF
+    n = len(data)
+    i = 0
+    t0, t1, t2, t3, t4, t5, t6, t7 = _TABLES8
+    while i + 8 <= n:
+        c ^= int.from_bytes(data[i : i + 4], "little")
+        hi = int.from_bytes(data[i + 4 : i + 8], "little")
+        c = (
+            t7[c & 0xFF]
+            ^ t6[(c >> 8) & 0xFF]
+            ^ t5[(c >> 16) & 0xFF]
+            ^ t4[(c >> 24) & 0xFF]
+            ^ t3[hi & 0xFF]
+            ^ t2[(hi >> 8) & 0xFF]
+            ^ t1[(hi >> 16) & 0xFF]
+            ^ t0[(hi >> 24) & 0xFF]
+        )
+        i += 8
+    while i < n:
+        c = _TABLE[(c ^ data[i]) & 0xFF] ^ (c >> 8)
+        i += 1
+    return c ^ 0xFFFFFFFF
+
+
+_native_crc32c = None
+try:  # pragma: no cover - exercised when the native lib is built
+    from seaweedfs_tpu.native import crc32c as _native_crc32c  # type: ignore
+except Exception:
+    _native_crc32c = None
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """Standard CRC-32C (Castagnoli) of `data`, continuing from `crc`."""
+    if _native_crc32c is not None:
+        return _native_crc32c(data, crc)
+    return _crc32c_py(data, crc)
+
+
+def masked_value(crc: int) -> int:
+    """SeaweedFS needle checksum: rotl17(crc) + 0xa282ead8 (mod 2^32)."""
+    crc &= 0xFFFFFFFF
+    rot = ((crc << 17) | (crc >> 15)) & 0xFFFFFFFF
+    return (rot + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def needle_checksum(data: bytes) -> int:
+    """The 4-byte checksum stored after a needle's body on disk."""
+    return masked_value(crc32c(data))

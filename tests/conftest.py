@@ -1,0 +1,31 @@
+"""Test harness: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; all sharding/collective
+tests run against 8 XLA host devices. Must run before jax is imported
+anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+
+import pytest
+
+REFERENCE_ROOT = pathlib.Path("/root/reference")
+
+
+@pytest.fixture(scope="session")
+def reference_root() -> pathlib.Path:
+    """Path to the read-only reference checkout; tests that golden-check
+    against its binary fixtures skip when it is absent (e.g. on the
+    bench host)."""
+    if not REFERENCE_ROOT.exists():
+        pytest.skip("reference checkout not available")
+    return REFERENCE_ROOT

@@ -1,0 +1,335 @@
+"""On-disk ABI tests: CRC, needle wire format, idx entries, superblock,
+TTL, replica placement, file ids.
+
+Golden values cross-checked against the reference implementation's
+formats (citations in each module under seaweedfs_tpu/storage/).
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage import idx
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.file_id import FileId, format_needle_id_cookie, parse_needle_id_cookie
+from seaweedfs_tpu.storage.needle import (
+    Needle,
+    CorruptNeedle,
+    get_actual_size,
+    padding_length,
+)
+from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
+from seaweedfs_tpu.storage.super_block import (
+    VERSION1,
+    VERSION2,
+    VERSION3,
+    SuperBlock,
+)
+from seaweedfs_tpu.storage.ttl import TTL
+from seaweedfs_tpu.util.crc import _crc32c_py, crc32c, masked_value, needle_checksum
+
+
+class TestCrc:
+    def test_crc32c_check_vector(self):
+        # Canonical CRC-32C check value (iSCSI test vector).
+        assert crc32c(b"123456789") == 0xE3069283
+
+    def test_crc32c_empty(self):
+        assert crc32c(b"") == 0
+
+    def test_incremental_update_matches_one_shot(self):
+        data = bytes(range(256)) * 7 + b"tail"
+        c = crc32c(data[:100])
+        c = crc32c(data[100:], c)
+        assert c == crc32c(data)
+
+    @staticmethod
+    def _crc32c_bitwise(data: bytes) -> int:
+        # Independent bit-at-a-time reference implementation.
+        c = 0xFFFFFFFF
+        for byte in data:
+            c ^= byte
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        return c ^ 0xFFFFFFFF
+
+    def test_against_independent_bitwise_impl(self):
+        rng = np.random.default_rng(0)
+        for n in [0, 1, 7, 8, 9, 63, 64, 100, 1023]:
+            data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            expected = self._crc32c_bitwise(data)
+            assert _crc32c_py(data) == expected
+            assert crc32c(data) == expected
+
+    def test_masked_value(self):
+        # crc.go:24: Value() = rotl17(c) + 0xa282ead8 (mod 2^32)
+        c = 0x12345678
+        expected = (((c << 17) | (c >> 15)) + 0xA282EAD8) & 0xFFFFFFFF
+        assert masked_value(c) == expected
+
+    def test_needle_checksum_is_masked(self):
+        data = b"hello world"
+        assert needle_checksum(data) == masked_value(crc32c(data))
+
+
+class TestPadding:
+    def test_padding_never_zero(self):
+        # needle_read_write.go:287: pad = 8 - (x % 8), so 8 when aligned.
+        for size in range(0, 64):
+            for version in (VERSION1, VERSION2, VERSION3):
+                pad = padding_length(size, version)
+                assert 1 <= pad <= 8
+
+    def test_actual_size_alignment(self):
+        for size in range(0, 64):
+            for version in (VERSION1, VERSION2, VERSION3):
+                assert get_actual_size(size, version) % 8 == 0
+
+    def test_v3_actual_size_example(self):
+        # header 16 + size 1 + crc 4 + ts 8 = 29 → pad 3 → 32
+        assert get_actual_size(1, VERSION3) == 32
+        # header 16 + size 3 + crc 4 + ts 8 = 31 → pad 1 → 32
+        assert get_actual_size(3, VERSION3) == 32
+        # aligned case gets a FULL extra 8: 16+4+4+8 = 32 → pad 8 → 40
+        assert get_actual_size(4, VERSION3) == 40
+
+
+class TestNeedleRoundTrip:
+    def _roundtrip(self, n: Needle, version: int) -> Needle:
+        blob = n.to_bytes(version)
+        assert len(blob) == n.disk_size(version)
+        return Needle.from_bytes(blob, version, size=n.size)
+
+    @pytest.mark.parametrize("version", [VERSION1, VERSION2, VERSION3])
+    def test_plain_data(self, version):
+        n = Needle(cookie=0xDEADBEEF, id=0x1234, data=b"some needle data")
+        m = self._roundtrip(n, version)
+        assert (m.cookie, m.id, m.data) == (n.cookie, n.id, n.data)
+
+    def test_all_fields_v3(self):
+        n = Needle(cookie=7, id=99, data=b"payload")
+        n.name = b"file.txt"
+        n.set_has_name()
+        n.mime = b"text/plain"
+        n.set_has_mime()
+        n.last_modified = 1_600_000_000
+        n.set_has_last_modified_date()
+        n.ttl = TTL.parse("3h")
+        n.set_has_ttl()
+        n.pairs = b'{"k":"v"}'
+        n.set_has_pairs()
+        n.append_at_ns = 1_600_000_000_123_456_789
+        m = self._roundtrip(n, VERSION3)
+        assert m.name == b"file.txt"
+        assert m.mime == b"text/plain"
+        assert m.last_modified == 1_600_000_000
+        assert m.ttl == TTL.parse("3h")
+        assert m.pairs == b'{"k":"v"}'
+        assert m.append_at_ns == n.append_at_ns
+        assert m.data == b"payload"
+
+    def test_empty_data_writes_empty_body(self):
+        n = Needle(cookie=1, id=2, data=b"")
+        blob = n.to_bytes(VERSION3)
+        assert n.size == 0
+        # header 16 + crc 4 + ts 8 = 28 → pad 4 → 32
+        assert len(blob) == 32
+        m = Needle.from_bytes(blob, VERSION3, size=0)
+        assert m.data == b""
+
+    def test_size_field_counts_body(self):
+        n = Needle(cookie=1, id=2, data=b"abcde")
+        n.name = b"nm"
+        n.set_has_name()
+        n.to_bytes(VERSION3)
+        # 4 (data_size) + 5 (data) + 1 (flags) + 1 (name_size) + 2 (name)
+        assert n.size == 13
+
+    def test_crc_corruption_detected(self):
+        n = Needle(cookie=1, id=2, data=b"good data here")
+        blob = bytearray(n.to_bytes(VERSION3))
+        blob[t.NEEDLE_HEADER_SIZE + 5] ^= 0xFF  # flip a data byte
+        with pytest.raises(CorruptNeedle, match="CRC"):
+            Needle.from_bytes(bytes(blob), VERSION3, size=n.size)
+
+    def test_size_mismatch_detected(self):
+        n = Needle(cookie=1, id=2, data=b"x")
+        blob = n.to_bytes(VERSION3)
+        with pytest.raises(CorruptNeedle, match="expected"):
+            Needle.from_bytes(blob, VERSION3, size=n.size + 1)
+
+    def test_header_layout_big_endian(self):
+        n = Needle(cookie=0x01020304, id=0x0A0B0C0D0E0F1011, data=b"Z")
+        blob = n.to_bytes(VERSION3)
+        assert blob[0:4] == bytes([1, 2, 3, 4])
+        assert blob[4:12] == bytes([0x0A, 0x0B, 0x0C, 0x0D, 0x0E, 0x0F, 0x10, 0x11])
+
+    def test_truncated_blob_raises_corrupt(self):
+        n = Needle(cookie=1, id=2, data=b"payload bytes here")
+        blob = n.to_bytes(VERSION3)
+        for cut in [0, 5, 15, 20, len(blob) - 12]:
+            with pytest.raises(CorruptNeedle):
+                Needle.from_bytes(blob[:cut], VERSION3)
+
+    def test_flags_byte_out_of_range(self):
+        # body claims data fills it entirely, leaving no room for flags
+        from seaweedfs_tpu.util import bytesutil as bu
+
+        body = bu.put_u32(4) + b"abcd"  # data_size=4, no flags byte
+        blob = (
+            bu.put_u32(1) + bu.put_u64(2) + bu.put_u32(len(body)) + body + bytes(16)
+        )
+        with pytest.raises(CorruptNeedle, match="flags"):
+            Needle.from_bytes(blob, VERSION3)
+
+    def test_long_name_truncated(self):
+        n = Needle(cookie=1, id=2, data=b"d", name=b"n" * 300)
+        n.set_has_name()
+        m = self._roundtrip(n, VERSION2)
+        assert len(m.name) == 255
+
+
+class TestIdx:
+    def test_pack_unpack(self):
+        b = idx.pack_entry(0x1122334455667788, 0xAABBCCDD, 0x99887766)
+        assert len(b) == 16
+        assert idx.unpack_entry(b) == (0x1122334455667788, 0xAABBCCDD, 0x99887766)
+
+    def test_walk(self):
+        blob = b"".join(idx.pack_entry(k, k * 2, k * 3) for k in range(1, 2500))
+        seen = []
+        idx.walk_index_file(io.BytesIO(blob), lambda k, o, s: seen.append((k, o, s)))
+        assert seen == [(k, k * 2, k * 3) for k in range(1, 2500)]
+
+    def test_numpy_views_roundtrip(self):
+        rng = np.random.default_rng(1)
+        keys = rng.integers(0, 1 << 63, 1000, dtype=np.uint64)
+        offs = rng.integers(0, 1 << 32, 1000, dtype=np.uint64)
+        sizes = rng.integers(0, 1 << 32, 1000, dtype=np.uint32)
+        blob = idx.arrays_to_entries(keys, offs, sizes)
+        k2, o2, s2 = idx.entries_as_arrays(blob)
+        np.testing.assert_array_equal(keys, k2)
+        np.testing.assert_array_equal(offs, o2)
+        np.testing.assert_array_equal(sizes, s2)
+        assert blob == b"".join(
+            idx.pack_entry(int(k), int(o), int(s)) for k, o, s in zip(keys, offs, sizes)
+        )
+
+    def test_reference_fixture_parses(self, reference_root):
+        fixture = reference_root / "weed/storage/erasure_coding/1.idx"
+        data = fixture.read_bytes()
+        assert len(data) % 16 == 0
+        keys, offs, sizes = idx.entries_as_arrays(data)
+        assert len(keys) > 0
+        # every live entry's record must lie inside the .dat file
+        dat_size = (reference_root / "weed/storage/erasure_coding/1.dat").stat().st_size
+        live = sizes != t.TOMBSTONE_FILE_SIZE
+        ends = offs[live] * 8 + sizes[live]
+        assert int(ends.max()) <= dat_size + get_actual_size(0, VERSION3)
+
+
+class TestSuperBlock:
+    def test_roundtrip(self):
+        sb = SuperBlock(
+            version=VERSION3,
+            replica_placement=ReplicaPlacement.parse("012"),
+            ttl=TTL.parse("5d"),
+            compaction_revision=7,
+        )
+        blob = sb.to_bytes()
+        assert len(blob) == 8
+        sb2 = SuperBlock.from_bytes(blob)
+        assert sb2 == sb
+
+    def test_layout(self):
+        sb = SuperBlock(
+            version=2,
+            replica_placement=ReplicaPlacement.parse("001"),
+            ttl=TTL.parse("3m"),
+            compaction_revision=0x0102,
+        )
+        blob = sb.to_bytes()
+        assert blob[0] == 2
+        assert blob[1] == 1
+        assert blob[2:4] == bytes([3, 1])  # count=3, unit=Minute
+        assert blob[4:6] == bytes([1, 2])
+
+    def test_extra_preserved(self):
+        sb = SuperBlock(extra=b"\x0a\x03abc")
+        f = io.BytesIO(sb.to_bytes())
+        sb2 = SuperBlock.read_from(f)
+        assert sb2.extra == b"\x0a\x03abc"
+
+
+class TestTtl:
+    @pytest.mark.parametrize(
+        "s,minutes",
+        [("3m", 3), ("4h", 240), ("5d", 7200), ("6w", 60480), ("7M", 312480), ("8y", 4204800)],
+    )
+    def test_parse_string(self, s, minutes):
+        ttl = TTL.parse(s)
+        assert str(ttl) == s
+        assert ttl.minutes == minutes
+
+    def test_bare_digits_are_minutes(self):
+        assert TTL.parse("45") == TTL.parse("45m")
+
+    def test_bytes_roundtrip(self):
+        for s in ["", "3m", "255y"]:
+            ttl = TTL.parse(s)
+            assert TTL.from_bytes(ttl.to_bytes()) == ttl
+            assert TTL.from_uint32(ttl.to_uint32()) == ttl
+
+    def test_empty(self):
+        assert TTL.parse("").to_uint32() == 0
+        assert str(TTL()) == ""
+
+
+class TestReplicaPlacement:
+    def test_parse_and_copy_count(self):
+        rp = ReplicaPlacement.parse("012")
+        assert rp.diff_data_center_count == 0
+        assert rp.diff_rack_count == 1
+        assert rp.same_rack_count == 2
+        assert rp.copy_count == 4
+
+    def test_byte_roundtrip(self):
+        for s in ["000", "001", "010", "100", "200", "112", "222"]:
+            rp = ReplicaPlacement.parse(s)
+            assert ReplicaPlacement.from_byte(rp.to_byte()) == rp
+            assert str(rp) == s
+
+    def test_rejects_invalid(self):
+        with pytest.raises(ValueError):
+            ReplicaPlacement.parse("003")
+
+
+class TestFileId:
+    def test_format_strips_leading_zero_pairs(self):
+        assert format_needle_id_cookie(0x1, 0xDEADBEEF) == "01deadbeef"
+        assert format_needle_id_cookie(0x0144B2, 0x01020304) == "0144b201020304"
+        assert format_needle_id_cookie(0, 0) == "0000000000"
+
+    def test_parse_roundtrip(self):
+        for key in [1, 0xFF, 0x1234567890ABCDEF]:
+            for cookie in [0, 0xFFFFFFFF, 0x12345678]:
+                s = format_needle_id_cookie(key, cookie)
+                assert parse_needle_id_cookie(s) == (key, cookie)
+
+    def test_file_id_string(self):
+        fid = FileId(3, 0x0144B2, 0xCAFEBABE)
+        assert str(fid) == "3,0144b2cafebabe"
+        assert FileId.parse(str(fid)) == fid
+
+    def test_rejects_nonstrict_hex(self):
+        # Go strconv.ParseUint rejects signs/prefixes/underscores/space.
+        for bad in ["3,-000001deadbeef", "3,0x0001deadbeef", "3,00_01deadbeef", "x,01deadbeef", "3, 01deadbeef"]:
+            with pytest.raises(ValueError):
+                FileId.parse(bad)
+
+    def test_superblock_truncated_extra_raises(self):
+        sb = SuperBlock(extra=b"\x0a\x03abc")
+        blob = sb.to_bytes()
+        with pytest.raises(ValueError, match="extra"):
+            SuperBlock.from_bytes(blob[:8])
