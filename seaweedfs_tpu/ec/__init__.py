@@ -1,0 +1,14 @@
+"""Erasure-coding tier: RS(10,4) over GF(2^8), TPU-first.
+
+The codec is the framework's north-star component (BASELINE.json):
+encode/reconstruct run as JAX bitsliced XOR-matmul programs on TPU,
+with a numpy CPU backend kept as the bit-exact reference. Striping
+layout and shard file formats are wire-compatible with the reference
+implementation (weed/storage/erasure_coding/)."""
+
+from seaweedfs_tpu.ec.codec import (  # noqa: F401
+    DATA_SHARDS,
+    PARITY_SHARDS,
+    TOTAL_SHARDS,
+    new_encoder,
+)

@@ -1,0 +1,163 @@
+"""TPU backend for the RS codec: GF(2^8) as bitsliced XOR-matmuls.
+
+TPUs have no native GF(2^8) multiply. The trick (SURVEY.md §7 step 2):
+multiplication by a constant c is GF(2)-linear on the 8 bits of a byte,
+so it is an 8x8 bit-matrix B(c) with B(c)[i,j] = bit i of (c·2^j).
+A whole RS coefficient matrix M [R,C] expands to a bit-matrix
+A [R*8, C*8] of B-blocks, and
+
+    parity_bits = (A @ data_bits) mod 2
+
+is an ordinary int8 matmul (accumulate in int32, then &1) — exactly the
+shape of work the MXU is built for. Contraction dim C*8=80 and output
+R*8=32 for RS(10,4); the N (byte-stream) dimension is the wide one.
+
+The same kernel serves encode (A = parity rows) and reconstruct
+(A = rows of the inverted survivor matrix, computed host-side in
+gf256.py — a 14x14 inversion is not TPU work).
+
+Everything is jittable, statically shaped, and usable under shard_map
+over a Mesh for the batched multi-volume paths (parallel/ and
+__graft_entry__.dryrun_multichip exercise that).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from seaweedfs_tpu.ec import gf256
+from seaweedfs_tpu.ec.codec import register_backend
+
+
+def gf_matrix_to_bits(matrix: np.ndarray) -> np.ndarray:
+    """Expand a GF(2^8) coefficient matrix [R,C] to its GF(2) bit-matrix
+    [R*8, C*8] of 8x8 blocks B(m[r,c])."""
+    r, c = matrix.shape
+    # mul_pow2[coef, j] = coef · 2^j in the field
+    pow2 = (1 << np.arange(8)).astype(np.uint8)
+    prods = gf256.MUL_TABLE[matrix.reshape(-1)[:, None], pow2[None, :]]  # [R*C, 8]
+    # bits[i, (rc), j] = bit i of prods[(rc), j]
+    bits = (prods[None, :, :] >> np.arange(8)[:, None, None]) & 1  # [8, R*C, 8]
+    blocks = bits.transpose(1, 0, 2).reshape(r, c, 8, 8)  # [R, C, i, j]
+    return (
+        blocks.transpose(0, 2, 1, 3).reshape(r * 8, c * 8).astype(np.int8)
+    )
+
+
+def unpack_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """[C, N] uint8 → [C*8, N] int8 bit-planes, LSB-first within a byte."""
+    c, n = x.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)[None, :, None]
+    bits = (x[:, None, :] >> shifts) & jnp.uint8(1)
+    return bits.reshape(c * 8, n).astype(jnp.int8)
+
+
+def pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """[R*8, N] int-ish bits → [R, N] uint8, LSB-first."""
+    r8, n = bits.shape
+    planes = bits.reshape(r8 // 8, 8, n).astype(jnp.int32)
+    weights = (1 << jnp.arange(8, dtype=jnp.int32))[None, :, None]
+    return jnp.sum(planes * weights, axis=1).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def apply_matrix_bits(a_bits: jnp.ndarray, inputs: jnp.ndarray) -> jnp.ndarray:
+    """out[r] = XOR_c M[r,c]·inputs[c], via one int8 matmul on the MXU.
+
+    a_bits: [R*8, C*8] int8 (from gf_matrix_to_bits)
+    inputs: [C, N] uint8
+    returns [R, N] uint8
+    """
+    x_bits = unpack_bits(inputs)  # [C*8, N] int8
+    acc = jax.lax.dot_general(
+        a_bits,
+        x_bits,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [R*8, N] int32; each entry ≤ 80 so no overflow
+    return pack_bits(acc & 1)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def apply_matrix_bits_batch(a_bits: jnp.ndarray, inputs: jnp.ndarray) -> jnp.ndarray:
+    """Batched variant: inputs [B, C, N] → [B, R, N] (vmapped matmul)."""
+    return jax.vmap(lambda x: apply_matrix_bits(a_bits, x))(inputs)
+
+
+def tpu_apply_matrix(matrix: np.ndarray, inputs: np.ndarray) -> np.ndarray:
+    """Host-interop backend for codec.ReedSolomon: numpy in, numpy out."""
+    a_bits = gf_matrix_to_bits(matrix)
+    out = apply_matrix_bits(jnp.asarray(a_bits), jnp.asarray(inputs))
+    return np.asarray(jax.device_get(out))
+
+
+register_backend("tpu", tpu_apply_matrix)
+
+
+class TpuCodecKernels:
+    """Device-resident kernels for one RS(k,p) configuration.
+
+    Holds the encode bit-matrix on device; decode bit-matrices are
+    built host-side per survivor set (cached) and shipped once per
+    rebuild. Used by the streaming encoder, bench.py and the graft
+    entry points.
+    """
+
+    def __init__(self, data_shards: int = 10, parity_shards: int = 4):
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.matrix = gf256.build_code_matrix(data_shards, self.total_shards)
+        self.encode_bits_host = gf_matrix_to_bits(self.matrix[data_shards:])
+        self.encode_bits = jnp.asarray(self.encode_bits_host)
+        self._decode_bits_cache: dict[tuple[int, ...], np.ndarray] = {}
+
+    def encode(self, data: jnp.ndarray) -> jnp.ndarray:
+        """data [k, N] uint8 (device) → parity [p, N] uint8 (device)."""
+        return apply_matrix_bits(self.encode_bits, data)
+
+    def encode_batch(self, data: jnp.ndarray) -> jnp.ndarray:
+        """data [B, k, N] → parity [B, p, N]."""
+        return apply_matrix_bits_batch(self.encode_bits, data)
+
+    def decode_bits_for(
+        self, survivors: tuple[int, ...], targets: tuple[int, ...]
+    ) -> np.ndarray:
+        """Bit-matrix mapping k survivor shards → the target shards.
+
+        survivors: k shard ids present (sorted); targets: shard ids to
+        produce. Data targets come from the inverted survivor submatrix;
+        parity targets from (parity rows · inverse).
+        """
+        key = survivors + (256,) + targets
+        cached = self._decode_bits_cache.get(key)
+        if cached is not None:
+            return cached
+        k = self.data_shards
+        sub = gf256.sub_matrix_for_survivors(self.matrix, list(survivors))
+        inv = gf256.mat_inv(sub)  # [k, k]: survivors → data shards
+        rows = []
+        for t in targets:
+            if t < k:
+                rows.append(inv[t])
+            else:
+                # parity row in terms of data, composed with inv
+                rows.append(gf256.mat_mul(self.matrix[t : t + 1], inv)[0])
+        bits = gf_matrix_to_bits(np.stack(rows))
+        self._decode_bits_cache[key] = bits
+        return bits
+
+    def reconstruct(
+        self,
+        survivors: tuple[int, ...],
+        targets: tuple[int, ...],
+        shard_data: jnp.ndarray,
+    ) -> jnp.ndarray:
+        """shard_data [k, N] uint8 = survivor shards (in `survivors`
+        order) → [len(targets), N] rebuilt shards."""
+        bits = jnp.asarray(self.decode_bits_for(survivors, targets))
+        return apply_matrix_bits(bits, shard_data)

@@ -1,0 +1,140 @@
+"""GF(2^8) arithmetic and matrices, field-compatible with the reference.
+
+The reference's EC math lives in github.com/klauspost/reedsolomon (a Go
+port of Backblaze's JavaReedSolomon), imported at
+weed/storage/erasure_coding/ec_encoder.go:13. That library fixes:
+
+  * the field: GF(2^8) with reducing polynomial x^8+x^4+x^3+x^2+1
+    (0x11D), generator element 2;
+  * the code matrix: a systematic matrix derived from the Vandermonde
+    matrix V[r][c] = r^c (element exponentiation in the field) as
+    A = V · (V[:k])^-1, so A's top k rows are the identity.
+
+Shards produced here are therefore byte-identical to shards produced
+by the reference, which is what makes mixed clusters and on-disk
+compatibility possible. Everything in this module is numpy/host-side;
+the bulk byte streams go through the codec backends.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
+FIELD_SIZE = 256
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    exp = np.zeros(510, dtype=np.uint8)  # exp[i] = 2^i, doubled to skip mod
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= _POLY
+    exp[255:510] = exp[0:255]
+    log[0] = -1  # log(0) undefined
+    return exp, log
+
+
+EXP_TABLE, LOG_TABLE = _build_tables()
+
+# Full 256x256 multiplication table: MUL[a, b] = a·b in the field.
+# 64 KB; the CPU codec indexes rows of this as per-coefficient LUTs.
+_a = np.arange(256)
+MUL_TABLE = np.zeros((256, 256), dtype=np.uint8)
+_nz = _a[1:]
+_log_sum = LOG_TABLE[_nz][:, None] + LOG_TABLE[_nz][None, :]
+MUL_TABLE[1:, 1:] = EXP_TABLE[_log_sum]
+del _a, _nz, _log_sum
+
+
+def gf_mul(a: int, b: int) -> int:
+    return int(MUL_TABLE[a, b])
+
+
+def gf_div(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError("division by zero in GF(2^8)")
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(LOG_TABLE[a] - LOG_TABLE[b]) % 255])
+
+
+def gf_inv(a: int) -> int:
+    return gf_div(1, a)
+
+
+def gf_exp(a: int, n: int) -> int:
+    """a^n in the field — matches the reference library's galExp:
+    n==0 → 1 (even for a==0), a==0 → 0."""
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(int(LOG_TABLE[a]) * n) % 255])
+
+
+# --- matrices over GF(2^8), stored as uint8 numpy arrays -------------------
+
+def identity(n: int) -> np.ndarray:
+    return np.eye(n, dtype=np.uint8)
+
+
+def mat_mul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Matrix product in GF(2^8): XOR-accumulate of MUL_TABLE gathers."""
+    assert a.shape[1] == b.shape[0]
+    out = np.zeros((a.shape[0], b.shape[1]), dtype=np.uint8)
+    for k in range(a.shape[1]):
+        out ^= MUL_TABLE[a[:, k][:, None], b[k, :][None, :]]
+    return out
+
+
+def mat_inv(m: np.ndarray) -> np.ndarray:
+    """Gauss-Jordan inversion in GF(2^8). Raises on singular input."""
+    n = m.shape[0]
+    assert m.shape == (n, n)
+    work = np.concatenate([m.astype(np.uint8), identity(n)], axis=1)
+    for col in range(n):
+        pivot = None
+        for row in range(col, n):
+            if work[row, col] != 0:
+                pivot = row
+                break
+        if pivot is None:
+            raise np.linalg.LinAlgError("matrix is singular in GF(2^8)")
+        if pivot != col:
+            work[[col, pivot]] = work[[pivot, col]]
+        inv_p = gf_inv(int(work[col, col]))
+        work[col] = MUL_TABLE[inv_p, work[col]]
+        for row in range(n):
+            if row != col and work[row, col] != 0:
+                work[row] ^= MUL_TABLE[int(work[row, col]), work[col]]
+    return work[:, n:].copy()
+
+
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    """V[r][c] = r^c, the reference library's starting matrix."""
+    return np.array(
+        [[gf_exp(r, c) for c in range(cols)] for r in range(rows)], dtype=np.uint8
+    )
+
+
+def build_code_matrix(data_shards: int, total_shards: int) -> np.ndarray:
+    """The systematic RS code matrix used by the reference library:
+    A = V · (V[:k])^-1. Top k rows are the identity; rows k..n are the
+    parity coefficient rows."""
+    vm = vandermonde(total_shards, data_shards)
+    top_inv = mat_inv(vm[:data_shards])
+    a = mat_mul(vm, top_inv)
+    assert np.array_equal(a[:data_shards], identity(data_shards))
+    return a
+
+
+def sub_matrix_for_survivors(
+    code_matrix: np.ndarray, survivor_rows: list[int]
+) -> np.ndarray:
+    """Rows of the code matrix for a set of surviving shards."""
+    return code_matrix[np.array(survivor_rows, dtype=np.intp)].copy()

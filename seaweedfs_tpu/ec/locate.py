@@ -1,0 +1,95 @@
+"""EC striping interval math — (offset, size) spans → shard intervals.
+
+Pure-math port of reference weed/storage/erasure_coding/ec_locate.go
+(SURVEY.md §2.1 marks it "port verbatim"): a sealed volume is striped
+row-major over 10 data shards in two tiers — 1 GB rows first, then 1 MB
+rows — and reads translate byte spans of the original .dat into
+per-shard (shard_id, shard_offset, size) intervals.
+
+Includes the reference's row-count quirk (ec_locate.go:15): the number
+of large-block rows encoded into an Interval is derived as
+(dat_size + 10·small) // (large·10) so it can be recovered from a shard
+size alone.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+DATA_SHARDS = 10
+PARITY_SHARDS = 4
+TOTAL_SHARDS = DATA_SHARDS + PARITY_SHARDS
+LARGE_BLOCK_SIZE = 1024 * 1024 * 1024  # 1 GB
+SMALL_BLOCK_SIZE = 1024 * 1024  # 1 MB
+
+
+@dataclass(frozen=True)
+class Interval:
+    block_index: int
+    inner_block_offset: int
+    size: int
+    is_large_block: bool
+    large_block_rows_count: int
+
+    def to_shard_id_and_offset(
+        self, large_block_size: int = LARGE_BLOCK_SIZE, small_block_size: int = SMALL_BLOCK_SIZE
+    ) -> tuple[int, int]:
+        """(shard id, offset within that shard's .ec file)."""
+        offset = self.inner_block_offset
+        row_index = self.block_index // DATA_SHARDS
+        if self.is_large_block:
+            offset += row_index * large_block_size
+        else:
+            offset += (
+                self.large_block_rows_count * large_block_size
+                + row_index * small_block_size
+            )
+        return self.block_index % DATA_SHARDS, offset
+
+
+def _locate_within_blocks(block_length: int, offset: int) -> tuple[int, int]:
+    return offset // block_length, offset % block_length
+
+
+def _locate_offset(
+    large: int, small: int, dat_size: int, offset: int
+) -> tuple[int, bool, int]:
+    large_row_size = large * DATA_SHARDS
+    n_large_rows = dat_size // large_row_size
+    if offset < n_large_rows * large_row_size:
+        idx, inner = _locate_within_blocks(large, offset)
+        return idx, True, inner
+    idx, inner = _locate_within_blocks(small, offset - n_large_rows * large_row_size)
+    return idx, False, inner
+
+
+def locate_data(
+    large: int, small: int, dat_size: int, offset: int, size: int
+) -> list[Interval]:
+    """Split [offset, offset+size) of the original .dat into striping
+    intervals (ec_locate.go:11 LocateData)."""
+    block_index, is_large, inner = _locate_offset(large, small, dat_size, offset)
+    n_large_rows = (dat_size + DATA_SHARDS * small) // (large * DATA_SHARDS)
+
+    intervals: list[Interval] = []
+    while size > 0:
+        block_remaining = (large if is_large else small) - inner
+        take = min(size, block_remaining)
+        intervals.append(
+            Interval(
+                block_index=block_index,
+                inner_block_offset=inner,
+                size=take,
+                is_large_block=is_large,
+                large_block_rows_count=n_large_rows,
+            )
+        )
+        if size <= block_remaining:
+            return intervals
+        size -= take
+        block_index += 1
+        if is_large and block_index == n_large_rows * DATA_SHARDS:
+            is_large = False
+            block_index = 0
+        inner = 0
+    return intervals

@@ -46,9 +46,14 @@ def format_needle_id_cookie(key: int, cookie: int) -> str:
     return key_hex[non_zero:] + cookie_hex
 
 
+_MAX_KEY_COOKIE_LEN = (8 + 4) * 2  # (NeedleIdSize + CookieSize) hex chars
+
+
 def parse_needle_id_cookie(key_cookie: str) -> tuple[int, int]:
-    """needle.go:181 ParseNeedleIdCookie."""
+    """needle.go:181 ParseNeedleIdCookie (incl. the max-length check)."""
     if len(key_cookie) <= 8:
         raise ValueError(f"needle id too short: {key_cookie!r}")
+    if len(key_cookie) > _MAX_KEY_COOKIE_LEN:
+        raise ValueError(f"key hash too long: {key_cookie!r}")
     split = len(key_cookie) - 8
     return parse_needle_id(key_cookie[:split]), parse_cookie(key_cookie[split:])

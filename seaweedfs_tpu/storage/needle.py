@@ -92,7 +92,9 @@ class Needle:
     last_modified: int = 0  # unix seconds, 5 bytes stored
     ttl: TTL | None = None
 
-    checksum: int = 0  # masked CRC32-C of data, set on encode/parse
+    checksum: int = 0  # RAW CRC32-C of data (the reference's n.Checksum;
+    # the on-disk trailer stores masked_value(checksum), but Etag and
+    # gRPC surfaces expose the raw value — crc.go Etag())
     append_at_ns: int = 0  # v3 only
 
     # --- flag helpers (needle.go Set*/Has*) ---
@@ -162,7 +164,8 @@ class Needle:
         edge cases: empty data ⇒ size 0 and an empty body; name longer
         than 255 is truncated via NameSize capping.
         """
-        self.checksum = masked_value(crc32c(self.data))
+        self.checksum = crc32c(self.data)
+        stored_checksum = masked_value(self.checksum)
         out = bytearray()
         if version == VERSION1:
             self.size = len(self.data)
@@ -170,7 +173,7 @@ class Needle:
             out += bytesutil.put_u64(self.id)
             out += bytesutil.put_u32(self.size)
             out += self.data
-            out += bytesutil.put_u32(self.checksum)
+            out += bytesutil.put_u32(stored_checksum)
             out += bytes(padding_length(self.size, version))
             return bytes(out)
         if version not in (VERSION2, VERSION3):
@@ -205,7 +208,7 @@ class Needle:
                     raise ValueError("pairs longer than 64KB")
                 out += bytesutil.put_u16(len(self.pairs))
                 out += self.pairs
-        out += bytesutil.put_u32(self.checksum)
+        out += bytesutil.put_u32(stored_checksum)
         if version == VERSION3:
             out += bytesutil.put_u64(self.append_at_ns)
         out += bytes(padding_length(self.size, version))
@@ -249,8 +252,8 @@ class Needle:
             raise ValueError(f"unsupported needle version {version}")
         if n.size > 0:
             stored = bytesutil.get_u32(blob, h + n.size)
-            fresh = masked_value(crc32c(n.data))
-            if stored != fresh:
+            fresh = crc32c(n.data)
+            if stored != masked_value(fresh):
                 raise CorruptNeedle("CRC error! Data On Disk Corrupted")
             n.checksum = fresh
         if version == VERSION3:

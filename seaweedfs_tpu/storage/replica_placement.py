@@ -19,12 +19,16 @@ class ReplicaPlacement:
 
     @staticmethod
     def parse(t: str) -> "ReplicaPlacement":
+        # Characters past index 2 are ignored, matching the reference's
+        # switch that only handles positions 0-2; digits outside 0..2
+        # are rejected anywhere in the string, as the reference does.
         counts = [0, 0, 0]
         for i, c in enumerate(t):
             v = ord(c) - ord("0")
-            if not 0 <= v <= 2 or i > 2:
+            if not 0 <= v <= 2:
                 raise ValueError(f"unknown replication type {t!r}")
-            counts[i] = v
+            if i <= 2:
+                counts[i] = v
         return ReplicaPlacement(counts[0], counts[1], counts[2])
 
     @staticmethod

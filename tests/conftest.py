@@ -7,12 +7,22 @@ anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The host environment exports JAX_PLATFORMS=axon (the tunneled TPU)
+# and a sitecustomize imports jax at interpreter start, so the env var
+# is already baked into jax.config before this file runs. Funneling
+# test kernels through the tunnel is slow and wedges when two processes
+# race for the single chip — force the virtual CPU mesh via
+# jax.config.update, which is still honored before first backend use.
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses we spawn
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 

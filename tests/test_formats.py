@@ -304,6 +304,10 @@ class TestReplicaPlacement:
         with pytest.raises(ValueError):
             ReplicaPlacement.parse("003")
 
+    def test_extra_chars_ignored(self):
+        # reference's parser only inspects positions 0-2
+        assert ReplicaPlacement.parse("0010") == ReplicaPlacement.parse("001")
+
 
 class TestFileId:
     def test_format_strips_leading_zero_pairs(self):
@@ -321,6 +325,27 @@ class TestFileId:
         fid = FileId(3, 0x0144B2, 0xCAFEBABE)
         assert str(fid) == "3,0144b2cafebabe"
         assert FileId.parse(str(fid)) == fid
+
+    def test_etag_is_raw_unmasked_crc(self):
+        # reference crc.go Etag(): hex of the RAW crc; masking is only
+        # applied in the on-disk trailer.
+        n = Needle(cookie=1, id=2, data=b"hello world")
+        n.to_bytes(VERSION3)
+        assert n.checksum == crc32c(b"hello world")
+        assert n.etag() == f"{crc32c(b'hello world'):08x}"
+
+    def test_parse_sets_raw_checksum(self):
+        n = Needle(cookie=1, id=2, data=b"abc")
+        blob = n.to_bytes(VERSION3)
+        m = Needle.from_bytes(blob, VERSION3)
+        assert m.checksum == crc32c(b"abc")
+
+    def test_key_cookie_max_length(self):
+        # reference rejects key+cookie hex longer than 24 chars
+        with pytest.raises(ValueError, match="too long"):
+            parse_needle_id_cookie("0" * 25)
+        # exactly 24 is fine
+        assert parse_needle_id_cookie("0" * 16 + "deadbeef") == (0, 0xDEADBEEF)
 
     def test_rejects_nonstrict_hex(self):
         # Go strconv.ParseUint rejects signs/prefixes/underscores/space.
