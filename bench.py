@@ -1,0 +1,77 @@
+"""RS(10,4) erasure-encode throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "ec_encode_rs10_4", "value": <GB/s>, "unit": "GB/s",
+   "vs_baseline": <value / 40.0>}
+
+value   = data bytes erasure-coded per second (the bytes of the sealed
+          volume stream, i.e. the 10 data shards — same accounting as
+          timing the reference's `ec.encode` hot loop, the
+          klauspost/reedsolomon AVX2 Encode call at
+          weed/storage/erasure_coding/ec_encoder.go:173).
+baseline: the repo publishes no EC numbers (BASELINE.md), so the ratio
+          is against the 40 GB/s/chip north-star target from
+          BASELINE.json; vs_baseline >= 1.0 means target met.
+
+Method: the TPU codec kernel (bitsliced GF(2^8) XOR-matmul,
+seaweedfs_tpu/ec/codec_tpu.py) encodes a device-resident [10, N] uint8
+volume block stream. Data is generated on-device (no PCIe in the timed
+region); each timed iteration produces the [4, N] parity block. One
+fixed shape to pay the remote-compile cost once.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    # 64 MiB per shard on the real chip (640 MiB data per step);
+    # smaller when falling back to CPU so the bench stays quick.
+    shard_len = (64 if on_tpu else 4) * 1024 * 1024
+
+    from seaweedfs_tpu.ec.codec_tpu import TpuCodecKernels
+
+    kern = TpuCodecKernels(10, 4)
+
+    @jax.jit
+    def gen(key):
+        return jax.random.randint(
+            key, (10, shard_len), 0, 256, dtype=jnp.int32
+        ).astype(jnp.uint8)
+
+    data = gen(jax.random.PRNGKey(0))
+    data.block_until_ready()
+
+    encode = jax.jit(lambda d: kern.encode(d))
+    encode(data).block_until_ready()  # compile + warm
+
+    iters = 8 if on_tpu else 2
+    start = time.perf_counter()
+    for _ in range(iters):
+        parity = encode(data)
+    parity.block_until_ready()
+    elapsed = time.perf_counter() - start
+
+    data_bytes = 10 * shard_len * iters
+    gbps = data_bytes / elapsed / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": "ec_encode_rs10_4",
+                "value": round(gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / 40.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,10 +88,23 @@ def apply_matrix_bits_batch(a_bits: jnp.ndarray, inputs: jnp.ndarray) -> jnp.nda
     return jax.vmap(lambda x: apply_matrix_bits(a_bits, x))(inputs)
 
 
+_BITS_CACHE: dict[bytes, jnp.ndarray] = {}
+
+
+def _cached_bits(matrix: np.ndarray) -> jnp.ndarray:
+    """Device-resident bit-matrix, memoized — streaming encode calls
+    the backend once per IO batch with the same constant matrix."""
+    key = matrix.tobytes() + bytes(matrix.shape)
+    bits = _BITS_CACHE.get(key)
+    if bits is None:
+        bits = jnp.asarray(gf_matrix_to_bits(matrix))
+        _BITS_CACHE[key] = bits
+    return bits
+
+
 def tpu_apply_matrix(matrix: np.ndarray, inputs: np.ndarray) -> np.ndarray:
     """Host-interop backend for codec.ReedSolomon: numpy in, numpy out."""
-    a_bits = gf_matrix_to_bits(matrix)
-    out = apply_matrix_bits(jnp.asarray(a_bits), jnp.asarray(inputs))
+    out = apply_matrix_bits(_cached_bits(matrix), jnp.asarray(inputs))
     return np.asarray(jax.device_get(out))
 
 

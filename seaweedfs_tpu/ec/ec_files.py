@@ -191,16 +191,30 @@ def compact_idx_entries(idx_data: bytes) -> bytes:
     """Replay .idx entries last-wins into sorted .ecx bytes.
 
     Mirrors readCompactMap + AscendingVisit (ec_encoder.go:283-302,
-    compact_map.go): live entries are set; deletion entries tombstone an
-    existing key in place (the key stays, size=TombstoneFileSize) and
-    are ignored for unknown keys."""
+    compact_map.go): live entries are set; a delete tombstones an
+    existing entry in place (the key stays, size=TombstoneFileSize)
+    when the entry was inserted in ascending key order (the reference's
+    sorted `values` array) — a delete of an out-of-order insert (the
+    reference's `overflow` array) removes the key entirely, and a
+    delete of a zero-size entry is a no-op (CompactSection.Delete only
+    tombstones Size > 0). Unknown keys are ignored."""
     state: dict[int, tuple[int, int]] = {}
+    in_order: dict[int, bool] = {}
+    max_key_seen = -1
     for key, offset, size in idx_codec.iter_entries(idx_data):
         if offset != 0 and size != t.TOMBSTONE_FILE_SIZE:
+            if key not in state:
+                in_order[key] = key > max_key_seen
             state[key] = (offset, size)
+            max_key_seen = max(max_key_seen, key)
         else:
-            if key in state:
-                state[key] = (state[key][0], t.TOMBSTONE_FILE_SIZE)
+            old = state.get(key)
+            if old is None:
+                continue
+            if not in_order.get(key, True):
+                del state[key]  # overflow entries are removed outright
+            elif old[1] > 0 and old[1] != t.TOMBSTONE_FILE_SIZE:
+                state[key] = (old[0], t.TOMBSTONE_FILE_SIZE)
     keys = np.array(sorted(state), dtype=np.uint64)
     offsets = np.array([state[int(k)][0] for k in keys], dtype=np.uint64)
     sizes = np.array([state[int(k)][1] for k in keys], dtype=np.uint32)
@@ -259,14 +273,23 @@ def read_shard_intervals(
     """Read a .dat byte span back out of local shard files via the
     interval math — the single-host degraded-read building block."""
     out = bytearray()
-    for iv in locate.locate_data(large_block_size, small_block_size, dat_size, offset, size):
-        shard_id, shard_off = iv.to_shard_id_and_offset(
-            large_block_size, small_block_size
-        )
-        with open(base_file_name + to_ext(shard_id), "rb") as f:
+    handles: dict[int, object] = {}
+    try:
+        for iv in locate.locate_data(
+            large_block_size, small_block_size, dat_size, offset, size
+        ):
+            shard_id, shard_off = iv.to_shard_id_and_offset(
+                large_block_size, small_block_size
+            )
+            f = handles.get(shard_id)
+            if f is None:
+                f = handles[shard_id] = open(base_file_name + to_ext(shard_id), "rb")
             f.seek(shard_off)
             chunk = f.read(iv.size)
-        if len(chunk) < iv.size:
-            chunk += bytes(iv.size - len(chunk))
-        out += chunk
+            if len(chunk) < iv.size:
+                chunk += bytes(iv.size - len(chunk))
+            out += chunk
+    finally:
+        for f in handles.values():
+            f.close()
     return bytes(out)

@@ -55,6 +55,13 @@ def _locate_offset(
     large: int, small: int, dat_size: int, offset: int
 ) -> tuple[int, bool, int]:
     large_row_size = large * DATA_SHARDS
+    # NOTE: dat_size an EXACT multiple of the large row size is a known
+    # reference edge case: the encoder's strict-greater loop sends the
+    # final full row through the small tier, while this floor division
+    # counts it as a large row (ec_locate.go:52 vs ec_encoder.go:205) —
+    # reads of that last row would map to the wrong shard offsets. Kept
+    # bit-identical for wire compatibility; the volume layer never
+    # seals at an exact multiple (superblock + 8B-padded needles).
     n_large_rows = dat_size // large_row_size
     if offset < n_large_rows * large_row_size:
         idx, inner = _locate_within_blocks(large, offset)

@@ -1,0 +1,103 @@
+"""DiskLocation: one data directory of volumes and EC shards.
+
+Behavioral match of reference weed/storage/disk_location.go +
+disk_location_ec.go: scan the directory for `[collection_]<vid>.dat`
+volumes and `[collection_]<vid>.ec00-13` shard sets, load them, and
+serve vid→Volume / vid→EcVolume lookups. (The reference loads with an
+8-way worker pool; volumes here load sequentially — directory scan is
+not a hot path for this build.)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+from seaweedfs_tpu.storage.volume import Volume
+
+_DAT_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.dat$")
+_EC_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.ec(?P<shard>\d{2})$")
+
+
+def parse_volume_file_name(name: str) -> tuple[str, int] | None:
+    """volume file name → (collection, vid), or None if not a .dat."""
+    m = _DAT_RE.match(name)
+    if not m:
+        return None
+    return m.group("collection") or "", int(m.group("vid"))
+
+
+def parse_ec_shard_file_name(name: str) -> tuple[str, int, int] | None:
+    m = _EC_RE.match(name)
+    if not m:
+        return None
+    return m.group("collection") or "", int(m.group("vid")), int(m.group("shard"))
+
+
+class DiskLocation:
+    def __init__(self, directory: str, max_volume_count: int = 7):
+        self.directory = directory
+        self.max_volume_count = max_volume_count
+        self.volumes: dict[int, Volume] = {}
+        # vid -> EcVolume; populated by load_existing_volumes and the
+        # EC mount RPCs (seaweedfs_tpu/ec/ec_volume.py)
+        self.ec_volumes: dict[int, object] = {}
+
+    def load_existing_volumes(self) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        for name in sorted(os.listdir(self.directory)):
+            parsed = parse_volume_file_name(name)
+            if parsed is None:
+                continue
+            collection, vid = parsed
+            if vid in self.volumes:
+                continue
+            try:
+                self.volumes[vid] = Volume(
+                    self.directory, vid, collection, create=False
+                )
+            except (OSError, ValueError):
+                continue  # unloadable volume; reference logs and skips
+        self._load_ec_shards()
+
+    def _load_ec_shards(self) -> None:
+        from seaweedfs_tpu.ec.ec_volume import EcVolume
+
+        shard_sets: dict[tuple[str, int], list[int]] = {}
+        for name in sorted(os.listdir(self.directory)):
+            parsed = parse_ec_shard_file_name(name)
+            if parsed is None:
+                continue
+            collection, vid, shard = parsed
+            shard_sets.setdefault((collection, vid), []).append(shard)
+        for (collection, vid), shards in shard_sets.items():
+            if vid in self.ec_volumes:
+                continue
+            try:
+                self.ec_volumes[vid] = EcVolume.load(
+                    self.directory, vid, collection
+                )
+            except (OSError, ValueError):
+                continue
+
+    def find_volume(self, vid: int) -> Optional[Volume]:
+        return self.volumes.get(vid)
+
+    def has_volume(self, vid: int) -> bool:
+        return vid in self.volumes
+
+    def delete_volume(self, vid: int) -> bool:
+        v = self.volumes.pop(vid, None)
+        if v is None:
+            return False
+        v.destroy()
+        return True
+
+    def close(self) -> None:
+        for v in self.volumes.values():
+            v.close()
+        for ev in self.ec_volumes.values():
+            close = getattr(ev, "close", None)
+            if close:
+                close()

@@ -1,0 +1,191 @@
+"""Per-volume needle index: id → (offset units, size), with metrics.
+
+Behavioral match of reference weed/storage/needle_map.go +
+needle_map_memory.go: every Put/Delete is appended to the .idx file
+(the map is the .idx replayed), metrics track live/deleted counts and
+bytes, deletes keep a tombstone entry. The in-memory representation
+here is a plain dict — the reference's CompactMap is a Go-specific
+memory optimization (16B/entry arrays); the observable semantics
+(last-wins replay, tombstones, metrics, ascending visit) are what the
+rest of the system depends on.
+
+A numpy-backed sorted snapshot (SortedNeedleMap) covers the
+sorted-file/.ecx binary-search use cases (needle_map_sorted_file.go).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from seaweedfs_tpu.storage import idx as idx_codec
+from seaweedfs_tpu.storage import types as t
+
+
+@dataclass(frozen=True)
+class NeedleValue:
+    key: int
+    offset: int  # units of 8 bytes (types.py)
+    size: int
+
+    @property
+    def actual_offset(self) -> int:
+        return t.units_to_offset(self.offset)
+
+
+class CompactNeedleMap:
+    """In-memory map mirrored to an append-only .idx file."""
+
+    def __init__(self, index_path: str):
+        self._index_path = index_path
+        self._m: dict[int, tuple[int, int]] = {}  # key -> (offset, size)
+        self._index_file = None
+        # mapMetric (needle_map_metric.go)
+        self.file_count = 0
+        self.file_byte_count = 0
+        self.deletion_count = 0
+        self.deletion_byte_count = 0
+        self.max_file_key = 0
+
+    # --- lifecycle ---
+    @classmethod
+    def load(cls, index_path: str) -> "CompactNeedleMap":
+        """Replay an existing .idx (doLoading, needle_map_memory.go:30)."""
+        nm = cls(index_path)
+        if os.path.exists(index_path):
+            with open(index_path, "rb") as f:
+                data = f.read()
+            for key, offset, size in idx_codec.iter_entries(data):
+                nm._replay(key, offset, size)
+        nm._index_file = open(index_path, "ab")
+        return nm
+
+    def _replay(self, key: int, offset: int, size: int) -> None:
+        self.max_file_key = max(self.max_file_key, key)
+        if offset != 0 and size != t.TOMBSTONE_FILE_SIZE:
+            self.file_count += 1
+            self.file_byte_count += size
+            old = self._m.get(key)
+            self._m[key] = (offset, size)
+            if old is not None and old[0] != 0 and old[1] != t.TOMBSTONE_FILE_SIZE:
+                self.deletion_count += 1
+                self.deletion_byte_count += old[1]
+        else:
+            old_size = self._delete_in_memory(key)
+            self.deletion_count += 1
+            self.deletion_byte_count += old_size
+
+    def _delete_in_memory(self, key: int) -> int:
+        old = self._m.get(key)
+        if old is None or old[1] == t.TOMBSTONE_FILE_SIZE:
+            return 0
+        self._m[key] = (old[0], t.TOMBSTONE_FILE_SIZE)
+        return old[1]
+
+    def _append_index(self, key: int, offset: int, size: int) -> None:
+        if self._index_file is None:
+            self._index_file = open(self._index_path, "ab")
+        self._index_file.write(idx_codec.pack_entry(key, offset, size))
+        self._index_file.flush()
+
+    # --- NeedleMapper surface (needle_map.go:22-33) ---
+    def put(self, key: int, offset: int, size: int) -> None:
+        old = self._m.get(key)
+        self._m[key] = (offset, size)
+        # logPut metric accounting
+        self.max_file_key = max(self.max_file_key, key)
+        if old is not None and old[1] != t.TOMBSTONE_FILE_SIZE:
+            self.deletion_count += 1
+            self.deletion_byte_count += old[1]
+        self.file_count += 1
+        self.file_byte_count += size
+        self._append_index(key, offset, size)
+
+    def get(self, key: int) -> Optional[NeedleValue]:
+        v = self._m.get(key)
+        if v is None:
+            return None
+        return NeedleValue(key, v[0], v[1])
+
+    def delete(self, key: int, offset: int) -> int:
+        """Tombstone `key`; `offset` is the tombstone record's position
+        in the .dat (recorded in the .idx entry). Returns freed bytes."""
+        freed = self._delete_in_memory(key)
+        self.deletion_count += 1
+        self.deletion_byte_count += freed
+        self._append_index(key, offset, t.TOMBSTONE_FILE_SIZE)
+        return freed
+
+    def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
+        for key in sorted(self._m):
+            offset, size = self._m[key]
+            fn(NeedleValue(key, offset, size))
+
+    def items(self) -> Iterator[NeedleValue]:
+        for key, (offset, size) in self._m.items():
+            yield NeedleValue(key, offset, size)
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+    # --- metrics surface ---
+    def content_size(self) -> int:
+        return self.file_byte_count
+
+    def deleted_size(self) -> int:
+        return self.deletion_byte_count
+
+    def index_file_size(self) -> int:
+        try:
+            return os.path.getsize(self._index_path)
+        except OSError:
+            return 0
+
+    def close(self) -> None:
+        if self._index_file is not None:
+            self._index_file.close()
+            self._index_file = None
+
+    def destroy(self) -> None:
+        self.close()
+        if os.path.exists(self._index_path):
+            os.remove(self._index_path)
+
+
+class SortedNeedleMap:
+    """Read-only binary-searchable snapshot of a sorted index file
+    (.ecx or sorted .idx) held as numpy arrays — the vectorized
+    equivalent of needle_map_sorted_file.go / ec_volume.go:199
+    SearchNeedleFromSortedIndex."""
+
+    def __init__(self, keys: np.ndarray, offsets: np.ndarray, sizes: np.ndarray):
+        self.keys = keys
+        self.offsets = offsets
+        self.sizes = sizes
+
+    @classmethod
+    def load(cls, path: str) -> "SortedNeedleMap":
+        with open(path, "rb") as f:
+            data = f.read()
+        keys, offsets, sizes = idx_codec.entries_as_arrays(data)
+        return cls(keys, offsets, sizes)
+
+    def search(self, key: int) -> Optional[NeedleValue]:
+        i = int(np.searchsorted(self.keys, np.uint64(key)))
+        if i >= len(self.keys) or int(self.keys[i]) != key:
+            return None
+        return NeedleValue(key, int(self.offsets[i]), int(self.sizes[i]))
+
+    def entry_index(self, key: int) -> int:
+        """Index of `key`'s 16-byte entry in the backing file, or -1 —
+        used to tombstone entries in place (MarkNeedleDeleted)."""
+        i = int(np.searchsorted(self.keys, np.uint64(key)))
+        if i >= len(self.keys) or int(self.keys[i]) != key:
+            return -1
+        return i
+
+    def __len__(self) -> int:
+        return len(self.keys)

@@ -1,0 +1,204 @@
+"""Store: the per-server storage engine over N disk locations.
+
+Behavioral match of reference weed/storage/store.go + store_ec.go
+(local parts): vid→Volume dispatch for write/read/delete, volume
+allocate/mount/delete, EC volume lookup and shard mount/unmount, and
+heartbeat assembly (the master-facing volume + EC-shard inventory).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from seaweedfs_tpu.ec.ec_volume import EcVolume
+from seaweedfs_tpu.storage.disk_location import DiskLocation
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
+from seaweedfs_tpu.storage.ttl import TTL
+from seaweedfs_tpu.storage.volume import NeedleNotFound, Volume
+
+
+@dataclass
+class VolumeInfo:
+    """One volume's heartbeat row (pb VolumeInformationMessage)."""
+
+    id: int
+    size: int
+    collection: str
+    file_count: int
+    delete_count: int
+    deleted_byte_count: int
+    read_only: bool
+    replica_placement: int
+    version: int
+    ttl: int
+
+    @staticmethod
+    def from_volume(v: Volume) -> "VolumeInfo":
+        return VolumeInfo(
+            id=v.id,
+            size=v.data_file_size(),
+            collection=v.collection,
+            file_count=v.file_count(),
+            delete_count=v.deleted_count(),
+            deleted_byte_count=v.deleted_size(),
+            read_only=v.read_only,
+            replica_placement=v.super_block.replica_placement.to_byte(),
+            version=v.version,
+            ttl=v.ttl.to_uint32(),
+        )
+
+
+@dataclass
+class EcShardInfo:
+    """One EC volume's heartbeat row (pb VolumeEcShardInformationMessage):
+    vid + bitmask of locally mounted shards."""
+
+    id: int
+    collection: str
+    ec_index_bits: int
+
+
+@dataclass
+class Heartbeat:
+    max_file_key: int
+    volumes: list[VolumeInfo] = field(default_factory=list)
+    ec_shards: list[EcShardInfo] = field(default_factory=list)
+
+
+class Store:
+    def __init__(self, directories: list[str], max_volume_counts: list[int] | None = None):
+        counts = max_volume_counts or [7] * len(directories)
+        self.locations = [
+            DiskLocation(d, c) for d, c in zip(directories, counts)
+        ]
+        for loc in self.locations:
+            loc.load_existing_volumes()
+
+    # --- volume management (store.go:165-226) ---
+    def has_volume(self, vid: int) -> bool:
+        return self.find_volume(vid) is not None
+
+    def find_volume(self, vid: int) -> Optional[Volume]:
+        for loc in self.locations:
+            v = loc.find_volume(vid)
+            if v is not None:
+                return v
+        return None
+
+    def find_free_location(self) -> Optional[DiskLocation]:
+        best, most_free = None, 0
+        for loc in self.locations:
+            free = loc.max_volume_count - len(loc.volumes)
+            if free > most_free:
+                best, most_free = loc, free
+        return best
+
+    def add_volume(
+        self,
+        vid: int,
+        collection: str = "",
+        replica_placement: str = "000",
+        ttl: str = "",
+        version: int = 3,
+    ) -> Volume:
+        if self.has_volume(vid):
+            raise ValueError(f"volume {vid} already exists")
+        loc = self.find_free_location()
+        if loc is None:
+            raise RuntimeError("no free disk location")
+        v = Volume(
+            loc.directory,
+            vid,
+            collection,
+            replica_placement=ReplicaPlacement.parse(replica_placement),
+            ttl=TTL.parse(ttl),
+            version=version,
+        )
+        loc.volumes[vid] = v
+        return v
+
+    def delete_volume(self, vid: int) -> bool:
+        for loc in self.locations:
+            if loc.delete_volume(vid):
+                return True
+        return False
+
+    def mark_volume_readonly(self, vid: int) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        v.read_only = True
+        return True
+
+    # --- needle IO (store.go:227-264) ---
+    def write_needle(self, vid: int, n: Needle) -> tuple[int, bool]:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NeedleNotFound(f"volume {vid} not found")
+        _, size, unchanged = v.write_needle(n)
+        return size, unchanged
+
+    def read_needle(self, vid: int, needle_id: int, cookie: int | None = None) -> Needle:
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.read_needle(needle_id, cookie)
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            return ev.read_needle(needle_id)
+        raise NeedleNotFound(f"volume {vid} not found")
+
+    def delete_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NeedleNotFound(f"volume {vid} not found")
+        return v.delete_needle(n)
+
+    # --- EC (store_ec.go local parts) ---
+    def find_ec_volume(self, vid: int) -> Optional[EcVolume]:
+        for loc in self.locations:
+            ev = loc.ec_volumes.get(vid)
+            if ev is not None:
+                return ev  # type: ignore[return-value]
+        return None
+
+    def mount_ec_shards(self, vid: int, collection: str, shard_ids: list[int]) -> EcVolume:
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            loc = self.locations[0]
+            ev = EcVolume(loc.directory, vid, collection)
+            loc.ec_volumes[vid] = ev
+        for sid in shard_ids:
+            ev.mount_shard(sid)
+        return ev
+
+    def unmount_ec_shards(self, vid: int, shard_ids: list[int]) -> None:
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            return
+        for sid in shard_ids:
+            ev.unmount_shard(sid)
+        if not ev.shards:
+            for loc in self.locations:
+                loc.ec_volumes.pop(vid, None)
+
+    # --- heartbeat (store.go CollectHeartbeat) ---
+    def collect_heartbeat(self) -> Heartbeat:
+        hb = Heartbeat(max_file_key=0)
+        for loc in self.locations:
+            for v in loc.volumes.values():
+                hb.max_file_key = max(hb.max_file_key, v.max_file_key())
+                hb.volumes.append(VolumeInfo.from_volume(v))
+            for vid, ev in loc.ec_volumes.items():
+                bits = 0
+                for sid in ev.shard_ids():  # type: ignore[attr-defined]
+                    bits |= 1 << sid
+                hb.ec_shards.append(
+                    EcShardInfo(vid, ev.collection, bits)  # type: ignore[attr-defined]
+                )
+        return hb
+
+    def close(self) -> None:
+        for loc in self.locations:
+            loc.close()

@@ -1,0 +1,356 @@
+"""Volume: one append-only .dat + .idx pair and its life cycle.
+
+Behavioral match of reference weed/storage/volume.go +
+volume_read_write.go + volume_loading.go + volume_checking.go:
+
+  * creation writes an 8-byte superblock (version, replica placement,
+    TTL, compaction revision);
+  * writes append a needle record, update the needle map, and append an
+    .idx entry; duplicate identical writes are no-ops (isFileUnchanged);
+    a write to an existing id must present the same cookie;
+  * deletes append a tombstone needle (empty data, fresh AppendAtNs)
+    and a tombstone .idx entry pointing at it;
+  * reads check not-found / tombstone / TTL expiry and verify CRC;
+  * loading replays the .idx and validates its tail against the .dat
+    (CheckVolumeDataIntegrity);
+  * vacuum/compaction copies live needles to <name>.cpd/.cpx scratch
+    files and atomically swaps them in, bumping the superblock
+    compaction revision (volume_vacuum.go).
+
+File naming (volume.go FileName): <dir>/<collection>_<vid> or
+<dir>/<vid> when the collection is empty.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.needle import (
+    CorruptNeedle,
+    Needle,
+    get_actual_size,
+)
+from seaweedfs_tpu.storage.needle_map import CompactNeedleMap, NeedleValue
+from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
+from seaweedfs_tpu.storage.super_block import CURRENT_VERSION, SuperBlock
+from seaweedfs_tpu.storage.ttl import TTL
+
+
+class NeedleNotFound(KeyError):
+    pass
+
+
+class VolumeReadOnly(RuntimeError):
+    pass
+
+
+class CookieMismatch(ValueError):
+    pass
+
+
+def volume_base_name(directory: str, collection: str, vid: int) -> str:
+    if collection:
+        return os.path.join(directory, f"{collection}_{vid}")
+    return os.path.join(directory, str(vid))
+
+
+class Volume:
+    def __init__(
+        self,
+        directory: str,
+        vid: int,
+        collection: str = "",
+        replica_placement: ReplicaPlacement | None = None,
+        ttl: TTL | None = None,
+        version: int = CURRENT_VERSION,
+        create: bool = True,
+    ):
+        self.id = vid
+        self.collection = collection
+        self.dir = directory
+        self.base_name = volume_base_name(directory, collection, vid)
+        self.read_only = False
+        self.last_append_at_ns = 0
+        self._lock = threading.RLock()
+
+        dat_path = self.base_name + ".dat"
+        exists = os.path.exists(dat_path)
+        if not exists:
+            if not create:
+                raise FileNotFoundError(dat_path)
+            self.super_block = SuperBlock(
+                version=version,
+                replica_placement=replica_placement or ReplicaPlacement(),
+                ttl=ttl or TTL(),
+            )
+            with open(dat_path, "wb") as f:
+                f.write(self.super_block.to_bytes())
+        self._dat = open(dat_path, "r+b")
+        if exists:
+            self.super_block = SuperBlock.read_from(self._dat)
+        self.nm = CompactNeedleMap.load(self.base_name + ".idx")
+        if exists:
+            self._check_integrity()
+
+    # --- properties ---
+    @property
+    def version(self) -> int:
+        return self.super_block.version
+
+    @property
+    def ttl(self) -> TTL:
+        return self.super_block.ttl
+
+    def data_file_size(self) -> int:
+        self._dat.seek(0, os.SEEK_END)
+        return self._dat.tell()
+
+    def content_size(self) -> int:
+        return self.nm.content_size()
+
+    def deleted_size(self) -> int:
+        return self.nm.deleted_size()
+
+    def file_count(self) -> int:
+        return self.nm.file_count
+
+    def deleted_count(self) -> int:
+        return self.nm.deletion_count
+
+    def max_file_key(self) -> int:
+        return self.nm.max_file_key
+
+    def garbage_level(self) -> float:
+        """Fraction of the .dat occupied by deleted records
+        (volume_vacuum.go garbageLevel)."""
+        size = self.data_file_size()
+        if size == 0:
+            return 0.0
+        return self.nm.deleted_size() / size
+
+    # --- integrity (volume_checking.go:14) ---
+    def _check_integrity(self) -> None:
+        idx_size = self.nm.index_file_size()
+        if idx_size == 0:
+            return
+        with open(self.base_name + ".idx", "rb") as f:
+            f.seek(idx_size - t.NEEDLE_MAP_ENTRY_SIZE)
+            from seaweedfs_tpu.storage import idx as idx_codec
+
+            key, offset, size = idx_codec.unpack_entry(f.read(t.NEEDLE_MAP_ENTRY_SIZE))
+        if offset == 0:
+            return
+        if size == t.TOMBSTONE_FILE_SIZE:
+            size = 0  # the tombstone .dat record is an empty-data needle
+        actual = t.units_to_offset(offset)
+        record_end = actual + get_actual_size(size, self.version)
+        if record_end > self.data_file_size():
+            raise CorruptNeedle(
+                f"volume {self.id}: last index entry [key {key}] ends at "
+                f"{record_end} past .dat size {self.data_file_size()}"
+            )
+        # recover lastAppendAtNs from the last record (v3)
+        blob = self._read_at(actual, get_actual_size(size, self.version))
+        try:
+            n = Needle.from_bytes(blob, self.version, size=size)
+            self.last_append_at_ns = n.append_at_ns
+        except CorruptNeedle:
+            raise
+
+    def _read_at(self, offset: int, length: int) -> bytes:
+        self._dat.seek(offset)
+        return self._dat.read(length)
+
+    def _append_blob(self, blob: bytes) -> int:
+        self._dat.seek(0, os.SEEK_END)
+        offset = self._dat.tell()
+        if offset % t.NEEDLE_PADDING_SIZE != 0:
+            # realign, matching the reference's defensive padding
+            pad = t.NEEDLE_PADDING_SIZE - offset % t.NEEDLE_PADDING_SIZE
+            self._dat.write(bytes(pad))
+            offset += pad
+        self._dat.write(blob)
+        self._dat.flush()
+        return offset
+
+    def _now_ns(self) -> int:
+        ns = time.time_ns()
+        if ns <= self.last_append_at_ns:
+            ns = self.last_append_at_ns + 1
+        return ns
+
+    # --- write path (volume_read_write.go:66 writeNeedle) ---
+    def write_needle(self, n: Needle) -> tuple[int, int, bool]:
+        """Returns (offset, size, is_unchanged)."""
+        with self._lock:
+            if self.read_only:
+                raise VolumeReadOnly(f"volume {self.id} is read-only")
+            if self._is_file_unchanged(n):
+                return 0, n.size, True
+            if n.ttl is None and self.ttl.count != 0:
+                n.set_has_ttl()
+                n.ttl = self.ttl
+
+            existing = self.nm.get(n.Id if hasattr(n, "Id") else n.id)
+            if existing is not None and existing.size != t.TOMBSTONE_FILE_SIZE:
+                old = self._read_needle_at(existing)
+                if old is not None and old.cookie != n.cookie:
+                    raise CookieMismatch(
+                        f"mismatching cookie {n.cookie:08x} for needle {n.id}"
+                    )
+
+            n.append_at_ns = self._now_ns()
+            blob = n.to_bytes(self.version)
+            offset = self._append_blob(blob)
+            self.last_append_at_ns = n.append_at_ns
+
+            if existing is None or existing.actual_offset < offset:
+                self.nm.put(n.id, t.offset_to_units(offset), n.size)
+            return offset, n.size, False
+
+    def _is_file_unchanged(self, n: Needle) -> bool:
+        if str(self.ttl):
+            return False
+        nv = self.nm.get(n.id)
+        if nv is None or nv.offset == 0 or nv.size == t.TOMBSTONE_FILE_SIZE:
+            return False
+        old = self._read_needle_at(nv)
+        return (
+            old is not None
+            and old.cookie == n.cookie
+            and old.data == n.data
+        )
+
+    def _read_needle_at(self, nv: NeedleValue) -> Optional[Needle]:
+        try:
+            blob = self._read_at(
+                nv.actual_offset, get_actual_size(nv.size, self.version)
+            )
+            return Needle.from_bytes(blob, self.version, size=nv.size)
+        except (CorruptNeedle, ValueError):
+            return None
+
+    # --- delete path (volume_read_write.go:115 deleteNeedle) ---
+    def delete_needle(self, n: Needle) -> int:
+        """Appends a tombstone record; returns the freed byte count."""
+        with self._lock:
+            if self.read_only:
+                raise VolumeReadOnly(f"volume {self.id} is read-only")
+            nv = self.nm.get(n.id)
+            if nv is None or nv.size == t.TOMBSTONE_FILE_SIZE:
+                return 0
+            freed = nv.size
+            n.data = b""
+            n.append_at_ns = self._now_ns()
+            blob = n.to_bytes(self.version)
+            offset = self._append_blob(blob)
+            self.last_append_at_ns = n.append_at_ns
+            self.nm.delete(n.id, t.offset_to_units(offset))
+            return freed
+
+    # --- read path (volume_read_write.go:139 readNeedle) ---
+    def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:
+        with self._lock:
+            nv = self.nm.get(needle_id)
+            if nv is None or nv.offset == 0:
+                raise NeedleNotFound(f"needle {needle_id} not found")
+            if nv.size == t.TOMBSTONE_FILE_SIZE:
+                raise NeedleNotFound(f"needle {needle_id} already deleted")
+            blob = self._read_at(
+                nv.actual_offset, get_actual_size(nv.size, self.version)
+            )
+        n = Needle.from_bytes(blob, self.version, size=nv.size)
+        if cookie is not None and n.cookie != cookie:
+            raise CookieMismatch(
+                f"cookie mismatch for needle {needle_id}"
+            )
+        if n.has_ttl() and n.ttl is not None and n.ttl.minutes and n.has_last_modified_date():
+            expires = n.last_modified + n.ttl.minutes * 60
+            if time.time() >= expires:
+                raise NeedleNotFound(f"needle {needle_id} expired")
+        return n
+
+    def has_needle(self, needle_id: int) -> bool:
+        nv = self.nm.get(needle_id)
+        return nv is not None and nv.offset != 0 and nv.size != t.TOMBSTONE_FILE_SIZE
+
+    # --- vacuum (volume_vacuum.go) ---
+    def compact(self) -> None:
+        """Copy live needles to .cpd/.cpx scratch files.
+
+        The reference's Compact runs concurrently with writes and
+        replays a catch-up diff on commit (makeupDiff); here compaction
+        holds the volume lock, which is the same observable result with
+        simpler invariants (single-writer volumes, SURVEY §5 race notes).
+        """
+        with self._lock:
+            cpd = self.base_name + ".cpd"
+            cpx = self.base_name + ".cpx"
+            new_sb = SuperBlock(
+                version=self.super_block.version,
+                replica_placement=self.super_block.replica_placement,
+                ttl=self.super_block.ttl,
+                compaction_revision=self.super_block.compaction_revision + 1,
+                extra=self.super_block.extra,
+            )
+            with open(cpd, "wb") as dat_out, open(cpx, "wb") as idx_out:
+                dat_out.write(new_sb.to_bytes())
+                from seaweedfs_tpu.storage import idx as idx_codec
+
+                def visit(nv: NeedleValue) -> None:
+                    if nv.offset == 0 or nv.size == t.TOMBSTONE_FILE_SIZE:
+                        return
+                    blob = self._read_at(
+                        nv.actual_offset, get_actual_size(nv.size, self.version)
+                    )
+                    new_offset = dat_out.tell()
+                    dat_out.write(blob)
+                    idx_out.write(
+                        idx_codec.pack_entry(
+                            nv.key, t.offset_to_units(new_offset), nv.size
+                        )
+                    )
+
+                self.nm.ascending_visit(visit)
+
+    def commit_compact(self) -> None:
+        """Swap .cpd/.cpx in as the live files (volume_vacuum.go:157)."""
+        with self._lock:
+            cpd = self.base_name + ".cpd"
+            cpx = self.base_name + ".cpx"
+            if not (os.path.exists(cpd) and os.path.exists(cpx)):
+                raise FileNotFoundError("no compaction scratch files to commit")
+            self._dat.close()
+            self.nm.close()
+            os.replace(cpd, self.base_name + ".dat")
+            os.replace(cpx, self.base_name + ".idx")
+            self._dat = open(self.base_name + ".dat", "r+b")
+            self.super_block = SuperBlock.read_from(self._dat)
+            # rebuild the in-memory map from the fresh index
+            os.replace(self.base_name + ".idx", self.base_name + ".idx.tmp")
+            os.replace(self.base_name + ".idx.tmp", self.base_name + ".idx")
+            self.nm = CompactNeedleMap.load(self.base_name + ".idx")
+
+    def cleanup_compact(self) -> None:
+        for ext in (".cpd", ".cpx"):
+            path = self.base_name + ext
+            if os.path.exists(path):
+                os.remove(path)
+
+    # --- lifecycle ---
+    def close(self) -> None:
+        with self._lock:
+            self.nm.close()
+            self._dat.close()
+
+    def destroy(self) -> None:
+        with self._lock:
+            self.close()
+            for ext in (".dat", ".idx", ".cpd", ".cpx"):
+                path = self.base_name + ext
+                if os.path.exists(path):
+                    os.remove(path)

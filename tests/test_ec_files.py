@@ -184,6 +184,34 @@ class TestEcx:
                 live[key] = (off, size)
         assert set(int(k) for k in keys) == set(live)
 
+    def test_delete_of_out_of_order_insert_removed(self, tmp_path):
+        # reference CompactMap: out-of-order inserts land in `overflow`,
+        # and Delete removes overflow entries entirely
+        base = str(tmp_path / "oo")
+        entries = (
+            idx_codec.pack_entry(10, 1, 100)
+            + idx_codec.pack_entry(4, 2, 200)  # out of order -> overflow
+            + idx_codec.pack_entry(4, 0, t.TOMBSTONE_FILE_SIZE)
+            + idx_codec.pack_entry(10, 0, t.TOMBSTONE_FILE_SIZE)
+        )
+        with open(base + ".idx", "wb") as f:
+            f.write(entries)
+        ec_files.write_sorted_file_from_idx(base)
+        got = list(idx_codec.iter_entries(open(base + ".ecx", "rb").read()))
+        assert got == [(10, 1, t.TOMBSTONE_FILE_SIZE)]
+
+    def test_delete_of_zero_size_entry_is_noop(self, tmp_path):
+        base = str(tmp_path / "zz")
+        entries = (
+            idx_codec.pack_entry(3, 5, 0)  # live zero-size needle
+            + idx_codec.pack_entry(3, 0, t.TOMBSTONE_FILE_SIZE)
+        )
+        with open(base + ".idx", "wb") as f:
+            f.write(entries)
+        ec_files.write_sorted_file_from_idx(base)
+        got = list(idx_codec.iter_entries(open(base + ".ecx", "rb").read()))
+        assert got == [(3, 5, 0)]
+
     def test_delete_entries_tombstone(self, tmp_path):
         base = str(tmp_path / "2")
         entries = (
