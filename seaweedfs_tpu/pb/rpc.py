@@ -1,0 +1,128 @@
+"""gRPC service binding without grpc_tools.
+
+Each service is a method table {name: (RequestCls, ResponseCls, kind)};
+`servicer_handler` turns an implementation object into a generic
+handler for grpc.Server, and `Stub` builds the client-side callables on
+a channel. Equivalent to what generated *_pb2_grpc code does, minus the
+codegen dependency.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from seaweedfs_tpu.pb import master_pb2 as m
+from seaweedfs_tpu.pb import volume_pb2 as v
+
+UNARY_UNARY = "unary_unary"
+UNARY_STREAM = "unary_stream"
+STREAM_UNARY = "stream_unary"
+STREAM_STREAM = "stream_stream"
+
+MASTER_SERVICE = "seaweedfs_tpu.master.Master"
+MASTER_METHODS = {
+    "Heartbeat": (m.HeartbeatRequest, m.HeartbeatResponse, STREAM_STREAM),
+    "KeepConnected": (m.ClientHello, m.VolumeLocationDelta, STREAM_STREAM),
+    "Assign": (m.AssignRequest, m.AssignResponse, UNARY_UNARY),
+    "LookupVolume": (m.LookupVolumeRequest, m.LookupVolumeResponse, UNARY_UNARY),
+    "LookupEcVolume": (m.LookupEcVolumeRequest, m.LookupEcVolumeResponse, UNARY_UNARY),
+    "Statistics": (m.StatisticsRequest, m.StatisticsResponse, UNARY_UNARY),
+    "CollectionList": (m.CollectionListRequest, m.CollectionListResponse, UNARY_UNARY),
+    "CollectionDelete": (m.CollectionDeleteRequest, m.CollectionDeleteResponse, UNARY_UNARY),
+    "VolumeList": (m.VolumeListRequest, m.VolumeListResponse, UNARY_UNARY),
+    "GetMasterConfiguration": (
+        m.GetMasterConfigurationRequest,
+        m.GetMasterConfigurationResponse,
+        UNARY_UNARY,
+    ),
+}
+
+VOLUME_SERVICE = "seaweedfs_tpu.volume.VolumeServer"
+VOLUME_METHODS = {
+    "BatchDelete": (v.BatchDeleteRequest, v.BatchDeleteResponse, UNARY_UNARY),
+    "VacuumVolumeCheck": (v.VacuumVolumeCheckRequest, v.VacuumVolumeCheckResponse, UNARY_UNARY),
+    "VacuumVolumeCompact": (v.VacuumVolumeCompactRequest, v.VacuumVolumeCompactResponse, UNARY_UNARY),
+    "VacuumVolumeCommit": (v.VacuumVolumeCommitRequest, v.VacuumVolumeCommitResponse, UNARY_UNARY),
+    "VacuumVolumeCleanup": (v.VacuumVolumeCleanupRequest, v.VacuumVolumeCleanupResponse, UNARY_UNARY),
+    "AllocateVolume": (v.AllocateVolumeRequest, v.AllocateVolumeResponse, UNARY_UNARY),
+    "DeleteCollection": (v.DeleteCollectionRequest, v.DeleteCollectionResponse, UNARY_UNARY),
+    "VolumeDelete": (v.VolumeDeleteRequest, v.VolumeDeleteResponse, UNARY_UNARY),
+    "VolumeMarkReadonly": (v.VolumeMarkReadonlyRequest, v.VolumeMarkReadonlyResponse, UNARY_UNARY),
+    "VolumeSyncStatus": (v.VolumeSyncStatusRequest, v.VolumeSyncStatusResponse, UNARY_UNARY),
+    "VolumeCopy": (v.VolumeCopyRequest, v.VolumeCopyResponse, UNARY_UNARY),
+    "CopyFile": (v.CopyFileRequest, v.CopyFileResponse, UNARY_STREAM),
+    "VolumeIncrementalCopy": (
+        v.VolumeIncrementalCopyRequest,
+        v.VolumeIncrementalCopyResponse,
+        UNARY_STREAM,
+    ),
+    "VolumeEcShardsGenerate": (
+        v.VolumeEcShardsGenerateRequest,
+        v.VolumeEcShardsGenerateResponse,
+        UNARY_UNARY,
+    ),
+    "VolumeEcShardsRebuild": (
+        v.VolumeEcShardsRebuildRequest,
+        v.VolumeEcShardsRebuildResponse,
+        UNARY_UNARY,
+    ),
+    "VolumeEcShardsCopy": (v.VolumeEcShardsCopyRequest, v.VolumeEcShardsCopyResponse, UNARY_UNARY),
+    "VolumeEcShardsDelete": (
+        v.VolumeEcShardsDeleteRequest,
+        v.VolumeEcShardsDeleteResponse,
+        UNARY_UNARY,
+    ),
+    "VolumeEcShardsMount": (v.VolumeEcShardsMountRequest, v.VolumeEcShardsMountResponse, UNARY_UNARY),
+    "VolumeEcShardsUnmount": (
+        v.VolumeEcShardsUnmountRequest,
+        v.VolumeEcShardsUnmountResponse,
+        UNARY_UNARY,
+    ),
+    "VolumeEcShardRead": (v.VolumeEcShardReadRequest, v.VolumeEcShardReadResponse, UNARY_STREAM),
+    "VolumeEcBlobDelete": (v.VolumeEcBlobDeleteRequest, v.VolumeEcBlobDeleteResponse, UNARY_UNARY),
+    "VolumeEcShardsToVolume": (
+        v.VolumeEcShardsToVolumeRequest,
+        v.VolumeEcShardsToVolumeResponse,
+        UNARY_UNARY,
+    ),
+}
+
+
+def servicer_handler(service_name: str, methods: dict, impl) -> grpc.GenericRpcHandler:
+    """Bind `impl`'s methods (same names as the table) into a generic
+    gRPC handler. Methods receive (request_or_iterator, context)."""
+    handlers = {}
+    for name, (req_cls, _resp_cls, kind) in methods.items():
+        fn = getattr(impl, name)
+        factory = getattr(grpc, f"{kind}_rpc_method_handler")
+        handlers[name] = factory(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda msg: msg.SerializeToString(),
+        )
+    return grpc.method_handlers_generic_handler(service_name, handlers)
+
+
+class Stub:
+    """Client stub: one callable attribute per method."""
+
+    def __init__(self, channel: grpc.Channel, service_name: str, methods: dict):
+        for name, (req_cls, resp_cls, kind) in methods.items():
+            factory = getattr(channel, kind)
+            setattr(
+                self,
+                name,
+                factory(
+                    f"/{service_name}/{name}",
+                    request_serializer=lambda msg: msg.SerializeToString(),
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )
+
+
+def master_stub(channel: grpc.Channel) -> Stub:
+    return Stub(channel, MASTER_SERVICE, MASTER_METHODS)
+
+
+def volume_stub(channel: grpc.Channel) -> Stub:
+    return Stub(channel, VOLUME_SERVICE, VOLUME_METHODS)

@@ -1,0 +1,38 @@
+"""File-key sequencer: allocates needle-id ranges for /dir/assign.
+
+Behavioral match of reference weed/sequence/memory_sequencer.go: a
+counter starting at 1; NextFileId(count) hands out [counter,
+counter+count) and advances; SetMax lifts the counter when heartbeats
+report larger keys already in use (master_grpc_server.go via
+Topology). The etcd-backed variant (etcd_sequencer.go) plugs in behind
+the same two methods.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class MemorySequencer:
+    def __init__(self, start: int = 1):
+        self._counter = start
+        self._lock = threading.Lock()
+
+    def next_file_id(self, count: int = 1) -> int:
+        """Returns the first id of a freshly reserved range of `count`."""
+        with self._lock:
+            start = self._counter
+            self._counter += count
+            return start
+
+    def set_max(self, seen_value: int) -> None:
+        # '>=' so a reported key equal to the counter advances past it
+        # (memory_sequencer.go:28 `counter <= value`) — otherwise the
+        # next assign re-issues an id already on disk.
+        with self._lock:
+            if seen_value >= self._counter:
+                self._counter = seen_value + 1
+
+    def peek(self) -> int:
+        with self._lock:
+            return self._counter

@@ -1,0 +1,469 @@
+"""Master server: cluster control plane over gRPC + HTTP.
+
+Behavioral match of the reference master
+(weed/server/master_server.go, master_grpc_server*.go,
+master_server_handlers*.go):
+
+  * gRPC Heartbeat stream: volume servers push full-state inventories;
+    the master registers them in the Topology, answers with the volume
+    size limit, and unregisters the node when the stream breaks —
+    liveness IS the stream (SURVEY §5 failure detection);
+  * gRPC KeepConnected: filers/shells hold this open and receive
+    vid→location deltas as volumes appear/disappear;
+  * HTTP /dir/assign /dir/lookup /vol/grow /col/delete /cluster/status
+    /stats/health — the public control API;
+  * automatic volume growth when an assign finds no writable volume
+    (AutomaticGrowByType), allocating on rack-aware placed nodes via
+    the volume servers' AllocateVolume RPC.
+
+Single-master build: the raft leader seam is `self.is_leader` plus the
+IdGenerator behind Topology.next_volume_id (SURVEY §7 "simplest
+possible leader election first, raft-compatible interface later").
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import random
+import threading
+import time
+from concurrent import futures
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import grpc
+
+from seaweedfs_tpu.pb import master_pb2 as pb
+from seaweedfs_tpu.pb import rpc, volume_pb2
+from seaweedfs_tpu.sequence import MemorySequencer
+from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
+from seaweedfs_tpu.storage.store import EcShardInfo, VolumeInfo
+from seaweedfs_tpu.storage.ttl import TTL
+from seaweedfs_tpu.topology import Topology
+from seaweedfs_tpu.topology.volume_growth import (
+    find_empty_slots_for_one_volume,
+    find_volume_count,
+)
+
+
+def _vol_info_from_pb(v: pb.VolumeStat) -> VolumeInfo:
+    return VolumeInfo(
+        id=v.id,
+        size=v.size,
+        collection=v.collection,
+        file_count=v.file_count,
+        delete_count=v.delete_count,
+        deleted_byte_count=v.deleted_byte_count,
+        read_only=v.read_only,
+        replica_placement=v.replica_placement,
+        version=v.version,
+        ttl=v.ttl,
+    )
+
+
+class MasterServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 9333,
+        volume_size_limit_mb: int = 30 * 1024,
+        default_replication: str = "000",
+        garbage_threshold: float = 0.3,
+    ):
+        self.host = host
+        self.port = port
+        self.grpc_port = port + 10000  # reference convention: http port + 10000
+        self.topology = Topology(volume_size_limit_mb * 1024 * 1024)
+        self.sequencer = MemorySequencer()
+        self.default_replication = default_replication
+        self.garbage_threshold = garbage_threshold
+        self.is_leader = True
+        self._grow_lock = threading.Lock()
+        self._clients: dict[int, queue.Queue] = {}
+        self._clients_seq = 0
+        self._clients_lock = threading.Lock()
+        self._grpc_server: grpc.Server | None = None
+        self._http_server: ThreadingHTTPServer | None = None
+
+    # ------------------------------------------------------------------
+    # location broadcast (master_grpc_server.go KeepConnected)
+    def _broadcast(self, url: str, public_url: str, new_vids: list[int], deleted_vids: list[int]) -> None:
+        msg = pb.VolumeLocationDelta(
+            location=pb.VolumeLocation(
+                url=url, public_url=public_url, new_vids=new_vids, deleted_vids=deleted_vids
+            )
+        )
+        with self._clients_lock:
+            for q in self._clients.values():
+                q.put(msg)
+
+    # ------------------------------------------------------------------
+    # gRPC servicer methods (bound via rpc.servicer_handler)
+    def Heartbeat(self, request_iterator, context):
+        dn = None
+        stream_token = object()
+        try:
+            for req in request_iterator:
+                if dn is None:
+                    dn = self.topology.register_data_node(
+                        ip=req.ip,
+                        port=req.port,
+                        public_url=req.public_url,
+                        data_center=req.data_center or "DefaultDataCenter",
+                        rack=req.rack or "DefaultRack",
+                        max_volumes=req.max_volume_count or 7,
+                    )
+                    # a reconnect takes ownership; the stale stream's
+                    # teardown must not unregister the live node
+                    dn.stream_token = stream_token
+                dn.last_seen = time.time()
+                self.sequencer.set_max(req.max_file_key)
+                if req.volumes or req.has_no_volumes:
+                    new, deleted = self.topology.sync_volumes(
+                        dn, [_vol_info_from_pb(v) for v in req.volumes]
+                    )
+                    if new or deleted:
+                        self._broadcast(
+                            dn.url,
+                            dn.public_url,
+                            [v.id for v in new],
+                            [v.id for v in deleted],
+                        )
+                if req.ec_shards or req.has_no_ec_shards:
+                    self.topology.sync_ec_shards(
+                        dn,
+                        [
+                            EcShardInfo(s.id, s.collection, s.ec_index_bits)
+                            for s in req.ec_shards
+                        ],
+                    )
+                yield pb.HeartbeatResponse(
+                    volume_size_limit=self.topology.volume_size_limit,
+                    leader=f"{self.host}:{self.port}",
+                )
+        finally:
+            if dn is not None and getattr(dn, "stream_token", None) is stream_token:
+                vids = list(dn.volumes)
+                self.topology.unregister_data_node(dn)
+                if vids:
+                    self._broadcast(dn.url, dn.public_url, [], vids)
+
+    def KeepConnected(self, request_iterator, context):
+        with self._clients_lock:
+            self._clients_seq += 1
+            cid = self._clients_seq
+            q: queue.Queue = queue.Queue()
+            self._clients[cid] = q
+        try:
+            # seed: full current map
+            for dn in self.topology.data_nodes():
+                vids = list(dn.volumes) + list(dn.ec_shards)
+                if vids:
+                    q.put(
+                        pb.VolumeLocationDelta(
+                            location=pb.VolumeLocation(
+                                url=dn.url, public_url=dn.public_url, new_vids=vids
+                            )
+                        )
+                    )
+            next(iter(request_iterator))  # hello
+            while context.is_active():
+                try:
+                    yield q.get(timeout=1.0)
+                except queue.Empty:
+                    continue
+        except StopIteration:
+            pass
+        finally:
+            with self._clients_lock:
+                self._clients.pop(cid, None)
+
+    def Assign(self, req: pb.AssignRequest, context) -> pb.AssignResponse:
+        try:
+            result = self.assign(
+                count=req.count or 1,
+                replication=req.replication,
+                collection=req.collection,
+                ttl=req.ttl,
+                data_center=req.data_center,
+            )
+        except Exception as e:  # noqa: BLE001 - error travels in-band
+            return pb.AssignResponse(error=str(e))
+        return pb.AssignResponse(
+            fid=result["fid"],
+            url=result["url"],
+            public_url=result["publicUrl"],
+            count=result["count"],
+        )
+
+    def LookupVolume(self, req: pb.LookupVolumeRequest, context) -> pb.LookupVolumeResponse:
+        out = pb.LookupVolumeResponse()
+        for vid_str in req.vids:
+            entry = out.vid_locations.add(vid=vid_str)
+            try:
+                vid = int(vid_str.split(",")[0])
+            except ValueError:
+                entry.error = f"unknown volume id {vid_str}"
+                continue
+            nodes = self.topology.lookup(req.collection, vid)
+            if not nodes:
+                entry.error = f"volume id {vid} not found"
+                continue
+            for dn in nodes:
+                entry.locations.add(url=dn.url, public_url=dn.public_url)
+        return out
+
+    def LookupEcVolume(self, req: pb.LookupEcVolumeRequest, context) -> pb.LookupEcVolumeResponse:
+        out = pb.LookupEcVolumeResponse(volume_id=req.volume_id)
+        locs = self.topology.lookup_ec_shards(req.volume_id)
+        if locs is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"ec volume {req.volume_id} not found")
+        for shard_id, nodes in enumerate(locs.locations):
+            if not nodes:
+                continue
+            entry = out.shard_id_locations.add(shard_id=shard_id)
+            for dn in nodes:
+                entry.locations.add(url=dn.url, public_url=dn.public_url)
+        return out
+
+    def Statistics(self, req: pb.StatisticsRequest, context) -> pb.StatisticsResponse:
+        total = used = files = 0
+        for dn in self.topology.data_nodes():
+            for v in dn.volumes.values():
+                if req.collection and v.collection != req.collection:
+                    continue
+                used += v.size
+                files += v.file_count
+        total = self.topology.max_volume_count() * self.topology.volume_size_limit
+        return pb.StatisticsResponse(total_size=total, used_size=used, file_count=files)
+
+    def CollectionList(self, req, context) -> pb.CollectionListResponse:
+        return pb.CollectionListResponse(collections=sorted(self.topology.collections()))
+
+    def CollectionDelete(self, req: pb.CollectionDeleteRequest, context):
+        for dn in self.topology.data_nodes():
+            try:
+                with grpc.insecure_channel(self._node_grpc(dn)) as ch:
+                    rpc.volume_stub(ch).DeleteCollection(
+                        volume_pb2.DeleteCollectionRequest(collection=req.name)
+                    )
+            except grpc.RpcError:
+                pass
+        return pb.CollectionDeleteResponse()
+
+    def VolumeList(self, req, context) -> pb.VolumeListResponse:
+        return pb.VolumeListResponse(
+            topology_json=json.dumps(self._topology_dump()),
+            volume_size_limit_mb=self.topology.volume_size_limit // (1024 * 1024),
+        )
+
+    def GetMasterConfiguration(self, req, context):
+        return pb.GetMasterConfigurationResponse()
+
+    # ------------------------------------------------------------------
+    # assignment (master_server_handlers.go:96 dirAssignHandler)
+    def assign(
+        self,
+        count: int = 1,
+        replication: str = "",
+        collection: str = "",
+        ttl: str = "",
+        data_center: str = "",
+    ) -> dict:
+        # normalize to the same canonical forms heartbeat registration
+        # uses, so both paths land in the same layout
+        rp = str(ReplicaPlacement.parse(replication or self.default_replication))
+        ttl = str(TTL.parse(ttl))
+        if not self.topology.has_writable_volume(collection, rp, ttl):
+            if self.topology.free_space() <= 0:
+                raise RuntimeError("no free volumes left")
+            with self._grow_lock:
+                if not self.topology.has_writable_volume(collection, rp, ttl):
+                    self.grow_volumes(collection, rp, ttl, data_center=data_center)
+        vid, _, nodes = self.topology.pick_for_write(
+            collection, rp, ttl, count, data_center=data_center
+        )
+        file_key = self.sequencer.next_file_id(count)
+        cookie = random.randrange(1 << 32)
+        fid = f"{vid},{format_needle_id_cookie(file_key, cookie)}"
+        dn = nodes[0]
+        return {
+            "fid": fid,
+            "url": dn.url,
+            "publicUrl": dn.public_url,
+            "count": count,
+        }
+
+    def _node_grpc(self, dn) -> str:
+        return f"{dn.ip}:{dn.port + 10000}"
+
+    def grow_volumes(
+        self, collection: str, replication: str, ttl: str, data_center: str = "", target_count: int = 0
+    ) -> int:
+        """AutomaticGrowByType (volume_growth.go:63)."""
+        rp = ReplicaPlacement.parse(replication)
+        replication = str(rp)
+        ttl = str(TTL.parse(ttl))
+        target = target_count or find_volume_count(rp.copy_count)
+        grown = 0
+        for _ in range(target):
+            try:
+                servers = find_empty_slots_for_one_volume(
+                    self.topology, rp, data_center=data_center
+                )
+            except ValueError:
+                break
+            vid = self.topology.next_volume_id()
+            ok = True
+            for dn in servers:
+                try:
+                    with grpc.insecure_channel(self._node_grpc(dn)) as ch:
+                        rpc.volume_stub(ch).AllocateVolume(
+                            volume_pb2.AllocateVolumeRequest(
+                                volume_id=vid,
+                                collection=collection,
+                                replication=replication,
+                                ttl=ttl,
+                            ),
+                            timeout=5,
+                        )
+                except grpc.RpcError as e:
+                    ok = False
+                    break
+            if ok:
+                # register immediately (volume_growth.go grow() does the
+                # same; the next heartbeat confirms)
+                layout = self.topology.get_layout(collection, replication, ttl)
+                for dn in servers:
+                    info = VolumeInfo(
+                        id=vid,
+                        size=0,
+                        collection=collection,
+                        file_count=0,
+                        delete_count=0,
+                        deleted_byte_count=0,
+                        read_only=False,
+                        replica_placement=rp.to_byte(),
+                        version=3,
+                        ttl=0,
+                    )
+                    dn.volumes[vid] = info
+                    layout.register_volume(info, dn)
+                grown += 1
+        if grown == 0:
+            raise RuntimeError("failed to grow any volume")
+        return grown
+
+    def _topology_dump(self) -> dict:
+        return self.topology.to_map()
+
+    # ------------------------------------------------------------------
+    # HTTP (master_server_handlers.go)
+    def _http_handler_class(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _json(self, obj, status=200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(url.query).items()}
+                if url.path == "/dir/assign":
+                    return self._assign(q)
+                if url.path == "/dir/lookup":
+                    return self._lookup(q)
+                if url.path == "/cluster/status":
+                    return self._json(
+                        {
+                            "IsLeader": server.is_leader,
+                            "Leader": f"{server.host}:{server.port}",
+                        }
+                    )
+                if url.path == "/dir/status":
+                    return self._json({"Topology": server._topology_dump()})
+                if url.path == "/stats/health":
+                    return self._json({"ok": True})
+                if url.path == "/vol/grow":
+                    try:
+                        count = server.grow_volumes(
+                            q.get("collection", ""),
+                            q.get("replication", server.default_replication),
+                            q.get("ttl", ""),
+                            data_center=q.get("dataCenter", ""),
+                            target_count=int(q.get("count", "0")),
+                        )
+                        return self._json({"count": count})
+                    except Exception as e:  # noqa: BLE001
+                        return self._json({"error": str(e)}, 500)
+                if url.path == "/col/delete":
+                    return self._json({"error": "use gRPC CollectionDelete"}, 400)
+                self._json({"error": f"unknown path {url.path}"}, 404)
+
+            do_POST = do_GET
+
+            def _assign(self, q):
+                try:
+                    result = server.assign(
+                        count=int(q.get("count", "1")),
+                        replication=q.get("replication", ""),
+                        collection=q.get("collection", ""),
+                        ttl=q.get("ttl", ""),
+                        data_center=q.get("dataCenter", ""),
+                    )
+                    self._json(result)
+                except Exception as e:  # noqa: BLE001
+                    self._json({"error": str(e)}, 500)
+
+            def _lookup(self, q):
+                vid_str = q.get("volumeId", "")
+                try:
+                    vid = int(vid_str.split(",")[0])
+                except ValueError:
+                    return self._json({"error": f"unknown volumeId {vid_str}"}, 400)
+                nodes = server.topology.lookup(q.get("collection", ""), vid)
+                if not nodes:
+                    return self._json(
+                        {"volumeId": vid_str, "error": "volume id not found"}, 404
+                    )
+                self._json(
+                    {
+                        "volumeId": vid_str,
+                        "locations": [
+                            {"url": dn.url, "publicUrl": dn.public_url} for dn in nodes
+                        ],
+                    }
+                )
+
+        return Handler
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
+        self._grpc_server.add_generic_rpc_handlers(
+            (rpc.servicer_handler(rpc.MASTER_SERVICE, rpc.MASTER_METHODS, self),)
+        )
+        self._grpc_server.add_insecure_port(f"{self.host}:{self.grpc_port}")
+        self._grpc_server.start()
+
+        self._http_server = ThreadingHTTPServer(
+            (self.host, self.port), self._http_handler_class()
+        )
+        threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
+
+    def stop(self) -> None:
+        if self._http_server:
+            self._http_server.shutdown()
+            self._http_server.server_close()
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.5)

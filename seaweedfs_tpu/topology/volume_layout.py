@@ -1,0 +1,131 @@
+"""VolumeLayout: writable-volume tracking per (collection, rp, ttl).
+
+Behavioral match of reference weed/topology/volume_layout.go: vid →
+location list, a writable set excluding readonly/oversized volumes,
+random pick-for-write with optional DC/rack/node affinity, and
+registration driven by heartbeats.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+from seaweedfs_tpu.storage.store import VolumeInfo
+from seaweedfs_tpu.topology.node import DataNode
+
+
+class VolumeLayout:
+    def __init__(self, rp_string: str, ttl_string: str, volume_size_limit: int):
+        self.rp = rp_string
+        self.ttl = ttl_string
+        self.volume_size_limit = volume_size_limit
+        self.vid2location: dict[int, list[DataNode]] = {}
+        self.writables: list[int] = []
+        self.readonly_vids: set[int] = set()
+        self.oversized_vids: set[int] = set()
+        self._lock = threading.RLock()
+
+    def register_volume(self, v: VolumeInfo, dn: DataNode) -> None:
+        with self._lock:
+            nodes = self.vid2location.setdefault(v.id, [])
+            if dn not in nodes:
+                nodes.append(dn)
+            if v.read_only:
+                self.readonly_vids.add(v.id)
+            if self._is_oversized(v):
+                self.oversized_vids.add(v.id)
+            self._refresh_writable(v.id)
+
+    def unregister_volume(self, vid: int, dn: DataNode) -> None:
+        with self._lock:
+            nodes = self.vid2location.get(vid)
+            if nodes and dn in nodes:
+                nodes.remove(dn)
+            if not nodes:
+                self.vid2location.pop(vid, None)
+                self._set_unwritable(vid)
+                self.readonly_vids.discard(vid)
+                self.oversized_vids.discard(vid)
+            else:
+                self._refresh_writable(vid)
+
+    def _is_oversized(self, v: VolumeInfo) -> bool:
+        return v.size >= self.volume_size_limit
+
+    def _refresh_writable(self, vid: int) -> None:
+        writable = (
+            vid in self.vid2location
+            and len(self.vid2location[vid]) > 0
+            and vid not in self.readonly_vids
+            and vid not in self.oversized_vids
+        )
+        if writable and vid not in self.writables:
+            self.writables.append(vid)
+        elif not writable:
+            self._set_unwritable(vid)
+
+    def _set_unwritable(self, vid: int) -> None:
+        if vid in self.writables:
+            self.writables.remove(vid)
+
+    def set_oversized(self, vid: int) -> None:
+        with self._lock:
+            self.oversized_vids.add(vid)
+            self._set_unwritable(vid)
+
+    def set_readonly(self, vid: int, readonly: bool = True) -> None:
+        with self._lock:
+            if readonly:
+                self.readonly_vids.add(vid)
+            else:
+                self.readonly_vids.discard(vid)
+            self._refresh_writable(vid)
+
+    def lookup(self, vid: int) -> list[DataNode]:
+        with self._lock:
+            return list(self.vid2location.get(vid, []))
+
+    def active_volume_count(self) -> int:
+        return len(self.writables)
+
+    def pick_for_write(
+        self,
+        data_center: str = "",
+        rack: str = "",
+        data_node: str = "",
+        rng: random.Random | None = None,
+    ) -> tuple[int, list[DataNode]]:
+        """Random writable vid, optionally affine to a DC/rack/node
+        (volume_layout.go:165 PickForWrite — reservoir sampling over
+        matching replica locations when affinity is requested)."""
+        rng = rng or random
+        with self._lock:
+            if not self.writables:
+                raise ValueError("no writable volumes")
+            if not data_center:
+                vid = rng.choice(self.writables)
+                return vid, list(self.vid2location[vid])
+            counter = 0
+            chosen: Optional[tuple[int, DataNode]] = None
+            for vid in self.writables:
+                for dn in self.vid2location.get(vid, []):
+                    if dn.get_data_center().id != data_center:
+                        continue
+                    if rack and dn.get_rack().id != rack:
+                        continue
+                    if data_node and dn.id != data_node:
+                        continue
+                    counter += 1
+                    if rng.randrange(counter) < 1:
+                        chosen = (vid, dn)
+            if chosen is None:
+                raise ValueError(
+                    f"no writable volumes in dc={data_center} rack={rack}"
+                )
+            # the affinity-matched node leads the location list, so
+            # callers using locations[0] honor the requested placement
+            vid, matched = chosen
+            others = [d for d in self.vid2location[vid] if d is not matched]
+            return vid, [matched, *others]

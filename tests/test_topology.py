@@ -1,0 +1,224 @@
+"""Control-plane tests: topology tree, layouts, placement, EC registry,
+sequencer — the reference's topology_test.go / volume_growth_test.go
+strategy (synthetic heartbeats into a real Topology, no cluster).
+"""
+
+import random
+
+import pytest
+
+from seaweedfs_tpu.sequence import MemorySequencer
+from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
+from seaweedfs_tpu.storage.store import EcShardInfo, VolumeInfo
+from seaweedfs_tpu.topology import Topology
+from seaweedfs_tpu.topology.volume_growth import (
+    find_empty_slots_for_one_volume,
+    find_volume_count,
+)
+
+
+def make_volume_info(vid, collection="", size=1000, rp=0, read_only=False, ttl=0):
+    return VolumeInfo(
+        id=vid,
+        size=size,
+        collection=collection,
+        file_count=1,
+        delete_count=0,
+        deleted_byte_count=0,
+        read_only=read_only,
+        replica_placement=rp,
+        version=3,
+        ttl=ttl,
+    )
+
+
+def build_topology(n_dcs=2, racks_per_dc=2, nodes_per_rack=3, max_volumes=8):
+    topo = Topology(volume_size_limit=10_000)
+    for d in range(n_dcs):
+        for r in range(racks_per_dc):
+            for n in range(nodes_per_rack):
+                topo.register_data_node(
+                    ip=f"10.{d}.{r}.{n}",
+                    port=8080,
+                    data_center=f"dc{d}",
+                    rack=f"rack{d}-{r}",
+                    max_volumes=max_volumes,
+                )
+    return topo
+
+
+class TestTree:
+    def test_counts_aggregate(self):
+        topo = build_topology()
+        assert topo.max_volume_count() == 2 * 2 * 3 * 8
+        assert topo.volume_count() == 0
+        assert topo.free_space() == 96
+        dn = topo.data_nodes()[0]
+        topo.sync_volumes(dn, [make_volume_info(1), make_volume_info(2)])
+        assert topo.volume_count() == 2
+        assert topo.free_space() == 94
+
+    def test_heartbeat_sync_add_remove(self):
+        topo = build_topology()
+        dn = topo.data_nodes()[0]
+        new, deleted = topo.sync_volumes(dn, [make_volume_info(1)])
+        assert [v.id for v in new] == [1]
+        new, deleted = topo.sync_volumes(dn, [make_volume_info(2)])
+        assert [v.id for v in new] == [2]
+        assert [v.id for v in deleted] == [1]
+        assert topo.lookup("", 2) == [dn]
+        assert topo.lookup("", 1) == []
+
+    def test_unregister_node_drops_volumes(self):
+        topo = build_topology()
+        dn = topo.data_nodes()[0]
+        topo.sync_volumes(dn, [make_volume_info(1)])
+        topo.unregister_data_node(dn)
+        assert topo.lookup("", 1) == []
+        assert dn.id not in [n.id for n in topo.data_nodes()]
+
+
+class TestLayout:
+    def test_pick_for_write_and_lookup(self):
+        topo = build_topology()
+        nodes = topo.data_nodes()
+        topo.sync_volumes(nodes[0], [make_volume_info(1)])
+        topo.sync_volumes(nodes[1], [make_volume_info(1)])
+        vid, count, locations = topo.pick_for_write("", "000", "", 1)
+        assert vid == 1
+        assert len(locations) == 2
+        assert set(topo.lookup("", 1)) == {nodes[0], nodes[1]}
+
+    def test_readonly_not_writable(self):
+        topo = build_topology()
+        dn = topo.data_nodes()[0]
+        topo.sync_volumes(dn, [make_volume_info(1, read_only=True)])
+        with pytest.raises(ValueError, match="no writable"):
+            topo.pick_for_write("", "000", "", 1)
+
+    def test_oversized_not_writable(self):
+        topo = build_topology()
+        dn = topo.data_nodes()[0]
+        topo.sync_volumes(dn, [make_volume_info(1, size=20_000)])
+        with pytest.raises(ValueError, match="no writable"):
+            topo.pick_for_write("", "000", "", 1)
+
+    def test_dc_affinity(self):
+        topo = build_topology()
+        nodes_dc0 = [n for n in topo.data_nodes() if n.get_data_center().id == "dc0"]
+        nodes_dc1 = [n for n in topo.data_nodes() if n.get_data_center().id == "dc1"]
+        topo.sync_volumes(nodes_dc0[0], [make_volume_info(1)])
+        topo.sync_volumes(nodes_dc1[0], [make_volume_info(2)])
+        for _ in range(10):
+            vid, _, _ = topo.pick_for_write("", "000", "", 1, data_center="dc1")
+            assert vid == 2
+
+    def test_collections_isolated(self):
+        topo = build_topology()
+        dn = topo.data_nodes()[0]
+        topo.sync_volumes(dn, [make_volume_info(1, collection="a")])
+        assert topo.lookup("a", 1) == [dn]
+        assert topo.lookup("b", 1) == []
+        assert "a" in topo.collections()
+
+
+class TestGrowth:
+    def test_find_volume_count(self):
+        assert find_volume_count(1) == 7
+        assert find_volume_count(2) == 6
+        assert find_volume_count(3) == 3
+        assert find_volume_count(4) == 1
+
+    @pytest.mark.parametrize("rp_str,expect_n", [("000", 1), ("001", 2), ("010", 2), ("100", 2), ("012", 4), ("112", 5)])
+    def test_placement_satisfies_rp(self, rp_str, expect_n):
+        topo = build_topology(n_dcs=3, racks_per_dc=3, nodes_per_rack=4)
+        rp = ReplicaPlacement.parse(rp_str)
+        rng = random.Random(0)
+        for _ in range(20):
+            servers = find_empty_slots_for_one_volume(topo, rp, rng=rng)
+            assert len(servers) == expect_n == rp.copy_count
+            # placement constraints
+            dcs = {s.get_data_center().id for s in servers}
+            racks = {(s.get_data_center().id, s.get_rack().id) for s in servers}
+            assert len(dcs) == rp.diff_data_center_count + 1
+            assert len(racks) == rp.diff_data_center_count + rp.diff_rack_count + 1
+            assert len(set(s.id for s in servers)) == len(servers)
+
+    def test_placement_fails_when_impossible(self):
+        topo = build_topology(n_dcs=1, racks_per_dc=1, nodes_per_rack=2)
+        with pytest.raises(ValueError):
+            find_empty_slots_for_one_volume(topo, ReplicaPlacement.parse("100"))
+
+    def test_placement_respects_capacity(self):
+        topo = build_topology(n_dcs=1, racks_per_dc=1, nodes_per_rack=4, max_volumes=1)
+        dn = topo.data_nodes()[0]
+        topo.sync_volumes(dn, [make_volume_info(1)])  # node full
+        rp = ReplicaPlacement.parse("002")
+        rng = random.Random(3)
+        for _ in range(10):
+            servers = find_empty_slots_for_one_volume(topo, rp, rng=rng)
+            assert dn not in servers
+
+
+class TestEcRegistry:
+    def test_register_lookup_unregister(self):
+        topo = build_topology()
+        dn0, dn1 = topo.data_nodes()[:2]
+        topo.sync_ec_shards(dn0, [EcShardInfo(5, "", 0b0000000001111111)])
+        topo.sync_ec_shards(dn1, [EcShardInfo(5, "", 0b0011111110000000)])
+        locs = topo.lookup_ec_shards(5)
+        assert locs is not None
+        assert locs.locations[0] == [dn0]
+        assert locs.locations[13] == [dn1]
+        assert set(topo.lookup("", 5)) == {dn0, dn1}
+        # shard set shrinks on next heartbeat
+        topo.sync_ec_shards(dn0, [])
+        locs = topo.lookup_ec_shards(5)
+        assert locs.locations[0] == []
+
+    def test_shard_bits_shrink_removes_stale_locations(self):
+        # shard moves away but the vid stays on the node: the stale
+        # location must be dropped from the shard map
+        topo = build_topology()
+        dn = topo.data_nodes()[0]
+        topo.sync_ec_shards(dn, [EcShardInfo(5, "", 0b11)])
+        topo.sync_ec_shards(dn, [EcShardInfo(5, "", 0b01)])
+        locs = topo.lookup_ec_shards(5)
+        assert locs.locations[0] == [dn]
+        assert locs.locations[1] == []
+
+    def test_ec_counts_in_free_space(self):
+        topo = build_topology(n_dcs=1, racks_per_dc=1, nodes_per_rack=1, max_volumes=10)
+        dn = topo.data_nodes()[0]
+        before = topo.free_space()
+        topo.sync_ec_shards(dn, [EcShardInfo(5, "", (1 << 14) - 1)])
+        assert topo.free_space() == before - 1
+
+
+class TestSequencer:
+    def test_ranges(self):
+        seq = MemorySequencer()
+        assert seq.next_file_id(1) == 1
+        assert seq.next_file_id(5) == 2
+        assert seq.next_file_id(1) == 7
+
+    def test_set_max_equal_advances(self):
+        # a reported key EQUAL to the counter must advance past it,
+        # or the next assign re-issues an id already on disk
+        seq = MemorySequencer()
+        assert seq.peek() == 1
+        seq.set_max(1)
+        assert seq.next_file_id(1) == 2
+
+    def test_set_max(self):
+        seq = MemorySequencer()
+        seq.set_max(100)
+        assert seq.next_file_id(1) == 101
+        seq.set_max(50)  # no-op, already past
+        assert seq.next_file_id(1) == 102
+
+    def test_id_generator_adjusts_from_heartbeat(self):
+        topo = Topology()
+        dn = topo.register_data_node("1.1.1.1", 80)
+        topo.sync_volumes(dn, [make_volume_info(41)])
+        assert topo.next_volume_id() == 42
