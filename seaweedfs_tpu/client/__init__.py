@@ -1,0 +1,4 @@
+from seaweedfs_tpu.client.masterclient import MasterClient
+from seaweedfs_tpu.client.vid_map import Location, VidMap
+
+__all__ = ["MasterClient", "Location", "VidMap"]

@@ -1,0 +1,332 @@
+"""Client SDK: assign / upload / lookup / delete / submit / tail.
+
+Behavioral match of weed/operation/:
+  * assign            — master Assign gRPC (assign_file_id.go:33)
+  * upload            — POST bytes to a volume server (upload_content.go)
+  * lookup            — master LookupVolume with a TTL cache (lookup.go:36)
+  * delete_files      — vid-grouped batch delete via volume-server
+                        BatchDelete gRPC (delete_content.go:43)
+  * submit_files      — assign+upload, auto-splitting big payloads into
+                        chunks behind a chunk-manifest needle
+                        (submit.go:40,112, chunked_file.go)
+  * tail_volume       — VolumeIncrementalCopy stream replay
+                        (tail_volume.go, volume_backup.go:170)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+
+import grpc
+
+from seaweedfs_tpu.pb import master_pb2, rpc, volume_pb2
+from seaweedfs_tpu.pb.rpc import grpc_address as master_grpc_address
+from seaweedfs_tpu.pb.rpc import grpc_address as volume_grpc_address
+
+
+# ----------------------------------------------------------------------
+# assign
+
+
+@dataclass
+class AssignResult:
+    fid: str
+    url: str
+    public_url: str
+    count: int
+    error: str = ""
+
+
+def assign(
+    master: str,
+    count: int = 1,
+    replication: str = "",
+    collection: str = "",
+    ttl: str = "",
+    data_center: str = "",
+) -> AssignResult:
+    with grpc.insecure_channel(master_grpc_address(master)) as ch:
+        resp = rpc.master_stub(ch).Assign(
+            master_pb2.AssignRequest(
+                count=count,
+                replication=replication,
+                collection=collection,
+                ttl=ttl,
+                data_center=data_center,
+            )
+        )
+    if resp.error:
+        raise RuntimeError(f"assign: {resp.error}")
+    return AssignResult(resp.fid, resp.url, resp.public_url, resp.count)
+
+
+# ----------------------------------------------------------------------
+# upload
+
+
+@dataclass
+class UploadResult:
+    name: str = ""
+    size: int = 0
+    etag: str = ""
+    error: str = ""
+
+
+def upload(
+    url: str,
+    data: bytes,
+    filename: str = "",
+    mime: str = "",
+    ttl: str = "",
+    jwt: str = "",
+    is_chunk_manifest: bool = False,
+    timeout: float = 30.0,
+) -> UploadResult:
+    """POST a blob to ``http://<url>`` (url is "host:port/fid")."""
+    q: dict[str, str] = {}
+    if filename:
+        q["filename"] = filename
+    if ttl:
+        q["ttl"] = ttl
+    if is_chunk_manifest:
+        q["cm"] = "true"
+    full = f"http://{url}"
+    if q:
+        full += ("&" if "?" in full else "?") + urllib.parse.urlencode(q)
+    req = urllib.request.Request(full, data=data, method="POST")
+    req.add_header("Content-Type", mime or "application/octet-stream")
+    if jwt:
+        req.add_header("Authorization", f"BEARER {jwt}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            body = json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read() or b"{}")
+        except ValueError:
+            body = {}
+        return UploadResult(error=body.get("error", str(e)))
+    except OSError as e:
+        return UploadResult(error=str(e))
+    if body.get("error"):
+        return UploadResult(error=body["error"])
+    return UploadResult(
+        name=body.get("name", ""), size=int(body.get("size", 0)), etag=body.get("eTag", "")
+    )
+
+
+def download(fid_url: str, timeout: float = 30.0) -> tuple[bytes, dict]:
+    """GET a blob; returns (bytes, headers)."""
+    with urllib.request.urlopen(f"http://{fid_url}", timeout=timeout) as r:
+        return r.read(), dict(r.headers)
+
+
+def delete(fid_url: str, timeout: float = 30.0) -> None:
+    req = urllib.request.Request(f"http://{fid_url}", method="DELETE")
+    try:
+        urllib.request.urlopen(req, timeout=timeout).read()
+    except urllib.error.HTTPError:
+        pass
+
+
+# ----------------------------------------------------------------------
+# lookup (+cache)
+
+
+@dataclass
+class LookupResult:
+    vid: str
+    locations: list[dict] = field(default_factory=list)
+    error: str = ""
+
+
+class _CacheEntry:
+    __slots__ = ("result", "expires")
+
+    def __init__(self, result: LookupResult, ttl: float):
+        self.result = result
+        self.expires = time.time() + ttl
+
+
+_lookup_cache: dict[tuple[str, str], _CacheEntry] = {}
+_lookup_lock = threading.Lock()
+LOOKUP_CACHE_TTL = 10 * 60  # lookup.go:18 (10 min)
+
+
+def lookup(master: str, vid: str, collection: str = "") -> LookupResult:
+    key = (master, vid)
+    with _lookup_lock:
+        entry = _lookup_cache.get(key)
+        if entry and entry.expires > time.time():
+            return entry.result
+    with grpc.insecure_channel(master_grpc_address(master)) as ch:
+        resp = rpc.master_stub(ch).LookupVolume(
+            master_pb2.LookupVolumeRequest(vids=[vid], collection=collection)
+        )
+    result = LookupResult(vid=vid, error=f"volume {vid} not found")
+    for e in resp.vid_locations:
+        if e.vid == vid:
+            result = LookupResult(
+                vid=vid,
+                locations=[
+                    {"url": l.url, "publicUrl": l.public_url} for l in e.locations
+                ],
+                error=e.error,
+            )
+    if not result.error:
+        with _lookup_lock:
+            _lookup_cache[key] = _CacheEntry(result, LOOKUP_CACHE_TTL)
+    return result
+
+
+def lookup_file_id(master: str, fid: str) -> str:
+    """fid → "host:port/fid" of one replica."""
+    vid = fid.split(",")[0]
+    result = lookup(master, vid)
+    if result.error:
+        raise RuntimeError(result.error)
+    if not result.locations:
+        raise RuntimeError(f"volume {vid} has no locations")
+    return f"{result.locations[0]['url']}/{fid}"
+
+
+# ----------------------------------------------------------------------
+# batch delete
+
+
+def delete_files(master: str, fids: list[str]) -> list[dict]:
+    """Group fids by volume id, resolve each volume once, then issue one
+    BatchDelete gRPC per server (delete_content.go:43)."""
+    by_vid: dict[str, list[str]] = {}
+    results: list[dict] = []
+    for fid in fids:
+        parts = fid.split(",")
+        if len(parts) != 2:
+            results.append({"fid": fid, "status": 400, "error": "invalid fid"})
+            continue
+        by_vid.setdefault(parts[0], []).append(fid)
+
+    # every replica location gets the batch (delete_content.go sends to
+    # all locations so no replica keeps the data)
+    by_server: dict[str, list[str]] = {}
+    primary: dict[str, str] = {}  # fid -> primary server (reported result)
+    for vid, vid_fids in by_vid.items():
+        res = lookup(master, vid)
+        if res.error or not res.locations:
+            for fid in vid_fids:
+                results.append({"fid": fid, "status": 404, "error": res.error})
+            continue
+        for i, loc in enumerate(res.locations):
+            by_server.setdefault(loc["url"], []).extend(vid_fids)
+            if i == 0:
+                for fid in vid_fids:
+                    primary[fid] = loc["url"]
+
+    for server, server_fids in by_server.items():
+        try:
+            with grpc.insecure_channel(volume_grpc_address(server)) as ch:
+                resp = rpc.volume_stub(ch).BatchDelete(
+                    volume_pb2.BatchDeleteRequest(file_ids=server_fids)
+                )
+            for r in resp.results:
+                if primary.get(r.file_id) == server:
+                    results.append(
+                        {
+                            "fid": r.file_id,
+                            "status": r.status,
+                            "error": r.error,
+                            "size": r.size,
+                        }
+                    )
+        except grpc.RpcError as e:
+            for fid in server_fids:
+                if primary.get(fid) == server:
+                    results.append({"fid": fid, "status": 500, "error": str(e)})
+    return results
+
+
+# ----------------------------------------------------------------------
+# submit (auto-chunking behind a chunk manifest)
+
+
+@dataclass
+class SubmitResult:
+    file_name: str
+    fid: str
+    file_url: str
+    size: int
+    error: str = ""
+
+
+def submit_file(
+    master: str,
+    filename: str,
+    data: bytes,
+    replication: str = "",
+    collection: str = "",
+    ttl: str = "",
+    mime: str = "",
+    max_mb: int = 0,
+) -> SubmitResult:
+    """Assign one fid and upload; payloads over max_mb are split into
+    chunks uploaded under their own fids and tied together by a
+    chunk-manifest needle (submit.go:112 upload with chunking)."""
+    ar = assign(master, count=1, replication=replication, collection=collection, ttl=ttl)
+    chunk_size = max_mb * 1024 * 1024
+    if chunk_size and len(data) > chunk_size:
+        chunks = []
+        offset = 0
+        idx = 0
+        while offset < len(data):
+            piece = data[offset : offset + chunk_size]
+            car = assign(
+                master, count=1, replication=replication, collection=collection, ttl=ttl
+            )
+            ur = upload(
+                f"{car.url}/{car.fid}",
+                piece,
+                filename=f"{filename}_{idx}",
+                ttl=ttl,
+            )
+            if ur.error:
+                return SubmitResult(filename, ar.fid, "", 0, ur.error)
+            chunks.append({"fid": car.fid, "offset": offset, "size": len(piece)})
+            offset += len(piece)
+            idx += 1
+        manifest = json.dumps(
+            {"name": filename, "mime": mime, "size": len(data), "chunks": chunks}
+        ).encode()
+        ur = upload(
+            f"{ar.url}/{ar.fid}",
+            manifest,
+            filename=filename,
+            ttl=ttl,
+            mime="application/json",
+            is_chunk_manifest=True,
+        )
+    else:
+        ur = upload(f"{ar.url}/{ar.fid}", data, filename=filename, mime=mime, ttl=ttl)
+    if ur.error:
+        return SubmitResult(filename, ar.fid, "", 0, ur.error)
+    return SubmitResult(filename, ar.fid, f"{ar.public_url}/{ar.fid}", len(data))
+
+
+# ----------------------------------------------------------------------
+# tail
+
+
+def tail_volume(volume_server_url: str, vid: int, since_ns: int = 0):
+    """Yield (needle_bytes_chunk) from the server's incremental-copy
+    stream; the caller reassembles needles (tail_volume.go)."""
+    with grpc.insecure_channel(volume_grpc_address(volume_server_url)) as ch:
+        stream = rpc.volume_stub(ch).VolumeIncrementalCopy(
+            volume_pb2.VolumeIncrementalCopyRequest(volume_id=vid, since_ns=since_ns)
+        )
+        for resp in stream:
+            yield resp.file_content

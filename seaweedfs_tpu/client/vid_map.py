@@ -1,0 +1,68 @@
+"""Client-side volume-id → locations map.
+
+Behavioral match of the reference's wdclient vidMap
+(weed/wdclient/vid_map.go): thread-safe map updated from the master's
+KeepConnected push stream, with round-robin pick over replicas.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Location:
+    url: str
+    public_url: str
+
+
+class VidMap:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._vid2locations: dict[int, list[Location]] = {}
+        self._counter = itertools.count()
+
+    def lookup(self, vid: int) -> list[Location]:
+        with self._lock:
+            return list(self._vid2locations.get(vid, ()))
+
+    def lookup_file_id(self, fid: str) -> list[str]:
+        """fid "3,0144b2c3" → ["host:port/3,0144b2c3", ...] full urls
+        (wdclient/vid_map.go LookupFileId)."""
+        parts = fid.split(",")
+        if len(parts) != 2 or not parts[0].isdigit():
+            raise ValueError(f"invalid file id {fid!r}")
+        locations = self.lookup(int(parts[0]))
+        if not locations:
+            raise KeyError(f"volume {parts[0]} not found")
+        # rotate so repeated reads spread over replicas
+        start = next(self._counter) % len(locations)
+        ordered = locations[start:] + locations[:start]
+        return [f"http://{loc.url}/{fid}" for loc in ordered]
+
+    def add_location(self, vid: int, loc: Location) -> None:
+        with self._lock:
+            locs = self._vid2locations.setdefault(vid, [])
+            if loc not in locs:
+                locs.append(loc)
+
+    def delete_location(self, vid: int, url: str) -> None:
+        with self._lock:
+            locs = self._vid2locations.get(vid)
+            if not locs:
+                return
+            locs[:] = [l for l in locs if l.url != url]
+            if not locs:
+                del self._vid2locations[vid]
+
+    def delete_server(self, url: str) -> None:
+        """Drop every vid entry pointing at a dead server."""
+        with self._lock:
+            for vid in list(self._vid2locations):
+                self.delete_location(vid, url)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._vid2locations)

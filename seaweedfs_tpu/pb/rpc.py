@@ -14,6 +14,15 @@ import grpc
 from seaweedfs_tpu.pb import master_pb2 as m
 from seaweedfs_tpu.pb import volume_pb2 as v
 
+GRPC_PORT_OFFSET = 10000  # reference convention: grpc port = http port + 10000
+
+
+def grpc_address(http_addr: str) -> str:
+    """"host:9333" → "host:19333"."""
+    host, _, port = http_addr.partition(":")
+    return f"{host}:{int(port) + GRPC_PORT_OFFSET}"
+
+
 UNARY_UNARY = "unary_unary"
 UNARY_STREAM = "unary_stream"
 STREAM_UNARY = "stream_unary"

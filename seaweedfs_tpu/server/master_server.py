@@ -157,6 +157,9 @@ class MasterServer:
             q: queue.Queue = queue.Queue()
             self._clients[cid] = q
         try:
+            # ack first so clients learn the leader even on an empty
+            # cluster (reference sends leader redirects the same way)
+            q.put(pb.VolumeLocationDelta(leader=f"{self.host}:{self.port}"))
             # seed: full current map
             for dn in self.topology.data_nodes():
                 vids = list(dn.volumes) + list(dn.ec_shards)

@@ -50,6 +50,22 @@ from seaweedfs_tpu.storage.volume import (
 COPY_CHUNK = 1024 * 1024
 
 
+def _parse_manifest_chunks(data: bytes) -> list[dict] | None:
+    """Validate + sort a chunk manifest's chunk list; None if malformed.
+    Manifests are client-supplied JSON, so every field is checked."""
+    try:
+        manifest = json.loads(data)
+        chunks = manifest["chunks"]
+        for c in chunks:
+            if not isinstance(c["fid"], str):
+                return None
+            c["offset"] = int(c["offset"])
+            c["size"] = int(c["size"])
+        return sorted(chunks, key=lambda c: c["offset"])
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
 class VolumeServer:
     def __init__(
         self,
@@ -565,6 +581,8 @@ class VolumeServer:
                     return self._reply(404)
                 except NotEnoughShards as e:
                     return self._json({"error": str(e)}, 500)
+                if n.is_chunked_manifest():
+                    return self._serve_chunked_manifest(n)
                 etag = f'"{n.etag()}"'
                 if self.headers.get("If-None-Match") == etag:
                     return self._reply(304)
@@ -580,6 +598,38 @@ class VolumeServer:
                         "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified)
                     )
                 self._reply(200, n.data, headers)
+
+            def _serve_chunked_manifest(self, n: Needle):
+                """Chunk-manifest fan-in: stream each chunk fid in offset
+                order without buffering the whole file
+                (volume_server_handlers_read.go:171, ChunkedFileReader)."""
+                chunks = _parse_manifest_chunks(n.data)
+                if chunks is None:
+                    return self._json({"error": "invalid chunk manifest"}, 500)
+                manifest = json.loads(n.data)
+                total = manifest.get("size") or sum(c["size"] for c in chunks)
+                headers = {"Content-Type": "application/octet-stream"}
+                if manifest.get("mime"):
+                    headers["Content-Type"] = manifest["mime"]
+                if manifest.get("name"):
+                    headers["Content-Disposition"] = (
+                        f'inline; filename="{manifest["name"]}"'
+                    )
+                self.send_response(200)
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(total))
+                self.end_headers()
+                if self.command == "HEAD":
+                    return
+                for c in chunks:
+                    piece = server._fetch_fid(c["fid"])
+                    if piece is None:
+                        # headers already sent; truncate the connection so
+                        # the client sees a short read, not silent corruption
+                        self.close_connection = True
+                        return
+                    self.wfile.write(piece)
 
             do_HEAD = do_GET
 
@@ -598,6 +648,8 @@ class VolumeServer:
                 if fname and len(fname) < 256:
                     n.name = fname.encode()
                     n.set_has_name()
+                if q.get("cm") == "true":
+                    n.set_is_chunk_manifest()
                 n.last_modified = int(time.time())
                 n.set_has_last_modified_date()
                 try:
@@ -639,11 +691,62 @@ class VolumeServer:
                     return self._json({"size": 0}, 404)
                 except CookieMismatch as e:
                     return self._json({"error": str(e)}, 409)
+                if existing.is_chunked_manifest():
+                    # cascade: delete every chunk the manifest points at
+                    # (volume_server_handlers_write.go DeleteHandler)
+                    for c in _parse_manifest_chunks(existing.data) or []:
+                        server._delete_fid(c["fid"])
                 if q.get("type") != "replicate":
                     server._replicate(fid, q, "DELETE", b"", {})
                 self._json({"size": size}, 202)
 
         return Handler
+
+    def _fetch_fid(self, fid_str: str) -> bytes | None:
+        """Resolve a chunk fid (local store first, then master lookup +
+        HTTP GET from the owning peer)."""
+        import urllib.request
+
+        try:
+            fid = FileId.parse(fid_str)
+        except ValueError:
+            return None
+        v = self.store.find_volume(fid.volume_id)
+        if v is not None:
+            try:
+                return v.read_needle(fid.key, cookie=fid.cookie).data
+            except (NeedleNotFound, CookieMismatch):
+                return None
+        locations = self._lookup_locations(fid.volume_id) or []
+        for url in locations:
+            try:
+                with urllib.request.urlopen(f"http://{url}/{fid_str}", timeout=10) as r:
+                    return r.read()
+            except OSError:
+                continue
+        return None
+
+    def _delete_fid(self, fid_str: str) -> None:
+        import urllib.request
+
+        try:
+            fid = FileId.parse(fid_str)
+        except ValueError:
+            return
+        v = self.store.find_volume(fid.volume_id)
+        if v is not None:
+            try:
+                self.store.delete_needle(fid.volume_id, Needle(cookie=fid.cookie, id=fid.key))
+            except NeedleNotFound:
+                pass
+            return
+        for url in self._lookup_locations(fid.volume_id) or []:
+            try:
+                req = urllib.request.Request(f"http://{url}/{fid_str}", method="DELETE")
+                urllib.request.urlopen(req, timeout=10).read()
+                return
+            except OSError:
+                continue
 
     def _replicate(self, fid: FileId, q: dict, method: str, body: bytes, headers: dict) -> str | None:
         """Fan the write to replica peers (store_replicate.go:44-80)."""
@@ -658,10 +761,16 @@ class VolumeServer:
         if all_locations is None:
             return "replication lookup failed"
         locations = [u for u in all_locations if u != f"{self.host}:{self.port}"]
+        # forward the original query params (filename/cm/ttl…) so replica
+        # needles carry the same flags (store_replicate.go:44 keeps the url)
+        from urllib.parse import urlencode
+
+        params = {k: v for k, v in q.items() if k != "type"}
+        params["type"] = "replicate"
         for url in locations:
             try:
                 req = urllib.request.Request(
-                    f"http://{url}/{fid}?type=replicate",
+                    f"http://{url}/{fid}?{urlencode(params)}",
                     data=body if method == "POST" else None,
                     method=method,
                 )

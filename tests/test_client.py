@@ -1,0 +1,187 @@
+"""Client SDK tests: masterclient KeepConnected map, operation
+assign/upload/lookup/delete/submit (incl. chunk manifest fan-in), batch
+delete — all against an in-process master + volume servers.
+
+Mirrors the behaviors of weed/wdclient/ and weed/operation/ (reference
+has no tests there; we add them per SURVEY §4 implication).
+"""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.client import MasterClient
+from seaweedfs_tpu.client import operation as op
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+from tests.test_cluster import free_port, http_get
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    master_port = free_port()
+    master = MasterServer(port=master_port, volume_size_limit_mb=64)
+    master.start()
+    volume_servers = []
+    for i in range(2):
+        vs = VolumeServer(
+            [str(tmp_path_factory.mktemp(f"cvs{i}"))],
+            port=free_port(),
+            master=f"127.0.0.1:{master_port}",
+            heartbeat_interval=0.2,
+            max_volume_counts=[100],
+        )
+        vs.start()
+        volume_servers.append(vs)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topology.data_nodes()) < 2:
+        time.sleep(0.05)
+    yield master, volume_servers
+    for vs in volume_servers:
+        vs.stop()
+    master.stop()
+
+
+@pytest.fixture()
+def master_addr(cluster):
+    master, _ = cluster
+    return f"127.0.0.1:{master.port}"
+
+
+class TestOperation:
+    def test_assign_upload_download_roundtrip(self, master_addr):
+        ar = op.assign(master_addr)
+        assert "," in ar.fid and ar.url
+        blob = b"hello operation sdk" * 50
+        ur = op.upload(f"{ar.url}/{ar.fid}", blob, filename="x.bin")
+        assert ur.error == ""
+        assert ur.size > 0
+        data, headers = op.download(f"{ar.url}/{ar.fid}")
+        assert data == blob
+
+    def test_lookup_and_cache(self, master_addr):
+        ar = op.assign(master_addr)
+        vid = ar.fid.split(",")[0]
+        res = op.lookup(master_addr, vid)
+        assert not res.error
+        assert any(loc["url"] == ar.url for loc in res.locations)
+        # cached path returns the same object
+        res2 = op.lookup(master_addr, vid)
+        assert res2 is res
+
+    def test_lookup_file_id(self, master_addr):
+        ar = op.assign(master_addr)
+        op.upload(f"{ar.url}/{ar.fid}", b"abc")
+        url = op.lookup_file_id(master_addr, ar.fid)
+        data, _ = op.download(url)
+        assert data == b"abc"
+
+    def test_delete_files_batch(self, master_addr):
+        fids = []
+        for _ in range(5):
+            ar = op.assign(master_addr)
+            op.upload(f"{ar.url}/{ar.fid}", b"to-delete")
+            fids.append(ar.fid)
+        results = op.delete_files(master_addr, fids + ["bogus"])
+        by_fid = {r["fid"]: r for r in results}
+        for fid in fids:
+            assert by_fid[fid]["status"] in (200, 202), by_fid[fid]
+        assert by_fid["bogus"]["status"] == 400
+        for fid in fids:
+            with pytest.raises(Exception):
+                op.download(op.lookup_file_id(master_addr, fid))
+
+    def test_submit_small(self, master_addr):
+        r = op.submit_file(master_addr, "small.txt", b"tiny", mime="text/plain")
+        assert r.error == ""
+        data, _ = op.download(r.file_url)
+        assert data == b"tiny"
+
+    def test_submit_chunked_manifest(self, master_addr):
+        # 1 MiB payload, 256 KiB chunks → 4 chunk fids + manifest needle
+        blob = bytes(range(256)) * 4096
+        r = op.submit_file(master_addr, "big.bin", blob, max_mb=0)
+        # force chunking with a tiny max by calling the chunk path directly
+        r = op.submit_file(master_addr, "big.bin", blob, mime="application/x-test")
+        assert r.error == ""
+
+        # chunked: monkey the chunk size via max_mb=1 on a >1MiB payload
+        blob2 = blob + blob  # 2 MiB
+        r2 = op.submit_file(master_addr, "big2.bin", blob2, max_mb=1)
+        assert r2.error == ""
+        status, data = http_get(f"http://{r2.file_url}")
+        assert status == 200
+        assert data == blob2
+
+    def test_chunk_manifest_cascade_delete(self, master_addr):
+        import json
+        import urllib.error
+
+        blob = b"z" * (2 * 1024 * 1024 + 17)
+        r = op.submit_file(master_addr, "casc.bin", blob, max_mb=1)
+        assert r.error == ""
+        # read the raw manifest needle (bypassing fan-in is not possible
+        # over HTTP, so re-fetch chunk list by re-deriving it: the chunks
+        # are the only other fids in the volume — instead, rebuild the
+        # manifest client-side the same way submit_file did)
+        # simpler: fetch via lookup of each chunk after capturing them
+        # from a fresh chunked submit
+        chunks = []
+        orig_upload = op.upload
+
+        def spy_upload(url, data, **kw):
+            res = orig_upload(url, data, **kw)
+            if kw.get("is_chunk_manifest"):
+                for c in json.loads(data)["chunks"]:
+                    chunks.append(c["fid"])
+            return res
+
+        op.upload = spy_upload
+        try:
+            r = op.submit_file(master_addr, "casc2.bin", blob, max_mb=1)
+        finally:
+            op.upload = orig_upload
+        assert r.error == "" and len(chunks) >= 2
+
+        op.delete(r.file_url)
+        # manifest gone
+        with pytest.raises(urllib.error.HTTPError):
+            http_get(f"http://{r.file_url}")
+        # every chunk cascade-deleted
+        for fid in chunks:
+            with pytest.raises(urllib.error.HTTPError):
+                http_get(f"http://{op.lookup_file_id(master_addr, fid)}")
+
+
+class TestMasterClient:
+    def test_keepconnected_map_and_lookup(self, cluster, master_addr):
+        master, _ = cluster
+        # populate at least one volume
+        ar = op.assign(master_addr)
+        op.upload(f"{ar.url}/{ar.fid}", b"mc")
+        mc = MasterClient("test-client", [master_addr])
+        mc.start()
+        try:
+            assert mc.wait_until_connected(10)
+            vid = int(ar.fid.split(",")[0])
+            deadline = time.time() + 5
+            while time.time() < deadline and not mc.vid_map.lookup(vid):
+                time.sleep(0.05)
+            urls = mc.lookup_file_id(ar.fid)
+            assert urls
+            data, _ = op.download(urls[0].removeprefix("http://"))
+            assert data == b"mc"
+        finally:
+            mc.stop()
+
+    def test_unary_refresh_fallback(self, cluster, master_addr):
+        master, _ = cluster
+        ar = op.assign(master_addr)
+        op.upload(f"{ar.url}/{ar.fid}", b"rf")
+        mc = MasterClient("lazy-client", [master_addr])
+        # no start(): stream never connects, lookup must fall back to
+        # the unary LookupVolume path
+        mc.current_master = master_addr
+        urls = mc.lookup_file_id(ar.fid)
+        assert urls
