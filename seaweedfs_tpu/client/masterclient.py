@@ -110,7 +110,14 @@ class MasterClient:
                 for delta in stream:
                     if self._stop.is_set():
                         return None
-                    if delta.leader and delta.leader != master:
+                    if (
+                        delta.leader
+                        and delta.leader != master
+                        and delta.leader in self.masters
+                    ):
+                        # genuine redirect to another seed; a leader
+                        # self-identity that merely spells the address
+                        # differently (localhost vs 127.0.0.1) is not one
                         return delta.leader
                     self.current_master = master
                     self._connected.set()

@@ -26,8 +26,7 @@ from dataclasses import dataclass, field
 import grpc
 
 from seaweedfs_tpu.pb import master_pb2, rpc, volume_pb2
-from seaweedfs_tpu.pb.rpc import grpc_address as master_grpc_address
-from seaweedfs_tpu.pb.rpc import grpc_address as volume_grpc_address
+from seaweedfs_tpu.pb.rpc import grpc_address
 
 
 # ----------------------------------------------------------------------
@@ -51,7 +50,7 @@ def assign(
     ttl: str = "",
     data_center: str = "",
 ) -> AssignResult:
-    with grpc.insecure_channel(master_grpc_address(master)) as ch:
+    with grpc.insecure_channel(grpc_address(master)) as ch:
         resp = rpc.master_stub(ch).Assign(
             master_pb2.AssignRequest(
                 count=count,
@@ -165,7 +164,7 @@ def lookup(master: str, vid: str, collection: str = "") -> LookupResult:
         entry = _lookup_cache.get(key)
         if entry and entry.expires > time.time():
             return entry.result
-    with grpc.insecure_channel(master_grpc_address(master)) as ch:
+    with grpc.insecure_channel(grpc_address(master)) as ch:
         resp = rpc.master_stub(ch).LookupVolume(
             master_pb2.LookupVolumeRequest(vids=[vid], collection=collection)
         )
@@ -230,7 +229,7 @@ def delete_files(master: str, fids: list[str]) -> list[dict]:
 
     for server, server_fids in by_server.items():
         try:
-            with grpc.insecure_channel(volume_grpc_address(server)) as ch:
+            with grpc.insecure_channel(grpc_address(server)) as ch:
                 resp = rpc.volume_stub(ch).BatchDelete(
                     volume_pb2.BatchDeleteRequest(file_ids=server_fids)
                 )
@@ -324,7 +323,7 @@ def submit_file(
 def tail_volume(volume_server_url: str, vid: int, since_ns: int = 0):
     """Yield (needle_bytes_chunk) from the server's incremental-copy
     stream; the caller reassembles needles (tail_volume.go)."""
-    with grpc.insecure_channel(volume_grpc_address(volume_server_url)) as ch:
+    with grpc.insecure_channel(grpc_address(volume_server_url)) as ch:
         stream = rpc.volume_stub(ch).VolumeIncrementalCopy(
             volume_pb2.VolumeIncrementalCopyRequest(volume_id=vid, since_ns=since_ns)
         )

@@ -186,6 +186,16 @@ class VolumeServer:
         self.store.delete_volume(req.volume_id)
         return pb.VolumeDeleteResponse()
 
+    def VolumeMount(self, req, context):
+        if not self.store.mount_volume(req.volume_id):
+            context.abort(grpc.StatusCode.NOT_FOUND, f"volume {req.volume_id} not found")
+        return pb.VolumeMountResponse()
+
+    def VolumeUnmount(self, req, context):
+        if not self.store.unmount_volume(req.volume_id):
+            context.abort(grpc.StatusCode.NOT_FOUND, f"volume {req.volume_id} not found")
+        return pb.VolumeUnmountResponse()
+
     def VolumeMarkReadonly(self, req, context):
         self.store.mark_volume_readonly(req.volume_id)
         return pb.VolumeMarkReadonlyResponse()
@@ -569,6 +579,15 @@ class VolumeServer:
                     else:
                         ev = server.store.find_ec_volume(fid.volume_id)
                         if ev is None:
+                            # not local: redirect the reader to an owning
+                            # node (volume_server_handlers_read.go:60-77)
+                            target = server._redirect_target(fid.volume_id)
+                            if target:
+                                return self._reply(
+                                    302,
+                                    b"",
+                                    {"Location": f"http://{target}{self.path}"},
+                                )
                             return self._json({"error": "volume not found"}, 404)
                         n = ev.read_needle(
                             fid.key, fetch=server._remote_shard_fetcher(fid.volume_id)
@@ -607,7 +626,10 @@ class VolumeServer:
                 if chunks is None:
                     return self._json({"error": "invalid chunk manifest"}, 500)
                 manifest = json.loads(n.data)
-                total = manifest.get("size") or sum(c["size"] for c in chunks)
+                # Content-Length must match what we actually stream, so
+                # it comes from the validated chunk sizes, never the
+                # client-declared manifest "size"
+                total = sum(c["size"] for c in chunks)
                 headers = {"Content-Type": "application/octet-stream"}
                 if manifest.get("mime"):
                     headers["Content-Type"] = manifest["mime"]
@@ -702,6 +724,28 @@ class VolumeServer:
 
         return Handler
 
+    def _redirect_target(self, vid: int) -> str | None:
+        """Another server that can serve this vid: a replica holder, or
+        any EC shard holder learned from the master."""
+        me = f"{self.host}:{self.port}"
+        for url in self._lookup_locations(vid) or []:
+            if url != me:
+                return url
+        if not self.master:
+            return None
+        try:
+            with grpc.insecure_channel(self._master_grpc()) as ch:
+                resp = rpc.master_stub(ch).LookupEcVolume(
+                    master_pb2.LookupEcVolumeRequest(volume_id=vid)
+                )
+            for entry in resp.shard_id_locations:
+                for loc in entry.locations:
+                    if loc.url != me:
+                        return loc.url
+        except grpc.RpcError:
+            pass
+        return None
+
     def _fetch_fid(self, fid_str: str) -> bytes | None:
         """Resolve a chunk fid (local store first, then master lookup +
         HTTP GET from the owning peer)."""
@@ -727,20 +771,20 @@ class VolumeServer:
         return None
 
     def _delete_fid(self, fid_str: str) -> None:
+        """Cascade-delete one chunk fid through the HTTP DELETE path so
+        the handler's replication fan-out reaches every replica (a
+        local-only store delete would orphan replica copies)."""
         import urllib.request
 
         try:
             fid = FileId.parse(fid_str)
         except ValueError:
             return
-        v = self.store.find_volume(fid.volume_id)
-        if v is not None:
-            try:
-                self.store.delete_needle(fid.volume_id, Needle(cookie=fid.cookie, id=fid.key))
-            except NeedleNotFound:
-                pass
-            return
-        for url in self._lookup_locations(fid.volume_id) or []:
+        urls = self._lookup_locations(fid.volume_id) or []
+        me = f"{self.host}:{self.port}"
+        if self.store.find_volume(fid.volume_id) is not None and me not in urls:
+            urls = [me] + urls
+        for url in urls:
             try:
                 req = urllib.request.Request(f"http://{url}/{fid_str}", method="DELETE")
                 urllib.request.urlopen(req, timeout=10).read()

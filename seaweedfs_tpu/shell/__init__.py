@@ -1,0 +1,4 @@
+from seaweedfs_tpu.shell.command_env import CommandEnv
+from seaweedfs_tpu.shell.commands import COMMANDS, run_command
+
+__all__ = ["CommandEnv", "COMMANDS", "run_command"]

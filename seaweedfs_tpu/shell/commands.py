@@ -1,0 +1,657 @@
+"""Admin shell commands.
+
+Behavioral match of weed/shell/ (31-command REPL). Implemented set:
+  ec.encode  ec.decode  ec.rebuild  ec.balance
+  volume.balance  volume.fix.replication  volume.vacuum  volume.list
+  volume.delete  volume.mount  volume.unmount  volume.move  volume.copy
+  collection.list  collection.delete  fs.* live in shell/fs_commands.py
+
+Each command is `run(env, args, out) -> None`, printing human output to
+`out` (an io.TextIOBase). Planners accept -force/-apply the same way the
+reference threads applyBalancing (command_ec_common.go:18).
+"""
+
+from __future__ import annotations
+
+import io
+import shlex
+import time
+
+import grpc
+
+from seaweedfs_tpu.pb import master_pb2, rpc, volume_pb2
+from seaweedfs_tpu.shell import ec_common
+from seaweedfs_tpu.shell.command_env import CommandEnv, TopologyDump
+
+COMMANDS: dict[str, "Command"] = {}
+
+
+class Command:
+    name = ""
+    help = ""
+
+    def run(self, env: CommandEnv, args: list[str], out: io.TextIOBase) -> None:
+        raise NotImplementedError
+
+
+def register(cls):
+    COMMANDS[cls.name] = cls()
+    return cls
+
+
+def run_command(env: CommandEnv, line: str, out: io.TextIOBase | None = None) -> str:
+    """Parse + run one command line; returns captured output."""
+    buf = io.StringIO()
+    parts = shlex.split(line)
+    if not parts:
+        return ""
+    cmd = COMMANDS.get(parts[0])
+    if cmd is None:
+        raise ValueError(f"unknown command {parts[0]!r}; try `help`")
+    cmd.run(env, parts[1:], out or buf)
+    return buf.getvalue()
+
+
+def _flag(args: list[str], name: str, default: str = "") -> str:
+    """-name=value or -name value."""
+    for i, a in enumerate(args):
+        if a == f"-{name}" and i + 1 < len(args):
+            return args[i + 1]
+        if a.startswith(f"-{name}="):
+            return a.split("=", 1)[1]
+    return default
+
+
+def _has_flag(args: list[str], name: str) -> bool:
+    return any(a == f"-{name}" or a.startswith(f"-{name}=") for a in args)
+
+
+# ----------------------------------------------------------------------
+# collection / volume info
+
+
+@register
+class CollectionList(Command):
+    name = "collection.list"
+    help = "list all collections"
+
+    def run(self, env, args, out):
+        with env.master_channel() as ch:
+            resp = rpc.master_stub(ch).CollectionList(
+                master_pb2.CollectionListRequest(
+                    include_normal_volumes=True, include_ec_volumes=True
+                )
+            )
+        for c in resp.collections:
+            print(f"collection:{c}", file=out)
+
+
+@register
+class CollectionDelete(Command):
+    name = "collection.delete"
+    help = "collection.delete <collection>"
+
+    def run(self, env, args, out):
+        if not args:
+            raise ValueError("usage: collection.delete <collection>")
+        with env.master_channel() as ch:
+            rpc.master_stub(ch).CollectionDelete(
+                master_pb2.CollectionDeleteRequest(name=args[0])
+            )
+        print(f"collection {args[0]} is deleted", file=out)
+
+
+@register
+class VolumeList(Command):
+    name = "volume.list"
+    help = "list all volumes"
+
+    def run(self, env, args, out):
+        dump = env.collect_topology()
+        for n in dump.nodes:
+            print(f"node {n.url} dc:{n.dc} rack:{n.rack}", file=out)
+            for v in sorted(n.volumes, key=lambda v: v["Id"]):
+                print(
+                    f"  volume id:{v['Id']} size:{v['Size']} "
+                    f"collection:{v['Collection']!r} file_count:{v['FileCount']} "
+                    f"delete_count:{v['DeleteCount']} read_only:{v['ReadOnly']}",
+                    file=out,
+                )
+            for s in sorted(n.ec_shards, key=lambda s: s["Id"]):
+                sids = ec_common.shard_bits_to_ids(s["EcIndexBits"])
+                print(f"  ec volume id:{s['Id']} shards:{sids}", file=out)
+
+
+# ----------------------------------------------------------------------
+# volume admin
+
+
+@register
+class VolumeDelete(Command):
+    name = "volume.delete"
+    help = "volume.delete -node <host:port> -volumeId <vid>"
+
+    def run(self, env, args, out):
+        node = _flag(args, "node")
+        vid = int(_flag(args, "volumeId"))
+        with env.volume_channel(node) as ch:
+            rpc.volume_stub(ch).VolumeDelete(
+                volume_pb2.VolumeDeleteRequest(volume_id=vid)
+            )
+        print(f"volume {vid} deleted from {node}", file=out)
+
+
+@register
+class VolumeMount(Command):
+    name = "volume.mount"
+    help = "volume.mount -node <host:port> -volumeId <vid>"
+
+    def run(self, env, args, out):
+        node = _flag(args, "node")
+        vid = int(_flag(args, "volumeId"))
+        with env.volume_channel(node) as ch:
+            rpc.volume_stub(ch).VolumeMount(volume_pb2.VolumeMountRequest(volume_id=vid))
+        print(f"volume {vid} mounted on {node}", file=out)
+
+
+@register
+class VolumeUnmount(Command):
+    name = "volume.unmount"
+    help = "volume.unmount -node <host:port> -volumeId <vid>"
+
+    def run(self, env, args, out):
+        node = _flag(args, "node")
+        vid = int(_flag(args, "volumeId"))
+        with env.volume_channel(node) as ch:
+            rpc.volume_stub(ch).VolumeUnmount(
+                volume_pb2.VolumeUnmountRequest(volume_id=vid)
+            )
+        print(f"volume {vid} unmounted on {node}", file=out)
+
+
+@register
+class VolumeCopy(Command):
+    name = "volume.copy"
+    help = "volume.copy -from <host:port> -to <host:port> -volumeId <vid>"
+
+    def run(self, env, args, out):
+        src = _flag(args, "from")
+        dst = _flag(args, "to")
+        vid = int(_flag(args, "volumeId"))
+        with env.volume_channel(dst) as ch:
+            rpc.volume_stub(ch).VolumeCopy(
+                volume_pb2.VolumeCopyRequest(volume_id=vid, source_data_node=src)
+            )
+        print(f"volume {vid} copied {src} => {dst}", file=out)
+
+
+@register
+class VolumeMove(Command):
+    name = "volume.move"
+    help = "volume.move -from <host:port> -to <host:port> -volumeId <vid>"
+
+    def run(self, env, args, out):
+        src = _flag(args, "from")
+        dst = _flag(args, "to")
+        vid = int(_flag(args, "volumeId"))
+        # copy → mount happens inside VolumeCopy; then delete source
+        # (command_volume_move.go: copy + tail + delete)
+        with env.volume_channel(dst) as ch:
+            rpc.volume_stub(ch).VolumeCopy(
+                volume_pb2.VolumeCopyRequest(volume_id=vid, source_data_node=src)
+            )
+        with env.volume_channel(src) as ch:
+            rpc.volume_stub(ch).VolumeDelete(
+                volume_pb2.VolumeDeleteRequest(volume_id=vid)
+            )
+        print(f"volume {vid} moved {src} => {dst}", file=out)
+
+
+@register
+class VolumeVacuum(Command):
+    name = "volume.vacuum"
+    help = "volume.vacuum [-garbageThreshold 0.3] — run the 4-phase vacuum across the cluster"
+
+    def run(self, env, args, out):
+        threshold = float(_flag(args, "garbageThreshold", "0.3"))
+        dump = env.collect_topology()
+        compacted = 0
+        for n in dump.nodes:
+            for v in n.volumes:
+                if v["ReadOnly"]:
+                    continue
+                with env.volume_channel(n.url) as ch:
+                    stub = rpc.volume_stub(ch)
+                    check = stub.VacuumVolumeCheck(
+                        volume_pb2.VacuumVolumeCheckRequest(volume_id=v["Id"])
+                    )
+                    if check.garbage_ratio <= threshold:
+                        continue
+                    stub.VacuumVolumeCompact(
+                        volume_pb2.VacuumVolumeCompactRequest(volume_id=v["Id"])
+                    )
+                    stub.VacuumVolumeCommit(
+                        volume_pb2.VacuumVolumeCommitRequest(volume_id=v["Id"])
+                    )
+                    stub.VacuumVolumeCleanup(
+                        volume_pb2.VacuumVolumeCleanupRequest(volume_id=v["Id"])
+                    )
+                compacted += 1
+                print(f"vacuumed volume {v['Id']} on {n.url}", file=out)
+        print(f"vacuumed {compacted} volumes", file=out)
+
+
+# ----------------------------------------------------------------------
+# volume.balance (command_volume_balance.go)
+
+
+def plan_volume_balance(dump: TopologyDump, collection: str | None = None) -> list[dict]:
+    """Plan moves so every node holds ≈ its share of volumes. Returns
+    [{vid, from, to}] without applying."""
+    nodes = dump.nodes
+    if not nodes:
+        return []
+    counts = {
+        n.url: len([v for v in n.volumes if collection is None or v["Collection"] == collection])
+        for n in nodes
+    }
+    caps = {n.url: max(n.max_volumes, 1) for n in nodes}
+    total = sum(counts.values())
+    cap_total = sum(caps.values())
+    moves = []
+    vols_by_node = {
+        n.url: [v for v in n.volumes if collection is None or v["Collection"] == collection]
+        for n in nodes
+    }
+    # target share per node proportional to capacity (reference balances
+    # by ratio of volume count to max count)
+    def ratio(url):
+        return counts[url] / caps[url]
+
+    urls = [n.url for n in nodes]
+    for _ in range(total):  # each volume moves at most once
+        urls.sort(key=ratio)
+        low, high = urls[0], urls[-1]
+        # move only while the donor's ratio stays above the receiver's
+        # even after giving one away (integer cross-multiply, no float)
+        if (counts[high] - 1) * caps[low] <= counts[low] * caps[high]:
+            break
+        candidates = [
+            v
+            for v in vols_by_node[high]
+            if v["Id"] not in {x["Id"] for x in vols_by_node[low]}
+        ]
+        if not candidates:
+            break
+        v = candidates[0]
+        moves.append({"vid": v["Id"], "from": high, "to": low})
+        vols_by_node[high].remove(v)
+        vols_by_node[low].append(v)
+        counts[high] -= 1
+        counts[low] += 1
+    return moves
+
+
+@register
+class VolumeBalance(Command):
+    name = "volume.balance"
+    help = "volume.balance [-collection name] [-force]"
+
+    def run(self, env, args, out):
+        apply = _has_flag(args, "force")
+        collection = _flag(args, "collection") or None
+        dump = env.collect_topology()
+        moves = plan_volume_balance(dump, collection)
+        for m in moves:
+            print(f"moving volume {m['vid']} {m['from']} => {m['to']}", file=out)
+            if apply:
+                with env.volume_channel(m["to"]) as ch:
+                    rpc.volume_stub(ch).VolumeCopy(
+                        volume_pb2.VolumeCopyRequest(
+                            volume_id=m["vid"], source_data_node=m["from"]
+                        )
+                    )
+                with env.volume_channel(m["from"]) as ch:
+                    rpc.volume_stub(ch).VolumeDelete(
+                        volume_pb2.VolumeDeleteRequest(volume_id=m["vid"])
+                    )
+        print(f"planned {len(moves)} moves, applied={apply}", file=out)
+
+
+# ----------------------------------------------------------------------
+# volume.fix.replication (command_volume_fix_replication.go)
+
+
+def plan_fix_replication(dump: TopologyDump) -> list[dict]:
+    """Find under-replicated volumes; plan [{vid, from, to}] copies.
+    Placement-aware: prefers a different rack when the placement's
+    diff_rack_count calls for it."""
+    from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
+
+    locations: dict[int, list] = {}
+    info: dict[int, dict] = {}
+    for n in dump.nodes:
+        for v in n.volumes:
+            locations.setdefault(v["Id"], []).append(n)
+            info[v["Id"]] = v
+    plans = []
+    for vid, nodes_with in locations.items():
+        v = info[vid]
+        rp = ReplicaPlacement.from_byte(v["ReplicaPlacement"])
+        want = rp.copy_count
+        have = len(nodes_with)
+        if have >= want:
+            continue
+        present = {n.url for n in nodes_with}
+        present_racks = {(n.dc, n.rack) for n in nodes_with}
+        candidates = [n for n in dump.nodes if n.url not in present]
+        # prefer rack diversity when required
+        if rp.diff_rack_count > 0:
+            preferred = [n for n in candidates if (n.dc, n.rack) not in present_racks]
+            candidates = preferred or candidates
+        candidates.sort(key=lambda n: len(n.volumes))
+        for target in candidates[: want - have]:
+            plans.append({"vid": vid, "from": nodes_with[0].url, "to": target.url})
+    return plans
+
+
+@register
+class VolumeFixReplication(Command):
+    name = "volume.fix.replication"
+    help = "volume.fix.replication [-n dry-run]"
+
+    def run(self, env, args, out):
+        dry = _has_flag(args, "n")
+        dump = env.collect_topology()
+        plans = plan_fix_replication(dump)
+        for p in plans:
+            print(f"replicating volume {p['vid']} {p['from']} => {p['to']}", file=out)
+            if not dry:
+                with env.volume_channel(p["to"]) as ch:
+                    rpc.volume_stub(ch).VolumeCopy(
+                        volume_pb2.VolumeCopyRequest(
+                            volume_id=p["vid"], source_data_node=p["from"]
+                        )
+                    )
+        print(f"fixed {0 if dry else len(plans)} volumes (planned {len(plans)})", file=out)
+
+
+# ----------------------------------------------------------------------
+# ec.* (command_ec_encode.go / _rebuild.go / _balance.go / _decode.go)
+
+
+def collect_volume_ids_for_ec_encode(
+    dump: TopologyDump, collection: str, quiet_period_s: float, full_percent: float
+) -> list[int]:
+    """Quiet + full volumes (collectVolumeIdsForEcEncode:258): volumes
+    of the collection whose size exceeds full_percent% of the limit.
+    (Our heartbeat rows don't carry modified-at; quiet filtering happens
+    server-side at generate time.)"""
+    limit = dump.volume_size_limit_mb * 1024 * 1024
+    vids = []
+    for n in dump.nodes:
+        for v in n.volumes:
+            if v["Collection"] != collection:
+                continue
+            if v["Size"] >= limit * full_percent / 100.0:
+                vids.append(v["Id"])
+    return sorted(set(vids))
+
+
+def do_ec_encode(env: CommandEnv, vid: int, collection: str, out) -> None:
+    """The 6-step encode pipeline (volume_grpc_erasure_coding.go:25-36 +
+    command_ec_encode.go doEcEncode): mark readonly on all replicas →
+    generate on one → spread by balanced distribution → mount → delete
+    source shards it no longer owns → delete the original volume."""
+    with env.master_channel() as ch:
+        resp = rpc.master_stub(ch).LookupVolume(
+            master_pb2.LookupVolumeRequest(vids=[str(vid)])
+        )
+    locs = [l.url for e in resp.vid_locations for l in e.locations]
+    if not locs:
+        raise ValueError(f"volume {vid} not found")
+    source = locs[0]
+
+    # 1. mark readonly everywhere (markVolumeReadonly :119)
+    for url in locs:
+        with env.volume_channel(url) as ch:
+            rpc.volume_stub(ch).VolumeMarkReadonly(
+                volume_pb2.VolumeMarkReadonlyRequest(volume_id=vid)
+            )
+    # 2. generate EC shards on the source
+    with env.volume_channel(source) as ch:
+        rpc.volume_stub(ch).VolumeEcShardsGenerate(
+            volume_pb2.VolumeEcShardsGenerateRequest(volume_id=vid, collection=collection)
+        )
+    print(f"generated ec shards for volume {vid} on {source}", file=out)
+
+    # 3. spread (spreadEcShards :153 + balancedEcDistribution :240)
+    nodes = ec_common.collect_ec_nodes(env)
+    allocation = ec_common.balanced_ec_distribution(nodes)
+    if len(allocation) < ec_common.TOTAL_SHARDS_COUNT:
+        raise RuntimeError(
+            f"not enough free ec shard slots to spread volume {vid}; "
+            "the generated shards remain on the source, volume untouched"
+        )
+    per_node: dict[str, list[int]] = {}
+    node_by_url = {n.url: n for n in nodes}
+    for sid, node in enumerate(allocation):
+        per_node.setdefault(node.url, []).append(sid)
+    for url, shard_ids in per_node.items():
+        ec_common.copy_and_mount_shards(
+            env, node_by_url[url], vid, collection, shard_ids, source, apply=True
+        )
+        print(f"spread ec shards {vid}.{shard_ids} => {url}", file=out)
+    # 4. delete shards from the source that moved elsewhere
+    moved = [sid for url, sids in per_node.items() if url != source for sid in sids]
+    if moved:
+        with env.volume_channel(source) as ch:
+            rpc.volume_stub(ch).VolumeEcShardsDelete(
+                volume_pb2.VolumeEcShardsDeleteRequest(
+                    volume_id=vid, collection=collection, shard_ids=moved
+                )
+            )
+    # 5. delete the original volume on every replica
+    for url in locs:
+        with env.volume_channel(url) as ch:
+            rpc.volume_stub(ch).VolumeDelete(volume_pb2.VolumeDeleteRequest(volume_id=vid))
+    print(f"ec encoded volume {vid}", file=out)
+
+
+@register
+class EcEncode(Command):
+    name = "ec.encode"
+    help = "ec.encode [-collection name] [-volumeId vid] [-fullPercent 95]"
+
+    def run(self, env, args, out):
+        collection = _flag(args, "collection")
+        vid_flag = _flag(args, "volumeId")
+        dump = env.collect_topology()
+        if vid_flag:
+            vids = [int(vid_flag)]
+            if not _has_flag(args, "collection"):
+                # resolve the volume's real collection so copy/mount
+                # address the right base name
+                for n in dump.nodes:
+                    for v in n.volumes:
+                        if v["Id"] == vids[0]:
+                            collection = v["Collection"]
+        else:
+            vids = collect_volume_ids_for_ec_encode(
+                dump, collection, 60.0, float(_flag(args, "fullPercent", "95"))
+            )
+        for vid in vids:
+            do_ec_encode(env, vid, collection, out)
+
+
+def find_missing_shards(nodes: list[ec_common.EcNode], vid: int) -> list[int]:
+    present = 0
+    for n in nodes:
+        entry = n.ec_shards.get(vid)
+        if entry:
+            present |= entry[1]
+    return [i for i in range(ec_common.TOTAL_SHARDS_COUNT) if not present & (1 << i)]
+
+
+def do_ec_rebuild(env: CommandEnv, vid: int, out, apply: bool = True) -> list[int]:
+    """Rebuild missing shards on one rebuilder node
+    (command_ec_rebuild.go rebuildOneEcVolume): copy survivors to the
+    rebuilder, VolumeEcShardsRebuild regenerates the missing ones
+    locally, mount them, master learns via heartbeat."""
+    nodes = ec_common.collect_ec_nodes(env)
+    missing = find_missing_shards(nodes, vid)
+    if not missing:
+        print(f"volume {vid}: no missing shards", file=out)
+        return []
+    holders = [n for n in nodes if vid in n.ec_shards]
+    if not holders:
+        raise ValueError(f"no ec shards for volume {vid}")
+    collection = holders[0].ec_shards[vid][0]
+    # rebuilder = node with most free slots
+    rebuilder = max(nodes, key=lambda n: n.free_ec_slot)
+    if not apply:
+        return missing
+    # pull surviving shards it doesn't hold yet
+    original_local = set(rebuilder.local_shard_ids(vid))
+    local = set(original_local)
+    for n in holders:
+        if n.url == rebuilder.url:
+            continue
+        need = [s for s in n.local_shard_ids(vid) if s not in local]
+        if not need:
+            continue
+        with env.volume_channel(rebuilder.url) as ch:
+            rpc.volume_stub(ch).VolumeEcShardsCopy(
+                volume_pb2.VolumeEcShardsCopyRequest(
+                    volume_id=vid,
+                    collection=collection,
+                    shard_ids=need,
+                    copy_ecx_file=True,
+                    source_data_node=n.url,
+                )
+            )
+        local.update(need)
+    with env.volume_channel(rebuilder.url) as ch:
+        resp = rpc.volume_stub(ch).VolumeEcShardsRebuild(
+            volume_pb2.VolumeEcShardsRebuildRequest(volume_id=vid, collection=collection)
+        )
+        rebuilt = list(resp.rebuilt_shard_ids)
+        rpc.volume_stub(ch).VolumeEcShardsMount(
+            volume_pb2.VolumeEcShardsMountRequest(
+                volume_id=vid, collection=collection, shard_ids=rebuilt
+            )
+        )
+        # drop the borrowed survivor copies (they stay mounted on their
+        # original owners); keep only what this node now contributes
+        borrowed = [s for s in local if s not in original_local and s not in rebuilt]
+        if borrowed:
+            rpc.volume_stub(ch).VolumeEcShardsDelete(
+                volume_pb2.VolumeEcShardsDeleteRequest(
+                    volume_id=vid, collection=collection, shard_ids=borrowed
+                )
+            )
+    print(f"rebuilt shards {rebuilt} for volume {vid} on {rebuilder.url}", file=out)
+    return rebuilt
+
+
+@register
+class EcRebuild(Command):
+    name = "ec.rebuild"
+    help = "ec.rebuild [-volumeId vid] [-force]"
+
+    def run(self, env, args, out):
+        vid_flag = _flag(args, "volumeId")
+        apply = _has_flag(args, "force") or bool(vid_flag)
+        nodes = ec_common.collect_ec_nodes(env)
+        vids = (
+            [int(vid_flag)]
+            if vid_flag
+            else sorted({vid for n in nodes for vid in n.ec_shards})
+        )
+        for vid in vids:
+            do_ec_rebuild(env, vid, out, apply)
+
+
+@register
+class EcBalance(Command):
+    name = "ec.balance"
+    help = "ec.balance [-collection name] [-force]"
+
+    def run(self, env, args, out):
+        apply = _has_flag(args, "force")
+        collection = _flag(args, "collection") or None
+        nodes = ec_common.collect_ec_nodes(env)
+        stats = ec_common.balance_ec_volumes(env, nodes, collection, apply)
+        print(
+            f"ec.balance dedup:{stats['dedup']} across_racks:{stats['across_racks']} "
+            f"within_racks:{stats['within_racks']} rack_total:{stats['rack_total']} "
+            f"applied={apply}",
+            file=out,
+        )
+
+
+@register
+class EcDecode(Command):
+    name = "ec.decode"
+    help = "ec.decode -volumeId vid [-collection name] — EC shards back to a normal volume"
+
+    def run(self, env, args, out):
+        vid = int(_flag(args, "volumeId"))
+        collection = _flag(args, "collection")
+        nodes = ec_common.collect_ec_nodes(env)
+        holders = [n for n in nodes if vid in n.ec_shards]
+        if not holders:
+            raise ValueError(f"no ec shards for volume {vid}")
+        if not collection:
+            collection = holders[0].ec_shards[vid][0]
+        # collect every shard onto one node, then decode there
+        # (command_ec_decode.go collectEcShards + generateNormalVolume)
+        target = max(holders, key=lambda n: len(n.local_shard_ids(vid)))
+        have = set(target.local_shard_ids(vid))
+        for n in holders:
+            if n.url == target.url:
+                continue
+            need = [s for s in n.local_shard_ids(vid) if s not in have]
+            if not need:
+                continue
+            with env.volume_channel(target.url) as ch:
+                rpc.volume_stub(ch).VolumeEcShardsCopy(
+                    volume_pb2.VolumeEcShardsCopyRequest(
+                        volume_id=vid,
+                        collection=collection,
+                        shard_ids=need,
+                        copy_ecx_file=True,
+                        source_data_node=n.url,
+                    )
+                )
+            have.update(need)
+        with env.volume_channel(target.url) as ch:
+            rpc.volume_stub(ch).VolumeEcShardsToVolume(
+                volume_pb2.VolumeEcShardsToVolumeRequest(
+                    volume_id=vid, collection=collection
+                )
+            )
+        # drop the ec shards everywhere now that the volume is back
+        for n in holders:
+            sids = n.local_shard_ids(vid)
+            with env.volume_channel(n.url) as ch:
+                stub = rpc.volume_stub(ch)
+                stub.VolumeEcShardsUnmount(
+                    volume_pb2.VolumeEcShardsUnmountRequest(volume_id=vid, shard_ids=sids)
+                )
+                stub.VolumeEcShardsDelete(
+                    volume_pb2.VolumeEcShardsDeleteRequest(
+                        volume_id=vid, collection=collection, shard_ids=sids
+                    )
+                )
+        print(f"decoded ec volume {vid} back to a normal volume on {target.url}", file=out)
+
+
+@register
+class Help(Command):
+    name = "help"
+    help = "list commands"
+
+    def run(self, env, args, out):
+        for name in sorted(COMMANDS):
+            print(f"{name:28s} {COMMANDS[name].help}", file=out)

@@ -1,0 +1,91 @@
+"""Interactive admin REPL (weed/shell/shell_liner.go) and the master's
+maintenance cron runner (weed/server/master_server.go:183
+startAdminScripts: when leader, run the configured admin script lines
+on a fixed period)."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+from seaweedfs_tpu.shell.command_env import CommandEnv
+from seaweedfs_tpu.shell.commands import COMMANDS, run_command
+
+
+def run_shell(masters: list[str], stdin=None, stdout=None) -> None:
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    env = CommandEnv(masters)
+    print("seaweedfs-tpu shell; `help` lists commands, `exit` quits", file=stdout)
+    while True:
+        print("> ", end="", file=stdout, flush=True)
+        line = stdin.readline()
+        if not line or line.strip() in ("exit", "quit"):
+            return
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out = run_command(env, line)
+            if out:
+                print(out, end="", file=stdout)
+        except Exception as e:  # noqa: BLE001 — REPL keeps running
+            print(f"error: {e}", file=stdout)
+
+
+DEFAULT_MAINTENANCE_SCRIPTS = [
+    # what the reference master cron runs every 17 min when leader
+    # (master_server.go:183-249)
+    "ec.encode -fullPercent=95",
+    "ec.rebuild -force",
+    "ec.balance -force",
+    "volume.balance -force",
+    "volume.fix.replication",
+]
+
+
+class MaintenanceRunner:
+    """Background admin-script loop (startAdminScripts). Attach to a
+    master with `start()`; each period it runs the script lines through
+    the same command table the shell uses."""
+
+    def __init__(
+        self,
+        masters: list[str],
+        scripts: list[str] | None = None,
+        period_s: float = 17 * 60,
+        is_leader=lambda: True,
+    ):
+        self.env = CommandEnv(masters)
+        self.scripts = DEFAULT_MAINTENANCE_SCRIPTS if scripts is None else scripts
+        self.period_s = period_s
+        self.is_leader = is_leader
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.last_output: list[str] = []
+
+    def run_once(self) -> list[str]:
+        outputs = []
+        for line in self.scripts:
+            if line.split()[0] not in COMMANDS:
+                outputs.append(f"{line}: unknown command")
+                continue
+            try:
+                outputs.append(run_command(self.env, line))
+            except Exception as e:  # noqa: BLE001 — cron keeps going
+                outputs.append(f"{line}: {e}")
+        self.last_output = outputs
+        return outputs
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_s):
+            if self.is_leader():
+                self.run_once()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

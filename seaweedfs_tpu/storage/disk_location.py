@@ -94,6 +94,32 @@ class DiskLocation:
         v.destroy()
         return True
 
+    def unmount_volume(self, vid: int) -> bool:
+        """Close and forget a volume, keeping its files on disk
+        (disk_location.go UnloadVolume)."""
+        v = self.volumes.pop(vid, None)
+        if v is None:
+            return False
+        v.close()
+        return True
+
+    def mount_volume(self, vid: int) -> bool:
+        """(Re)load one volume from this directory's files
+        (disk_location.go LoadVolume)."""
+        if vid in self.volumes:
+            return True
+        for name in sorted(os.listdir(self.directory)):
+            parsed = parse_volume_file_name(name)
+            if parsed is None or parsed[1] != vid:
+                continue
+            collection = parsed[0]
+            try:
+                self.volumes[vid] = Volume(self.directory, vid, collection, create=False)
+                return True
+            except (OSError, ValueError):
+                return False
+        return False
+
     def close(self) -> None:
         for v in self.volumes.values():
             v.close()

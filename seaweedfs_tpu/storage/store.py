@@ -125,6 +125,18 @@ class Store:
                 return True
         return False
 
+    def mount_volume(self, vid: int) -> bool:
+        for loc in self.locations:
+            if loc.mount_volume(vid):
+                return True
+        return False
+
+    def unmount_volume(self, vid: int) -> bool:
+        for loc in self.locations:
+            if loc.unmount_volume(vid):
+                return True
+        return False
+
     def mark_volume_readonly(self, vid: int) -> bool:
         v = self.find_volume(vid)
         if v is None:

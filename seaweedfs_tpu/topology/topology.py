@@ -234,6 +234,32 @@ class Topology(Node):
                                     "Volumes": dn.volume_count(),
                                     "EcShards": dn.ec_shard_count(),
                                     "Max": dn.max_volume_count(),
+                                    # full per-volume detail so admin
+                                    # planners (shell) can work from one
+                                    # VolumeList call, like the
+                                    # reference's TopologyInfo proto
+                                    "VolumeInfos": [
+                                        {
+                                            "Id": v.id,
+                                            "Collection": v.collection,
+                                            "Size": v.size,
+                                            "FileCount": v.file_count,
+                                            "DeleteCount": v.delete_count,
+                                            "DeletedByteCount": v.deleted_byte_count,
+                                            "ReadOnly": v.read_only,
+                                            "ReplicaPlacement": v.replica_placement,
+                                            "Ttl": v.ttl,
+                                        }
+                                        for v in dn.volumes.values()
+                                    ],
+                                    "EcShardInfos": [
+                                        {
+                                            "Id": s.id,
+                                            "Collection": s.collection,
+                                            "EcIndexBits": s.ec_index_bits,
+                                        }
+                                        for s in dn.ec_shards.values()
+                                    ],
                                 }
                                 for dn in rack.children.values()  # type: ignore[attr-defined]
                             ],

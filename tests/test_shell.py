@@ -1,0 +1,354 @@
+"""Admin shell tests.
+
+Planner tests run on pure in-memory EcNode state with apply=False —
+the same no-cluster pattern as the reference's shell/command_ec_test.go
+(newEcNode/addEcVolumeAndShardsForTest + applyBalancing=false).
+Pipeline tests drive a live in-process cluster end-to-end:
+ec.encode → kill a shard → ec.rebuild → ec.balance → degraded read.
+"""
+
+import io
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.shell import ec_common
+from seaweedfs_tpu.shell.command_env import CommandEnv, TopologyDump, TopologyNodeInfo
+from seaweedfs_tpu.shell.commands import (
+    collect_volume_ids_for_ec_encode,
+    plan_fix_replication,
+    plan_volume_balance,
+    run_command,
+)
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+from tests.test_cluster import free_port, http_get, http_json
+
+
+def new_ec_node(url, rack, free=100, shards=None):
+    n = ec_common.EcNode(url=url, dc="dc1", rack=rack, free_ec_slot=free)
+    for vid, sids in (shards or {}).items():
+        n.ec_shards[vid] = ("", ec_common.ids_to_shard_bits(sids))
+        n.free_ec_slot -= len(sids)
+    return n
+
+
+ENV = CommandEnv(["127.0.0.1:0"])  # planners with apply=False never dial
+
+
+class TestEcPlanners:
+    def test_shard_bits_roundtrip(self):
+        ids = [0, 3, 13]
+        assert ec_common.shard_bits_to_ids(ec_common.ids_to_shard_bits(ids)) == ids
+
+    def test_balanced_distribution_prefers_free(self):
+        nodes = [
+            new_ec_node("a:1", "r1", free=100),
+            new_ec_node("b:1", "r1", free=10),
+            new_ec_node("c:1", "r2", free=1),
+        ]
+        picked = ec_common.balanced_ec_distribution(nodes)
+        assert len(picked) == 14
+        counts = {u: sum(1 for p in picked if p.url == u) for u in ("a:1", "b:1", "c:1")}
+        # the freest node takes the most shards; every node's allocation
+        # reflects its capacity ordering
+        assert counts["a:1"] >= counts["b:1"] >= counts["c:1"]
+
+    def test_balanced_distribution_insufficient_slots(self):
+        # fewer than 14 free slots in the whole cluster → [] (no hang)
+        nodes = [new_ec_node("a:1", "r1", free=5)]
+        assert ec_common.balanced_ec_distribution(nodes) == []
+
+    def test_dedup_removes_extra_copies(self):
+        nodes = [
+            new_ec_node("a:1", "r1", shards={5: [0, 1]}),
+            new_ec_node("b:1", "r1", shards={5: [1, 2]}),
+        ]
+        removed = ec_common.dedup_ec_shards(ENV, nodes, 5, apply=False)
+        assert removed == 1
+        holders = [n for n in nodes if 1 in n.local_shard_ids(5)]
+        assert len(holders) == 1
+
+    def test_balance_across_racks(self):
+        # all 14 shards in one rack, 2 racks exist → half must move
+        nodes = [
+            new_ec_node("a:1", "r1", shards={7: list(range(14))}),
+            new_ec_node("b:1", "r2", free=100),
+        ]
+        moves = ec_common.balance_across_racks(ENV, nodes, 7, apply=False)
+        assert moves == 7
+        assert len(nodes[1].local_shard_ids(7)) == 7
+
+    def test_balance_within_racks(self):
+        nodes = [
+            new_ec_node("a:1", "r1", shards={9: list(range(10))}),
+            new_ec_node("b:1", "r1", free=100),
+        ]
+        moves = ec_common.balance_within_racks(ENV, nodes, 9, apply=False)
+        assert moves > 0
+        assert len(nodes[0].local_shard_ids(9)) == 5
+        assert len(nodes[1].local_shard_ids(9)) == 5
+
+    def test_balance_ec_rack_totals(self):
+        nodes = [
+            new_ec_node("a:1", "r1", shards={1: list(range(8)), 2: list(range(6))}),
+            new_ec_node("b:1", "r1", free=100),
+        ]
+        moves = ec_common.balance_ec_rack(ENV, nodes, apply=False)
+        # reference semantics: only move a volume the receiver does not
+        # already hold, so one shard of each volume migrates (2 moves)
+        assert moves == 2
+        assert sorted(nodes[1].ec_shards) == [1, 2]
+
+    def test_full_balance_pass(self):
+        nodes = [
+            new_ec_node("a:1", "r1", shards={3: list(range(14))}),
+            new_ec_node("b:1", "r1", free=100),
+            new_ec_node("c:1", "r2", free=100),
+            new_ec_node("d:1", "r2", free=100),
+        ]
+        stats = ec_common.balance_ec_volumes(ENV, nodes, apply=False)
+        assert stats["across_racks"] > 0
+        # shard sets stay complete
+        total = sum(len(n.local_shard_ids(3)) for n in nodes)
+        assert total == 14
+        per_rack = {}
+        for n in nodes:
+            per_rack[n.rack] = per_rack.get(n.rack, 0) + len(n.local_shard_ids(3))
+        assert per_rack["r1"] == 7 and per_rack["r2"] == 7
+
+    def test_find_missing_shards(self):
+        nodes = [
+            new_ec_node("a:1", "r1", shards={4: [0, 1, 2]}),
+            new_ec_node("b:1", "r1", shards={4: [3, 4, 5, 6, 7, 8, 9, 10, 11, 12]}),
+        ]
+        from seaweedfs_tpu.shell.commands import find_missing_shards
+
+        assert find_missing_shards(nodes, 4) == [13]
+
+
+class TestVolumePlanners:
+    def _dump(self, spec):
+        """spec: {url: (rack, max, [vid...])}"""
+        nodes = []
+        for url, (rack, mx, vids) in spec.items():
+            nodes.append(
+                TopologyNodeInfo(
+                    url=url,
+                    public_url=url,
+                    dc="dc1",
+                    rack=rack,
+                    max_volumes=mx,
+                    volumes=[
+                        {
+                            "Id": vid,
+                            "Collection": "",
+                            "Size": 100,
+                            "FileCount": 1,
+                            "DeleteCount": 0,
+                            "DeletedByteCount": 0,
+                            "ReadOnly": False,
+                            "ReplicaPlacement": 0,
+                            "Ttl": 0,
+                        }
+                        for vid in vids
+                    ],
+                )
+            )
+        return TopologyDump(volume_size_limit_mb=30 * 1024, nodes=nodes)
+
+    def test_balance_moves_from_loaded_to_empty(self):
+        dump = self._dump({"a:1": ("r1", 10, [1, 2, 3, 4]), "b:1": ("r1", 10, [])})
+        moves = plan_volume_balance(dump)
+        assert moves
+        assert all(m["from"] == "a:1" and m["to"] == "b:1" for m in moves)
+        # ends balanced within 1
+        a = 4 - len(moves)
+        assert abs(a - len(moves)) <= 1
+
+    def test_balance_noop_when_even(self):
+        dump = self._dump({"a:1": ("r1", 10, [1, 2]), "b:1": ("r1", 10, [3, 4])})
+        assert plan_volume_balance(dump) == []
+
+    def test_fix_replication_prefers_other_rack(self):
+        dump = self._dump(
+            {
+                "a:1": ("r1", 10, [1]),
+                "b:1": ("r1", 10, []),
+                "c:1": ("r2", 10, []),
+            }
+        )
+        # volume 1 wants replication 010 (one replica on another rack)
+        dump.nodes[0].volumes[0]["ReplicaPlacement"] = 10  # "010": one replica on another rack
+        plans = plan_fix_replication(dump)
+        assert plans == [{"vid": 1, "from": "a:1", "to": "c:1"}]
+
+    def test_fix_replication_noop_when_satisfied(self):
+        dump = self._dump({"a:1": ("r1", 10, [1]), "b:1": ("r1", 10, [1])})
+        dump.nodes[0].volumes[0]["ReplicaPlacement"] = 1  # "001"
+        dump.nodes[1].volumes[0]["ReplicaPlacement"] = 1  # "001"
+        assert plan_fix_replication(dump) == []
+
+    def test_collect_volume_ids_for_ec_encode(self):
+        dump = self._dump({"a:1": ("r1", 10, [1, 2])})
+        dump.volume_size_limit_mb = 1  # 1 MiB limit
+        dump.nodes[0].volumes[0]["Size"] = 2 * 1024 * 1024  # full
+        dump.nodes[0].volumes[0]["Collection"] = "x"
+        dump.nodes[0].volumes[1]["Collection"] = "x"
+        vids = collect_volume_ids_for_ec_encode(dump, "x", 60, 95)
+        assert vids == [1]
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    master_port = free_port()
+    master = MasterServer(port=master_port, volume_size_limit_mb=64)
+    master.start()
+    volume_servers = []
+    for i in range(3):
+        vs = VolumeServer(
+            [str(tmp_path_factory.mktemp(f"svs{i}"))],
+            port=free_port(),
+            master=f"127.0.0.1:{master_port}",
+            rack=f"rack{i % 2}",
+            heartbeat_interval=0.2,
+            max_volume_counts=[100],
+        )
+        vs.start()
+        volume_servers.append(vs)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topology.data_nodes()) < 3:
+        time.sleep(0.05)
+    yield master, volume_servers
+    for vs in volume_servers:
+        vs.stop()
+    master.stop()
+
+
+def wait_for(predicate, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+class TestShellPipeline:
+    def test_ec_encode_rebuild_balance_end_to_end(self, cluster):
+        master, volume_servers = cluster
+        env = CommandEnv([f"127.0.0.1:{master.port}"])
+
+        # write a blob into a fresh collection
+        _, assign = http_json(
+            f"http://127.0.0.1:{master.port}/dir/assign?collection=shellec"
+        )
+        payload = b"shell pipeline payload " * 1000
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://{assign['url']}/{assign['fid']}", data=payload, method="POST"
+            ),
+            timeout=10,
+        ).close()
+        vid = int(assign["fid"].split(",")[0])
+
+        # ec.encode via the shell command
+        out = io.StringIO()
+        run_command(env, f"ec.encode -collection shellec -volumeId {vid}", out)
+        assert f"ec encoded volume {vid}" in out.getvalue()
+
+        # master learns the shard map via heartbeats
+        assert wait_for(
+            lambda: (locs := master.topology.lookup_ec_shards(vid)) is not None
+            and sum(1 for l in locs.locations if l) == 14
+        )
+
+        # degraded read through any server
+        status, body = http_get(f"http://{assign['url']}/{assign['fid']}")
+        assert status == 200 and body == payload
+
+        # drop one shard somewhere, then ec.rebuild restores it
+        victim = None
+        for vs in volume_servers:
+            ev = vs.store.find_ec_volume(vid)
+            if ev is not None and ev.shard_ids():
+                victim = vs
+                break
+        assert victim is not None
+        lost = victim.store.find_ec_volume(vid).shard_ids()[0]
+        victim.store.unmount_ec_shards(vid, [lost])
+        import os
+
+        base = victim.store.find_ec_volume(vid) or None
+        # remove the shard file so rebuild has real work
+        for loc in victim.store.locations:
+            p = os.path.join(loc.directory, f"shellec_{vid}.ec{lost:02d}")
+            if os.path.exists(p):
+                os.remove(p)
+        assert wait_for(
+            lambda: (locs := master.topology.lookup_ec_shards(vid)) is not None
+            and not locs.locations[lost]
+        )
+
+        out = io.StringIO()
+        run_command(env, f"ec.rebuild -volumeId {vid}", out)
+        assert "rebuilt shards" in out.getvalue()
+        assert wait_for(
+            lambda: (locs := master.topology.lookup_ec_shards(vid)) is not None
+            and sum(1 for l in locs.locations if l) == 14
+        )
+
+        # ec.balance runs clean over the live topology
+        out = io.StringIO()
+        run_command(env, "ec.balance -force", out)
+        assert "applied=True" in out.getvalue()
+
+        # data still readable after rebuild + balance
+        status, body = http_get(f"http://{assign['url']}/{assign['fid']}")
+        assert status == 200 and body == payload
+
+    def test_volume_list_and_collection_list(self, cluster):
+        master, _ = cluster
+        env = CommandEnv([f"127.0.0.1:{master.port}"])
+        out = io.StringIO()
+        run_command(env, "volume.list", out)
+        assert "node 127.0.0.1:" in out.getvalue()
+        out = io.StringIO()
+        run_command(env, "collection.list", out)
+        # the shellec collection became EC volumes; collection listing
+        # includes ec collections
+        assert "collection:" in out.getvalue() or out.getvalue() == ""
+
+    def test_volume_vacuum_command(self, cluster):
+        master, _ = cluster
+        env = CommandEnv([f"127.0.0.1:{master.port}"])
+        # create garbage: write then delete
+        _, assign = http_json(
+            f"http://127.0.0.1:{master.port}/dir/assign?collection=vac"
+        )
+        url = f"http://{assign['url']}/{assign['fid']}"
+        urllib.request.urlopen(
+            urllib.request.Request(url, data=b"garbage" * 1000, method="POST"),
+            timeout=10,
+        ).close()
+        urllib.request.urlopen(
+            urllib.request.Request(url, method="DELETE"), timeout=10
+        ).close()
+        out = io.StringIO()
+        run_command(env, "volume.vacuum -garbageThreshold 0.0001", out)
+        assert "vacuumed" in out.getvalue()
+
+    def test_maintenance_runner_once(self, cluster):
+        master, _ = cluster
+        from seaweedfs_tpu.shell.shell_runner import MaintenanceRunner
+
+        runner = MaintenanceRunner(
+            [f"127.0.0.1:{master.port}"],
+            scripts=["volume.fix.replication -n", "ec.balance"],
+            period_s=3600,
+        )
+        outputs = runner.run_once()
+        assert len(outputs) == 2
+        assert all("unknown command" not in o for o in outputs)
