@@ -1,0 +1,23 @@
+from seaweedfs_tpu.filer.entry import Attr, Entry, new_directory_entry
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filerstore import (
+    EntryNotFound,
+    FilerStore,
+    MemoryStore,
+    SortedLogStore,
+    SqliteStore,
+    new_store,
+)
+
+__all__ = [
+    "Attr",
+    "Entry",
+    "EntryNotFound",
+    "Filer",
+    "FilerStore",
+    "MemoryStore",
+    "SortedLogStore",
+    "SqliteStore",
+    "new_directory_entry",
+    "new_store",
+]

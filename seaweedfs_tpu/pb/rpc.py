@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import grpc
 
+from seaweedfs_tpu.pb import filer_pb2 as f
 from seaweedfs_tpu.pb import master_pb2 as m
 from seaweedfs_tpu.pb import volume_pb2 as v
 
@@ -99,6 +100,34 @@ VOLUME_METHODS = {
 }
 
 
+FILER_SERVICE = "seaweedfs_tpu.filer.Filer"
+FILER_METHODS = {
+    "LookupDirectoryEntry": (
+        f.LookupDirectoryEntryRequest,
+        f.LookupDirectoryEntryResponse,
+        UNARY_UNARY,
+    ),
+    "ListEntries": (f.ListEntriesRequest, f.ListEntriesResponse, UNARY_STREAM),
+    "CreateEntry": (f.CreateEntryRequest, f.CreateEntryResponse, UNARY_UNARY),
+    "UpdateEntry": (f.UpdateEntryRequest, f.UpdateEntryResponse, UNARY_UNARY),
+    "DeleteEntry": (f.DeleteEntryRequest, f.DeleteEntryResponse, UNARY_UNARY),
+    "AtomicRenameEntry": (
+        f.AtomicRenameEntryRequest,
+        f.AtomicRenameEntryResponse,
+        UNARY_UNARY,
+    ),
+    "AssignVolume": (f.AssignVolumeRequest, f.AssignVolumeResponse, UNARY_UNARY),
+    "LookupVolume": (f.LookupVolumeRequest, f.LookupVolumeResponse, UNARY_UNARY),
+    "DeleteCollection": (f.DeleteCollectionRequest, f.DeleteCollectionResponse, UNARY_UNARY),
+    "Statistics": (f.StatisticsRequest, f.StatisticsResponse, UNARY_UNARY),
+    "GetFilerConfiguration": (
+        f.GetFilerConfigurationRequest,
+        f.GetFilerConfigurationResponse,
+        UNARY_UNARY,
+    ),
+}
+
+
 def servicer_handler(service_name: str, methods: dict, impl) -> grpc.GenericRpcHandler:
     """Bind `impl`'s methods (same names as the table) into a generic
     gRPC handler. Methods receive (request_or_iterator, context)."""
@@ -137,3 +166,7 @@ def master_stub(channel: grpc.Channel) -> Stub:
 
 def volume_stub(channel: grpc.Channel) -> Stub:
     return Stub(channel, VOLUME_SERVICE, VOLUME_METHODS)
+
+
+def filer_stub(channel: grpc.Channel) -> Stub:
+    return Stub(channel, FILER_SERVICE, FILER_METHODS)

@@ -1,0 +1,351 @@
+"""Filer server: HTTP namespace gateway + gRPC service.
+
+Behavioral match of weed/server/filer_server*.go:
+
+  * POST /path — assign a fid from the master, proxy the body to the
+    volume server, create the entry; bodies over max_mb are split into
+    chunks each under its own fid (filer_server_handlers_write.go:41,
+    _write_autochunk.go:23 autoChunk);
+  * GET /path — files stream their chunk views from volume servers
+    (filer2/stream.go); directories list as JSON (readerAt the UI role);
+  * DELETE /path?recursive=true — entry + async chunk GC;
+  * gRPC — the 11-verb Filer service incl. AtomicRenameEntry inside a
+    store transaction (filer_grpc_server.go, _rename.go).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent import futures
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+import grpc
+
+from seaweedfs_tpu.client import operation as op
+from seaweedfs_tpu.filer import filechunks, stream
+from seaweedfs_tpu.filer.entry import Attr, Entry, normalize_path
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filerstore import EntryNotFound, new_store
+from seaweedfs_tpu.pb import filer_pb2 as fpb
+from seaweedfs_tpu.pb import rpc
+
+
+class FilerServer:
+    def __init__(
+        self,
+        masters: list[str],
+        host: str = "127.0.0.1",
+        port: int = 8888,
+        store: str = "memory",
+        store_path: str = "",
+        collection: str = "",
+        replication: str = "",
+        max_mb: int = 32,
+        on_event=None,
+    ):
+        self.masters = masters
+        self.host = host
+        self.port = port
+        self.grpc_port = port + 10000
+        self.collection = collection
+        self.replication = replication
+        self.max_mb = max_mb
+        self.filer = Filer(new_store(store, store_path), masters, on_event=on_event)
+        self._grpc_server: grpc.Server | None = None
+        self._http_server: ThreadingHTTPServer | None = None
+
+    # ------------------------------------------------------------------
+    # write path helpers
+    def _assign(self, collection: str = "", replication: str = "", ttl: str = "") -> op.AssignResult:
+        return op.assign(
+            self.masters[0],
+            collection=collection or self.collection,
+            replication=replication or self.replication,
+            ttl=ttl,
+        )
+
+    def _upload_bytes(
+        self, data: bytes, filename: str, mime: str, collection: str, replication: str, ttl: str
+    ) -> list:
+        """Upload `data` as 1..N chunks (autoChunk when over max_mb)."""
+        chunk_size = self.max_mb * 1024 * 1024
+        chunks = []
+        offset = 0
+        now_ns = time.time_ns()
+        while True:
+            piece = data[offset : offset + chunk_size] if chunk_size else data
+            ar = self._assign(collection, replication, ttl)
+            ur = op.upload(
+                f"{ar.url}/{ar.fid}", piece, filename=filename, mime=mime, ttl=ttl
+            )
+            if ur.error:
+                raise RuntimeError(f"upload chunk: {ur.error}")
+            chunks.append(
+                filechunks.make_chunk(
+                    ar.fid, offset, len(piece), now_ns + offset, e_tag=ur.etag
+                )
+            )
+            offset += len(piece)
+            if offset >= len(data):
+                break
+        return chunks
+
+    # ------------------------------------------------------------------
+    # gRPC servicer (filer_grpc_server.go)
+    def LookupDirectoryEntry(self, req: fpb.LookupDirectoryEntryRequest, context):
+        try:
+            entry = self.filer.find_entry(f"{req.directory}/{req.name}")
+        except EntryNotFound:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"{req.directory}/{req.name}")
+        return fpb.LookupDirectoryEntryResponse(entry=entry.to_pb())
+
+    def ListEntries(self, req: fpb.ListEntriesRequest, context):
+        entries = self.filer.list_entries(
+            req.directory,
+            start_file_name=req.start_from_file_name,
+            include_start=req.inclusive_start_from,
+            limit=req.limit or 1024,
+            prefix=req.prefix,
+        )
+        for e in entries:
+            yield fpb.ListEntriesResponse(entry=e.to_pb())
+
+    def CreateEntry(self, req: fpb.CreateEntryRequest, context):
+        entry = Entry.from_pb(req.directory, req.entry)
+        self.filer.create_entry(entry)
+        return fpb.CreateEntryResponse()
+
+    def UpdateEntry(self, req: fpb.UpdateEntryRequest, context):
+        entry = Entry.from_pb(req.directory, req.entry)
+        try:
+            old = self.filer.find_entry(entry.full_path)
+        except EntryNotFound:
+            context.abort(grpc.StatusCode.NOT_FOUND, entry.full_path)
+        garbage = filechunks.minus_chunks(old.chunks, entry.chunks)
+        self.filer.update_entry(entry)
+        if garbage:
+            self.filer.delete_chunks_async([c.fid for c in garbage])
+        return fpb.UpdateEntryResponse()
+
+    def DeleteEntry(self, req: fpb.DeleteEntryRequest, context):
+        try:
+            self.filer.delete_entry(
+                f"{req.directory}/{req.name}",
+                is_recursive=req.is_recursive,
+                delete_data=req.is_delete_data,
+            )
+        except EntryNotFound:
+            pass
+        except ValueError as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        return fpb.DeleteEntryResponse()
+
+    def AtomicRenameEntry(self, req: fpb.AtomicRenameEntryRequest, context):
+        try:
+            self.filer.atomic_rename(
+                f"{req.old_directory}/{req.old_name}",
+                f"{req.new_directory}/{req.new_name}",
+            )
+        except EntryNotFound as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return fpb.AtomicRenameEntryResponse()
+
+    def AssignVolume(self, req: fpb.AssignVolumeRequest, context):
+        ar = self._assign(req.collection, req.replication)
+        return fpb.AssignVolumeResponse(
+            fid=ar.fid, url=ar.url, public_url=ar.public_url, count=ar.count
+        )
+
+    def LookupVolume(self, req: fpb.LookupVolumeRequest, context):
+        out = fpb.LookupVolumeResponse()
+        for vid in req.volume_ids:
+            res = op.lookup(self.masters[0], vid)
+            locs = out.locations_map[vid]
+            for l in res.locations:
+                locs.locations.add(url=l["url"], public_url=l["publicUrl"])
+        return out
+
+    def DeleteCollection(self, req: fpb.DeleteCollectionRequest, context):
+        from seaweedfs_tpu.pb import master_pb2
+        from seaweedfs_tpu.pb.rpc import grpc_address
+
+        with grpc.insecure_channel(grpc_address(self.masters[0])) as ch:
+            rpc.master_stub(ch).CollectionDelete(
+                master_pb2.CollectionDeleteRequest(name=req.collection)
+            )
+        return fpb.DeleteCollectionResponse()
+
+    def Statistics(self, req: fpb.StatisticsRequest, context):
+        from seaweedfs_tpu.pb import master_pb2
+        from seaweedfs_tpu.pb.rpc import grpc_address
+
+        with grpc.insecure_channel(grpc_address(self.masters[0])) as ch:
+            resp = rpc.master_stub(ch).Statistics(
+                master_pb2.StatisticsRequest(
+                    replication=req.replication, collection=req.collection, ttl=req.ttl
+                )
+            )
+        return fpb.StatisticsResponse(
+            total_size=resp.total_size,
+            used_size=resp.used_size,
+            file_count=resp.file_count,
+        )
+
+    def GetFilerConfiguration(self, req, context):
+        return fpb.GetFilerConfigurationResponse(
+            masters=self.masters,
+            replication=self.replication,
+            collection=self.collection,
+            max_mb=self.max_mb,
+        )
+
+    # ------------------------------------------------------------------
+    # HTTP
+    def _http_handler_class(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, status, body=b"", headers=None):
+                self.send_response(status)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if self.command != "HEAD" and body:
+                    self.wfile.write(body)
+
+            def _json(self, obj, status=200):
+                self._reply(
+                    status,
+                    json.dumps(obj).encode(),
+                    {"Content-Type": "application/json"},
+                )
+
+            def _path_and_query(self):
+                url = urlparse(self.path)
+                return (
+                    normalize_path(unquote(url.path)),
+                    {k: v[0] for k, v in parse_qs(url.query).items()},
+                )
+
+            def do_GET(self):
+                path, q = self._path_and_query()
+                try:
+                    entry = server.filer.find_entry(path)
+                except EntryNotFound:
+                    return self._json({"error": "not found"}, 404)
+                if entry.is_directory:
+                    limit = int(q.get("limit", "100"))
+                    entries = server.filer.list_entries(
+                        path, start_file_name=q.get("lastFileName", ""), limit=limit
+                    )
+                    return self._json(
+                        {
+                            "Path": path,
+                            "Entries": [
+                                {
+                                    "FullPath": e.full_path,
+                                    "IsDirectory": e.is_directory,
+                                    "Size": e.size(),
+                                    "Mtime": e.attr.mtime,
+                                    "Mime": e.attr.mime,
+                                }
+                                for e in entries
+                            ],
+                            "Limit": limit,
+                        }
+                    )
+                body = b"".join(
+                    stream.stream_content(server.masters[0], entry.chunks)
+                )
+                headers = {
+                    "Content-Type": entry.attr.mime or "application/octet-stream",
+                    "ETag": filechunks.etag(entry.chunks) if entry.chunks else "",
+                }
+                self._reply(200, body, headers)
+
+            do_HEAD = do_GET
+
+            def do_POST(self):
+                path, q = self._path_and_query()
+                length = int(self.headers.get("Content-Length", "0"))
+                data = self.rfile.read(length)
+                mime = self.headers.get("Content-Type", "")
+                if path.endswith("/") or (not data and not length):
+                    # mkdir (the reference creates dirs via FUSE/gRPC;
+                    # HTTP POST with no body maps to mkdir here)
+                    from seaweedfs_tpu.filer.entry import new_directory_entry
+
+                    server.filer.create_entry(new_directory_entry(path))
+                    return self._json({"name": path}, 201)
+                try:
+                    chunks = server._upload_bytes(
+                        data,
+                        filename=path.rsplit("/", 1)[-1],
+                        mime=mime,
+                        collection=q.get("collection", ""),
+                        replication=q.get("replication", ""),
+                        ttl=q.get("ttl", ""),
+                    )
+                except RuntimeError as e:
+                    return self._json({"error": str(e)}, 500)
+                now = int(time.time())
+                entry = Entry(
+                    full_path=path,
+                    attr=Attr(
+                        mtime=now,
+                        crtime=now,
+                        mime=mime,
+                        replication=q.get("replication", ""),
+                        collection=q.get("collection", ""),
+                    ),
+                    chunks=chunks,
+                )
+                server.filer.create_entry(entry)
+                self._json({"name": entry.name, "size": len(data)}, 201)
+
+            def do_DELETE(self):
+                path, q = self._path_and_query()
+                try:
+                    server.filer.delete_entry(
+                        path,
+                        is_recursive=q.get("recursive") == "true",
+                        delete_data=True,
+                    )
+                except EntryNotFound:
+                    return self._json({"error": "not found"}, 404)
+                except ValueError as e:
+                    return self._json({"error": str(e)}, 409)
+                self._reply(204)
+
+        return Handler
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self.filer.start_deletion_loop()
+        self._grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+        self._grpc_server.add_generic_rpc_handlers(
+            (rpc.servicer_handler(rpc.FILER_SERVICE, rpc.FILER_METHODS, self),)
+        )
+        self._grpc_server.add_insecure_port(f"{self.host}:{self.grpc_port}")
+        self._grpc_server.start()
+        self._http_server = ThreadingHTTPServer(
+            (self.host, self.port), self._http_handler_class()
+        )
+        threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
+
+    def stop(self) -> None:
+        if self._http_server:
+            self._http_server.shutdown()
+            self._http_server.server_close()
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.5)
+        self.filer.stop()

@@ -15,9 +15,6 @@ from __future__ import annotations
 
 import io
 import shlex
-import time
-
-import grpc
 
 from seaweedfs_tpu.pb import master_pb2, rpc, volume_pb2
 from seaweedfs_tpu.shell import ec_common
@@ -64,6 +61,38 @@ def _flag(args: list[str], name: str, default: str = "") -> str:
 
 def _has_flag(args: list[str], name: str) -> bool:
     return any(a == f"-{name}" or a.startswith(f"-{name}=") for a in args)
+
+
+
+def _lookup_collection(env: CommandEnv, vid: int) -> str:
+    for n in env.collect_topology().nodes:
+        for v in n.volumes:
+            if v["Id"] == vid:
+                return v["Collection"]
+    return ""
+
+
+def _copy_volume(env: CommandEnv, vid: int, collection: str, src: str, dst: str) -> None:
+    with env.volume_channel(dst) as ch:
+        rpc.volume_stub(ch).VolumeCopy(
+            volume_pb2.VolumeCopyRequest(
+                volume_id=vid, collection=collection, source_data_node=src
+            )
+        )
+
+
+def _move_volume(env: CommandEnv, vid: int, collection: str, src: str, dst: str) -> None:
+    """copy + delete with a readonly guard on the source so no write
+    lands between the copy and the delete (the reference tails instead,
+    command_volume_move.go; readonly-then-move trades brief write
+    unavailability of this volume for the same safety)."""
+    with env.volume_channel(src) as ch:
+        rpc.volume_stub(ch).VolumeMarkReadonly(
+            volume_pb2.VolumeMarkReadonlyRequest(volume_id=vid)
+        )
+    _copy_volume(env, vid, collection, src, dst)
+    with env.volume_channel(src) as ch:
+        rpc.volume_stub(ch).VolumeDelete(volume_pb2.VolumeDeleteRequest(volume_id=vid))
 
 
 # ----------------------------------------------------------------------
@@ -178,10 +207,7 @@ class VolumeCopy(Command):
         src = _flag(args, "from")
         dst = _flag(args, "to")
         vid = int(_flag(args, "volumeId"))
-        with env.volume_channel(dst) as ch:
-            rpc.volume_stub(ch).VolumeCopy(
-                volume_pb2.VolumeCopyRequest(volume_id=vid, source_data_node=src)
-            )
+        _copy_volume(env, vid, _lookup_collection(env, vid), src, dst)
         print(f"volume {vid} copied {src} => {dst}", file=out)
 
 
@@ -194,16 +220,7 @@ class VolumeMove(Command):
         src = _flag(args, "from")
         dst = _flag(args, "to")
         vid = int(_flag(args, "volumeId"))
-        # copy → mount happens inside VolumeCopy; then delete source
-        # (command_volume_move.go: copy + tail + delete)
-        with env.volume_channel(dst) as ch:
-            rpc.volume_stub(ch).VolumeCopy(
-                volume_pb2.VolumeCopyRequest(volume_id=vid, source_data_node=src)
-            )
-        with env.volume_channel(src) as ch:
-            rpc.volume_stub(ch).VolumeDelete(
-                volume_pb2.VolumeDeleteRequest(volume_id=vid)
-            )
+        _move_volume(env, vid, _lookup_collection(env, vid), src, dst)
         print(f"volume {vid} moved {src} => {dst}", file=out)
 
 
@@ -284,7 +301,7 @@ def plan_volume_balance(dump: TopologyDump, collection: str | None = None) -> li
         if not candidates:
             break
         v = candidates[0]
-        moves.append({"vid": v["Id"], "from": high, "to": low})
+        moves.append({"vid": v["Id"], "collection": v["Collection"], "from": high, "to": low})
         vols_by_node[high].remove(v)
         vols_by_node[low].append(v)
         counts[high] -= 1
@@ -305,16 +322,7 @@ class VolumeBalance(Command):
         for m in moves:
             print(f"moving volume {m['vid']} {m['from']} => {m['to']}", file=out)
             if apply:
-                with env.volume_channel(m["to"]) as ch:
-                    rpc.volume_stub(ch).VolumeCopy(
-                        volume_pb2.VolumeCopyRequest(
-                            volume_id=m["vid"], source_data_node=m["from"]
-                        )
-                    )
-                with env.volume_channel(m["from"]) as ch:
-                    rpc.volume_stub(ch).VolumeDelete(
-                        volume_pb2.VolumeDeleteRequest(volume_id=m["vid"])
-                    )
+                _move_volume(env, m["vid"], m["collection"], m["from"], m["to"])
         print(f"planned {len(moves)} moves, applied={apply}", file=out)
 
 
@@ -351,7 +359,14 @@ def plan_fix_replication(dump: TopologyDump) -> list[dict]:
             candidates = preferred or candidates
         candidates.sort(key=lambda n: len(n.volumes))
         for target in candidates[: want - have]:
-            plans.append({"vid": vid, "from": nodes_with[0].url, "to": target.url})
+            plans.append(
+                {
+                    "vid": vid,
+                    "collection": v["Collection"],
+                    "from": nodes_with[0].url,
+                    "to": target.url,
+                }
+            )
     return plans
 
 
@@ -367,12 +382,7 @@ class VolumeFixReplication(Command):
         for p in plans:
             print(f"replicating volume {p['vid']} {p['from']} => {p['to']}", file=out)
             if not dry:
-                with env.volume_channel(p["to"]) as ch:
-                    rpc.volume_stub(ch).VolumeCopy(
-                        volume_pb2.VolumeCopyRequest(
-                            volume_id=p["vid"], source_data_node=p["from"]
-                        )
-                    )
+                _copy_volume(env, p["vid"], p["collection"], p["from"], p["to"])
         print(f"fixed {0 if dry else len(plans)} volumes (planned {len(plans)})", file=out)
 
 
@@ -561,7 +571,7 @@ class EcRebuild(Command):
 
     def run(self, env, args, out):
         vid_flag = _flag(args, "volumeId")
-        apply = _has_flag(args, "force") or bool(vid_flag)
+        apply = _has_flag(args, "force")
         nodes = ec_common.collect_ec_nodes(env)
         vids = (
             [int(vid_flag)]
@@ -569,7 +579,12 @@ class EcRebuild(Command):
             else sorted({vid for n in nodes for vid in n.ec_shards})
         )
         for vid in vids:
-            do_ec_rebuild(env, vid, out, apply)
+            missing = do_ec_rebuild(env, vid, out, apply)
+            if not apply and missing:
+                print(
+                    f"volume {vid}: missing shards {missing} (dry run; -force to rebuild)",
+                    file=out,
+                )
 
 
 @register

@@ -7,7 +7,6 @@ from __future__ import annotations
 
 import sys
 import threading
-import time
 
 from seaweedfs_tpu.shell.command_env import CommandEnv
 from seaweedfs_tpu.shell.commands import COMMANDS, run_command
@@ -68,7 +67,10 @@ class MaintenanceRunner:
     def run_once(self) -> list[str]:
         outputs = []
         for line in self.scripts:
-            if line.split()[0] not in COMMANDS:
+            parts = line.split()
+            if not parts:
+                continue
+            if parts[0] not in COMMANDS:
                 outputs.append(f"{line}: unknown command")
                 continue
             try:
@@ -80,8 +82,11 @@ class MaintenanceRunner:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.period_s):
-            if self.is_leader():
-                self.run_once()
+            try:
+                if self.is_leader():
+                    self.run_once()
+            except Exception as e:  # noqa: BLE001 — the cron thread must survive
+                self.last_output = [f"maintenance pass failed: {e}"]
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop, daemon=True)

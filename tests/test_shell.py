@@ -183,7 +183,9 @@ class TestVolumePlanners:
         # volume 1 wants replication 010 (one replica on another rack)
         dump.nodes[0].volumes[0]["ReplicaPlacement"] = 10  # "010": one replica on another rack
         plans = plan_fix_replication(dump)
-        assert plans == [{"vid": 1, "from": "a:1", "to": "c:1"}]
+        assert plans == [
+            {"vid": 1, "collection": "", "from": "a:1", "to": "c:1"}
+        ]
 
     def test_fix_replication_noop_when_satisfied(self):
         dump = self._dump({"a:1": ("r1", 10, [1]), "b:1": ("r1", 10, [1])})
@@ -293,7 +295,7 @@ class TestShellPipeline:
         )
 
         out = io.StringIO()
-        run_command(env, f"ec.rebuild -volumeId {vid}", out)
+        run_command(env, f"ec.rebuild -volumeId {vid} -force", out)
         assert "rebuilt shards" in out.getvalue()
         assert wait_for(
             lambda: (locs := master.topology.lookup_ec_shards(vid)) is not None
