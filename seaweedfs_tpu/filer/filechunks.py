@@ -86,6 +86,9 @@ def view_from_visible_intervals(
     stop = offset + size
     views: list[ChunkView] = []
     for v in visibles:
+        # reference parity (filer2/filechunks.go ViewFromVisibleIntervals):
+        # views advance only while contiguous — a hole ends the read, it
+        # is NOT zero-filled (pinned by the ported test table, case 4)
         if v.start <= offset < v.stop and offset < stop:
             is_full = v.is_full_chunk and v.start == offset and v.stop <= stop
             views.append(

@@ -123,6 +123,7 @@ class SqliteStore(FilerStore):
     def __init__(self, path: str = ":memory:") -> None:
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.RLock()
+        self._tx_depth = 0  # >0: inside begin/commit_transaction, defer commits
         self._conn.execute(
             "CREATE TABLE IF NOT EXISTS filemeta ("
             " directory TEXT NOT NULL,"
@@ -139,7 +140,8 @@ class SqliteStore(FilerStore):
                 "INSERT OR REPLACE INTO filemeta (directory, name, meta) VALUES (?,?,?)",
                 (d, name, entry.encode()),
             )
-            self._conn.commit()
+            if self._tx_depth == 0:
+                self._conn.commit()
 
     update_entry = insert_entry
 
@@ -159,13 +161,15 @@ class SqliteStore(FilerStore):
             self._conn.execute(
                 "DELETE FROM filemeta WHERE directory=? AND name=?", (d, name)
             )
-            self._conn.commit()
+            if self._tx_depth == 0:
+                self._conn.commit()
 
     def delete_folder_children(self, full_path: str) -> None:
         d = normalize_path(full_path)
         with self._lock:
             self._conn.execute("DELETE FROM filemeta WHERE directory=?", (d,))
-            self._conn.commit()
+            if self._tx_depth == 0:
+                self._conn.commit()
 
     def list_directory_entries(self, dir_path, start_file_name, include_start, limit):
         d = normalize_path(dir_path)
@@ -179,13 +183,19 @@ class SqliteStore(FilerStore):
         return [Entry.decode(f"{d}/{name}", meta) for name, meta in rows]
 
     def begin_transaction(self) -> None:
+        # per-op commits are deferred while _tx_depth > 0 so a rollback
+        # really undoes the whole transaction (atomic_rename contract)
         self._lock.acquire()
+        self._tx_depth += 1
 
     def commit_transaction(self) -> None:
-        self._conn.commit()
+        self._tx_depth -= 1
+        if self._tx_depth == 0:
+            self._conn.commit()
         self._lock.release()
 
     def rollback_transaction(self) -> None:
+        self._tx_depth -= 1
         self._conn.rollback()
         self._lock.release()
 
@@ -219,10 +229,14 @@ class SortedLogStore(FilerStore):
                 if len(hdr) < 9:
                     break
                 op, plen, mlen = struct.unpack("<BII", hdr)
-                path = f.read(plen).decode()
+                raw_path = f.read(plen)
                 meta = f.read(mlen)
-                if len(path.encode()) < plen or len(meta) < mlen:
+                if len(raw_path) < plen or len(meta) < mlen:
                     break  # torn tail record; recover what we have
+                try:
+                    path = raw_path.decode()
+                except UnicodeDecodeError:
+                    break  # torn mid-character: same recovery as short read
                 if op == self._PUT:
                     self._mem.insert_entry(Entry.decode(path, meta))
                 else:

@@ -3,8 +3,9 @@
 
 Each view's fid is resolved through the master (operation.lookup cache)
 and fetched from a volume server; sub-chunk views slice the fetched
-needle. Missing intervals (sparse files) read as zeros, matching the
-reference's zero-padded view walk.
+needle. Reference parity: a sparse hole ends the stream — views stop at
+the first gap and nothing is zero-filled (filechunks.go semantics,
+pinned by the ported view tests).
 """
 
 from __future__ import annotations
@@ -17,22 +18,10 @@ def stream_content(master: str, chunks, offset: int = 0, size: int | None = None
     """Yield the file's bytes for [offset, offset+size)."""
     if size is None:
         size = filechunks.total_size(chunks) - offset
-    views = filechunks.view_from_chunks(chunks, offset, size)
-    pos = offset
-    for view in views:
-        if view.logic_offset > pos:
-            yield b"\x00" * (view.logic_offset - pos)
-            pos = view.logic_offset
+    for view in filechunks.view_from_chunks(chunks, offset, size):
         url = op.lookup_file_id(master, view.fid)
         data, _ = op.download(url)
         yield data[view.offset : view.offset + view.size]
-        pos += view.size
-    if pos < offset + size:
-        # trailing hole inside the requested range, but never past EOF
-        eof = filechunks.total_size(chunks)
-        tail = min(offset + size, eof) - pos
-        if tail > 0:
-            yield b"\x00" * tail
 
 
 def read_all(master: str, chunks) -> bytes:

@@ -58,6 +58,7 @@ VOLUME_METHODS = {
     "DeleteCollection": (v.DeleteCollectionRequest, v.DeleteCollectionResponse, UNARY_UNARY),
     "VolumeDelete": (v.VolumeDeleteRequest, v.VolumeDeleteResponse, UNARY_UNARY),
     "VolumeMarkReadonly": (v.VolumeMarkReadonlyRequest, v.VolumeMarkReadonlyResponse, UNARY_UNARY),
+    "VolumeMarkWritable": (v.VolumeMarkWritableRequest, v.VolumeMarkWritableResponse, UNARY_UNARY),
     "VolumeMount": (v.VolumeMountRequest, v.VolumeMountResponse, UNARY_UNARY),
     "VolumeUnmount": (v.VolumeUnmountRequest, v.VolumeUnmountResponse, UNARY_UNARY),
     "VolumeSyncStatus": (v.VolumeSyncStatusRequest, v.VolumeSyncStatusResponse, UNARY_UNARY),

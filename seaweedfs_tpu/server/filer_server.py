@@ -263,23 +263,47 @@ class FilerServer:
                             "Limit": limit,
                         }
                     )
-                body = b"".join(
-                    stream.stream_content(server.masters[0], entry.chunks)
-                )
                 headers = {
                     "Content-Type": entry.attr.mime or "application/octet-stream",
                     "ETag": filechunks.etag(entry.chunks) if entry.chunks else "",
                 }
-                self._reply(200, body, headers)
+                total = filechunks.total_size(entry.chunks)
+                self.send_response(200)
+                for k, v in headers.items():
+                    if v:
+                        self.send_header(k, v)
+                self.send_header("Content-Length", str(total))
+                self.end_headers()
+                if self.command == "HEAD":
+                    # size/etag come from metadata alone — no chunk I/O
+                    return
+                written = 0
+                try:
+                    for piece in stream.stream_content(
+                        server.masters[0], entry.chunks
+                    ):
+                        self.wfile.write(piece)
+                        written += len(piece)
+                except (RuntimeError, OSError):
+                    pass
+                if written < total:
+                    # failure or sparse hole after headers: truncate so
+                    # the client sees a short read, not silent corruption
+                    self.close_connection = True
 
             do_HEAD = do_GET
 
             def do_POST(self):
                 path, q = self._path_and_query()
+                # normalize_path strips trailing slashes, so check the
+                # raw URL to tell "POST /dir/" (mkdir) from "POST /dir"
+                raw_path = unquote(urlparse(self.path).path)
                 length = int(self.headers.get("Content-Length", "0"))
                 data = self.rfile.read(length)
                 mime = self.headers.get("Content-Type", "")
-                if path.endswith("/") or (not data and not length):
+                if (raw_path.endswith("/") and raw_path != "/") or (
+                    not data and not length
+                ):
                     # mkdir (the reference creates dirs via FUSE/gRPC;
                     # HTTP POST with no body maps to mkdir here)
                     from seaweedfs_tpu.filer.entry import new_directory_entry

@@ -71,6 +71,7 @@ class MasterServer:
         volume_size_limit_mb: int = 30 * 1024,
         default_replication: str = "000",
         garbage_threshold: float = 0.3,
+        guard=None,
     ):
         self.host = host
         self.port = port
@@ -79,6 +80,10 @@ class MasterServer:
         self.sequencer = MemorySequencer()
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
+        self.guard = guard  # security.Guard; assign responses carry a jwt
+        from seaweedfs_tpu.stats import DurationCounter
+
+        self.request_counter = DurationCounter()  # /stats/counter rolling UI
         self.is_leader = True
         self._grow_lock = threading.Lock()
         self._clients: dict[int, queue.Queue] = {}
@@ -292,12 +297,18 @@ class MasterServer:
         cookie = random.randrange(1 << 32)
         fid = f"{vid},{format_needle_id_cookie(file_key, cookie)}"
         dn = nodes[0]
-        return {
+        result = {
             "fid": fid,
             "url": dn.url,
             "publicUrl": dn.public_url,
             "count": count,
         }
+        if self.guard is not None and self.guard.signing_key:
+            # write token scoped to the assigned fid, handed to the
+            # client the way the reference's assign response carries
+            # `auth` (security.GenJwt on the master side)
+            result["auth"] = self.guard.sign_write(fid)
+        return result
 
     def _node_grpc(self, dn) -> str:
         return f"{dn.ip}:{dn.port + 10000}"
@@ -380,6 +391,7 @@ class MasterServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                server.request_counter.add()
                 url = urlparse(self.path)
                 q = {k: v[0] for k, v in parse_qs(url.query).items()}
                 if url.path == "/dir/assign":
@@ -397,6 +409,24 @@ class MasterServer:
                     return self._json({"Topology": server._topology_dump()})
                 if url.path == "/stats/health":
                     return self._json({"ok": True})
+                if url.path == "/stats/counter":
+                    return self._json(server.request_counter.snapshot())
+                if url.path == "/stats/memory":
+                    import resource
+
+                    ru = resource.getrusage(resource.RUSAGE_SELF)
+                    return self._json({"maxrss_kb": ru.ru_maxrss})
+                if url.path == "/metrics":
+                    from seaweedfs_tpu.stats.metrics import DEFAULT_REGISTRY
+
+                    body = DEFAULT_REGISTRY.render_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    return self.wfile.write(body)
                 if url.path == "/vol/grow":
                     try:
                         count = server.grow_volumes(

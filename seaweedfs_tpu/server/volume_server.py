@@ -79,6 +79,7 @@ class VolumeServer:
         max_volume_counts: list[int] | None = None,
         heartbeat_interval: float = 2.0,
         read_redirect: bool = False,
+        guard=None,
     ):
         self.store = Store(directories, max_volume_counts)
         self.host = host
@@ -90,6 +91,7 @@ class VolumeServer:
         self.rack = rack
         self.heartbeat_interval = heartbeat_interval
         self.read_redirect = read_redirect
+        self.guard = guard  # security.Guard; None = security off
         self.volume_size_limit = 30 * 1024 * 1024 * 1024
         self._stop = threading.Event()
         self._grpc_server: grpc.Server | None = None
@@ -199,6 +201,10 @@ class VolumeServer:
     def VolumeMarkReadonly(self, req, context):
         self.store.mark_volume_readonly(req.volume_id)
         return pb.VolumeMarkReadonlyResponse()
+
+    def VolumeMarkWritable(self, req, context):
+        self.store.mark_volume_writable(req.volume_id)
+        return pb.VolumeMarkWritableResponse()
 
     def DeleteCollection(self, req: pb.DeleteCollectionRequest, context):
         for loc in self.store.locations:
@@ -563,12 +569,40 @@ class VolumeServer:
                 except ValueError:
                     return None, None
 
+            def _check_write_auth(self) -> bool:
+                """JWT/white-list gate on mutating requests; True = allowed
+                (security/guard.go WhiteList+Secure wrapping of the write
+                handlers). The jwt claim must match the request fid."""
+                if server.guard is None or not server.guard.is_write_active:
+                    return True
+                from seaweedfs_tpu.security import UnauthorizedError, jwt_from_headers
+
+                url = urlparse(self.path)
+                token = jwt_from_headers(parse_qs(url.query), self.headers)
+                try:
+                    server.guard.check_write(
+                        self.client_address[0], token, url.path.lstrip("/")
+                    )
+                    return True
+                except UnauthorizedError as e:
+                    self._json({"error": str(e)}, 401)
+                    return False
+
             def do_GET(self):
                 if urlparse(self.path).path == "/status":
                     hb = server.store.collect_heartbeat()
                     return self._json(
                         {"Version": "seaweedfs_tpu", "Volumes": len(hb.volumes)}
                     )
+                if urlparse(self.path).path == "/metrics":
+                    from seaweedfs_tpu.stats.metrics import DEFAULT_REGISTRY
+
+                    body = DEFAULT_REGISTRY.render_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    return self.wfile.write(body)
                 fid, q = self._parse_fid()
                 if fid is None:
                     return self._json({"error": "invalid file id"}, 400)
@@ -659,6 +693,8 @@ class VolumeServer:
                 fid, q = self._parse_fid()
                 if fid is None:
                     return self._json({"error": "invalid file id"}, 400)
+                if not self._check_write_auth():
+                    return
                 length = int(self.headers.get("Content-Length", "0"))
                 body = self.rfile.read(length)
                 n = Needle(cookie=fid.cookie, id=fid.key, data=body)
@@ -690,6 +726,8 @@ class VolumeServer:
                 fid, q = self._parse_fid()
                 if fid is None:
                     return self._json({"error": "invalid file id"}, 400)
+                if not self._check_write_auth():
+                    return
                 n = Needle(cookie=fid.cookie, id=fid.key)
                 try:
                     v = server.store.find_volume(fid.volume_id)
@@ -719,7 +757,11 @@ class VolumeServer:
                     for c in _parse_manifest_chunks(existing.data) or []:
                         server._delete_fid(c["fid"])
                 if q.get("type") != "replicate":
-                    server._replicate(fid, q, "DELETE", b"", {})
+                    err = server._replicate(
+                        fid, q, "DELETE", b"", dict(self.headers)
+                    )
+                    if err:
+                        return self._json({"error": err}, 500)
                 self._json({"size": size}, 202)
 
         return Handler
@@ -787,6 +829,11 @@ class VolumeServer:
         for url in urls:
             try:
                 req = urllib.request.Request(f"http://{url}/{fid_str}", method="DELETE")
+                if self.guard is not None and self.guard.signing_key:
+                    # server-initiated cascade: sign our own write token
+                    req.add_header(
+                        "Authorization", f"BEARER {self.guard.sign_write(fid_str)}"
+                    )
                 urllib.request.urlopen(req, timeout=10).read()
                 return
             except OSError:
@@ -821,6 +868,9 @@ class VolumeServer:
                 ct = headers.get("Content-Type")
                 if ct:
                     req.add_header("Content-Type", ct)
+                auth = headers.get("Authorization")
+                if auth:  # keep the write jwt valid on the replica hop
+                    req.add_header("Authorization", auth)
                 with urllib.request.urlopen(req, timeout=10) as r:
                     if r.status >= 300:
                         return f"replica {url} returned {r.status}"

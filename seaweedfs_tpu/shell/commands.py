@@ -90,7 +90,16 @@ def _move_volume(env: CommandEnv, vid: int, collection: str, src: str, dst: str)
         rpc.volume_stub(ch).VolumeMarkReadonly(
             volume_pb2.VolumeMarkReadonlyRequest(volume_id=vid)
         )
-    _copy_volume(env, vid, collection, src, dst)
+    try:
+        _copy_volume(env, vid, collection, src, dst)
+    except Exception:
+        # copy failed: revert the readonly mark so the source volume
+        # keeps serving writes instead of staying wedged
+        with env.volume_channel(src) as ch:
+            rpc.volume_stub(ch).VolumeMarkWritable(
+                volume_pb2.VolumeMarkWritableRequest(volume_id=vid)
+            )
+        raise
     with env.volume_channel(src) as ch:
         rpc.volume_stub(ch).VolumeDelete(volume_pb2.VolumeDeleteRequest(volume_id=vid))
 

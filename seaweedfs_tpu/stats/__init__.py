@@ -1,0 +1,19 @@
+from seaweedfs_tpu.stats.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    DEFAULT_REGISTRY,
+    start_push_loop,
+)
+from seaweedfs_tpu.stats.duration_counter import DurationCounter
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "DEFAULT_REGISTRY",
+    "DurationCounter",
+    "start_push_loop",
+]

@@ -1,0 +1,256 @@
+"""Prometheus-style metrics: registry, counters, gauges, histograms,
+text exposition, and a push loop.
+
+Behavioral match of weed/stats/metrics.go:14-60: the reference keeps
+Gather-able registries per process (filer/volume), wraps every HTTP
+handler and filer-store call in request counters + duration histograms,
+and pushes to a push gateway on an interval configured by the master's
+HeartbeatResponse (master_grpc_server.go:80-84, LoopPushingMetric).
+Here: a Registry renders Prometheus text format 0.0.4 so any scraper
+understands it; `start_push_loop` POSTs that text to a
+pushgateway-style URL on an interval.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+import urllib.request
+
+DEFAULT_BUCKETS = (
+    0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0
+)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, label_names: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._values: dict[tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *label_values: str) -> "_CounterChild":
+        return _CounterChild(self, tuple(label_values))
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    def value(self, *label_values: str) -> float:
+        return self._values.get(tuple(label_values), 0.0)
+
+    def _add(self, key: tuple[str, ...], amount: float) -> None:
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, val in items or [((), 0.0)]:
+            labels = dict(zip(self.label_names, key))
+            lines.append(f"{self.name}{_fmt_labels(labels)} {val}")
+        return lines
+
+
+class _CounterChild:
+    def __init__(self, parent: Counter, key: tuple[str, ...]):
+        self._parent = parent
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._parent._add(self._key, amount)
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str, label_names: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._values: dict[tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, *label_values: str) -> None:
+        with self._lock:
+            self._values[tuple(label_values)] = value
+
+    def add(self, amount: float, *label_values: str) -> None:
+        key = tuple(label_values)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, *label_values: str) -> float:
+        return self._values.get(tuple(label_values), 0.0)
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, val in items or [((), 0.0)]:
+            labels = dict(zip(self.label_names, key))
+            lines.append(f"{self.name}{_fmt_labels(labels)} {val}")
+        return lines
+
+
+class Histogram:
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        label_names: tuple[str, ...] = (),
+        buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+    ):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple[str, ...], list[int]] = {}
+        self._sums: dict[tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, *label_values: str) -> None:
+        key = tuple(label_values)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def time(self, *label_values: str) -> "_Timer":
+        return _Timer(self, label_values)
+
+    def count(self, *label_values: str) -> int:
+        return sum(self._counts.get(tuple(label_values), []))
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sums)
+        for key, counts in items:
+            labels = dict(zip(self.label_names, key))
+            cum = 0
+            for bound, c in zip(self.buckets, counts):
+                cum += c
+                lb = dict(labels, le=repr(bound))
+                lines.append(f"{self.name}_bucket{_fmt_labels(lb)} {cum}")
+            cum += counts[-1]
+            lb = dict(labels, le="+Inf")
+            lines.append(f"{self.name}_bucket{_fmt_labels(lb)} {cum}")
+            lines.append(f"{self.name}_sum{_fmt_labels(labels)} {sums.get(key, 0.0)}")
+            lines.append(f"{self.name}_count{_fmt_labels(labels)} {cum}")
+        return lines
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, label_values: tuple[str, ...]):
+        self._hist = hist
+        self._labels = label_values
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.perf_counter() - self._start, *self._labels)
+        return False
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str, label_names: tuple[str, ...] = ()) -> Counter:
+        m = Counter(name, help_, label_names)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def gauge(self, name: str, help_: str, label_names: tuple[str, ...] = ()) -> Gauge:
+        m = Gauge(name, help_, label_names)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def histogram(
+        self,
+        name: str,
+        help_: str,
+        label_names: tuple[str, ...] = (),
+        buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        m = Histogram(name, help_, label_names, buckets)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def render_text(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+DEFAULT_REGISTRY = Registry()
+
+# The metric families the reference registers (stats/metrics.go:20-60):
+REQUEST_COUNTER = DEFAULT_REGISTRY.counter(
+    "weed_request_total", "number of requests", ("server", "type")
+)
+REQUEST_HISTOGRAM = DEFAULT_REGISTRY.histogram(
+    "weed_request_seconds", "request latency", ("server", "type")
+)
+VOLUME_GAUGE = DEFAULT_REGISTRY.gauge(
+    "weed_volumes", "number of volumes", ("server", "collection", "type")
+)
+STORE_COUNTER = DEFAULT_REGISTRY.counter(
+    "weed_filer_store_total", "filer store ops", ("store", "type")
+)
+STORE_HISTOGRAM = DEFAULT_REGISTRY.histogram(
+    "weed_filer_store_seconds", "filer store latency", ("store", "type")
+)
+
+
+def start_push_loop(
+    gateway_url: str,
+    job: str,
+    interval_sec: float,
+    registry: Registry = DEFAULT_REGISTRY,
+    stop_event: threading.Event | None = None,
+) -> threading.Thread:
+    """Push registry text to a pushgateway URL every interval
+    (stats/metrics.go LoopPushingMetric; interval and address arrive in
+    the master HeartbeatResponse in the reference)."""
+    stop = stop_event or threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            try:
+                body = registry.render_text().encode()
+                req = urllib.request.Request(
+                    gateway_url.rstrip("/") + f"/metrics/job/{job}",
+                    data=body,
+                    method="POST",
+                    headers={"Content-Type": "text/plain; version=0.0.4"},
+                )
+                urllib.request.urlopen(req, timeout=5).read()
+            except OSError:
+                pass  # push gateway being down must not hurt the server
+            stop.wait(interval_sec)
+
+    t = threading.Thread(target=loop, daemon=True, name="metrics-push")
+    t.stop_event = stop
+    t.start()
+    return t

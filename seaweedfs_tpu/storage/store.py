@@ -144,6 +144,13 @@ class Store:
         v.read_only = True
         return True
 
+    def mark_volume_writable(self, vid: int) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        v.read_only = False
+        return True
+
     # --- needle IO (store.go:227-264) ---
     def write_needle(self, vid: int, n: Needle) -> tuple[int, bool]:
         v = self.find_volume(vid)

@@ -476,3 +476,36 @@ class TestFilerServer:
             time.sleep(0.1)
         with pytest.raises(Exception):
             op.download(op.lookup_file_id(f"127.0.0.1:{master.port}", fid))
+
+
+class TestSqliteTransactions:
+    """rollback_transaction must undo everything since begin (the
+    atomic_rename contract; regression for per-op commits)."""
+
+    def test_rollback_undoes_inserts_and_deletes(self):
+        from seaweedfs_tpu.filer.entry import Entry, Attr
+        from seaweedfs_tpu.filer.filerstore import SqliteStore, EntryNotFound
+
+        store = SqliteStore(":memory:")
+        keep = Entry(full_path="/keep", attr=Attr(mtime=1))
+        store.insert_entry(keep)
+        store.begin_transaction()
+        store.insert_entry(Entry(full_path="/tx-new", attr=Attr(mtime=2)))
+        store.delete_entry("/keep")
+        store.rollback_transaction()
+        # the pre-tx entry survives, the in-tx insert is gone
+        assert store.find_entry("/keep").full_path == "/keep"
+        import pytest as _pytest
+
+        with _pytest.raises(EntryNotFound):
+            store.find_entry("/tx-new")
+
+    def test_commit_applies(self):
+        from seaweedfs_tpu.filer.entry import Entry, Attr
+        from seaweedfs_tpu.filer.filerstore import SqliteStore
+
+        store = SqliteStore(":memory:")
+        store.begin_transaction()
+        store.insert_entry(Entry(full_path="/tx", attr=Attr(mtime=1)))
+        store.commit_transaction()
+        assert store.find_entry("/tx").full_path == "/tx"
