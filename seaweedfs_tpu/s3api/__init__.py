@@ -1,0 +1,3 @@
+from seaweedfs_tpu.s3api.s3api_server import S3ApiServer
+
+__all__ = ["S3ApiServer"]

@@ -1,0 +1,690 @@
+"""S3-compatible gateway over the filer.
+
+Behavioral match of weed/s3api/s3api_server.go:31-70 (route table) and
+its handlers: buckets are directories under `/buckets` on the filer
+(s3api_bucket_handlers.go), object bytes are proxied to the filer HTTP
+server (s3api_object_handlers.go PutObjectHandler→putToFiler), metadata
+ops ride the filer gRPC service, and multipart uploads stage parts in
+`/buckets/<bucket>/.uploads/<uploadId>/` then splice every part's
+chunks into one entry on complete (filer_multipart.go:56-120).
+
+Route dispatch (the gorilla/mux table, s3api_server.go:42-79):
+  HEAD   /b            HeadBucket           HEAD   /b/k  HeadObject
+  PUT    /b            PutBucket            PUT    /b/k  PutObject | PutObjectPart(partNumber&uploadId) | CopyObject(X-Amz-Copy-Source)
+  DELETE /b            DeleteBucket         DELETE /b/k  DeleteObject | AbortMultipartUpload(uploadId)
+  GET    /             ListBuckets          GET    /b/k  GetObject | ListObjectParts(uploadId)
+  GET    /b            ListObjectsV1 | ListObjectsV2(list-type=2) | ListMultipartUploads(uploads)
+  POST   /b            DeleteMultipleObjects(delete)
+  POST   /b/k          NewMultipartUpload(uploads) | CompleteMultipartUpload(uploadId)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from xml.sax.saxutils import escape
+
+import grpc
+
+from seaweedfs_tpu.pb import filer_pb2 as fpb
+from seaweedfs_tpu.pb import rpc
+from seaweedfs_tpu.s3api import auth as s3auth
+from seaweedfs_tpu.s3api import chunked_reader
+from seaweedfs_tpu.s3api.errors import S3Error, s3_error
+
+S3_XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+MAX_OBJECT_LIST_SIZE = 1000  # s3api_objects_list_handlers.go:21
+
+
+class S3ApiServer:
+    def __init__(
+        self,
+        filer: str,
+        host: str = "127.0.0.1",
+        port: int = 8333,
+        buckets_path: str = "/buckets",
+        iam: s3auth.IdentityAccessManagement | None = None,
+    ):
+        self.filer = filer
+        self.host = host
+        self.port = port
+        self.buckets_path = buckets_path.rstrip("/")
+        self.iam = iam or s3auth.IdentityAccessManagement()
+        self._http_server: ThreadingHTTPServer | None = None
+        self._channel: grpc.Channel | None = None
+        self._channel_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # filer access
+    def _stub(self):
+        with self._channel_lock:
+            if self._channel is None:
+                self._channel = grpc.insecure_channel(rpc.grpc_address(self.filer))
+            return rpc.filer_stub(self._channel)
+
+    def _lookup(self, directory: str, name: str):
+        try:
+            return self._stub().LookupDirectoryEntry(
+                fpb.LookupDirectoryEntryRequest(directory=directory, name=name)
+            ).entry
+        except grpc.RpcError:
+            return None
+
+    def _mkdir(self, parent: str, name: str, extended: dict | None = None) -> None:
+        entry = fpb.Entry(
+            name=name,
+            is_directory=True,
+            attributes=fpb.Attributes(mtime=int(time.time()), file_mode=0o40777),
+        )
+        for k, v in (extended or {}).items():
+            entry.extended[k] = v
+        self._stub().CreateEntry(fpb.CreateEntryRequest(directory=parent, entry=entry))
+
+    def _mkfile(self, parent: str, name: str, chunks, mime: str = "") -> None:
+        entry = fpb.Entry(
+            name=name,
+            is_directory=False,
+            chunks=chunks,
+            attributes=fpb.Attributes(
+                mtime=int(time.time()), file_mode=0o660, mime=mime
+            ),
+        )
+        self._stub().CreateEntry(fpb.CreateEntryRequest(directory=parent, entry=entry))
+
+    def _list(self, directory: str, prefix: str = "", start: str = "",
+              inclusive: bool = False, limit: int = MAX_OBJECT_LIST_SIZE):
+        try:
+            return [
+                resp.entry
+                for resp in self._stub().ListEntries(
+                    fpb.ListEntriesRequest(
+                        directory=directory,
+                        prefix=prefix,
+                        start_from_file_name=start,
+                        inclusive_start_from=inclusive,
+                        limit=limit,
+                    )
+                )
+            ]
+        except grpc.RpcError:
+            return []
+
+    def _rm(self, directory: str, name: str, delete_data: bool = True) -> None:
+        try:
+            self._stub().DeleteEntry(
+                fpb.DeleteEntryRequest(
+                    directory=directory,
+                    name=name,
+                    is_delete_data=delete_data,
+                    is_recursive=True,
+                )
+            )
+        except grpc.RpcError:
+            pass
+
+    def _filer_url(self, *segments: str) -> str:
+        path = "/".join(urllib.parse.quote(s) for s in segments if s)
+        return f"http://{self.filer}/{path}"
+
+    def _put_to_filer(self, path_segments: list[str], body: bytes, mime: str) -> None:
+        """Store object bytes through the filer HTTP write path (which
+        auto-chunks) — the putToFiler proxy in the reference."""
+        req = urllib.request.Request(
+            self._filer_url(*path_segments), data=body, method="POST"
+        )
+        if mime:
+            req.add_header("Content-Type", mime)
+        with urllib.request.urlopen(req, timeout=60) as r:
+            if r.status >= 300:
+                raise s3_error("InternalError")
+
+    def _get_from_filer(self, path_segments: list[str]) -> tuple[bytes, str]:
+        try:
+            with urllib.request.urlopen(
+                self._filer_url(*path_segments), timeout=60
+            ) as r:
+                return r.read(), r.headers.get("Content-Type", "")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise s3_error("NoSuchKey") from None
+            raise s3_error("InternalError") from None
+
+    def _uploads_folder(self, bucket: str) -> str:
+        # genUploadsFolder (s3api_object_multipart_handlers.go:219)
+        return f"{self.buckets_path}/{bucket}/.uploads"
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    def start(self) -> None:
+        handler = self._handler_class()
+        self._http_server = ThreadingHTTPServer((self.host, self.port), handler)
+        threading.Thread(
+            target=self._http_server.serve_forever, daemon=True, name="s3-http"
+        ).start()
+
+    def stop(self) -> None:
+        if self._http_server:
+            self._http_server.shutdown()
+            self._http_server.server_close()
+        if self._channel is not None:
+            self._channel.close()
+
+    # ------------------------------------------------------------------
+    def _handler_class(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            # ---------- plumbing ----------
+            def _send(self, status: int, body: bytes = b"", headers: dict | None = None):
+                self.send_response(status)
+                for k, v in (headers or {}).items():
+                    if v:
+                        self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body and self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def _send_xml(self, root: ET.Element, status: int = 200):
+                body = b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
+                self._send(status, body, {"Content-Type": "application/xml"})
+
+            def _send_error(self, err: S3Error):
+                self._send(
+                    err.status,
+                    err.to_xml(resource=self.path),
+                    {"Content-Type": "application/xml"},
+                )
+
+            def _route(self):
+                url = urllib.parse.urlparse(self.path)
+                raw = urllib.parse.unquote(url.path)
+                query = urllib.parse.parse_qs(url.query, keep_blank_values=True)
+                parts = raw.lstrip("/").split("/", 1)
+                bucket = parts[0]
+                key = parts[1] if len(parts) > 1 else ""
+                return bucket, key, query, url.path
+
+            def _read_body(self) -> bytes:
+                length = int(self.headers.get("Content-Length", "0") or "0")
+                return self.rfile.read(length) if length else b""
+
+            def _authenticate(self, body: bytes | None):
+                url = urllib.parse.urlparse(self.path)
+                query = urllib.parse.parse_qs(url.query, keep_blank_values=True)
+                server.iam.authenticate(
+                    self.command,
+                    urllib.parse.unquote(url.path),
+                    query,
+                    self.headers,
+                    body,
+                )
+
+            # ---------- verbs ----------
+            def do_GET(self):
+                try:
+                    bucket, key, query, _ = self._route()
+                    self._authenticate(b"")
+                    if not bucket:
+                        return self._list_buckets()
+                    if key:
+                        if "uploadId" in query:
+                            return self._list_object_parts(bucket, key, query)
+                        return self._get_object(bucket, key)
+                    if "uploads" in query:
+                        return self._list_multipart_uploads(bucket)
+                    return self._list_objects(bucket, query)
+                except S3Error as e:
+                    self._send_error(e)
+
+            def do_HEAD(self):
+                try:
+                    bucket, key, query, _ = self._route()
+                    self._authenticate(b"")
+                    if key:
+                        return self._head_object(bucket, key)
+                    return self._head_bucket(bucket)
+                except S3Error as e:
+                    self._send_error(e)
+
+            def do_PUT(self):
+                try:
+                    bucket, key, query, _ = self._route()
+                    body = self._read_body()
+                    sha_hdr = self.headers.get("x-amz-content-sha256", "")
+                    if sha_hdr == s3auth.STREAMING_PAYLOAD:
+                        body = self._decode_streaming(body)
+                    else:
+                        self._authenticate(body)
+                    if not key:
+                        return self._put_bucket(bucket)
+                    if "partNumber" in query and "uploadId" in query:
+                        return self._put_object_part(bucket, key, query, body)
+                    if self.headers.get("X-Amz-Copy-Source"):
+                        return self._copy_object(bucket, key)
+                    return self._put_object(bucket, key, body)
+                except S3Error as e:
+                    self._send_error(e)
+
+            def do_POST(self):
+                try:
+                    bucket, key, query, _ = self._route()
+                    body = self._read_body()
+                    self._authenticate(body)
+                    if key and "uploads" in query:
+                        return self._new_multipart_upload(bucket, key)
+                    if key and "uploadId" in query:
+                        return self._complete_multipart_upload(bucket, key, query, body)
+                    if "delete" in query:
+                        return self._delete_multiple_objects(bucket, body)
+                    raise s3_error("NotImplemented")
+                except S3Error as e:
+                    self._send_error(e)
+
+            def do_DELETE(self):
+                try:
+                    bucket, key, query, _ = self._route()
+                    self._authenticate(b"")
+                    if key and "uploadId" in query:
+                        return self._abort_multipart_upload(bucket, key, query)
+                    if key:
+                        return self._delete_object(bucket, key)
+                    return self._delete_bucket(bucket)
+                except S3Error as e:
+                    self._send_error(e)
+
+            # ---------- streaming sigv4 ----------
+            def _decode_streaming(self, raw: bytes) -> bytes:
+                import io
+
+                if server.iam.is_enabled:
+                    url = urllib.parse.urlparse(self.path)
+                    query = urllib.parse.parse_qs(url.query, keep_blank_values=True)
+                    key, seed, amz_date, scope = server.iam.seed_signature(
+                        self.command,
+                        urllib.parse.unquote(url.path),
+                        query,
+                        self.headers,
+                    )
+                    try:
+                        return chunked_reader.decode_chunked_payload(
+                            io.BytesIO(raw),
+                            signing_key=key,
+                            seed_signature=seed,
+                            amz_date=amz_date,
+                            scope=scope,
+                        )
+                    except chunked_reader.ChunkSignatureMismatch:
+                        raise s3_error("SignatureDoesNotMatch") from None
+                return chunked_reader.decode_chunked_payload(io.BytesIO(raw))
+
+            # ---------- buckets ----------
+            def _list_buckets(self):
+                entries = server._list(server.buckets_path)
+                root = ET.Element("ListAllMyBucketsResult", xmlns=S3_XMLNS)
+                owner = ET.SubElement(root, "Owner")
+                ET.SubElement(owner, "ID").text = ""
+                buckets = ET.SubElement(root, "Buckets")
+                for e in entries:
+                    if not e.is_directory:
+                        continue
+                    b = ET.SubElement(buckets, "Bucket")
+                    ET.SubElement(b, "Name").text = e.name
+                    ET.SubElement(b, "CreationDate").text = _iso(e.attributes.mtime)
+                self._send_xml(root)
+
+            def _put_bucket(self, bucket: str):
+                if not _valid_bucket_name(bucket):
+                    raise s3_error("InvalidBucketName")
+                if server._lookup(server.buckets_path, bucket) is not None:
+                    raise s3_error("BucketAlreadyExists")
+                server._mkdir(server.buckets_path, bucket)
+                self._send(200, headers={"Location": f"/{bucket}"})
+
+            def _head_bucket(self, bucket: str):
+                if server._lookup(server.buckets_path, bucket) is None:
+                    raise s3_error("NoSuchBucket")
+                self._send(200)
+
+            def _delete_bucket(self, bucket: str):
+                if server._lookup(server.buckets_path, bucket) is None:
+                    raise s3_error("NoSuchBucket")
+                # the reference deletes the whole collection then the dir
+                # (s3api_bucket_handlers.go DeleteBucketHandler)
+                try:
+                    server._stub().DeleteCollection(
+                        fpb.DeleteCollectionRequest(collection=bucket)
+                    )
+                except grpc.RpcError:
+                    pass
+                server._rm(server.buckets_path, bucket, delete_data=False)
+                self._send(204)
+
+            # ---------- objects ----------
+            def _put_object(self, bucket: str, key: str, body: bytes):
+                if server._lookup(server.buckets_path, bucket) is None:
+                    raise s3_error("NoSuchBucket")
+                mime = self.headers.get("Content-Type", "")
+                server._put_to_filer(
+                    [server.buckets_path.lstrip("/"), bucket] + key.split("/"),
+                    body,
+                    mime,
+                )
+                etag = hashlib.md5(body).hexdigest()
+                self._send(200, headers={"ETag": f'"{etag}"'})
+
+            def _copy_object(self, bucket: str, key: str):
+                src = urllib.parse.unquote(self.headers["X-Amz-Copy-Source"])
+                src = src.lstrip("/")
+                src_bucket, _, src_key = src.partition("/")
+                data, mime = server._get_from_filer(
+                    [server.buckets_path.lstrip("/"), src_bucket] + src_key.split("/")
+                )
+                server._put_to_filer(
+                    [server.buckets_path.lstrip("/"), bucket] + key.split("/"),
+                    data,
+                    mime,
+                )
+                root = ET.Element("CopyObjectResult", xmlns=S3_XMLNS)
+                ET.SubElement(root, "ETag").text = f'"{hashlib.md5(data).hexdigest()}"'
+                ET.SubElement(root, "LastModified").text = _iso(int(time.time()))
+                self._send_xml(root)
+
+            def _get_object(self, bucket: str, key: str):
+                data, mime = server._get_from_filer(
+                    [server.buckets_path.lstrip("/"), bucket] + key.split("/")
+                )
+                self._send(
+                    200,
+                    data,
+                    {
+                        "Content-Type": mime or "application/octet-stream",
+                        "ETag": f'"{hashlib.md5(data).hexdigest()}"',
+                    },
+                )
+
+            def _head_object(self, bucket: str, key: str):
+                directory, _, name = f"{server.buckets_path}/{bucket}/{key}".rpartition("/")
+                entry = server._lookup(directory, name)
+                if entry is None or entry.is_directory:
+                    raise s3_error("NoSuchKey")
+                size = sum(c.size for c in entry.chunks)
+                self._send(
+                    200,
+                    headers={
+                        "Content-Type": entry.attributes.mime
+                        or "application/octet-stream",
+                        "Content-Length-Hint": str(size),
+                        "Last-Modified": _http_date(entry.attributes.mtime),
+                    },
+                )
+
+            def _delete_object(self, bucket: str, key: str):
+                directory, _, name = f"{server.buckets_path}/{bucket}/{key}".rpartition("/")
+                server._rm(directory, name, delete_data=True)
+                self._send(204)
+
+            def _delete_multiple_objects(self, bucket: str, body: bytes):
+                try:
+                    root = ET.fromstring(body)
+                except ET.ParseError:
+                    raise s3_error("MalformedXML") from None
+                deleted, errors = [], []
+                ns = ""
+                if root.tag.startswith("{"):
+                    ns = root.tag[: root.tag.index("}") + 1]
+                for obj in root.findall(f"{ns}Object"):
+                    key_el = obj.find(f"{ns}Key")
+                    if key_el is None or not key_el.text:
+                        continue
+                    key = key_el.text
+                    directory, _, name = (
+                        f"{server.buckets_path}/{bucket}/{key}".rpartition("/")
+                    )
+                    server._rm(directory, name, delete_data=True)
+                    deleted.append(key)
+                out = ET.Element("DeleteResult", xmlns=S3_XMLNS)
+                for key in deleted:
+                    d = ET.SubElement(out, "Deleted")
+                    ET.SubElement(d, "Key").text = key
+                self._send_xml(out)
+
+            # ---------- listing ----------
+            def _list_objects(self, bucket: str, query: dict):
+                if server._lookup(server.buckets_path, bucket) is None:
+                    raise s3_error("NoSuchBucket")
+                v2 = query.get("list-type", [""])[0] == "2"
+                prefix = query.get("prefix", [""])[0]
+                delimiter = query.get("delimiter", [""])[0]
+                if v2:
+                    marker = query.get("continuation-token", [""])[0] or query.get(
+                        "start-after", [""]
+                    )[0]
+                else:
+                    marker = query.get("marker", [""])[0]
+                try:
+                    max_keys = int(query.get("max-keys", ["1000"])[0])
+                except ValueError:
+                    raise s3_error("InvalidMaxKeys") from None
+                if max_keys < 0:
+                    raise s3_error("InvalidMaxKeys")
+                if delimiter not in ("", "/"):
+                    raise s3_error("NotImplemented")
+
+                # split the prefix into directory part + entry-name prefix
+                # (listFilerEntries, s3api_objects_list_handlers.go:92-100)
+                slash = prefix.rfind("/")
+                dir_part = prefix[: slash + 1] if slash >= 0 else ""
+                name_prefix = prefix[slash + 1:] if slash >= 0 else prefix
+                directory = f"{server.buckets_path}/{bucket}"
+                if dir_part:
+                    directory += "/" + dir_part.rstrip("/")
+                rel_marker = marker[len(dir_part):] if marker.startswith(dir_part) else marker
+
+                entries = server._list(
+                    directory,
+                    prefix=name_prefix,
+                    start=rel_marker,
+                    inclusive=False,
+                    limit=min(max_keys, MAX_OBJECT_LIST_SIZE) + 1,
+                )
+                truncated = len(entries) > max_keys
+                entries = entries[:max_keys]
+                contents, common = [], []
+                last = ""
+                for e in entries:
+                    last = f"{dir_part}{e.name}"
+                    if e.is_directory:
+                        if e.name != ".uploads":
+                            common.append(f"{dir_part}{e.name}/")
+                    else:
+                        contents.append(e)
+
+                root = ET.Element("ListBucketResult", xmlns=S3_XMLNS)
+                ET.SubElement(root, "Name").text = bucket
+                ET.SubElement(root, "Prefix").text = prefix
+                ET.SubElement(root, "Marker").text = marker
+                ET.SubElement(root, "NextMarker").text = last if truncated else ""
+                ET.SubElement(root, "MaxKeys").text = str(max_keys)
+                ET.SubElement(root, "Delimiter").text = delimiter or "/"
+                ET.SubElement(root, "IsTruncated").text = (
+                    "true" if truncated else "false"
+                )
+                if v2:
+                    ET.SubElement(root, "KeyCount").text = str(len(contents))
+                    if truncated:
+                        ET.SubElement(root, "NextContinuationToken").text = last
+                for e in contents:
+                    c = ET.SubElement(root, "Contents")
+                    ET.SubElement(c, "Key").text = f"{dir_part}{e.name}"
+                    ET.SubElement(c, "LastModified").text = _iso(e.attributes.mtime)
+                    etag = e.chunks[0].e_tag if len(e.chunks) == 1 else ""
+                    ET.SubElement(c, "ETag").text = f'"{etag}"'
+                    ET.SubElement(c, "Size").text = str(
+                        sum(ch.size for ch in e.chunks)
+                    )
+                    ET.SubElement(c, "StorageClass").text = "STANDARD"
+                for p in common:
+                    cp = ET.SubElement(root, "CommonPrefixes")
+                    ET.SubElement(cp, "Prefix").text = p
+                self._send_xml(root)
+
+            # ---------- multipart ----------
+            def _new_multipart_upload(self, bucket: str, key: str):
+                upload_id = str(uuid.uuid4())
+                # parent dirs (.../.uploads) auto-create on the filer side
+                server._mkdir(
+                    server._uploads_folder(bucket),
+                    upload_id,
+                    extended={"key": key.encode()},
+                )
+                root = ET.Element("InitiateMultipartUploadResult", xmlns=S3_XMLNS)
+                ET.SubElement(root, "Bucket").text = bucket
+                ET.SubElement(root, "Key").text = key
+                ET.SubElement(root, "UploadId").text = upload_id
+                self._send_xml(root)
+
+            def _put_object_part(self, bucket, key, query, body):
+                upload_id = query["uploadId"][0]
+                part_num = int(query["partNumber"][0])
+                if server._lookup(server._uploads_folder(bucket), upload_id) is None:
+                    raise s3_error("NoSuchUpload")
+                server._put_to_filer(
+                    [
+                        server.buckets_path.lstrip("/"),
+                        bucket,
+                        ".uploads",
+                        upload_id,
+                        f"{part_num:04d}.part",
+                    ],
+                    body,
+                    "application/octet-stream",
+                )
+                self._send(
+                    200, headers={"ETag": f'"{hashlib.md5(body).hexdigest()}"'}
+                )
+
+            def _complete_multipart_upload(self, bucket, key, query, body):
+                upload_id = query["uploadId"][0]
+                upload_dir = f"{server._uploads_folder(bucket)}/{upload_id}"
+                entries = server._list(upload_dir)
+                if not entries:
+                    raise s3_error("NoSuchUpload")
+                # splice every part's chunks into one chunk list at
+                # running offsets (filer_multipart.go:67-84)
+                final_chunks = []
+                offset = 0
+                for entry in sorted(entries, key=lambda e: e.name):
+                    if not entry.name.endswith(".part") or entry.is_directory:
+                        continue
+                    for chunk in entry.chunks:
+                        final_chunks.append(
+                            fpb.FileChunk(
+                                fid=chunk.fid,
+                                offset=offset,
+                                size=chunk.size,
+                                mtime=chunk.mtime,
+                                e_tag=chunk.e_tag,
+                            )
+                        )
+                        offset += chunk.size
+                dir_name = f"{server.buckets_path}/{bucket}"
+                entry_name = key
+                if "/" in key:
+                    sub, _, entry_name = key.rpartition("/")
+                    dir_name = f"{dir_name}/{sub}"
+                server._mkfile(dir_name, entry_name, final_chunks)
+                # drop the staging dir but keep the part chunks alive —
+                # the final entry references them
+                server._rm(
+                    server._uploads_folder(bucket), upload_id, delete_data=False
+                )
+                root = ET.Element("CompleteMultipartUploadResult", xmlns=S3_XMLNS)
+                ET.SubElement(root, "Location").text = (
+                    f"http://{server.filer}{dir_name}/{entry_name}"
+                )
+                ET.SubElement(root, "Bucket").text = bucket
+                ET.SubElement(root, "Key").text = key
+                ET.SubElement(root, "ETag").text = f'"{_chunks_etag(final_chunks)}"'
+                self._send_xml(root)
+
+            def _abort_multipart_upload(self, bucket, key, query):
+                upload_id = query["uploadId"][0]
+                server._rm(server._uploads_folder(bucket), upload_id, delete_data=True)
+                self._send(204)
+
+            def _list_multipart_uploads(self, bucket):
+                uploads = server._list(server._uploads_folder(bucket))
+                root = ET.Element("ListMultipartUploadsResult", xmlns=S3_XMLNS)
+                ET.SubElement(root, "Bucket").text = bucket
+                for u in uploads:
+                    if not u.is_directory:
+                        continue
+                    el = ET.SubElement(root, "Upload")
+                    ET.SubElement(el, "UploadId").text = u.name
+                    key = u.extended.get("key", b"").decode()
+                    ET.SubElement(el, "Key").text = key
+                self._send_xml(root)
+
+            def _list_object_parts(self, bucket, key, query):
+                upload_id = query["uploadId"][0]
+                upload_dir = f"{server._uploads_folder(bucket)}/{upload_id}"
+                entries = server._list(upload_dir)
+                if server._lookup(server._uploads_folder(bucket), upload_id) is None:
+                    raise s3_error("NoSuchUpload")
+                root = ET.Element("ListPartsResult", xmlns=S3_XMLNS)
+                ET.SubElement(root, "Bucket").text = bucket
+                ET.SubElement(root, "Key").text = key
+                ET.SubElement(root, "UploadId").text = upload_id
+                for entry in sorted(entries, key=lambda e: e.name):
+                    if not entry.name.endswith(".part"):
+                        continue
+                    p = ET.SubElement(root, "Part")
+                    ET.SubElement(p, "PartNumber").text = str(
+                        int(entry.name[:-5])
+                    )
+                    ET.SubElement(p, "LastModified").text = _iso(entry.attributes.mtime)
+                    ET.SubElement(p, "Size").text = str(
+                        sum(c.size for c in entry.chunks)
+                    )
+                self._send_xml(root)
+
+        return Handler
+
+
+# ----------------------------------------------------------------------
+def _iso(epoch_sec: int) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(epoch_sec or 0))
+
+
+def _http_date(epoch_sec: int) -> str:
+    return time.strftime(
+        "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(epoch_sec or 0)
+    )
+
+
+def _chunks_etag(chunks) -> str:
+    h = hashlib.md5()
+    for c in chunks:
+        h.update(c.e_tag.encode() or c.fid.encode())
+    return f"{h.hexdigest()}-{len(chunks)}"
+
+
+def _valid_bucket_name(name: str) -> bool:
+    if not 3 <= len(name) <= 63:
+        return False
+    return all(c.islower() or c.isdigit() or c in "-." for c in name) and (
+        name[0].isalnum() and name[-1].isalnum()
+    )
