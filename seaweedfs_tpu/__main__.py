@@ -1,0 +1,5 @@
+import sys
+
+from seaweedfs_tpu.command import main
+
+sys.exit(main())

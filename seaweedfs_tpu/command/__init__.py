@@ -1,0 +1,54 @@
+"""CLI subcommand registry — the `weed` entry point's role
+(weed/weed.go:38 + weed/command/command.go:10-29).
+
+Each module registers a Command; `python -m seaweedfs_tpu <name>`
+dispatches here. The reference's 19 subcommands and their flags are
+mirrored where they make sense for this framework; FUSE mount is gated
+on a fuse binding being importable.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+COMMANDS: dict[str, "Command"] = {}
+
+
+class Command:
+    name = ""
+    help = ""
+
+    def add_arguments(self, parser: argparse.ArgumentParser) -> None:
+        pass
+
+    def run(self, args: argparse.Namespace) -> int:
+        raise NotImplementedError
+
+
+def register(cls):
+    COMMANDS[cls.name] = cls()
+    return cls
+
+
+def main(argv: list[str] | None = None) -> int:
+    # import for registration side effects
+    from seaweedfs_tpu.command import (  # noqa: F401
+        servers,
+        tools,
+        benchmark,
+        filer_tools,
+    )
+
+    parser = argparse.ArgumentParser(
+        prog="seaweedfs_tpu",
+        description="TPU-native SeaweedFS-capability distributed object store",
+    )
+    sub = parser.add_subparsers(dest="command")
+    for name, cmd in sorted(COMMANDS.items()):
+        p = sub.add_parser(name, help=cmd.help)
+        cmd.add_arguments(p)
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.print_help()
+        return 2
+    return COMMANDS[args.command].run(args) or 0

@@ -1,0 +1,186 @@
+"""`benchmark` — concurrent write/read load generator with latency
+histograms, the reference's perf-testing product feature
+(weed/command/benchmark.go:53-66 flags, :377-514 stats printer).
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import threading
+import time
+
+from seaweedfs_tpu.command import Command, register
+
+PERCENTAGES = (50, 66, 75, 80, 90, 95, 98, 99, 100)
+
+
+class LatencyStats:
+    """Fixed-bucket latency collector mirroring the reference's
+    benchmark stats: req/s, MB/s, percentile table, distribution."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.latencies_ms: list[float] = []
+        self.bytes = 0
+        self.completed = 0
+        self.failed = 0
+        self.start = time.perf_counter()
+
+    def add(self, latency_sec: float, nbytes: int, ok: bool = True) -> None:
+        with self._lock:
+            if ok:
+                self.completed += 1
+                self.bytes += nbytes
+                self.latencies_ms.append(latency_sec * 1000.0)
+            else:
+                self.failed += 1
+
+    def report(self, title: str, concurrency: int) -> str:
+        elapsed = time.perf_counter() - self.start
+        lat = sorted(self.latencies_ms)
+        n = len(lat)
+        lines = [
+            f"\n------------ {title} ----------",
+            f"Concurrency Level:      {concurrency}",
+            f"Time taken for tests:   {elapsed:.3f} seconds",
+            f"Complete requests:      {self.completed}",
+            f"Failed requests:        {self.failed}",
+            f"Total transferred:      {self.bytes} bytes",
+            f"Requests per second:    {self.completed / elapsed:.2f} [#/sec]",
+            f"Transfer rate:          {self.bytes / 1024.0 / elapsed:.2f} [Kbytes/sec]",
+        ]
+        if n:
+            avg = sum(lat) / n
+            std = (sum((x - avg) ** 2 for x in lat) / n) ** 0.5
+            lines += [
+                "\nConnection Times (ms)",
+                "              min      avg        max      std",
+                f"Total:        {lat[0]:.1f}      {avg:.1f}       {lat[-1]:.1f}      {std:.1f}",
+                "\nPercentage of the requests served within a certain time (ms)",
+            ]
+            for p in PERCENTAGES:
+                idx = min(n - 1, max(0, int(n * p / 100) - 1))
+                lines.append(f"   {p}% {lat[idx]:>9.1f} ms")
+        return "\n".join(lines)
+
+
+@register
+class BenchmarkCommand(Command):
+    name = "benchmark"
+    help = "load-test the cluster: concurrent writes then random reads"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-master", default="127.0.0.1:9333")
+        p.add_argument("-c", dest="concurrency", type=int, default=16)
+        p.add_argument("-n", dest="num", type=int, default=1024 * 1024)
+        p.add_argument("-size", type=int, default=1024)
+        p.add_argument("-collection", default="benchmark")
+        p.add_argument("-replication", default="000")
+        p.add_argument("-write", action=argparse.BooleanOptionalAction, default=True)
+        p.add_argument("-read", action=argparse.BooleanOptionalAction, default=True)
+        p.add_argument("-deletePercent", type=int, default=0)
+
+    def run(self, args) -> int:
+        stats, fids = run_benchmark(
+            master=args.master,
+            concurrency=args.concurrency,
+            num=args.num,
+            size=args.size,
+            collection=args.collection,
+            replication=args.replication,
+            do_write=args.write,
+            do_read=args.read,
+            delete_percent=args.deletePercent,
+        )
+        for title, s in stats:
+            print(s.report(title, args.concurrency))
+        return 0
+
+
+def run_benchmark(
+    master: str,
+    concurrency: int = 4,
+    num: int = 1024,
+    size: int = 1024,
+    collection: str = "benchmark",
+    replication: str = "000",
+    do_write: bool = True,
+    do_read: bool = True,
+    delete_percent: int = 0,
+):
+    """Programmatic entry (also used by tests); returns
+    ([(title, LatencyStats)], written_fids)."""
+    from seaweedfs_tpu.client import operation as op
+
+    results = []
+    fids: list[str] = []
+    fid_lock = threading.Lock()
+
+    if do_write:
+        stats = LatencyStats()
+        counter = iter(range(num))
+        counter_lock = threading.Lock()
+        rng = random.Random(1)
+        payload = bytes(rng.randrange(256) for _ in range(size))
+
+        def writer():
+            while True:
+                with counter_lock:
+                    try:
+                        next(counter)
+                    except StopIteration:
+                        return
+                t0 = time.perf_counter()
+                try:
+                    ar = op.assign(
+                        master, collection=collection, replication=replication
+                    )
+                    ur = op.upload(f"{ar.url}/{ar.fid}", payload, filename="bench.bin")
+                    ok = not ur.error
+                    if ok:
+                        with fid_lock:
+                            fids.append(ar.fid)
+                        if delete_percent and random.randrange(100) < delete_percent:
+                            op.delete_files(master, [ar.fid])
+                except Exception:
+                    ok = False
+                stats.add(time.perf_counter() - t0, size, ok)
+
+        threads = [threading.Thread(target=writer) for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        results.append((f"Writing Benchmark ({num} x {size}B)", stats))
+
+    if do_read and fids:
+        stats = LatencyStats()
+        counter = iter(range(num))
+        counter_lock = threading.Lock()
+
+        def reader():
+            rng = random.Random(threading.get_ident())
+            while True:
+                with counter_lock:
+                    try:
+                        next(counter)
+                    except StopIteration:
+                        return
+                fid = rng.choice(fids)
+                t0 = time.perf_counter()
+                try:
+                    url = op.lookup_file_id(master, fid)
+                    data, _ = op.download(url)
+                    stats.add(time.perf_counter() - t0, len(data), True)
+                except Exception:
+                    stats.add(time.perf_counter() - t0, 0, False)
+
+        threads = [threading.Thread(target=reader) for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        results.append((f"Random Read Benchmark ({num} reads)", stats))
+
+    return results, fids

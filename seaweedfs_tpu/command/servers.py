@@ -1,0 +1,377 @@
+"""Server subcommands: master, volume, filer, s3, webdav, server
+(all-in-one), shell — the daemon half of the reference CLI
+(weed/command/master.go, volume.go, filer.go, s3.go, webdav.go,
+server.go:30-100, shell.go)."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from seaweedfs_tpu.command import Command, register
+from seaweedfs_tpu.util import wlog
+
+
+def _wait_forever() -> int:
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    stop.wait()
+    return 0
+
+
+def _load_guard():
+    """security.toml → Guard (None when not configured)."""
+    from seaweedfs_tpu.security import Guard
+    from seaweedfs_tpu.util.config import load_config
+
+    cfg = load_config("security")
+    key = cfg.get_string("jwt.signing.key")
+    read_key = cfg.get_string("jwt.signing.read.key")
+    white = cfg.get("access.white_list") or []
+    if isinstance(white, str):
+        white = [w for w in white.split(",") if w]
+    if not key and not read_key and not white:
+        return None
+    return Guard(
+        white_list=white,
+        signing_key=key,
+        expires_after_sec=cfg.get_int("jwt.signing.expires_after_seconds", 10),
+        read_signing_key=read_key,
+        read_expires_after_sec=cfg.get_int(
+            "jwt.signing.read.expires_after_seconds", 60
+        ),
+    )
+
+
+@register
+class MasterCommand(Command):
+    name = "master"
+    help = "start the cluster master (volume assignment, topology, lookup)"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-ip", default="127.0.0.1")
+        p.add_argument("-port", type=int, default=9333)
+        p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
+        p.add_argument("-defaultReplication", default="000")
+        p.add_argument("-garbageThreshold", type=float, default=0.3)
+        p.add_argument("-v", type=int, default=0, help="verbosity")
+
+    def run(self, args) -> int:
+        from seaweedfs_tpu.server.master_server import MasterServer
+
+        wlog.set_verbosity(args.v)
+        server = MasterServer(
+            host=args.ip,
+            port=args.port,
+            volume_size_limit_mb=args.volumeSizeLimitMB,
+            default_replication=args.defaultReplication,
+            garbage_threshold=args.garbageThreshold,
+            guard=_load_guard(),
+        )
+        server.start()
+        wlog.info("master listening on %s:%d (grpc %d)", args.ip, args.port, args.port + 10000)
+        try:
+            return _wait_forever()
+        finally:
+            server.stop()
+
+
+@register
+class VolumeCommand(Command):
+    name = "volume"
+    help = "start a volume server (blob data plane)"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-ip", default="127.0.0.1")
+        p.add_argument("-port", type=int, default=8080)
+        p.add_argument("-dir", default=".", help="comma-separated data directories")
+        p.add_argument("-max", default="7", help="comma-separated max volume counts")
+        p.add_argument("-mserver", default="127.0.0.1:9333")
+        p.add_argument("-dataCenter", default="")
+        p.add_argument("-rack", default="")
+        p.add_argument("-publicUrl", default="")
+        p.add_argument("-readRedirect", action="store_true")
+        p.add_argument("-v", type=int, default=0)
+
+    def run(self, args) -> int:
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+
+        wlog.set_verbosity(args.v)
+        dirs = args.dir.split(",")
+        maxes = [int(m) for m in args.max.split(",")]
+        if len(maxes) == 1:
+            maxes = maxes * len(dirs)
+        server = VolumeServer(
+            dirs,
+            host=args.ip,
+            port=args.port,
+            master=args.mserver,
+            public_url=args.publicUrl,
+            data_center=args.dataCenter,
+            rack=args.rack,
+            max_volume_counts=maxes,
+            read_redirect=args.readRedirect,
+            guard=_load_guard(),
+        )
+        server.start()
+        wlog.info("volume server %s:%d -> master %s", args.ip, args.port, args.mserver)
+        try:
+            return _wait_forever()
+        finally:
+            server.stop()
+
+
+@register
+class FilerCommand(Command):
+    name = "filer"
+    help = "start a filer (directory/file namespace over the blob store)"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-ip", default="127.0.0.1")
+        p.add_argument("-port", type=int, default=8888)
+        p.add_argument("-master", default="127.0.0.1:9333")
+        p.add_argument("-store", default="memory", help="memory | sqlite | sortedlog")
+        p.add_argument("-storePath", default="")
+        p.add_argument("-collection", default="")
+        p.add_argument("-replication", default="")
+        p.add_argument("-maxMB", type=int, default=32)
+        p.add_argument("-v", type=int, default=0)
+
+    def run(self, args) -> int:
+        from seaweedfs_tpu.server.filer_server import FilerServer
+
+        wlog.set_verbosity(args.v)
+        server = FilerServer(
+            args.master.split(","),
+            host=args.ip,
+            port=args.port,
+            store=args.store,
+            store_path=args.storePath,
+            collection=args.collection,
+            replication=args.replication,
+            max_mb=args.maxMB,
+        )
+        server.start()
+        wlog.info("filer %s:%d -> master %s", args.ip, args.port, args.master)
+        try:
+            return _wait_forever()
+        finally:
+            server.stop()
+
+
+@register
+class S3Command(Command):
+    name = "s3"
+    help = "start the S3-compatible gateway over a filer"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-ip", default="127.0.0.1")
+        p.add_argument("-port", type=int, default=8333)
+        p.add_argument("-filer", default="127.0.0.1:8888")
+        p.add_argument("-bucketsPath", default="/buckets")
+        p.add_argument("-config", default="", help="identities toml with access/secret keys")
+        p.add_argument("-v", type=int, default=0)
+
+    def run(self, args) -> int:
+        from seaweedfs_tpu.s3api import S3ApiServer
+        from seaweedfs_tpu.s3api.auth import Identity, IdentityAccessManagement
+
+        wlog.set_verbosity(args.v)
+        iam = None
+        if args.config:
+            import tomllib
+
+            with open(args.config, "rb") as f:
+                tree = tomllib.load(f)
+            idents = [
+                Identity(
+                    i.get("name", i["access_key"]),
+                    i["access_key"],
+                    i["secret_key"],
+                    i.get("actions", ("Admin",)),
+                )
+                for i in tree.get("identities", [])
+            ]
+            iam = IdentityAccessManagement(idents)
+        server = S3ApiServer(
+            filer=args.filer,
+            host=args.ip,
+            port=args.port,
+            buckets_path=args.bucketsPath,
+            iam=iam,
+        )
+        server.start()
+        wlog.info("s3 gateway %s:%d -> filer %s", args.ip, args.port, args.filer)
+        try:
+            return _wait_forever()
+        finally:
+            server.stop()
+
+
+@register
+class WebDavCommand(Command):
+    name = "webdav"
+    help = "start the WebDAV gateway over a filer"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-ip", default="127.0.0.1")
+        p.add_argument("-port", type=int, default=7333)
+        p.add_argument("-filer", default="127.0.0.1:8888")
+        p.add_argument("-v", type=int, default=0)
+
+    def run(self, args) -> int:
+        from seaweedfs_tpu.webdav.webdav_server import WebDavServer
+
+        wlog.set_verbosity(args.v)
+        server = WebDavServer(filer=args.filer, host=args.ip, port=args.port)
+        server.start()
+        wlog.info("webdav %s:%d -> filer %s", args.ip, args.port, args.filer)
+        try:
+            return _wait_forever()
+        finally:
+            server.stop()
+
+
+@register
+class ServerCommand(Command):
+    name = "server"
+    help = "start master + volume server(s) [+ filer + s3] in one process"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-ip", default="127.0.0.1")
+        p.add_argument("-master.port", dest="master_port", type=int, default=9333)
+        p.add_argument("-volume.port", dest="volume_port", type=int, default=8080)
+        p.add_argument("-dir", default=".")
+        p.add_argument("-master.volumeSizeLimitMB", dest="vsl", type=int, default=30 * 1024)
+        p.add_argument("-master.defaultReplication", dest="repl", default="000")
+        p.add_argument("-volume.max", dest="vmax", default="7")
+        p.add_argument("-dataCenter", default="")
+        p.add_argument("-rack", default="")
+        p.add_argument("-filer", action="store_true")
+        p.add_argument("-filer.port", dest="filer_port", type=int, default=8888)
+        p.add_argument("-filer.store", dest="filer_store", default="memory")
+        p.add_argument("-s3", action="store_true")
+        p.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
+        p.add_argument("-webdav", action="store_true")
+        p.add_argument("-webdav.port", dest="webdav_port", type=int, default=7333)
+        p.add_argument("-v", type=int, default=0)
+
+    def run(self, args) -> int:
+        from seaweedfs_tpu.server.master_server import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+
+        wlog.set_verbosity(args.v)
+        guard = _load_guard()
+        started = []
+        master = MasterServer(
+            host=args.ip,
+            port=args.master_port,
+            volume_size_limit_mb=args.vsl,
+            default_replication=args.repl,
+            guard=guard,
+        )
+        master.start()
+        started.append(master)
+        dirs = args.dir.split(",")
+        maxes = [int(m) for m in args.vmax.split(",")]
+        if len(maxes) == 1:
+            maxes = maxes * len(dirs)
+        volume = VolumeServer(
+            dirs,
+            host=args.ip,
+            port=args.volume_port,
+            master=f"{args.ip}:{args.master_port}",
+            data_center=args.dataCenter,
+            rack=args.rack,
+            max_volume_counts=maxes,
+            guard=guard,
+        )
+        volume.start()
+        started.append(volume)
+        if args.filer or args.s3 or args.webdav:
+            from seaweedfs_tpu.server.filer_server import FilerServer
+
+            filer = FilerServer(
+                [f"{args.ip}:{args.master_port}"],
+                host=args.ip,
+                port=args.filer_port,
+                store=args.filer_store,
+            )
+            filer.start()
+            started.append(filer)
+        if args.s3:
+            from seaweedfs_tpu.s3api import S3ApiServer
+
+            s3 = S3ApiServer(
+                filer=f"{args.ip}:{args.filer_port}", host=args.ip, port=args.s3_port
+            )
+            s3.start()
+            started.append(s3)
+        if args.webdav:
+            from seaweedfs_tpu.webdav.webdav_server import WebDavServer
+
+            wd = WebDavServer(
+                filer=f"{args.ip}:{args.filer_port}", host=args.ip, port=args.webdav_port
+            )
+            wd.start()
+            started.append(wd)
+        wlog.info("all-in-one server up: %d components", len(started))
+        try:
+            return _wait_forever()
+        finally:
+            for s in reversed(started):
+                s.stop()
+
+
+@register
+class ShellCommand(Command):
+    name = "shell"
+    help = "interactive admin shell (ec.*, volume.*, fs.* commands)"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-master", default="127.0.0.1:9333")
+        p.add_argument("-c", dest="script", default="", help="run semicolon-separated commands and exit")
+
+    def run(self, args) -> int:
+        import io
+        import sys
+
+        from seaweedfs_tpu.shell.shell_runner import run_shell
+
+        masters = args.master.split(",")
+        if args.script:
+            fake_stdin = io.StringIO(
+                "\n".join(s.strip() for s in args.script.split(";")) + "\nexit\n"
+            )
+            run_shell(masters, stdin=fake_stdin, stdout=sys.stdout)
+            return 0
+        run_shell(masters)
+        return 0
+
+
+@register
+class MountCommand(Command):
+    name = "mount"
+    help = "mount the filer as a FUSE filesystem (requires a fuse binding)"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-filer", default="127.0.0.1:8888")
+        p.add_argument("-dir", required=False, default="")
+        p.add_argument("-filer.path", dest="filer_path", default="/")
+
+    def run(self, args) -> int:
+        try:
+            from seaweedfs_tpu.filesys.mount import mount  # noqa
+        except ImportError as e:
+            print(f"mount unavailable: {e} (no fuse binding in this environment)")
+            return 1
+        if not args.dir:
+            print("usage: mount -dir=<mountpoint>")
+            return 2
+        return mount(args.filer, args.dir, args.filer_path)

@@ -1,0 +1,271 @@
+"""Offline/utility subcommands: version, scaffold, upload, download,
+backup, compact, fix, export — the tool half of the reference CLI
+(weed/command/{upload,download,backup,compact,fix,export,scaffold,
+version}.go)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from seaweedfs_tpu.command import Command, register
+
+VERSION = "seaweedfs_tpu 0.1 (TPU-native build)"
+
+
+@register
+class VersionCommand(Command):
+    name = "version"
+    help = "print version"
+
+    def run(self, args) -> int:
+        print(VERSION)
+        return 0
+
+
+@register
+class ScaffoldCommand(Command):
+    name = "scaffold"
+    help = "generate template toml config files"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument(
+            "-config",
+            default="security",
+            help="security | filer | notification | replication | master",
+        )
+        p.add_argument("-output", default="", help="write <name>.toml to this dir ('' = stdout)")
+
+    def run(self, args) -> int:
+        from seaweedfs_tpu.util.config import SCAFFOLD_TEMPLATES
+
+        text = SCAFFOLD_TEMPLATES.get(args.config)
+        if text is None:
+            print(f"unknown config {args.config}; have {sorted(SCAFFOLD_TEMPLATES)}")
+            return 1
+        if args.output:
+            path = os.path.join(args.output, f"{args.config}.toml")
+            with open(path, "w") as f:
+                f.write(text)
+            print(f"wrote {path}")
+        else:
+            print(text)
+        return 0
+
+
+@register
+class UploadCommand(Command):
+    name = "upload"
+    help = "upload local files to the cluster (assign + upload; big files chunked)"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("files", nargs="+")
+        p.add_argument("-master", default="127.0.0.1:9333")
+        p.add_argument("-collection", default="")
+        p.add_argument("-replication", default="")
+        p.add_argument("-ttl", default="")
+        p.add_argument("-maxMB", type=int, default=32)
+
+    def run(self, args) -> int:
+        import dataclasses
+
+        from seaweedfs_tpu.client import operation as op
+
+        results = []
+        for path in args.files:
+            with open(path, "rb") as f:
+                data = f.read()
+            r = op.submit_file(
+                args.master,
+                os.path.basename(path),
+                data,
+                collection=args.collection,
+                replication=args.replication,
+                ttl=args.ttl,
+                max_mb=args.maxMB,
+            )
+            results.append(dataclasses.asdict(r))
+        print(json.dumps(results, indent=2))
+        return 0
+
+
+@register
+class DownloadCommand(Command):
+    name = "download"
+    help = "download files by fid"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("fids", nargs="+")
+        p.add_argument("-server", default="127.0.0.1:9333", help="master")
+        p.add_argument("-dir", default=".")
+
+    def run(self, args) -> int:
+        from seaweedfs_tpu.client import operation as op
+
+        for fid in args.fids:
+            url = op.lookup_file_id(args.server, fid)
+            data, headers = op.download(url)
+            name = headers.get("X-File-Name") or fid.replace(",", "_")
+            out = os.path.join(args.dir, name)
+            with open(out, "wb") as f:
+                f.write(data)
+            print(f"{fid} -> {out} ({len(data)} bytes)")
+        return 0
+
+
+@register
+class BackupCommand(Command):
+    name = "backup"
+    help = "incrementally back up one volume from the cluster to local files"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-master", default="127.0.0.1:9333")
+        p.add_argument("-volumeId", type=int, required=True)
+        p.add_argument("-dir", default=".")
+        p.add_argument("-collection", default="")
+
+    def run(self, args) -> int:
+        """Locate the volume, then VolumeIncrementalCopy since our local
+        tail, appending raw records and rebuilding the index
+        (command/backup.go runBackup semantics)."""
+        import grpc
+
+        from seaweedfs_tpu.client import operation as op
+        from seaweedfs_tpu.pb import rpc, volume_pb2
+        from seaweedfs_tpu.storage.volume import Volume, volume_base_name
+
+        result = op.lookup(args.master, str(args.volumeId))
+        if result.error or not result.locations:
+            print(f"volume {args.volumeId} not found: {result.error}")
+            return 1
+        vol = Volume(args.dir, args.volumeId, args.collection)
+        since = vol.last_append_at_ns
+        vol.close()
+        base = volume_base_name(args.dir, args.collection, args.volumeId)
+        url = result.locations[0]["url"]
+        appended = 0
+        with grpc.insecure_channel(rpc.grpc_address(url)) as ch:
+            stub = rpc.volume_stub(ch)
+            with open(base + ".dat", "ab") as dat:
+                for resp in stub.VolumeIncrementalCopy(
+                    volume_pb2.VolumeIncrementalCopyRequest(
+                        volume_id=args.volumeId, since_ns=since
+                    )
+                ):
+                    dat.write(resp.file_content)
+                    appended += len(resp.file_content)
+        if appended:
+            _rebuild_idx(base)
+        print(f"backed up {appended} new bytes into {base}.dat")
+        return 0
+
+
+@register
+class CompactCommand(Command):
+    name = "compact"
+    help = "offline-compact a local volume (drop deleted needles)"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-dir", default=".")
+        p.add_argument("-volumeId", type=int, required=True)
+        p.add_argument("-collection", default="")
+
+    def run(self, args) -> int:
+        from seaweedfs_tpu.storage.volume import Volume
+
+        vol = Volume(args.dir, args.volumeId, args.collection)
+        before = vol.data_file_size()
+        vol.compact()
+        vol.commit_compact()
+        after = vol.data_file_size()
+        vol.close()
+        print(f"compacted volume {args.volumeId}: {before} -> {after} bytes")
+        return 0
+
+
+@register
+class FixCommand(Command):
+    name = "fix"
+    help = "rebuild a volume's .idx by scanning its .dat"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-dir", default=".")
+        p.add_argument("-volumeId", type=int, required=True)
+        p.add_argument("-collection", default="")
+
+    def run(self, args) -> int:
+        from seaweedfs_tpu.storage.volume import volume_base_name
+
+        base = volume_base_name(args.dir, args.collection, args.volumeId)
+        count = _rebuild_idx(base)
+        print(f"rebuilt {base}.idx with {count} entries")
+        return 0
+
+
+def _rebuild_idx(base: str) -> int:
+    """Scan <base>.dat and rewrite <base>.idx; a record with size==0 is
+    the deletion tombstone delete_needle appends (weed/command/fix.go)."""
+    from seaweedfs_tpu.storage import idx as idx_mod, types as t
+    from seaweedfs_tpu.storage.volume import scan_volume_file
+
+    entries: dict[int, tuple[int, int]] = {}
+    order: list[int] = []
+    for needle, offset in scan_volume_file(base + ".dat"):
+        if needle.size == 0:
+            entries.pop(needle.id, None)
+        else:
+            if needle.id not in entries:
+                order.append(needle.id)
+            entries[needle.id] = (t.offset_to_units(offset), needle.size)
+    with open(base + ".idx", "wb") as f:
+        for key in order:
+            if key in entries:
+                off_units, size = entries[key]
+                f.write(idx_mod.pack_entry(key, off_units, size))
+    return len(entries)
+
+
+@register
+class ExportCommand(Command):
+    name = "export"
+    help = "list or extract needles from a local volume"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-dir", default=".")
+        p.add_argument("-volumeId", type=int, required=True)
+        p.add_argument("-collection", default="")
+        p.add_argument("-o", dest="output", default="", help="extract files into this dir")
+
+    def run(self, args) -> int:
+        from seaweedfs_tpu.storage.volume import scan_volume_file, volume_base_name
+
+        base = volume_base_name(args.dir, args.collection, args.volumeId)
+        # two passes: resolve final liveness first (later records —
+        # overwrites and size==0 tombstones — supersede earlier ones),
+        # then emit only each id's surviving record
+        final_offset: dict[int, int] = {}
+        for needle, off in scan_volume_file(base + ".dat"):
+            if needle.size == 0:
+                final_offset.pop(needle.id, None)
+            else:
+                final_offset[needle.id] = off
+        count = 0
+        for needle, offset in scan_volume_file(base + ".dat"):
+            if needle.size == 0 or final_offset.get(needle.id) != offset:
+                continue
+            name = (needle.name or b"").decode("utf-8", "replace")
+            print(
+                f"key={needle.id:x} cookie={needle.cookie:08x} size={needle.size} "
+                f"name={name!r} mime={(needle.mime or b'').decode('utf-8', 'replace')!r}"
+            )
+            if args.output:
+                out = os.path.join(
+                    args.output, name or f"{args.volumeId}_{needle.id:x}"
+                )
+                with open(out, "wb") as f:
+                    f.write(needle.data)
+            count += 1
+        print(f"{count} needles", file=sys.stderr)
+        return 0

@@ -354,3 +354,26 @@ class Volume:
                 path = self.base_name + ext
                 if os.path.exists(path):
                     os.remove(path)
+
+
+def scan_volume_file(dat_path: str):
+    """Walk every record in a .dat sequentially, yielding
+    (needle, byte_offset). Deletion tombstones appear as needles with
+    size == 0 (the record delete_needle appends). The scanner role of
+    the reference's storage.ScanVolumeFile used by `weed fix`/`export`."""
+    with open(dat_path, "rb") as f:
+        sb = SuperBlock.read_from(f)
+        version = sb.version
+        offset = sb.block_size()
+        f.seek(offset)
+        while True:
+            header = f.read(t.NEEDLE_HEADER_SIZE)
+            if len(header) < t.NEEDLE_HEADER_SIZE:
+                return
+            _, _, size = Needle.parse_header(header)
+            rest_len = get_actual_size(size, version) - t.NEEDLE_HEADER_SIZE
+            rest = f.read(rest_len)
+            if len(rest) < rest_len:
+                return  # torn tail record
+            yield Needle.from_bytes(header + rest, version), offset
+            offset += t.NEEDLE_HEADER_SIZE + rest_len

@@ -1,0 +1,173 @@
+"""CLI subcommand tests: offline tools against real volume files, and
+the benchmark/upload/download tools against a live in-process cluster."""
+
+import json
+import os
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.command import main as cli_main
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestOfflineTools:
+    def _make_volume(self, tmp_path, vid=7):
+        vol = Volume(str(tmp_path), vid)
+        for i in range(1, 21):
+            n = Needle(cookie=0x1234, id=i, data=f"needle-{i}".encode() * 10)
+            n.name = f"file{i}.txt".encode()
+            n.set_has_name()
+            vol.write_needle(n)
+        for i in (3, 7):
+            vol.delete_needle(Needle(cookie=0x1234, id=i))
+        vol.close()
+        return vid
+
+    def test_version(self, capsys):
+        assert cli_main(["version"]) == 0
+        assert "seaweedfs_tpu" in capsys.readouterr().out
+
+    def test_scaffold(self, capsys):
+        assert cli_main(["scaffold", "-config", "filer"]) == 0
+        out = capsys.readouterr().out
+        assert "[sqlite]" in out
+
+    def test_scaffold_unknown(self, capsys):
+        assert cli_main(["scaffold", "-config", "nope"]) == 1
+
+    def test_fix_rebuilds_idx(self, tmp_path, capsys):
+        vid = self._make_volume(tmp_path)
+        idx = tmp_path / f"{vid}.idx"
+        original = idx.read_bytes()
+        idx.unlink()
+        assert cli_main(["fix", "-dir", str(tmp_path), "-volumeId", str(vid)]) == 0
+        rebuilt = idx.read_bytes()
+        # 18 live entries (20 written, 2 deleted)
+        assert len(rebuilt) == 18 * 16
+        # reopening the volume with the rebuilt index serves the data
+        vol = Volume(str(tmp_path), vid)
+        n = vol.read_needle(5)
+        assert n.data == b"needle-5" * 10
+        assert not vol.has_needle(3)
+        vol.close()
+
+    def test_export_lists_live_needles(self, tmp_path, capsys):
+        vid = self._make_volume(tmp_path)
+        out_dir = tmp_path / "exported"
+        out_dir.mkdir()
+        assert (
+            cli_main(
+                [
+                    "export",
+                    "-dir",
+                    str(tmp_path),
+                    "-volumeId",
+                    str(vid),
+                    "-o",
+                    str(out_dir),
+                ]
+            )
+            == 0
+        )
+        out = capsys.readouterr().out
+        assert "file5.txt" in out
+        assert (out_dir / "file5.txt").read_bytes() == b"needle-5" * 10
+        # deleted needles are not exported
+        assert not (out_dir / "file3.txt").exists()
+        assert len(list(out_dir.iterdir())) == 18
+
+    def test_compact(self, tmp_path, capsys):
+        vid = self._make_volume(tmp_path)
+        before = (tmp_path / f"{vid}.dat").stat().st_size
+        assert cli_main(["compact", "-dir", str(tmp_path), "-volumeId", str(vid)]) == 0
+        after = (tmp_path / f"{vid}.dat").stat().st_size
+        assert after < before
+        vol = Volume(str(tmp_path), vid)
+        assert vol.read_needle(5).data == b"needle-5" * 10
+        assert not vol.has_needle(3)
+        vol.close()
+
+    def test_help_lists_commands(self, capsys):
+        assert cli_main([]) == 2
+        out = capsys.readouterr().out
+        for cmd in ("master", "volume", "filer", "s3", "benchmark", "shell"):
+            assert cmd in out
+
+
+@pytest.fixture(scope="module")
+def mini_cluster(tmp_path_factory):
+    mport = free_port()
+    master = MasterServer(port=mport, volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer(
+        [str(tmp_path_factory.mktemp("clivol"))],
+        port=free_port(),
+        master=f"127.0.0.1:{mport}",
+        heartbeat_interval=0.2,
+        max_volume_counts=[50],
+    )
+    vs.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topology.data_nodes():
+        time.sleep(0.05)
+    yield f"127.0.0.1:{mport}"
+    vs.stop()
+    master.stop()
+
+
+class TestClusterTools:
+    def test_upload_download(self, mini_cluster, tmp_path, capsys):
+        src = tmp_path / "hello.txt"
+        src.write_bytes(b"cli upload payload")
+        assert (
+            cli_main(["upload", str(src), "-master", mini_cluster]) == 0
+        )
+        result = json.loads(capsys.readouterr().out)
+        fid = result[0]["fid"]
+        assert result[0]["error"] == ""
+        out_dir = tmp_path / "dl"
+        out_dir.mkdir()
+        assert (
+            cli_main(
+                ["download", fid, "-server", mini_cluster, "-dir", str(out_dir)]
+            )
+            == 0
+        )
+        files = list(out_dir.iterdir())
+        assert len(files) == 1
+        assert files[0].read_bytes() == b"cli upload payload"
+
+    def test_benchmark_small(self, mini_cluster, capsys):
+        from seaweedfs_tpu.command.benchmark import run_benchmark
+
+        results, fids = run_benchmark(
+            mini_cluster, concurrency=4, num=40, size=512
+        )
+        assert len(fids) == 40
+        titles = [t for t, _ in results]
+        assert any("Writing" in t for t in titles)
+        assert any("Read" in t for t in titles)
+        for _, stats in results:
+            assert stats.failed == 0
+            assert stats.completed == 40
+            report = stats.report("x", 4)
+            assert "Requests per second" in report
+            assert "99%" in report
+
+    def test_shell_script(self, mini_cluster, capsys):
+        assert (
+            cli_main(["shell", "-master", mini_cluster, "-c", "volume.list"]) == 0
+        )
+        out = capsys.readouterr().out
+        assert "DataCenter" in out or "volume" in out.lower()
