@@ -144,9 +144,12 @@ class FilerCommand(Command):
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
+        from seaweedfs_tpu import notification
         from seaweedfs_tpu.server.filer_server import FilerServer
+        from seaweedfs_tpu.util.config import load_config
 
         wlog.set_verbosity(args.v)
+        notification.configure(load_config("notification"))
         server = FilerServer(
             args.master.split(","),
             host=args.ip,

@@ -1,0 +1,3 @@
+from seaweedfs_tpu.replication.replicator import Replicator
+
+__all__ = ["Replicator"]

@@ -1,0 +1,91 @@
+"""`weed filer.replicate` — consume the notification queue and drive a
+Replicator (weed/command/filer_replicate.go runFilerReplicate)."""
+
+from __future__ import annotations
+
+import os
+import time
+
+from seaweedfs_tpu.replication.replicator import Replicator
+from seaweedfs_tpu.replication.sink import FilerSink, GatedSink, LocalSink
+from seaweedfs_tpu.replication.source import FilerSource
+from seaweedfs_tpu.util import wlog
+from seaweedfs_tpu.util.config import load_config, Configuration
+
+
+def build_replicator(repl_cfg: Configuration) -> Replicator:
+    src = repl_cfg.sub("source.filer")
+    source = FilerSource(
+        src.get("grpcAddress", "localhost:8888"),
+        directory=src.get("directory", "/buckets"),
+    )
+    if repl_cfg.get_bool("sink.filer.enabled"):
+        s = repl_cfg.sub("sink.filer")
+        sink = FilerSink(
+            s.get("grpcAddress", "localhost:8888"),
+            directory=s.get("directory", "/backup"),
+            replication=s.get("replication", ""),
+            collection=s.get("collection", ""),
+            ttl_sec=int(s.get("ttlSec", 0)),
+        )
+    elif repl_cfg.get_bool("sink.local.enabled"):
+        sink = LocalSink(repl_cfg.sub("sink.local").get("directory", "/tmp/backup"))
+    elif repl_cfg.get_bool("sink.s3.enabled"):
+        sink = GatedSink("s3")
+    elif repl_cfg.get_bool("sink.gcs.enabled"):
+        sink = GatedSink("gcs")
+    elif repl_cfg.get_bool("sink.azure.enabled"):
+        sink = GatedSink("azure")
+    elif repl_cfg.get_bool("sink.backblaze.enabled"):
+        sink = GatedSink("backblaze")
+    else:
+        raise RuntimeError("no enabled sink in replication.toml")
+    return Replicator(source, sink)
+
+
+def run_replicate(
+    config_path: str = "",
+    poll_interval: float = 1.0,
+    stop_after_idle: float = 0.0,
+) -> int:
+    """Consume a DirQueue and replicate each event; offset checkpointed
+    next to the queue so restarts resume. stop_after_idle > 0 makes the
+    loop exit after that many idle seconds (tests / one-shot drains)."""
+    if config_path:
+        import tomllib
+
+        with open(config_path, "rb") as f:
+            repl_cfg = Configuration(tomllib.load(f))
+    else:
+        repl_cfg = load_config("replication", required=True)
+    notif_cfg = load_config("notification", required=False)
+
+    from seaweedfs_tpu import notification
+
+    qdir = notif_cfg.get_string("notification.dirqueue.dir", "./notifications")
+    dirqueue = notification.DirQueue(qdir)
+    replicator = build_replicator(repl_cfg)
+    offset_file = os.path.join(qdir, ".replicate_offset")
+    after = 0
+    if os.path.exists(offset_file):
+        with open(offset_file) as f:
+            after = int(f.read().strip() or "0")
+    idle_since = time.time()
+    wlog.info("filer.replicate consuming %s from seq %d", qdir, after)
+    while True:
+        progressed = False
+        for seq, key, msg in dirqueue.consume(after_seq=after):
+            try:
+                replicator.replicate(key, msg)
+            except Exception as e:  # noqa: BLE001 — keep consuming
+                wlog.error("replicate %s: %s", key, e)
+            after = seq
+            with open(offset_file, "w") as f:
+                f.write(str(after))
+            progressed = True
+        if progressed:
+            idle_since = time.time()
+        elif stop_after_idle and time.time() - idle_since > stop_after_idle:
+            return 0
+        else:
+            time.sleep(poll_interval)

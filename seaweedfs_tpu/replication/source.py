@@ -1,0 +1,41 @@
+"""Replication source: reads chunk bytes out of the source cluster.
+
+Behavioral match of weed/replication/source/filer_source.go: given a
+chunk fid, look its volume up through the source filer's LookupVolume
+and fetch the blob from a volume server."""
+
+from __future__ import annotations
+
+import grpc
+
+from seaweedfs_tpu.client import operation as op
+from seaweedfs_tpu.pb import filer_pb2 as fpb, rpc
+
+
+class FilerSource:
+    def __init__(self, grpc_address: str, directory: str = "/"):
+        # grpc_address is "host:httpPort" — the +10000 convention applies
+        self.filer = grpc_address
+        self.dir = directory.rstrip("/") or "/"
+        self._channel: grpc.Channel | None = None
+
+    def _stub(self):
+        if self._channel is None:
+            self._channel = grpc.insecure_channel(rpc.grpc_address(self.filer))
+        return rpc.filer_stub(self._channel)
+
+    def lookup_file_url(self, fid: str) -> str:
+        vid = fid.split(",")[0]
+        resp = self._stub().LookupVolume(fpb.LookupVolumeRequest(volume_ids=[vid]))
+        locs = resp.locations_map.get(vid)
+        if locs is None or not locs.locations:
+            raise RuntimeError(f"volume {vid} not found via filer {self.filer}")
+        return f"{locs.locations[0].url}/{fid}"
+
+    def read_chunk(self, fid: str) -> bytes:
+        data, _ = op.download(self.lookup_file_url(fid))
+        return data
+
+    def close(self) -> None:
+        if self._channel is not None:
+            self._channel.close()

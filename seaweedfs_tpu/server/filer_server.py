@@ -33,6 +33,30 @@ from seaweedfs_tpu.pb import filer_pb2 as fpb
 from seaweedfs_tpu.pb import rpc
 
 
+def _queue_publisher():
+    """Default on_event: publish EventNotifications to the process
+    notification queue when one is configured (filer_notify.go:9-39).
+    Returns None when no queue is set so the filer skips the work."""
+    from seaweedfs_tpu import notification
+
+    if notification.queue is None:
+        return None
+
+    def publish(old, new, delete_chunks: bool) -> None:
+        if notification.queue is None:  # queue torn down after start
+            return
+        key = (old or new).full_path
+        msg = fpb.EventNotification(delete_chunks=delete_chunks)
+        if old is not None:
+            msg.old_entry.CopyFrom(old.to_pb())
+        if new is not None:
+            msg.new_entry.CopyFrom(new.to_pb())
+            msg.new_parent_path = new.directory
+        notification.queue.send_message(key, msg)
+
+    return publish
+
+
 class FilerServer:
     def __init__(
         self,
@@ -53,7 +77,11 @@ class FilerServer:
         self.collection = collection
         self.replication = replication
         self.max_mb = max_mb
-        self.filer = Filer(new_store(store, store_path), masters, on_event=on_event)
+        self.filer = Filer(
+            new_store(store, store_path),
+            masters,
+            on_event=on_event or _queue_publisher(),
+        )
         self._grpc_server: grpc.Server | None = None
         self._http_server: ThreadingHTTPServer | None = None
 

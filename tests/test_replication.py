@@ -1,0 +1,197 @@
+"""Notification queues + cross-cluster replication tests: queue units,
+then a live source-cluster → sink-cluster replication pass (the
+reference covers this only via manual docker-compose; SURVEY §4)."""
+
+import os
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu import notification
+from seaweedfs_tpu.pb import filer_pb2 as fpb
+from seaweedfs_tpu.replication.replicator import Replicator
+from seaweedfs_tpu.replication.sink import FilerSink, LocalSink
+from seaweedfs_tpu.replication.source import FilerSource
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.util.config import Configuration
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _event(key_old=None, key_new=None, chunks=()):
+    msg = fpb.EventNotification()
+    if key_old:
+        msg.old_entry.name = key_old
+    if key_new:
+        msg.new_entry.name = key_new
+        for fid in chunks:
+            msg.new_entry.chunks.add(fid=fid, size=1)
+    return msg
+
+
+class TestQueues:
+    def test_memory_queue(self):
+        q = notification.MemoryQueue()
+        q.send_message("/a", _event(key_new="a"))
+        got = q.receive(timeout=1)
+        assert got is not None
+        key, msg = got
+        assert key == "/a"
+        assert msg.new_entry.name == "a"
+        assert q.receive(timeout=0.01) is None
+
+    def test_dir_queue_durable_ordering(self, tmp_path):
+        q = notification.DirQueue(str(tmp_path))
+        for i in range(5):
+            q.send_message(f"/k{i}", _event(key_new=f"e{i}"))
+        got = list(q.consume())
+        assert [k for _, k, _ in got] == [f"/k{i}" for i in range(5)]
+        # a new instance over the same dir continues the sequence
+        q2 = notification.DirQueue(str(tmp_path))
+        q2.send_message("/k5", _event(key_new="e5"))
+        seqs = [s for s, _, _ in q2.consume()]
+        assert seqs == sorted(seqs) and len(seqs) == 6
+        # offset-based resume
+        tail = list(q2.consume(after_seq=seqs[3]))
+        assert [k for _, k, _ in tail] == ["/k4", "/k5"]
+
+    def test_configure_from_toml(self, tmp_path):
+        cfg = Configuration(
+            {"notification": {"dirqueue": {"enabled": True, "dir": str(tmp_path / "q")}}},
+            env={},
+        )
+        q = notification.configure(cfg)
+        assert isinstance(q, notification.DirQueue)
+        notification.queue = None
+
+    def test_gated_queue_raises(self):
+        cfg = Configuration(
+            {"notification": {"kafka": {"enabled": True}}}, env={}
+        )
+        with pytest.raises(RuntimeError, match="kafka"):
+            notification.configure(cfg)
+        notification.queue = None
+
+
+@pytest.fixture()
+def two_clusters(tmp_path_factory):
+    """source (master+volume+filer, dirqueue notifications) and sink
+    (master+volume+filer) clusters."""
+    qdir = str(tmp_path_factory.mktemp("queue"))
+    notification.queue = notification.DirQueue(qdir)
+    stacks = []
+    try:
+        filers = []
+        for name in ("src", "dst"):
+            mport = free_port()
+            master = MasterServer(port=mport, volume_size_limit_mb=64)
+            master.start()
+            vs = VolumeServer(
+                [str(tmp_path_factory.mktemp(f"{name}vol"))],
+                port=free_port(),
+                master=f"127.0.0.1:{mport}",
+                heartbeat_interval=0.2,
+                max_volume_counts=[20],
+            )
+            vs.start()
+            fport = free_port()
+            filer = FilerServer(
+                [f"127.0.0.1:{mport}"], port=fport, store="memory"
+            )
+            filer.start()
+            stacks.extend([filer, vs, master])
+            deadline = time.time() + 10
+            while time.time() < deadline and not master.topology.data_nodes():
+                time.sleep(0.05)
+            filers.append(f"127.0.0.1:{fport}")
+            if name == "src":
+                # only the source publishes events
+                notification.queue = None
+        notification.queue = None
+        yield filers[0], filers[1], qdir
+    finally:
+        notification.queue = None
+        for s in stacks:
+            s.stop()
+
+
+def _drain(qdir: str, replicator: Replicator) -> int:
+    q = notification.DirQueue(qdir)
+    n = 0
+    for _, key, msg in q.consume():
+        replicator.replicate(key, msg)
+        n += 1
+    return n
+
+
+class TestReplicationEndToEnd:
+    def _post(self, filer, path, data):
+        req = urllib.request.Request(
+            f"http://{filer}{path}", data=data, method="POST"
+        )
+        urllib.request.urlopen(req, timeout=10).close()
+
+    def _get(self, filer, path) -> bytes:
+        with urllib.request.urlopen(f"http://{filer}{path}", timeout=10) as r:
+            return r.read()
+
+    def test_filer_sink_create_and_delete(self, two_clusters):
+        src_filer, dst_filer, qdir = two_clusters
+        # re-arm the queue for the source writes below
+        notification.queue = notification.DirQueue(qdir)
+        try:
+            src_stack_payload = b"replicate-me " * 1000
+            self._post(src_filer, "/buckets/docs/a.txt", src_stack_payload)
+            self._post(src_filer, "/buckets/docs/b.txt", b"second-file")
+        finally:
+            notification.queue = None
+
+        source = FilerSource(src_filer, directory="/buckets")
+        sink = FilerSink(dst_filer, directory="/backup")
+        replicator = Replicator(source, sink)
+        assert _drain(qdir, replicator) >= 2
+        assert self._get(dst_filer, "/backup/docs/a.txt") == src_stack_payload
+        assert self._get(dst_filer, "/backup/docs/b.txt") == b"second-file"
+
+        # delete propagates
+        notification.queue = notification.DirQueue(qdir)
+        try:
+            req = urllib.request.Request(
+                f"http://{src_filer}/buckets/docs/b.txt", method="DELETE"
+            )
+            urllib.request.urlopen(req, timeout=10).close()
+        finally:
+            notification.queue = None
+        # replay only the tail (skip already-applied events)
+        q = notification.DirQueue(qdir)
+        events = list(q.consume())
+        last_seq, last_key, last_msg = events[-1]
+        replicator.replicate(last_key, last_msg)
+        with pytest.raises(urllib.error.HTTPError):
+            self._get(dst_filer, "/backup/docs/b.txt")
+        source.close()
+        sink.close()
+
+    def test_local_sink(self, two_clusters, tmp_path):
+        src_filer, _, qdir = two_clusters
+        notification.queue = notification.DirQueue(qdir)
+        try:
+            self._post(src_filer, "/buckets/imgs/x.bin", b"local-sink-bytes")
+        finally:
+            notification.queue = None
+        source = FilerSource(src_filer, directory="/buckets")
+        sink = LocalSink(str(tmp_path / "mirror"))
+        _drain(qdir, Replicator(source, sink))
+        assert (tmp_path / "mirror/imgs/x.bin").read_bytes() == b"local-sink-bytes"
+        source.close()
+
+
+import urllib.error  # noqa: E402
