@@ -1,0 +1,3 @@
+from seaweedfs_tpu.webdav.webdav_server import WebDavServer
+
+__all__ = ["WebDavServer"]

@@ -1,0 +1,160 @@
+"""WebDAV gateway tests over a live master+volume+filer stack, using
+http.client for the non-standard DAV verbs."""
+
+import http.client
+import socket
+import time
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.webdav.webdav_server import WebDavServer
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def dav(tmp_path_factory):
+    mport = free_port()
+    master = MasterServer(port=mport, volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer(
+        [str(tmp_path_factory.mktemp("davvol"))],
+        port=free_port(),
+        master=f"127.0.0.1:{mport}",
+        heartbeat_interval=0.2,
+        max_volume_counts=[20],
+    )
+    vs.start()
+    fport = free_port()
+    filer = FilerServer([f"127.0.0.1:{mport}"], port=fport, store="memory")
+    filer.start()
+    dport = free_port()
+    wd = WebDavServer(filer=f"127.0.0.1:{fport}", port=dport)
+    wd.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topology.data_nodes():
+        time.sleep(0.05)
+    yield dport
+    wd.stop()
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def dav_req(port, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    conn.request(method, path, body=body, headers=headers or {})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data, dict(resp.getheaders())
+
+
+def strip_ns(root):
+    for el in root.iter():
+        if "}" in el.tag:
+            el.tag = el.tag.split("}", 1)[1]
+    return root
+
+
+class TestWebDav:
+    def test_options_advertises_dav(self, dav):
+        status, _, headers = dav_req(dav, "OPTIONS", "/")
+        assert status == 200
+        assert "1,2" in headers["DAV"]
+        assert "PROPFIND" in headers["Allow"]
+
+    def test_mkcol_put_get(self, dav):
+        status, _, _ = dav_req(dav, "MKCOL", "/docs")
+        assert status == 201
+        # MKCOL on existing → 405
+        status, _, _ = dav_req(dav, "MKCOL", "/docs")
+        assert status == 405
+        status, _, _ = dav_req(dav, "PUT", "/docs/readme.txt", body=b"dav content",
+                               headers={"Content-Type": "text/plain"})
+        assert status == 201
+        status, data, headers = dav_req(dav, "GET", "/docs/readme.txt")
+        assert status == 200
+        assert data == b"dav content"
+        assert headers["Content-Type"] == "text/plain"
+
+    def test_propfind_depth(self, dav):
+        dav_req(dav, "MKCOL", "/tree")
+        dav_req(dav, "PUT", "/tree/a.bin", body=b"12345")
+        dav_req(dav, "PUT", "/tree/b.bin", body=b"xy")
+        status, body, _ = dav_req(dav, "PROPFIND", "/tree", headers={"Depth": "1"})
+        assert status == 207
+        root = strip_ns(ET.fromstring(body))
+        hrefs = [r.findtext("href") for r in root.iter("response")]
+        assert "/tree/" in hrefs
+        assert "/tree/a.bin" in hrefs and "/tree/b.bin" in hrefs
+        sizes = {
+            r.findtext("href"): r.findtext("propstat/prop/getcontentlength")
+            for r in root.iter("response")
+        }
+        assert sizes["/tree/a.bin"] == "5"
+        # depth 0: only the collection itself
+        status, body, _ = dav_req(dav, "PROPFIND", "/tree", headers={"Depth": "0"})
+        root = strip_ns(ET.fromstring(body))
+        assert len(list(root.iter("response"))) == 1
+        # collections carry <collection/>
+        assert root.find("response/propstat/prop/resourcetype/collection") is not None
+
+    def test_propfind_missing_404(self, dav):
+        status, _, _ = dav_req(dav, "PROPFIND", "/nope", headers={"Depth": "0"})
+        assert status == 404
+
+    def test_move(self, dav):
+        dav_req(dav, "MKCOL", "/mv")
+        dav_req(dav, "PUT", "/mv/old.txt", body=b"move-me")
+        status, _, _ = dav_req(
+            dav, "MOVE", "/mv/old.txt",
+            headers={"Destination": "/mv/new.txt"},
+        )
+        assert status == 201
+        status, data, _ = dav_req(dav, "GET", "/mv/new.txt")
+        assert data == b"move-me"
+        status, _, _ = dav_req(dav, "GET", "/mv/old.txt")
+        assert status == 404
+
+    def test_copy(self, dav):
+        dav_req(dav, "MKCOL", "/cp")
+        dav_req(dav, "PUT", "/cp/src.txt", body=b"copy-me")
+        status, _, _ = dav_req(
+            dav, "COPY", "/cp/src.txt", headers={"Destination": "/cp/dst.txt"}
+        )
+        assert status == 201
+        _, data, _ = dav_req(dav, "GET", "/cp/dst.txt")
+        assert data == b"copy-me"
+        _, data, _ = dav_req(dav, "GET", "/cp/src.txt")
+        assert data == b"copy-me"
+
+    def test_delete(self, dav):
+        dav_req(dav, "MKCOL", "/rm")
+        dav_req(dav, "PUT", "/rm/f.txt", body=b"bye")
+        status, _, _ = dav_req(dav, "DELETE", "/rm/f.txt")
+        assert status == 204
+        status, _, _ = dav_req(dav, "GET", "/rm/f.txt")
+        assert status == 404
+        # recursive collection delete
+        dav_req(dav, "PUT", "/rm/deep.txt", body=b"x")
+        status, _, _ = dav_req(dav, "DELETE", "/rm")
+        assert status == 204
+        status, _, _ = dav_req(dav, "PROPFIND", "/rm", headers={"Depth": "0"})
+        assert status == 404
+
+    def test_lock_unlock(self, dav):
+        dav_req(dav, "PUT", "/locked.txt", body=b"v1")
+        status, body, headers = dav_req(dav, "LOCK", "/locked.txt")
+        assert status == 200
+        assert "opaquelocktoken" in headers["Lock-Token"]
+        status, _, _ = dav_req(dav, "UNLOCK", "/locked.txt")
+        assert status == 204
