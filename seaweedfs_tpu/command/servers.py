@@ -97,6 +97,13 @@ class VolumeCommand(Command):
         p.add_argument("-rack", default="")
         p.add_argument("-publicUrl", default="")
         p.add_argument("-readRedirect", action="store_true")
+        p.add_argument(
+            "-ec.codec",
+            dest="ec_codec",
+            default="",
+            choices=("", "cpu", "tpu"),
+            help="EC codec backend; empty = auto (tpu when a JAX device is present)",
+        )
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
@@ -118,6 +125,7 @@ class VolumeCommand(Command):
             max_volume_counts=maxes,
             read_redirect=args.readRedirect,
             guard=_load_guard(),
+            ec_codec=args.ec_codec,
         )
         server.start()
         wlog.info("volume server %s:%d -> master %s", args.ip, args.port, args.mserver)
@@ -263,6 +271,13 @@ class ServerCommand(Command):
         p.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
         p.add_argument("-webdav", action="store_true")
         p.add_argument("-webdav.port", dest="webdav_port", type=int, default=7333)
+        p.add_argument(
+            "-ec.codec",
+            dest="ec_codec",
+            default="",
+            choices=("", "cpu", "tpu"),
+            help="EC codec backend; empty = auto (tpu when a JAX device is present)",
+        )
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
@@ -294,6 +309,7 @@ class ServerCommand(Command):
             rack=args.rack,
             max_volume_counts=maxes,
             guard=guard,
+            ec_codec=args.ec_codec,
         )
         volume.start()
         started.append(volume)

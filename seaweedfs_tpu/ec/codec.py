@@ -62,6 +62,43 @@ def cpu_apply_matrix(matrix: np.ndarray, inputs: np.ndarray) -> np.ndarray:
 register_backend("cpu", cpu_apply_matrix)
 
 
+# --- default-backend selection (the `ec.codec` config key) -----------------
+#
+# An explicit backend= argument always wins (servers thread their
+# -ec.codec flag down through Store → DiskLocation → EcVolume). When no
+# backend is given, the WEED_EC_CODEC env var (viper idiom for
+# `ec.codec`) decides; otherwise auto-detect: tpu only when an
+# accelerator device is actually attached, cpu on plain hosts (the
+# numpy LUT path beats XLA-on-CPU for this workload). Both backends are
+# byte-identical; selection is purely a performance choice, so a
+# process-wide cached default is safe.
+
+_default_backend = ""  # "" = undecided; resolved lazily
+
+
+def default_backend() -> str:
+    global _default_backend
+    import os
+
+    env = os.environ.get("WEED_EC_CODEC", "").strip().lower()
+    if env:
+        if env != "tpu" and env not in _BACKENDS:
+            raise ValueError(
+                f"WEED_EC_CODEC={env!r} is not a known EC backend "
+                f"(expected one of: cpu, tpu)"
+            )
+        return env
+    if not _default_backend:
+        try:
+            import jax
+
+            has_accel = any(d.platform != "cpu" for d in jax.devices())
+            _default_backend = "tpu" if has_accel else "cpu"
+        except Exception:
+            _default_backend = "cpu"
+    return _default_backend
+
+
 class ReedSolomon:
     """Systematic RS(k, p) codec over GF(2^8), reference-field-compatible."""
 
@@ -69,8 +106,9 @@ class ReedSolomon:
         self,
         data_shards: int = DATA_SHARDS,
         parity_shards: int = PARITY_SHARDS,
-        backend: str = "cpu",
+        backend: str | None = None,
     ):
+        backend = backend or default_backend()
         if data_shards <= 0 or parity_shards <= 0:
             raise ValueError("shard counts must be positive")
         if data_shards + parity_shards > 256:
@@ -205,7 +243,10 @@ class ReedSolomon:
 def new_encoder(
     data_shards: int = DATA_SHARDS,
     parity_shards: int = PARITY_SHARDS,
-    backend: str = "cpu",
+    backend: str | None = None,
 ) -> ReedSolomon:
-    """Factory mirroring reedsolomon.New(10, 4) (ec_encoder.go:193)."""
+    """Factory mirroring reedsolomon.New(10, 4) (ec_encoder.go:193).
+
+    backend=None picks the process default (`ec.codec` config): tpu on
+    hosts with a JAX device, cpu otherwise."""
     return ReedSolomon(data_shards, parity_shards, backend)

@@ -102,10 +102,28 @@ def _cached_bits(matrix: np.ndarray) -> jnp.ndarray:
     return bits
 
 
+def _bucket_len(n: int) -> int:
+    """Round a byte-stream length up to a power of two (min 1 KiB).
+
+    The serving path calls the codec with arbitrary needle-interval
+    sizes; jit specializes per shape, so bucketing caps compilation at
+    ~log2(max) variants instead of one per distinct request size."""
+    return max(1024, 1 << (n - 1).bit_length())
+
+
 def tpu_apply_matrix(matrix: np.ndarray, inputs: np.ndarray) -> np.ndarray:
-    """Host-interop backend for codec.ReedSolomon: numpy in, numpy out."""
+    """Host-interop backend for codec.ReedSolomon: numpy in, numpy out.
+
+    Zero-pads the stream dim to a size bucket (GF math is positionwise,
+    so padding never changes the first n output bytes)."""
+    n = inputs.shape[1]
+    nb = _bucket_len(n)
+    if nb != n:
+        padded = np.zeros((inputs.shape[0], nb), dtype=np.uint8)
+        padded[:, :n] = inputs
+        inputs = padded
     out = apply_matrix_bits(_cached_bits(matrix), jnp.asarray(inputs))
-    return np.asarray(jax.device_get(out))
+    return np.asarray(jax.device_get(out))[:, :n]
 
 
 register_backend("tpu", tpu_apply_matrix)

@@ -73,7 +73,13 @@ class EcVolumeShard:
 
 
 class EcVolume:
-    def __init__(self, directory: str, vid: int, collection: str = ""):
+    def __init__(
+        self,
+        directory: str,
+        vid: int,
+        collection: str = "",
+        backend: str | None = None,
+    ):
         self.volume_id = vid
         self.collection = collection
         self.directory = directory
@@ -81,13 +87,22 @@ class EcVolume:
         self.shards: dict[int, EcVolumeShard] = {}
         self._ecx: SortedNeedleMap | None = None
         self._ecx_version = 0  # bumped on deletes to refresh the mmap
+        # codec backend for degraded-read reconstruction (the `ec.codec`
+        # config, threaded down from the server; None = process default)
+        self.backend = backend
         self._rs: ReedSolomon | None = None
         self.version = 3
 
     # --- mounting (disk_location_ec.go) ---
     @classmethod
-    def load(cls, directory: str, vid: int, collection: str = "") -> "EcVolume":
-        ev = cls(directory, vid, collection)
+    def load(
+        cls,
+        directory: str,
+        vid: int,
+        collection: str = "",
+        backend: str | None = None,
+    ) -> "EcVolume":
+        ev = cls(directory, vid, collection, backend=backend)
         for shard_id in range(ec_files.TOTAL_SHARDS):
             path = ev.base_name + ec_files.to_ext(shard_id)
             if os.path.exists(path):
@@ -113,7 +128,7 @@ class EcVolume:
     @property
     def rs(self) -> ReedSolomon:
         if self._rs is None:
-            self._rs = new_encoder()
+            self._rs = new_encoder(backend=self.backend)
         return self._rs
 
     # --- index ---

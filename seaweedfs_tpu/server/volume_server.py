@@ -80,8 +80,15 @@ class VolumeServer:
         heartbeat_interval: float = 2.0,
         read_redirect: bool = False,
         guard=None,
+        ec_codec: str = "",
     ):
-        self.store = Store(directories, max_volume_counts)
+        # `ec.codec` config: "cpu" | "tpu" | "" (auto: tpu when a JAX
+        # device is present). Threaded into every server-side EC code
+        # path — generate (ec_encoder.go:173 enc.Encode), rebuild, decode
+        # back to a volume, and degraded-read reconstruction
+        # (store_ec.go:364 enc.ReconstructData).
+        self.ec_codec = ec_codec or None
+        self.store = Store(directories, max_volume_counts, ec_backend=self.ec_codec)
         self.host = host
         self.port = port
         self.grpc_port = port + 10000
@@ -359,18 +366,23 @@ class VolumeServer:
                 return base
         return volume_base_name(self.store.locations[0].directory, collection, vid)
 
+    def _new_rs(self):
+        from seaweedfs_tpu.ec.codec import new_encoder
+
+        return new_encoder(backend=self.ec_codec)
+
     def VolumeEcShardsGenerate(self, req, context):
         v = self.store.find_volume(req.volume_id)
         if v is None:
             context.abort(grpc.StatusCode.NOT_FOUND, f"volume {req.volume_id} not found")
         base = v.base_name
-        ec_files.write_ec_files(base)
+        ec_files.write_ec_files(base, rs=self._new_rs())
         ec_files.write_sorted_file_from_idx(base)
         return pb.VolumeEcShardsGenerateResponse()
 
     def VolumeEcShardsRebuild(self, req, context):
         base = self._base_name(req.collection, req.volume_id)
-        rebuilt = ec_files.rebuild_ec_files(base)
+        rebuilt = ec_files.rebuild_ec_files(base, rs=self._new_rs())
         return pb.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
 
     def VolumeEcShardsCopy(self, req: pb.VolumeEcShardsCopyRequest, context):
@@ -465,7 +477,7 @@ class VolumeServer:
         # ensure all shards present locally
         missing = [i for i in range(14) if i not in ev.shards]
         if missing:
-            ec_files.rebuild_ec_files(base)
+            ec_files.rebuild_ec_files(base, rs=self._new_rs())
         ec_files.write_idx_file_from_ec_index(base)
         dat_size = ec_files.find_dat_file_size(base, ev.version)
         with open(base + ".dat", "wb") as out:

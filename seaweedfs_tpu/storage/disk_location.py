@@ -36,9 +36,15 @@ def parse_ec_shard_file_name(name: str) -> tuple[str, int, int] | None:
 
 
 class DiskLocation:
-    def __init__(self, directory: str, max_volume_count: int = 7):
+    def __init__(
+        self,
+        directory: str,
+        max_volume_count: int = 7,
+        ec_backend: str | None = None,
+    ):
         self.directory = directory
         self.max_volume_count = max_volume_count
+        self.ec_backend = ec_backend  # `ec.codec` for EC volumes here
         self.volumes: dict[int, Volume] = {}
         # vid -> EcVolume; populated by load_existing_volumes and the
         # EC mount RPCs (seaweedfs_tpu/ec/ec_volume.py)
@@ -76,7 +82,7 @@ class DiskLocation:
                 continue
             try:
                 self.ec_volumes[vid] = EcVolume.load(
-                    self.directory, vid, collection
+                    self.directory, vid, collection, backend=self.ec_backend
                 )
             except (OSError, ValueError):
                 continue

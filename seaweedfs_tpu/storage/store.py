@@ -68,10 +68,17 @@ class Heartbeat:
 
 
 class Store:
-    def __init__(self, directories: list[str], max_volume_counts: list[int] | None = None):
+    def __init__(
+        self,
+        directories: list[str],
+        max_volume_counts: list[int] | None = None,
+        ec_backend: str | None = None,
+    ):
         counts = max_volume_counts or [7] * len(directories)
+        self.ec_backend = ec_backend  # `ec.codec`: cpu | tpu | None=auto
         self.locations = [
-            DiskLocation(d, c) for d, c in zip(directories, counts)
+            DiskLocation(d, c, ec_backend=ec_backend)
+            for d, c in zip(directories, counts)
         ]
         for loc in self.locations:
             loc.load_existing_volumes()
@@ -186,7 +193,7 @@ class Store:
         ev = self.find_ec_volume(vid)
         if ev is None:
             loc = self.locations[0]
-            ev = EcVolume(loc.directory, vid, collection)
+            ev = EcVolume(loc.directory, vid, collection, backend=self.ec_backend)
             loc.ec_volumes[vid] = ev
         for sid in shard_ids:
             ev.mount_shard(sid)

@@ -24,6 +24,12 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Unit tests default to the cpu codec (fast, no per-shape jit compiles);
+# the TPU serving path is covered explicitly by tests that pass
+# ec_codec="tpu" / backend="tpu" (e.g. test_ec_tpu_serving.py), which
+# overrides this env default.
+os.environ.setdefault("WEED_EC_CODEC", "cpu")
+
 import pathlib
 
 import pytest
