@@ -1,0 +1,59 @@
+"""Kernel variants with tunnel-latency-corrected timing.
+
+Times K chained iterations vs 1, reports marginal per-iter throughput.
+Chains iterations through a data dependency (feed output back into a
+dummy xor with the input) so the runtime cannot overlap/dedupe them.
+"""
+import functools, time
+import jax, jax.numpy as jnp
+import numpy as np
+from experiments.kernel_variants import fused_apply, build_perm_bits, K, P
+from seaweedfs_tpu.ec import gf256
+from seaweedfs_tpu.ec.codec_tpu import TpuCodecKernels
+
+SHARD = 64 * 1024 * 1024
+
+
+def marginal(fn, data, iters=8):
+    """fn: data -> parity. Chain: data ^= broadcast of parity row 0."""
+    @jax.jit
+    def step(d):
+        par = fn(d)
+        # cheap dependency: xor first parity row into shard 0
+        return d.at[0].set(d[0] ^ par[0])
+
+    def run(k):
+        d = data
+        for _ in range(k):
+            d = step(d)
+        return int(jax.device_get(d[0, 0]))
+
+    run(1)  # warm
+    t0 = time.perf_counter(); run(1); t1 = time.perf_counter() - t0
+    t0 = time.perf_counter(); run(1 + iters); t2 = time.perf_counter() - t0
+    return (t2 - t1) / iters
+
+
+def main():
+    data = jax.random.randint(jax.random.PRNGKey(0), (K, SHARD), 0, 256,
+                              dtype=jnp.int32).astype(jnp.uint8)
+    jax.block_until_ready(data)
+    payload = K * SHARD
+
+    probe = lambda d: d[:4] ^ jnp.uint8(1)
+    t = marginal(probe, data)
+    print(f"probe(read 10N+write4N): {14*SHARD/t/1e9:9.1f} GB/s traffic")
+
+    kern = TpuCodecKernels(K, P)
+    t = marginal(kern.encode, data)
+    print(f"xla-unfused   : {payload/t/1e9:8.2f} GB/s payload")
+
+    matrix = gf256.build_code_matrix(K, K + P)
+    a_perm = jnp.asarray(build_perm_bits(matrix[K:], K))
+    for tn in (8192, 16384, 32768, 65536):
+        t = marginal(lambda d: fused_apply(a_perm, d, tn=tn), data)
+        print(f"pallas tn={tn:6d}: {payload/t/1e9:8.2f} GB/s payload")
+
+
+if __name__ == "__main__":
+    main()

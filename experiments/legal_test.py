@@ -1,0 +1,31 @@
+"""Which packed-i8 vector ops does Mosaic legalize?"""
+import functools
+import jax, jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TN = 1024
+
+def try_kernel(name, body):
+    def kern(x_ref, o_ref):
+        o_ref[:] = body(x_ref[:])
+    try:
+        f = pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((8, TN), jnp.int8),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )
+        x = jnp.arange(8 * TN, dtype=jnp.int32).reshape(8, TN).astype(jnp.uint8)
+        out = np.asarray(jax.jit(f)(x))
+        print(f"{name:30s} OK   sample={out[0,:6]}")
+    except Exception as e:
+        msg = str(e).split("\n")[0][:100]
+        print(f"{name:30s} FAIL {msg}")
+
+try_kernel("and_i8", lambda x: (x & jnp.uint8(4)).astype(jnp.int8))
+try_kernel("cmp_ne_i8", lambda x: ((x & jnp.uint8(4)) != 0).astype(jnp.int8))
+try_kernel("cmp_eq_i8", lambda x: ((x & jnp.uint8(4)) == jnp.uint8(4)).astype(jnp.int8))
+try_kernel("min_i8", lambda x: jnp.minimum(x & jnp.uint8(4), jnp.uint8(1)).astype(jnp.int8))
+try_kernel("mul_i8", lambda x: ((x & jnp.uint8(1)) * jnp.uint8(3)).astype(jnp.int8))

@@ -1,0 +1,45 @@
+"""Validate timing methodology on the tunneled TPU.
+
+Checks whether repeated dispatch of the same (fn, args) is deduplicated
+by the runtime (which would inflate throughput numbers) by comparing:
+  a) 1 call vs N identical calls
+  b) N calls on N distinct buffers
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+N = 64 * 1024 * 1024
+
+
+def t(fn, args_list):
+    out = fn(args_list[0])
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    outs = [fn(a) for a in args_list]
+    jax.block_until_ready(outs)
+    return time.perf_counter() - t0
+
+
+def main():
+    xs = [
+        jax.random.randint(jax.random.PRNGKey(i), (10, N), 0, 256,
+                           dtype=jnp.int32).astype(jnp.uint8)
+        for i in range(4)
+    ]
+    jax.block_until_ready(xs)
+    probe = jax.jit(lambda x: x ^ jnp.uint8(1))
+
+    t1 = t(probe, [xs[0]])
+    t4_same = t(probe, [xs[0]] * 4)
+    t4_diff = t(probe, xs)
+    tr = 2 * 10 * N
+    print(f"probe 1 call        : {t1*1e3:8.3f} ms  {tr/t1/1e9:9.1f} GB/s traffic")
+    print(f"probe 4 same calls  : {t4_same*1e3:8.3f} ms  {4*tr/t4_same/1e9:9.1f} GB/s")
+    print(f"probe 4 diff calls  : {t4_diff*1e3:8.3f} ms  {4*tr/t4_diff/1e9:9.1f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

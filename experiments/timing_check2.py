@@ -1,0 +1,32 @@
+"""Timing with a real host-side sync: fetch a scalar reduction."""
+import time
+import jax, jax.numpy as jnp
+import numpy as np
+
+N = 64 * 1024 * 1024
+
+def main():
+    xs = [jax.random.randint(jax.random.PRNGKey(i), (10, N), 0, 256,
+                             dtype=jnp.int32).astype(jnp.uint8) for i in range(4)]
+    jax.block_until_ready(xs)
+    probe = jax.jit(lambda x: x ^ jnp.uint8(1))
+    red = jax.jit(lambda ys: sum(y[0, 0].astype(jnp.int32) for y in ys))
+
+    def t(args_list):
+        outs = [probe(a) for a in args_list]
+        _ = int(red(outs))  # warm compile of reducer
+        t0 = time.perf_counter()
+        outs = [probe(a) for a in args_list]
+        _ = int(red(outs))
+        return time.perf_counter() - t0
+
+    tr = 2 * 10 * N
+    t1 = t([xs[0]])
+    t4s = t([xs[0]] * 4)
+    t4d = t(xs)
+    print(f"1 call   : {t1*1e3:8.3f} ms {tr/t1/1e9:9.1f} GB/s traffic")
+    print(f"4 same   : {t4s*1e3:8.3f} ms {4*tr/t4s/1e9:9.1f} GB/s")
+    print(f"4 diff   : {t4d*1e3:8.3f} ms {4*tr/t4d/1e9:9.1f} GB/s")
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,7 @@ SIGN_V4_ALGORITHM = "AWS4-HMAC-SHA256"
 UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
 STREAMING_PAYLOAD = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
 MAX_CLOCK_SKEW_SEC = 15 * 60
+MAX_PRESIGNED_EXPIRES_SEC = 7 * 24 * 3600
 
 
 def _hmac(key: bytes, msg: str) -> bytes:
@@ -169,11 +170,21 @@ class IdentityAccessManagement:
             raise s3_error("MissingFields") from None
         access_key, date, region, service = _parse_credential(credential)
         ident = self.lookup(access_key)
-        _check_skew(amz_date)
-        expires = int(query.get("X-Amz-Expires", ["900"])[0])
-        t = datetime.datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(
-            tzinfo=datetime.timezone.utc
-        )
+        # Presigned URLs are bounded by their own expiry window, not the
+        # 15-minute header-auth skew check (X-Amz-Expires may validly be
+        # up to 7 days).
+        try:
+            expires = int(query.get("X-Amz-Expires", ["900"])[0])
+        except ValueError:
+            raise s3_error("AuthorizationQueryParametersError") from None
+        if not 1 <= expires <= MAX_PRESIGNED_EXPIRES_SEC:
+            raise s3_error("AuthorizationQueryParametersError")
+        try:
+            t = datetime.datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(
+                tzinfo=datetime.timezone.utc
+            )
+        except ValueError:
+            raise s3_error("AuthorizationHeaderMalformed") from None
         now = datetime.datetime.now(datetime.timezone.utc)
         if now > t + datetime.timedelta(seconds=expires):
             raise s3_error("AccessDenied")

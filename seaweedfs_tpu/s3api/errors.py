@@ -96,6 +96,16 @@ ERRORS = {
         "NotImplemented", 501, "A header you provided implies functionality "
         "that is not implemented"
     ),
+    "AuthorizationQueryParametersError": _err(
+        "AuthorizationQueryParametersError",
+        400,
+        "X-Amz-Expires must be an integer between 1 and 604800 seconds.",
+    ),
+    "InvalidArgument": _err(
+        "InvalidArgument",
+        400,
+        "Part number must be an integer between 1 and 10000, inclusive",
+    ),
     "RequestTimeTooSkewed": _err(
         "RequestTimeTooSkewed",
         403,

@@ -265,6 +265,11 @@ class S3ApiServer:
                     body = self._read_body()
                     sha_hdr = self.headers.get("x-amz-content-sha256", "")
                     if sha_hdr == s3auth.STREAMING_PAYLOAD:
+                        # Verify the header V4 signature (method, path,
+                        # headers, date — payload hash is the STREAMING
+                        # sentinel) BEFORE trusting the seed signature
+                        # the per-chunk signatures chain from.
+                        self._authenticate(None)
                         body = self._decode_streaming(body)
                     else:
                         self._authenticate(body)
@@ -493,24 +498,65 @@ class S3ApiServer:
                     directory += "/" + dir_part.rstrip("/")
                 rel_marker = marker[len(dir_part):] if marker.startswith(dir_part) else marker
 
-                entries = server._list(
-                    directory,
-                    prefix=name_prefix,
-                    start=rel_marker,
-                    inclusive=False,
-                    limit=min(max_keys, MAX_OBJECT_LIST_SIZE) + 1,
-                )
-                truncated = len(entries) > max_keys
-                entries = entries[:max_keys]
+                limit = min(max_keys, MAX_OBJECT_LIST_SIZE)
                 contents, common = [], []
+                keys = []
                 last = ""
-                for e in entries:
-                    last = f"{dir_part}{e.name}"
-                    if e.is_directory:
-                        if e.name != ".uploads":
-                            common.append(f"{dir_part}{e.name}/")
-                    else:
-                        contents.append(e)
+                truncated = False
+                if delimiter == "/":
+                    entries = server._list(
+                        directory,
+                        prefix=name_prefix,
+                        start=rel_marker,
+                        inclusive=False,
+                        limit=limit + 1,
+                    )
+                    truncated = len(entries) > max_keys
+                    entries = entries[:max_keys]
+                    for e in entries:
+                        last = f"{dir_part}{e.name}"
+                        if e.is_directory:
+                            if e.name != ".uploads":
+                                common.append(f"{dir_part}{e.name}/")
+                        else:
+                            contents.append(e)
+                            keys.append(last)
+                else:
+                    # flat listing: recurse into subdirectories so nested
+                    # keys appear as Contents (S3 semantics when no
+                    # delimiter is given)
+                    def walk(dirpath, rel):
+                        nonlocal truncated
+                        sub = server._list(
+                            dirpath,
+                            prefix=name_prefix if rel == dir_part else "",
+                            limit=MAX_OBJECT_LIST_SIZE + 1,
+                        )
+                        for e in sub:
+                            if len(contents) > limit:
+                                truncated = True
+                                return
+                            k = f"{rel}{e.name}"
+                            if e.is_directory:
+                                if e.name == ".uploads" and rel == "":
+                                    continue
+                                # prune subtrees wholly <= marker
+                                if marker and not (
+                                    f"{k}/" > marker
+                                    or marker.startswith(f"{k}/")
+                                ):
+                                    continue
+                                walk(f"{dirpath}/{e.name}", f"{k}/")
+                            elif k > marker:
+                                contents.append(e)
+                                keys.append(k)
+
+                    walk(directory, dir_part)
+                    truncated = truncated or len(contents) > limit
+                    contents = contents[:limit]
+                    keys = keys[:limit]
+                    if keys:
+                        last = keys[-1]
 
                 root = ET.Element("ListBucketResult", xmlns=S3_XMLNS)
                 ET.SubElement(root, "Name").text = bucket
@@ -518,7 +564,8 @@ class S3ApiServer:
                 ET.SubElement(root, "Marker").text = marker
                 ET.SubElement(root, "NextMarker").text = last if truncated else ""
                 ET.SubElement(root, "MaxKeys").text = str(max_keys)
-                ET.SubElement(root, "Delimiter").text = delimiter or "/"
+                if delimiter:
+                    ET.SubElement(root, "Delimiter").text = delimiter
                 ET.SubElement(root, "IsTruncated").text = (
                     "true" if truncated else "false"
                 )
@@ -526,9 +573,9 @@ class S3ApiServer:
                     ET.SubElement(root, "KeyCount").text = str(len(contents))
                     if truncated:
                         ET.SubElement(root, "NextContinuationToken").text = last
-                for e in contents:
+                for e, full_key in zip(contents, keys):
                     c = ET.SubElement(root, "Contents")
-                    ET.SubElement(c, "Key").text = f"{dir_part}{e.name}"
+                    ET.SubElement(c, "Key").text = full_key
                     ET.SubElement(c, "LastModified").text = _iso(e.attributes.mtime)
                     etag = e.chunks[0].e_tag if len(e.chunks) == 1 else ""
                     ET.SubElement(c, "ETag").text = f'"{etag}"'
@@ -558,7 +605,12 @@ class S3ApiServer:
 
             def _put_object_part(self, bucket, key, query, body):
                 upload_id = query["uploadId"][0]
-                part_num = int(query["partNumber"][0])
+                try:
+                    part_num = int(query["partNumber"][0])
+                except ValueError:
+                    raise s3_error("InvalidArgument") from None
+                if not 1 <= part_num <= 10000:
+                    raise s3_error("InvalidArgument")
                 if server._lookup(server._uploads_folder(bucket), upload_id) is None:
                     raise s3_error("NoSuchUpload")
                 server._put_to_filer(
@@ -584,11 +636,15 @@ class S3ApiServer:
                     raise s3_error("NoSuchUpload")
                 # splice every part's chunks into one chunk list at
                 # running offsets (filer_multipart.go:67-84)
+                # numeric part order — lexical sort would splice part
+                # 10000 ("10000.part") between 1000 and 1001
+                parts = [
+                    e for e in entries
+                    if e.name.endswith(".part") and not e.is_directory
+                ]
                 final_chunks = []
                 offset = 0
-                for entry in sorted(entries, key=lambda e: e.name):
-                    if not entry.name.endswith(".part") or entry.is_directory:
-                        continue
+                for entry in sorted(parts, key=lambda e: int(e.name[:-5])):
                     for chunk in entry.chunks:
                         final_chunks.append(
                             fpb.FileChunk(
@@ -648,9 +704,8 @@ class S3ApiServer:
                 ET.SubElement(root, "Bucket").text = bucket
                 ET.SubElement(root, "Key").text = key
                 ET.SubElement(root, "UploadId").text = upload_id
-                for entry in sorted(entries, key=lambda e: e.name):
-                    if not entry.name.endswith(".part"):
-                        continue
+                parts = [e for e in entries if e.name.endswith(".part")]
+                for entry in sorted(parts, key=lambda e: int(e.name[:-5])):
                     p = ET.SubElement(root, "Part")
                     ET.SubElement(p, "PartNumber").text = str(
                         int(entry.name[:-5])
