@@ -254,8 +254,13 @@ class TestS3EndToEnd:
         for name in ("a.txt", "b.txt", "c.txt"):
             req(f"{base}/listb/{name}", "PUT", data=b"x").close()
         req(f"{base}/listb/sub/nested.txt", "PUT", data=b"y").close()
-        # v1
+        # v1 without a delimiter: flat recursive listing, no CommonPrefixes
         root = xml_of(req(f"{base}/listb").read())
+        keys = [c.findtext("Key") for c in root.iter("Contents")]
+        assert keys == ["a.txt", "b.txt", "c.txt", "sub/nested.txt"]
+        assert list(root.iter("CommonPrefixes")) == []
+        # v1 with delimiter=/: immediate keys + rolled-up prefixes
+        root = xml_of(req(f"{base}/listb?delimiter=/").read())
         keys = [c.findtext("Key") for c in root.iter("Contents")]
         assert keys == ["a.txt", "b.txt", "c.txt"]
         prefixes = [p.findtext("Prefix") for p in root.iter("CommonPrefixes")]
