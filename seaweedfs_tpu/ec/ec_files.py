@@ -76,6 +76,26 @@ def shard_file_size(
     return n_large * large + n_small * small
 
 
+def shard_presence(base_file_name: str) -> tuple[list[bool], list[int]]:
+    """(present flags, missing ids) over the 14 shard files."""
+    present = [
+        os.path.exists(base_file_name + to_ext(i)) for i in range(TOTAL_SHARDS)
+    ]
+    return present, [i for i, p in enumerate(present) if not p]
+
+
+def _use_stream_driver(rs: ReedSolomon) -> bool:
+    """Route to the pipelined ec_stream driver when the codec would run
+    on an attached TPU anyway — output bytes are identical; the stream
+    driver overlaps disk IO, H2D, kernel, and D2H instead of
+    round-tripping synchronously per batch."""
+    if rs._backend_name != "tpu":
+        return False
+    from seaweedfs_tpu.ec.codec_tpu import _on_tpu
+
+    return _on_tpu()
+
+
 def _read_block(f, offset: int, length: int) -> np.ndarray:
     """Read `length` bytes at `offset`, zero-padded past EOF
     (encodeDataOneBatch:158-170)."""
@@ -102,6 +122,17 @@ def write_ec_files(
     for block in (large_block_size, small_block_size):
         if block % buffer_size != 0 and buffer_size % block != 0:
             raise ValueError("buffer size must tile the block sizes")
+
+    if _use_stream_driver(rs):
+        from seaweedfs_tpu.ec import ec_stream
+
+        ec_stream.stream_write_ec_files(
+            base_file_name,
+            tile_bytes=buffer_size,
+            large_block_size=large_block_size,
+            small_block_size=small_block_size,
+        )
+        return
 
     dat_size = os.path.getsize(base_file_name + ".dat")
     n_large, n_small = shard_row_counts(dat_size, large_block_size, small_block_size)
@@ -140,10 +171,13 @@ def rebuild_ec_files(
     """Regenerate whichever .ec files are missing from the ones present
     (ec_encoder.go:83 generateMissingEcFiles). Returns rebuilt ids."""
     rs = rs or new_encoder()
-    present = [
-        os.path.exists(base_file_name + to_ext(i)) for i in range(TOTAL_SHARDS)
-    ]
-    missing = [i for i, p in enumerate(present) if not p]
+    if _use_stream_driver(rs):
+        from seaweedfs_tpu.ec import ec_stream
+
+        return ec_stream.stream_rebuild_ec_files(
+            base_file_name, tile_bytes=buffer_size
+        )
+    present, missing = shard_presence(base_file_name)
     if not missing:
         return []
     if sum(present) < rs.data_shards:
