@@ -321,17 +321,7 @@ class TpuCodecKernels:
         cached = self._decode_rows_cache.get(key)
         if cached is not None:
             return cached
-        k = self.data_shards
-        sub = gf256.sub_matrix_for_survivors(self.matrix, list(survivors))
-        inv = gf256.mat_inv(sub)  # [k, k]: survivors → data shards
-        rows = []
-        for t in targets:
-            if t < k:
-                rows.append(inv[t])
-            else:
-                # parity row in terms of data, composed with inv
-                rows.append(gf256.mat_mul(self.matrix[t : t + 1], inv)[0])
-        stacked = np.stack(rows)
+        stacked = gf256.decode_rows(self.matrix, survivors, targets)
         self._decode_rows_cache[key] = stacked
         return stacked
 

@@ -96,32 +96,56 @@ def _use_stream_driver(rs: ReedSolomon) -> bool:
     return _on_tpu()
 
 
-def _read_block(f, offset: int, length: int) -> np.ndarray:
-    """Read `length` bytes at `offset`, zero-padded past EOF
+def iter_ec_tiles(dat_size: int, tile: int, large: int, small: int):
+    """Yield (row_offset, block_size, batch_off, step) sub-tiles
+    covering the two-tier row layout (strict-`>` row counting,
+    ec_encoder.go:188-225). The reader takes [10, step] at
+    row_offset + i*block_size + batch_off for shard i. Single source
+    of the tiling math for the classic and pipelined drivers."""
+    n_large, n_small = shard_row_counts(dat_size, large, small)
+    processed = 0
+    for block_size, n_rows in ((large, n_large), (small, n_small)):
+        step = min(tile, block_size)
+        for _ in range(n_rows):
+            for batch_off in range(0, block_size, step):
+                yield processed, block_size, batch_off, min(
+                    step, block_size - batch_off
+                )
+            processed += block_size * DATA_SHARDS
+
+
+def read_dat_tile(
+    dat, dat_size: int, row_off: int, block: int, batch_off: int, step: int
+) -> np.ndarray:
+    """[10, step] uint8 tile of the .dat, zero-padded past EOF
     (encodeDataOneBatch:158-170)."""
-    f.seek(offset)
-    raw = f.read(length)
-    buf = np.zeros(length, dtype=np.uint8)
-    if raw:
-        buf[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    buf = np.zeros((DATA_SHARDS, step), dtype=np.uint8)
+    for i in range(DATA_SHARDS):
+        off = row_off + i * block + batch_off
+        if off >= dat_size:
+            continue
+        dat.seek(off)
+        raw = dat.read(step)
+        if raw:
+            buf[i, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
     return buf
 
 
 def write_ec_files(
     base_file_name: str,
     rs: ReedSolomon | None = None,
-    buffer_size: int = DEFAULT_BUFFER_SIZE,
+    buffer_size: int | None = None,
     large_block_size: int = LARGE_BLOCK_SIZE,
     small_block_size: int = SMALL_BLOCK_SIZE,
 ) -> None:
     """Generate .ec00-.ec13 next to `base_file_name`.dat
-    (ec_encoder.go:53 WriteEcFiles)."""
+    (ec_encoder.go:53 WriteEcFiles).
+
+    buffer_size=None lets each driver pick its default (4 MiB classic
+    IO batches; 16 MiB pipelined tiles on a TPU host)."""
     rs = rs or new_encoder()
     if rs.data_shards != DATA_SHARDS or rs.parity_shards != PARITY_SHARDS:
         raise ValueError("shard-file layout is fixed at RS(10,4)")
-    for block in (large_block_size, small_block_size):
-        if block % buffer_size != 0 and buffer_size % block != 0:
-            raise ValueError("buffer size must tile the block sizes")
 
     if _use_stream_driver(rs):
         from seaweedfs_tpu.ec import ec_stream
@@ -134,30 +158,25 @@ def write_ec_files(
         )
         return
 
-    dat_size = os.path.getsize(base_file_name + ".dat")
-    n_large, n_small = shard_row_counts(dat_size, large_block_size, small_block_size)
+    buffer_size = buffer_size or DEFAULT_BUFFER_SIZE
+    for block in (large_block_size, small_block_size):
+        if block % buffer_size != 0 and buffer_size % block != 0:
+            raise ValueError("buffer size must tile the block sizes")
 
+    dat_size = os.path.getsize(base_file_name + ".dat")
     outputs = [open(base_file_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
     try:
         with open(base_file_name + ".dat", "rb") as dat:
-            row_plan = [(large_block_size, n_large), (small_block_size, n_small)]
-            processed = 0
-            for block_size, n_rows in row_plan:
-                step = min(buffer_size, block_size)
-                for _ in range(n_rows):
-                    for batch_off in range(0, block_size, step):
-                        shards: list[np.ndarray | None] = [
-                            _read_block(
-                                dat,
-                                processed + i * block_size + batch_off,
-                                step,
-                            )
-                            for i in range(DATA_SHARDS)
-                        ] + [None] * PARITY_SHARDS
-                        rs.encode(shards)
-                        for i in range(TOTAL_SHARDS):
-                            outputs[i].write(shards[i].tobytes())  # type: ignore[union-attr]
-                    processed += block_size * DATA_SHARDS
+            for row_off, block, batch_off, step in iter_ec_tiles(
+                dat_size, buffer_size, large_block_size, small_block_size
+            ):
+                tile = read_dat_tile(dat, dat_size, row_off, block, batch_off, step)
+                shards: list[np.ndarray | None] = [
+                    tile[i] for i in range(DATA_SHARDS)
+                ] + [None] * PARITY_SHARDS
+                rs.encode(shards)
+                for i in range(TOTAL_SHARDS):
+                    outputs[i].write(shards[i].tobytes())  # type: ignore[union-attr]
     finally:
         for f in outputs:
             f.close()
@@ -166,10 +185,13 @@ def write_ec_files(
 def rebuild_ec_files(
     base_file_name: str,
     rs: ReedSolomon | None = None,
-    buffer_size: int = SMALL_BLOCK_SIZE,
+    buffer_size: int | None = None,
 ) -> list[int]:
     """Regenerate whichever .ec files are missing from the ones present
-    (ec_encoder.go:83 generateMissingEcFiles). Returns rebuilt ids."""
+    (ec_encoder.go:83 generateMissingEcFiles). Returns rebuilt ids.
+
+    buffer_size=None lets each driver pick its default (1 MiB classic
+    batches; 16 MiB pipelined tiles on a TPU host)."""
     rs = rs or new_encoder()
     if _use_stream_driver(rs):
         from seaweedfs_tpu.ec import ec_stream
@@ -177,6 +199,7 @@ def rebuild_ec_files(
         return ec_stream.stream_rebuild_ec_files(
             base_file_name, tile_bytes=buffer_size
         )
+    buffer_size = buffer_size or SMALL_BLOCK_SIZE
     present, missing = shard_presence(base_file_name)
     if not missing:
         return []

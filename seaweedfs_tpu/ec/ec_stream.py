@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import os
 from collections import deque
-from typing import Callable, Iterator
+from typing import Callable
 
 import numpy as np
 
@@ -43,45 +43,9 @@ DEFAULT_TILE_BYTES = 16 * 1024 * 1024
 _INFLIGHT = 2
 
 
-def _tiles_for_dat(
-    dat_size: int, tile: int, large: int, small: int
-) -> Iterator[tuple[int, int, int, int]]:
-    """Yield (row_offset, block_size, batch_off, step) sub-tiles
-    covering the two-tier row layout (strict-`>` row counting,
-    ec_encoder.go:188-225). The caller reads [10, step] at
-    row_offset + i*block_size + batch_off for shard i."""
-    from seaweedfs_tpu.ec.ec_files import shard_row_counts
-
-    n_large, n_small = shard_row_counts(dat_size, large, small)
-    processed = 0
-    for block_size, n_rows in ((large, n_large), (small, n_small)):
-        step = min(tile, block_size)
-        for _ in range(n_rows):
-            for batch_off in range(0, block_size, step):
-                yield processed, block_size, batch_off, min(
-                    step, block_size - batch_off
-                )
-            processed += block_size * DATA_SHARDS
-
-
-def _read_tile(dat, dat_size: int, row_off: int, block: int, batch_off: int,
-               step: int) -> np.ndarray:
-    """[10, step] uint8 tile, zero-padded past EOF."""
-    buf = np.zeros((DATA_SHARDS, step), dtype=np.uint8)
-    for i in range(DATA_SHARDS):
-        off = row_off + i * block + batch_off
-        if off >= dat_size:
-            continue
-        dat.seek(off)
-        raw = dat.read(step)
-        if raw:
-            buf[i, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
-    return buf
-
-
 def stream_write_ec_files(
     base_file_name: str,
-    tile_bytes: int = DEFAULT_TILE_BYTES,
+    tile_bytes: int | None = None,
     large_block_size: int = LARGE_BLOCK_SIZE,
     small_block_size: int = SMALL_BLOCK_SIZE,
     parity_fn: Callable[[np.ndarray], "object"] | None = None,
@@ -96,12 +60,15 @@ def stream_write_ec_files(
     pipeline logic testable on CPU hosts (tests inject a numpy
     parity_fn and still exercise tiling/ordering/write paths).
     """
-    if parity_fn is None or fetch_fn is None:
+    if (parity_fn is None) != (fetch_fn is None):
+        raise ValueError("parity_fn and fetch_fn must be injected together")
+    if parity_fn is None:
         parity_fn, fetch_fn = _tpu_encode_fns()
+    tile_bytes = tile_bytes or DEFAULT_TILE_BYTES
 
     dat_path = base_file_name + ".dat"
     dat_size = os.path.getsize(dat_path)
-    from seaweedfs_tpu.ec.ec_files import to_ext
+    from seaweedfs_tpu.ec.ec_files import iter_ec_tiles, read_dat_tile, to_ext
 
     outputs = [open(base_file_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
     inflight: deque[tuple[np.ndarray, object]] = deque()
@@ -116,10 +83,10 @@ def stream_write_ec_files(
 
     try:
         with open(dat_path, "rb") as dat:
-            for row_off, block, batch_off, step in _tiles_for_dat(
+            for row_off, block, batch_off, step in iter_ec_tiles(
                 dat_size, tile_bytes, large_block_size, small_block_size
             ):
-                tile = _read_tile(dat, dat_size, row_off, block, batch_off, step)
+                tile = read_dat_tile(dat, dat_size, row_off, block, batch_off, step)
                 inflight.append((tile, parity_fn(tile)))
                 if len(inflight) >= _INFLIGHT:
                     drain_one()
@@ -132,7 +99,7 @@ def stream_write_ec_files(
 
 def stream_rebuild_ec_files(
     base_file_name: str,
-    tile_bytes: int = DEFAULT_TILE_BYTES,
+    tile_bytes: int | None = None,
     rebuild_fn: Callable[[tuple[int, ...], tuple[int, ...], np.ndarray], "object"]
     | None = None,
     fetch_fn: Callable[["object"], np.ndarray] | None = None,
@@ -142,8 +109,11 @@ def stream_rebuild_ec_files(
     rebuild_fn(survivors, targets, [10, step] u8) dispatches
     reconstruction of `targets` from the survivor tile and returns a
     handle; fetch_fn blocks it into [len(targets), step] u8."""
-    if rebuild_fn is None or fetch_fn is None:
+    if (rebuild_fn is None) != (fetch_fn is None):
+        raise ValueError("rebuild_fn and fetch_fn must be injected together")
+    if rebuild_fn is None:
         rebuild_fn, fetch_fn = _tpu_rebuild_fns()
+    tile_bytes = tile_bytes or DEFAULT_TILE_BYTES
 
     from seaweedfs_tpu.ec.ec_files import shard_presence, to_ext
 

@@ -138,3 +138,27 @@ def sub_matrix_for_survivors(
 ) -> np.ndarray:
     """Rows of the code matrix for a set of surviving shards."""
     return code_matrix[np.array(survivor_rows, dtype=np.intp)].copy()
+
+
+def decode_rows(
+    code_matrix: np.ndarray,
+    survivors: "tuple[int, ...] | list[int]",
+    targets: "tuple[int, ...] | list[int]",
+) -> np.ndarray:
+    """GF coefficient rows mapping k survivor shards → target shards.
+
+    survivors: the k shard ids present (ascending), targets: shard ids
+    to produce. Data targets are rows of the inverted survivor
+    submatrix; parity targets compose the parity row with that inverse.
+    Single home for the survivor-decode algebra used by the host codec,
+    the TPU kernels, and the mesh codec."""
+    k = code_matrix.shape[1]
+    sub = sub_matrix_for_survivors(code_matrix, list(survivors))
+    inv = mat_inv(sub)  # [k, k]: survivors → data shards
+    rows = []
+    for t in targets:
+        if t < k:
+            rows.append(inv[t])
+        else:
+            rows.append(mat_mul(code_matrix[t : t + 1], inv)[0])
+    return np.stack(rows)

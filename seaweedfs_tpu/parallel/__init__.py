@@ -1,0 +1,25 @@
+"""Mesh-parallel EC codec paths (the ICI tier of SURVEY §2.7).
+
+The reference scales EC work with goroutines × TCP (store_ec.go:344,
+command_ec_encode.go:202). The TPU-native equivalent keeps gRPC/DCN
+for control and blob traffic between hosts, and runs the bulk GF math
+as SPMD programs over a `jax.sharding.Mesh`:
+
+  axis "vol"    — volume parallelism (DP analogue): independent sealed
+                  volumes spread across devices (BASELINE's batched
+                  256-volume encode config).
+  axis "stripe" — byte-stream parallelism (SP analogue): EC is
+                  positionwise, so the N dimension shards freely; a
+                  30 GB volume becomes per-device stripe blocks
+                  (SURVEY §5 long-context analogue).
+
+Collectives: encode/rebuild need none (positionwise math — the whole
+point of laying the stream out along the mesh); verify reduces a
+per-volume residual with a `psum` over the stripe axis, the degraded-
+read fan-in of SURVEY §2.6.5 ("reconstruct in one pmap").
+"""
+
+from seaweedfs_tpu.parallel.mesh_codec import (  # noqa: F401
+    MeshCodec,
+    make_mesh,
+)

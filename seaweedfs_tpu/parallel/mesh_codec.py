@@ -1,0 +1,154 @@
+"""Batched RS(k,p) over a device mesh via shard_map.
+
+Per-device work is the portable bitsliced XOR-matmul kernel
+(codec_tpu.apply_matrix_bits — lowers on CPU meshes and TPU slices
+alike; on a real TPU slice XLA maps the int8 dot onto the MXU per
+chip). Shardings:
+
+  volumes  [B, k, N]  P("vol", None, "stripe")
+  parity   [B, p, N]  P("vol", None, "stripe")
+  residual [B]        P("vol")  (after psum over "stripe")
+
+Batched-encode role: the spread/encode fan-out of the reference's
+shell command_ec_encode.go:153 + ec_encoder.go:173, lifted from
+goroutine-per-volume to one SPMD program. Degraded-read fan-in role:
+store_ec.go:344-373 (goroutine-per-shard gather + ReconstructData),
+lifted to "reconstruct in one pmap" (SURVEY §2.6.5).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from seaweedfs_tpu.ec import gf256
+from seaweedfs_tpu.ec.codec_tpu import (
+    apply_matrix_bits_batch,
+    gf_matrix_to_bits,
+)
+
+VOL_AXIS = "vol"
+STRIPE_AXIS = "stripe"
+
+
+def make_mesh(
+    devices: list | None = None, stripe: int | None = None
+) -> Mesh:
+    """Build a (vol × stripe) mesh over `devices` (default: all).
+
+    stripe=None picks 2 when the device count is even, else 1 — volume
+    parallelism first (independent work), stripe parallelism to split
+    streams too long for one device's HBM."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if stripe is None:
+        stripe = 2 if n % 2 == 0 else 1
+    if n % stripe:
+        raise ValueError(f"{n} devices do not split into stripe={stripe}")
+    return Mesh(
+        np.array(devices).reshape(n // stripe, stripe), (VOL_AXIS, STRIPE_AXIS)
+    )
+
+
+class MeshCodec:
+    """RS(k,p) batched encode / rebuild / verify over a Mesh."""
+
+    def __init__(self, mesh: Mesh, data_shards: int = 10, parity_shards: int = 4):
+        self.mesh = mesh
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.matrix = gf256.build_code_matrix(data_shards, self.total_shards)
+        self._parity_bits = jnp.asarray(gf_matrix_to_bits(self.matrix[data_shards:]))
+        self._decode_bits_cache: dict[tuple[int, ...], jnp.ndarray] = {}
+        self.block_sharding = NamedSharding(mesh, P(VOL_AXIS, None, STRIPE_AXIS))
+        self.vol_sharding = NamedSharding(mesh, P(VOL_AXIS))
+
+    # --- sharding helpers ---
+    def shard_volumes(self, host_volumes: np.ndarray) -> jnp.ndarray:
+        """[B, C, N] host → device array sharded P(vol, None, stripe).
+        B must divide by the vol axis, N by the stripe axis."""
+        return jax.device_put(host_volumes, self.block_sharding)
+
+    # --- batched encode ---
+    @functools.cached_property
+    def _encode_sharded(self):
+        def per_device(bits, vols):  # vols [Bb, k, Nb]
+            return apply_matrix_bits_batch(bits, vols)
+
+        fn = shard_map(
+            per_device,
+            mesh=self.mesh,
+            in_specs=(P(), P(VOL_AXIS, None, STRIPE_AXIS)),
+            out_specs=P(VOL_AXIS, None, STRIPE_AXIS),
+        )
+        return jax.jit(fn)
+
+    def encode_batch(self, volumes: jnp.ndarray) -> jnp.ndarray:
+        """volumes [B, k, N] (sharded) → parity [B, p, N] (sharded).
+
+        Positionwise GF math: no collectives; each device encodes its
+        (volume-block × stripe-block) tile independently."""
+        return self._encode_sharded(self._parity_bits, volumes)
+
+    # --- batched degraded rebuild ---
+    def _decode_bits(
+        self, survivors: tuple[int, ...], targets: tuple[int, ...]
+    ) -> jnp.ndarray:
+        key = survivors + (256,) + targets
+        bits = self._decode_bits_cache.get(key)
+        if bits is None:
+            rows = gf256.decode_rows(self.matrix, survivors, targets)
+            bits = jnp.asarray(gf_matrix_to_bits(rows))
+            self._decode_bits_cache[key] = bits
+        return bits
+
+    def reconstruct_batch(
+        self,
+        survivors: tuple[int, ...],
+        targets: tuple[int, ...],
+        shard_data: jnp.ndarray,
+    ) -> jnp.ndarray:
+        """shard_data [B, k, N] survivor blocks (in `survivors` order,
+        sharded) → [B, len(targets), N] rebuilt blocks (sharded).
+
+        The gather of surviving shards into `shard_data` rides DCN
+        (gRPC shard reads); the decode is one SPMD program — the
+        store_ec.go:364 ReconstructData hot path, batched."""
+        return self._encode_sharded(self._decode_bits(survivors, targets), shard_data)
+
+    # --- verify with a stripe-axis collective ---
+    @functools.cached_property
+    def _verify_sharded(self):
+        def per_device(bits, vols, parity):
+            # [Bb, p, Nb] recomputed on this device's tile
+            recomputed = apply_matrix_bits_batch(bits, vols)
+            local = jnp.sum(
+                (recomputed ^ parity).astype(jnp.int32), axis=(1, 2)
+            )  # [Bb]
+            return jax.lax.psum(local, STRIPE_AXIS)
+
+        fn = shard_map(
+            per_device,
+            mesh=self.mesh,
+            in_specs=(
+                P(),
+                P(VOL_AXIS, None, STRIPE_AXIS),
+                P(VOL_AXIS, None, STRIPE_AXIS),
+            ),
+            out_specs=P(VOL_AXIS),
+        )
+        return jax.jit(fn)
+
+    def verify_batch(
+        self, volumes: jnp.ndarray, parity: jnp.ndarray
+    ) -> jnp.ndarray:
+        """Per-volume XOR residual between recomputed and given parity:
+        [B] int32, 0 = verified. The stripe-axis psum is the mesh
+        collective of the degraded-read fan-in story (§2.6.5)."""
+        return self._verify_sharded(self._parity_bits, volumes, parity)

@@ -281,19 +281,10 @@ class TestStreamDrivers:
             return rs._apply(rs.parity_rows, tile)
 
         def rebuild_fn(survivors, targets, tile):
-            import numpy as np
-
             from seaweedfs_tpu.ec import gf256
 
-            sub = gf256.sub_matrix_for_survivors(rs.matrix, list(survivors))
-            inv = gf256.mat_inv(sub)
-            rows = []
-            for t_ in targets:
-                if t_ < rs.data_shards:
-                    rows.append(inv[t_])
-                else:
-                    rows.append(gf256.mat_mul(rs.matrix[t_ : t_ + 1], inv)[0])
-            return rs._apply(np.stack(rows), tile)
+            rows = gf256.decode_rows(rs.matrix, survivors, targets)
+            return rs._apply(rows, tile)
 
         return parity_fn, rebuild_fn, (lambda h: h)
 
