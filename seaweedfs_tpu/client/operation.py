@@ -40,6 +40,7 @@ class AssignResult:
     public_url: str
     count: int
     error: str = ""
+    auth: str = ""  # write-JWT for the fid; pass as upload(jwt=...)
 
 
 def assign(
@@ -62,7 +63,9 @@ def assign(
         )
     if resp.error:
         raise RuntimeError(f"assign: {resp.error}")
-    return AssignResult(resp.fid, resp.url, resp.public_url, resp.count)
+    return AssignResult(
+        resp.fid, resp.url, resp.public_url, resp.count, auth=resp.auth
+    )
 
 
 # ----------------------------------------------------------------------
@@ -126,12 +129,19 @@ def download(fid_url: str, timeout: float = 30.0) -> tuple[bytes, dict]:
         return r.read(), dict(r.headers)
 
 
-def delete(fid_url: str, timeout: float = 30.0) -> None:
+def delete(fid_url: str, timeout: float = 30.0, jwt: str = "") -> None:
+    """DELETE a blob. Pass the assign-issued write JWT on signed
+    clusters; auth failures raise (a swallowed 401 would silently leak
+    the blob), while 404s stay idempotent no-ops."""
     req = urllib.request.Request(f"http://{fid_url}", method="DELETE")
+    if jwt:
+        req.add_header("Authorization", f"BEARER {jwt}")
     try:
         urllib.request.urlopen(req, timeout=timeout).read()
-    except urllib.error.HTTPError:
-        pass
+    except urllib.error.HTTPError as e:
+        if e.code in (401, 403):
+            raise RuntimeError(f"delete {fid_url}: not authorized ({e.code})")
+        # 404 etc.: delete is idempotent
 
 
 # ----------------------------------------------------------------------
@@ -292,6 +302,7 @@ def submit_file(
                 piece,
                 filename=f"{filename}_{idx}",
                 ttl=ttl,
+                jwt=car.auth,
             )
             if ur.error:
                 return SubmitResult(filename, ar.fid, "", 0, ur.error)
@@ -308,9 +319,13 @@ def submit_file(
             ttl=ttl,
             mime="application/json",
             is_chunk_manifest=True,
+            jwt=ar.auth,
         )
     else:
-        ur = upload(f"{ar.url}/{ar.fid}", data, filename=filename, mime=mime, ttl=ttl)
+        ur = upload(
+            f"{ar.url}/{ar.fid}", data, filename=filename, mime=mime, ttl=ttl,
+            jwt=ar.auth,
+        )
     if ur.error:
         return SubmitResult(filename, ar.fid, "", 0, ur.error)
     return SubmitResult(filename, ar.fid, f"{ar.public_url}/{ar.fid}", len(data))

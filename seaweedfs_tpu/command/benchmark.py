@@ -136,7 +136,9 @@ def run_benchmark(
                     ar = op.assign(
                         master, collection=collection, replication=replication
                     )
-                    ur = op.upload(f"{ar.url}/{ar.fid}", payload, filename="bench.bin")
+                    ur = op.upload(
+                        f"{ar.url}/{ar.fid}", payload, filename="bench.bin", jwt=ar.auth
+                    )
                     ok = not ur.error
                     if ok:
                         with fid_lock:

@@ -193,6 +193,8 @@ def rebuild_ec_files(
     buffer_size=None lets each driver pick its default (1 MiB classic
     batches; 16 MiB pipelined tiles on a TPU host)."""
     rs = rs or new_encoder()
+    if rs.data_shards != DATA_SHARDS or rs.parity_shards != PARITY_SHARDS:
+        raise ValueError("shard-file layout is fixed at RS(10,4)")
     if _use_stream_driver(rs):
         from seaweedfs_tpu.ec import ec_stream
 

@@ -26,10 +26,9 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from seaweedfs_tpu.ec import gf256
 from seaweedfs_tpu.ec.codec_tpu import (
+    TpuCodecKernels,
     apply_matrix_bits_batch,
-    gf_matrix_to_bits,
 )
 
 VOL_AXIS = "vol"
@@ -63,9 +62,11 @@ class MeshCodec:
         self.data_shards = data_shards
         self.parity_shards = parity_shards
         self.total_shards = data_shards + parity_shards
-        self.matrix = gf256.build_code_matrix(data_shards, self.total_shards)
-        self._parity_bits = jnp.asarray(gf_matrix_to_bits(self.matrix[data_shards:]))
-        self._decode_bits_cache: dict[tuple[int, ...], jnp.ndarray] = {}
+        # single-chip kernels own the code matrix and the decode-row
+        # bit-matrix cache; MeshCodec only lifts them over the mesh
+        self._kern = TpuCodecKernels(data_shards, parity_shards)
+        self.matrix = self._kern.matrix
+        self._parity_bits = self._kern.encode_bits
         self.block_sharding = NamedSharding(mesh, P(VOL_AXIS, None, STRIPE_AXIS))
         self.vol_sharding = NamedSharding(mesh, P(VOL_AXIS))
 
@@ -100,13 +101,7 @@ class MeshCodec:
     def _decode_bits(
         self, survivors: tuple[int, ...], targets: tuple[int, ...]
     ) -> jnp.ndarray:
-        key = survivors + (256,) + targets
-        bits = self._decode_bits_cache.get(key)
-        if bits is None:
-            rows = gf256.decode_rows(self.matrix, survivors, targets)
-            bits = jnp.asarray(gf_matrix_to_bits(rows))
-            self._decode_bits_cache[key] = bits
-        return bits
+        return jnp.asarray(self._kern.decode_bits_for(survivors, targets))
 
     def reconstruct_batch(
         self,

@@ -89,7 +89,7 @@ class FilerSink(ReplicationSink):
                     ttl_sec=self.ttl_sec,
                 )
             )
-            ur = op.upload(f"{ar.url}/{ar.fid}", data)
+            ur = op.upload(f"{ar.url}/{ar.fid}", data, jwt=ar.auth)
             if ur.error:
                 raise RuntimeError(f"sink upload {ar.fid}: {ur.error}")
             out.append(

@@ -107,7 +107,12 @@ class FilerServer:
             piece = data[offset : offset + chunk_size] if chunk_size else data
             ar = self._assign(collection, replication, ttl)
             ur = op.upload(
-                f"{ar.url}/{ar.fid}", piece, filename=filename, mime=mime, ttl=ttl
+                f"{ar.url}/{ar.fid}",
+                piece,
+                filename=filename,
+                mime=mime,
+                ttl=ttl,
+                jwt=ar.auth,
             )
             if ur.error:
                 raise RuntimeError(f"upload chunk: {ur.error}")
@@ -184,7 +189,11 @@ class FilerServer:
     def AssignVolume(self, req: fpb.AssignVolumeRequest, context):
         ar = self._assign(req.collection, req.replication)
         return fpb.AssignVolumeResponse(
-            fid=ar.fid, url=ar.url, public_url=ar.public_url, count=ar.count
+            fid=ar.fid,
+            url=ar.url,
+            public_url=ar.public_url,
+            count=ar.count,
+            auth=ar.auth,
         )
 
     def LookupVolume(self, req: fpb.LookupVolumeRequest, context):

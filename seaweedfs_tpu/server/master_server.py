@@ -204,6 +204,7 @@ class MasterServer:
             url=result["url"],
             public_url=result["publicUrl"],
             count=result["count"],
+            auth=result.get("auth", ""),
         )
 
     def LookupVolume(self, req: pb.LookupVolumeRequest, context) -> pb.LookupVolumeResponse:

@@ -332,3 +332,82 @@ class TestEcLifecycle:
         # blob still readable after the rejected delete
         status, body = http_get(f"http://{assign['url']}/{assign['fid']}")
         assert status == 200 and body == payload
+
+
+class TestJwtSignedWrites:
+    """With jwt signing enabled cluster-wide, internal writers (filer
+    auto-chunk, submit) must carry the assign-issued write token —
+    the reference returns `auth` in assign results and forwards it on
+    upload (security.GenJwt; master_server_handlers.go + upload_content.go)."""
+
+    @pytest.fixture()
+    def jwt_cluster(self, tmp_path_factory):
+        from seaweedfs_tpu.security.guard import Guard
+
+        key = "test-signing-key"
+        master_port = free_port()
+        master = MasterServer(
+            port=master_port,
+            volume_size_limit_mb=64,
+            guard=Guard(signing_key=key, expires_after_sec=30),
+        )
+        master.start()
+        vs = VolumeServer(
+            [str(tmp_path_factory.mktemp("jwtvs"))],
+            port=free_port(),
+            master=f"127.0.0.1:{master_port}",
+            heartbeat_interval=0.2,
+            max_volume_counts=[100],
+            guard=Guard(signing_key=key, expires_after_sec=30),
+        )
+        vs.start()
+        deadline = time.time() + 10
+        while time.time() < deadline and len(master.topology.data_nodes()) < 1:
+            time.sleep(0.05)
+        yield master, vs
+        vs.stop()
+        master.stop()
+
+    def test_grpc_assign_carries_auth_and_upload_succeeds(self, jwt_cluster):
+        from seaweedfs_tpu.client import operation as op
+
+        master, vs = jwt_cluster
+        ar = op.assign(f"127.0.0.1:{master.port}")
+        assert ar.auth, "gRPC AssignResponse must carry the write JWT"
+
+        # unauthenticated POST is rejected...
+        bad = op.upload(f"{ar.url}/{ar.fid}", b"denied")
+        assert bad.error
+        # ...the assign-issued token is accepted
+        good = op.upload(f"{ar.url}/{ar.fid}", b"hello jwt", jwt=ar.auth)
+        assert not good.error and good.size > 0
+
+    def test_filer_writes_with_signing_enabled(self, jwt_cluster, tmp_path):
+        import urllib.request
+
+        from seaweedfs_tpu.server.filer_server import FilerServer
+
+        master, vs = jwt_cluster
+        filer = FilerServer(
+            [f"127.0.0.1:{master.port}"], port=free_port(), store="memory"
+        )
+        filer.start()
+        try:
+            url = f"http://127.0.0.1:{filer.port}/dir/hello.txt"
+            req = urllib.request.Request(url, data=b"filer payload", method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status in (200, 201)
+            with urllib.request.urlopen(url, timeout=10) as r:
+                assert r.read() == b"filer payload"
+        finally:
+            filer.stop()
+
+    def test_submit_with_signing_enabled(self, jwt_cluster):
+        from seaweedfs_tpu.client import operation as op
+
+        master, vs = jwt_cluster
+        res = op.submit_file(
+            f"127.0.0.1:{master.port}", "sub.bin", b"x" * 2048, max_mb=0
+        )
+        assert not res.error
+        assert res.fid
