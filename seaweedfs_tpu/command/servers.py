@@ -385,12 +385,19 @@ class MountCommand(Command):
         p.add_argument("-filer.path", dest="filer_path", default="/")
 
     def run(self, args) -> int:
-        try:
-            from seaweedfs_tpu.filesys.mount import mount  # noqa
-        except ImportError as e:
-            print(f"mount unavailable: {e} (no fuse binding in this environment)")
-            return 1
+        from seaweedfs_tpu.filesys.mount import mount_fuse
+        from seaweedfs_tpu.filesys.wfs import WfsOption
+
         if not args.dir:
             print("usage: mount -dir=<mountpoint>")
             return 2
-        return mount(args.filer, args.dir, args.filer_path)
+        option = WfsOption(args.filer, filer_mount_root_path=args.filer_path)
+        try:
+            mount_fuse(option, args.dir)
+        except RuntimeError as e:
+            # no fuse binding in this environment; the in-process VFS
+            # (seaweedfs_tpu.filesys.MountedFileSystem) is the
+            # supported surface here
+            print(f"mount unavailable: {e}")
+            return 1
+        return 0

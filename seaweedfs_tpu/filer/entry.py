@@ -43,6 +43,7 @@ class Attr:
     collection: str = ""
     ttl_sec: int = 0
     symlink_target: str = ""
+    file_size: int = 0  # explicit size; 0 = derive from chunk total
 
     @property
     def is_directory(self) -> bool:
@@ -69,9 +70,12 @@ class Entry:
         return self.attr.is_directory
 
     def size(self) -> int:
+        # an explicit file_size wins (truncate can clamp below the
+        # chunk total, since a kept chunk may span past the new EOF);
+        # otherwise derive from the chunk list
         from seaweedfs_tpu.filer.filechunks import total_size
 
-        return total_size(self.chunks)
+        return self.attr.file_size or total_size(self.chunks)
 
     # --- pb codec (entry_codec.go) ---
     def to_pb(self) -> filer_pb2.Entry:
@@ -113,6 +117,7 @@ class Entry:
                 collection=a.collection,
                 ttl_sec=a.ttl_sec,
                 symlink_target=a.symlink_target,
+                file_size=a.file_size,
             ),
             chunks=list(pb_entry.chunks),
             extended=dict(pb_entry.extended),

@@ -1,0 +1,18 @@
+"""FUSE-style file-system layer over the filer (reference weed/filesys/).
+
+Components:
+  page_writer   ContinuousIntervals — dirty-page interval buffering
+                (dirty_page_interval.go:90)
+  wfs           WFS — filer gRPC client, entry cache, chunk IO
+                (wfs.go:46-70)
+  nodes         Dir / File / FileHandle — the FUSE operation surface
+                (dir.go, file.go, filehandle.go)
+  mount         MountedFileSystem — libfuse-free in-process POSIX-style
+                facade over the node layer, plus an optional real FUSE
+                adapter when a fuse binding is importable
+                (command/mount_std.go role)
+"""
+
+from seaweedfs_tpu.filesys.mount import MountedFileSystem  # noqa: F401
+from seaweedfs_tpu.filesys.page_writer import ContinuousIntervals  # noqa: F401
+from seaweedfs_tpu.filesys.wfs import WFS, WfsOption  # noqa: F401

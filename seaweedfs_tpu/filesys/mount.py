@@ -1,0 +1,346 @@
+"""Mount layer: libfuse-free in-process facade + optional FUSE adapter.
+
+MountedFileSystem exposes POSIX-style calls (open/read/write/mkdir/
+listdir/stat/rename/unlink/truncate/symlink/xattr) over the Dir/File/
+FileHandle node layer — the full VFS without a kernel mount, so the
+write-buffering/flush/rename semantics are testable in-process
+(command/mount_std.go role; the v0 reference can only test these
+through a real kernel mount, which it does not do in CI either).
+
+mount_fuse() bridges the same node layer to a real kernel mountpoint
+when a `fuse` binding (fusepy) is importable; this environment ships
+none, so it is gated with a clear error rather than a dead stub.
+"""
+
+from __future__ import annotations
+
+import posixpath
+
+from seaweedfs_tpu.filesys.nodes import (
+    Dir,
+    FileHandle,
+    FsError,
+    NotFound,
+    S_IFDIR,
+)
+from seaweedfs_tpu.filesys.wfs import WFS, WfsOption
+
+
+class OpenFile:
+    """A python-file-like wrapper with a cursor over a FileHandle."""
+
+    def __init__(self, handle: FileHandle, append: bool = False):
+        self._h = handle
+        self._pos = handle.f.size if append else 0
+        self.closed = False
+
+    def read(self, size: int = -1) -> bytes:
+        if size < 0:
+            size = max(self._h.f.size, self._h._dirty_max_end) - self._pos
+            size = max(size, 0)
+        data = self._h.read(self._pos, size)
+        self._pos += len(data)
+        return data
+
+    def write(self, data: bytes) -> int:
+        n = self._h.write(self._pos, data)
+        self._pos += n
+        return n
+
+    def seek(self, pos: int, whence: int = 0) -> int:
+        if whence == 0:
+            self._pos = pos
+        elif whence == 1:
+            self._pos += pos
+        elif whence == 2:
+            self._pos = max(self._h.f.size, self._h._dirty_max_end) + pos
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def flush(self) -> None:
+        self._h.flush()
+
+    def close(self) -> None:
+        if not self.closed:
+            self._h.release()
+            self.closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class MountedFileSystem:
+    """The in-process mount: path-string API over the node layer."""
+
+    def __init__(self, option: WfsOption):
+        self.wfs = WFS(option)
+        self.root = option.filer_mount_root_path
+
+    def close(self) -> None:
+        self.wfs.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------
+    def _full(self, path: str) -> str:
+        path = posixpath.normpath("/" + path.strip("/"))
+        if self.root != "/":
+            return self.root + ("" if path == "/" else path)
+        return path
+
+    def _split(self, path: str) -> tuple[str, str]:
+        full = self._full(path)
+        d, name = posixpath.split(full)
+        return d or "/", name
+
+    def _dir(self, path: str) -> Dir:
+        return Dir(self.wfs, self._full(path))
+
+    # ------------------------------------------------------------------
+    def open(self, path: str, mode: str = "r") -> OpenFile:
+        """Modes: r (read), w (create/truncate), a (append), r+ (rw)."""
+        d, name = self._split(path)
+        parent = Dir(self.wfs, d)
+        entry = self.wfs.lookup_entry(d, name)
+        if "w" in mode:
+            if entry is not None:
+                parent.remove(name)
+            _, handle = parent.create(name)
+            return OpenFile(handle)
+        if entry is None:
+            if "a" in mode:
+                _, handle = parent.create(name)
+                return OpenFile(handle)
+            raise NotFound(path)
+        node = parent.lookup(name)
+        if isinstance(node, Dir):
+            raise FsError(f"{path} is a directory")
+        return OpenFile(node.open(), append=("a" in mode))
+
+    def read_file(self, path: str) -> bytes:
+        with self.open(path, "r") as f:
+            return f.read()
+
+    def write_file(self, path: str, data: bytes) -> None:
+        with self.open(path, "w") as f:
+            f.write(data)
+
+    # ------------------------------------------------------------------
+    def mkdir(self, path: str, mode: int = 0o755) -> None:
+        d, name = self._split(path)
+        Dir(self.wfs, d).mkdir(name, mode)
+
+    def makedirs(self, path: str) -> None:
+        parts = [p for p in self._full(path).split("/") if p]
+        cur = ""
+        for p in parts:
+            parent, cur = cur or "/", f"{cur}/{p}"
+            if self.wfs.lookup_entry(parent, p) is None:
+                Dir(self.wfs, parent).mkdir(p)
+
+    def listdir(self, path: str = "/") -> list[str]:
+        return [e.name for e in Dir(self.wfs, self._full(path)).readdir()]
+
+    def stat(self, path: str):
+        d, name = self._split(path)
+        if name == "":
+            # the root
+            return type("Stat", (), {"is_dir": True, "size": 0, "mode": S_IFDIR})()
+        entry = self.wfs.lookup_entry(d, name)
+        if entry is None:
+            raise NotFound(path)
+        from seaweedfs_tpu.filer import filechunks
+
+        size = entry.attributes.file_size or filechunks.total_size(
+            list(entry.chunks)
+        )
+        return type(
+            "Stat",
+            (),
+            {
+                "is_dir": entry.is_directory,
+                "size": size,
+                "mode": entry.attributes.file_mode,
+                "mtime": entry.attributes.mtime,
+                "uid": entry.attributes.uid,
+                "gid": entry.attributes.gid,
+            },
+        )()
+
+    def exists(self, path: str) -> bool:
+        try:
+            self.stat(path)
+            return True
+        except NotFound:
+            return False
+
+    def unlink(self, path: str) -> None:
+        d, name = self._split(path)
+        Dir(self.wfs, d).remove(name)
+
+    def rmdir(self, path: str) -> None:
+        d, name = self._split(path)
+        Dir(self.wfs, d).remove(name, must_be_empty_dir=True)
+
+    def rename(self, old: str, new: str) -> None:
+        od, on = self._split(old)
+        nd, nn = self._split(new)
+        Dir(self.wfs, od).rename(on, Dir(self.wfs, nd), nn)
+
+    def truncate(self, path: str, size: int) -> None:
+        d, name = self._split(path)
+        node = Dir(self.wfs, d).lookup(name)
+        if isinstance(node, Dir):
+            raise FsError(f"{path} is a directory")
+        node.truncate(size)
+
+    def symlink(self, target: str, link_path: str) -> None:
+        d, name = self._split(link_path)
+        Dir(self.wfs, d).symlink(name, target)
+
+    def readlink(self, path: str) -> str:
+        d, name = self._split(path)
+        node = Dir(self.wfs, d).lookup(name)
+        if isinstance(node, Dir):
+            raise FsError(f"{path} is a directory")
+        return node.readlink()
+
+    # xattr ------------------------------------------------------------
+    def setxattr(self, path: str, name: str, value: bytes) -> None:
+        d, fname = self._split(path)
+        node = Dir(self.wfs, d).lookup(fname)
+        node.set_xattr(name, value)
+
+    def getxattr(self, path: str, name: str) -> bytes:
+        d, fname = self._split(path)
+        return Dir(self.wfs, d).lookup(fname).get_xattr(name)
+
+    def listxattr(self, path: str) -> list[str]:
+        d, fname = self._split(path)
+        return Dir(self.wfs, d).lookup(fname).list_xattr()
+
+
+def mount_fuse(option: WfsOption, mountpoint: str, foreground: bool = True):
+    """Kernel mount via fusepy when available (weed mount role).
+
+    The adapter maps the fusepy Operations callbacks onto
+    MountedFileSystem; it is import-gated because this environment
+    ships no FUSE binding (the in-process facade above carries the
+    test coverage either way)."""
+    try:
+        import errno
+
+        import fuse
+    except ImportError as e:
+        raise RuntimeError(
+            "no FUSE binding (fusepy) available; use MountedFileSystem "
+            "for the in-process VFS, or install fusepy for a kernel mount"
+        ) from e
+
+    mfs = MountedFileSystem(option)
+
+    class _Ops(fuse.Operations):
+        def __init__(self):
+            self._handles: dict[int, OpenFile] = {}
+            self._next = 1
+
+        # --- metadata ---
+        def getattr(self, path, fh=None):
+            try:
+                st = mfs.stat(path)
+            except NotFound:
+                raise fuse.FuseOSError(errno.ENOENT)
+            mode = st.mode or (S_IFDIR | 0o755 if st.is_dir else 0o100644)
+            return {
+                "st_mode": mode,
+                "st_size": st.size,
+                "st_mtime": getattr(st, "mtime", 0),
+                "st_uid": getattr(st, "uid", 0),
+                "st_gid": getattr(st, "gid", 0),
+                "st_nlink": 2 if st.is_dir else 1,
+            }
+
+        def readdir(self, path, fh):
+            return [".", ".."] + mfs.listdir(path)
+
+        def mkdir(self, path, mode):
+            mfs.mkdir(path, mode)
+
+        def rmdir(self, path):
+            mfs.rmdir(path)
+
+        def unlink(self, path):
+            mfs.unlink(path)
+
+        def rename(self, old, new):
+            mfs.rename(old, new)
+
+        def truncate(self, path, length, fh=None):
+            mfs.truncate(path, length)
+
+        def symlink(self, link_path, target):
+            mfs.symlink(target, link_path)
+
+        def readlink(self, path):
+            return mfs.readlink(path)
+
+        # --- data ---
+        def create(self, path, mode, fi=None):
+            f = mfs.open(path, "w")
+            fh = self._next
+            self._next += 1
+            self._handles[fh] = f
+            return fh
+
+        def open(self, path, flags):
+            import os as _os
+
+            mode = "r+" if flags & (_os.O_RDWR | _os.O_WRONLY) else "r"
+            f = mfs.open(path, mode)
+            fh = self._next
+            self._next += 1
+            self._handles[fh] = f
+            return fh
+
+        def read(self, path, size, offset, fh):
+            f = self._handles[fh]
+            f.seek(offset)
+            return f.read(size)
+
+        def write(self, path, data, offset, fh):
+            f = self._handles[fh]
+            f.seek(offset)
+            return f.write(data)
+
+        def flush(self, path, fh):
+            if fh in self._handles:
+                self._handles[fh].flush()
+
+        def release(self, path, fh):
+            f = self._handles.pop(fh, None)
+            if f is not None:
+                f.close()
+
+        # --- xattr ---
+        def getxattr(self, path, name, position=0):
+            try:
+                return mfs.getxattr(path, name)
+            except NotFound:
+                raise fuse.FuseOSError(getattr(errno, "ENODATA", errno.ENOENT))
+
+        def setxattr(self, path, name, value, options, position=0):
+            mfs.setxattr(path, name, value)
+
+        def listxattr(self, path):
+            return mfs.listxattr(path)
+
+    return fuse.FUSE(_Ops(), mountpoint, foreground=foreground, nothreads=True)

@@ -1,0 +1,278 @@
+"""Dir / File / FileHandle — the FUSE operation surface.
+
+Behavioral port of reference weed/filesys/dir.go, file.go,
+filehandle.go, dirty_page.go (libfuse types replaced with plain
+Python methods; the mount shim or a real FUSE adapter drives these).
+
+Key behaviors preserved:
+  * writes buffer in ContinuousIntervals; when the buffer exceeds
+    chunk_size_limit the largest continuous run is flushed as one
+    chunk (dirty_page.go AddPage/saveExistingLargestPageToStorage);
+    oversized writes flush everything and go to storage directly
+    (flushAndSave)
+  * reads merge the entry's chunk views with unflushed dirty pages,
+    dirty data winning (filehandle.go Read → readFromChunks +
+    readFromDirtyPages)
+  * flush uploads remaining dirty runs then persists the entry with
+    the accumulated chunk list (filehandle.go Flush → CreateEntry);
+    the filer's visible-interval algebra resolves overlaps on read
+  * truncate drops chunks past the new size (file.go Setattr)
+  * rename is the filer's AtomicRenameEntry tx (dir_rename.go)
+  * hard links are not in the v0 reference; symlinks are
+    (dir_link.go Symlink/Readlink via attributes.symlink_target)
+"""
+
+from __future__ import annotations
+
+import time
+
+from seaweedfs_tpu.filer import filechunks
+from seaweedfs_tpu.filesys.page_writer import ContinuousIntervals
+from seaweedfs_tpu.filesys.wfs import WFS
+from seaweedfs_tpu.pb import filer_pb2 as fpb
+
+S_IFDIR = 0o040000
+S_IFREG = 0o100000
+S_IFLNK = 0o120000
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+class FsError(OSError):
+    pass
+
+
+class NotFound(FsError):
+    pass
+
+
+class NotEmpty(FsError):
+    pass
+
+
+class Dir:
+    def __init__(self, wfs: WFS, path: str):
+        self.wfs = wfs
+        self.path = path.rstrip("/") or "/"
+
+    # ------------------------------------------------------------------
+    def lookup(self, name: str):
+        entry = self.wfs.lookup_entry(self.path, name)
+        if entry is None:
+            raise NotFound(f"{self.path}/{name}")
+        child = f"{self.path}/{name}" if self.path != "/" else f"/{name}"
+        if entry.is_directory:
+            return Dir(self.wfs, child)
+        return File(self.wfs, self, name, entry)
+
+    def readdir(self) -> list[fpb.Entry]:
+        return self.wfs.list_entries(self.path)
+
+    def mkdir(self, name: str, mode: int = 0o755) -> "Dir":
+        entry = fpb.Entry(
+            name=name,
+            is_directory=True,
+            attributes=fpb.Attributes(
+                mtime=_now(),
+                crtime=_now(),
+                file_mode=S_IFDIR | (mode & 0o777),
+                uid=0,
+                gid=0,
+            ),
+        )
+        self.wfs.create_entry(self.path, entry)
+        child = f"{self.path}/{name}" if self.path != "/" else f"/{name}"
+        return Dir(self.wfs, child)
+
+    def create(self, name: str, mode: int = 0o644) -> tuple["File", "FileHandle"]:
+        entry = fpb.Entry(
+            name=name,
+            is_directory=False,
+            attributes=fpb.Attributes(
+                mtime=_now(),
+                crtime=_now(),
+                file_mode=S_IFREG | (mode & 0o777),
+                collection=self.wfs.option.collection,
+                replication=self.wfs.option.replication,
+                ttl_sec=self.wfs.option.ttl_sec,
+            ),
+        )
+        self.wfs.create_entry(self.path, entry)
+        f = File(self.wfs, self, name, entry)
+        return f, f.open()
+
+    def symlink(self, name: str, target: str) -> "File":
+        entry = fpb.Entry(
+            name=name,
+            is_directory=False,
+            attributes=fpb.Attributes(
+                mtime=_now(),
+                crtime=_now(),
+                file_mode=S_IFLNK | 0o777,
+                symlink_target=target,
+            ),
+        )
+        self.wfs.create_entry(self.path, entry)
+        return File(self.wfs, self, name, entry)
+
+    def remove(self, name: str, must_be_empty_dir: bool = False) -> None:
+        entry = self.wfs.lookup_entry(self.path, name)
+        if entry is None:
+            raise NotFound(f"{self.path}/{name}")
+        if entry.is_directory and must_be_empty_dir:
+            child = f"{self.path}/{name}" if self.path != "/" else f"/{name}"
+            if self.wfs.list_entries(child):
+                raise NotEmpty(child)
+        self.wfs.delete_entry(
+            self.path,
+            name,
+            is_delete_data=True,
+            is_recursive=entry.is_directory,
+        )
+
+    def rename(self, old_name: str, new_dir: "Dir", new_name: str) -> None:
+        self.wfs.atomic_rename(self.path, old_name, new_dir.path, new_name)
+
+
+class File:
+    def __init__(self, wfs: WFS, dir: Dir, name: str, entry: fpb.Entry):
+        self.wfs = wfs
+        self.dir = dir
+        self.name = name
+        self.entry = entry
+
+    @property
+    def fullpath(self) -> str:
+        return f"{self.dir.path}/{self.name}" if self.dir.path != "/" else f"/{self.name}"
+
+    def reload(self) -> None:
+        entry = self.wfs.lookup_entry(self.dir.path, self.name)
+        if entry is None:
+            raise NotFound(self.fullpath)
+        self.entry = entry
+
+    def attr(self) -> fpb.Attributes:
+        return self.entry.attributes
+
+    @property
+    def size(self) -> int:
+        # file_size wins once set: truncate may clamp below the chunk
+        # total (a kept chunk can span past the new EOF); entries
+        # written without an explicit size fall back to the chunk total
+        explicit = self.entry.attributes.file_size
+        if explicit > 0:
+            return explicit
+        return filechunks.total_size(list(self.entry.chunks))
+
+    def readlink(self) -> str:
+        target = self.entry.attributes.symlink_target
+        if not target:
+            raise FsError(f"{self.fullpath} is not a symlink")
+        return target
+
+    def open(self) -> "FileHandle":
+        return FileHandle(self)
+
+    def truncate(self, size: int) -> None:
+        """file.go Setattr size branch: drop chunks wholly past the new
+        size and clamp file_size."""
+        kept = [c for c in self.entry.chunks if c.offset < size]
+        del self.entry.chunks[:]
+        self.entry.chunks.extend(kept)
+        self.entry.attributes.file_size = size
+        self.entry.attributes.mtime = _now()
+        self.save()
+
+    def set_xattr(self, name: str, value: bytes) -> None:
+        self.entry.extended[name] = value
+        self.save()
+
+    def get_xattr(self, name: str) -> bytes:
+        if name not in self.entry.extended:
+            raise NotFound(f"xattr {name}")
+        return self.entry.extended[name]
+
+    def list_xattr(self) -> list[str]:
+        return sorted(self.entry.extended)
+
+    def remove_xattr(self, name: str) -> None:
+        if name in self.entry.extended:
+            del self.entry.extended[name]
+            self.save()
+
+    def add_chunks(self, chunks) -> None:
+        self.entry.chunks.extend(chunks)
+
+    def save(self) -> None:
+        self.wfs.update_entry(self.dir.path, self.entry)
+
+
+class FileHandle:
+    """filehandle.go FileHandle + dirty_page.go ContinuousDirtyPages."""
+
+    def __init__(self, f: File):
+        self.f = f
+        self.dirty = ContinuousIntervals()
+        self._dirty_max_end = 0
+
+    # ------------------------------------------------------------------
+    def write(self, offset: int, data: bytes) -> int:
+        limit = self.f.wfs.option.chunk_size_limit
+        if len(data) > limit:
+            # more than the buffer can hold: flush existing pages, then
+            # save this write straight to storage (flushAndSave)
+            self._flush_all_dirty()
+            chunk = self.f.wfs.save_data_as_chunk(bytes(data), offset)
+            self.f.add_chunks([chunk])
+        else:
+            self.dirty.add_interval(data, offset)
+            while self.dirty.total_size() > limit:
+                self._flush_largest()
+        self._dirty_max_end = max(self._dirty_max_end, offset + len(data))
+        return len(data)
+
+    def read(self, offset: int, size: int) -> bytes:
+        """Chunk views first, dirty pages on top (dirty wins)."""
+        file_size = max(self.f.size, self._dirty_max_end)
+        if offset >= file_size:
+            return b""
+        size = min(size, file_size - offset)
+        buf = bytearray(self.f.wfs.read_chunks(self.f.entry.chunks, offset, size))
+        for run in self.dirty.runs:
+            lo = max(offset, run.offset)
+            hi = min(offset + size, run.end)
+            if lo < hi:
+                run.read_into(buf, offset, lo, hi)
+        return bytes(buf)
+
+    def flush(self) -> None:
+        """Upload remaining dirty runs, then persist the entry
+        (filehandle.go Flush)."""
+        self._flush_all_dirty()
+        attrs = self.f.entry.attributes
+        attrs.mtime = _now()
+        attrs.file_size = max(
+            self.f.size, attrs.file_size, self._dirty_max_end
+        )
+        self.f.save()
+
+    def release(self) -> None:
+        self.flush()
+
+    # ------------------------------------------------------------------
+    def _flush_largest(self) -> None:
+        run = self.dirty.remove_largest_run()
+        if run is None:
+            return
+        chunk = self.f.wfs.save_data_as_chunk(run.to_bytes(), run.offset)
+        self.f.add_chunks([chunk])
+
+    def _flush_all_dirty(self) -> None:
+        while True:
+            run = self.dirty.remove_largest_run()
+            if run is None:
+                return
+            chunk = self.f.wfs.save_data_as_chunk(run.to_bytes(), run.offset)
+            self.f.add_chunks([chunk])

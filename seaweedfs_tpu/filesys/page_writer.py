@@ -1,0 +1,147 @@
+"""Dirty-page interval buffering for the write path.
+
+Behavioral port of reference weed/filesys/dirty_page_interval.go:
+ContinuousIntervals keeps written-but-unflushed byte ranges as a set
+of *continuous runs*, each run a chain of non-overlapping nodes in
+offset order. AddInterval resolves overlap by slicing existing runs
+down to their uncovered left/right remainders, then splices the new
+node onto an adjacent run (or bridges two runs into one). Reads give
+the newest data for any covered range; the largest run is flushed
+first when the buffer exceeds the chunk-size limit
+(dirty_page.go saveExistingLargestPageToStorage).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Node:
+    offset: int
+    data: bytes
+
+    @property
+    def end(self) -> int:
+        return self.offset + len(self.data)
+
+
+@dataclass
+class IntervalRun:
+    """One continuous byte range built from ordered adjacent nodes
+    (IntervalLinkedList)."""
+
+    nodes: list[_Node] = field(default_factory=list)
+
+    @property
+    def offset(self) -> int:
+        return self.nodes[0].offset
+
+    @property
+    def end(self) -> int:
+        return self.nodes[-1].end
+
+    @property
+    def size(self) -> int:
+        return self.end - self.offset
+
+    def read_into(self, buf: bytearray, buf_start: int, start: int, stop: int) -> None:
+        """Copy this run's bytes overlapping [start, stop) into buf
+        (positioned so that file offset `buf_start` is buf[0])."""
+        for node in self.nodes:
+            lo = max(start, node.offset)
+            hi = min(stop, node.end)
+            if lo < hi:
+                buf[lo - buf_start : hi - buf_start] = node.data[
+                    lo - node.offset : hi - node.offset
+                ]
+
+    def sub_run(self, start: int, stop: int) -> "IntervalRun":
+        """The [start, stop) slice of this run (subList)."""
+        nodes = []
+        for node in self.nodes:
+            lo = max(start, node.offset)
+            hi = min(stop, node.end)
+            if lo < hi:
+                nodes.append(_Node(lo, node.data[lo - node.offset : hi - node.offset]))
+        return IntervalRun(nodes)
+
+    def to_bytes(self) -> bytes:
+        return b"".join(n.data for n in self.nodes)
+
+
+class ContinuousIntervals:
+    """The dirty-page buffer (ContinuousIntervals, dirty_page_interval.go)."""
+
+    def __init__(self) -> None:
+        self.runs: list[IntervalRun] = []
+
+    def total_size(self) -> int:
+        return sum(r.size for r in self.runs)
+
+    def add_interval(self, data: bytes, offset: int) -> None:
+        """Insert a write of `data` at `offset`, newest-wins."""
+        new_node = _Node(offset, bytes(data))
+        end = new_node.end
+
+        kept: list[IntervalRun] = []
+        for run in self.runs:
+            if run.end <= offset or end <= run.offset:
+                kept.append(run)  # disjoint: keep whole
+                continue
+            # keep the uncovered left remainder
+            if run.offset < offset:
+                kept.append(run.sub_run(run.offset, offset))
+            # keep the uncovered right remainder
+            if end < run.end:
+                kept.append(run.sub_run(end, run.end))
+            # fully covered parts are dropped
+        self.runs = kept
+
+        prev = next_ = None
+        for run in self.runs:
+            if run.end == offset:
+                prev = run
+            elif run.offset == end:
+                next_ = run
+
+        if prev is not None and next_ is not None:
+            prev.nodes.append(new_node)
+            prev.nodes.extend(next_.nodes)
+            self.runs.remove(next_)
+        elif prev is not None:
+            prev.nodes.append(new_node)
+        elif next_ is not None:
+            next_.nodes.insert(0, new_node)
+        else:
+            self.runs.append(IntervalRun([new_node]))
+
+    def read_data(self, size: int, start_offset: int) -> tuple[int, int, bytearray]:
+        """Fill up to `size` bytes from `start_offset`; returns
+        (covered_offset, covered_size, buf) where buf holds the window
+        [start_offset, start_offset+size) with dirty bytes copied in
+        (uncovered gaps stay zero, same contract as ReadData)."""
+        buf = bytearray(size)
+        min_off = None
+        max_stop = 0
+        for run in self.runs:
+            lo = max(start_offset, run.offset)
+            hi = min(start_offset + size, run.end)
+            if lo <= hi:
+                run.read_into(buf, start_offset, lo, hi)
+                min_off = lo if min_off is None else min(min_off, lo)
+                max_stop = max(max_stop, hi)
+        if min_off is None:
+            return 0, 0, buf
+        return min_off, max_stop - min_off, buf
+
+    def remove_largest_run(self) -> IntervalRun | None:
+        """Pop the largest continuous run for flushing
+        (RemoveLargestIntervalLinkedList)."""
+        if not self.runs:
+            return None
+        largest = max(self.runs, key=lambda r: r.size)
+        if largest.size <= 0:
+            return None
+        self.runs.remove(largest)
+        return largest

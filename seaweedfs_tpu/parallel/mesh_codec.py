@@ -63,10 +63,13 @@ class MeshCodec:
         self.parity_shards = parity_shards
         self.total_shards = data_shards + parity_shards
         # single-chip kernels own the code matrix and the decode-row
-        # bit-matrix cache; MeshCodec only lifts them over the mesh
+        # bit-matrix construction; MeshCodec lifts them over the mesh
+        # and keeps its own device-array cache (jnp.asarray per call
+        # would re-upload the bit-matrix host->device every rebuild)
         self._kern = TpuCodecKernels(data_shards, parity_shards)
         self.matrix = self._kern.matrix
         self._parity_bits = self._kern.encode_bits
+        self._decode_bits_dev: dict[tuple[int, ...], jnp.ndarray] = {}
         self.block_sharding = NamedSharding(mesh, P(VOL_AXIS, None, STRIPE_AXIS))
         self.vol_sharding = NamedSharding(mesh, P(VOL_AXIS))
 
@@ -101,7 +104,12 @@ class MeshCodec:
     def _decode_bits(
         self, survivors: tuple[int, ...], targets: tuple[int, ...]
     ) -> jnp.ndarray:
-        return jnp.asarray(self._kern.decode_bits_for(survivors, targets))
+        key = survivors + (256,) + targets
+        bits = self._decode_bits_dev.get(key)
+        if bits is None:
+            bits = jnp.asarray(self._kern.decode_bits_for(survivors, targets))
+            self._decode_bits_dev[key] = bits
+        return bits
 
     def reconstruct_batch(
         self,

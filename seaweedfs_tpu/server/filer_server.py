@@ -304,7 +304,9 @@ class FilerServer:
                     "Content-Type": entry.attr.mime or "application/octet-stream",
                     "ETag": filechunks.etag(entry.chunks) if entry.chunks else "",
                 }
-                total = filechunks.total_size(entry.chunks)
+                # entry.size() honors an explicit file_size (truncate
+                # may clamp below the chunk total)
+                total = entry.size()
                 self.send_response(200)
                 for k, v in headers.items():
                     if v:
@@ -317,7 +319,7 @@ class FilerServer:
                 written = 0
                 try:
                     for piece in stream.stream_content(
-                        server.masters[0], entry.chunks
+                        server.masters[0], entry.chunks, 0, total
                     ):
                         self.wfile.write(piece)
                         written += len(piece)

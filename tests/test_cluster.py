@@ -411,3 +411,16 @@ class TestJwtSignedWrites:
         )
         assert not res.error
         assert res.fid
+
+    def test_chunked_submit_with_signing_enabled(self, jwt_cluster):
+        """The chunked branch: per-piece uploads and the chunk-manifest
+        needle must each carry their assign-issued token."""
+        from seaweedfs_tpu.client import operation as op
+
+        master, vs = jwt_cluster
+        payload = bytes(range(256)) * 8192  # 2 MiB > 1 MB chunk limit
+        res = op.submit_file(
+            f"127.0.0.1:{master.port}", "chunked.bin", payload, max_mb=1
+        )
+        assert not res.error
+        assert res.fid
