@@ -1,0 +1,3 @@
+"""HA master tier: compact raft consensus (reference raft_server.go)."""
+
+from seaweedfs_tpu.cluster.raft import RaftNode  # noqa: F401

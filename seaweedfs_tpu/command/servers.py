@@ -60,12 +60,21 @@ class MasterCommand(Command):
         p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
         p.add_argument("-defaultReplication", default="000")
         p.add_argument("-garbageThreshold", type=float, default=0.3)
+        p.add_argument(
+            "-peers",
+            default="",
+            help="comma-separated master peers incl. self (HA raft cluster)",
+        )
+        p.add_argument("-mdir", default="", help="raft/meta data directory")
         p.add_argument("-v", type=int, default=0, help="verbosity")
 
     def run(self, args) -> int:
         from seaweedfs_tpu.server.master_server import MasterServer
 
         wlog.set_verbosity(args.v)
+        if args.peers and not args.mdir:
+            print("master: -peers requires -mdir (persistent raft state)")
+            return 2
         server = MasterServer(
             host=args.ip,
             port=args.port,
@@ -73,6 +82,8 @@ class MasterCommand(Command):
             default_replication=args.defaultReplication,
             garbage_threshold=args.garbageThreshold,
             guard=_load_guard(),
+            peers=args.peers or None,
+            raft_dir=args.mdir or None,
         )
         server.start()
         wlog.info("master listening on %s:%d (grpc %d)", args.ip, args.port, args.port + 10000)

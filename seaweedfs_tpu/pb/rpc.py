@@ -13,6 +13,7 @@ import grpc
 
 from seaweedfs_tpu.pb import filer_pb2 as f
 from seaweedfs_tpu.pb import master_pb2 as m
+from seaweedfs_tpu.pb import raft_pb2 as r
 from seaweedfs_tpu.pb import volume_pb2 as v
 
 GRPC_PORT_OFFSET = 10000  # reference convention: grpc port = http port + 10000
@@ -159,6 +160,17 @@ class Stub:
                     response_deserializer=resp_cls.FromString,
                 ),
             )
+
+
+RAFT_SERVICE = "seaweedfs_tpu.raft.Raft"
+RAFT_METHODS = {
+    "RequestVote": (r.RequestVoteRequest, r.RequestVoteResponse, UNARY_UNARY),
+    "AppendEntries": (r.AppendEntriesRequest, r.AppendEntriesResponse, UNARY_UNARY),
+}
+
+
+def raft_stub(channel: grpc.Channel) -> Stub:
+    return Stub(channel, RAFT_SERVICE, RAFT_METHODS)
 
 
 def master_stub(channel: grpc.Channel) -> Stub:

@@ -72,6 +72,8 @@ class MasterServer:
         default_replication: str = "000",
         garbage_threshold: float = 0.3,
         guard=None,
+        peers: str | list | None = None,
+        raft_dir: str | None = None,
     ):
         self.host = host
         self.port = port
@@ -84,13 +86,62 @@ class MasterServer:
         from seaweedfs_tpu.stats import DurationCounter
 
         self.request_counter = DurationCounter()  # /stats/counter rolling UI
-        self.is_leader = True
+        # HA: peers (incl. self) => compact raft replicates MaxVolumeId
+        # and elects the write coordinator (reference raft_server.go)
+        self._raft = None
+        peer_list = (
+            [p.strip() for p in peers.split(",") if p.strip()]
+            if isinstance(peers, str)
+            else list(peers or [])
+        )
+        if peer_list:
+            if not raft_dir:
+                # without persisted term/vote a restarted master could
+                # double-vote in a term it already voted in → split brain
+                raise ValueError("peers requires raft_dir (persistent raft state)")
+            from seaweedfs_tpu.cluster.raft import RaftNode
+
+            self._raft = RaftNode(
+                f"{host}:{port}",
+                peer_list,
+                self._apply_cluster_command,
+                data_dir=raft_dir,
+            )
+        self._vid_alloc_lock = threading.Lock()
         self._grow_lock = threading.Lock()
         self._clients: dict[int, queue.Queue] = {}
         self._clients_seq = 0
         self._clients_lock = threading.Lock()
         self._grpc_server: grpc.Server | None = None
         self._http_server: ThreadingHTTPServer | None = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._raft.is_leader if self._raft else True
+
+    def leader_address(self) -> str:
+        hint = self._raft.leader() if self._raft else ""
+        return hint or f"{self.host}:{self.port}"
+
+    def _apply_cluster_command(self, cmd: dict) -> None:
+        """Raft state machine (cluster_commands.go MaxVolumeIdCommand)."""
+        if cmd.get("name") == "MaxVolumeId":
+            self.topology.id_gen.adjust_if_larger(int(cmd["maxVolumeId"]))
+
+    def _next_volume_id(self) -> int:
+        """Allocate the next volume id; with raft, the allocation is
+        replicated to a majority before use (topology.go NextVolumeId →
+        raft Do(MaxVolumeIdCommand))."""
+        if self._raft is None:
+            return self.topology.next_volume_id()
+        with self._vid_alloc_lock:
+            # a freshly elected leader may hold committed-but-unapplied
+            # MaxVolumeId entries from the prior term; drain them before
+            # peeking or the next vid could collide with an existing one
+            self._raft.barrier()
+            vid = self.topology.id_gen.peek() + 1
+            self._raft.propose({"name": "MaxVolumeId", "maxVolumeId": vid})
+            return vid
 
     # ------------------------------------------------------------------
     # location broadcast (master_grpc_server.go KeepConnected)
@@ -111,6 +162,15 @@ class MasterServer:
         stream_token = object()
         try:
             for req in request_iterator:
+                if not self.is_leader:
+                    # redirect before registering: a follower must not
+                    # ingest the node (clients on KeepConnected would
+                    # see the volume map flap on every redirect)
+                    yield pb.HeartbeatResponse(
+                        volume_size_limit=self.topology.volume_size_limit,
+                        leader=self.leader_address(),
+                    )
+                    return
                 if dn is None:
                     dn = self.topology.register_data_node(
                         ip=req.ip,
@@ -146,7 +206,7 @@ class MasterServer:
                     )
                 yield pb.HeartbeatResponse(
                     volume_size_limit=self.topology.volume_size_limit,
-                    leader=f"{self.host}:{self.port}",
+                    leader=self.leader_address(),
                 )
         finally:
             if dn is not None and getattr(dn, "stream_token", None) is stream_token:
@@ -164,7 +224,7 @@ class MasterServer:
         try:
             # ack first so clients learn the leader even on an empty
             # cluster (reference sends leader redirects the same way)
-            q.put(pb.VolumeLocationDelta(leader=f"{self.host}:{self.port}"))
+            q.put(pb.VolumeLocationDelta(leader=self.leader_address()))
             # seed: full current map
             for dn in self.topology.data_nodes():
                 vids = list(dn.volumes) + list(dn.ec_shards)
@@ -281,6 +341,12 @@ class MasterServer:
         ttl: str = "",
         data_center: str = "",
     ) -> dict:
+        if not self.is_leader:
+            # proxy to the leader (master_server.go:151 proxyToLeader):
+            # clients may talk to any master; only the leader assigns
+            return self._proxy_assign(
+                count, replication, collection, ttl, data_center
+            )
         # normalize to the same canonical forms heartbeat registration
         # uses, so both paths land in the same layout
         rp = str(ReplicaPlacement.parse(replication or self.default_replication))
@@ -330,7 +396,7 @@ class MasterServer:
                 )
             except ValueError:
                 break
-            vid = self.topology.next_volume_id()
+            vid = self._next_volume_id()
             ok = True
             for dn in servers:
                 try:
@@ -403,7 +469,8 @@ class MasterServer:
                     return self._json(
                         {
                             "IsLeader": server.is_leader,
-                            "Leader": f"{server.host}:{server.port}",
+                            "Leader": server.leader_address(),
+                            "Peers": server._raft.peers if server._raft else [],
                         }
                     )
                 if url.path == "/dir/status":
@@ -482,13 +549,50 @@ class MasterServer:
         return Handler
 
     # ------------------------------------------------------------------
+    def _proxy_assign(
+        self, count, replication, collection, ttl, data_center
+    ) -> dict:
+        leader = self.leader_address()
+        if leader == f"{self.host}:{self.port}":
+            raise RuntimeError("no leader elected yet")
+        with grpc.insecure_channel(rpc.grpc_address(leader)) as ch:
+            resp = rpc.master_stub(ch).Assign(
+                pb.AssignRequest(
+                    count=count,
+                    replication=replication,
+                    collection=collection,
+                    ttl=ttl,
+                    data_center=data_center,
+                ),
+                timeout=10,
+            )
+        if resp.error:
+            raise RuntimeError(resp.error)
+        return {
+            "fid": resp.fid,
+            "url": resp.url,
+            "publicUrl": resp.public_url,
+            "count": resp.count,
+            **({"auth": resp.auth} if resp.auth else {}),
+        }
+
     def start(self) -> None:
         self._grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         self._grpc_server.add_generic_rpc_handlers(
             (rpc.servicer_handler(rpc.MASTER_SERVICE, rpc.MASTER_METHODS, self),)
         )
+        if self._raft is not None:
+            self._grpc_server.add_generic_rpc_handlers(
+                (
+                    rpc.servicer_handler(
+                        rpc.RAFT_SERVICE, rpc.RAFT_METHODS, self._raft
+                    ),
+                )
+            )
         self._grpc_server.add_insecure_port(f"{self.host}:{self.grpc_port}")
         self._grpc_server.start()
+        if self._raft is not None:
+            self._raft.start()
 
         self._http_server = ThreadingHTTPServer(
             (self.host, self.port), self._http_handler_class()
@@ -496,6 +600,8 @@ class MasterServer:
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
 
     def stop(self) -> None:
+        if self._raft is not None:
+            self._raft.stop()
         if self._http_server:
             self._http_server.shutdown()
             self._http_server.server_close()

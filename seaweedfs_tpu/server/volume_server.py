@@ -92,7 +92,12 @@ class VolumeServer:
         self.host = host
         self.port = port
         self.grpc_port = port + 10000
-        self.master = master
+        # seed masters (comma-separated); self.master tracks the one we
+        # currently talk to and follows leader hints from heartbeats
+        # (volume_grpc_client_to_master.go:34-53)
+        self.seed_masters = [m.strip() for m in master.split(",") if m.strip()] if master else []
+        self.master = self.seed_masters[0] if self.seed_masters else master
+        self._master_rr = 0
         self.public_url = public_url or f"{host}:{port}"
         self.data_center = data_center
         self.rack = rack
@@ -155,10 +160,23 @@ class VolumeServer:
                     for resp in stub.Heartbeat(self._heartbeat_requests()):
                         if resp.volume_size_limit:
                             self.volume_size_limit = resp.volume_size_limit
+                        if resp.leader and resp.leader != self.master:
+                            # follow the leader hint: reconnect there
+                            self.master = resp.leader
+                            break
                         if self._stop.is_set():
                             return
+                    else:
+                        # stream ended cleanly (e.g. a leaderless
+                        # follower redirecting to itself): back off so
+                        # election windows don't become a reconnect storm
+                        self._stop.wait(0.2)
             except grpc.RpcError:
-                self._stop.wait(1.0)
+                # rotate through the seed masters until one answers
+                if len(self.seed_masters) > 1:
+                    self._master_rr = (self._master_rr + 1) % len(self.seed_masters)
+                    self.master = self.seed_masters[self._master_rr]
+                self._stop.wait(0.2 if len(self.seed_masters) > 1 else 1.0)
 
     def _master_grpc(self) -> str:
         host, _, port = self.master.partition(":")

@@ -41,6 +41,12 @@ class IdGenerator:
             self._max_vid += 1
             return self._max_vid
 
+    def peek(self) -> int:
+        """Current max without allocating (raft leaders propose
+        peek()+1 and let the replicated apply advance it)."""
+        with self._lock:
+            return self._max_vid
+
     def adjust_if_larger(self, vid: int) -> None:
         with self._lock:
             if vid > self._max_vid:
