@@ -246,7 +246,10 @@ class RaftNode:
             return rpb.AppendEntriesResponse(
                 term=self.current_term,
                 success=True,
-                match_index=self._last_log_index(),
+                # only what THIS request proved replicated: stale
+                # entries past prev+entries may conflict with the
+                # leader's log and must not count toward commit
+                match_index=req.prev_log_index + len(req.entries),
             )
 
     # ------------------------------------------------------------------

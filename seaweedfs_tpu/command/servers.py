@@ -119,6 +119,7 @@ class VolumeCommand(Command):
 
     def run(self, args) -> int:
         from seaweedfs_tpu.server.volume_server import VolumeServer
+        from seaweedfs_tpu.util.config import load_config
 
         wlog.set_verbosity(args.v)
         dirs = args.dir.split(",")
@@ -137,6 +138,7 @@ class VolumeCommand(Command):
             read_redirect=args.readRedirect,
             guard=_load_guard(),
             ec_codec=args.ec_codec,
+            storage_backends=load_config("master").sub("storage.backend"),
         )
         server.start()
         wlog.info("volume server %s:%d -> master %s", args.ip, args.port, args.mserver)

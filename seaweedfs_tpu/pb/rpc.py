@@ -99,6 +99,16 @@ VOLUME_METHODS = {
         v.VolumeEcShardsToVolumeResponse,
         UNARY_UNARY,
     ),
+    "VolumeTierMoveDatToRemote": (
+        v.VolumeTierMoveDatToRemoteRequest,
+        v.VolumeTierMoveDatToRemoteResponse,
+        UNARY_STREAM,
+    ),
+    "VolumeTierMoveDatFromRemote": (
+        v.VolumeTierMoveDatFromRemoteRequest,
+        v.VolumeTierMoveDatFromRemoteResponse,
+        UNARY_STREAM,
+    ),
 }
 
 

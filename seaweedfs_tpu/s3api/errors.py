@@ -56,6 +56,9 @@ ERRORS = {
         400,
         "One or more of the specified parts could not be found.",
     ),
+    "InvalidRange": _err(
+        "InvalidRange", 416, "The requested range is not satisfiable"
+    ),
     "InvalidPartOrder": _err(
         "InvalidPartOrder",
         400,

@@ -411,14 +411,35 @@ class S3ApiServer:
                 data, mime = server._get_from_filer(
                     [server.buckets_path.lstrip("/"), bucket] + key.split("/")
                 )
-                self._send(
-                    200,
-                    data,
-                    {
-                        "Content-Type": mime or "application/octet-stream",
-                        "ETag": f'"{hashlib.md5(data).hexdigest()}"',
-                    },
-                )
+                headers = {
+                    "Content-Type": mime or "application/octet-stream",
+                    "ETag": f'"{hashlib.md5(data).hexdigest()}"',
+                    "Accept-Ranges": "bytes",
+                }
+                rng = self.headers.get("Range", "")
+                if rng.startswith("bytes="):
+                    total = len(data)
+                    spec = rng[6:].split(",")[0].strip()
+                    start_s, _, end_s = spec.partition("-")
+                    try:
+                        if start_s == "":  # suffix: last N bytes
+                            n = int(end_s)
+                            start, end = max(0, total - n), total - 1
+                        else:
+                            start = int(start_s)
+                            end = int(end_s) if end_s else total - 1
+                    except ValueError:
+                        raise s3_error("InvalidRange") from None
+                    if start >= total or start > end:
+                        self._send(
+                            416, b"", {"Content-Range": f"bytes */{total}"}
+                        )
+                        return
+                    end = min(end, total - 1)
+                    headers["Content-Range"] = f"bytes {start}-{end}/{total}"
+                    self._send(206, data[start : end + 1], headers)
+                    return
+                self._send(200, data, headers)
 
             def _head_object(self, bucket: str, key: str):
                 directory, _, name = f"{server.buckets_path}/{bucket}/{key}".rpartition("/")

@@ -81,6 +81,7 @@ class VolumeServer:
         read_redirect: bool = False,
         guard=None,
         ec_codec: str = "",
+        storage_backends: dict | None = None,
     ):
         # `ec.codec` config: "cpu" | "tpu" | "" (auto: tpu when a JAX
         # device is present). Threaded into every server-side EC code
@@ -88,6 +89,14 @@ class VolumeServer:
         # back to a volume, and degraded-read reconstruction
         # (store_ec.go:364 enc.ReconstructData).
         self.ec_codec = ec_codec or None
+        if storage_backends:
+            # remote-tier backends (storage.backend config tree; the
+            # reference ships this from master config in heartbeats,
+            # backend.go:78-97)
+            from seaweedfs_tpu.storage import backend as _bk
+
+            _bk.ensure_builtin_factories()
+            _bk.load_backend_config(storage_backends)
         self.store = Store(directories, max_volume_counts, ec_backend=self.ec_codec)
         self.host = host
         self.port = port
@@ -514,6 +523,67 @@ class VolumeServer:
             os.path.dirname(base) or ".", req.volume_id, req.collection, create=False
         )
         return pb.VolumeEcShardsToVolumeResponse()
+
+    # ------------------------------------------------------------------
+    # tiered storage (volume_grpc_tier_upload.go:14 / tier_download.go)
+    def VolumeTierMoveDatToRemote(self, req, context):
+        """Copy a sealed volume's .dat to a remote backend, streaming
+        progress; the volume then serves reads via ranged GETs."""
+        v = self.store.find_volume(req.volume_id)
+        if v is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"volume {req.volume_id} not found"
+            )
+        if v.collection != req.collection:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"existing collection {v.collection!r} != {req.collection!r}",
+            )
+        updates: list = []
+
+        def progress(done: int, pct: float) -> None:
+            updates.append((done, pct))
+
+        try:
+            v.tier_upload(
+                req.destination_backend_name,
+                keep_local=req.keep_local_dat_file,
+                progress=progress,
+            )
+        except (RuntimeError, OSError) as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        for done, pct in updates:
+            yield pb.VolumeTierMoveDatToRemoteResponse(
+                processed=done, processed_percentage=pct
+            )
+
+    def VolumeTierMoveDatFromRemote(self, req, context):
+        """Bring a tiered volume's .dat back to local disk."""
+        v = self.store.find_volume(req.volume_id)
+        if v is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"volume {req.volume_id} not found"
+            )
+        if v.collection != req.collection:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"existing collection {v.collection!r} != {req.collection!r}",
+            )
+        updates: list = []
+
+        def progress(done: int, pct: float) -> None:
+            updates.append((done, pct))
+
+        try:
+            v.tier_download(
+                keep_remote=req.keep_remote_dat_file, progress=progress
+            )
+        except (RuntimeError, OSError) as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        for done, pct in updates:
+            yield pb.VolumeTierMoveDatFromRemoteResponse(
+                processed=done, processed_percentage=pct
+            )
 
     # ------------------------------------------------------------------
     # remote shard fetch for degraded reads (store_ec.go:260-316)

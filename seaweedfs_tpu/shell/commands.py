@@ -679,3 +679,75 @@ class Help(Command):
     def run(self, env, args, out):
         for name in sorted(COMMANDS):
             print(f"{name:28s} {COMMANDS[name].help}", file=out)
+
+
+# ----------------------------------------------------------------------
+# tiered storage (command_volume_tier_upload.go / _download.go)
+
+
+def _find_volume_node(env: CommandEnv, vid: int) -> str:
+    for n in env.collect_topology().nodes:
+        for v in n.volumes:
+            if v["Id"] == vid:
+                return n.url
+    raise ValueError(f"volume {vid} not found on any node")
+
+
+@register
+class VolumeTierUpload(Command):
+    name = "volume.tier.upload"
+    help = (
+        "volume.tier.upload -volumeId <vid> -dest <backendName> "
+        "[-keepLocalDatFile] — move a sealed volume's .dat to a remote tier"
+    )
+
+    def run(self, env, args, out):
+        vid = int(_flag(args, "volumeId"))
+        dest = _flag(args, "dest")
+        if not dest:
+            raise ValueError("-dest <backendName> required (e.g. s3.default)")
+        node = _flag(args, "node") or _find_volume_node(env, vid)
+        collection = _flag(args, "collection") or _lookup_collection(env, vid)
+        with env.volume_channel(node) as ch:
+            for resp in rpc.volume_stub(ch).VolumeTierMoveDatToRemote(
+                volume_pb2.VolumeTierMoveDatToRemoteRequest(
+                    volume_id=vid,
+                    collection=collection,
+                    destination_backend_name=dest,
+                    keep_local_dat_file=_has_flag(args, "keepLocalDatFile"),
+                )
+            ):
+                print(
+                    f"uploaded {resp.processed} bytes "
+                    f"({resp.processed_percentage:.0f}%)",
+                    file=out,
+                )
+        print(f"volume {vid} dat moved to {dest}", file=out)
+
+
+@register
+class VolumeTierDownload(Command):
+    name = "volume.tier.download"
+    help = (
+        "volume.tier.download -volumeId <vid> [-keepRemoteDatFile] — "
+        "bring a tiered volume's .dat back to local disk"
+    )
+
+    def run(self, env, args, out):
+        vid = int(_flag(args, "volumeId"))
+        node = _flag(args, "node") or _find_volume_node(env, vid)
+        collection = _flag(args, "collection") or _lookup_collection(env, vid)
+        with env.volume_channel(node) as ch:
+            for resp in rpc.volume_stub(ch).VolumeTierMoveDatFromRemote(
+                volume_pb2.VolumeTierMoveDatFromRemoteRequest(
+                    volume_id=vid,
+                    collection=collection,
+                    keep_remote_dat_file=_has_flag(args, "keepRemoteDatFile"),
+                )
+            ):
+                print(
+                    f"downloaded {resp.processed} bytes "
+                    f"({resp.processed_percentage:.0f}%)",
+                    file=out,
+                )
+        print(f"volume {vid} dat restored locally", file=out)

@@ -54,6 +54,10 @@ class DiskLocation:
         os.makedirs(self.directory, exist_ok=True)
         for name in sorted(os.listdir(self.directory)):
             parsed = parse_volume_file_name(name)
+            if parsed is None and name.endswith(".vif"):
+                # remote-tiered volume: .dat lives in a backend; the
+                # .vif + .idx are enough to load it read-only
+                parsed = parse_volume_file_name(name[:-4] + ".dat")
             if parsed is None:
                 continue
             collection, vid = parsed

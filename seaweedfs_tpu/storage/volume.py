@@ -58,6 +58,51 @@ def volume_base_name(directory: str, collection: str, vid: int) -> str:
     return os.path.join(directory, str(vid))
 
 
+class _FileLikeOverBackend:
+    """File-object protocol (seek/read/tell) over a BackendStorageFile,
+    so the Volume read path works unchanged on remote-tier volumes.
+    Writes raise: tiered volumes are sealed."""
+
+    def __init__(self, bsf):
+        self._bsf = bsf
+        self._pos = 0
+
+    def seek(self, offset: int, whence: int = os.SEEK_SET) -> int:
+        if whence == os.SEEK_END:
+            size, _ = self._bsf.get_stat()
+            self._pos = size + offset
+        elif whence == os.SEEK_CUR:
+            self._pos += offset
+        else:
+            self._pos = offset
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            size, _ = self._bsf.get_stat()
+            n = max(0, size - self._pos)
+        if n == 0:
+            return b""
+        data = self._bsf.read_at(n, self._pos)
+        self._pos += len(data)
+        return data
+
+    def write(self, data: bytes) -> int:
+        raise VolumeReadOnly("remote-tier volume is sealed")
+
+    def truncate(self, size: int) -> None:
+        raise VolumeReadOnly("remote-tier volume is sealed")
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        self._bsf.close()
+
+
 class Volume:
     def __init__(
         self,
@@ -78,7 +123,24 @@ class Volume:
         self._lock = threading.RLock()
 
         dat_path = self.base_name + ".dat"
+        # tier metadata: a .vif with remote files means the sealed .dat
+        # lives in a remote backend (volume_info.go MaybeLoadVolumeInfo)
+        from seaweedfs_tpu.storage import volume_info as vif
+
+        self.volume_info, has_remote = vif.maybe_load_volume_info(
+            self.base_name + ".vif"
+        )
         exists = os.path.exists(dat_path)
+        if has_remote and not exists:
+            self._open_remote_dat()
+            self.read_only = True
+            self.super_block = SuperBlock.read_from(self._dat)
+            self.nm = CompactNeedleMap.load(self.base_name + ".idx")
+            return
+        if has_remote:
+            # keep_local_dat_file case: a local copy exists alongside
+            # the remote one — it must stay sealed or the copies diverge
+            self.read_only = True
         if not exists:
             if not create:
                 raise FileNotFoundError(dat_path)
@@ -95,6 +157,109 @@ class Volume:
         self.nm = CompactNeedleMap.load(self.base_name + ".idx")
         if exists:
             self._check_integrity()
+
+    # --- remote tier (backend.go + volume_grpc_tier_*.go) ---
+    def _open_remote_dat(self) -> None:
+        from seaweedfs_tpu.storage import backend as bk
+
+        bk.ensure_builtin_factories()
+        rf = self.volume_info.files[0]
+        storage = bk.get_backend(rf.backend_name)
+        if storage is None:
+            raise RuntimeError(
+                f"volume {self.id}: remote backend {rf.backend_name!r} is "
+                f"not configured (storage.backend config)"
+            )
+        self._dat = _FileLikeOverBackend(
+            storage.new_storage_file(rf.key, rf.file_size)
+        )
+
+    def has_remote_file(self) -> bool:
+        return self.volume_info.has_remote_file()
+
+    def tier_upload(
+        self, backend_name: str, keep_local: bool = False, progress=None
+    ) -> tuple[str, int]:
+        """Move this (sealed) volume's .dat to a remote backend
+        (VolumeTierMoveDatToRemote, volume_grpc_tier_upload.go:14)."""
+        from seaweedfs_tpu.storage import backend as bk
+        from seaweedfs_tpu.storage import volume_info as vif
+
+        bk.ensure_builtin_factories()
+        storage = bk.get_backend(backend_name)
+        if storage is None:
+            raise RuntimeError(
+                f"destination {backend_name!r} not found; configured: "
+                f"{sorted(bk.BACKEND_STORAGES)}"
+            )
+        for rf in self.volume_info.files:
+            if rf.backend_name == storage.name:
+                raise RuntimeError(f"destination {backend_name} already exists")
+        with self._lock:
+            was_read_only = self.read_only
+            self.read_only = True
+            self._dat.flush()
+            dat_path = self.base_name + ".dat"
+            attributes = {
+                "volumeId": str(self.id),
+                "collection": self.collection,
+                "ext": ".dat",
+            }
+            try:
+                key, size = storage.copy_file(dat_path, attributes, progress)
+            except Exception:
+                # failed upload must not leave the volume wedged
+                # rejecting writes with no .vif written
+                self.read_only = was_read_only
+                raise
+            self.volume_info.files.append(
+                vif.RemoteFile(
+                    backend_type=storage.storage_type,
+                    backend_id=storage.id,
+                    key=key,
+                    file_size=size,
+                    modified_time=int(time.time()),
+                    extension=".dat",
+                )
+            )
+            vif.save_volume_info(self.base_name + ".vif", self.volume_info)
+            if not keep_local:
+                self._dat.close()
+                os.remove(dat_path)
+                self._open_remote_dat()
+            return key, size
+
+    def tier_download(self, keep_remote: bool = False, progress=None) -> int:
+        """Bring a tiered volume's .dat back to local disk
+        (VolumeTierMoveDatFromRemote, volume_grpc_tier_download.go)."""
+        from seaweedfs_tpu.storage import backend as bk
+        from seaweedfs_tpu.storage import volume_info as vif
+
+        if not self.volume_info.has_remote_file():
+            raise RuntimeError(f"volume {self.id} has no remote file")
+        bk.ensure_builtin_factories()
+        rf = self.volume_info.files[0]
+        storage = bk.get_backend(rf.backend_name)
+        if storage is None:
+            raise RuntimeError(f"backend {rf.backend_name!r} not configured")
+        with self._lock:
+            dat_path = self.base_name + ".dat"
+            size = storage.download_file(dat_path, rf.key, progress)
+            self._dat.close()
+            self._dat = open(dat_path, "r+b")
+            if not keep_remote:
+                storage.delete_file(rf.key)
+                self.volume_info.files.remove(rf)
+            if self.volume_info.has_remote_file():
+                vif.save_volume_info(self.base_name + ".vif", self.volume_info)
+            else:
+                self.volume_info.files.clear()
+                try:
+                    os.remove(self.base_name + ".vif")
+                except FileNotFoundError:
+                    pass
+            self.read_only = False
+            return size
 
     # --- properties ---
     @property
