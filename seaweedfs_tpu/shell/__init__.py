@@ -1,4 +1,5 @@
 from seaweedfs_tpu.shell.command_env import CommandEnv
 from seaweedfs_tpu.shell.commands import COMMANDS, run_command
+from seaweedfs_tpu.shell import fs_commands  # noqa: F401  (registers fs.*)
 
 __all__ = ["CommandEnv", "COMMANDS", "run_command"]

@@ -38,10 +38,38 @@ class TopologyDump:
 class CommandEnv:
     def __init__(self, masters: list[str]):
         self.masters = list(masters)
+        # fs.* context (commands.go CommandEnv option.FilerHost/directory):
+        # set by `fs.cd http://<filer>:<port>/path`; subsequent relative
+        # fs paths resolve against (filer, cwd)
+        self.filer: str = ""
+        self.cwd: str = "/"
 
     @property
     def master(self) -> str:
         return self.masters[0]
+
+    # ------------------------------------------------------------------
+    # fs path resolution (commandEnv.parseUrl, commands.go:54-113)
+    def parse_fs_path(self, input_path: str) -> tuple[str, str]:
+        """'http://filer:8888/a/b' | '/a/b' | 'b' → (filer, abs path)."""
+        import posixpath
+        import urllib.parse
+
+        if input_path.startswith(("http://", "https://")):
+            u = urllib.parse.urlparse(input_path)
+            return u.netloc, posixpath.normpath(u.path or "/")
+        if not self.filer:
+            raise ValueError(
+                "no filer selected; use fs.cd http://<filer>:<port>/path first"
+            )
+        if input_path.startswith("/"):
+            return self.filer, posixpath.normpath(input_path)
+        return self.filer, posixpath.normpath(
+            posixpath.join(self.cwd, input_path)
+        )
+
+    def filer_channel(self, filer: str) -> grpc.Channel:
+        return grpc.insecure_channel(grpc_address(filer))
 
     # ------------------------------------------------------------------
     def master_stub(self, ch: grpc.Channel) -> rpc.Stub:

@@ -1,10 +1,15 @@
 """Admin shell commands.
 
-Behavioral match of weed/shell/ (31-command REPL). Implemented set:
+Behavioral match of weed/shell/ (the reference's full REPL command set).
+Implemented here:
   ec.encode  ec.decode  ec.rebuild  ec.balance
   volume.balance  volume.fix.replication  volume.vacuum  volume.list
   volume.delete  volume.mount  volume.unmount  volume.move  volume.copy
-  collection.list  collection.delete  fs.* live in shell/fs_commands.py
+  volume.tier.upload  volume.tier.download
+  collection.list  collection.delete
+The 11 fs.* commands (cd/pwd/ls/du/cat/tree/mv/meta.cat/meta.save/
+meta.load/meta.notify) live in shell/fs_commands.py, registered on
+import by shell/__init__.py.
 
 Each command is `run(env, args, out) -> None`, printing human output to
 `out` (an io.TextIOBase). Planners accept -force/-apply the same way the

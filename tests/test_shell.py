@@ -354,3 +354,135 @@ class TestShellPipeline:
         outputs = runner.run_once()
         assert len(outputs) == 2
         assert all("unknown command" not in o for o in outputs)
+
+
+class TestFsCommands:
+    """fs.* against a live filer (command_fs_*.go role)."""
+
+    @pytest.fixture(scope="class")
+    def fs_env(self, tmp_path_factory):
+        import socket
+        import time as _time
+
+        from seaweedfs_tpu.server.filer_server import FilerServer
+        from seaweedfs_tpu.server.master_server import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+        from seaweedfs_tpu.shell import CommandEnv
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        master = MasterServer(port=free_port(), volume_size_limit_mb=64)
+        master.start()
+        vs = VolumeServer(
+            [str(tmp_path_factory.mktemp("fsvs"))],
+            port=free_port(),
+            master=f"127.0.0.1:{master.port}",
+            heartbeat_interval=0.2,
+            max_volume_counts=[100],
+        )
+        vs.start()
+        deadline = _time.time() + 10
+        while _time.time() < deadline and len(master.topology.data_nodes()) < 1:
+            _time.sleep(0.05)
+        filer = FilerServer(
+            [f"127.0.0.1:{master.port}"], port=free_port(), store="memory"
+        )
+        filer.start()
+
+        # seed a small namespace through the filer HTTP API
+        import urllib.request
+
+        for path, data in [
+            ("/docs/a.txt", b"alpha"),
+            ("/docs/b.txt", b"beta beta"),
+            ("/docs/sub/c.txt", b"gamma!"),
+            ("/top.txt", b"root file"),
+        ]:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{filer.port}{path}", data=data, method="POST"
+            )
+            urllib.request.urlopen(req, timeout=10).close()
+
+        env = CommandEnv([f"127.0.0.1:{master.port}"])
+        yield env, filer
+        filer.stop()
+        vs.stop()
+        master.stop()
+
+    def _run(self, env, line):
+        from seaweedfs_tpu.shell import run_command
+
+        return run_command(env, line)
+
+    def test_cd_pwd_ls(self, fs_env):
+        env, filer = fs_env
+        self._run(env, f"fs.cd http://127.0.0.1:{filer.port}/docs")
+        assert env.filer == f"127.0.0.1:{filer.port}"
+        assert env.cwd == "/docs"
+        assert f"/docs" in self._run(env, "fs.pwd")
+        listing = self._run(env, "fs.ls")
+        assert "a.txt" in listing and "sub/" in listing
+        long_listing = self._run(env, "fs.ls -l")
+        assert "total" in long_listing
+
+    def test_du_and_tree(self, fs_env):
+        env, filer = fs_env
+        self._run(env, f"fs.cd http://127.0.0.1:{filer.port}/")
+        du = self._run(env, "fs.du /docs")
+        assert "3 files" in du
+        tree = self._run(env, "fs.tree /docs")
+        assert "└──" in tree or "├──" in tree
+        assert "c.txt" in tree
+
+    def test_cat(self, fs_env):
+        env, filer = fs_env
+        self._run(env, f"fs.cd http://127.0.0.1:{filer.port}/")
+        assert self._run(env, "fs.cat /docs/a.txt") == "alpha"
+
+    def test_mv(self, fs_env):
+        # own subtree: /docs must stay untouched for the other tests
+        import urllib.request
+
+        env, filer = fs_env
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{filer.port}/mvsrc/top.txt",
+            data=b"root file",
+            method="POST",
+        )
+        urllib.request.urlopen(req, timeout=10).close()
+        self._run(env, f"fs.cd http://127.0.0.1:{filer.port}/")
+        self._run(env, "fs.mv /mvsrc/top.txt /mvsrc/renamed.txt")
+        assert "renamed.txt" in self._run(env, "fs.ls /mvsrc")
+        assert self._run(env, "fs.cat /mvsrc/renamed.txt") == "root file"
+
+    def test_meta_cat_save_load(self, fs_env, tmp_path):
+        import grpc
+
+        from seaweedfs_tpu.pb import filer_pb2 as fpb
+        from seaweedfs_tpu.pb import rpc as _rpc
+
+        env, filer = fs_env
+        self._run(env, f"fs.cd http://127.0.0.1:{filer.port}/")
+        meta = self._run(env, "fs.meta.cat /docs/a.txt")
+        assert "a.txt" in meta
+        out_file = str(tmp_path / "docs.meta")
+        saved = self._run(env, f"fs.meta.save -o {out_file} /docs")
+        assert "saved" in saved
+
+        # delete an entry's metadata, then load restores it
+        with grpc.insecure_channel(
+            f"127.0.0.1:{filer.port + 10000}"
+        ) as ch:
+            _rpc.filer_stub(ch).DeleteEntry(
+                fpb.DeleteEntryRequest(
+                    directory="/docs", name="a.txt", is_delete_data=False
+                )
+            )
+        assert "a.txt" not in self._run(env, "fs.ls /docs")
+        loaded = self._run(env, f"fs.meta.load {out_file}")
+        assert "loaded" in loaded
+        assert "a.txt" in self._run(env, "fs.ls /docs")
+        assert self._run(env, "fs.cat /docs/a.txt") == "alpha"
