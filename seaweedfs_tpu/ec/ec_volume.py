@@ -23,6 +23,8 @@ quirk baked into locate.py (large rows recoverable from shard size).
 from __future__ import annotations
 
 import os
+import threading
+from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Callable, Optional
 
 import numpy as np
@@ -92,6 +94,12 @@ class EcVolume:
         self.backend = backend
         self._rs: ReedSolomon | None = None
         self.version = 3
+        # health-tiered shard-location cache (store_ec.go:218-259):
+        # the serving layer fills this from the master's LookupEcVolume
+        # and forgets locations whose reads fail
+        self.shard_locations: dict[int, list[str]] = {}
+        self.shard_locations_lock = threading.Lock()
+        self.shard_locations_refresh_time = 0.0
 
     # --- mounting (disk_location_ec.go) ---
     @classmethod
@@ -196,25 +204,41 @@ class EcVolume:
     def _reconstruct_interval(
         self, target_shard: int, offset: int, size: int, fetch: ShardFetcher | None
     ) -> bytes:
-        """Rebuild one shard interval from any k available shards
-        (store_ec.go:319 recoverOneRemoteEcShardInterval)."""
+        """Rebuild one shard interval from any k available shards,
+        fetching remote survivors with one parallel fan-out round
+        (store_ec.go:319-359 recoverOneRemoteEcShardInterval's
+        goroutine-per-shard gather)."""
         k = self.rs.data_shards
         shards: list[Optional[np.ndarray]] = [None] * self.rs.total_shards
         available = 0
-        for sid in range(self.rs.total_shards):
-            if available >= k:
-                break
+        # snapshot: mount/unmount RPCs mutate self.shards concurrently
+        for sid, local in list(self.shards.items()):
             if sid == target_shard:
                 continue
-            local = self.shards.get(sid)
-            if local is not None:
-                shards[sid] = np.frombuffer(local.read_at(offset, size), dtype=np.uint8)
-                available += 1
-            elif fetch is not None:
-                data = fetch(sid, offset, size)
-                if data is not None:
-                    shards[sid] = np.frombuffer(data, dtype=np.uint8)
-                    available += 1
+            if available >= k:
+                break  # the codec uses the first k survivors only
+            shards[sid] = np.frombuffer(
+                local.read_at(offset, size), dtype=np.uint8
+            )
+            available += 1
+        missing = [
+            sid
+            for sid in range(self.rs.total_shards)
+            if shards[sid] is None and sid != target_shard
+        ]
+        if fetch is not None and available < k and missing:
+            with ThreadPoolExecutor(max_workers=len(missing)) as pool:
+                futures = {
+                    pool.submit(fetch, sid, offset, size): sid for sid in missing
+                }
+                for fut in as_completed(futures):
+                    try:
+                        data = fut.result()
+                    except Exception:  # noqa: BLE001 - a failed fetch is a miss
+                        data = None
+                    if data is not None and len(data) == size:
+                        shards[futures[fut]] = np.frombuffer(data, dtype=np.uint8)
+            available = sum(1 for s in shards if s is not None)
         if available < k:
             raise NotEnoughShards(
                 f"vid {self.volume_id}: only {available} of {k} shards reachable "

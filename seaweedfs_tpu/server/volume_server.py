@@ -586,28 +586,72 @@ class VolumeServer:
             )
 
     # ------------------------------------------------------------------
-    # remote shard fetch for degraded reads (store_ec.go:260-316)
-    def _remote_shard_fetcher(self, vid: int):
-        locations: dict[int, list[str]] = {}
+    # remote shard fetch for degraded reads (store_ec.go:197-316)
+    # shard-location cache tiers (store_ec.go:218-259): unhealthy
+    # volumes (< k shards known) re-poll fast; healthy ones slowly
+    _EC_LOC_TTL_UNHEALTHY = 11.0
+    _EC_LOC_TTL_DEGRADED = 7 * 60.0
+    _EC_LOC_TTL_FULL = 37 * 60.0
 
-        def ensure_locations():
-            if locations or not self.master:
+    def _cached_lookup_ec_locations(self, ev) -> None:
+        """Refresh ev.shard_locations from the master when stale
+        (cachedLookupEcShardLocations, store_ec.go:218-259)."""
+        now = time.time()
+        with ev.shard_locations_lock:
+            count = len(ev.shard_locations)
+            age = now - ev.shard_locations_refresh_time
+            if count >= 14:
+                ttl = self._EC_LOC_TTL_FULL
+            elif count >= 10:
+                ttl = self._EC_LOC_TTL_DEGRADED
+            else:
+                ttl = self._EC_LOC_TTL_UNHEALTHY
+            if age < ttl:
                 return
-            try:
-                with grpc.insecure_channel(self._master_grpc()) as ch:
-                    resp = rpc.master_stub(ch).LookupEcVolume(
-                        master_pb2.LookupEcVolumeRequest(volume_id=vid), timeout=5
-                    )
-                for entry in resp.shard_id_locations:
-                    locations[entry.shard_id] = [l.url for l in entry.locations]
-            except grpc.RpcError:
-                pass
+        if not self.master:
+            return
+        try:
+            with grpc.insecure_channel(self._master_grpc()) as ch:
+                resp = rpc.master_stub(ch).LookupEcVolume(
+                    master_pb2.LookupEcVolumeRequest(volume_id=ev.volume_id),
+                    timeout=5,
+                )
+        except grpc.RpcError:
+            return
+        with ev.shard_locations_lock:
+            for entry in resp.shard_id_locations:
+                ev.shard_locations[entry.shard_id] = [
+                    l.url for l in entry.locations
+                ]
+            ev.shard_locations_refresh_time = time.time()
+
+    @staticmethod
+    def _forget_shard_id(ev, shard_id: int) -> None:
+        """Drop a shard's cached locations after a failed read; the
+        next unhealthy-tier refresh re-learns them (forgetShardId,
+        store_ec.go:211-216)."""
+        with ev.shard_locations_lock:
+            ev.shard_locations.pop(shard_id, None)
+
+    def _remote_shard_fetcher(self, ev):
+        """fetch(shard_id, offset, size) against the EC volume's cached
+        shard locations, forgetting locations whose reads fail. Safe to
+        call concurrently (the reconstruction fan-out runs one fetch
+        per missing shard in parallel)."""
+
+        # refresh once up front: the reconstruction fan-out calls
+        # fetch() from up to 13 threads at once, and each doing its own
+        # cold-cache LookupEcVolume would hammer the master
+        self._cached_lookup_ec_locations(ev)
 
         def fetch(shard_id: int, offset: int, size: int):
-            ensure_locations()
-            for url in locations.get(shard_id, []):
+            with ev.shard_locations_lock:
+                urls = list(ev.shard_locations.get(shard_id, []))
+            attempted = False
+            for url in urls:
                 if url == f"{self.host}:{self.port}":
                     continue
+                attempted = True
                 host, _, port = url.partition(":")
                 try:
                     with grpc.insecure_channel(f"{host}:{int(port) + 10000}") as ch:
@@ -615,7 +659,7 @@ class VolumeServer:
                             r.data
                             for r in rpc.volume_stub(ch).VolumeEcShardRead(
                                 pb.VolumeEcShardReadRequest(
-                                    volume_id=vid,
+                                    volume_id=ev.volume_id,
                                     shard_id=shard_id,
                                     offset=offset,
                                     size=size,
@@ -626,6 +670,8 @@ class VolumeServer:
                     return b"".join(chunks)
                 except grpc.RpcError:
                     continue
+            if attempted:
+                self._forget_shard_id(ev, shard_id)
             return None
 
         return fetch
@@ -724,7 +770,7 @@ class VolumeServer:
                                 )
                             return self._json({"error": "volume not found"}, 404)
                         n = ev.read_needle(
-                            fid.key, fetch=server._remote_shard_fetcher(fid.volume_id)
+                            fid.key, fetch=server._remote_shard_fetcher(ev)
                         )
                         if n.cookie != fid.cookie:
                             raise CookieMismatch("cookie mismatch")
@@ -841,7 +887,7 @@ class VolumeServer:
                         # same cookie gate as the normal-volume branch
                         existing = ev.read_needle(
                             fid.key,
-                            fetch=server._remote_shard_fetcher(fid.volume_id),
+                            fetch=server._remote_shard_fetcher(ev),
                         )
                         if existing.cookie != fid.cookie:
                             raise CookieMismatch("cookie mismatch")

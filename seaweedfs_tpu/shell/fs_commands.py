@@ -86,6 +86,19 @@ def _walk(stub, directory: str):
             yield from _walk(stub, child)
 
 
+def _walk_path(stub, path: str):
+    """_walk that also accepts a single-file path (a backup tool must
+    not silently save 0 entries for an existing file)."""
+    if _is_dir(stub, path):
+        yield from _walk(stub, path)
+        return
+    d, name = posixpath.split(path)
+    e = _lookup(stub, d or "/", name)
+    if e is None:
+        raise ValueError(f"{path} not found")
+    yield d or "/", e
+
+
 @register
 class FsCd(Command):
     name = "fs.cd"
@@ -296,7 +309,7 @@ class FsMetaSave(Command):
         with _stub(env, filer) as ch, open(out_file, "wb") as f:
             stub = rpc.filer_stub(ch)
             f.write(_META_MAGIC)
-            for directory, e in _walk(stub, path):
+            for directory, e in _walk_path(stub, path):
                 blob = fpb.FullEntry(dir=directory, entry=e).SerializeToString()
                 f.write(struct.pack(">I", len(blob)))
                 f.write(blob)
@@ -350,7 +363,7 @@ class FsMetaNotify(Command):
         count = 0
         with _stub(env, filer) as ch:
             stub = rpc.filer_stub(ch)
-            for directory, e in _walk(stub, path):
+            for directory, e in _walk_path(stub, path):
                 queue.send_message(
                     f"{directory.rstrip('/')}/{e.name}",
                     fpb.EventNotification(new_entry=e),

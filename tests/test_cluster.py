@@ -424,3 +424,116 @@ class TestJwtSignedWrites:
         )
         assert not res.error
         assert res.fid
+
+
+class TestDegradedParallelRead:
+    """The needle's data lives in shard 0's stripe. Shard 0 is placed
+    ONLY on a sacrificial server: healthy reads fetch it remotely;
+    after killing that server the read must reconstruct shard 0's
+    interval from the 13 surviving shards in one parallel fan-out
+    round, and the dead location is forgotten
+    (store_ec.go:319-359 + forgetShardId/cache tiers)."""
+
+    def test_read_after_losing_shard_holder(self, cluster, tmp_path_factory):
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+
+        master, volume_servers = cluster
+        # write the needle BEFORE the sacrificial server joins, so the
+        # assign can only land on the long-lived fixture servers
+        _, assign = http_json(master_url(master, "/dir/assign?collection=ecd"))
+        url = f"http://{assign['url']}/{assign['fid']}"
+        payload = b"degraded parallel read " * 700
+        urllib.request.urlopen(
+            urllib.request.Request(url, data=payload, method="POST"), timeout=10
+        ).close()
+        vid = int(assign["fid"].split(",")[0])
+        source = next(
+            v for v in volume_servers if f"127.0.0.1:{v.port}" == assign["url"]
+        )
+        peer = next(v for v in volume_servers if v is not source)
+
+        extra = VolumeServer(
+            [str(tmp_path_factory.mktemp("sacrifice"))],
+            port=free_port(),
+            master=f"127.0.0.1:{master.port}",
+            heartbeat_interval=0.2,
+            max_volume_counts=[100],
+        )
+        extra.start()
+        deadline = time.time() + 10
+        while time.time() < deadline and len(master.topology.data_nodes()) < 4:
+            time.sleep(0.05)
+
+        with grpc.insecure_channel(f"127.0.0.1:{source.grpc_port}") as ch:
+            stub = rpc.volume_stub(ch)
+            stub.VolumeMarkReadonly(volume_pb2.VolumeMarkReadonlyRequest(volume_id=vid))
+            stub.VolumeEcShardsGenerate(
+                volume_pb2.VolumeEcShardsGenerateRequest(volume_id=vid, collection="ecd")
+            )
+
+        def copy_mount(target, shard_ids):
+            with grpc.insecure_channel(f"127.0.0.1:{target.grpc_port}") as ch:
+                rpc.volume_stub(ch).VolumeEcShardsCopy(
+                    volume_pb2.VolumeEcShardsCopyRequest(
+                        volume_id=vid,
+                        collection="ecd",
+                        shard_ids=shard_ids,
+                        copy_ecx_file=True,
+                        source_data_node=f"127.0.0.1:{source.port}",
+                    )
+                )
+                rpc.volume_stub(ch).VolumeEcShardsMount(
+                    volume_pb2.VolumeEcShardsMountRequest(
+                        volume_id=vid, collection="ecd", shard_ids=shard_ids
+                    )
+                )
+
+        # spread: shard 0 ONLY on the sacrifice, 10-13 on a peer,
+        # 1-9 stay on the source
+        copy_mount(extra, [0])
+        copy_mount(peer, list(range(10, 14)))
+        with grpc.insecure_channel(f"127.0.0.1:{source.grpc_port}") as ch:
+            stub = rpc.volume_stub(ch)
+            stub.VolumeEcShardsDelete(
+                volume_pb2.VolumeEcShardsDeleteRequest(
+                    volume_id=vid, collection="ecd", shard_ids=[0] + list(range(10, 14))
+                )
+            )
+            stub.VolumeEcShardsMount(
+                volume_pb2.VolumeEcShardsMountRequest(
+                    volume_id=vid, collection="ecd", shard_ids=list(range(1, 10))
+                )
+            )
+            stub.VolumeDelete(volume_pb2.VolumeDeleteRequest(volume_id=vid))
+
+        # master must know all 14 shard locations before the read
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            locs = master.topology.lookup_ec_shards(vid)
+            if locs is not None and all(locs.locations[i] for i in range(14)):
+                break
+            time.sleep(0.1)
+
+        # healthy read: shard 0's interval is fetched from the sacrifice
+        status, body = http_get(f"http://{assign['url']}/{assign['fid']}")
+        assert status == 200 and body == payload
+        ev = source.store.find_ec_volume(vid)
+        with ev.shard_locations_lock:
+            assert any(
+                f"127.0.0.1:{extra.port}" in urls
+                for urls in ev.shard_locations.values()
+            ), "healthy read should have cached the sacrifice's location"
+
+        # kill the shard-0 holder: the read must reconstruct from the
+        # 13 survivors (9 local + 4 on the peer) in one parallel round
+        extra.stop()
+        status, body = http_get(f"http://{assign['url']}/{assign['fid']}")
+        assert status == 200 and body == payload
+
+        # the failed fetch forgot the dead location (or the refresh
+        # already dropped it after the master unregistered the node)
+        with ev.shard_locations_lock:
+            assert not any(
+                f"127.0.0.1:{extra.port}" in urls
+                for urls in ev.shard_locations.values()
+            )
