@@ -1,0 +1,149 @@
+"""On-read image resizing + JPEG EXIF orientation fixing.
+
+Behavioral match of reference weed/images/:
+  resized()             resizing.go:15 Resized — ?width=&height=&mode=
+                        on volume GETs; only downscales (a source
+                        smaller than the target passes through), with
+                        fit / fill / default(thumbnail-or-resize) modes
+  fix_jpg_orientation() orientation.go:14 FixJpgOrientation — applied
+                        to .jpg uploads on the write path so stored
+                        pixels are upright and EXIF rotation quirks
+                        never reach clients
+
+Pillow does the pixel work; when it is unavailable both functions
+degrade to pass-through (the reference likewise returns the original
+bytes on any decode error).
+"""
+
+from __future__ import annotations
+
+import io
+
+_IMAGE_EXTS = {".jpg", ".jpeg", ".png", ".gif"}
+
+
+def _pil():
+    try:
+        from PIL import Image
+
+        return Image
+    except ImportError:
+        return None
+
+
+def is_image_ext(ext: str) -> bool:
+    return ext.lower() in _IMAGE_EXTS
+
+
+def _format_for(ext: str) -> str:
+    e = ext.lower()
+    if e in (".jpg", ".jpeg"):
+        return "JPEG"
+    if e == ".png":
+        return "PNG"
+    if e == ".gif":
+        return "GIF"
+    return "PNG"
+
+
+def resized(
+    ext: str, data: bytes, width: int, height: int, mode: str = ""
+) -> tuple[bytes, int, int]:
+    """(bytes, w, h); pass-through when no resize applies
+    (resizing.go:15 semantics, Lanczos filter)."""
+    if width == 0 and height == 0:
+        return data, 0, 0
+    Image = _pil()
+    if Image is None:
+        return data, 0, 0
+    try:
+        src = Image.open(io.BytesIO(data))
+        src.load()
+    except Exception:  # noqa: BLE001 - undecodable: serve original bytes
+        return data, 0, 0
+    src_w, src_h = src.size
+    needs = (src_w > width and width != 0) or (src_h > height and height != 0)
+    if not needs:
+        return data, src_w, src_h
+
+    resample = Image.LANCZOS
+    if mode == "fit":
+        dst = src.copy()
+        dst.thumbnail((width or src_w, height or src_h), resample)
+    elif mode == "fill":
+        from PIL import ImageOps
+
+        dst = ImageOps.fit(src, (width or src_w, height or src_h), resample)
+    else:
+        if width == height and width != 0 and src_w != src_h:
+            # square thumbnail: center-crop then scale (imaging.Thumbnail)
+            from PIL import ImageOps
+
+            dst = ImageOps.fit(src, (width, height), resample)
+        else:
+            # plain resize; 0 on one axis keeps aspect
+            if width == 0:
+                width = max(1, src_w * height // src_h)
+            if height == 0:
+                height = max(1, src_h * width // src_w)
+            dst = src.resize((width, height), resample)
+
+    buf = io.BytesIO()
+    fmt = _format_for(ext)
+    if fmt == "JPEG" and dst.mode not in ("RGB", "L"):
+        dst = dst.convert("RGB")
+    dst.save(buf, format=fmt)
+    return buf.getvalue(), dst.size[0], dst.size[1]
+
+
+# EXIF orientation values → (rotate degrees CCW, flip op) per the TIFF
+# spec (orientation.go's switch table)
+_ORIENT_OPS = {
+    1: (0, None),
+    2: (0, "h"),
+    3: (180, None),
+    4: (0, "v"),
+    5: (90, "h"),
+    6: (270, None),
+    7: (270, "h"),
+    8: (90, None),
+}
+
+
+def fix_jpg_orientation(data: bytes) -> bytes:
+    """Bake the EXIF orientation into the pixels (orientation.go:14);
+    returns the input unchanged when there is nothing to fix."""
+    Image = _pil()
+    if Image is None:
+        return data
+    try:
+        img = Image.open(io.BytesIO(data))
+        exif = img.getexif()
+        orient = exif.get(0x0112, 1)  # Orientation tag
+    except Exception:  # noqa: BLE001
+        return data
+    if orient == 1:
+        return data
+    op = _ORIENT_OPS.get(orient)
+    if op is None:
+        return data
+    angle, flip = op
+    try:
+        img.load()
+        if flip == "h":
+            img = img.transpose(Image.FLIP_LEFT_RIGHT)
+        elif flip == "v":
+            img = img.transpose(Image.FLIP_TOP_BOTTOM)
+        if angle:
+            img = img.rotate(angle, expand=True)
+        # strip the orientation tag: pixels are now upright
+        new_exif = img.getexif()
+        if 0x0112 in new_exif:
+            del new_exif[0x0112]
+        buf = io.BytesIO()
+        if img.mode not in ("RGB", "L"):
+            img = img.convert("RGB")
+        img.save(buf, format="JPEG", exif=new_exif.tobytes())
+        return buf.getvalue()
+    except Exception:  # noqa: BLE001
+        return data

@@ -416,26 +416,19 @@ class S3ApiServer:
                     "ETag": f'"{hashlib.md5(data).hexdigest()}"',
                     "Accept-Ranges": "bytes",
                 }
-                rng = self.headers.get("Range", "")
-                if rng.startswith("bytes="):
-                    total = len(data)
-                    spec = rng[6:].split(",")[0].strip()
-                    start_s, _, end_s = spec.partition("-")
-                    try:
-                        if start_s == "":  # suffix: last N bytes
-                            n = int(end_s)
-                            start, end = max(0, total - n), total - 1
-                        else:
-                            start = int(start_s)
-                            end = int(end_s) if end_s else total - 1
-                    except ValueError:
-                        raise s3_error("InvalidRange") from None
-                    if start >= total or start > end:
-                        self._send(
-                            416, b"", {"Content-Range": f"bytes */{total}"}
-                        )
-                        return
-                    end = min(end, total - 1)
+                from seaweedfs_tpu.util.http_range import (
+                    RangeNotSatisfiable,
+                    parse_range,
+                )
+
+                total = len(data)
+                try:
+                    span = parse_range(self.headers.get("Range", ""), total)
+                except RangeNotSatisfiable:
+                    self._send(416, b"", {"Content-Range": f"bytes */{total}"})
+                    return
+                if span is not None:
+                    start, end = span
                     headers["Content-Range"] = f"bytes {start}-{end}/{total}"
                     self._send(206, data[start : end + 1], headers)
                     return

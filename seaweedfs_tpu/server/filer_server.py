@@ -307,11 +307,30 @@ class FilerServer:
                 # entry.size() honors an explicit file_size (truncate
                 # may clamp below the chunk total)
                 total = entry.size()
-                self.send_response(200)
+                headers["Accept-Ranges"] = "bytes"
+                status, offset, length = 200, 0, total
+                from seaweedfs_tpu.util.http_range import (
+                    RangeNotSatisfiable,
+                    parse_range,
+                )
+
+                try:
+                    span = parse_range(self.headers.get("Range", ""), total)
+                except RangeNotSatisfiable:
+                    self.send_response(416)
+                    self.send_header("Content-Range", f"bytes */{total}")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                if span is not None:
+                    start, end = span
+                    status, offset, length = 206, start, end - start + 1
+                    headers["Content-Range"] = f"bytes {start}-{end}/{total}"
+                self.send_response(status)
                 for k, v in headers.items():
                     if v:
                         self.send_header(k, v)
-                self.send_header("Content-Length", str(total))
+                self.send_header("Content-Length", str(length))
                 self.end_headers()
                 if self.command == "HEAD":
                     # size/etag come from metadata alone — no chunk I/O
@@ -319,15 +338,17 @@ class FilerServer:
                 written = 0
                 try:
                     for piece in stream.stream_content(
-                        server.masters[0], entry.chunks, 0, total
+                        server.masters[0], entry.chunks, offset, length
                     ):
                         self.wfile.write(piece)
                         written += len(piece)
                 except (RuntimeError, OSError):
                     pass
-                if written < total:
+                if written < length:
                     # failure or sparse hole after headers: truncate so
                     # the client sees a short read, not silent corruption
+                    # (compare against the RESPONSE length — a completed
+                    # 206 must keep the connection reusable)
                     self.close_connection = True
 
             do_HEAD = do_GET

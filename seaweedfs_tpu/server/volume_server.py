@@ -82,6 +82,7 @@ class VolumeServer:
         guard=None,
         ec_codec: str = "",
         storage_backends: dict | None = None,
+        fix_jpg_orientation: bool = True,
     ):
         # `ec.codec` config: "cpu" | "tpu" | "" (auto: tpu when a JAX
         # device is present). Threaded into every server-side EC code
@@ -113,6 +114,7 @@ class VolumeServer:
         self.heartbeat_interval = heartbeat_interval
         self.read_redirect = read_redirect
         self.guard = guard  # security.Guard; None = security off
+        self.fix_jpg_orientation = fix_jpg_orientation
         self.volume_size_limit = 30 * 1024 * 1024 * 1024
         self._stop = threading.Event()
         self._grpc_server: grpc.Server | None = None
@@ -796,7 +798,51 @@ class VolumeServer:
                     headers["Last-Modified"] = time.strftime(
                         "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified)
                     )
-                self._reply(200, n.data, headers)
+                data = bytes(n.data)
+                # on-read image resizing (?width=&height=&mode=,
+                # volume_server_handlers_read.go:224 images.Resized);
+                # unparseable dims serve the original, as the reference
+                try:
+                    width = int(q.get("width", "0") or 0)
+                    height = int(q.get("height", "0") or 0)
+                except ValueError:
+                    width = height = 0
+                if width or height:
+                    ext = ""
+                    if n.has_name() and n.name:
+                        ext = os.path.splitext(n.name.decode("latin-1"))[1]
+                    elif headers["Content-Type"].startswith("image/"):
+                        ext = "." + headers["Content-Type"].split("/")[1]
+                    from seaweedfs_tpu import images
+
+                    if images.is_image_ext(ext):
+                        data, _, _ = images.resized(ext, data, width, height, q.get("mode", ""))
+                        headers.pop("ETag", None)  # derived variant
+                self._serve_maybe_ranged(data, headers)
+
+            def _serve_maybe_ranged(self, data: bytes, headers: dict):
+                """Full 200 or single-range 206 per the Range header
+                (volume_server_handlers_read.go serves ranges via
+                http.ServeContent; suffix and open-ended forms too)."""
+                from seaweedfs_tpu.util.http_range import (
+                    RangeNotSatisfiable,
+                    parse_range,
+                )
+
+                headers = dict(headers)
+                headers["Accept-Ranges"] = "bytes"
+                total = len(data)
+                try:
+                    span = parse_range(self.headers.get("Range", ""), total)
+                except RangeNotSatisfiable:
+                    return self._reply(
+                        416, b"", {"Content-Range": f"bytes */{total}"}
+                    )
+                if span is None:
+                    return self._reply(200, data, headers)
+                start, end = span
+                headers["Content-Range"] = f"bytes {start}-{end}/{total}"
+                self._reply(206, data[start : end + 1], headers)
 
             def _serve_chunked_manifest(self, n: Needle):
                 """Chunk-manifest fan-in: stream each chunk fid in offset
@@ -852,6 +898,12 @@ class VolumeServer:
                 if fname and len(fname) < 256:
                     n.name = fname.encode()
                     n.set_has_name()
+                    if server.fix_jpg_orientation and fname.lower().endswith(
+                        (".jpg", ".jpeg")
+                    ):
+                        from seaweedfs_tpu import images
+
+                        n.data = images.fix_jpg_orientation(bytes(n.data))
                 if q.get("cm") == "true":
                     n.set_is_chunk_manifest()
                 n.last_modified = int(time.time())

@@ -1,0 +1,208 @@
+"""HTTP Range reads (volume + filer) and on-read image resizing.
+
+Reference roles: volume_server_handlers_read.go:30-128 (ranged reads
+via http.ServeContent), images/resizing.go:15 (?width=&height=&mode=),
+images/orientation.go:14 (EXIF fix on .jpg upload)."""
+
+import io
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    master = MasterServer(port=free_port(), volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer(
+        [str(tmp_path_factory.mktemp("imgvs"))],
+        port=free_port(),
+        master=f"127.0.0.1:{master.port}",
+        heartbeat_interval=0.2,
+        max_volume_counts=[100],
+    )
+    vs.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topology.data_nodes()) < 1:
+        time.sleep(0.05)
+    filer = FilerServer([f"127.0.0.1:{master.port}"], port=free_port(), store="memory")
+    filer.start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+class TestVolumeRange:
+    @pytest.fixture(scope="class")
+    def blob(self, stack):
+        from seaweedfs_tpu.client import operation as op
+
+        master, vs, _ = stack
+        payload = bytes(range(256)) * 64  # 16 KiB
+        ar = op.assign(f"127.0.0.1:{master.port}")
+        assert not op.upload(f"{ar.url}/{ar.fid}", payload, jwt=ar.auth).error
+        return f"http://{ar.url}/{ar.fid}", payload
+
+    def test_full_read_advertises_ranges(self, blob):
+        url, payload = blob
+        status, body, headers = _get(url)
+        assert status == 200 and body == payload
+        assert headers.get("Accept-Ranges") == "bytes"
+
+    def test_closed_range(self, blob):
+        url, payload = blob
+        status, body, headers = _get(url, {"Range": "bytes=100-299"})
+        assert status == 206
+        assert body == payload[100:300]
+        assert headers["Content-Range"] == f"bytes 100-299/{len(payload)}"
+
+    def test_open_and_suffix_ranges(self, blob):
+        url, payload = blob
+        _, body, _ = _get(url, {"Range": f"bytes={len(payload) - 50}-"})
+        assert body == payload[-50:]
+        _, body, _ = _get(url, {"Range": "bytes=-77"})
+        assert body == payload[-77:]
+
+    def test_unsatisfiable_range(self, blob):
+        url, payload = blob
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(url, {"Range": f"bytes={len(payload) + 10}-"})
+        assert e.value.code == 416
+
+
+class TestFilerRange:
+    @pytest.fixture(scope="class")
+    def filer_file(self, stack):
+        _, _, filer = stack
+        payload = bytes(range(256)) * 32
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{filer.port}/r/data.bin", data=payload, method="POST"
+        )
+        urllib.request.urlopen(req, timeout=10).close()
+        return f"http://127.0.0.1:{filer.port}/r/data.bin", payload
+
+    def test_closed_range(self, filer_file):
+        url, payload = filer_file
+        status, body, headers = _get(url, {"Range": "bytes=10-19"})
+        assert status == 206 and body == payload[10:20]
+        assert headers["Content-Range"] == f"bytes 10-19/{len(payload)}"
+
+    def test_suffix_range(self, filer_file):
+        url, payload = filer_file
+        status, body, _ = _get(url, {"Range": "bytes=-100"})
+        assert status == 206 and body == payload[-100:]
+
+    def test_unsatisfiable(self, filer_file):
+        url, payload = filer_file
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(url, {"Range": f"bytes={len(payload)}-"})
+        assert e.value.code == 416
+
+
+def _png_bytes(w, h, color=(255, 0, 0)):
+    from PIL import Image
+
+    img = Image.new("RGB", (w, h), color)
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return buf.getvalue()
+
+
+class TestImageResize:
+    def test_resized_downscales(self):
+        from PIL import Image
+
+        from seaweedfs_tpu import images
+
+        data = _png_bytes(200, 100)
+        out, w, h = images.resized(".png", data, 100, 0)
+        assert (w, h) == (100, 50)
+        img = Image.open(io.BytesIO(out))
+        assert img.size == (100, 50)
+
+    def test_resized_passthrough_when_smaller(self):
+        from seaweedfs_tpu import images
+
+        data = _png_bytes(50, 50)
+        out, w, h = images.resized(".png", data, 100, 100)
+        assert out == data and (w, h) == (50, 50)
+
+    def test_fit_and_fill_modes(self):
+        from PIL import Image
+
+        from seaweedfs_tpu import images
+
+        data = _png_bytes(400, 200)
+        out, _, _ = images.resized(".png", data, 100, 100, "fit")
+        assert Image.open(io.BytesIO(out)).size == (100, 50)
+        out, _, _ = images.resized(".png", data, 100, 100, "fill")
+        assert Image.open(io.BytesIO(out)).size == (100, 100)
+
+    def test_served_resize_on_volume_get(self, stack):
+        from PIL import Image
+
+        from seaweedfs_tpu.client import operation as op
+
+        master, vs, _ = stack
+        ar = op.assign(f"127.0.0.1:{master.port}")
+        data = _png_bytes(300, 150)
+        assert not op.upload(
+            f"{ar.url}/{ar.fid}",
+            data,
+            filename="pic.png",
+            mime="image/png",
+            jwt=ar.auth,
+        ).error
+        _, body, _ = _get(f"http://{ar.url}/{ar.fid}?width=60")
+        assert Image.open(io.BytesIO(body)).size == (60, 30)
+        # mode=fit via query
+        _, body, _ = _get(f"http://{ar.url}/{ar.fid}?width=50&height=50&mode=fit")
+        assert Image.open(io.BytesIO(body)).size == (50, 25)
+
+    def test_jpg_orientation_fixed_on_upload(self, stack):
+        from PIL import Image
+
+        from seaweedfs_tpu.client import operation as op
+
+        master, vs, _ = stack
+        # a 40x20 image marked EXIF orientation 6 (rotate 90 CW to view):
+        # after the write-path fix it must come back 20x40 upright with
+        # no orientation tag
+        img = Image.new("RGB", (40, 20), (0, 128, 255))
+        exif = Image.Exif()
+        exif[0x0112] = 6
+        buf = io.BytesIO()
+        img.save(buf, format="JPEG", exif=exif.tobytes())
+
+        ar = op.assign(f"127.0.0.1:{master.port}")
+        assert not op.upload(
+            f"{ar.url}/{ar.fid}",
+            buf.getvalue(),
+            filename="rot.jpg",
+            mime="image/jpeg",
+            jwt=ar.auth,
+        ).error
+        _, body, _ = _get(f"http://{ar.url}/{ar.fid}")
+        served = Image.open(io.BytesIO(body))
+        assert served.size == (20, 40)
+        assert served.getexif().get(0x0112, 1) == 1
