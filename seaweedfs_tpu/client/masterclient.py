@@ -65,7 +65,7 @@ class MasterClient:
         caught up yet (wdclient falls back the same way via
         LookupVolumeId)."""
         master = self.current_master or self.masters[0]
-        with grpc.insecure_channel(master_grpc_address(master)) as ch:
+        with rpc.dial(master_grpc_address(master)) as ch:
             resp = rpc.master_stub(ch).LookupVolume(
                 master_pb2.LookupVolumeRequest(vids=[vid_str])
             )
@@ -105,7 +105,7 @@ class MasterClient:
                     continue
 
         try:
-            with grpc.insecure_channel(master_grpc_address(master)) as ch:
+            with rpc.dial(master_grpc_address(master)) as ch:
                 stream = rpc.master_stub(ch).KeepConnected(requests())
                 for delta in stream:
                     if self._stop.is_set():

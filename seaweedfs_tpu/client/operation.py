@@ -51,7 +51,7 @@ def assign(
     ttl: str = "",
     data_center: str = "",
 ) -> AssignResult:
-    with grpc.insecure_channel(grpc_address(master)) as ch:
+    with rpc.dial(grpc_address(master)) as ch:
         resp = rpc.master_stub(ch).Assign(
             master_pb2.AssignRequest(
                 count=count,
@@ -174,7 +174,7 @@ def lookup(master: str, vid: str, collection: str = "") -> LookupResult:
         entry = _lookup_cache.get(key)
         if entry and entry.expires > time.time():
             return entry.result
-    with grpc.insecure_channel(grpc_address(master)) as ch:
+    with rpc.dial(grpc_address(master)) as ch:
         resp = rpc.master_stub(ch).LookupVolume(
             master_pb2.LookupVolumeRequest(vids=[vid], collection=collection)
         )
@@ -239,7 +239,7 @@ def delete_files(master: str, fids: list[str]) -> list[dict]:
 
     for server, server_fids in by_server.items():
         try:
-            with grpc.insecure_channel(grpc_address(server)) as ch:
+            with rpc.dial(grpc_address(server)) as ch:
                 resp = rpc.volume_stub(ch).BatchDelete(
                     volume_pb2.BatchDeleteRequest(file_ids=server_fids)
                 )
@@ -338,7 +338,7 @@ def submit_file(
 def tail_volume(volume_server_url: str, vid: int, since_ns: int = 0):
     """Yield (needle_bytes_chunk) from the server's incremental-copy
     stream; the caller reassembles needles (tail_volume.go)."""
-    with grpc.insecure_channel(grpc_address(volume_server_url)) as ch:
+    with rpc.dial(grpc_address(volume_server_url)) as ch:
         stream = rpc.volume_stub(ch).VolumeIncrementalCopy(
             volume_pb2.VolumeIncrementalCopyRequest(volume_id=vid, since_ns=since_ns)
         )

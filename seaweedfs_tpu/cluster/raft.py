@@ -478,7 +478,7 @@ class RaftNode:
     def _channel(self, peer: str) -> grpc.Channel:
         ch = self._channels.get(peer)
         if ch is None:
-            ch = grpc.insecure_channel(rpc.grpc_address(peer))
+            ch = rpc.dial(rpc.grpc_address(peer))
             self._channels[peer] = ch
         return ch
 

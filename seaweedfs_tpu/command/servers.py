@@ -25,6 +25,19 @@ def _wait_forever() -> int:
     return 0
 
 
+def _configure_tls(component: str) -> None:
+    """security.toml [grpc]/[grpc.<component>] → process-wide gRPC TLS
+    (security/tls.go LoadServerTLS/LoadClientTLS role)."""
+    from seaweedfs_tpu.pb import rpc
+    from seaweedfs_tpu.security.tls import load_tls_config
+    from seaweedfs_tpu.util.config import load_config
+
+    cfg = load_config("security")
+    tls = load_tls_config(cfg, component)
+    if tls is not None:
+        rpc.set_tls(tls, cfg.get_string("grpc.server_name"))
+
+
 def _load_guard():
     """security.toml → Guard (None when not configured)."""
     from seaweedfs_tpu.security import Guard
@@ -75,6 +88,7 @@ class MasterCommand(Command):
         if args.peers and not args.mdir:
             print("master: -peers requires -mdir (persistent raft state)")
             return 2
+        _configure_tls("master")
         server = MasterServer(
             host=args.ip,
             port=args.port,
@@ -109,6 +123,12 @@ class VolumeCommand(Command):
         p.add_argument("-publicUrl", default="")
         p.add_argument("-readRedirect", action="store_true")
         p.add_argument(
+            "-index",
+            default="memory",
+            choices=("memory", "db"),
+            help="needle map kind: memory (CompactMap) | db (persistent sqlite)",
+        )
+        p.add_argument(
             "-ec.codec",
             dest="ec_codec",
             default="",
@@ -126,6 +146,7 @@ class VolumeCommand(Command):
         maxes = [int(m) for m in args.max.split(",")]
         if len(maxes) == 1:
             maxes = maxes * len(dirs)
+        _configure_tls("volume")
         server = VolumeServer(
             dirs,
             host=args.ip,
@@ -139,6 +160,7 @@ class VolumeCommand(Command):
             guard=_load_guard(),
             ec_codec=args.ec_codec,
             storage_backends=load_config("master").sub("storage.backend"),
+            needle_map_kind=args.index,
         )
         server.start()
         wlog.info("volume server %s:%d -> master %s", args.ip, args.port, args.mserver)
@@ -171,6 +193,7 @@ class FilerCommand(Command):
 
         wlog.set_verbosity(args.v)
         notification.configure(load_config("notification"))
+        _configure_tls("filer")
         server = FilerServer(
             args.master.split(","),
             host=args.ip,
@@ -203,6 +226,7 @@ class S3Command(Command):
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
+        _configure_tls("client")
         from seaweedfs_tpu.s3api import S3ApiServer
         from seaweedfs_tpu.s3api.auth import Identity, IdentityAccessManagement
 
@@ -250,6 +274,7 @@ class WebDavCommand(Command):
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
+        _configure_tls("client")
         from seaweedfs_tpu.webdav.webdav_server import WebDavServer
 
         wlog.set_verbosity(args.v)
@@ -294,6 +319,7 @@ class ServerCommand(Command):
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
+        _configure_tls("master")
         from seaweedfs_tpu.server.master_server import MasterServer
         from seaweedfs_tpu.server.volume_server import VolumeServer
 
@@ -376,6 +402,7 @@ class ShellCommand(Command):
 
         from seaweedfs_tpu.shell.shell_runner import run_shell
 
+        _configure_tls("client")
         masters = args.master.split(",")
         if args.script:
             fake_stdin = io.StringIO(

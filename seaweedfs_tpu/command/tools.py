@@ -146,7 +146,7 @@ class BackupCommand(Command):
         base = volume_base_name(args.dir, args.collection, args.volumeId)
         url = result.locations[0]["url"]
         appended = 0
-        with grpc.insecure_channel(rpc.grpc_address(url)) as ch:
+        with rpc.dial(rpc.grpc_address(url)) as ch:
             stub = rpc.volume_stub(ch)
             with open(base + ".dat", "ab") as dat:
                 for resp in stub.VolumeIncrementalCopy(

@@ -53,7 +53,7 @@ class WfsOption:
 class WFS:
     def __init__(self, option: WfsOption):
         self.option = option
-        self._channel = grpc.insecure_channel(grpc_address(option.filer))
+        self._channel = rpc.dial(grpc_address(option.filer))
         self._stub = rpc.filer_stub(self._channel)
         # full path -> (entry, expires); invalidated on every mutation
         self._entry_cache: dict[str, tuple[fpb.Entry, float]] = {}

@@ -25,6 +25,46 @@ def grpc_address(http_addr: str) -> str:
     return f"{host}:{int(port) + GRPC_PORT_OFFSET}"
 
 
+# --- process-wide gRPC TLS (security/tls.go role) ---------------------------
+# set_tls() installs one TlsConfig for every dial()/add_port() in the
+# process; None (default) keeps plaintext channels. The grpc "target
+# name override" lets certs issued for a common name (e.g. "seaweedfs")
+# verify against 127.0.0.1 endpoints, as cluster-internal mTLS needs.
+_TLS = None
+_TLS_SERVER_NAME = ""
+
+
+def set_tls(tls, server_name_override: str = "") -> None:
+    global _TLS, _TLS_SERVER_NAME
+    _TLS = tls
+    _TLS_SERVER_NAME = server_name_override
+
+
+def dial(addr: str) -> grpc.Channel:
+    """TLS channel when the process has TLS configured, else plaintext
+    (the single seam every client-side channel goes through)."""
+    if _TLS is not None and _TLS.is_enabled:
+        from seaweedfs_tpu.security.tls import client_credentials
+
+        options = []
+        if _TLS_SERVER_NAME:
+            options.append(
+                ("grpc.ssl_target_name_override", _TLS_SERVER_NAME)
+            )
+        return grpc.secure_channel(addr, client_credentials(_TLS), options)
+    return grpc.insecure_channel(addr)
+
+
+def add_port(server: grpc.Server, addr: str) -> None:
+    """Bind a server port honoring the process TLS config."""
+    if _TLS is not None and _TLS.is_enabled:
+        from seaweedfs_tpu.security.tls import server_credentials
+
+        server.add_secure_port(addr, server_credentials(_TLS))
+    else:
+        server.add_insecure_port(addr)
+
+
 UNARY_UNARY = "unary_unary"
 UNARY_STREAM = "unary_stream"
 STREAM_UNARY = "stream_unary"
@@ -109,6 +149,7 @@ VOLUME_METHODS = {
         v.VolumeTierMoveDatFromRemoteResponse,
         UNARY_STREAM,
     ),
+    "Query": (v.QueryRequest, v.QueriedStripe, UNARY_STREAM),
 }
 
 

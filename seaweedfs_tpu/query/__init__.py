@@ -1,0 +1,3 @@
+"""Experimental select-from-files query engine (reference weed/query/)."""
+
+from seaweedfs_tpu.query.json_query import Query, query_json  # noqa: F401

@@ -1,0 +1,114 @@
+"""JSON-lines filter + projection.
+
+Behavioral match of reference weed/query/json/query_json.go:18-105
+(gjson-based): a Query(field, op, value) filters each JSON line by the
+field's *runtime type* — string ops compare lexically, number ops
+numerically, booleans have the reference's quirky ordering table — and
+projections pull dotted-path fields from passing lines. The `%` / `!%`
+ops are glob matches (tidwall/match → fnmatch)."""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+from dataclasses import dataclass
+from typing import Any
+
+_MISSING = object()
+
+
+@dataclass
+class Query:
+    field: str = ""
+    op: str = ""
+    value: str = ""
+
+
+def get_path(obj: Any, path: str):
+    """Dotted-path lookup ("a.b.2.c"); integer segments index arrays.
+    Returns _MISSING when any segment is absent (gjson.Get role)."""
+    cur = obj
+    for seg in path.split("."):
+        if isinstance(cur, dict):
+            if seg not in cur:
+                return _MISSING
+            cur = cur[seg]
+        elif isinstance(cur, list):
+            try:
+                cur = cur[int(seg)]
+            except (ValueError, IndexError):
+                return _MISSING
+        else:
+            return _MISSING
+    return cur
+
+
+def _filter(doc: Any, q: Query) -> bool:
+    if not q.field:
+        return True  # no filter: projection-only select passes all
+    value = get_path(doc, q.field)
+    if value is _MISSING:
+        return False
+    if q.op == "":
+        return True  # existence check
+    rpv = q.value
+    if isinstance(value, str):
+        table = {
+            "=": value == rpv,
+            "!=": value != rpv,
+            "<": value < rpv,
+            "<=": value <= rpv,
+            ">": value > rpv,
+            ">=": value >= rpv,
+            "%": fnmatch.fnmatchcase(value, rpv),
+            "!%": not fnmatch.fnmatchcase(value, rpv),
+        }
+        return table.get(q.op, False)
+    if isinstance(value, bool):
+        # gjson True/False tables (query_json.go:81-104)
+        if value:
+            return {
+                "=": rpv == "true",
+                "!=": rpv != "true",
+                ">": rpv == "false",
+                ">=": True,
+            }.get(q.op, False)
+        return {
+            "=": rpv == "false",
+            "!=": rpv != "false",
+            "<": rpv == "true",
+            "<=": True,
+        }.get(q.op, False)
+    if isinstance(value, (int, float)):
+        try:
+            rpvn = float(rpv)
+        except ValueError:
+            rpvn = 0.0
+        table = {
+            "=": value == rpvn,
+            "!=": value != rpvn,
+            "<": value < rpvn,
+            "<=": value <= rpvn,
+            ">": value > rpvn,
+            ">=": value >= rpvn,
+        }
+        return table.get(q.op, False)
+    return False
+
+
+def query_json(
+    json_line: str, projections: list[str], query: Query
+) -> tuple[bool, list]:
+    """(passed_filter, projected values) for one JSON line
+    (QueryJson, query_json.go:18)."""
+    try:
+        doc = json.loads(json_line)
+    except ValueError:
+        return False, []
+    if not _filter(doc, query):
+        return False, []
+    values = []
+    for p in projections:
+        v = get_path(doc, p)
+        values.append(None if v is _MISSING else v)
+    return True, values

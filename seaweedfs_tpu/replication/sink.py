@@ -68,7 +68,7 @@ class FilerSink(ReplicationSink):
 
     def _stub(self):
         if self._channel is None:
-            self._channel = grpc.insecure_channel(rpc.grpc_address(self.filer))
+            self._channel = rpc.dial(rpc.grpc_address(self.filer))
         return rpc.filer_stub(self._channel)
 
     def get_sink_to_directory(self) -> str:

@@ -21,7 +21,7 @@ class FilerSource:
 
     def _stub(self):
         if self._channel is None:
-            self._channel = grpc.insecure_channel(rpc.grpc_address(self.filer))
+            self._channel = rpc.dial(rpc.grpc_address(self.filer))
         return rpc.filer_stub(self._channel)
 
     def lookup_file_url(self, fid: str) -> str:

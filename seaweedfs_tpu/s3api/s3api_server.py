@@ -66,7 +66,7 @@ class S3ApiServer:
     def _stub(self):
         with self._channel_lock:
             if self._channel is None:
-                self._channel = grpc.insecure_channel(rpc.grpc_address(self.filer))
+                self._channel = rpc.dial(rpc.grpc_address(self.filer))
             return rpc.filer_stub(self._channel)
 
     def _lookup(self, directory: str, name: str):

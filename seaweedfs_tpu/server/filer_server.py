@@ -209,7 +209,7 @@ class FilerServer:
         from seaweedfs_tpu.pb import master_pb2
         from seaweedfs_tpu.pb.rpc import grpc_address
 
-        with grpc.insecure_channel(grpc_address(self.masters[0])) as ch:
+        with rpc.dial(grpc_address(self.masters[0])) as ch:
             rpc.master_stub(ch).CollectionDelete(
                 master_pb2.CollectionDeleteRequest(name=req.collection)
             )
@@ -219,7 +219,7 @@ class FilerServer:
         from seaweedfs_tpu.pb import master_pb2
         from seaweedfs_tpu.pb.rpc import grpc_address
 
-        with grpc.insecure_channel(grpc_address(self.masters[0])) as ch:
+        with rpc.dial(grpc_address(self.masters[0])) as ch:
             resp = rpc.master_stub(ch).Statistics(
                 master_pb2.StatisticsRequest(
                     replication=req.replication, collection=req.collection, ttl=req.ttl
@@ -419,7 +419,7 @@ class FilerServer:
         self._grpc_server.add_generic_rpc_handlers(
             (rpc.servicer_handler(rpc.FILER_SERVICE, rpc.FILER_METHODS, self),)
         )
-        self._grpc_server.add_insecure_port(f"{self.host}:{self.grpc_port}")
+        rpc.add_port(self._grpc_server, f"{self.host}:{self.grpc_port}")
         self._grpc_server.start()
         self._http_server = ThreadingHTTPServer(
             (self.host, self.port), self._http_handler_class()

@@ -196,6 +196,17 @@ class MasterServer:
                             [v.id for v in new],
                             [v.id for v in deleted],
                         )
+                elif req.new_volumes or req.deleted_volumes:
+                    # delta beat: O(changes) registration
+                    new = [_vol_info_from_pb(v) for v in req.new_volumes]
+                    deleted = [_vol_info_from_pb(v) for v in req.deleted_volumes]
+                    self.topology.delta_sync_volumes(dn, new, deleted)
+                    self._broadcast(
+                        dn.url,
+                        dn.public_url,
+                        [v.id for v in new],
+                        [v.id for v in deleted],
+                    )
                 if req.ec_shards or req.has_no_ec_shards:
                     self.topology.sync_ec_shards(
                         dn,
@@ -314,7 +325,7 @@ class MasterServer:
     def CollectionDelete(self, req: pb.CollectionDeleteRequest, context):
         for dn in self.topology.data_nodes():
             try:
-                with grpc.insecure_channel(self._node_grpc(dn)) as ch:
+                with rpc.dial(self._node_grpc(dn)) as ch:
                     rpc.volume_stub(ch).DeleteCollection(
                         volume_pb2.DeleteCollectionRequest(collection=req.name)
                     )
@@ -400,7 +411,7 @@ class MasterServer:
             ok = True
             for dn in servers:
                 try:
-                    with grpc.insecure_channel(self._node_grpc(dn)) as ch:
+                    with rpc.dial(self._node_grpc(dn)) as ch:
                         rpc.volume_stub(ch).AllocateVolume(
                             volume_pb2.AllocateVolumeRequest(
                                 volume_id=vid,
@@ -555,7 +566,7 @@ class MasterServer:
         leader = self.leader_address()
         if leader == f"{self.host}:{self.port}":
             raise RuntimeError("no leader elected yet")
-        with grpc.insecure_channel(rpc.grpc_address(leader)) as ch:
+        with rpc.dial(rpc.grpc_address(leader)) as ch:
             resp = rpc.master_stub(ch).Assign(
                 pb.AssignRequest(
                     count=count,
@@ -589,7 +600,7 @@ class MasterServer:
                     ),
                 )
             )
-        self._grpc_server.add_insecure_port(f"{self.host}:{self.grpc_port}")
+        rpc.add_port(self._grpc_server, f"{self.host}:{self.grpc_port}")
         self._grpc_server.start()
         if self._raft is not None:
             self._raft.start()

@@ -83,6 +83,7 @@ class VolumeServer:
         ec_codec: str = "",
         storage_backends: dict | None = None,
         fix_jpg_orientation: bool = True,
+        needle_map_kind: str = "memory",
     ):
         # `ec.codec` config: "cpu" | "tpu" | "" (auto: tpu when a JAX
         # device is present). Threaded into every server-side EC code
@@ -98,7 +99,12 @@ class VolumeServer:
 
             _bk.ensure_builtin_factories()
             _bk.load_backend_config(storage_backends)
-        self.store = Store(directories, max_volume_counts, ec_backend=self.ec_codec)
+        self.store = Store(
+            directories,
+            max_volume_counts,
+            ec_backend=self.ec_codec,
+            needle_map_kind=needle_map_kind,
+        )
         self.host = host
         self.port = port
         self.grpc_port = port + 10000
@@ -127,7 +133,32 @@ class VolumeServer:
 
     # ------------------------------------------------------------------
     # heartbeat client (volume_grpc_client_to_master.go)
+    # full beats every Nth cycle keep master state authoritative; the
+    # cycles between send only volume-set changes so steady-state
+    # chatter is O(changes), not O(volumes) (master.proto:43-44
+    # new_volumes/deleted_volumes delta beats)
+    _FULL_HEARTBEAT_EVERY = 10
+
+    @staticmethod
+    def _add_vol_stats(field, infos) -> None:
+        for v in infos:
+            field.add(
+                id=v.id,
+                size=v.size,
+                collection=v.collection,
+                file_count=v.file_count,
+                delete_count=v.delete_count,
+                deleted_byte_count=v.deleted_byte_count,
+                read_only=v.read_only,
+                replica_placement=v.replica_placement,
+                version=v.version,
+                ttl=v.ttl,
+            )
+
     def _heartbeat_requests(self):
+        last_vids: dict[int, object] | None = None  # None => send full
+        last_full_infos: dict[int, object] = {}
+        beat = 0
         while not self._stop.is_set():
             hb = self.store.collect_heartbeat()
             req = master_pb2.HeartbeatRequest(
@@ -140,22 +171,35 @@ class VolumeServer:
                 max_file_key=hb.max_file_key,
                 data_center=self.data_center,
                 rack=self.rack,
-                has_no_volumes=not hb.volumes,
                 has_no_ec_shards=not hb.ec_shards,
             )
-            for v in hb.volumes:
-                req.volumes.add(
-                    id=v.id,
-                    size=v.size,
-                    collection=v.collection,
-                    file_count=v.file_count,
-                    delete_count=v.delete_count,
-                    deleted_byte_count=v.deleted_byte_count,
-                    read_only=v.read_only,
-                    replica_placement=v.replica_placement,
-                    version=v.version,
-                    ttl=v.ttl,
-                )
+            # signature catches in-place changes (growth past the size
+            # limit, read-only flips, delete counts) so they propagate
+            # on the next delta beat, not only on the Nth full beat
+            def sig(v):
+                return (v.size, v.file_count, v.delete_count, v.read_only)
+
+            current = {v.id: v for v in hb.volumes}
+            full = last_vids is None or beat % self._FULL_HEARTBEAT_EVERY == 0
+            if full:
+                req.has_no_volumes = not hb.volumes
+                self._add_vol_stats(req.volumes, hb.volumes)
+            else:
+                new = [
+                    v
+                    for vid, v in current.items()
+                    if vid not in last_vids or last_vids[vid] != sig(v)
+                ]
+                gone = [
+                    hb_v
+                    for vid, hb_v in last_full_infos.items()
+                    if vid not in current
+                ]
+                self._add_vol_stats(req.new_volumes, new)
+                self._add_vol_stats(req.deleted_volumes, gone)
+            last_vids = {vid: sig(v) for vid, v in current.items()}
+            last_full_infos = current
+            beat += 1
             for s in hb.ec_shards:
                 req.ec_shards.add(
                     id=s.id, collection=s.collection, ec_index_bits=s.ec_index_bits
@@ -166,7 +210,7 @@ class VolumeServer:
     def _heartbeat_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                with grpc.insecure_channel(self._master_grpc()) as ch:
+                with rpc.dial(self._master_grpc()) as ch:
                     stub = rpc.master_stub(ch)
                     for resp in stub.Heartbeat(self._heartbeat_requests()):
                         if resp.volume_size_limit:
@@ -200,7 +244,7 @@ class VolumeServer:
         if cached and cached[0] > now:
             return cached[1]
         try:
-            with grpc.insecure_channel(self._master_grpc()) as ch:
+            with rpc.dial(self._master_grpc()) as ch:
                 resp = rpc.master_stub(ch).LookupVolume(
                     master_pb2.LookupVolumeRequest(vids=[str(vid)]), timeout=5
                 )
@@ -321,7 +365,7 @@ class VolumeServer:
         loc = self.store.locations[0]
         base = volume_base_name(loc.directory, req.collection, req.volume_id)
         host, _, port = req.source_data_node.partition(":")
-        with grpc.insecure_channel(f"{host}:{int(port) + 10000}") as ch:
+        with rpc.dial(f"{host}:{int(port) + 10000}") as ch:
             stub = rpc.volume_stub(ch)
             for ext in (".dat", ".idx"):
                 with open(base + ext, "wb") as f:
@@ -419,7 +463,7 @@ class VolumeServer:
         target_dir = self.store.locations[0].directory
         base = volume_base_name(target_dir, req.collection, req.volume_id)
         host, _, port = req.source_data_node.partition(":")
-        with grpc.insecure_channel(f"{host}:{int(port) + 10000}") as ch:
+        with rpc.dial(f"{host}:{int(port) + 10000}") as ch:
             stub = rpc.volume_stub(ch)
             exts = [ec_files.to_ext(sid) for sid in req.shard_ids]
             if req.copy_ecx_file:
@@ -527,6 +571,40 @@ class VolumeServer:
         return pb.VolumeEcShardsToVolumeResponse()
 
     # ------------------------------------------------------------------
+    # experimental select-from-files (volume_grpc_query.go:12)
+    def Query(self, req, context):
+        """Scan JSON-lines needles, filter + project, stream records
+        (one JSON array of projections per passing line)."""
+        from seaweedfs_tpu.query import Query as JsonQuery, query_json
+
+        flt = JsonQuery(
+            field=req.filter.field,
+            op=req.filter.operand,
+            value=req.filter.value,
+        )
+        for fid_str in req.from_file_ids:
+            try:
+                fid = FileId.parse(fid_str)
+            except ValueError:
+                continue
+            v = self.store.find_volume(fid.volume_id)
+            if v is None:
+                continue
+            try:
+                n = v.read_needle(fid.key, cookie=fid.cookie)
+            except (NeedleNotFound, CookieMismatch):
+                continue
+            out = []
+            for line in bytes(n.data).decode("utf-8", "replace").splitlines():
+                if not line.strip():
+                    continue
+                passed, values = query_json(line, list(req.selections), flt)
+                if passed:
+                    out.append(json.dumps(values))
+            if out:
+                yield pb.QueriedStripe(records=("\n".join(out) + "\n").encode())
+
+    # ------------------------------------------------------------------
     # tiered storage (volume_grpc_tier_upload.go:14 / tier_download.go)
     def VolumeTierMoveDatToRemote(self, req, context):
         """Copy a sealed volume's .dat to a remote backend, streaming
@@ -613,7 +691,7 @@ class VolumeServer:
         if not self.master:
             return
         try:
-            with grpc.insecure_channel(self._master_grpc()) as ch:
+            with rpc.dial(self._master_grpc()) as ch:
                 resp = rpc.master_stub(ch).LookupEcVolume(
                     master_pb2.LookupEcVolumeRequest(volume_id=ev.volume_id),
                     timeout=5,
@@ -656,7 +734,7 @@ class VolumeServer:
                 attempted = True
                 host, _, port = url.partition(":")
                 try:
-                    with grpc.insecure_channel(f"{host}:{int(port) + 10000}") as ch:
+                    with rpc.dial(f"{host}:{int(port) + 10000}") as ch:
                         chunks = [
                             r.data
                             for r in rpc.volume_stub(ch).VolumeEcShardRead(
@@ -974,7 +1052,7 @@ class VolumeServer:
         if not self.master:
             return None
         try:
-            with grpc.insecure_channel(self._master_grpc()) as ch:
+            with rpc.dial(self._master_grpc()) as ch:
                 resp = rpc.master_stub(ch).LookupEcVolume(
                     master_pb2.LookupEcVolumeRequest(volume_id=vid)
                 )
@@ -1082,7 +1160,7 @@ class VolumeServer:
         self._grpc_server.add_generic_rpc_handlers(
             (rpc.servicer_handler(rpc.VOLUME_SERVICE, rpc.VOLUME_METHODS, self),)
         )
-        self._grpc_server.add_insecure_port(f"{self.host}:{self.grpc_port}")
+        rpc.add_port(self._grpc_server, f"{self.host}:{self.grpc_port}")
         self._grpc_server.start()
         self._http_server = ThreadingHTTPServer(
             (self.host, self.port), self._http_handler_class()

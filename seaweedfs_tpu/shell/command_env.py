@@ -69,17 +69,17 @@ class CommandEnv:
         )
 
     def filer_channel(self, filer: str) -> grpc.Channel:
-        return grpc.insecure_channel(grpc_address(filer))
+        return rpc.dial(grpc_address(filer))
 
     # ------------------------------------------------------------------
     def master_stub(self, ch: grpc.Channel) -> rpc.Stub:
         return rpc.master_stub(ch)
 
     def master_channel(self) -> grpc.Channel:
-        return grpc.insecure_channel(grpc_address(self.master))
+        return rpc.dial(grpc_address(self.master))
 
     def volume_channel(self, url: str) -> grpc.Channel:
-        return grpc.insecure_channel(grpc_address(url))
+        return rpc.dial(grpc_address(url))
 
     # ------------------------------------------------------------------
     def collect_topology(self) -> TopologyDump:

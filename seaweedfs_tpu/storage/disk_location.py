@@ -41,10 +41,12 @@ class DiskLocation:
         directory: str,
         max_volume_count: int = 7,
         ec_backend: str | None = None,
+        needle_map_kind: str = "memory",
     ):
         self.directory = directory
         self.max_volume_count = max_volume_count
         self.ec_backend = ec_backend  # `ec.codec` for EC volumes here
+        self.needle_map_kind = needle_map_kind  # -index memory|db
         self.volumes: dict[int, Volume] = {}
         # vid -> EcVolume; populated by load_existing_volumes and the
         # EC mount RPCs (seaweedfs_tpu/ec/ec_volume.py)
@@ -65,7 +67,11 @@ class DiskLocation:
                 continue
             try:
                 self.volumes[vid] = Volume(
-                    self.directory, vid, collection, create=False
+                    self.directory,
+                    vid,
+                    collection,
+                    create=False,
+                    needle_map_kind=self.needle_map_kind,
                 )
             except (OSError, ValueError):
                 continue  # unloadable volume; reference logs and skips
@@ -124,7 +130,13 @@ class DiskLocation:
                 continue
             collection = parsed[0]
             try:
-                self.volumes[vid] = Volume(self.directory, vid, collection, create=False)
+                self.volumes[vid] = Volume(
+                    self.directory,
+                    vid,
+                    collection,
+                    create=False,
+                    needle_map_kind=self.needle_map_kind,
+                )
                 return True
             except (OSError, ValueError):
                 return False

@@ -20,7 +20,7 @@ import numpy as np
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.util import bytesutil
 
-ENTRY_SIZE = t.NEEDLE_MAP_ENTRY_SIZE  # 16
+ENTRY_SIZE = t.NEEDLE_MAP_ENTRY_SIZE  # 16 (17 under 5-byte offsets)
 
 
 def pack_entry(key: int, offset_units: int, size: int) -> bytes:
@@ -63,20 +63,31 @@ def walk_index_file(
 # --- numpy bulk views -------------------------------------------------------
 
 def entries_as_arrays(data: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Decode a whole .idx/.ecx byte blob to (keys u64, offsets u32/u64,
-    sizes u32) arrays in one vectorized pass."""
-    n = len(data) // ENTRY_SIZE
-    raw = np.frombuffer(data, dtype=np.uint8, count=n * ENTRY_SIZE).reshape(n, ENTRY_SIZE)
+    """Decode a whole .idx/.ecx byte blob to (keys u64, offsets u64,
+    sizes u32) arrays in one vectorized pass. Honors the process
+    offset size (4- or 5-byte entries)."""
+    osz = t.OFFSET_SIZE
+    entry = t.NEEDLE_MAP_ENTRY_SIZE
+    n = len(data) // entry
+    raw = np.frombuffer(data, dtype=np.uint8, count=n * entry).reshape(n, entry)
     keys = raw[:, :8].copy().view(">u8").reshape(n).astype(np.uint64)
-    offsets = raw[:, 8 : 8 + t.OFFSET_SIZE].copy().view(">u4").reshape(n).astype(np.uint64)
-    sizes = raw[:, 12:16].copy().view(">u4").reshape(n).astype(np.uint32)
+    # big-endian offsets of arbitrary width: widen to 8 bytes, view u64
+    off8 = np.zeros((n, 8), dtype=np.uint8)
+    off8[:, 8 - osz :] = raw[:, 8 : 8 + osz]
+    offsets = off8.view(">u8").reshape(n).astype(np.uint64)
+    sizes = (
+        raw[:, 8 + osz : 8 + osz + 4].copy().view(">u4").reshape(n).astype(np.uint32)
+    )
     return keys, offsets, sizes
 
 
 def arrays_to_entries(keys: np.ndarray, offsets: np.ndarray, sizes: np.ndarray) -> bytes:
+    osz = t.OFFSET_SIZE
+    entry = t.NEEDLE_MAP_ENTRY_SIZE
     n = len(keys)
-    raw = np.empty((n, ENTRY_SIZE), dtype=np.uint8)
+    raw = np.empty((n, entry), dtype=np.uint8)
     raw[:, :8] = keys.astype(">u8").reshape(n, 1).view(np.uint8)
-    raw[:, 8:12] = offsets.astype(">u4").reshape(n, 1).view(np.uint8)
-    raw[:, 12:16] = sizes.astype(">u4").reshape(n, 1).view(np.uint8)
+    off8 = offsets.astype(">u8").reshape(n, 1).view(np.uint8).reshape(n, 8)
+    raw[:, 8 : 8 + osz] = off8[:, 8 - osz :]
+    raw[:, 8 + osz : 8 + osz + 4] = sizes.astype(">u4").reshape(n, 1).view(np.uint8)
     return raw.tobytes()

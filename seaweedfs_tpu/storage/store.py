@@ -73,11 +73,15 @@ class Store:
         directories: list[str],
         max_volume_counts: list[int] | None = None,
         ec_backend: str | None = None,
+        needle_map_kind: str = "memory",
     ):
         counts = max_volume_counts or [7] * len(directories)
         self.ec_backend = ec_backend  # `ec.codec`: cpu | tpu | None=auto
+        self.needle_map_kind = needle_map_kind
         self.locations = [
-            DiskLocation(d, c, ec_backend=ec_backend)
+            DiskLocation(
+                d, c, ec_backend=ec_backend, needle_map_kind=needle_map_kind
+            )
             for d, c in zip(directories, counts)
         ]
         for loc in self.locations:
@@ -122,6 +126,7 @@ class Store:
             replica_placement=ReplicaPlacement.parse(replica_placement),
             ttl=TTL.parse(ttl),
             version=version,
+            needle_map_kind=self.needle_map_kind,
         )
         loc.volumes[vid] = v
         return v

@@ -113,6 +113,7 @@ class Volume:
         ttl: TTL | None = None,
         version: int = CURRENT_VERSION,
         create: bool = True,
+        needle_map_kind: str = "memory",
     ):
         self.id = vid
         self.collection = collection
@@ -121,6 +122,9 @@ class Volume:
         self.read_only = False
         self.last_append_at_ns = 0
         self._lock = threading.RLock()
+        # "memory" (CompactNeedleMap) or "db" (persistent sqlite map —
+        # the reference's -index=leveldb variant, needle_map_leveldb.go)
+        self.needle_map_kind = needle_map_kind
 
         dat_path = self.base_name + ".dat"
         # tier metadata: a .vif with remote files means the sealed .dat
@@ -135,7 +139,7 @@ class Volume:
             self._open_remote_dat()
             self.read_only = True
             self.super_block = SuperBlock.read_from(self._dat)
-            self.nm = CompactNeedleMap.load(self.base_name + ".idx")
+            self.nm = self._load_needle_map()
             return
         if has_remote:
             # keep_local_dat_file case: a local copy exists alongside
@@ -154,9 +158,16 @@ class Volume:
         self._dat = open(dat_path, "r+b")
         if exists:
             self.super_block = SuperBlock.read_from(self._dat)
-        self.nm = CompactNeedleMap.load(self.base_name + ".idx")
+        self.nm = self._load_needle_map()
         if exists:
             self._check_integrity()
+
+    def _load_needle_map(self):
+        if self.needle_map_kind == "db":
+            from seaweedfs_tpu.storage.needle_map import DbNeedleMap
+
+            return DbNeedleMap.load(self.base_name + ".idx")
+        return CompactNeedleMap.load(self.base_name + ".idx")
 
     # --- remote tier (backend.go + volume_grpc_tier_*.go) ---
     def _open_remote_dat(self) -> None:
@@ -495,10 +506,9 @@ class Volume:
             os.replace(cpx, self.base_name + ".idx")
             self._dat = open(self.base_name + ".dat", "r+b")
             self.super_block = SuperBlock.read_from(self._dat)
-            # rebuild the in-memory map from the fresh index
-            os.replace(self.base_name + ".idx", self.base_name + ".idx.tmp")
-            os.replace(self.base_name + ".idx.tmp", self.base_name + ".idx")
-            self.nm = CompactNeedleMap.load(self.base_name + ".idx")
+            # rebuild the map from the fresh index (a db map rebuilds
+            # its table since the .idx shrank below its watermark)
+            self.nm = self._load_needle_map()
 
     def cleanup_compact(self) -> None:
         for ext in (".cpd", ".cpx"):

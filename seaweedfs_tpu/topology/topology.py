@@ -129,6 +129,23 @@ class Topology(Node):
             self._layout_for(v).unregister_volume(v.id, dn)
         return new, deleted
 
+    def delta_sync_volumes(
+        self,
+        dn: DataNode,
+        new: list[VolumeInfo],
+        deleted: list[VolumeInfo],
+    ) -> None:
+        """Incremental registration from a delta heartbeat
+        (IncrementalSyncDataNodeRegistration role, master.proto:43-44):
+        O(changes) instead of O(volumes) per beat."""
+        for v in new:
+            dn.volumes[v.id] = v
+            self.id_gen.adjust_if_larger(v.id)
+            self._layout_for(v).register_volume(v, dn)
+        for v in deleted:
+            dn.volumes.pop(v.id, None)
+            self._layout_for(v).unregister_volume(v.id, dn)
+
     def _layout_for(self, v: VolumeInfo) -> VolumeLayout:
         rp = str(ReplicaPlacement.from_byte(v.replica_placement))
         ttl = str(TTL.from_uint32(v.ttl))

@@ -48,7 +48,7 @@ class WebDavServer:
     def _stub(self):
         with self._lock:
             if self._channel is None:
-                self._channel = grpc.insecure_channel(rpc.grpc_address(self.filer))
+                self._channel = rpc.dial(rpc.grpc_address(self.filer))
             return rpc.filer_stub(self._channel)
 
     def _full(self, dav_path: str) -> str:

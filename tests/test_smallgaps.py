@@ -1,0 +1,392 @@
+"""Small-gap sweep: gRPC TLS, persistent needle map, query engine,
+Query RPC, delta heartbeats, 5-byte offsets.
+
+Reference roles: security/tls.go, needle_map_leveldb.go:24,
+query/json/query_json.go:18 + volume_grpc_query.go:12,
+master.proto:43-44 delta beats, types/offset_5bytes.go."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# TLS
+
+
+def _make_certs(tmp_path):
+    """Self-signed CA + a server/client cert signed by it."""
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    def key():
+        return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+    def name(cn):
+        return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    ca_key = key()
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(name("weed-ca"))
+        .issuer_name(name("weed-ca"))
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), True)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    leaf_key = key()
+    leaf_cert = (
+        x509.CertificateBuilder()
+        .subject_name(name("seaweedfs"))
+        .issuer_name(name("weed-ca"))
+        .public_key(leaf_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("seaweedfs")]), False
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    paths = {}
+    for nm, data in [
+        ("ca.crt", ca_cert.public_bytes(serialization.Encoding.PEM)),
+        ("node.crt", leaf_cert.public_bytes(serialization.Encoding.PEM)),
+        (
+            "node.key",
+            leaf_key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            ),
+        ),
+    ]:
+        p = tmp_path / nm
+        p.write_bytes(data)
+        paths[nm] = str(p)
+    return paths
+
+
+class TestGrpcTls:
+    def test_mtls_handshake_and_plaintext_rejection(self, tmp_path):
+        import grpc
+
+        from seaweedfs_tpu.pb import master_pb2, rpc
+        from seaweedfs_tpu.security.tls import (
+            TlsConfig,
+            client_credentials,
+            server_credentials,
+        )
+
+        certs = _make_certs(tmp_path)
+        tls = TlsConfig(
+            ca_pem=open(certs["ca.crt"], "rb").read(),
+            cert_pem=open(certs["node.crt"], "rb").read(),
+            key_pem=open(certs["node.key"], "rb").read(),
+        )
+
+        # a bare gRPC server with the master service behind mTLS
+        from concurrent import futures
+
+        class Impl:
+            def __getattr__(self, name):
+                def h(req, ctx):
+                    return master_pb2.StatisticsResponse(total_size=42)
+
+                return h
+
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        server.add_generic_rpc_handlers(
+            (rpc.servicer_handler(rpc.MASTER_SERVICE, rpc.MASTER_METHODS, Impl()),)
+        )
+        port = free_port()
+        server.add_secure_port(
+            f"127.0.0.1:{port}", server_credentials(tls)
+        )
+        server.start()
+        try:
+            # mTLS client succeeds (cert CN "seaweedfs" needs override)
+            ch = grpc.secure_channel(
+                f"127.0.0.1:{port}",
+                client_credentials(tls),
+                (("grpc.ssl_target_name_override", "seaweedfs"),),
+            )
+            resp = rpc.master_stub(ch).Statistics(
+                master_pb2.StatisticsRequest(), timeout=5
+            )
+            assert resp.total_size == 42
+            ch.close()
+
+            # plaintext client is refused
+            ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+            with pytest.raises(grpc.RpcError):
+                rpc.master_stub(ch).Statistics(
+                    master_pb2.StatisticsRequest(), timeout=5
+                )
+            ch.close()
+        finally:
+            server.stop(grace=0)
+
+    def test_dial_seam_honors_set_tls(self, tmp_path):
+        from seaweedfs_tpu.pb import rpc
+        from seaweedfs_tpu.security.tls import TlsConfig
+
+        certs = _make_certs(tmp_path)
+        tls = TlsConfig(
+            ca_pem=open(certs["ca.crt"], "rb").read(),
+            cert_pem=open(certs["node.crt"], "rb").read(),
+            key_pem=open(certs["node.key"], "rb").read(),
+        )
+        try:
+            rpc.set_tls(tls, "seaweedfs")
+            ch = rpc.dial("127.0.0.1:1")  # no connect yet; type check only
+            assert ch is not None
+            ch.close()
+        finally:
+            rpc.set_tls(None)
+
+
+# ---------------------------------------------------------------------------
+# persistent needle map
+
+
+class TestDbNeedleMap:
+    def test_roundtrip_and_resume(self, tmp_path):
+        from seaweedfs_tpu.storage.needle_map import CompactNeedleMap, DbNeedleMap
+
+        idx = str(tmp_path / "1.idx")
+        nm = DbNeedleMap.load(idx)
+        nm.put(5, 10, 100)
+        nm.put(9, 30, 200)
+        nm.put(5, 50, 120)  # overwrite
+        nm.delete(9, 70)
+        assert nm.get(5).offset == 50 and nm.get(5).size == 120
+        assert nm.get(9).size == 0xFFFFFFFF
+        assert nm.file_count == 3 and nm.deletion_count == 2
+        assert nm.max_file_key == 9
+        nm.close()
+
+        # resume: no .idx replay needed (watermark), state intact
+        nm2 = DbNeedleMap.load(idx)
+        assert nm2.get(5).offset == 50
+        assert nm2.max_file_key == 9
+        assert sorted(v.key for v in nm2.items()) == [5, 9]
+        nm2.close()
+
+        # the .idx bytes are identical to what the in-memory map writes
+        cm = CompactNeedleMap.load(str(tmp_path / "2.idx"))
+        cm.put(5, 10, 100)
+        cm.put(9, 30, 200)
+        cm.put(5, 50, 120)
+        cm.delete(9, 70)
+        cm.close()
+        assert (
+            open(idx, "rb").read() == open(str(tmp_path / "2.idx"), "rb").read()
+        )
+
+    def test_tail_replay_after_external_append(self, tmp_path):
+        from seaweedfs_tpu.storage import idx as idx_codec
+        from seaweedfs_tpu.storage.needle_map import DbNeedleMap
+
+        idx = str(tmp_path / "3.idx")
+        nm = DbNeedleMap.load(idx)
+        nm.put(1, 8, 64)
+        nm.close()
+        # an external writer (e.g. replication) appends to the .idx
+        with open(idx, "ab") as f:
+            f.write(idx_codec.pack_entry(2, 16, 128))
+        nm2 = DbNeedleMap.load(idx)
+        assert nm2.get(2).offset == 16
+        nm2.close()
+
+    def test_volume_with_db_map(self, tmp_path):
+        from seaweedfs_tpu.storage.needle import Needle
+        from seaweedfs_tpu.storage.volume import Volume
+
+        v = Volume(str(tmp_path), 7, needle_map_kind="db")
+        n = Needle(cookie=0x1234, id=42, data=b"persistent map payload")
+        v.write_needle(n)
+        got = v.read_needle(42, cookie=0x1234)
+        assert bytes(got.data) == b"persistent map payload"
+        v.close()
+        v2 = Volume(str(tmp_path), 7, create=False, needle_map_kind="db")
+        got = v2.read_needle(42, cookie=0x1234)
+        assert bytes(got.data) == b"persistent map payload"
+        v2.close()
+
+
+# ---------------------------------------------------------------------------
+# query engine
+
+
+class TestJsonQuery:
+    def test_ops(self):
+        from seaweedfs_tpu.query import Query, query_json
+
+        line = '{"name": "alice", "age": 30, "vip": true, "addr": {"city": "sf"}}'
+        cases = [
+            (Query("name", "=", "alice"), True),
+            (Query("name", "!=", "alice"), False),
+            (Query("name", "%", "al*"), True),
+            (Query("name", "!%", "al*"), False),
+            (Query("age", ">", "29"), True),
+            (Query("age", "<=", "29"), False),
+            (Query("vip", "=", "true"), True),
+            (Query("addr.city", "=", "sf"), True),
+            (Query("missing", "=", "x"), False),
+            (Query("addr.city", "", ""), True),  # existence
+        ]
+        for q, expect in cases:
+            passed, _ = query_json(line, [], q)
+            assert passed is expect, q
+
+    def test_projections(self):
+        from seaweedfs_tpu.query import Query, query_json
+
+        line = '{"a": 1, "b": {"c": [10, 20]}}'
+        passed, values = query_json(line, ["a", "b.c.1", "nope"], Query("a", "=", "1"))
+        assert passed
+        assert values == [1, 20, None]
+
+
+# ---------------------------------------------------------------------------
+# cluster-level: Query RPC + delta heartbeats
+
+
+@pytest.fixture(scope="module")
+def mini_cluster(tmp_path_factory):
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    master = MasterServer(port=free_port(), volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer(
+        [str(tmp_path_factory.mktemp("sgvs"))],
+        port=free_port(),
+        master=f"127.0.0.1:{master.port}",
+        heartbeat_interval=0.1,
+        max_volume_counts=[100],
+    )
+    vs.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topology.data_nodes()) < 1:
+        time.sleep(0.05)
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+class TestQueryRpc:
+    def test_select_from_json_lines(self, mini_cluster):
+        import grpc
+
+        from seaweedfs_tpu.client import operation as op
+        from seaweedfs_tpu.pb import rpc, volume_pb2
+
+        master, vs = mini_cluster
+        rows = b"\n".join(
+            [
+                b'{"name": "a", "n": 1}',
+                b'{"name": "b", "n": 5}',
+                b'{"name": "c", "n": 9}',
+            ]
+        )
+        ar = op.assign(f"127.0.0.1:{master.port}")
+        assert not op.upload(f"{ar.url}/{ar.fid}", rows, jwt=ar.auth).error
+
+        with grpc.insecure_channel(f"127.0.0.1:{vs.grpc_port}") as ch:
+            stripes = list(
+                rpc.volume_stub(ch).Query(
+                    volume_pb2.QueryRequest(
+                        selections=["name", "n"],
+                        from_file_ids=[ar.fid],
+                        filter=volume_pb2.QueryRequest.Filter(
+                            field="n", operand=">", value="2"
+                        ),
+                    )
+                )
+            )
+        records = b"".join(s.records for s in stripes).decode().strip().splitlines()
+        assert records == ['["b", 5]', '["c", 9]']
+
+
+class TestDeltaHeartbeats:
+    def test_new_volume_registers_via_delta(self, mini_cluster):
+        """After the first full beat, a freshly grown volume reaches the
+        master through a delta beat (O(changes) chatter)."""
+        from seaweedfs_tpu.client import operation as op
+
+        master, vs = mini_cluster
+        # force growth in a new collection -> new volumes appear between
+        # full beats; the master must learn them from the delta path
+        ar = op.assign(f"127.0.0.1:{master.port}", collection="deltac")
+        vid = int(ar.fid.split(",")[0])
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if master.topology.lookup("deltac", vid):
+                break
+            time.sleep(0.05)
+        assert master.topology.lookup("deltac", vid)
+        assert not op.upload(f"{ar.url}/{ar.fid}", b"delta beat", jwt=ar.auth).error
+
+
+# ---------------------------------------------------------------------------
+# 5-byte offsets (subprocess: the switch is process-wide)
+
+
+class TestFiveByteOffsets:
+    def test_idx_layout_and_volume_roundtrip(self, tmp_path):
+        code = f"""
+import os
+os.environ["WEED_VOLUME_OFFSET_SIZE"] = "5"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from seaweedfs_tpu.storage import types as t, idx
+assert t.OFFSET_SIZE == 5 and idx.ENTRY_SIZE == 17
+e = idx.pack_entry(7, 0xFFFFFFFFF, 123)
+assert len(e) == 17
+assert idx.unpack_entry(e) == (7, 0xFFFFFFFFF, 123)
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+v = Volume({str(tmp_path)!r}, 3)
+v.write_needle(Needle(cookie=1, id=11, data=b"five byte offsets"))
+assert bytes(v.read_needle(11, cookie=1).data) == b"five byte offsets"
+v.close()
+v2 = Volume({str(tmp_path)!r}, 3, create=False)
+assert bytes(v2.read_needle(11, cookie=1).data) == b"five byte offsets"
+print("OK")
+"""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd="/root/repo",
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "OK" in proc.stdout
