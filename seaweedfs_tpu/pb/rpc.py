@@ -150,6 +150,16 @@ VOLUME_METHODS = {
         UNARY_STREAM,
     ),
     "Query": (v.QueryRequest, v.QueriedStripe, UNARY_STREAM),
+    "VolumeTailSender": (
+        v.VolumeTailSenderRequest,
+        v.VolumeTailSenderResponse,
+        UNARY_STREAM,
+    ),
+    "VolumeTailReceiver": (
+        v.VolumeTailReceiverRequest,
+        v.VolumeTailReceiverResponse,
+        UNARY_UNARY,
+    ),
 }
 
 

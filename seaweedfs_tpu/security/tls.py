@@ -47,8 +47,16 @@ def load_tls_config(cfg, component: str) -> TlsConfig | None:
     cert = cfg.get_string(f"grpc.{component}.cert") or cfg.get_string("grpc.cert")
     key = cfg.get_string(f"grpc.{component}.key") or cfg.get_string("grpc.key")
     ca = cfg.get_string("grpc.ca")
-    if not cert and not key:
+    if not cert and not key and not ca:
         return None
+    if not cert or not key:
+        # a partial config silently downgrading to plaintext would be a
+        # security misconfiguration; refuse to start instead
+        raise ValueError(
+            f"incomplete gRPC TLS config for {component!r}: both cert and "
+            f"key are required (got cert={bool(cert)}, key={bool(key)}, "
+            f"ca={bool(ca)})"
+        )
     return TlsConfig(
         ca_pem=_read(ca), cert_pem=_read(cert), key_pem=_read(key)
     )

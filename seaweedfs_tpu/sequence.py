@@ -36,3 +36,65 @@ class MemorySequencer:
     def peek(self) -> int:
         with self._lock:
             return self._counter
+
+
+class FileSequencer:
+    """Durable sequencer: the counter survives master restarts the way
+    the reference's EtcdSequencer does (sequence/etcd_sequencer.go) —
+    without an external KV store, the durable medium is a local file.
+
+    Ranges are reserved in batches: the file stores the upper bound of
+    the reserved range, so one fsync covers `batch` allocations and a
+    crash only skips ids (never reuses them) — the same no-reuse
+    guarantee etcd leases give the reference."""
+
+    BATCH = 10000  # ids reserved per durable write (etcd_sequencer.go step)
+
+    def __init__(self, path: str, batch: int = BATCH):
+        import os
+
+        self._path = path
+        self._batch = batch
+        self._lock = threading.Lock()
+        reserved = 0
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    reserved = int(f.read().strip() or 0)
+            except (OSError, ValueError):
+                reserved = 0
+        # resume past everything previously reserved: ids in (counter,
+        # reserved] may or may not have been handed out pre-crash
+        self._counter = reserved + 1
+        self._reserved = reserved
+        self._ensure_reserved_locked()
+
+    def _ensure_reserved_locked(self) -> None:
+        import os
+
+        if self._counter <= self._reserved:
+            return  # still inside the durably reserved range
+        self._reserved = self._counter + self._batch
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(self._reserved))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)
+
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            start = self._counter
+            self._counter += count
+            self._ensure_reserved_locked()
+            return start
+
+    def set_max(self, seen_value: int) -> None:
+        with self._lock:
+            if seen_value >= self._counter:
+                self._counter = seen_value + 1
+                self._ensure_reserved_locked()
+
+    def peek(self) -> int:
+        with self._lock:
+            return self._counter

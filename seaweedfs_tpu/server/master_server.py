@@ -74,12 +74,25 @@ class MasterServer:
         guard=None,
         peers: str | list | None = None,
         raft_dir: str | None = None,
+        vacuum_interval: float = 15 * 60.0,
     ):
         self.host = host
         self.port = port
         self.grpc_port = port + 10000  # reference convention: http port + 10000
         self.topology = Topology(volume_size_limit_mb * 1024 * 1024)
-        self.sequencer = MemorySequencer()
+        # durable (file-backed, etcd_sequencer.go role) when the master
+        # has a meta directory; in-memory otherwise
+        if raft_dir:
+            import os as _os
+
+            from seaweedfs_tpu.sequence import FileSequencer
+
+            _os.makedirs(raft_dir, exist_ok=True)
+            self.sequencer = FileSequencer(
+                _os.path.join(raft_dir, f"sequencer-{port}.txt")
+            )
+        else:
+            self.sequencer = MemorySequencer()
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
         self.guard = guard  # security.Guard; assign responses carry a jwt
@@ -109,6 +122,10 @@ class MasterServer:
             )
         self._vid_alloc_lock = threading.Lock()
         self._grow_lock = threading.Lock()
+        # leader-only periodic garbage-ratio vacuum sweep
+        # (master_server.go:126 StartRefreshWritableVolumes); 0 disables
+        self.vacuum_interval = vacuum_interval
+        self._stop_event = threading.Event()
         self._clients: dict[int, queue.Queue] = {}
         self._clients_seq = 0
         self._clients_lock = threading.Lock()
@@ -197,16 +214,20 @@ class MasterServer:
                             [v.id for v in deleted],
                         )
                 elif req.new_volumes or req.deleted_volumes:
-                    # delta beat: O(changes) registration
+                    # delta beat: O(changes) registration. Stat changes
+                    # to already-registered volumes update layouts but
+                    # must not spam KeepConnected clients as "new"
                     new = [_vol_info_from_pb(v) for v in req.new_volumes]
                     deleted = [_vol_info_from_pb(v) for v in req.deleted_volumes]
+                    truly_new = [v.id for v in new if v.id not in dn.volumes]
                     self.topology.delta_sync_volumes(dn, new, deleted)
-                    self._broadcast(
-                        dn.url,
-                        dn.public_url,
-                        [v.id for v in new],
-                        [v.id for v in deleted],
-                    )
+                    if truly_new or deleted:
+                        self._broadcast(
+                            dn.url,
+                            dn.public_url,
+                            truly_new,
+                            [v.id for v in deleted],
+                        )
                 if req.ec_shards or req.has_no_ec_shards:
                     self.topology.sync_ec_shards(
                         dn,
@@ -587,6 +608,96 @@ class MasterServer:
             **({"auth": resp.auth} if resp.auth else {}),
         }
 
+    # ------------------------------------------------------------------
+    # leader vacuum loop (topology_vacuum.go:16-160 via
+    # topology_event_handling.go StartRefreshWritableVolumes)
+    def _vacuum_once(self) -> int:
+        """One garbage-ratio sweep: replica-consistent check → compact
+        all replicas → commit all (cleanup on failure). Returns the
+        number of vacuumed volumes."""
+        compacted = 0
+        for dn in self.topology.data_nodes():
+            for vid, info in list(dn.volumes.items()):
+                if info.read_only:
+                    continue
+                locations = self.topology.lookup(info.collection, vid) or [dn]
+                try:
+                    # phase 1: every replica must be above threshold
+                    ratios = []
+                    for node in locations:
+                        with rpc.dial(self._node_grpc(node)) as ch:
+                            resp = rpc.volume_stub(ch).VacuumVolumeCheck(
+                                volume_pb2.VacuumVolumeCheckRequest(volume_id=vid),
+                                timeout=30,
+                            )
+                        ratios.append(resp.garbage_ratio)
+                    if not ratios or min(ratios) < self.garbage_threshold:
+                        continue
+                    # fence writes for the whole compact→commit span: a
+                    # write landing between the snapshot and the swap
+                    # would be silently lost (the reference instead
+                    # replays makeupDiff, volume_vacuum.go:78-133; our
+                    # compact holds the volume lock, so the only unsafe
+                    # window is BETWEEN the two RPCs)
+                    for node in locations:
+                        with rpc.dial(self._node_grpc(node)) as ch:
+                            rpc.volume_stub(ch).VolumeMarkReadonly(
+                                volume_pb2.VolumeMarkReadonlyRequest(volume_id=vid),
+                                timeout=30,
+                            )
+                    try:
+                        for node in locations:
+                            with rpc.dial(self._node_grpc(node)) as ch:
+                                rpc.volume_stub(ch).VacuumVolumeCompact(
+                                    volume_pb2.VacuumVolumeCompactRequest(
+                                        volume_id=vid
+                                    ),
+                                    timeout=600,
+                                )
+                        for node in locations:
+                            with rpc.dial(self._node_grpc(node)) as ch:
+                                rpc.volume_stub(ch).VacuumVolumeCommit(
+                                    volume_pb2.VacuumVolumeCommitRequest(
+                                        volume_id=vid
+                                    ),
+                                    timeout=600,
+                                )
+                    finally:
+                        for node in locations:
+                            try:
+                                with rpc.dial(self._node_grpc(node)) as ch:
+                                    rpc.volume_stub(ch).VolumeMarkWritable(
+                                        volume_pb2.VolumeMarkWritableRequest(
+                                            volume_id=vid
+                                        ),
+                                        timeout=30,
+                                    )
+                            except grpc.RpcError:
+                                pass
+                    compacted += 1
+                except grpc.RpcError:
+                    # phase 4: abandon scratch files on the replicas
+                    for node in locations:
+                        try:
+                            with rpc.dial(self._node_grpc(node)) as ch:
+                                rpc.volume_stub(ch).VacuumVolumeCleanup(
+                                    volume_pb2.VacuumVolumeCleanupRequest(
+                                        volume_id=vid
+                                    ),
+                                    timeout=30,
+                                )
+                        except grpc.RpcError:
+                            pass
+        return compacted
+
+    def _vacuum_loop(self) -> None:
+        while not self._stop_event.wait(self.vacuum_interval):
+            if self.is_leader:
+                try:
+                    self._vacuum_once()
+                except Exception:  # noqa: BLE001 - loop must survive
+                    pass
+
     def start(self) -> None:
         self._grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         self._grpc_server.add_generic_rpc_handlers(
@@ -609,8 +720,11 @@ class MasterServer:
             (self.host, self.port), self._http_handler_class()
         )
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
+        if self.vacuum_interval > 0:
+            threading.Thread(target=self._vacuum_loop, daemon=True).start()
 
     def stop(self) -> None:
+        self._stop_event.set()
         if self._raft is not None:
             self._raft.stop()
         if self._http_server:

@@ -405,25 +405,105 @@ class VolumeServer:
         # stream the .dat tail whose records are newer than since_ns
         # (binary search over AppendAtNs, volume_backup.go:170); linear
         # scan from the superblock is equivalent on the append-only file
+        for blob, _n, _end in self._iter_needles_since(v, req.since_ns):
+            yield pb.VolumeIncrementalCopyResponse(file_content=blob)
+
+    # tail follow/replicate (volume_grpc_tail.go)
+    def _iter_needles_since(self, v, since_ns: int, start_offset: int = 0):
+        """(blob, needle) for needles appended after since_ns, in .dat
+        order, starting the scan at start_offset (sendNeedlesSince
+        role; linear scan is equivalent to the binary search on the
+        append-only file). The generator's .end_offset attribute is
+        unusable from a generator, so callers that poll should pass the
+        last end offset back in — see VolumeTailSender."""
         from seaweedfs_tpu.storage.needle import get_actual_size
         from seaweedfs_tpu.storage.super_block import SUPER_BLOCK_SIZE
 
-        offset = SUPER_BLOCK_SIZE + len(v.super_block.extra)
+        offset = max(start_offset, SUPER_BLOCK_SIZE + len(v.super_block.extra))
         size = v.data_file_size()
         while offset < size:
             header = v._read_at(offset, 16)
             if len(header) < 16:
-                break
+                return
             _, _, nsize = Needle.parse_header(header + bytes(16))
-            record = get_actual_size(nsize if nsize != 0xFFFFFFFF else 0, v.version)
+            record = get_actual_size(
+                nsize if nsize != 0xFFFFFFFF else 0, v.version
+            )
             blob = v._read_at(offset, record)
             try:
                 n = Needle.from_bytes(blob, v.version)
-                if n.append_at_ns > req.since_ns:
-                    yield pb.VolumeIncrementalCopyResponse(file_content=blob)
             except ValueError:
-                break
+                return
+            if n.append_at_ns > since_ns:
+                yield blob, n, offset + record
             offset += record
+
+    def VolumeTailSender(self, req, context):
+        """Stream needles appended since since_ns as (header, body)
+        pairs; keep following until idle for idle_timeout_seconds
+        (0 = follow forever) (volume_grpc_tail.go:16-54)."""
+        v = self.store.find_volume(req.volume_id)
+        if v is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"volume {req.volume_id} not found"
+            )
+        last_ns = req.since_ns
+        draining = req.idle_timeout_seconds
+        # resume each poll from the previous end-of-file position: the
+        # .dat is append-only, so a follow-forever tail must not rescan
+        # the whole volume every 2 seconds
+        resume_at = 0
+        while not self._stop.is_set():
+            progressed = False
+            for blob, n, end in self._iter_needles_since(v, last_ns, resume_at):
+                yield pb.VolumeTailSenderResponse(
+                    needle_header=blob[:16],
+                    needle_body=blob[16:],
+                    is_last_chunk=False,
+                )
+                last_ns = max(last_ns, n.append_at_ns)
+                resume_at = end
+                progressed = True
+            if req.idle_timeout_seconds == 0:
+                self._stop.wait(2.0)
+                continue
+            if progressed:
+                draining = req.idle_timeout_seconds
+            else:
+                draining -= 1
+                if draining <= 0:
+                    return
+            self._stop.wait(1.0)
+
+    def VolumeTailReceiver(self, req, context):
+        """Pull a source server's tail into the local volume
+        (volume_grpc_tail.go:79 VolumeTailReceiver)."""
+        v = self.store.find_volume(req.volume_id)
+        if v is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"volume {req.volume_id} not found"
+            )
+        host, _, port = req.source_volume_server.partition(":")
+        with rpc.dial(f"{host}:{int(port) + 10000}") as ch:
+            for resp in rpc.volume_stub(ch).VolumeTailSender(
+                pb.VolumeTailSenderRequest(
+                    volume_id=req.volume_id,
+                    since_ns=req.since_ns,
+                    idle_timeout_seconds=req.idle_timeout_seconds or 2,
+                )
+            ):
+                blob = resp.needle_header + resp.needle_body
+                try:
+                    n = Needle.from_bytes(blob, v.version)
+                except ValueError:
+                    continue
+                if len(n.data) == 0:
+                    # zero-size record = tombstone (the reference keys
+                    # replicated deletes off n.Size == 0 the same way)
+                    v.delete_needle(n)
+                else:
+                    v.write_needle(n)
+        return pb.VolumeTailReceiverResponse()
 
     # EC verbs (volume_grpc_erasure_coding.go)
     def _base_name(self, collection: str, vid: int) -> str:

@@ -32,10 +32,18 @@ class VolumeLayout:
             nodes = self.vid2location.setdefault(v.id, [])
             if dn not in nodes:
                 nodes.append(dn)
+            # both directions: a vacuumed volume shrinking below the
+            # limit or a readonly→writable flip must restore
+            # writability (StartRefreshWritableVolumes role), not just
+            # the degrading transitions
             if v.read_only:
                 self.readonly_vids.add(v.id)
+            else:
+                self.readonly_vids.discard(v.id)
             if self._is_oversized(v):
                 self.oversized_vids.add(v.id)
+            else:
+                self.oversized_vids.discard(v.id)
             self._refresh_writable(v.id)
 
     def unregister_volume(self, vid: int, dn: DataNode) -> None:

@@ -390,3 +390,138 @@ print("OK")
         )
         assert proc.returncode == 0, proc.stderr[-2000:]
         assert "OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# master vacuum loop + tail RPCs + durable sequencer
+
+
+class TestMasterVacuumLoop:
+    def test_vacuum_once_compacts_garbage(self, tmp_path_factory):
+        from seaweedfs_tpu.client import operation as op
+        from seaweedfs_tpu.server.master_server import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+
+        master = MasterServer(
+            port=free_port(),
+            volume_size_limit_mb=64,
+            garbage_threshold=0.3,
+            vacuum_interval=0,  # loop off; drive _vacuum_once directly
+        )
+        master.start()
+        vs = VolumeServer(
+            [str(tmp_path_factory.mktemp("vacvs"))],
+            port=free_port(),
+            master=f"127.0.0.1:{master.port}",
+            heartbeat_interval=0.1,
+            max_volume_counts=[100],
+        )
+        vs.start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and len(master.topology.data_nodes()) < 1:
+                time.sleep(0.05)
+            ar = op.assign(f"127.0.0.1:{master.port}", collection="vacloop")
+            vid = int(ar.fid.split(",")[0])
+            # create garbage: write then delete a fat needle
+            assert not op.upload(
+                f"{ar.url}/{ar.fid}", b"x" * 20000, jwt=ar.auth
+            ).error
+            op.delete(f"{ar.url}/{ar.fid}")
+            keeper = op.assign(f"127.0.0.1:{master.port}", collection="vacloop")
+            assert not op.upload(
+                f"{keeper.url}/{keeper.fid}", b"keep me", jwt=keeper.auth
+            ).error
+
+            vol = vs.store.find_volume(vid)
+            assert vol.garbage_level() > 0.3
+            compacted = master._vacuum_once()
+            assert compacted >= 1
+            assert vol.garbage_level() < 0.1
+            # live needle survives compaction
+            if int(keeper.fid.split(",")[0]) == vid:
+                data, _ = op.download(f"{keeper.url}/{keeper.fid}")
+                assert data == b"keep me"
+        finally:
+            vs.stop()
+            master.stop()
+
+
+class TestTailRpcs:
+    def test_sender_streams_and_receiver_applies(self, mini_cluster, tmp_path_factory):
+        import grpc
+
+        from seaweedfs_tpu.client import operation as op
+        from seaweedfs_tpu.pb import rpc, volume_pb2
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+
+        master, vs = mini_cluster
+        ar = op.assign(f"127.0.0.1:{master.port}", collection="tail")
+        vid = int(ar.fid.split(",")[0])
+        payload = b"tail me " * 100
+        assert not op.upload(f"{ar.url}/{ar.fid}", payload, jwt=ar.auth).error
+
+        # sender drains after the idle timeout and delivers the needle
+        with grpc.insecure_channel(f"127.0.0.1:{vs.grpc_port}") as ch:
+            frames = list(
+                rpc.volume_stub(ch).VolumeTailSender(
+                    volume_pb2.VolumeTailSenderRequest(
+                        volume_id=vid, since_ns=0, idle_timeout_seconds=1
+                    ),
+                    timeout=30,
+                )
+            )
+        assert frames, "expected at least one tailed needle"
+        assert payload in b"".join(f.needle_body for f in frames)
+
+        # a second server replicates the volume through TailReceiver
+        vs2 = VolumeServer(
+            [str(tmp_path_factory.mktemp("tailvs2"))],
+            port=free_port(),
+            master="",  # standalone; no heartbeats needed
+        )
+        vs2.start()
+        try:
+            with grpc.insecure_channel(f"127.0.0.1:{vs2.grpc_port}") as ch:
+                rpc.volume_stub(ch).AllocateVolume(
+                    volume_pb2.AllocateVolumeRequest(
+                        volume_id=vid, collection="", replication="000"
+                    )
+                )
+                rpc.volume_stub(ch).VolumeTailReceiver(
+                    volume_pb2.VolumeTailReceiverRequest(
+                        volume_id=vid,
+                        since_ns=0,
+                        idle_timeout_seconds=1,
+                        source_volume_server=f"{vs.host}:{vs.port}",
+                    ),
+                    timeout=60,
+                )
+            data, _ = op.download(f"127.0.0.1:{vs2.port}/{ar.fid}")
+            assert data == payload
+        finally:
+            vs2.stop()
+
+
+class TestFileSequencer:
+    def test_no_reuse_across_restart(self, tmp_path):
+        from seaweedfs_tpu.sequence import FileSequencer
+
+        path = str(tmp_path / "seq.txt")
+        s = FileSequencer(path, batch=10)
+        first = s.next_file_id(5)
+        assert first == 1
+        second = s.next_file_id(1)
+        assert second == 6
+
+        # crash (no clean shutdown): a new instance must never re-issue
+        s2 = FileSequencer(path, batch=10)
+        third = s2.next_file_id(1)
+        assert third > second
+
+    def test_set_max_advances(self, tmp_path):
+        from seaweedfs_tpu.sequence import FileSequencer
+
+        s = FileSequencer(str(tmp_path / "seq2.txt"), batch=10)
+        s.set_max(500)
+        assert s.next_file_id(1) == 501
