@@ -1,0 +1,80 @@
+"""Native runtime shims (the reference's vendored-assembly tier).
+
+The reference's only native code is vendored Go assembly:
+klauspost/crc32 (SSE4.2 Castagnoli, needle/crc.go:8) and
+klauspost/reedsolomon AVX2 (replaced here by the TPU SWAR kernel,
+ec/codec_tpu.py). This package supplies the CRC counterpart as a small
+C library compiled lazily with the system compiler and loaded via
+ctypes — no pybind11/pip needed. When no compiler is available the
+pure-Python slicing-by-8 fallback in util/crc.py serves instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "_crc32c.so")
+_SRC_PATH = os.path.join(_HERE, "crc32c.c")
+
+
+def _build() -> str | None:
+    """Compile crc32c.c → _crc32c.so (cached; rebuilt when stale)."""
+    try:
+        if os.path.exists(_SO_PATH) and os.path.getmtime(
+            _SO_PATH
+        ) >= os.path.getmtime(_SRC_PATH):
+            return _SO_PATH
+        for cc in ("cc", "gcc", "g++", "clang"):
+            # build to a temp file then rename: concurrent importers
+            # must never dlopen a half-written .so
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+            os.close(fd)
+            try:
+                proc = subprocess.run(
+                    [cc, "-O2", "-shared", "-fPIC", "-o", tmp, _SRC_PATH],
+                    capture_output=True,
+                    timeout=60,
+                )
+                if proc.returncode == 0:
+                    os.replace(tmp, _SO_PATH)
+                    return _SO_PATH
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+            finally:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return None
+
+
+_lib = None
+_so = _build()
+if _so is not None:
+    try:
+        _lib = ctypes.CDLL(_so)
+        _lib.weed_crc32c.restype = ctypes.c_uint32
+        _lib.weed_crc32c.argtypes = (
+            ctypes.c_uint32,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        )
+    except OSError:
+        _lib = None
+
+if _lib is None:  # surface as ImportError so util/crc.py falls back
+    raise ImportError("native crc32c unavailable (no compiler or load failed)")
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """Hardware-accelerated CRC-32C (SSE4.2 when the CPU has it).
+    Accepts any bytes-like object, matching the Python fallback."""
+    if not isinstance(data, bytes):
+        data = bytes(data)
+    return _lib.weed_crc32c(crc & 0xFFFFFFFF, data, len(data))

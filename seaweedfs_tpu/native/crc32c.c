@@ -1,0 +1,89 @@
+/* CRC32-Castagnoli: the needle-checksum hot path.
+ *
+ * Role match: the reference vendors github.com/klauspost/crc32 for its
+ * SSE4.2 Castagnoli kernel (weed/storage/needle/crc.go:8); this is the
+ * same component as a small C library loaded via ctypes.
+ *
+ * Two paths, chosen once at load time:
+ *   - hardware: SSE4.2 crc32 instruction, 8 bytes per step
+ *   - portable: slicing-by-8 tables
+ * Both compute the standard reflected CRC-32C (poly 0x1EDC6F41).
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+
+#if defined(__x86_64__) /* crc32di needs 64-bit mode */
+#include <cpuid.h>
+#define HAVE_X86 1
+#endif
+
+static uint32_t table8[8][256];
+static int use_hw = 0;
+
+/* constructor: runs once at dlopen, before any caller thread exists —
+ * lazy init under ctypes would race (the GIL is released during calls) */
+__attribute__((constructor)) static void init_tables(void) {
+    const uint32_t poly = 0x82F63B78u; /* reflected Castagnoli */
+    for (int i = 0; i < 256; i++) {
+        uint32_t c = (uint32_t)i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? (c >> 1) ^ poly : c >> 1;
+        table8[0][i] = c;
+    }
+    for (int t = 1; t < 8; t++)
+        for (int i = 0; i < 256; i++)
+            table8[t][i] =
+                table8[0][table8[t - 1][i] & 0xFF] ^ (table8[t - 1][i] >> 8);
+#ifdef HAVE_X86
+    {
+        unsigned int eax, ebx, ecx, edx;
+        if (__get_cpuid(1, &eax, &ebx, &ecx, &edx))
+            use_hw = (ecx & (1u << 20)) != 0; /* SSE4.2 */
+    }
+#endif
+}
+
+#ifdef HAVE_X86
+__attribute__((target("sse4.2"))) static uint32_t crc_hw(uint32_t crc,
+                                                         const uint8_t *p,
+                                                         size_t n) {
+    uint64_t c = crc;
+    while (n >= 8) {
+        uint64_t v;
+        __builtin_memcpy(&v, p, 8);
+        c = __builtin_ia32_crc32di(c, v);
+        p += 8;
+        n -= 8;
+    }
+    uint32_t c32 = (uint32_t)c;
+    while (n--) c32 = __builtin_ia32_crc32qi(c32, *p++);
+    return c32;
+}
+#endif
+
+static uint32_t crc_sw(uint32_t c, const uint8_t *p, size_t n) {
+    while (n >= 8) {
+        uint32_t lo, hi;
+        __builtin_memcpy(&lo, p, 4);
+        __builtin_memcpy(&hi, p + 4, 4);
+        lo ^= c;
+        c = table8[7][lo & 0xFF] ^ table8[6][(lo >> 8) & 0xFF] ^
+            table8[5][(lo >> 16) & 0xFF] ^ table8[4][lo >> 24] ^
+            table8[3][hi & 0xFF] ^ table8[2][(hi >> 8) & 0xFF] ^
+            table8[1][(hi >> 16) & 0xFF] ^ table8[0][hi >> 24];
+        p += 8;
+        n -= 8;
+    }
+    while (n--) c = table8[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+    return c;
+}
+
+/* Standard CRC-32C continuing from `crc` (pre-inversion handled here). */
+uint32_t weed_crc32c(uint32_t crc, const uint8_t *data, size_t n) {
+    uint32_t c = crc ^ 0xFFFFFFFFu;
+#ifdef HAVE_X86
+    if (use_hw) return crc_hw(c, data, n) ^ 0xFFFFFFFFu;
+#endif
+    return crc_sw(c, data, n) ^ 0xFFFFFFFFu;
+}
