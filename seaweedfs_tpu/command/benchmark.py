@@ -80,8 +80,17 @@ class BenchmarkCommand(Command):
         p.add_argument("-write", action=argparse.BooleanOptionalAction, default=True)
         p.add_argument("-read", action=argparse.BooleanOptionalAction, default=True)
         p.add_argument("-deletePercent", type=int, default=0)
+        p.add_argument(
+            "-cpuprofile", default="", help="dump pstats profile here on exit"
+        )
 
     def run(self, args) -> int:
+        from seaweedfs_tpu.util.profiling import CpuProfile
+
+        with CpuProfile(args.cpuprofile):
+            return self._run(args)
+
+    def _run(self, args) -> int:
         stats, fids = run_benchmark(
             master=args.master,
             concurrency=args.concurrency,

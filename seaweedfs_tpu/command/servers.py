@@ -79,6 +79,7 @@ class MasterCommand(Command):
             help="comma-separated master peers incl. self (HA raft cluster)",
         )
         p.add_argument("-mdir", default="", help="raft/meta data directory")
+        p.add_argument("-cpuprofile", default="", help="dump pstats profile here on exit")
         p.add_argument("-v", type=int, default=0, help="verbosity")
 
     def run(self, args) -> int:
@@ -99,12 +100,22 @@ class MasterCommand(Command):
             peers=args.peers or None,
             raft_dir=args.mdir or None,
         )
-        server.start()
-        wlog.info("master listening on %s:%d (grpc %d)", args.ip, args.port, args.port + 10000)
-        try:
-            return _wait_forever()
-        finally:
-            server.stop()
+        from seaweedfs_tpu.util.profiling import CpuProfile
+
+        # the profiler must wrap start(): threads created before
+        # enable() (gRPC executor, raft loops) are never instrumented
+        with CpuProfile(args.cpuprofile):
+            server.start()
+            wlog.info(
+                "master listening on %s:%d (grpc %d)",
+                args.ip,
+                args.port,
+                args.port + 10000,
+            )
+            try:
+                return _wait_forever()
+            finally:
+                server.stop()
 
 
 @register
@@ -122,6 +133,7 @@ class VolumeCommand(Command):
         p.add_argument("-rack", default="")
         p.add_argument("-publicUrl", default="")
         p.add_argument("-readRedirect", action="store_true")
+        p.add_argument("-cpuprofile", default="", help="dump pstats profile here on exit")
         p.add_argument(
             "-index",
             default="memory",
@@ -162,12 +174,17 @@ class VolumeCommand(Command):
             storage_backends=load_config("master").sub("storage.backend"),
             needle_map_kind=args.index,
         )
-        server.start()
-        wlog.info("volume server %s:%d -> master %s", args.ip, args.port, args.mserver)
-        try:
-            return _wait_forever()
-        finally:
-            server.stop()
+        from seaweedfs_tpu.util.profiling import CpuProfile
+
+        with CpuProfile(args.cpuprofile):
+            server.start()
+            wlog.info(
+                "volume server %s:%d -> master %s", args.ip, args.port, args.mserver
+            )
+            try:
+                return _wait_forever()
+            finally:
+                server.stop()
 
 
 @register

@@ -361,6 +361,19 @@ class FilerServer:
                 length = int(self.headers.get("Content-Length", "0"))
                 data = self.rfile.read(length)
                 mime = self.headers.get("Content-Type", "")
+                if mime.lower().startswith("multipart/form-data"):
+                    # `curl -F` form uploads (filer_server_handlers_write.go
+                    # parses the same way through ParseUpload)
+                    from seaweedfs_tpu.util.multipart import (
+                        MalformedUpload,
+                        parse_upload,
+                    )
+
+                    try:
+                        p = parse_upload(data, mime)
+                    except MalformedUpload as e:
+                        return self._json({"error": str(e)}, 400)
+                    data, mime = p.data, p.mime
                 if (raw_path.endswith("/") and raw_path != "/") or (
                     not data and not length
                 ):

@@ -1047,12 +1047,26 @@ class VolumeServer:
                     return
                 length = int(self.headers.get("Content-Length", "0"))
                 body = self.rfile.read(length)
-                n = Needle(cookie=fid.cookie, id=fid.key, data=body)
-                ctype = self.headers.get("Content-Type", "")
+                # `curl -F file=@x` multipart forms carry the payload,
+                # filename, and mime inside the body (needle.go:85
+                # ParseUpload); raw bodies pass through unchanged
+                from seaweedfs_tpu.util.multipart import (
+                    MalformedUpload,
+                    parse_upload,
+                )
+
+                try:
+                    part = parse_upload(
+                        body, self.headers.get("Content-Type", "")
+                    )
+                except MalformedUpload as e:
+                    return self._json({"error": str(e)}, 400)
+                n = Needle(cookie=fid.cookie, id=fid.key, data=part.data)
+                ctype = part.mime
                 if ctype and len(ctype) < 256 and ctype != "application/octet-stream":
                     n.mime = ctype.encode()
                     n.set_has_mime()
-                fname = q.get("filename", "")
+                fname = q.get("filename", "") or part.filename
                 if fname and len(fname) < 256:
                     n.name = fname.encode()
                     n.set_has_name()

@@ -1,0 +1,56 @@
+"""multipart/form-data parsing for blob uploads.
+
+Role match: the reference's needle.CreateNeedleFromRequest
+(weed/storage/needle/needle.go:85 ParseUpload) accepts both raw bodies
+and `curl -F file=@x` multipart forms, taking the first file part's
+bytes, filename, and content type. Stdlib `email` does the MIME
+parsing (cgi.FieldStorage left the stdlib in 3.13)."""
+
+from __future__ import annotations
+
+import email.parser
+import email.policy
+from dataclasses import dataclass
+
+
+@dataclass
+class UploadPart:
+    data: bytes
+    filename: str = ""
+    mime: str = ""
+
+
+class MalformedUpload(ValueError):
+    """Multipart content type with no parsable file part — the
+    reference's ParseUpload errors here rather than storing 0 bytes."""
+
+
+def parse_upload(body: bytes, content_type: str) -> UploadPart:
+    """The first file part of a multipart body, or the raw body itself
+    when the request is not multipart/form-data (ParseUpload role)."""
+    if not content_type.lower().startswith("multipart/form-data"):
+        return UploadPart(data=body, mime=content_type)
+    parser = email.parser.BytesParser(policy=email.policy.HTTP)
+    msg = parser.parsebytes(
+        b"Content-Type: " + content_type.encode("latin-1") + b"\r\n\r\n" + body
+    )
+    first: UploadPart | None = None
+    for part in msg.iter_parts():
+        payload = part.get_payload(decode=True)
+        if payload is None:
+            continue
+        filename = part.get_filename() or ""
+        # only an EXPLICIT part Content-Type counts (the email parser
+        # defaults to text/plain, which must not be stamped on binary)
+        ctype = part.get_content_type() if part.get("Content-Type") else ""
+        candidate = UploadPart(data=payload, filename=filename, mime=ctype)
+        if filename:
+            # the reference takes the first part that carries a file
+            return candidate
+        if first is None:
+            first = candidate
+    if first is None:
+        raise MalformedUpload(
+            "multipart/form-data body contained no parsable part"
+        )
+    return first

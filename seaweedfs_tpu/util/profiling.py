@@ -1,0 +1,25 @@
+"""-cpuprofile support (reference command/benchmark.go:64,
+master.go:74, server.go:66 pprof.StartCPUProfile): run the process
+under cProfile, dump pstats to the given path on shutdown; the file
+loads with `python -m pstats <path>` (the pprof-viewer role)."""
+
+from __future__ import annotations
+
+
+class CpuProfile:
+    def __init__(self, path: str):
+        self.path = path
+        self._profile = None
+
+    def __enter__(self):
+        if self.path:
+            import cProfile
+
+            self._profile = cProfile.Profile()
+            self._profile.enable()
+        return self
+
+    def __exit__(self, *exc):
+        if self._profile is not None:
+            self._profile.disable()
+            self._profile.dump_stats(self.path)

@@ -537,3 +537,46 @@ class TestDegradedParallelRead:
                 f"127.0.0.1:{extra.port}" in urls
                 for urls in ev.shard_locations.values()
             )
+
+
+class TestMultipartUploads:
+    """`curl -F file=@x` form uploads (needle.go:85 ParseUpload)."""
+
+    def _multipart_body(self, filename, payload, mime="text/plain"):
+        boundary = "weedformboundary123"
+        body = (
+            f"--{boundary}\r\n"
+            f'Content-Disposition: form-data; name="file"; filename="{filename}"\r\n'
+            f"Content-Type: {mime}\r\n\r\n"
+        ).encode() + payload + f"\r\n--{boundary}--\r\n".encode()
+        return body, f"multipart/form-data; boundary={boundary}"
+
+    def test_volume_multipart_post(self, cluster):
+        master, _ = cluster
+        _, assign = http_json(master_url(master, "/dir/assign"))
+        payload = b"multipart payload bytes" * 40
+        body, ctype = self._multipart_body("form.txt", payload)
+        req = urllib.request.Request(
+            f"http://{assign['url']}/{assign['fid']}",
+            data=body,
+            method="POST",
+            headers={"Content-Type": ctype},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+        status, got = http_get(f"http://{assign['url']}/{assign['fid']}")
+        assert status == 200
+        assert got == payload  # boundary bytes must NOT be stored
+
+    def test_raw_post_still_works(self, cluster):
+        master, _ = cluster
+        _, assign = http_json(master_url(master, "/dir/assign"))
+        req = urllib.request.Request(
+            f"http://{assign['url']}/{assign['fid']}",
+            data=b"raw body",
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=10).close()
+        _, got = http_get(f"http://{assign['url']}/{assign['fid']}")
+        assert got == b"raw body"
