@@ -30,6 +30,39 @@ from seaweedfs_tpu.pb.rpc import grpc_address
 
 
 # ----------------------------------------------------------------------
+# HA master failover
+
+
+def _is_retryable_master_error(e: Exception) -> bool:
+    """Transport failures and leaderless windows rotate to the next
+    master; in-band application errors (e.g. 'no free volumes') come
+    from the leader itself — every master proxies to the same place,
+    so retrying them elsewhere just multiplies the same failure."""
+    if isinstance(e, (OSError, grpc.RpcError)):
+        return True
+    return "no leader" in str(e)
+
+
+def with_master_failover(masters, fn, start_idx: int = 0):
+    """Run fn(master) against the first master that answers, rotating
+    through the seed list on connection/RPC failure (any live master
+    serves: non-leaders proxy writes to the leader). Returns
+    (result, index_of_master_used); raises the last error when every
+    master is down. The single home for try-each-master logic."""
+    last: Exception | None = None
+    n = len(masters)
+    for i in range(n):
+        idx = (start_idx + i) % n
+        try:
+            return fn(masters[idx]), idx
+        except (RuntimeError, OSError, grpc.RpcError) as e:
+            if not _is_retryable_master_error(e):
+                raise
+            last = e
+    raise last if last is not None else RuntimeError("no masters configured")
+
+
+# ----------------------------------------------------------------------
 # assign
 
 

@@ -75,7 +75,10 @@ class Filer:
         from seaweedfs_tpu.client import operation as op
 
         try:
-            op.delete_files(self.masters[0], fids)
+            # HA: any live master can resolve locations for the batch
+            op.with_master_failover(
+                self.masters, lambda m: op.delete_files(m, fids)
+            )
         except Exception:  # noqa: BLE001 — deletion is best-effort GC
             pass
 

@@ -71,6 +71,8 @@ class FilerServer:
         on_event=None,
     ):
         self.masters = masters
+        self._master_idx = 0  # rotates on failure (HA master failover)
+        self._live_master_cache: tuple[str, float] | None = None
         self.host = host
         self.port = port
         self.grpc_port = port + 10000
@@ -86,13 +88,47 @@ class FilerServer:
         self._http_server: ThreadingHTTPServer | None = None
 
     # ------------------------------------------------------------------
+    # master failover: any live master serves (non-leaders proxy writes
+    # to the leader), so calls rotate through the seed list on failure
+    def _with_master(self, fn):
+        out, idx = op.with_master_failover(self.masters, fn, self._master_idx)
+        self._master_idx = idx
+        return out
+
+    def _live_master(self) -> str:
+        """A master that currently answers (Statistics probe), for the
+        read path's chunk lookups. The probe result is cached briefly
+        so steady-state reads don't pay an extra RPC each."""
+        import time as _time
+
+        cached = self._live_master_cache
+        if cached is not None and cached[1] > _time.monotonic():
+            return cached[0]
+
+        from seaweedfs_tpu.pb import master_pb2
+        from seaweedfs_tpu.pb.rpc import grpc_address
+
+        def probe(m):
+            with rpc.dial(grpc_address(m)) as ch:
+                rpc.master_stub(ch).Statistics(
+                    master_pb2.StatisticsRequest(), timeout=3
+                )
+            return m
+
+        m = self._with_master(probe)
+        self._live_master_cache = (m, _time.monotonic() + 5.0)
+        return m
+
+    # ------------------------------------------------------------------
     # write path helpers
     def _assign(self, collection: str = "", replication: str = "", ttl: str = "") -> op.AssignResult:
-        return op.assign(
-            self.masters[0],
-            collection=collection or self.collection,
-            replication=replication or self.replication,
-            ttl=ttl,
+        return self._with_master(
+            lambda m: op.assign(
+                m,
+                collection=collection or self.collection,
+                replication=replication or self.replication,
+                ttl=ttl,
+            )
         )
 
     def _upload_bytes(
@@ -199,7 +235,7 @@ class FilerServer:
     def LookupVolume(self, req: fpb.LookupVolumeRequest, context):
         out = fpb.LookupVolumeResponse()
         for vid in req.volume_ids:
-            res = op.lookup(self.masters[0], vid)
+            res = self._with_master(lambda m: op.lookup(m, vid))
             locs = out.locations_map[vid]
             for l in res.locations:
                 locs.locations.add(url=l["url"], public_url=l["publicUrl"])
@@ -209,22 +245,30 @@ class FilerServer:
         from seaweedfs_tpu.pb import master_pb2
         from seaweedfs_tpu.pb.rpc import grpc_address
 
-        with rpc.dial(grpc_address(self.masters[0])) as ch:
-            rpc.master_stub(ch).CollectionDelete(
-                master_pb2.CollectionDeleteRequest(name=req.collection)
-            )
+        def call(m):
+            with rpc.dial(grpc_address(m)) as ch:
+                rpc.master_stub(ch).CollectionDelete(
+                    master_pb2.CollectionDeleteRequest(name=req.collection)
+                )
+
+        self._with_master(call)
         return fpb.DeleteCollectionResponse()
 
     def Statistics(self, req: fpb.StatisticsRequest, context):
         from seaweedfs_tpu.pb import master_pb2
         from seaweedfs_tpu.pb.rpc import grpc_address
 
-        with rpc.dial(grpc_address(self.masters[0])) as ch:
-            resp = rpc.master_stub(ch).Statistics(
-                master_pb2.StatisticsRequest(
-                    replication=req.replication, collection=req.collection, ttl=req.ttl
+        def call(m):
+            with rpc.dial(grpc_address(m)) as ch:
+                return rpc.master_stub(ch).Statistics(
+                    master_pb2.StatisticsRequest(
+                        replication=req.replication,
+                        collection=req.collection,
+                        ttl=req.ttl,
+                    )
                 )
-            )
+
+        resp = self._with_master(call)
         return fpb.StatisticsResponse(
             total_size=resp.total_size,
             used_size=resp.used_size,
@@ -338,7 +382,7 @@ class FilerServer:
                 written = 0
                 try:
                     for piece in stream.stream_content(
-                        server.masters[0], entry.chunks, offset, length
+                        server._live_master(), entry.chunks, offset, length
                     ):
                         self.wfile.write(piece)
                         written += len(piece)
@@ -361,6 +405,7 @@ class FilerServer:
                 length = int(self.headers.get("Content-Length", "0"))
                 data = self.rfile.read(length)
                 mime = self.headers.get("Content-Type", "")
+                upload_filename = ""
                 if mime.lower().startswith("multipart/form-data"):
                     # `curl -F` form uploads (filer_server_handlers_write.go
                     # parses the same way through ParseUpload)
@@ -374,6 +419,12 @@ class FilerServer:
                     except MalformedUpload as e:
                         return self._json({"error": str(e)}, 400)
                     data, mime = p.data, p.mime
+                    upload_filename = p.filename
+                    if upload_filename and raw_path.endswith("/"):
+                        # form upload INTO a directory: store the file
+                        # under its form filename, don't mkdir
+                        path = f"{path.rstrip('/')}/{upload_filename}"
+                        raw_path = path
                 if (raw_path.endswith("/") and raw_path != "/") or (
                     not data and not length
                 ):
@@ -386,7 +437,7 @@ class FilerServer:
                 try:
                     chunks = server._upload_bytes(
                         data,
-                        filename=path.rsplit("/", 1)[-1],
+                        filename=upload_filename or path.rsplit("/", 1)[-1],
                         mime=mime,
                         collection=q.get("collection", ""),
                         replication=q.get("replication", ""),

@@ -299,7 +299,28 @@ class MasterServer:
             auth=result.get("auth", ""),
         )
 
+    def _proxy_to_leader_stub(self):
+        """Stub on the leader, or None when this master IS the leader
+        or no leader is known (master_server.go:151 proxyToLeader:
+        followers hold no topology — volume servers heartbeat only the
+        leader — so reads must be answered there)."""
+        leader = self.leader_address()
+        if leader == f"{self.host}:{self.port}":
+            return None
+        ch = grpc.insecure_channel(rpc.grpc_address(leader))
+        return ch, rpc.master_stub(ch)
+
     def LookupVolume(self, req: pb.LookupVolumeRequest, context) -> pb.LookupVolumeResponse:
+        if not self.is_leader:
+            proxied = self._proxy_to_leader_stub()
+            if proxied is not None:
+                ch, stub = proxied
+                try:
+                    return stub.LookupVolume(req, timeout=10)
+                except grpc.RpcError:
+                    pass  # fall through to the (likely empty) local view
+                finally:
+                    ch.close()
         out = pb.LookupVolumeResponse()
         for vid_str in req.vids:
             entry = out.vid_locations.add(vid=vid_str)
@@ -317,6 +338,16 @@ class MasterServer:
         return out
 
     def LookupEcVolume(self, req: pb.LookupEcVolumeRequest, context) -> pb.LookupEcVolumeResponse:
+        if not self.is_leader:
+            proxied = self._proxy_to_leader_stub()
+            if proxied is not None:
+                ch, stub = proxied
+                try:
+                    return stub.LookupEcVolume(req, timeout=10)
+                except grpc.RpcError:
+                    pass
+                finally:
+                    ch.close()
         out = pb.LookupEcVolumeResponse(volume_id=req.volume_id)
         locs = self.topology.lookup_ec_shards(req.volume_id)
         if locs is None:
@@ -330,6 +361,16 @@ class MasterServer:
         return out
 
     def Statistics(self, req: pb.StatisticsRequest, context) -> pb.StatisticsResponse:
+        if not self.is_leader:
+            proxied = self._proxy_to_leader_stub()
+            if proxied is not None:
+                ch, stub = proxied
+                try:
+                    return stub.Statistics(req, timeout=10)
+                except grpc.RpcError:
+                    pass
+                finally:
+                    ch.close()
         total = used = files = 0
         for dn in self.topology.data_nodes():
             for v in dn.volumes.values():
@@ -344,6 +385,16 @@ class MasterServer:
         return pb.CollectionListResponse(collections=sorted(self.topology.collections()))
 
     def CollectionDelete(self, req: pb.CollectionDeleteRequest, context):
+        if not self.is_leader:
+            proxied = self._proxy_to_leader_stub()
+            if proxied is not None:
+                ch, stub = proxied
+                try:
+                    return stub.CollectionDelete(req, timeout=30)
+                except grpc.RpcError:
+                    pass
+                finally:
+                    ch.close()
         for dn in self.topology.data_nodes():
             try:
                 with rpc.dial(self._node_grpc(dn)) as ch:

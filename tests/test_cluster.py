@@ -580,3 +580,36 @@ class TestMultipartUploads:
         urllib.request.urlopen(req, timeout=10).close()
         _, got = http_get(f"http://{assign['url']}/{assign['fid']}")
         assert got == b"raw body"
+
+
+class TestMultipartIntoDirectory:
+    def test_form_upload_into_filer_directory(self, cluster, tmp_path_factory):
+        """curl -F file=@x.txt http://filer/dir/ stores dir/x.txt."""
+        from seaweedfs_tpu.server.filer_server import FilerServer
+
+        master, _ = cluster
+        filer = FilerServer(
+            [f"127.0.0.1:{master.port}"], port=free_port(), store="memory"
+        )
+        filer.start()
+        try:
+            boundary = "bb123"
+            payload = b"into the directory"
+            body = (
+                f"--{boundary}\r\n"
+                'Content-Disposition: form-data; name="file"; filename="x.txt"\r\n'
+                "Content-Type: text/plain\r\n\r\n"
+            ).encode() + payload + f"\r\n--{boundary}--\r\n".encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{filer.port}/up/",
+                data=body,
+                method="POST",
+                headers={
+                    "Content-Type": f"multipart/form-data; boundary={boundary}"
+                },
+            )
+            urllib.request.urlopen(req, timeout=10).close()
+            status, got = http_get(f"http://127.0.0.1:{filer.port}/up/x.txt")
+            assert status == 200 and got == payload
+        finally:
+            filer.stop()

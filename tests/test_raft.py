@@ -213,3 +213,87 @@ class TestHaMasters:
         assert vid3 != vid1 or vid2 == vid1  # fresh collection => fresh vid
         ur2 = op.upload(f"{ar3.url}/{ar3.fid}", b"post failover")
         assert not ur2.error
+
+
+class TestFilerHaFailover:
+    def test_filer_writes_survive_leader_loss(self, tmp_path_factory):
+        """A filer configured with all three masters keeps serving
+        writes after the leader dies (rotation + leader proxy)."""
+        import urllib.request
+
+        from seaweedfs_tpu.server.filer_server import FilerServer
+        from seaweedfs_tpu.server.master_server import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+
+        ports = [free_port() for _ in range(3)]
+        peers = ",".join(f"127.0.0.1:{p}" for p in ports)
+        masters = [
+            MasterServer(
+                port=p,
+                volume_size_limit_mb=64,
+                peers=peers,
+                raft_dir=str(tmp_path_factory.mktemp(f"fha{p}")),
+            )
+            for p in ports
+        ]
+        for m in masters:
+            m.start()
+        vs = filer = None
+        try:
+            assert wait_for(
+                lambda: sum(1 for m in masters if m.is_leader) == 1, timeout=15
+            )
+            vs = VolumeServer(
+                [str(tmp_path_factory.mktemp("fhavs"))],
+                port=free_port(),
+                master=peers,
+                heartbeat_interval=0.2,
+                max_volume_counts=[100],
+            )
+            vs.start()
+            leader = next(m for m in masters if m.is_leader)
+            assert wait_for(
+                lambda: len(leader.topology.data_nodes()) == 1, timeout=15
+            )
+            filer = FilerServer(
+                [f"127.0.0.1:{p}" for p in ports],
+                port=free_port(),
+                store="memory",
+            )
+            filer.start()
+
+            def put(path, data):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{filer.port}{path}",
+                    data=data,
+                    method="POST",
+                )
+                urllib.request.urlopen(req, timeout=15).close()
+
+            put("/a/pre.txt", b"before failover")
+
+            leader.stop()
+            rest = [m for m in masters if m is not leader]
+            assert wait_for(
+                lambda: sum(1 for m in rest if m.is_leader) == 1, timeout=20
+            )
+            new_leader = next(m for m in rest if m.is_leader)
+            assert wait_for(
+                lambda: len(new_leader.topology.data_nodes()) == 1, timeout=20
+            )
+
+            put("/a/post.txt", b"after failover")
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{filer.port}/a/post.txt", timeout=15
+            ) as r:
+                assert r.read() == b"after failover"
+        finally:
+            if filer:
+                filer.stop()
+            if vs:
+                vs.stop()
+            for m in masters:
+                try:
+                    m.stop()
+                except Exception:
+                    pass
