@@ -7,7 +7,7 @@ import os
 import time
 
 from seaweedfs_tpu.replication.replicator import Replicator
-from seaweedfs_tpu.replication.sink import FilerSink, GatedSink, LocalSink
+from seaweedfs_tpu.replication.sink import FilerSink, GatedSink, LocalSink, S3Sink
 from seaweedfs_tpu.replication.source import FilerSource
 from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.util.config import load_config, Configuration
@@ -31,7 +31,15 @@ def build_replicator(repl_cfg: Configuration) -> Replicator:
     elif repl_cfg.get_bool("sink.local.enabled"):
         sink = LocalSink(repl_cfg.sub("sink.local").get("directory", "/tmp/backup"))
     elif repl_cfg.get_bool("sink.s3.enabled"):
-        sink = GatedSink("s3")
+        s = repl_cfg.sub("sink.s3")
+        sink = S3Sink(
+            s.get("endpoint", "localhost:8333"),
+            s.get("bucket", "backup"),
+            access_key=s.get("aws_access_key_id", ""),
+            secret_key=s.get("aws_secret_access_key", ""),
+            directory=s.get("directory", ""),
+            region=s.get("region", "us-east-1"),
+        )
     elif repl_cfg.get_bool("sink.gcs.enabled"):
         sink = GatedSink("gcs")
     elif repl_cfg.get_bool("sink.azure.enabled"):

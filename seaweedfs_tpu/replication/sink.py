@@ -2,7 +2,7 @@
 
 Behavioral match of weed/replication/sink/replication_sink.go (the
 ReplicationSink interface: CreateEntry / UpdateEntry / DeleteEntry /
-GetSinkToDirectory) with two concrete sinks:
+GetSinkToDirectory) with three concrete sinks:
 
 * FilerSink — writes into a destination filer over gRPC, re-uploading
   every chunk through the destination cluster's AssignVolume + volume
@@ -10,8 +10,10 @@ GetSinkToDirectory) with two concrete sinks:
   cluster-local, so bytes always re-upload; the new chunk records the
   source fid for dedup-aware updates.
 * LocalSink — materializes entries as plain files under a local
-  directory; the stand-in for the cloud object-store sinks (s3sink,
-  gcssink, azuresink, b2sink) whose SDKs are not in this image.
+  directory.
+* S3Sink — writes whole objects into any S3-compatible endpoint via
+  the in-repo SigV4 client (sink/s3sink/s3_sink.go, minus the aws-sdk:
+  gcs/azure/b2 remain gated since their SDKs are not in this image).
 """
 
 from __future__ import annotations
@@ -206,12 +208,82 @@ class LocalSink(ReplicationSink):
             os.remove(path)
 
 
+class S3Sink(ReplicationSink):
+    """Replicate into an S3-compatible bucket (sink/s3sink/s3_sink.go):
+    each file entry becomes one object (chunks fetched from the source
+    cluster and assembled), directories are implicit in the keys. Works
+    against any SigV4 endpoint including this repo's own gateway."""
+
+    name = "s3"
+
+    def __init__(
+        self,
+        endpoint: str,
+        bucket: str,
+        access_key: str = "",
+        secret_key: str = "",
+        directory: str = "",
+        region: str = "us-east-1",
+    ):
+        from seaweedfs_tpu.s3api.client import S3Client
+
+        self.client = S3Client(endpoint, access_key, secret_key, region=region)
+        self.bucket = bucket
+        self.dir = directory.strip("/")
+        self.source: FilerSource | None = None
+
+    def get_sink_to_directory(self) -> str:
+        return ""
+
+    def _key(self, key: str) -> str:
+        k = key.lstrip("/")
+        return f"{self.dir}/{k}" if self.dir else k
+
+    def _assemble(self, entry: fpb.Entry) -> bytes:
+        """Assemble the file through the visible-interval algebra
+        (mtime-resolved overlaps, size-clamped views) — NOT a raw
+        offset sort, which would resurrect overwritten bytes and let
+        truncated entries grow back past their EOF."""
+        from seaweedfs_tpu.filer import filechunks
+
+        size = entry.attributes.file_size or sum(c.size for c in entry.chunks)
+        buf = bytearray(size)
+        for view in filechunks.view_from_chunks(list(entry.chunks), 0, size):
+            data = self.source.read_chunk(view.fid)
+            piece = data[view.offset : view.offset + view.size]
+            buf[view.logic_offset : view.logic_offset + len(piece)] = piece
+        return bytes(buf)
+
+    def create_entry(self, key: str, entry: fpb.Entry) -> None:
+        if entry.is_directory:
+            return  # object stores have no directories
+        self.client.put_object(self.bucket, self._key(key), self._assemble(entry))
+
+    def update_entry(
+        self, key, old_entry, new_parent_path, new_entry, delete_chunks
+    ) -> bool:
+        self.create_entry(key, new_entry)
+        return True  # puts are idempotent upserts in an object store
+
+    def delete_entry(self, key: str, is_directory: bool, delete_chunks: bool) -> None:
+        if is_directory:
+            # a recursive source delete emits ONE event for the top
+            # directory; sweep the whole replicated prefix or every
+            # object under it is orphaned in the bucket forever
+            prefix = self._key(key).rstrip("/") + "/"
+            for obj_key in self.client.list_objects(self.bucket, prefix):
+                self.client.delete_object(self.bucket, obj_key)
+            return
+        self.client.delete_object(self.bucket, self._key(key))
+
+
 class GatedSink(ReplicationSink):
-    """Placeholder for the cloud sinks (s3, gcs, azure, backblaze)
-    whose SDKs are absent here; constructing one raises with guidance."""
+    """Placeholder for the remaining cloud sinks (gcs, azure,
+    backblaze) whose SDKs are absent here; constructing one raises
+    with guidance."""
 
     def __init__(self, kind: str):
         raise RuntimeError(
             f"replication sink {kind!r} needs a cloud SDK not present in "
-            "this environment; use [sink.filer] or [sink.local]"
+            "this environment; use [sink.filer], [sink.local], or [sink.s3]"
         )

@@ -66,8 +66,13 @@ class S3Client:
         data=None,  # bytes or file-like (file-like => unsigned payload)
         extra_headers: dict | None = None,
         payload_hash: str | None = None,
+        query: dict | None = None,
     ):
         path = "/" + bucket + ("/" + key.lstrip("/") if key else "")
+        query_string = "&".join(
+            f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+            for k, v in sorted((query or {}).items())
+        )
         now = datetime.datetime.now(datetime.timezone.utc)
         amz_date = now.strftime("%Y%m%dT%H%M%SZ")
         date = now.strftime("%Y%m%d")
@@ -92,7 +97,7 @@ class S3Client:
             [
                 method,
                 urllib.parse.quote(path),
-                "",  # no query
+                query_string,
                 canonical_headers,
                 ";".join(signed),
                 payload_hash,
@@ -116,11 +121,10 @@ class S3Client:
             f"SignedHeaders={';'.join(signed)}, Signature={signature}"
         )
 
-        req = urllib.request.Request(
-            f"http://{self.endpoint}{urllib.parse.quote(path)}",
-            data=data,
-            method=method,
-        )
+        url = f"http://{self.endpoint}{urllib.parse.quote(path)}"
+        if query_string:
+            url += "?" + query_string
+        req = urllib.request.Request(url, data=data, method=method)
         for k, v in headers.items():
             if k != "host":
                 req.add_header(k, v)
@@ -180,6 +184,22 @@ class S3Client:
                         pct = 100.0 * done / total if total else 0.0
                         progress(done, pct)
         return done
+
+    def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
+        """Object keys under a prefix (ListObjects V1 XML)."""
+        import xml.etree.ElementTree as ET
+
+        query = {"prefix": prefix} if prefix else None
+        with self._request("GET", bucket, "", query=query) as r:
+            tree = ET.fromstring(r.read())
+        ns = ""
+        if tree.tag.startswith("{"):
+            ns = tree.tag.split("}")[0] + "}"
+        return [
+            c.findtext(f"{ns}Key")
+            for c in tree.findall(f"{ns}Contents")
+            if c.findtext(f"{ns}Key")
+        ]
 
     def head_object(self, bucket: str, key: str) -> dict:
         with self._request("HEAD", bucket, key) as r:
