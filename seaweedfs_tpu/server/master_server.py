@@ -75,6 +75,8 @@ class MasterServer:
         peers: str | list | None = None,
         raft_dir: str | None = None,
         vacuum_interval: float = 15 * 60.0,
+        metrics_address: str = "",
+        metrics_interval_sec: int = 15,
     ):
         self.host = host
         self.port = port
@@ -126,6 +128,10 @@ class MasterServer:
         # (master_server.go:126 StartRefreshWritableVolumes); 0 disables
         self.vacuum_interval = vacuum_interval
         self._stop_event = threading.Event()
+        # pushed down to volume servers in HeartbeatResponse
+        # (master_grpc_server.go:80-84)
+        self.metrics_address = metrics_address
+        self.metrics_interval_sec = metrics_interval_sec
         self._clients: dict[int, queue.Queue] = {}
         self._clients_seq = 0
         self._clients_lock = threading.Lock()
@@ -239,6 +245,8 @@ class MasterServer:
                 yield pb.HeartbeatResponse(
                     volume_size_limit=self.topology.volume_size_limit,
                     leader=self.leader_address(),
+                    metrics_address=self.metrics_address,
+                    metrics_interval_seconds=self.metrics_interval_sec,
                 )
         finally:
             if dn is not None and getattr(dn, "stream_token", None) is stream_token:
@@ -532,6 +540,14 @@ class MasterServer:
             def log_message(self, *args):  # quiet
                 pass
 
+            def _html(self, body: str, status=200):
+                data = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def _json(self, obj, status=200):
                 body = json.dumps(obj).encode()
                 self.send_response(status)
@@ -548,6 +564,8 @@ class MasterServer:
                     return self._assign(q)
                 if url.path == "/dir/lookup":
                     return self._lookup(q)
+                if url.path in ("/", "/ui/index.html"):
+                    return self._html(server._render_master_ui())
                 if url.path == "/cluster/status":
                     return self._json(
                         {
@@ -658,6 +676,40 @@ class MasterServer:
             "count": resp.count,
             **({"auth": resp.auth} if resp.auth else {}),
         }
+
+
+    # ------------------------------------------------------------------
+    # status UI (server/master_ui/templates.go role)
+    def _render_master_ui(self) -> str:
+        import html as _html
+
+        rows = []
+        for dn in self.topology.data_nodes():
+            rack = dn.parent.id if dn.parent is not None else ""
+            dc = (
+                dn.parent.parent.id
+                if dn.parent is not None and dn.parent.parent is not None
+                else ""
+            )
+            rows.append(
+                f"<tr><td>{_html.escape(dc)}</td><td>{_html.escape(rack)}</td>"
+                f"<td><a href='http://{_html.escape(dn.public_url)}/ui/index.html'>"
+                f"{_html.escape(dn.url)}</a></td>"
+                f"<td>{len(dn.volumes)}</td><td>{dn.max_volume_count()}</td>"
+                f"<td>{len(dn.ec_shards)}</td></tr>"
+            )
+        role = "leader" if self.is_leader else "follower"
+        from seaweedfs_tpu.util.status_ui import status_page
+
+        return status_page(
+            "SeaweedFS-TPU Master",
+            f"Master {self.host}:{self.port}",
+            f"role: <b>{role}</b> &middot; leader: {self.leader_address()}"
+            f" &middot; max volume id: {self.topology.id_gen.peek()}",
+            ["DataCenter", "Rack", "Node", "Volumes", "Max", "EC shards"],
+            "".join(rows),
+            ["/dir/status", "/cluster/status", "/metrics"],
+        )
 
     # ------------------------------------------------------------------
     # leader vacuum loop (topology_vacuum.go:16-160 via

@@ -126,10 +126,44 @@ class VolumeServer:
         self._grpc_server: grpc.Server | None = None
         self._http_server: ThreadingHTTPServer | None = None
         self._hb_thread: threading.Thread | None = None
+        self._metrics_push: threading.Thread | None = None
+        self._metrics_cfg: tuple | None = None
         # vid -> (expires, [urls]); keeps the master off the per-write
         # hot path (the reference's wdclient vidMap role)
         self._location_cache: dict[int, tuple[float, list[str]]] = {}
         self._location_cache_ttl = 10.0
+
+    # ------------------------------------------------------------------
+    # status UI (server/volume_server_ui/templates.go role)
+    def _render_ui(self) -> str:
+        import html as _html
+
+        rows = []
+        for loc in self.store.locations:
+            for vid, v in sorted(loc.volumes.items()):
+                rows.append(
+                    f"<tr><td>{vid}</td><td>{_html.escape(v.collection)}</td>"
+                    f"<td>{v.data_file_size()}</td><td>{v.file_count()}</td>"
+                    f"<td>{v.deleted_count()}</td>"
+                    f"<td>{'ro' if v.read_only else 'rw'}</td></tr>"
+                )
+            for vid, ev in sorted(loc.ec_volumes.items()):
+                shards = ",".join(str(s) for s in ev.shard_ids())
+                rows.append(
+                    f"<tr><td>{vid}</td><td>{_html.escape(ev.collection)}</td>"
+                    f"<td colspan=3>EC shards: {shards}</td><td>ec</td></tr>"
+                )
+        from seaweedfs_tpu.util.status_ui import status_page
+
+        return status_page(
+            "SeaweedFS-TPU Volume",
+            f"Volume Server {self.host}:{self.port}",
+            f"master: {_html.escape(self.master or '(none)')} &middot; "
+            f"ec codec: {self.ec_codec or 'auto'}",
+            ["Id", "Collection", "Size", "Files", "Deleted", "Mode"],
+            "".join(rows),
+            ["/status", "/metrics"],
+        )
 
     # ------------------------------------------------------------------
     # heartbeat client (volume_grpc_client_to_master.go)
@@ -215,6 +249,29 @@ class VolumeServer:
                     for resp in stub.Heartbeat(self._heartbeat_requests()):
                         if resp.volume_size_limit:
                             self.volume_size_limit = resp.volume_size_limit
+                        if resp.metrics_address:
+                            # master ships the pushgateway config in the
+                            # heartbeat response (master_grpc_server.go:80);
+                            # a NEW address/interval (e.g. from a new
+                            # leader) replaces the running loop
+                            cfg = (
+                                resp.metrics_address,
+                                resp.metrics_interval_seconds or 15,
+                            )
+                            if cfg != self._metrics_cfg:
+                                from seaweedfs_tpu.stats.metrics import (
+                                    start_push_loop,
+                                )
+
+                                if self._metrics_push is not None:
+                                    self._metrics_push.stop_event.set()
+                                self._metrics_cfg = cfg
+                                self._metrics_push = start_push_loop(
+                                    f"http://{cfg[0]}",
+                                    job=f"volume_{self.host}_{self.port}",
+                                    interval_sec=cfg[1],
+                                    stop_event=threading.Event(),
+                                )
                         if resp.leader and resp.leader != self.master:
                             # follow the leader hint: reconnect there
                             self.master = resp.leader
@@ -895,6 +952,12 @@ class VolumeServer:
                     return False
 
             def do_GET(self):
+                if urlparse(self.path).path in ("/", "/ui/index.html"):
+                    return self._reply(
+                        200,
+                        server._render_ui().encode(),
+                        {"Content-Type": "text/html; charset=utf-8"},
+                    )
                 if urlparse(self.path).path == "/status":
                     hb = server.store.collect_heartbeat()
                     return self._json(
@@ -1266,6 +1329,8 @@ class VolumeServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._metrics_push is not None:
+            self._metrics_push.stop_event.set()
         if self._http_server:
             self._http_server.shutdown()
             self._http_server.server_close()

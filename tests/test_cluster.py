@@ -613,3 +613,17 @@ class TestMultipartIntoDirectory:
             assert status == 200 and got == payload
         finally:
             filer.stop()
+
+
+class TestStatusUi:
+    def test_master_and_volume_html_pages(self, cluster):
+        master, volume_servers = cluster
+        status, body = http_get(master_url(master, "/"))
+        assert status == 200
+        text = body.decode()
+        assert "<html" in text and "Topology" in text
+        assert f"127.0.0.1:{volume_servers[0].port}" in text
+
+        status, body = http_get(f"http://127.0.0.1:{volume_servers[0].port}/ui/index.html")
+        assert status == 200
+        assert "Volume Server" in body.decode()

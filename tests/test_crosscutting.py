@@ -318,3 +318,68 @@ class TestNativeCrc:
         from seaweedfs_tpu.util.crc import crc32c
 
         assert crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+class TestMetricsPushPlumbing:
+    """The master ships pushgateway config in heartbeat responses and
+    the volume server starts pushing (master_grpc_server.go:80-84 +
+    LoopPushingMetric)."""
+
+    def test_volume_server_pushes_after_heartbeat_hint(self, tmp_path_factory):
+        import socket
+        import threading
+        import time as _time
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from seaweedfs_tpu.server.master_server import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        received = []
+
+        class Gateway(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                received.append((self.path, self.rfile.read(n)))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        gw_port = free_port()
+        gw = ThreadingHTTPServer(("127.0.0.1", gw_port), Gateway)
+        threading.Thread(target=gw.serve_forever, daemon=True).start()
+
+        master = MasterServer(
+            port=free_port(),
+            volume_size_limit_mb=64,
+            metrics_address=f"127.0.0.1:{gw_port}",
+            metrics_interval_sec=1,
+        )
+        master.start()
+        vs = VolumeServer(
+            [str(tmp_path_factory.mktemp("metricsvs"))],
+            port=free_port(),
+            master=f"127.0.0.1:{master.port}",
+            heartbeat_interval=0.2,
+        )
+        vs.start()
+        try:
+            deadline = _time.time() + 15
+            while _time.time() < deadline and not received:
+                _time.sleep(0.1)
+            assert received, "no metrics push arrived at the gateway"
+            path, body = received[0]
+            assert path.startswith("/metrics/job/volume_")
+            assert b"# TYPE" in body
+        finally:
+            vs.stop()
+            master.stop()
+            gw.shutdown()
+            gw.server_close()
