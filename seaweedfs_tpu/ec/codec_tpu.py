@@ -100,9 +100,12 @@ def apply_matrix_bits_batch(a_bits: jnp.ndarray, inputs: jnp.ndarray) -> jnp.nda
 
 # --- SWAR Horner Pallas kernel (fast path) ---------------------------------
 
-# Lanes (uint32s) per grid block. 16384 lanes = 64 KiB of stream per
-# input row; VMEM per block = (k + r) * tn * 4 B ≈ 0.9 MiB for RS(10,4).
-_SWAR_TN = 16384
+# Lanes (uint32s) per grid block. 32768 lanes = 128 KiB of stream per
+# input row; VMEM per block = (k + r) * tn * 4 B ≈ 1.8 MiB for RS(10,4).
+# Swept on a v5e chip: 4K→82, 8K→89, 16K→95, 32K→100, 64K→101 GB/s
+# sustained; 256K fails to compile (VMEM). 32K balances throughput
+# against VMEM headroom for pipelining.
+_SWAR_TN = 32768
 # Minimum stream bytes for the Pallas path; below this the matmul path
 # compiles faster and latency dominates anyway.
 _SWAR_MIN_BYTES = 64 * 1024
