@@ -171,3 +171,96 @@ class TestClusterTools:
         )
         out = capsys.readouterr().out
         assert "DataCenter" in out or "volume" in out.lower()
+
+
+class TestServerDaemon:
+    """Boot the all-in-one `server` command as a real subprocess and
+    drive it over HTTP — the README quickstart, verified."""
+
+    def test_all_in_one_smoke(self, tmp_path):
+        import json as _json
+        import os
+        import signal
+        import socket
+        import subprocess
+        import sys
+        import time
+        import urllib.request
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        mport, vport, fport = free_port(), free_port(), free_port()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["WEED_EC_CODEC"] = "cpu"
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                # sitecustomize may bake the axon platform in before the
+                # CLI runs; force cpu the way conftest does
+                "import jax; jax.config.update('jax_platforms', 'cpu');"
+                "from seaweedfs_tpu.__main__ import main; main()",
+                "server",
+                "-dir",
+                str(tmp_path),
+                "-master.port",
+                str(mport),
+                "-volume.port",
+                str(vport),
+                "-filer",
+                "-filer.port",
+                str(fport),
+            ],
+            env=env,
+            cwd="/root/repo",
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        try:
+            deadline = time.time() + 30
+            assign = None
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mport}/dir/assign", timeout=2
+                    ) as r:
+                        assign = _json.loads(r.read())
+                    if "fid" in assign:
+                        break
+                except OSError:
+                    time.sleep(0.2)
+            assert assign and "fid" in assign, f"daemon never served: {assign}"
+
+            blob = b"all-in-one daemon smoke"
+            req = urllib.request.Request(
+                f"http://{assign['url']}/{assign['fid']}",
+                data=blob,
+                method="POST",
+            )
+            urllib.request.urlopen(req, timeout=10).close()
+            with urllib.request.urlopen(
+                f"http://{assign['url']}/{assign['fid']}", timeout=10
+            ) as r:
+                assert r.read() == blob
+
+            # filer HTTP namespace up too
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{fport}/smoke/hello.txt",
+                data=b"via filer",
+                method="POST",
+            )
+            urllib.request.urlopen(req, timeout=10).close()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{fport}/smoke/hello.txt", timeout=10
+            ) as r:
+                assert r.read() == b"via filer"
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
