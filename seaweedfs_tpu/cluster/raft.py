@@ -54,13 +54,15 @@ class RaftNode:
         peers: list[str],
         apply_fn: Callable[[dict], None],
         data_dir: str | None = None,
-        election_timeout: tuple[float, float] = (0.15, 0.30),
-        heartbeat_interval: float = 0.05,
+        election_timeout: tuple[float, float] = (0.4, 0.8),
+        heartbeat_interval: float = 0.1,
     ):
         """self_addr/peers are master HTTP addresses ("host:port");
         the raft RPCs ride each master's gRPC port (+10000).
         apply_fn(command_dict) is invoked in log order on every node
-        as entries commit."""
+        as entries commit. Election timeouts are 4-8x the heartbeat
+        interval so GIL/CPU starvation in crowded test hosts does not
+        read as leader death and churn elections."""
         self.self_addr = self_addr
         self.peers = [p for p in peers if p != self_addr]
         self.apply_fn = apply_fn

@@ -72,7 +72,6 @@ class FilerServer:
     ):
         self.masters = masters
         self._master_idx = 0  # rotates on failure (HA master failover)
-        self._live_master_cache: tuple[str, float] | None = None
         self.host = host
         self.port = port
         self.grpc_port = port + 10000
@@ -95,29 +94,30 @@ class FilerServer:
         self._master_idx = idx
         return out
 
-    def _live_master(self) -> str:
-        """A master that currently answers (Statistics probe), for the
-        read path's chunk lookups. The probe result is cached briefly
-        so steady-state reads don't pay an extra RPC each."""
-        import time as _time
+    def _read_master(self, entry) -> str:
+        """A master that can actually resolve this entry's chunks.
 
-        cached = self._live_master_cache
-        if cached is not None and cached[1] > _time.monotonic():
-            return cached[0]
-
-        from seaweedfs_tpu.pb import master_pb2
-        from seaweedfs_tpu.pb.rpc import grpc_address
+        Probes with a real LookupVolume of the first chunk's vid, so a
+        follower with a stale leader pointer (which aborts UNAVAILABLE)
+        rotates away BEFORE the 200 header goes out — a mid-stream
+        lookup failure can only truncate the response. Success results
+        are cached by op.lookup, so the steady-state cost is nil."""
+        chunks = list(entry.chunks)
+        if not chunks:
+            return self.masters[self._master_idx % len(self.masters)]
+        vid = chunks[0].fid.split(",")[0]
 
         def probe(m):
-            with rpc.dial(grpc_address(m)) as ch:
-                rpc.master_stub(ch).Statistics(
-                    master_pb2.StatisticsRequest(), timeout=3
+            res = op.lookup(m, vid)
+            if res.error or not res.locations:
+                # in-band leader answer ("volume not found"): do NOT
+                # rotate — every master proxies to the same leader
+                raise RuntimeError(
+                    f"lookup {vid} via {m}: {res.error or 'no locations'}"
                 )
             return m
 
-        m = self._with_master(probe)
-        self._live_master_cache = (m, _time.monotonic() + 5.0)
-        return m
+        return self._with_master(probe)
 
     # ------------------------------------------------------------------
     # write path helpers
@@ -370,6 +370,13 @@ class FilerServer:
                     start, end = span
                     status, offset, length = 206, start, end - start + 1
                     headers["Content-Range"] = f"bytes {start}-{end}/{total}"
+                # resolve a master that can serve the chunks BEFORE the
+                # status line goes out: a probe failure here is a clean
+                # 503, not a 200 with a truncated body
+                try:
+                    read_master = server._read_master(entry)
+                except (RuntimeError, OSError, grpc.RpcError) as e:
+                    return self._json({"error": str(e)}, 503)
                 self.send_response(status)
                 for k, v in headers.items():
                     if v:
@@ -382,7 +389,7 @@ class FilerServer:
                 written = 0
                 try:
                     for piece in stream.stream_content(
-                        server._live_master(), entry.chunks, offset, length
+                        read_master, entry.chunks, offset, length
                     ):
                         self.wfile.write(piece)
                         written += len(piece)

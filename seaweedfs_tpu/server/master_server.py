@@ -307,28 +307,51 @@ class MasterServer:
             auth=result.get("auth", ""),
         )
 
-    def _proxy_to_leader_stub(self):
+    def _proxy_to_leader_stub(self, wait: float = 3.0):
         """Stub on the leader, or None when this master IS the leader
-        or no leader is known (master_server.go:151 proxyToLeader:
-        followers hold no topology — volume servers heartbeat only the
-        leader — so reads must be answered there)."""
-        leader = self.leader_address()
-        if leader == f"{self.host}:{self.port}":
+        (master_server.go:151 proxyToLeader: followers hold no
+        topology — volume servers heartbeat only the leader — so reads
+        must be answered there). Waits out brief leaderless election
+        windows instead of failing instantly."""
+        deadline = time.time() + wait
+        while True:
+            leader = self.leader_address()
+            known = self._raft is None or self._raft.leader()
+            if leader == f"{self.host}:{self.port}" and known:
+                return None  # we are the leader
+            if leader != f"{self.host}:{self.port}" and known:
+                ch = grpc.insecure_channel(rpc.grpc_address(leader))
+                return ch, rpc.master_stub(ch)
+            if time.time() >= deadline:
+                return "unknown"
+            time.sleep(0.05)
+
+    def _proxy_or_abort(self, context, verb: str, req, timeout: float):
+        """Follower-side leader proxy for read verbs: returns the
+        leader's response, None when THIS master is the leader (caller
+        answers locally), or aborts UNAVAILABLE — an empty local
+        answer from a follower would poison clients silently."""
+        proxied = self._proxy_to_leader_stub()
+        if proxied == "unknown":
+            context.abort(grpc.StatusCode.UNAVAILABLE, "no leader elected yet")
+        if proxied is None:
             return None
-        ch = grpc.insecure_channel(rpc.grpc_address(leader))
-        return ch, rpc.master_stub(ch)
+        ch, stub = proxied
+        try:
+            return getattr(stub, verb)(req, timeout=timeout)
+        except grpc.RpcError:
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                "leader unreachable from this follower",
+            )
+        finally:
+            ch.close()
 
     def LookupVolume(self, req: pb.LookupVolumeRequest, context) -> pb.LookupVolumeResponse:
         if not self.is_leader:
-            proxied = self._proxy_to_leader_stub()
-            if proxied is not None:
-                ch, stub = proxied
-                try:
-                    return stub.LookupVolume(req, timeout=10)
-                except grpc.RpcError:
-                    pass  # fall through to the (likely empty) local view
-                finally:
-                    ch.close()
+            resp = self._proxy_or_abort(context, "LookupVolume", req, 10)
+            if resp is not None:
+                return resp
         out = pb.LookupVolumeResponse()
         for vid_str in req.vids:
             entry = out.vid_locations.add(vid=vid_str)
@@ -347,15 +370,9 @@ class MasterServer:
 
     def LookupEcVolume(self, req: pb.LookupEcVolumeRequest, context) -> pb.LookupEcVolumeResponse:
         if not self.is_leader:
-            proxied = self._proxy_to_leader_stub()
-            if proxied is not None:
-                ch, stub = proxied
-                try:
-                    return stub.LookupEcVolume(req, timeout=10)
-                except grpc.RpcError:
-                    pass
-                finally:
-                    ch.close()
+            resp = self._proxy_or_abort(context, "LookupEcVolume", req, 10)
+            if resp is not None:
+                return resp
         out = pb.LookupEcVolumeResponse(volume_id=req.volume_id)
         locs = self.topology.lookup_ec_shards(req.volume_id)
         if locs is None:
@@ -370,15 +387,9 @@ class MasterServer:
 
     def Statistics(self, req: pb.StatisticsRequest, context) -> pb.StatisticsResponse:
         if not self.is_leader:
-            proxied = self._proxy_to_leader_stub()
-            if proxied is not None:
-                ch, stub = proxied
-                try:
-                    return stub.Statistics(req, timeout=10)
-                except grpc.RpcError:
-                    pass
-                finally:
-                    ch.close()
+            resp = self._proxy_or_abort(context, "Statistics", req, 10)
+            if resp is not None:
+                return resp
         total = used = files = 0
         for dn in self.topology.data_nodes():
             for v in dn.volumes.values():
@@ -394,15 +405,9 @@ class MasterServer:
 
     def CollectionDelete(self, req: pb.CollectionDeleteRequest, context):
         if not self.is_leader:
-            proxied = self._proxy_to_leader_stub()
-            if proxied is not None:
-                ch, stub = proxied
-                try:
-                    return stub.CollectionDelete(req, timeout=30)
-                except grpc.RpcError:
-                    pass
-                finally:
-                    ch.close()
+            resp = self._proxy_or_abort(context, "CollectionDelete", req, 30)
+            if resp is not None:
+                return resp
         for dn in self.topology.data_nodes():
             try:
                 with rpc.dial(self._node_grpc(dn)) as ch:

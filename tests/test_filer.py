@@ -509,3 +509,67 @@ class TestSqliteTransactions:
         store.insert_entry(Entry(full_path="/tx", attr=Attr(mtime=1)))
         store.commit_transaction()
         assert store.find_entry("/tx").full_path == "/tx"
+
+
+def test_empty_file_get_does_not_crash(tmp_path_factory):
+    """A chunkless entry (zero-byte POST) must GET cleanly — the read
+    path's master probe has no chunks to probe with."""
+    import socket
+    import time
+    import urllib.request
+
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    master = MasterServer(port=free_port(), volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer(
+        [str(tmp_path_factory.mktemp("emptyvs"))],
+        port=free_port(),
+        master=f"127.0.0.1:{master.port}",
+        heartbeat_interval=0.2,
+    )
+    vs.start()
+    filer = None
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and len(master.topology.data_nodes()) < 1:
+            time.sleep(0.05)
+        filer = FilerServer(
+            [f"127.0.0.1:{master.port}"], port=free_port(), store="memory"
+        )
+        filer.start()
+        # an entry with content works; then create a zero-byte file via
+        # gRPC CreateEntry (the HTTP empty-POST maps to mkdir)
+        import grpc
+
+        from seaweedfs_tpu.pb import filer_pb2 as fpb
+        from seaweedfs_tpu.pb import rpc as _rpc
+
+        with grpc.insecure_channel(f"127.0.0.1:{filer.port + 10000}") as ch:
+            _rpc.filer_stub(ch).CreateEntry(
+                fpb.CreateEntryRequest(
+                    directory="/",
+                    entry=fpb.Entry(
+                        name="empty.txt",
+                        is_directory=False,
+                        attributes=fpb.Attributes(file_mode=0o644),
+                    ),
+                )
+            )
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{filer.port}/empty.txt", timeout=10
+        ) as r:
+            assert r.status == 200
+            assert r.read() == b""
+    finally:
+        if filer:
+            filer.stop()
+        vs.stop()
+        master.stop()
