@@ -741,47 +741,26 @@ class MasterServer:
                         ratios.append(resp.garbage_ratio)
                     if not ratios or min(ratios) < self.garbage_threshold:
                         continue
-                    # fence writes for the whole compact→commit span: a
-                    # write landing between the snapshot and the swap
-                    # would be silently lost (the reference instead
-                    # replays makeupDiff, volume_vacuum.go:78-133; our
-                    # compact holds the volume lock, so the only unsafe
-                    # window is BETWEEN the two RPCs)
+                    # no write fence needed: each replica's compact
+                    # snapshots without blocking writes and its commit
+                    # replays the catch-up diff under the volume lock
+                    # (volume_vacuum.go:78-133 Compact2 + makeupDiff)
                     for node in locations:
                         with rpc.dial(self._node_grpc(node)) as ch:
-                            rpc.volume_stub(ch).VolumeMarkReadonly(
-                                volume_pb2.VolumeMarkReadonlyRequest(volume_id=vid),
-                                timeout=30,
+                            rpc.volume_stub(ch).VacuumVolumeCompact(
+                                volume_pb2.VacuumVolumeCompactRequest(
+                                    volume_id=vid
+                                ),
+                                timeout=600,
                             )
-                    try:
-                        for node in locations:
-                            with rpc.dial(self._node_grpc(node)) as ch:
-                                rpc.volume_stub(ch).VacuumVolumeCompact(
-                                    volume_pb2.VacuumVolumeCompactRequest(
-                                        volume_id=vid
-                                    ),
-                                    timeout=600,
-                                )
-                        for node in locations:
-                            with rpc.dial(self._node_grpc(node)) as ch:
-                                rpc.volume_stub(ch).VacuumVolumeCommit(
-                                    volume_pb2.VacuumVolumeCommitRequest(
-                                        volume_id=vid
-                                    ),
-                                    timeout=600,
-                                )
-                    finally:
-                        for node in locations:
-                            try:
-                                with rpc.dial(self._node_grpc(node)) as ch:
-                                    rpc.volume_stub(ch).VolumeMarkWritable(
-                                        volume_pb2.VolumeMarkWritableRequest(
-                                            volume_id=vid
-                                        ),
-                                        timeout=30,
-                                    )
-                            except grpc.RpcError:
-                                pass
+                    for node in locations:
+                        with rpc.dial(self._node_grpc(node)) as ch:
+                            rpc.volume_stub(ch).VacuumVolumeCommit(
+                                volume_pb2.VacuumVolumeCommitRequest(
+                                    volume_id=vid
+                                ),
+                                timeout=600,
+                            )
                     compacted += 1
                 except grpc.RpcError:
                     # phase 4: abandon scratch files on the replicas

@@ -456,61 +456,132 @@ class Volume:
 
     # --- vacuum (volume_vacuum.go) ---
     def compact(self) -> None:
-        """Copy live needles to .cpd/.cpx scratch files.
-
-        The reference's Compact runs concurrently with writes and
-        replays a catch-up diff on commit (makeupDiff); here compaction
-        holds the volume lock, which is the same observable result with
-        simpler invariants (single-writer volumes, SURVEY §5 race notes).
-        """
+        """Copy live needles to .cpd/.cpx scratch files WITHOUT blocking
+        writes (volume_vacuum.go:78-133 Compact2 shape): the lock is
+        held only to snapshot the needle map and the current .dat size.
+        The .dat is append-only, so every record below the snapshot
+        offset is immutable and can be copied lock-free; anything
+        appended afterwards (new needles, tombstones, overwrites) is
+        replayed by the catch-up diff inside commit_compact
+        (makeupDiff, volume_vacuum.go:157)."""
         with self._lock:
-            cpd = self.base_name + ".cpd"
-            cpx = self.base_name + ".cpx"
-            new_sb = SuperBlock(
-                version=self.super_block.version,
-                replica_placement=self.super_block.replica_placement,
-                ttl=self.super_block.ttl,
-                compaction_revision=self.super_block.compaction_revision + 1,
-                extra=self.super_block.extra,
+            snapshot = list(self.nm.items())
+            self._dat.flush()
+            self._compact_snapshot_size = self.data_file_size()
+            self._compact_snapshot_idx = self.nm.index_file_size()
+            sb = self.super_block
+        cpd = self.base_name + ".cpd"
+        cpx = self.base_name + ".cpx"
+        new_sb = SuperBlock(
+            version=sb.version,
+            replica_placement=sb.replica_placement,
+            ttl=sb.ttl,
+            compaction_revision=sb.compaction_revision + 1,
+            extra=sb.extra,
+        )
+        snapshot_size = self._compact_snapshot_size
+        # the copy runs WITHOUT the volume lock, so it must not touch
+        # self._dat: concurrent (locked) writers and readers seek that
+        # shared handle, and interleaved seeks would corrupt either the
+        # copy or the live file — use a private read-only fd instead
+        # (records below the snapshot offset are immutable, append-only)
+        with open(cpd, "wb") as dat_out, open(cpx, "wb") as idx_out, open(
+            self.base_name + ".dat", "rb"
+        ) as dat_in:
+            dat_out.write(new_sb.to_bytes())
+            from seaweedfs_tpu.storage import idx as idx_codec
+
+            for nv in sorted(snapshot, key=lambda v: v.key):
+                if nv.offset == 0 or nv.size == t.TOMBSTONE_FILE_SIZE:
+                    continue
+                if nv.actual_offset >= snapshot_size:
+                    continue  # appended post-snapshot; the diff replays it
+                dat_in.seek(nv.actual_offset)
+                blob = dat_in.read(get_actual_size(nv.size, self.version))
+                new_offset = dat_out.tell()
+                dat_out.write(blob)
+                idx_out.write(
+                    idx_codec.pack_entry(
+                        nv.key, t.offset_to_units(new_offset), nv.size
+                    )
+                )
+
+    def _makeup_diff(self, cpd_path: str, cpx_path: str) -> None:
+        """Replay .idx entries appended since the compact snapshot onto
+        the scratch files (makeupDiff, volume_vacuum.go:157 — which
+        walks the idx tail: the idx distinguishes tombstones from
+        legitimate zero-byte needles, where raw .dat records cannot).
+        Runs under the volume lock inside commit_compact."""
+        from seaweedfs_tpu.storage import idx as idx_codec
+
+        idx_start = getattr(self, "_compact_snapshot_idx", None)
+        if idx_start is None:
+            # .cpd/.cpx exist but the snapshot boundary is gone (e.g.
+            # process restarted between compact and commit): committing
+            # would silently drop every post-snapshot write
+            raise RuntimeError(
+                "compaction scratch files are stale (no snapshot in this "
+                "process); run compact again or cleanup_compact"
             )
-            with open(cpd, "wb") as dat_out, open(cpx, "wb") as idx_out:
-                dat_out.write(new_sb.to_bytes())
-                from seaweedfs_tpu.storage import idx as idx_codec
-
-                def visit(nv: NeedleValue) -> None:
-                    if nv.offset == 0 or nv.size == t.TOMBSTONE_FILE_SIZE:
-                        return
-                    blob = self._read_at(
-                        nv.actual_offset, get_actual_size(nv.size, self.version)
-                    )
-                    new_offset = dat_out.tell()
-                    dat_out.write(blob)
+        idx_path = self.base_name + ".idx"
+        with open(idx_path, "rb") as f:
+            f.seek(idx_start)
+            tail = f.read()
+        with open(cpd_path, "r+b") as dat_out, open(cpx_path, "ab") as idx_out:
+            dat_out.seek(0, os.SEEK_END)
+            for key, offset_units, size in idx_codec.iter_entries(tail):
+                if offset_units == 0 or size == t.TOMBSTONE_FILE_SIZE:
+                    # append a tombstone RECORD too: the new .dat must
+                    # agree with its .idx, or a .dat-scan rebuild
+                    # (weed fix / export role) resurrects the needle
+                    # (the reference appends a fake delete needle here)
+                    tomb = Needle(cookie=0, id=key, data=b"")
+                    tomb.append_at_ns = self._now_ns()
+                    dat_out.write(tomb.to_bytes(self.version))
                     idx_out.write(
-                        idx_codec.pack_entry(
-                            nv.key, t.offset_to_units(new_offset), nv.size
-                        )
+                        idx_codec.pack_entry(key, 0, t.TOMBSTONE_FILE_SIZE)
                     )
-
-                self.nm.ascending_visit(visit)
+                    continue
+                blob = self._read_at(
+                    t.units_to_offset(offset_units),
+                    get_actual_size(size, self.version),
+                )
+                new_offset = dat_out.tell()
+                dat_out.write(blob)
+                idx_out.write(
+                    idx_codec.pack_entry(
+                        key, t.offset_to_units(new_offset), size
+                    )
+                )
+        self._compact_snapshot_idx = None
+        self._compact_snapshot_size = None
 
     def commit_compact(self) -> None:
-        """Swap .cpd/.cpx in as the live files (volume_vacuum.go:157)."""
+        """Replay the catch-up diff, then swap .cpd/.cpx in as the live
+        files (volume_vacuum.go:157 makeupDiff + commit)."""
         with self._lock:
             cpd = self.base_name + ".cpd"
             cpx = self.base_name + ".cpx"
             if not (os.path.exists(cpd) and os.path.exists(cpx)):
                 raise FileNotFoundError("no compaction scratch files to commit")
+            self._makeup_diff(cpd, cpx)
             self._dat.close()
             self.nm.close()
             os.replace(cpd, self.base_name + ".dat")
             os.replace(cpx, self.base_name + ".idx")
             self._dat = open(self.base_name + ".dat", "r+b")
             self.super_block = SuperBlock.read_from(self._dat)
-            # rebuild the map from the fresh index (a db map rebuilds
-            # its table since the .idx shrank below its watermark)
+            # rebuild the map from the fresh index; a db map's stale
+            # sqlite table must go too — the watermark can't detect a
+            # same-size .cpx whose offsets all moved
+            sdb = self.base_name + ".idx.sdb"
+            if os.path.exists(sdb):
+                os.remove(sdb)
             self.nm = self._load_needle_map()
 
     def cleanup_compact(self) -> None:
+        self._compact_snapshot_idx = None
+        self._compact_snapshot_size = None
         for ext in (".cpd", ".cpx"):
             path = self.base_name + ext
             if os.path.exists(path):

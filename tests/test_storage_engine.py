@@ -356,3 +356,102 @@ class TestEcVolume:
         assert len(hb.ec_shards) == 1
         assert hb.ec_shards[0].ec_index_bits == (1 << 14) - 1
         store.close()
+
+
+class TestConcurrentVacuum:
+    """Compaction must not lose writes that land between compact() and
+    commit_compact() — the makeupDiff catch-up (volume_vacuum.go:78-157)."""
+
+    def test_writes_during_compaction_survive_commit(self, tmp_path):
+        from seaweedfs_tpu.storage.needle import Needle
+        from seaweedfs_tpu.storage.volume import Volume
+
+        v = Volume(str(tmp_path), 11)
+        for i in range(1, 6):
+            v.write_needle(Needle(cookie=i, id=i, data=f"pre {i}".encode() * 50))
+        v.delete_needle(Needle(cookie=2, id=2))  # garbage to reclaim
+
+        v.compact()
+        # writes landing AFTER the snapshot, BEFORE the commit:
+        v.write_needle(Needle(cookie=100, id=100, data=b"mid-compaction write"))
+        v.write_needle(Needle(cookie=3, id=3, data=b"overwritten!"))  # update
+        v.delete_needle(Needle(cookie=4, id=4))  # delete a compacted needle
+        v.commit_compact()
+        v.cleanup_compact()
+
+        assert bytes(v.read_needle(100, cookie=100).data) == b"mid-compaction write"
+        assert bytes(v.read_needle(3, cookie=3).data) == b"overwritten!"
+        assert bytes(v.read_needle(1, cookie=1).data) == b"pre 1" * 50
+        import pytest as _pytest
+
+        from seaweedfs_tpu.storage.volume import NeedleNotFound
+
+        with _pytest.raises(NeedleNotFound):
+            v.read_needle(2)
+        with _pytest.raises(NeedleNotFound):
+            v.read_needle(4)
+        v.close()
+
+        # reload from disk: the committed files are self-consistent
+        v2 = Volume(str(tmp_path), 11, create=False)
+        assert bytes(v2.read_needle(100, cookie=100).data) == b"mid-compaction write"
+        assert bytes(v2.read_needle(3, cookie=3).data) == b"overwritten!"
+        with _pytest.raises(NeedleNotFound):
+            v2.read_needle(4)
+        v2.close()
+
+    def test_compact_does_not_block_writes(self, tmp_path):
+        """compact() must run without the volume write lock held for
+        the duration of the copy (only the snapshot takes it)."""
+        import threading
+        import time as _time
+
+        from seaweedfs_tpu.storage.needle import Needle
+        from seaweedfs_tpu.storage.volume import Volume
+
+        v = Volume(str(tmp_path), 12)
+        for i in range(1, 200):
+            v.write_needle(Needle(cookie=i, id=i, data=b"z" * 2000))
+
+        write_done = threading.Event()
+        errors = []
+
+        def writer():
+            try:
+                v.write_needle(
+                    Needle(cookie=999, id=999, data=b"concurrent write")
+                )
+                write_done.set()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        # slow the copy enough to overlap: compact uses its own read
+        # fd (not _read_at), so pace it via the record-size helper it
+        # calls once per copied needle
+        from seaweedfs_tpu.storage import volume as volume_mod
+
+        orig = volume_mod.get_actual_size
+        started = threading.Event()
+
+        def slow_size(size, version):
+            started.set()
+            _time.sleep(0.002)
+            return orig(size, version)
+
+        volume_mod.get_actual_size = slow_size
+        try:
+            t = threading.Thread(target=v.compact)
+            t.start()
+            assert started.wait(5)
+            w = threading.Thread(target=writer)
+            w.start()
+            # the write must complete while the compaction copy runs
+            assert write_done.wait(5), "write blocked behind compact()"
+            t.join()
+        finally:
+            volume_mod.get_actual_size = orig
+        v.commit_compact()
+        v.cleanup_compact()
+        assert not errors
+        assert bytes(v.read_needle(999, cookie=999).data) == b"concurrent write"
+        v.close()
