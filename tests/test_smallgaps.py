@@ -525,3 +525,83 @@ class TestFileSequencer:
         s = FileSequencer(str(tmp_path / "seq2.txt"), batch=10)
         s.set_max(500)
         assert s.next_file_id(1) == 501
+
+
+class TestDbNeedleMapCluster:
+    """-index db under a live cluster: writes, reads, restart resume,
+    and vacuum (whose commit must invalidate the sqlite table)."""
+
+    def test_write_read_vacuum_restart(self, tmp_path_factory):
+        import grpc
+
+        from seaweedfs_tpu.client import operation as op
+        from seaweedfs_tpu.pb import rpc, volume_pb2
+        from seaweedfs_tpu.server.master_server import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+
+        data_dir = str(tmp_path_factory.mktemp("dbmapvs"))
+        master = MasterServer(port=free_port(), volume_size_limit_mb=64)
+        master.start()
+        vs = VolumeServer(
+            [data_dir],
+            port=free_port(),
+            master=f"127.0.0.1:{master.port}",
+            heartbeat_interval=0.2,
+            max_volume_counts=[100],
+            needle_map_kind="db",
+        )
+        vs.start()
+        vs2 = None
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and len(master.topology.data_nodes()) < 1:
+                time.sleep(0.05)
+
+            keep = op.assign(f"127.0.0.1:{master.port}", collection="dbm")
+            assert not op.upload(
+                f"{keep.url}/{keep.fid}", b"keeper " * 300, jwt=keep.auth
+            ).error
+            doomed = op.assign(f"127.0.0.1:{master.port}", collection="dbm")
+            assert not op.upload(
+                f"{doomed.url}/{doomed.fid}", b"x" * 30000, jwt=doomed.auth
+            ).error
+            op.delete(f"{doomed.url}/{doomed.fid}")
+
+            vid = int(keep.fid.split(",")[0])
+            # vacuum through the gRPC 4-phase (db map rebuilds on commit)
+            with grpc.insecure_channel(f"127.0.0.1:{vs.grpc_port}") as ch:
+                stub = rpc.volume_stub(ch)
+                for v in {int(keep.fid.split(",")[0]), int(doomed.fid.split(",")[0])}:
+                    stub.VacuumVolumeCompact(
+                        volume_pb2.VacuumVolumeCompactRequest(volume_id=v)
+                    )
+                    stub.VacuumVolumeCommit(
+                        volume_pb2.VacuumVolumeCommitRequest(volume_id=v)
+                    )
+                    stub.VacuumVolumeCleanup(
+                        volume_pb2.VacuumVolumeCleanupRequest(volume_id=v)
+                    )
+            data, _ = op.download(f"{vs.host}:{vs.port}/{keep.fid}")
+            assert data == b"keeper " * 300
+
+            # restart the volume server on the same directory: the db
+            # map resumes (or rebuilds) and serves the same bytes
+            vs.stop()
+            vs2 = VolumeServer(
+                [data_dir],
+                port=free_port(),
+                master=f"127.0.0.1:{master.port}",
+                heartbeat_interval=0.2,
+                max_volume_counts=[100],
+                needle_map_kind="db",
+            )
+            vs2.start()
+            data, _ = op.download(f"{vs2.host}:{vs2.port}/{keep.fid}")
+            assert data == b"keeper " * 300
+            import urllib.error
+
+            with pytest.raises(urllib.error.HTTPError):
+                op.download(f"{vs2.host}:{vs2.port}/{doomed.fid}")
+        finally:
+            (vs2 or vs).stop()
+            master.stop()
