@@ -193,15 +193,33 @@ class WebDavServer:
                     return self._send(
                         200, names.encode(), {"Content-Type": "text/plain"}
                     )
+                req = urllib.request.Request(
+                    f"http://{server.filer}{urllib.parse.quote(full)}",
+                    # HEAD passes through as HEAD: the filer answers it
+                    # from metadata with zero chunk IO, so size probes
+                    # on multi-GB files never read the body
+                    method=self.command,
+                )
+                rng = self.headers.get("Range")
+                if rng:
+                    # WebDAV clients (video players, resumable copies)
+                    # issue ranged GETs; the filer serves them natively
+                    req.add_header("Range", rng)
                 try:
-                    with urllib.request.urlopen(
-                        f"http://{server.filer}{urllib.parse.quote(full)}", timeout=60
-                    ) as r:
-                        data = r.read()
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        data = b"" if self.command == "HEAD" else r.read()
                         mime = r.headers.get("Content-Type", "application/octet-stream")
+                        headers = {"Content-Type": mime, "Accept-Ranges": "bytes"}
+                        if r.status == 206:
+                            headers["Content-Range"] = r.headers.get("Content-Range", "")
+                        return self._send(r.status, data, headers)
                 except urllib.error.HTTPError as e:
-                    return self._send(e.code)
-                self._send(200, data, {"Content-Type": mime})
+                    hdrs = {}
+                    if e.code == 416 and e.headers.get("Content-Range"):
+                        # the unsatisfiable-range reply must carry the
+                        # real size or resumable clients cannot recover
+                        hdrs["Content-Range"] = e.headers["Content-Range"]
+                    return self._send(e.code, b"", hdrs)
 
             do_HEAD = do_GET
 

@@ -567,7 +567,6 @@ class TestDbNeedleMapCluster:
             ).error
             op.delete(f"{doomed.url}/{doomed.fid}")
 
-            vid = int(keep.fid.split(",")[0])
             # vacuum through the gRPC 4-phase (db map rebuilds on commit)
             with grpc.insecure_channel(f"127.0.0.1:{vs.grpc_port}") as ch:
                 stub = rpc.volume_stub(ch)

@@ -158,3 +158,16 @@ class TestWebDav:
         assert "opaquelocktoken" in headers["Lock-Token"]
         status, _, _ = dav_req(dav, "UNLOCK", "/locked.txt")
         assert status == 204
+
+
+def test_ranged_get(dav):
+    """WebDAV forwards Range to the filer (video seeks, resumable copies)."""
+    payload = bytes(range(256)) * 8
+    status, _, _ = dav_req(dav, "PUT", "/r.bin", body=payload)
+    assert status in (200, 201)
+    status, data, headers = dav_req(
+        dav, "GET", "/r.bin", headers={"Range": "bytes=100-199"}
+    )
+    assert status == 206
+    assert data == payload[100:200]
+    assert headers["Content-Range"] == f"bytes 100-199/{len(payload)}"
