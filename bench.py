@@ -92,6 +92,29 @@ def bench_encode() -> None:
     data = gen(jax.random.PRNGKey(0))
     data.block_until_ready()
 
+    # integrity gate: the timed kernel must be byte-identical to the
+    # CPU reference on a sample before its number means anything
+    import numpy as np
+
+    from seaweedfs_tpu.ec.codec import new_encoder
+
+    sample_u32 = np.asarray(jax.device_get(data[:, :1024]))
+    sample = sample_u32.view(np.uint8).reshape(10, 4096)
+    rs = new_encoder(backend="cpu")
+    expect = rs.encode([sample[i].copy() for i in range(10)] + [None] * 4)
+
+    if on_tpu:
+        got = np.asarray(
+            jax.device_get(kern.encode_u32(jnp.asarray(sample_u32)))
+        ).view(np.uint8)
+    else:
+        got = np.asarray(jax.device_get(kern.encode(jnp.asarray(sample))))
+    for i in range(4):
+        assert np.array_equal(got[i], expect[10 + i]), (
+            "bench kernel diverges from the CPU reference; refusing to "
+            "publish a throughput number for wrong bytes"
+        )
+
     if on_tpu:
         enc = kern.encode_u32
     else:
@@ -153,6 +176,37 @@ def bench_rebuild() -> None:
 
     data = gen(jax.random.PRNGKey(1))
     data.block_until_ready()
+
+    # integrity gate (see bench_encode): rebuilt bytes must match the
+    # CPU reference before the projection means anything
+    import numpy as np
+
+    from seaweedfs_tpu.ec.codec import new_encoder
+
+    sample_u32 = np.asarray(jax.device_get(data[:, :1024]))
+    sample = sample_u32.view(np.uint8).reshape(10, 4096)
+    rs = new_encoder(backend="cpu")
+    full = rs.encode([sample[i].copy() for i in range(10)] + [None] * 4)
+    surv_stack = np.stack([full[i] for i in survivors])
+    if on_tpu:
+        got = np.asarray(
+            jax.device_get(
+                kern.reconstruct_u32(
+                    survivors,
+                    targets,
+                    jnp.asarray(surv_stack.view(np.uint32).reshape(10, 1024)),
+                )
+            )
+        ).view(np.uint8)
+    else:
+        got = np.asarray(
+            jax.device_get(
+                kern.reconstruct(survivors, targets, jnp.asarray(surv_stack))
+            )
+        )
+    assert np.array_equal(got[0], full[0]), (
+        "rebuild kernel diverges from the CPU reference"
+    )
 
     if on_tpu:
         def rec(d):
