@@ -455,3 +455,46 @@ class TestConcurrentVacuum:
         assert not errors
         assert bytes(v.read_needle(999, cookie=999).data) == b"concurrent write"
         v.close()
+
+
+class TestNeedleMapBulk:
+    """Scaled mirror of the reference's compact_map_perf_test.go
+    1M-entry harness: bulk load, lookups, overwrite/delete accounting,
+    and idx-replay equivalence at 100k entries (kept small for CI)."""
+
+    N = 100_000
+
+    def test_bulk_load_and_replay(self, tmp_path):
+        import random
+
+        from seaweedfs_tpu.storage.needle_map import CompactNeedleMap
+
+        idx = str(tmp_path / "bulk.idx")
+        nm = CompactNeedleMap.load(idx)
+        rng = random.Random(7)
+        keys = list(range(1, self.N + 1))
+        for k in keys:
+            nm.put(k, k * 2, 100 + (k % 50))
+        # overwrite 5%, delete 5%
+        for k in rng.sample(keys, self.N // 20):
+            nm.put(k, k * 3, 999)
+        deleted = rng.sample(keys, self.N // 20)
+        for k in deleted:
+            nm.delete(k, 0)
+        assert len(nm) == self.N
+        assert nm.max_file_key == self.N
+        nm.close()
+
+        # replaying the .idx reproduces the same visible state
+        nm2 = CompactNeedleMap.load(idx)
+        for k in rng.sample(keys, 200):
+            a, b = nm.get(k), nm2.get(k)
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert (a.offset, a.size) == (b.offset, b.size)
+        import seaweedfs_tpu.storage.types as t
+
+        for k in rng.sample(deleted, 50):
+            v = nm2.get(k)
+            assert v is not None and v.size == t.TOMBSTONE_FILE_SIZE
+        nm2.close()
