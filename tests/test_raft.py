@@ -297,3 +297,120 @@ class TestFilerHaFailover:
                     m.stop()
                 except Exception:
                     pass
+
+
+class TestRaftLogRepair:
+    """Direct AppendEntries-handler checks for the paper's §5.3
+    conflict rules — stale divergent suffixes must truncate, and acks
+    must never overstate replication."""
+
+    def _node(self, tmp_path):
+        n = RaftNode("127.0.0.1:19333", ["127.0.0.1:19333", "127.0.0.1:19334"],
+                     lambda cmd: None, data_dir=str(tmp_path))
+        return n
+
+    def _entry(self, term, index, cmd="{}"):
+        from seaweedfs_tpu.pb import raft_pb2 as rpb
+
+        return rpb.LogEntry(term=term, index=index, command=cmd)
+
+    def test_conflicting_suffix_truncated(self, tmp_path):
+        from seaweedfs_tpu.pb import raft_pb2 as rpb
+
+        n = self._node(tmp_path)
+        # follower holds entries 1-3 from term 1
+        n.current_term = 1
+        n.log = [self._entry(1, 1), self._entry(1, 2), self._entry(1, 3)]
+
+        # new leader (term 2) overwrites from index 2
+        req = rpb.AppendEntriesRequest(
+            term=2,
+            leader_id="127.0.0.1:19334",
+            prev_log_index=1,
+            prev_log_term=1,
+            leader_commit=1,
+        )
+        req.entries.add(term=2, index=2, command='{"name":"Noop"}')
+        resp = n.AppendEntries(req)
+        assert resp.success
+        # stale index-3 entry is gone; log = [t1 i1, t2 i2]
+        assert [(e.term, e.index) for e in n.log] == [(1, 1), (2, 2)]
+        # ack covers exactly prev + entries, not any imagined suffix
+        assert resp.match_index == 2
+
+    def test_gap_rejected(self, tmp_path):
+        from seaweedfs_tpu.pb import raft_pb2 as rpb
+
+        n = self._node(tmp_path)
+        n.current_term = 1
+        n.log = [self._entry(1, 1)]
+        req = rpb.AppendEntriesRequest(
+            term=1,
+            leader_id="127.0.0.1:19334",
+            prev_log_index=5,  # follower has no entry 5
+            prev_log_term=1,
+        )
+        resp = n.AppendEntries(req)
+        assert not resp.success
+
+    def test_heartbeat_does_not_overstate_match(self, tmp_path):
+        """The §5.4 safety case behind the match_index fix: a follower
+        with a stale suffix must not ack it on an empty heartbeat."""
+        from seaweedfs_tpu.pb import raft_pb2 as rpb
+
+        n = self._node(tmp_path)
+        n.current_term = 3
+        # entries 1-2 consistent with the leader; 3-4 are stale term-1
+        # leftovers the leader knows nothing about
+        n.log = [
+            self._entry(2, 1),
+            self._entry(2, 2),
+            self._entry(1, 3),
+            self._entry(1, 4),
+        ]
+        req = rpb.AppendEntriesRequest(
+            term=3,
+            leader_id="127.0.0.1:19334",
+            prev_log_index=2,
+            prev_log_term=2,
+            leader_commit=0,
+        )
+        resp = n.AppendEntries(req)
+        assert resp.success
+        assert resp.match_index == 2  # NOT 4
+
+    def test_stale_term_rejected_with_current_term(self, tmp_path):
+        from seaweedfs_tpu.pb import raft_pb2 as rpb
+
+        n = self._node(tmp_path)
+        n.current_term = 5
+        resp = n.AppendEntries(
+            rpb.AppendEntriesRequest(term=3, leader_id="x", prev_log_index=0)
+        )
+        assert not resp.success and resp.term == 5
+
+    def test_vote_denied_to_stale_log(self, tmp_path):
+        from seaweedfs_tpu.pb import raft_pb2 as rpb
+
+        n = self._node(tmp_path)
+        n.current_term = 2
+        n.log = [self._entry(2, 1)]
+        resp = n.RequestVote(
+            rpb.RequestVoteRequest(
+                term=3,
+                candidate_id="127.0.0.1:19334",
+                last_log_index=5,
+                last_log_term=1,  # older last term than ours
+            )
+        )
+        assert not resp.vote_granted
+        # but an up-to-date candidate gets the vote in the same term
+        resp = n.RequestVote(
+            rpb.RequestVoteRequest(
+                term=3,
+                candidate_id="127.0.0.1:19334",
+                last_log_index=1,
+                last_log_term=2,
+            )
+        )
+        assert resp.vote_granted
