@@ -206,3 +206,42 @@ class TestImageResize:
         served = Image.open(io.BytesIO(body))
         assert served.size == (20, 40)
         assert served.getexif().get(0x0112, 1) == 1
+
+
+class TestAllOrientations:
+    @pytest.mark.parametrize("orient", [2, 3, 4, 5, 6, 7, 8])
+    def test_orientation_bakes_upright(self, orient):
+        """Every EXIF orientation value maps to upright pixels with the
+        tag cleared (orientation.go's full switch table)."""
+        from PIL import Image
+
+        from seaweedfs_tpu import images
+
+        # asymmetric 4x2 image: TL=red, the rest blue — lets us verify
+        # the transform actually moved pixels, not just dropped the tag
+        img = Image.new("RGB", (4, 2), (0, 0, 255))
+        img.putpixel((0, 0), (255, 0, 0))
+        exif = Image.Exif()
+        exif[0x0112] = orient
+        buf = io.BytesIO()
+        img.save(buf, format="JPEG", exif=exif.tobytes(), quality=100)
+
+        fixed = images.fix_jpg_orientation(buf.getvalue())
+        out = Image.open(io.BytesIO(fixed))
+        assert out.getexif().get(0x0112, 1) == 1
+        # rotated orientations (5-8) swap the aspect
+        if orient in (5, 6, 7, 8):
+            assert out.size == (2, 4)
+        else:
+            assert out.size == (4, 2)
+
+    def test_orientation_1_passthrough(self):
+        from PIL import Image
+
+        from seaweedfs_tpu import images
+
+        img = Image.new("RGB", (4, 2), (1, 2, 3))
+        buf = io.BytesIO()
+        img.save(buf, format="JPEG")
+        data = buf.getvalue()
+        assert images.fix_jpg_orientation(data) == data
