@@ -96,54 +96,35 @@ def resized(
     return buf.getvalue(), dst.size[0], dst.size[1]
 
 
-# EXIF orientation values → (rotate degrees CCW, flip op) per the TIFF
-# spec (orientation.go's switch table)
-_ORIENT_OPS = {
-    1: (0, None),
-    2: (0, "h"),
-    3: (180, None),
-    4: (0, "v"),
-    5: (90, "h"),
-    6: (270, None),
-    7: (270, "h"),
-    8: (90, None),
-}
-
-
 def fix_jpg_orientation(data: bytes) -> bytes:
     """Bake the EXIF orientation into the pixels (orientation.go:14);
-    returns the input unchanged when there is nothing to fix."""
+    returns the input unchanged when there is nothing to fix. Uses
+    Pillow's canonical exif_transpose — a hand-rolled rotate/flip
+    table is exactly the kind of thing that silently disagrees with
+    the spec on half the orientation values."""
     Image = _pil()
     if Image is None:
         return data
     try:
         img = Image.open(io.BytesIO(data))
-        exif = img.getexif()
-        orient = exif.get(0x0112, 1)  # Orientation tag
+        orient = img.getexif().get(0x0112, 1)  # Orientation tag
     except Exception:  # noqa: BLE001
         return data
-    if orient == 1:
+    if orient == 1 or orient not in range(2, 9):
+        # 1 = upright; out-of-range tags (corrupt cameras) must pass
+        # through untouched, not get generation-lossed by a no-op
+        # re-encode
         return data
-    op = _ORIENT_OPS.get(orient)
-    if op is None:
-        return data
-    angle, flip = op
     try:
-        img.load()
-        if flip == "h":
-            img = img.transpose(Image.FLIP_LEFT_RIGHT)
-        elif flip == "v":
-            img = img.transpose(Image.FLIP_TOP_BOTTOM)
-        if angle:
-            img = img.rotate(angle, expand=True)
-        # strip the orientation tag: pixels are now upright
-        new_exif = img.getexif()
-        if 0x0112 in new_exif:
-            del new_exif[0x0112]
+        from PIL import ImageOps
+
+        fixed = ImageOps.exif_transpose(img)  # also clears the tag
         buf = io.BytesIO()
-        if img.mode not in ("RGB", "L"):
-            img = img.convert("RGB")
-        img.save(buf, format="JPEG", exif=new_exif.tobytes())
+        if fixed.mode not in ("RGB", "L"):
+            fixed = fixed.convert("RGB")
+        # quality 95: the write path must not visibly degrade photos
+        # just to bake in the rotation
+        fixed.save(buf, format="JPEG", quality=95, exif=fixed.getexif().tobytes())
         return buf.getvalue()
     except Exception:  # noqa: BLE001
         return data

@@ -210,30 +210,43 @@ class TestImageResize:
 
 class TestAllOrientations:
     @pytest.mark.parametrize("orient", [2, 3, 4, 5, 6, 7, 8])
-    def test_orientation_bakes_upright(self, orient):
-        """Every EXIF orientation value maps to upright pixels with the
-        tag cleared (orientation.go's full switch table)."""
-        from PIL import Image
+    def test_orientation_matches_pillow_ground_truth(self, orient):
+        """Every EXIF orientation bakes to the same pixels Pillow's
+        canonical exif_transpose produces, with the tag cleared.
+        Block colors + corner means keep JPEG chroma subsampling out
+        of the comparison (a tiny test image would smear)."""
+        from PIL import Image, ImageOps
 
         from seaweedfs_tpu import images
 
-        # asymmetric 4x2 image: TL=red, the rest blue — lets us verify
-        # the transform actually moved pixels, not just dropped the tag
-        img = Image.new("RGB", (4, 2), (0, 0, 255))
-        img.putpixel((0, 0), (255, 0, 0))
+        img = Image.new("RGB", (64, 32), (0, 0, 255))
+        for x in range(16):
+            for y in range(16):
+                img.putpixel((x, y), (255, 0, 0))
+                img.putpixel((63 - x, 31 - y), (0, 255, 0))
         exif = Image.Exif()
         exif[0x0112] = orient
         buf = io.BytesIO()
         img.save(buf, format="JPEG", exif=exif.tobytes(), quality=100)
+        data = buf.getvalue()
 
-        fixed = images.fix_jpg_orientation(buf.getvalue())
-        out = Image.open(io.BytesIO(fixed))
-        assert out.getexif().get(0x0112, 1) == 1
-        # rotated orientations (5-8) swap the aspect
-        if orient in (5, 6, 7, 8):
-            assert out.size == (2, 4)
-        else:
-            assert out.size == (4, 2)
+        ours = Image.open(io.BytesIO(images.fix_jpg_orientation(data)))
+        truth = ImageOps.exif_transpose(Image.open(io.BytesIO(data)))
+        assert ours.getexif().get(0x0112, 1) == 1
+        assert ours.size == truth.size
+
+        def corner_mean(im, cx, cy):
+            px = [
+                im.getpixel((cx + dx, cy + dy))
+                for dx in range(6)
+                for dy in range(6)
+            ]
+            return tuple(sum(c[i] for c in px) // len(px) for i in range(3))
+
+        w, h = truth.size
+        for cx, cy in ((2, 2), (w - 8, 2), (2, h - 8), (w - 8, h - 8)):
+            a, b = corner_mean(ours, cx, cy), corner_mean(truth, cx, cy)
+            assert sum(abs(x - y) for x, y in zip(a, b)) < 90, (orient, a, b)
 
     def test_orientation_1_passthrough(self):
         from PIL import Image
