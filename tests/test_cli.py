@@ -264,3 +264,70 @@ class TestServerDaemon:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+
+class TestBackupCommand:
+    def test_incremental_backup_roundtrip(self, mini_cluster, tmp_path, capsys):
+        """backup pulls a volume's records locally and resumes
+        incrementally (command/backup.go runBackup role)."""
+        from seaweedfs_tpu.client import operation as op
+        from seaweedfs_tpu.storage.file_id import FileId
+        from seaweedfs_tpu.storage.volume import Volume
+
+        main = cli_main
+
+        master_addr = mini_cluster
+        ar = op.assign(master_addr, collection="bak")
+        payload1 = b"first backup payload " * 40
+        assert not op.upload(f"{ar.url}/{ar.fid}", payload1, jwt=ar.auth).error
+        vid = int(ar.fid.split(",")[0])
+
+        rc = main(
+            [
+                "backup",
+                "-master",
+                master_addr,
+                "-volumeId",
+                str(vid),
+                "-collection",
+                "bak",
+                "-dir",
+                str(tmp_path),
+            ]
+        )
+        assert rc == 0
+
+        fid1 = FileId.parse(ar.fid)
+        v = Volume(str(tmp_path), vid, "bak", create=False)
+        assert bytes(v.read_needle(fid1.key, cookie=fid1.cookie).data) == payload1
+        first_size = v.data_file_size()
+        v.close()
+
+        # write more into the SAME volume, then an incremental run
+        # appends only the tail
+        payload2 = b"second incremental blob"
+        ar2 = op.assign(master_addr, collection="bak")
+        while int(ar2.fid.split(",")[0]) != vid:
+            ar2 = op.assign(master_addr, collection="bak")
+        assert not op.upload(f"{ar2.url}/{ar2.fid}", payload2, jwt=ar2.auth).error
+
+        rc = main(
+            [
+                "backup",
+                "-master",
+                master_addr,
+                "-volumeId",
+                str(vid),
+                "-collection",
+                "bak",
+                "-dir",
+                str(tmp_path),
+            ]
+        )
+        assert rc == 0
+        fid2 = FileId.parse(ar2.fid)
+        v = Volume(str(tmp_path), vid, "bak", create=False)
+        assert bytes(v.read_needle(fid1.key, cookie=fid1.cookie).data) == payload1
+        assert bytes(v.read_needle(fid2.key, cookie=fid2.cookie).data) == payload2
+        assert v.data_file_size() > first_size
+        v.close()
