@@ -56,7 +56,7 @@ def cluster(tmp_path_factory):
         )
         vs.start()
         volume_servers.append(vs)
-    deadline = time.time() + 10
+    deadline = time.time() + 45
     while time.time() < deadline and len(master.topology.data_nodes()) < 3:
         time.sleep(0.05)
     assert len(master.topology.data_nodes()) == 3
@@ -296,7 +296,7 @@ class TestEcLifecycle:
             stub.VolumeDelete(volume_pb2.VolumeDeleteRequest(volume_id=vid))
 
         # wait for heartbeats to report the shard split to the master
-        deadline = time.time() + 10
+        deadline = time.time() + 45
         while time.time() < deadline:
             locs = master.topology.lookup_ec_shards(vid)
             if locs is not None and all(locs.locations[i] for i in range(14)):
@@ -361,7 +361,7 @@ class TestJwtSignedWrites:
             guard=Guard(signing_key=key, expires_after_sec=30),
         )
         vs.start()
-        deadline = time.time() + 10
+        deadline = time.time() + 45
         while time.time() < deadline and len(master.topology.data_nodes()) < 1:
             time.sleep(0.05)
         yield master, vs
@@ -460,7 +460,7 @@ class TestDegradedParallelRead:
             max_volume_counts=[100],
         )
         extra.start()
-        deadline = time.time() + 10
+        deadline = time.time() + 45
         while time.time() < deadline and len(master.topology.data_nodes()) < 4:
             time.sleep(0.05)
 
@@ -507,7 +507,7 @@ class TestDegradedParallelRead:
             stub.VolumeDelete(volume_pb2.VolumeDeleteRequest(volume_id=vid))
 
         # master must know all 14 shard locations before the read
-        deadline = time.time() + 10
+        deadline = time.time() + 45
         while time.time() < deadline:
             locs = master.topology.lookup_ec_shards(vid)
             if locs is not None and all(locs.locations[i] for i in range(14)):

@@ -41,7 +41,7 @@ def stack(tmp_path_factory):
         max_volume_counts=[100],
     )
     vs.start()
-    deadline = time.time() + 10
+    deadline = time.time() + 45
     while time.time() < deadline and len(master.topology.data_nodes()) < 1:
         time.sleep(0.05)
     filer = FilerServer([f"127.0.0.1:{master.port}"], port=free_port(), store="memory")

@@ -19,7 +19,7 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def wait_for(cond, timeout=10.0, interval=0.05):
+def wait_for(cond, timeout=45.0, interval=0.05):
     deadline = time.time() + timeout
     while time.time() < deadline:
         if cond():
@@ -114,7 +114,7 @@ class TestRaftCore:
             servers[idx].stop(grace=0)
             rest = [n for i, n in enumerate(nodes) if i != idx]
             assert wait_for(
-                lambda: sum(1 for n in rest if n.is_leader) == 1, timeout=15
+                lambda: sum(1 for n in rest if n.is_leader) == 1, timeout=45
             ), "no new leader after failover"
             new_leader = next(n for n in rest if n.is_leader)
             # the committed entry survived, and new proposals commit
@@ -146,7 +146,7 @@ class TestHaMasters:
         for m in masters:
             m.start()
         assert wait_for(
-            lambda: sum(1 for m in masters if m.is_leader) == 1, timeout=15
+            lambda: sum(1 for m in masters if m.is_leader) == 1, timeout=45
         ), "no leader among masters"
         vs = VolumeServer(
             [str(tmp_path_factory.mktemp("havs"))],
@@ -158,7 +158,7 @@ class TestHaMasters:
         vs.start()
         leader = next(m for m in masters if m.is_leader)
         assert wait_for(
-            lambda: len(leader.topology.data_nodes()) == 1, timeout=15
+            lambda: len(leader.topology.data_nodes()) == 1, timeout=45
         ), "volume server did not register with the leader"
         yield masters, vs
         vs.stop()
@@ -186,13 +186,13 @@ class TestHaMasters:
         leader.stop()
         rest = [m for m in masters if m is not leader]
         assert wait_for(
-            lambda: sum(1 for m in rest if m.is_leader) == 1, timeout=20
+            lambda: sum(1 for m in rest if m.is_leader) == 1, timeout=45
         ), "no failover leader"
         new_leader = next(m for m in rest if m.is_leader)
 
         # the volume server re-registers with the new leader
         assert wait_for(
-            lambda: len(new_leader.topology.data_nodes()) == 1, timeout=20
+            lambda: len(new_leader.topology.data_nodes()) == 1, timeout=45
         ), "volume server did not follow the new leader"
 
         # assigns keep working via the new leader, and if growth
@@ -241,7 +241,7 @@ class TestFilerHaFailover:
         vs = filer = None
         try:
             assert wait_for(
-                lambda: sum(1 for m in masters if m.is_leader) == 1, timeout=15
+                lambda: sum(1 for m in masters if m.is_leader) == 1, timeout=45
             )
             vs = VolumeServer(
                 [str(tmp_path_factory.mktemp("fhavs"))],
@@ -253,7 +253,7 @@ class TestFilerHaFailover:
             vs.start()
             leader = next(m for m in masters if m.is_leader)
             assert wait_for(
-                lambda: len(leader.topology.data_nodes()) == 1, timeout=15
+                lambda: len(leader.topology.data_nodes()) == 1, timeout=45
             )
             filer = FilerServer(
                 [f"127.0.0.1:{p}" for p in ports],
@@ -268,23 +268,23 @@ class TestFilerHaFailover:
                     data=data,
                     method="POST",
                 )
-                urllib.request.urlopen(req, timeout=15).close()
+                urllib.request.urlopen(req, timeout=45).close()
 
             put("/a/pre.txt", b"before failover")
 
             leader.stop()
             rest = [m for m in masters if m is not leader]
             assert wait_for(
-                lambda: sum(1 for m in rest if m.is_leader) == 1, timeout=20
+                lambda: sum(1 for m in rest if m.is_leader) == 1, timeout=45
             )
             new_leader = next(m for m in rest if m.is_leader)
             assert wait_for(
-                lambda: len(new_leader.topology.data_nodes()) == 1, timeout=20
+                lambda: len(new_leader.topology.data_nodes()) == 1, timeout=45
             )
 
             put("/a/post.txt", b"after failover")
             with urllib.request.urlopen(
-                f"http://127.0.0.1:{filer.port}/a/post.txt", timeout=15
+                f"http://127.0.0.1:{filer.port}/a/post.txt", timeout=45
             ) as r:
                 assert r.read() == b"after failover"
         finally:

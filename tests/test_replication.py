@@ -108,7 +108,7 @@ def two_clusters(tmp_path_factory):
             )
             filer.start()
             stacks.extend([filer, vs, master])
-            deadline = time.time() + 10
+            deadline = time.time() + 45
             while time.time() < deadline and not master.topology.data_nodes():
                 time.sleep(0.05)
             filers.append(f"127.0.0.1:{fport}")
@@ -237,7 +237,7 @@ class TestS3Sink:
                 max_volume_counts=[100],
             )
         )
-        deadline = _time.time() + 10
+        deadline = _time.time() + 45
         while _time.time() < deadline and len(master.topology.data_nodes()) < 1:
             _time.sleep(0.05)
         filer = up(
@@ -390,7 +390,7 @@ def test_s3_sink_directory_delete_sweeps_prefix(tmp_path_factory):
             max_volume_counts=[100],
         )
     )
-    deadline = _time.time() + 10
+    deadline = _time.time() + 45
     while _time.time() < deadline and len(master.topology.data_nodes()) < 1:
         _time.sleep(0.05)
     filer = up(

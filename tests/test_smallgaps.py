@@ -289,7 +289,7 @@ def mini_cluster(tmp_path_factory):
         max_volume_counts=[100],
     )
     vs.start()
-    deadline = time.time() + 10
+    deadline = time.time() + 45
     while time.time() < deadline and len(master.topology.data_nodes()) < 1:
         time.sleep(0.05)
     yield master, vs
@@ -418,7 +418,7 @@ class TestMasterVacuumLoop:
         )
         vs.start()
         try:
-            deadline = time.time() + 10
+            deadline = time.time() + 45
             while time.time() < deadline and len(master.topology.data_nodes()) < 1:
                 time.sleep(0.05)
             ar = op.assign(f"127.0.0.1:{master.port}", collection="vacloop")
@@ -553,7 +553,7 @@ class TestDbNeedleMapCluster:
         vs.start()
         vs2 = None
         try:
-            deadline = time.time() + 10
+            deadline = time.time() + 45
             while time.time() < deadline and len(master.topology.data_nodes()) < 1:
                 time.sleep(0.05)
 

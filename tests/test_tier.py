@@ -50,7 +50,7 @@ def tier_env(tmp_path_factory):
             max_volume_counts=[100],
         )
     )
-    deadline = time.time() + 10
+    deadline = time.time() + 45
     while time.time() < deadline and len(m2.topology.data_nodes()) < 1:
         time.sleep(0.05)
     f2 = up(FilerServer([f"127.0.0.1:{m2.port}"], port=free_port(), store="memory"))
@@ -86,7 +86,7 @@ def tier_env(tmp_path_factory):
             storage_backends=backends,
         )
     )
-    deadline = time.time() + 10
+    deadline = time.time() + 45
     while time.time() < deadline and len(m1.topology.data_nodes()) < 1:
         time.sleep(0.05)
 
