@@ -498,3 +498,37 @@ class TestNeedleMapBulk:
             v = nm2.get(k)
             assert v is not None and v.size == t.TOMBSTONE_FILE_SIZE
         nm2.close()
+
+
+class TestTtlExpiry:
+    def test_expired_needle_reads_as_not_found(self, tmp_path, monkeypatch):
+        """A needle whose TTL has elapsed 404s on read while a fresh one
+        keeps serving (volume_read_write.go TTL gate)."""
+        import time as _time
+
+        from seaweedfs_tpu.storage.needle import Needle
+        from seaweedfs_tpu.storage.ttl import TTL
+        from seaweedfs_tpu.storage.volume import NeedleNotFound, Volume
+
+        v = Volume(str(tmp_path), 21, ttl=TTL.parse("1m"))
+        n = Needle(cookie=1, id=1, data=b"short lived")
+        n.ttl = TTL.parse("1m")
+        n.set_has_ttl()
+        n.last_modified = int(_time.time())
+        n.set_has_last_modified_date()
+        v.write_needle(n)
+
+        # fresh: serves
+        assert bytes(v.read_needle(1, cookie=1).data) == b"short lived"
+
+        # jump 2 minutes into the future
+        real_time = _time.time
+        monkeypatch.setattr(
+            "seaweedfs_tpu.storage.volume.time.time",
+            lambda: real_time() + 120,
+        )
+        import pytest as _pytest
+
+        with _pytest.raises(NeedleNotFound):
+            v.read_needle(1, cookie=1)
+        v.close()
