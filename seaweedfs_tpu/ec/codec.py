@@ -12,10 +12,12 @@ Mirrors the semantics the reference relies on (ec_encoder.go:173
 Shards are equal-length 1-D uint8 numpy arrays (missing = None). The
 byte math runs through a pluggable backend:
 
-  "cpu"  numpy LUT-gather XOR loops — bit-exact reference
-  "tpu"  JAX bitsliced XOR-matmul (codec_tpu.py) — rides the MXU
+  "cpu"     numpy LUT-gather XOR loops — bit-exact reference
+  "native"  SIMD C shim (native/gf256.c, PSHUFB nibble tables) — the
+            klauspost/reedsolomon-AVX2 role for plain hosts
+  "tpu"     JAX SWAR/bitsliced kernels (codec_tpu.py)
 
-Both produce byte-identical output (tested against each other and
+All produce byte-identical output (tested against each other and
 against the code-matrix algebra in gf256.py).
 """
 
@@ -67,13 +69,14 @@ register_backend("cpu", cpu_apply_matrix)
 # An explicit backend= argument always wins (servers thread their
 # -ec.codec flag down through Store → DiskLocation → EcVolume). When no
 # backend is given, the WEED_EC_CODEC env var (viper idiom for
-# `ec.codec`) decides; otherwise auto-detect: tpu only when an
-# accelerator device is actually attached, cpu on plain hosts (the
-# numpy LUT path beats XLA-on-CPU for this workload). Both backends are
-# byte-identical; selection is purely a performance choice, so a
-# process-wide cached default is safe.
+# `ec.codec`) decides; otherwise auto-detect: tpu when an accelerator
+# device is actually attached, else the native SIMD shim when it
+# builds, else numpy (which beats XLA-on-CPU for this workload). All
+# backends are byte-identical; selection is purely a performance
+# choice, so a process-wide cached default is safe.
 
 _default_backend = ""  # "" = undecided; resolved lazily
+_LAZY_BACKENDS = ("tpu", "native")  # registered on first resolve
 
 
 def default_backend() -> str:
@@ -82,10 +85,10 @@ def default_backend() -> str:
 
     env = os.environ.get("WEED_EC_CODEC", "").strip().lower()
     if env:
-        if env != "tpu" and env not in _BACKENDS:
+        if env not in _LAZY_BACKENDS and env not in _BACKENDS:
             raise ValueError(
                 f"WEED_EC_CODEC={env!r} is not a known EC backend "
-                f"(expected one of: cpu, tpu)"
+                f"(expected one of: cpu, native, tpu)"
             )
         return env
     if not _default_backend:
@@ -93,9 +96,16 @@ def default_backend() -> str:
             import jax
 
             has_accel = any(d.platform != "cpu" for d in jax.devices())
-            _default_backend = "tpu" if has_accel else "cpu"
+            _default_backend = "tpu" if has_accel else ""
         except Exception:
-            _default_backend = "cpu"
+            pass
+        if not _default_backend:
+            try:
+                from seaweedfs_tpu.ec import codec_native  # noqa: F401
+
+                _default_backend = "native"
+            except ImportError:
+                _default_backend = "cpu"
     return _default_backend
 
 
@@ -129,6 +139,8 @@ class ReedSolomon:
         if name == "tpu" and "tpu" not in _BACKENDS:
             # lazy import so CPU-only users never touch jax
             from seaweedfs_tpu.ec import codec_tpu  # noqa: F401
+        if name == "native" and "native" not in _BACKENDS:
+            from seaweedfs_tpu.ec import codec_native  # noqa: F401
         try:
             return _BACKENDS[name]
         except KeyError:

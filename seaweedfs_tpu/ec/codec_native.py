@@ -1,0 +1,17 @@
+""""native" EC codec backend: the SIMD C shim (native/gf256.c).
+
+Registers on import, mirroring codec_tpu.py's pattern. This is the
+counterpart of the reference's klauspost/reedsolomon AVX2 path
+(ec_encoder.go:13) for hosts without an attached TPU — byte-identical
+to the "cpu" numpy backend (tests/test_ec_codec.py cross-checks), just
+~2 orders of magnitude faster, which makes end-to-end `ec.encode` of
+real volume files disk-bound instead of codec-bound.
+
+Importing this module raises ImportError when the shim can't build;
+codec.default_backend() catches that and picks "cpu".
+"""
+
+from seaweedfs_tpu.ec.codec import register_backend
+from seaweedfs_tpu.native.gf import apply_matrix as native_apply_matrix
+
+register_backend("native", native_apply_matrix)

@@ -1,64 +1,30 @@
 """Native runtime shims (the reference's vendored-assembly tier).
 
-The reference's only native code is vendored Go assembly:
-klauspost/crc32 (SSE4.2 Castagnoli, needle/crc.go:8) and
-klauspost/reedsolomon AVX2 (replaced here by the TPU SWAR kernel,
-ec/codec_tpu.py). This package supplies the CRC counterpart as a small
-C library compiled lazily with the system compiler and loaded via
-ctypes — no pybind11/pip needed. When no compiler is available the
-pure-Python slicing-by-8 fallback in util/crc.py serves instead.
+The reference's performance-critical native code is vendored Go
+assembly: klauspost/crc32 (SSE4.2 Castagnoli, needle/crc.go:8) and
+klauspost/reedsolomon (AVX2 GF(2^8), ec_encoder.go:13). This package
+supplies both counterparts as small C libraries compiled lazily with
+the system compiler and loaded via ctypes — no pybind11/pip needed:
+
+  crc32c.c  hardware CRC-32C           → `from seaweedfs_tpu.native import crc32c`
+  gf256.c   SIMD GF(2^8) matrix apply  → `seaweedfs_tpu.native.gf`
+            (the "native" EC codec backend; the TPU SWAR kernel in
+            ec/codec_tpu.py serves accelerator hosts instead)
+
+When no compiler is available the pure-Python/numpy fallbacks serve:
+util/crc.py slicing-by-8 and the "cpu" numpy LUT codec backend.
+Importing a missing shim raises ImportError, which the callers catch.
 """
 
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-import tempfile
 
-_HERE = os.path.dirname(os.path.abspath(__file__))
-_SO_PATH = os.path.join(_HERE, "_crc32c.so")
-_SRC_PATH = os.path.join(_HERE, "crc32c.c")
+from seaweedfs_tpu.native import _build
 
-
-def _build() -> str | None:
-    """Compile crc32c.c → _crc32c.so (cached; rebuilt when stale)."""
+_lib = _build.load("crc32c.c", "_crc32c.so")
+if _lib is not None:
     try:
-        if os.path.exists(_SO_PATH) and os.path.getmtime(
-            _SO_PATH
-        ) >= os.path.getmtime(_SRC_PATH):
-            return _SO_PATH
-        for cc in ("cc", "gcc", "g++", "clang"):
-            # build to a temp file then rename: concurrent importers
-            # must never dlopen a half-written .so
-            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
-            os.close(fd)
-            try:
-                proc = subprocess.run(
-                    [cc, "-O2", "-shared", "-fPIC", "-o", tmp, _SRC_PATH],
-                    capture_output=True,
-                    timeout=60,
-                )
-                if proc.returncode == 0:
-                    os.replace(tmp, _SO_PATH)
-                    return _SO_PATH
-            except (OSError, subprocess.TimeoutExpired):
-                pass
-            finally:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-    except OSError:
-        pass
-    return None
-
-
-_lib = None
-_so = _build()
-if _so is not None:
-    try:
-        _lib = ctypes.CDLL(_so)
         _lib.weed_crc32c.restype = ctypes.c_uint32
         _lib.weed_crc32c.argtypes = (
             ctypes.c_uint32,
@@ -68,13 +34,11 @@ if _so is not None:
     except OSError:
         _lib = None
 
-if _lib is None:  # surface as ImportError so util/crc.py falls back
-    raise ImportError("native crc32c unavailable (no compiler or load failed)")
+if _lib is not None:
 
-
-def crc32c(data, crc: int = 0) -> int:
-    """Hardware-accelerated CRC-32C (SSE4.2 when the CPU has it).
-    Accepts any bytes-like object, matching the Python fallback."""
-    if not isinstance(data, bytes):
-        data = bytes(data)
-    return _lib.weed_crc32c(crc & 0xFFFFFFFF, data, len(data))
+    def crc32c(data, crc: int = 0) -> int:
+        """Hardware-accelerated CRC-32C (SSE4.2 when the CPU has it).
+        Accepts any bytes-like object, matching the Python fallback."""
+        if not isinstance(data, bytes):
+            data = bytes(data)
+        return _lib.weed_crc32c(crc & 0xFFFFFFFF, data, len(data))

@@ -1,0 +1,57 @@
+"""Lazy compile-and-load for the native shims.
+
+Each shim is one C file next to this module, compiled with whatever
+system compiler is present and loaded via ctypes — no pybind11/pip.
+Callers treat a None return as "no native path" and fall back to their
+pure-Python/numpy implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def load(src_name: str, so_name: str) -> ctypes.CDLL | None:
+    """Compile src_name → so_name (cached; rebuilt when stale) and dlopen it."""
+    src = os.path.join(_HERE, src_name)
+    so = os.path.join(_HERE, so_name)
+    built = None
+    try:
+        if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+            built = so
+        else:
+            for cc in ("cc", "gcc", "g++", "clang"):
+                # build to a temp file then rename: concurrent importers
+                # must never dlopen a half-written .so
+                fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+                os.close(fd)
+                try:
+                    proc = subprocess.run(
+                        [cc, "-O2", "-shared", "-fPIC", "-o", tmp, src],
+                        capture_output=True,
+                        timeout=60,
+                    )
+                    if proc.returncode == 0:
+                        os.replace(tmp, so)
+                        built = so
+                        break
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+                finally:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+    except OSError:
+        pass
+    if built is None:
+        return None
+    try:
+        return ctypes.CDLL(built)
+    except OSError:
+        return None

@@ -1,0 +1,53 @@
+"""ctypes wrapper for the SIMD GF(2^8) matrix-apply shim (gf256.c).
+
+Importing this module raises ImportError when no compiler is available;
+ec/codec_native.py catches that and the numpy "cpu" backend serves.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from seaweedfs_tpu.native import _build
+
+_lib = _build.load("gf256.c", "_gf256.so")
+if _lib is None:
+    raise ImportError("native gf256 unavailable (no compiler or load failed)")
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+try:
+    _lib.weed_gf_apply.restype = None
+    _lib.weed_gf_apply.argtypes = (
+        _u8p,  # matrix [r*k]
+        ctypes.c_int32,  # r
+        ctypes.c_int32,  # k
+        ctypes.POINTER(_u8p),  # inputs  [k] row pointers
+        ctypes.POINTER(_u8p),  # outputs [r] row pointers
+        ctypes.c_size_t,  # n
+    )
+except AttributeError as e:  # stale/foreign .so without our export
+    raise ImportError(f"native gf256 lacks weed_gf_apply: {e}") from e
+
+
+def apply_matrix(matrix: np.ndarray, inputs: np.ndarray) -> np.ndarray:
+    """out[r] = XOR_c gfmul(matrix[r,c], inputs[c]) over the 0x11D field.
+
+    matrix [R, C] u8, inputs [C, N] u8 → [R, N] u8. Same contract as
+    codec.cpu_apply_matrix (rows of the C-contiguous arrays are passed
+    as raw pointers; no copies beyond contiguity normalization).
+    """
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    inputs = np.ascontiguousarray(inputs, dtype=np.uint8)
+    r, k = matrix.shape
+    if inputs.shape[0] != k:
+        raise ValueError(f"matrix has {k} columns but inputs has {inputs.shape[0]} rows")
+    n = inputs.shape[1]
+    out = np.empty((r, n), dtype=np.uint8)
+    in_ptrs = (_u8p * k)(*(inputs[i].ctypes.data_as(_u8p) for i in range(k)))
+    out_ptrs = (_u8p * r)(*(out[i].ctypes.data_as(_u8p) for i in range(r)))
+    _lib.weed_gf_apply(
+        matrix.ctypes.data_as(_u8p), r, k, in_ptrs, out_ptrs, n
+    )
+    return out
