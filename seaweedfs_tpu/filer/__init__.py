@@ -8,6 +8,7 @@ from seaweedfs_tpu.filer.filerstore import (
     SqliteStore,
     new_store,
 )
+from seaweedfs_tpu.filer.lsm import LsmStore
 
 __all__ = [
     "Attr",
@@ -15,6 +16,7 @@ __all__ = [
     "EntryNotFound",
     "Filer",
     "FilerStore",
+    "LsmStore",
     "MemoryStore",
     "SortedLogStore",
     "SqliteStore",

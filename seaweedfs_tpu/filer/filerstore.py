@@ -277,4 +277,10 @@ def new_store(kind: str, path: str = "") -> FilerStore:
         if not path:
             raise ValueError("sortedlog store needs a path")
         return SortedLogStore(path)
+    if kind == "lsm":
+        if not path:
+            raise ValueError("lsm store needs a directory path")
+        from seaweedfs_tpu.filer.lsm import LsmStore
+
+        return LsmStore(path)
     raise ValueError(f"unknown filer store {kind!r}")
