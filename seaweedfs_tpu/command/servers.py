@@ -196,7 +196,9 @@ class FilerCommand(Command):
         p.add_argument("-ip", default="127.0.0.1")
         p.add_argument("-port", type=int, default=8888)
         p.add_argument("-master", default="127.0.0.1:9333")
-        p.add_argument("-store", default="memory", help="memory | sqlite | sortedlog")
+        p.add_argument(
+            "-store", default="memory", help="memory | sqlite | sortedlog | lsm"
+        )
         p.add_argument("-storePath", default="")
         p.add_argument("-collection", default="")
         p.add_argument("-replication", default="")

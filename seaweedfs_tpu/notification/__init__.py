@@ -4,9 +4,12 @@ Behavioral match of weed/notification/configuration.go: a process-wide
 `queue` that the filer's NotifyUpdateEvent pushes (key,
 EventNotification) messages into (filer2/filer_notify.go:9-39).
 Backends here: log (glog-style), memory (in-process, subscribable),
-dirqueue (durable file-per-message directory — the cross-process path
-the reference fills with Kafka/SQS/PubSub; those need client libraries
-not present in this image and are represented by GatedQueue stubs).
+dirqueue (durable file-per-message directory), logqueue (embedded
+partitioned segmented log with consumer groups — the Kafka-role broker,
+notification/logqueue.py). Broker-backed kinds that need client
+libraries not present in this image (kafka, aws_sqs, google_pub_sub)
+remain GatedQueue stubs pointing at logqueue as the built-in
+equivalent.
 """
 
 from __future__ import annotations
@@ -126,7 +129,8 @@ class GatedQueue(NotificationQueue):
         raise RuntimeError(
             f"notification queue {kind!r} requires an external client "
             "library not present in this environment; use [notification."
-            "dirqueue] for durable queuing or [notification.memory]"
+            "logqueue] (embedded partitioned log with consumer groups) "
+            "or [notification.dirqueue] / [notification.memory]"
         )
 
 
@@ -140,6 +144,13 @@ def configure(cfg) -> NotificationQueue | None:
         queue = MemoryQueue()
     elif cfg.get_bool("notification.dirqueue.enabled"):
         queue = DirQueue(cfg.get_string("notification.dirqueue.dir", "./notifications"))
+    elif cfg.get_bool("notification.logqueue.enabled"):
+        from seaweedfs_tpu.notification.logqueue import PartitionedLogQueue
+
+        queue = PartitionedLogQueue(
+            cfg.get_string("notification.logqueue.dir", "./notifications"),
+            partitions=cfg.get_int("notification.logqueue.partitions", 4),
+        )
     elif cfg.get_bool("notification.kafka.enabled"):
         queue = GatedQueue("kafka")
     elif cfg.get_bool("notification.aws_sqs.enabled"):

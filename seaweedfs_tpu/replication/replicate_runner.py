@@ -56,9 +56,11 @@ def run_replicate(
     poll_interval: float = 1.0,
     stop_after_idle: float = 0.0,
 ) -> int:
-    """Consume a DirQueue and replicate each event; offset checkpointed
-    next to the queue so restarts resume. stop_after_idle > 0 makes the
-    loop exit after that many idle seconds (tests / one-shot drains)."""
+    """Consume the configured durable queue (dirqueue, or the
+    partitioned logqueue with consumer group "replicate") and replicate
+    each event; offsets are checkpointed so restarts resume.
+    stop_after_idle > 0 makes the loop exit after that many idle
+    seconds (tests / one-shot drains)."""
     if config_path:
         import tomllib
 
@@ -70,9 +72,25 @@ def run_replicate(
 
     from seaweedfs_tpu import notification
 
+    replicator = build_replicator(repl_cfg)
+    if notif_cfg.get_bool("notification.logqueue.enabled"):
+        from seaweedfs_tpu.notification.logqueue import PartitionedLogQueue
+
+        qdir = notif_cfg.get_string("notification.logqueue.dir", "./notifications")
+        lq = PartitionedLogQueue(
+            qdir,
+            partitions=notif_cfg.get_int("notification.logqueue.partitions", 4),
+        )
+        wlog.info(
+            "filer.replicate consuming logqueue %s (lag %d)",
+            qdir,
+            lq.depth("replicate"),
+        )
+        return _consume_logqueue(
+            lq, replicator, poll_interval, stop_after_idle
+        )
     qdir = notif_cfg.get_string("notification.dirqueue.dir", "./notifications")
     dirqueue = notification.DirQueue(qdir)
-    replicator = build_replicator(repl_cfg)
     offset_file = os.path.join(qdir, ".replicate_offset")
     after = 0
     if os.path.exists(offset_file):
@@ -92,6 +110,31 @@ def run_replicate(
                 f.write(str(after))
             progressed = True
         if progressed:
+            idle_since = time.time()
+        elif stop_after_idle and time.time() - idle_since > stop_after_idle:
+            return 0
+        else:
+            time.sleep(poll_interval)
+
+
+def _consume_logqueue(lq, replicator, poll_interval, stop_after_idle) -> int:
+    """Drain loop over the partitioned log: poll → replicate →
+    commit-per-partition (at-least-once), then trim consumed segments."""
+    group = "replicate"
+    idle_since = time.time()
+    while True:
+        batch = lq.poll(group)
+        if batch:
+            high: dict[int, int] = {}
+            for part, offset, key, msg in batch:
+                try:
+                    replicator.replicate(key, msg)
+                except Exception as e:  # noqa: BLE001 — keep consuming
+                    wlog.error("replicate %s: %s", key, e)
+                high[part] = offset + 1
+            for part, next_off in high.items():
+                lq.commit(group, part, next_off)
+            lq.trim()
             idle_since = time.time()
         elif stop_after_idle and time.time() - idle_since > stop_after_idle:
             return 0

@@ -438,3 +438,230 @@ def test_s3_sink_directory_delete_sweeps_prefix(tmp_path_factory):
     finally:
         for s in reversed(servers):
             s.stop()
+
+
+class TestPartitionedLogQueue:
+    """The embedded Kafka-role broker (notification/logqueue.py):
+    partition/offset/consumer-group/segment-retention semantics."""
+
+    @staticmethod
+    def _mk(tmp_path, **kw):
+        from seaweedfs_tpu.notification.logqueue import PartitionedLogQueue
+
+        return PartitionedLogQueue(str(tmp_path / "q"), **kw)
+
+    @staticmethod
+    def _event(name: str):
+        from seaweedfs_tpu.pb import filer_pb2 as fpb
+
+        ev = fpb.EventNotification()
+        ev.new_entry.name = name
+        return ev
+
+    def test_poll_commit_resume(self, tmp_path):
+        q = self._mk(tmp_path, partitions=2)
+        for i in range(10):
+            q.send_message(f"/k{i}", self._event(f"e{i}"))
+        assert q.depth("replicate") == 10
+
+        got = q.poll("replicate", max_records=4)
+        assert len(got) == 4
+        high = {}
+        for part, off, key, msg in got:
+            high[part] = off + 1
+        for part, n in high.items():
+            q.commit("replicate", part, n)
+        # the rest, then nothing
+        rest = q.poll("replicate", max_records=100)
+        assert len(rest) == 6
+        seen = {m.new_entry.name for _, _, _, m in got} | {
+            m.new_entry.name for _, _, _, m in rest
+        }
+        assert seen == {f"e{i}" for i in range(10)}
+        for part, off, key, msg in rest:
+            q.commit("replicate", part, off + 1)
+        assert q.poll("replicate") == []
+        assert q.depth("replicate") == 0
+        q.close()
+
+    def test_key_order_within_partition_and_groups_independent(self, tmp_path):
+        q = self._mk(tmp_path, partitions=4)
+        for i in range(6):
+            q.send_message("/same/key", self._event(f"v{i}"))
+        got = q.poll("a", max_records=100)
+        # same key -> same partition, in append order
+        assert len({part for part, *_ in got}) == 1
+        assert [m.new_entry.name for _, _, _, m in got] == [
+            f"v{i}" for i in range(6)
+        ]
+        for part, off, _, _ in got:
+            q.commit("a", part, off + 1)
+        # group b is unaffected by a's commits
+        assert len(q.poll("b", max_records=100)) == 6
+        q.close()
+
+    def test_durable_across_reopen(self, tmp_path):
+        q = self._mk(tmp_path, partitions=2)
+        for i in range(5):
+            q.send_message(f"/k{i}", self._event(f"e{i}"))
+        got = q.poll("g", max_records=2)
+        for part, off, _, _ in got:
+            q.commit("g", part, off + 1)
+        q.close()
+
+        q2 = self._mk(tmp_path, partitions=2)
+        rest = q2.poll("g", max_records=100)
+        assert len(rest) == 3
+        names = {m.new_entry.name for _, _, _, m in got} | {
+            m.new_entry.name for _, _, _, m in rest
+        }
+        assert names == {f"e{i}" for i in range(5)}
+        # producer offsets continue, no overwrite
+        q2.send_message("/k9", self._event("e9"))
+        assert q2.depth("g") == 4
+        q2.close()
+
+    def test_segment_roll_and_trim(self, tmp_path):
+        import os
+
+        q = self._mk(tmp_path, partitions=1, segment_bytes=256)
+        for i in range(30):
+            q.send_message("/k", self._event(f"payload-{i:04d}"))
+        part_dir = tmp_path / "q" / "p000"
+        segs = [n for n in os.listdir(part_dir) if n.endswith(".seg")]
+        assert len(segs) > 1, "segments never rolled at 256B"
+
+        got = q.poll("g", max_records=1000)
+        assert len(got) == 30
+        q.commit("g", 0, 30)
+        removed = q.trim()
+        assert removed >= 1
+        left = [n for n in os.listdir(part_dir) if n.endswith(".seg")]
+        assert len(left) < len(segs)
+        # a new group still starts at its own offset 0 but the data is
+        # gone below the trim point — documented retention-by-consumption
+        q.close()
+
+    def test_corrupt_record_cut(self, tmp_path):
+        import os
+
+        q = self._mk(tmp_path, partitions=1)
+        for i in range(3):
+            q.send_message("/k", self._event(f"e{i}"))
+        q.close()
+        part_dir = tmp_path / "q" / "p000"
+        seg = next(
+            os.path.join(part_dir, n)
+            for n in os.listdir(part_dir)
+            if n.endswith(".seg")
+        )
+        raw = open(seg, "rb").read()
+        with open(seg, "wb") as f:  # flip a byte in the last record
+            f.write(raw[:-2] + bytes([raw[-2] ^ 0xFF]) + raw[-1:])
+        q2 = self._mk(tmp_path, partitions=1)
+        got = q2.poll("g", max_records=100)
+        assert [m.new_entry.name for _, _, _, m in got] == ["e0", "e1"]
+        q2.close()
+
+    def test_configure_builds_logqueue(self, tmp_path):
+        from seaweedfs_tpu.notification.logqueue import PartitionedLogQueue
+        from seaweedfs_tpu.util.config import Configuration
+
+        cfg = Configuration(
+            {
+                "notification": {
+                    "logqueue": {
+                        "enabled": True,
+                        "dir": str(tmp_path / "nq"),
+                        "partitions": "2",
+                    }
+                }
+            }
+        )
+        q = notification.configure(cfg)
+        try:
+            assert isinstance(q, PartitionedLogQueue)
+            assert len(q.partitions) == 2
+        finally:
+            q.close()
+            notification.queue = None
+
+    def test_end_to_end_local_sink(self, two_clusters, tmp_path):
+        """filer events -> logqueue -> consumer-group drain -> LocalSink,
+        via the same loop filer.replicate runs (_consume_logqueue)."""
+        from seaweedfs_tpu.notification.logqueue import PartitionedLogQueue
+        from seaweedfs_tpu.replication.replicate_runner import _consume_logqueue
+
+        src_filer, _, _ = two_clusters
+        qdir = str(tmp_path / "lq")
+        notification.queue = PartitionedLogQueue(qdir, partitions=2)
+        try:
+            req = urllib.request.Request(
+                f"http://{src_filer}/buckets/lq/y.bin",
+                data=b"logqueue-bytes",
+                method="POST",
+            )
+            urllib.request.urlopen(req, timeout=10).close()
+        finally:
+            notification.queue.close()
+            notification.queue = None
+
+        source = FilerSource(src_filer, directory="/buckets")
+        sink = LocalSink(str(tmp_path / "mirror"))
+        lq = PartitionedLogQueue(qdir, partitions=2)
+        _consume_logqueue(
+            lq, Replicator(source, sink), poll_interval=0.05, stop_after_idle=0.3
+        )
+        assert (tmp_path / "mirror/lq/y.bin").read_bytes() == b"logqueue-bytes"
+        assert lq.depth("replicate") == 0
+        lq.close()
+        source.close()
+
+    def test_consumer_sees_segments_rolled_after_open(self, tmp_path):
+        """Regression: the consumer's segment view must track segments
+        rolled (and records appended) by the producer after the
+        consumer instance opened — a long-lived filer.replicate must
+        never stall on a stale snapshot."""
+        producer = self._mk(tmp_path, partitions=1, segment_bytes=128)
+        producer.send_message("/k", self._event("early"))
+        consumer = self._mk(tmp_path, partitions=1, segment_bytes=128)
+        assert len(consumer.poll("g", max_records=100)) == 1
+        consumer.commit("g", 0, 1)
+        # producer keeps writing: tail grows AND new segments roll
+        for i in range(12):
+            producer.send_message("/k", self._event(f"late-{i:02d}"))
+        got = consumer.poll("g", max_records=100)
+        assert [m.new_entry.name for _, _, _, m in got] == [
+            f"late-{i:02d}" for i in range(12)
+        ]
+        assert consumer.depth("g") == 12
+        consumer.close()
+        producer.close()
+
+    def test_partition_count_pinned_by_meta(self, tmp_path):
+        q4 = self._mk(tmp_path, partitions=4)
+        for i in range(8):
+            q4.send_message(f"/k{i}", self._event(f"e{i}"))
+        q4.close()
+        # reopening with a different configured count adopts the
+        # on-disk count instead of stranding p002/p003
+        q2 = self._mk(tmp_path, partitions=2)
+        assert len(q2.partitions) == 4
+        assert len(q2.poll("g", max_records=100)) == 8
+        q2.close()
+
+    def test_poll_fairness_hot_partition(self, tmp_path):
+        q = self._mk(tmp_path, partitions=2)
+        # find keys for each partition
+        from seaweedfs_tpu.notification.logqueue import _partition_of
+
+        k0 = next(f"/a{i}" for i in range(100) if _partition_of(f"/a{i}", 2) == 0)
+        k1 = next(f"/b{i}" for i in range(100) if _partition_of(f"/b{i}", 2) == 1)
+        for i in range(50):
+            q.send_message(k0, self._event(f"hot-{i}"))
+        q.send_message(k1, self._event("cold"))
+        got = q.poll("g", max_records=10)
+        parts = {p for p, *_ in got}
+        assert 1 in parts, "hot partition starved the cold one"
+        assert len(got) == 10, "leftover budget not refilled from the hot partition"
+        q.close()
