@@ -15,7 +15,9 @@ Behavioral match of weed/operation/:
 
 from __future__ import annotations
 
+import http.client
 import json
+import socket
 import threading
 import time
 import urllib.error
@@ -84,16 +86,16 @@ def assign(
     ttl: str = "",
     data_center: str = "",
 ) -> AssignResult:
-    with rpc.dial(grpc_address(master)) as ch:
-        resp = rpc.master_stub(ch).Assign(
-            master_pb2.AssignRequest(
-                count=count,
-                replication=replication,
-                collection=collection,
-                ttl=ttl,
-                data_center=data_center,
-            )
+    ch = rpc.cached_channel(grpc_address(master))
+    resp = rpc.master_stub(ch).Assign(
+        master_pb2.AssignRequest(
+            count=count,
+            replication=replication,
+            collection=collection,
+            ttl=ttl,
+            data_center=data_center,
         )
+    )
     if resp.error:
         raise RuntimeError(f"assign: {resp.error}")
     return AssignResult(
@@ -111,6 +113,95 @@ class UploadResult:
     size: int = 0
     etag: str = ""
     error: str = ""
+
+
+# --- pooled keep-alive HTTP (the Go http.Client role) ----------------
+#
+# urllib.request opens and closes a TCP connection per call; the
+# servers all speak HTTP/1.1 keep-alive, so the data plane's hot path
+# (assign→upload, lookup→download) was paying a handshake plus
+# TIME_WAIT churn per blob. One http.client.HTTPConnection per
+# (thread, host) fixes that — thread-local because HTTPConnection is
+# not thread-safe. A pooled connection can go stale between calls
+# (server restart, idle timeout); one retry on a fresh connection
+# mirrors Go's transport behavior.
+
+_http_pool = threading.local()
+
+
+class _NoDelayHTTPConnection(http.client.HTTPConnection):
+    """HTTPConnection with Nagle off: request headers and body are two
+    small writes; with Nagle on, the body waits ~40 ms for the server's
+    delayed ACK on every pooled request."""
+
+    def connect(self):
+        super().connect()
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, True)
+
+
+def _pooled_conn(netloc: str, timeout: float):
+    conns = getattr(_http_pool, "conns", None)
+    if conns is None:
+        conns = _http_pool.conns = {}
+    c = conns.get(netloc)
+    if c is None:
+        host, _, port = netloc.partition(":")
+        c = _NoDelayHTTPConnection(host, int(port or 80), timeout=timeout)
+        conns[netloc] = c
+    elif c.timeout != timeout:
+        # the pool caches the connection, not the first caller's
+        # deadline: re-arm per call
+        c.timeout = timeout
+        if c.sock is not None:
+            c.sock.settimeout(timeout)
+    return c
+
+
+def _drop_conn(netloc: str) -> None:
+    c = getattr(_http_pool, "conns", {}).pop(netloc, None)
+    if c is not None:
+        c.close()
+
+
+def http_call(
+    method: str,
+    url: str,
+    body: bytes | None = None,
+    headers: dict | None = None,
+    timeout: float = 30.0,
+    max_redirects: int = 3,
+) -> tuple[int, dict, bytes]:
+    """Keep-alive request; returns (status, headers, body). Follows
+    redirects (volume read-redirect 302s). `url` may omit the scheme."""
+
+    if "://" in url:
+        url = url.split("://", 1)[1]
+    for _hop in range(max_redirects + 1):
+        netloc, slash, rest = url.partition("/")
+        path = slash + rest or "/"
+        for attempt in (0, 1):
+            c = _pooled_conn(netloc, timeout)
+            try:
+                c.request(method, path, body=body, headers=headers or {})
+                resp = c.getresponse()
+                data = resp.read()
+                break
+            except (http.client.HTTPException, OSError):
+                _drop_conn(netloc)
+                if attempt:
+                    raise
+        if resp.status in (301, 302, 307, 308):
+            loc = resp.getheader("Location", "")
+            if loc:
+                url = urllib.parse.urljoin(f"http://{url}", loc).split("://", 1)[1]
+                continue
+        if resp.will_close or resp.status >= 400:
+            # >=400: error handlers may reply before draining the
+            # request body, leaving body bytes in the socket — reusing
+            # the connection would parse them as the next request line
+            _drop_conn(netloc)
+        return resp.status, dict(resp.getheaders()), data
+    raise RuntimeError(f"{method} {url}: too many redirects")
 
 
 def upload(
@@ -131,24 +222,26 @@ def upload(
         q["ttl"] = ttl
     if is_chunk_manifest:
         q["cm"] = "true"
-    full = f"http://{url}"
+    full = url
     if q:
         full += ("&" if "?" in full else "?") + urllib.parse.urlencode(q)
-    req = urllib.request.Request(full, data=data, method="POST")
-    req.add_header("Content-Type", mime or "application/octet-stream")
+    headers = {"Content-Type": mime or "application/octet-stream"}
     if jwt:
-        req.add_header("Authorization", f"BEARER {jwt}")
+        headers["Authorization"] = f"BEARER {jwt}"
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            body = json.loads(r.read() or b"{}")
-    except urllib.error.HTTPError as e:
-        try:
-            body = json.loads(e.read() or b"{}")
-        except ValueError:
-            body = {}
-        return UploadResult(error=body.get("error", str(e)))
-    except OSError as e:
+        status, _, raw = http_call("POST", full, body=data, headers=headers, timeout=timeout)
+    except (OSError, http.client.HTTPException, RuntimeError) as e:
+        # urllib wrapped every transport failure as URLError(OSError);
+        # the pooled transport surfaces HTTPException (e.g.
+        # IncompleteRead) and RuntimeError (redirect loop) too — all of
+        # them are "the upload failed", not caller crashes
         return UploadResult(error=str(e))
+    try:
+        body = json.loads(raw or b"{}")
+    except ValueError:
+        body = {}
+    if status >= 300:
+        return UploadResult(error=body.get("error", f"HTTP {status}"))
     if body.get("error"):
         return UploadResult(error=body["error"])
     return UploadResult(
@@ -158,23 +251,29 @@ def upload(
 
 def download(fid_url: str, timeout: float = 30.0) -> tuple[bytes, dict]:
     """GET a blob; returns (bytes, headers)."""
-    with urllib.request.urlopen(f"http://{fid_url}", timeout=timeout) as r:
-        return r.read(), dict(r.headers)
+    status, headers, data = http_call("GET", fid_url, timeout=timeout)
+    if status >= 300:
+        import io
+
+        # keep the server's error body readable via e.read(), like the
+        # urllib HTTPErrors this replaces
+        raise urllib.error.HTTPError(
+            f"http://{fid_url}", status, f"HTTP {status}", headers, io.BytesIO(data)
+        )
+    return data, headers
 
 
 def delete(fid_url: str, timeout: float = 30.0, jwt: str = "") -> None:
     """DELETE a blob. Pass the assign-issued write JWT on signed
     clusters; auth failures raise (a swallowed 401 would silently leak
     the blob), while 404s stay idempotent no-ops."""
-    req = urllib.request.Request(f"http://{fid_url}", method="DELETE")
+    headers = {}
     if jwt:
-        req.add_header("Authorization", f"BEARER {jwt}")
-    try:
-        urllib.request.urlopen(req, timeout=timeout).read()
-    except urllib.error.HTTPError as e:
-        if e.code in (401, 403):
-            raise RuntimeError(f"delete {fid_url}: not authorized ({e.code})")
-        # 404 etc.: delete is idempotent
+        headers["Authorization"] = f"BEARER {jwt}"
+    status, _, _ = http_call("DELETE", fid_url, headers=headers, timeout=timeout)
+    if status in (401, 403):
+        raise RuntimeError(f"delete {fid_url}: not authorized ({status})")
+    # 404 etc.: delete is idempotent
 
 
 # ----------------------------------------------------------------------
@@ -207,10 +306,10 @@ def lookup(master: str, vid: str, collection: str = "") -> LookupResult:
         entry = _lookup_cache.get(key)
         if entry and entry.expires > time.time():
             return entry.result
-    with rpc.dial(grpc_address(master)) as ch:
-        resp = rpc.master_stub(ch).LookupVolume(
-            master_pb2.LookupVolumeRequest(vids=[vid], collection=collection)
-        )
+    ch = rpc.cached_channel(grpc_address(master))
+    resp = rpc.master_stub(ch).LookupVolume(
+        master_pb2.LookupVolumeRequest(vids=[vid], collection=collection)
+    )
     result = LookupResult(vid=vid, error=f"volume {vid} not found")
     for e in resp.vid_locations:
         if e.vid == vid:

@@ -9,6 +9,8 @@ codegen dependency.
 
 from __future__ import annotations
 
+import threading
+
 import grpc
 
 from seaweedfs_tpu.pb import filer_pb2 as f
@@ -38,6 +40,36 @@ def set_tls(tls, server_name_override: str = "") -> None:
     global _TLS, _TLS_SERVER_NAME
     _TLS = tls
     _TLS_SERVER_NAME = server_name_override
+    _reset_channel_cache()  # pooled channels carry the old credentials
+
+
+_CHANNEL_CACHE: dict[str, grpc.Channel] = {}
+_CHANNEL_CACHE_LOCK = threading.Lock()
+
+
+def cached_channel(addr: str) -> grpc.Channel:
+    """Process-wide pooled channel to `addr` (grpc channels are
+    thread-safe and multiplex concurrent RPCs over one HTTP/2
+    connection). The reference pools the same way
+    (operation/grpc_client.go:15-41); dialing per call pays a fresh
+    TCP+HTTP/2 handshake on every assign/lookup. Never close the
+    returned channel — set_tls() invalidates the pool wholesale."""
+    with _CHANNEL_CACHE_LOCK:
+        ch = _CHANNEL_CACHE.get(addr)
+        if ch is None:
+            ch = _CHANNEL_CACHE[addr] = dial(addr)
+        return ch
+
+
+def _reset_channel_cache() -> None:
+    with _CHANNEL_CACHE_LOCK:
+        old = list(_CHANNEL_CACHE.values())
+        _CHANNEL_CACHE.clear()
+    for ch in old:
+        try:
+            ch.close()
+        except Exception:
+            pass
 
 
 def dial(addr: str) -> grpc.Channel:

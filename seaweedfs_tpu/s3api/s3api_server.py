@@ -29,6 +29,8 @@ import urllib.request
 import uuid
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from seaweedfs_tpu.util.httpd import WeedHTTPServer
 from xml.sax.saxutils import escape
 
 import grpc
@@ -164,7 +166,7 @@ class S3ApiServer:
     # lifecycle
     def start(self) -> None:
         handler = self._handler_class()
-        self._http_server = ThreadingHTTPServer((self.host, self.port), handler)
+        self._http_server = WeedHTTPServer((self.host, self.port), handler)
         threading.Thread(
             target=self._http_server.serve_forever, daemon=True, name="s3-http"
         ).start()

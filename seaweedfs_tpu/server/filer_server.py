@@ -20,6 +20,8 @@ import threading
 import time
 from concurrent import futures
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from seaweedfs_tpu.util.httpd import WeedHTTPServer
 from urllib.parse import parse_qs, unquote, urlparse
 
 import grpc
@@ -492,7 +494,7 @@ class FilerServer:
         )
         rpc.add_port(self._grpc_server, f"{self.host}:{self.grpc_port}")
         self._grpc_server.start()
-        self._http_server = ThreadingHTTPServer(
+        self._http_server = WeedHTTPServer(
             (self.host, self.port), self._http_handler_class()
         )
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()

@@ -30,6 +30,8 @@ import threading
 import time
 from concurrent import futures
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from seaweedfs_tpu.util.httpd import WeedHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 import grpc
@@ -803,7 +805,7 @@ class MasterServer:
         if self._raft is not None:
             self._raft.start()
 
-        self._http_server = ThreadingHTTPServer(
+        self._http_server = WeedHTTPServer(
             (self.host, self.port), self._http_handler_class()
         )
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()

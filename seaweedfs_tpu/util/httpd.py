@@ -1,0 +1,30 @@
+"""Shared HTTP server base for all daemons.
+
+http.server.ThreadingHTTPServer defaults to a TCP accept backlog of 5
+(socketserver.TCPServer.request_queue_size). Under a concurrency-16
+load-generator burst (`weed benchmark -c 16`, the reference's headline
+workload, command/benchmark.go:53) the backlog overflows, the kernel
+drops SYNs, and clients stall in 1 s / 3 s retransmission steps — the
+benchmark's p99 showed exactly those ~1 s / ~2 s spikes. The reference
+never hits this because Go's net/http listens with the system's
+somaxconn. A deep backlog plus daemon threads restores that behavior.
+"""
+
+from __future__ import annotations
+
+import socket
+from http.server import ThreadingHTTPServer
+
+
+class WeedHTTPServer(ThreadingHTTPServer):
+    request_queue_size = 256
+    daemon_threads = True
+
+    def get_request(self):
+        # TCP_NODELAY: keep-alive responses are written headers-then-
+        # body; with Nagle on, the body segment waits for the client's
+        # delayed ACK (~40 ms) — the whole data plane flatlines at the
+        # delayed-ACK timer instead of wire speed
+        sock, addr = super().get_request()
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, True)
+        return sock, addr

@@ -20,6 +20,8 @@ import urllib.request
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from seaweedfs_tpu.util.httpd import WeedHTTPServer
+
 import grpc
 
 from seaweedfs_tpu.pb import filer_pb2 as fpb
@@ -81,7 +83,7 @@ class WebDavServer:
             return []
 
     def start(self) -> None:
-        self._http_server = ThreadingHTTPServer(
+        self._http_server = WeedHTTPServer(
             (self.host, self.port), self._handler_class()
         )
         threading.Thread(

@@ -185,3 +185,84 @@ class TestMasterClient:
         mc.current_master = master_addr
         urls = mc.lookup_file_id(ar.fid)
         assert urls
+
+
+class TestPooledHttp:
+    """The keep-alive client transport (operation.http_call): reuse,
+    redirect following, and error-status connection hygiene."""
+
+    @pytest.fixture()
+    def little_server(self):
+        import threading
+        from http.server import BaseHTTPRequestHandler
+
+        from seaweedfs_tpu.util.httpd import WeedHTTPServer
+
+        hits = []
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):
+                hits.append(self.path)
+                if self.path == "/hop":
+                    self.send_response(302)
+                    self.send_header("Location", "/land")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                body = b"ok:" + self.path.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                # reject WITHOUT draining the body — the hostile case
+                self.send_response(401)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        srv = WeedHTTPServer(("127.0.0.1", 0), H)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        yield f"127.0.0.1:{srv.server_address[1]}", hits
+        srv.shutdown()
+
+    def test_redirect_followed(self, little_server):
+        from seaweedfs_tpu.client.operation import http_call
+
+        addr, hits = little_server
+        status, headers, body = http_call("GET", f"{addr}/hop")
+        assert status == 200
+        assert body == b"ok:/land"
+        assert hits == ["/hop", "/land"]
+
+    def test_connection_reused_across_calls(self, little_server):
+        from seaweedfs_tpu.client import operation as op
+
+        addr, hits = little_server
+        op.http_call("GET", f"{addr}/a")
+        conns = getattr(op._http_pool, "conns", {})
+        first = conns.get(addr)
+        assert first is not None
+        op.http_call("GET", f"{addr}/b")
+        assert conns.get(addr) is first, "connection was not reused"
+
+    def test_error_status_drops_pooled_connection(self, little_server):
+        """A 4xx reply may leave an undrained request body on the wire;
+        reusing that connection would parse body bytes as the next
+        request line (manifested as bogus 501s)."""
+        from seaweedfs_tpu.client import operation as op
+
+        addr, hits = little_server
+        status, _, _ = op.http_call("POST", f"{addr}/up", body=b"Z" * 4096)
+        assert status == 401
+        conns = getattr(op._http_pool, "conns", {})
+        assert addr not in conns, "connection kept after error status"
+        # and the next call works on a fresh connection
+        status, _, body = op.http_call("GET", f"{addr}/after")
+        assert status == 200 and body == b"ok:/after"
