@@ -140,6 +140,10 @@ class _NoDelayHTTPConnection(http.client.HTTPConnection):
 
 
 def _pooled_conn(netloc: str, timeout: float):
+    """Returns (connection, reused): reused=True only when an already-
+    established socket came out of the pool — the one case where a
+    send failure means 'idle connection went stale' rather than 'the
+    server is down or slow'."""
     conns = getattr(_http_pool, "conns", None)
     if conns is None:
         conns = _http_pool.conns = {}
@@ -148,13 +152,14 @@ def _pooled_conn(netloc: str, timeout: float):
         host, _, port = netloc.partition(":")
         c = _NoDelayHTTPConnection(host, int(port or 80), timeout=timeout)
         conns[netloc] = c
-    elif c.timeout != timeout:
+        return c, False
+    if c.timeout != timeout:
         # the pool caches the connection, not the first caller's
         # deadline: re-arm per call
         c.timeout = timeout
         if c.sock is not None:
             c.sock.settimeout(timeout)
-    return c
+    return c, c.sock is not None
 
 
 def _drop_conn(netloc: str) -> None:
@@ -175,25 +180,50 @@ def http_call(
     redirects (volume read-redirect 302s). `url` may omit the scheme."""
 
     if "://" in url:
-        url = url.split("://", 1)[1]
+        scheme, _, url = url.partition("://")
+        if scheme != "http":
+            raise ValueError(f"pooled transport is http-only, got {scheme!r}")
+    headers = dict(headers or {})
     for _hop in range(max_redirects + 1):
         netloc, slash, rest = url.partition("/")
         path = slash + rest or "/"
-        for attempt in (0, 1):
-            c = _pooled_conn(netloc, timeout)
+        while True:
+            c, reused = _pooled_conn(netloc, timeout)
             try:
-                c.request(method, path, body=body, headers=headers or {})
+                c.request(method, path, body=body, headers=headers)
                 resp = c.getresponse()
                 data = resp.read()
                 break
-            except (http.client.HTTPException, OSError):
+            except (http.client.HTTPException, OSError) as e:
                 _drop_conn(netloc)
-                if attempt:
-                    raise
-        if resp.status in (301, 302, 307, 308):
+                # Retry exactly the Go-transport case: an idle POOLED
+                # connection that turned out stale. A fresh dial that
+                # fails means the server is down; a timeout means it is
+                # slow — re-sending there doubles the wait and can
+                # double-apply a non-idempotent request.
+                if reused and not isinstance(e, TimeoutError):
+                    continue  # next _pooled_conn dials fresh (sock is gone)
+                raise
+        if resp.status in (301, 302, 303, 307, 308):
             loc = resp.getheader("Location", "")
             if loc:
-                url = urllib.parse.urljoin(f"http://{url}", loc).split("://", 1)[1]
+                target = urllib.parse.urljoin(f"http://{url}", loc)
+                t_scheme, _, t_rest = target.partition("://")
+                if t_scheme != "http":
+                    # never silently downgrade an https redirect target
+                    raise RuntimeError(
+                        f"{method} {url}: redirect to non-http target {loc!r}"
+                    )
+                if t_rest.partition("/")[0] != netloc:
+                    # a redirect that changes host must not carry the
+                    # caller's write JWT to the new host
+                    headers.pop("Authorization", None)
+                if resp.status in (301, 302, 303) and method == "POST":
+                    # urllib/Go both redirect POST as a body-less GET
+                    # for 301/302/303; only 307/308 preserve the method
+                    method, body = "GET", None
+                    headers.pop("Content-Type", None)
+                url = t_rest
                 continue
         if resp.will_close or resp.status >= 400:
             # >=400: error handlers may reply before draining the

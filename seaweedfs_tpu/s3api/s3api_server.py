@@ -29,13 +29,12 @@ import urllib.request
 import uuid
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
-from seaweedfs_tpu.util.httpd import WeedHTTPServer
 from xml.sax.saxutils import escape
 
 import grpc
 
 from seaweedfs_tpu.pb import filer_pb2 as fpb
+from seaweedfs_tpu.util.httpd import WeedHTTPServer
 from seaweedfs_tpu.pb import rpc
 from seaweedfs_tpu.s3api import auth as s3auth
 from seaweedfs_tpu.s3api import chunked_reader

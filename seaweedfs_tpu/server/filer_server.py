@@ -20,8 +20,6 @@ import threading
 import time
 from concurrent import futures
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
-from seaweedfs_tpu.util.httpd import WeedHTTPServer
 from urllib.parse import parse_qs, unquote, urlparse
 
 import grpc
@@ -32,6 +30,7 @@ from seaweedfs_tpu.filer.entry import Attr, Entry, normalize_path
 from seaweedfs_tpu.filer.filer import Filer
 from seaweedfs_tpu.filer.filerstore import EntryNotFound, new_store
 from seaweedfs_tpu.pb import filer_pb2 as fpb
+from seaweedfs_tpu.util.httpd import WeedHTTPServer
 from seaweedfs_tpu.pb import rpc
 
 

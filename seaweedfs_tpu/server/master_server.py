@@ -30,13 +30,12 @@ import threading
 import time
 from concurrent import futures
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
-from seaweedfs_tpu.util.httpd import WeedHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 import grpc
 
 from seaweedfs_tpu.pb import master_pb2 as pb
+from seaweedfs_tpu.util.httpd import WeedHTTPServer
 from seaweedfs_tpu.pb import rpc, volume_pb2
 from seaweedfs_tpu.sequence import MemorySequencer
 from seaweedfs_tpu.storage.file_id import format_needle_id_cookie

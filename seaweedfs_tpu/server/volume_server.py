@@ -30,8 +30,6 @@ import threading
 import time
 from concurrent import futures
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
-from seaweedfs_tpu.util.httpd import WeedHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 import grpc
@@ -39,6 +37,7 @@ import grpc
 from seaweedfs_tpu.ec import ec_files
 from seaweedfs_tpu.ec.ec_volume import EcVolume, NotEnoughShards
 from seaweedfs_tpu.pb import master_pb2, rpc, volume_pb2 as pb
+from seaweedfs_tpu.util.httpd import WeedHTTPServer
 from seaweedfs_tpu.storage.file_id import FileId
 from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.store import Store
@@ -954,18 +953,19 @@ class VolumeServer:
                     return False
 
             def do_GET(self):
-                if urlparse(self.path).path in ("/", "/ui/index.html"):
+                url_path = urlparse(self.path).path
+                if url_path in ("/", "/ui/index.html"):
                     return self._reply(
                         200,
                         server._render_ui().encode(),
                         {"Content-Type": "text/html; charset=utf-8"},
                     )
-                if urlparse(self.path).path == "/status":
+                if url_path == "/status":
                     hb = server.store.collect_heartbeat()
                     return self._json(
                         {"Version": "seaweedfs_tpu", "Volumes": len(hb.volumes)}
                     )
-                if urlparse(self.path).path == "/metrics":
+                if url_path == "/metrics":
                     from seaweedfs_tpu.stats.metrics import DEFAULT_REGISTRY
 
                     body = DEFAULT_REGISTRY.render_text().encode()

@@ -7,7 +7,7 @@ workload, command/benchmark.go:53) the backlog overflows, the kernel
 drops SYNs, and clients stall in 1 s / 3 s retransmission steps — the
 benchmark's p99 showed exactly those ~1 s / ~2 s spikes. The reference
 never hits this because Go's net/http listens with the system's
-somaxconn. A deep backlog plus daemon threads restores that behavior.
+somaxconn; a deep backlog restores that behavior.
 """
 
 from __future__ import annotations
@@ -18,7 +18,6 @@ from http.server import ThreadingHTTPServer
 
 class WeedHTTPServer(ThreadingHTTPServer):
     request_queue_size = 256
-    daemon_threads = True
 
     def get_request(self):
         # TCP_NODELAY: keep-alive responses are written headers-then-

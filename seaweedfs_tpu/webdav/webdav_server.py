@@ -20,11 +20,10 @@ import urllib.request
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from seaweedfs_tpu.util.httpd import WeedHTTPServer
-
 import grpc
 
 from seaweedfs_tpu.pb import filer_pb2 as fpb
+from seaweedfs_tpu.util.httpd import WeedHTTPServer
 from seaweedfs_tpu.pb import rpc
 
 DAV_NS = "DAV:"
