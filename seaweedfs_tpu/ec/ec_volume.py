@@ -36,6 +36,7 @@ from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.needle import Needle, get_actual_size
 from seaweedfs_tpu.storage.needle_map import SortedNeedleMap
 from seaweedfs_tpu.storage.volume import NeedleNotFound, volume_base_name
+from seaweedfs_tpu.util import wlog
 
 # fetch(shard_id, offset, size) -> bytes | None. Returning None means
 # the shard is unavailable everywhere (candidates exhausted).
@@ -44,6 +45,12 @@ ShardFetcher = Callable[[int, int, int], Optional[bytes]]
 
 class NotEnoughShards(RuntimeError):
     pass
+
+
+class ShardTruncated(RuntimeError):
+    """A local shard file is shorter than its nominal length (disk
+    truncation/corruption). Reads treat the shard as lost and
+    reconstruct from the survivors instead of serving zero-fill."""
 
 
 class EcVolumeShard:
@@ -62,8 +69,15 @@ class EcVolumeShard:
     def read_at(self, offset: int, size: int) -> bytes:
         self._f.seek(offset)
         data = self._f.read(size)
-        if len(data) < size:  # zero-padded tail (encode pads with zeros)
-            data += bytes(size - len(data))
+        if len(data) < size:
+            # encode materializes zero padding on disk, so every shard
+            # file spans the full nominal length — a short read means
+            # the file was truncated/corrupted, never legitimate tail
+            raise ShardTruncated(
+                f"shard {self.shard_id} of vid {self.volume_id}: "
+                f"read [{offset}, {offset + size}) past file end "
+                f"({os.path.getsize(self.path)} bytes)"
+            )
         return data
 
     def close(self) -> None:
@@ -156,12 +170,17 @@ class EcVolume:
         return nv.actual_offset, nv.size
 
     def dat_file_size(self) -> int:
-        """Original .dat size derived from any shard's size via the
+        """Original .dat size derived from the shard size via the
         row-count quirk (shard = nLarge·large + nSmall·small; we only
-        need a dat_size that reproduces the same row split)."""
+        need a dat_size that reproduces the same row split).
+
+        Uses the MAX across mounted shards: intact shards all share the
+        nominal length, while a truncated one is shorter — deriving
+        geometry from it would mis-split rows and corrupt the interval
+        mapping for every shard."""
         if not self.shards:
             raise NotEnoughShards("no local shards mounted")
-        shard_size = next(iter(self.shards.values())).size
+        shard_size = max(s.size for s in self.shards.values())
         large, small = locate.LARGE_BLOCK_SIZE, locate.SMALL_BLOCK_SIZE
         n_large = shard_size // large
         n_small = (shard_size - n_large * large) // small
@@ -194,7 +213,16 @@ class EcVolume:
     ) -> bytes:
         shard = self.shards.get(shard_id)
         if shard is not None:
-            return shard.read_at(offset, size)
+            try:
+                return shard.read_at(offset, size)
+            except ShardTruncated as e:
+                # self-heal beyond the reference: quarantine the corrupt
+                # shard (unmount) so this and every later read treats it
+                # exactly like a lost shard — direct remote fetch first,
+                # reconstruction fallback — and its short length can
+                # never poison dat_file_size()'s geometry
+                wlog.warning("ec read: %s; quarantining shard", e)
+                self.unmount_shard(shard_id)
         if fetch is not None:
             data = fetch(shard_id, offset, size)
             if data is not None:
@@ -217,9 +245,14 @@ class EcVolume:
                 continue
             if available >= k:
                 break  # the codec uses the first k survivors only
-            shards[sid] = np.frombuffer(
-                local.read_at(offset, size), dtype=np.uint8
-            )
+            try:
+                shards[sid] = np.frombuffer(
+                    local.read_at(offset, size), dtype=np.uint8
+                )
+            except ShardTruncated as e:
+                wlog.warning("ec rebuild: %s; quarantining shard", e)
+                self.unmount_shard(sid)
+                continue  # a corrupt survivor counts as missing
             available += 1
         missing = [
             sid

@@ -532,3 +532,115 @@ class TestTtlExpiry:
         with _pytest.raises(NeedleNotFound):
             v.read_needle(1, cookie=1)
         v.close()
+
+
+class TestFaultInjection:
+    """Fault-injection coverage the reference lacks (SURVEY §5 notes it
+    has none): disk truncation on EC shards, torn .dat tails, and a
+    random-operation model check of every needle-map implementation."""
+
+    def test_truncated_shard_self_heals_through_reconstruction(
+        self, ec_volume_dir
+    ):
+        tmp_path, payload = ec_volume_dir
+        # The tiny fixture's data lives entirely in shard 0's first
+        # small block (dat < 1 MB row), so truncate BELOW the data
+        # extent — reads in the lost region must reconstruct, not
+        # serve zero-fill (silent corruption) and not fail. Shards
+        # 1-3 are truncated too: they get picked as survivors during
+        # reconstruction and must be detected + skipped there.
+        for s in (0, 1, 2, 3):
+            p = str(tmp_path / "9") + ec_files.to_ext(s)
+            with open(p, "r+b") as f:
+                f.truncate(1024)
+        ev = EcVolume.load(str(tmp_path), 9)
+        for k, data in payload.items():
+            assert ev.read_needle(k).data == data, f"needle {k}"
+        # corrupt shards are quarantined (unmounted) on first detection,
+        # so later reads route through the normal lost-shard path and
+        # dat_file_size() can never derive geometry from a short file
+        assert all(s not in ev.shard_ids() for s in (0, 1, 2, 3))
+        ev.close()
+
+    def test_too_many_truncated_shards_fail_loudly(self, ec_volume_dir):
+        import os
+
+        tmp_path, payload = ec_volume_dir
+        # 5 corrupt shards > 4 parity: unreadable regions must raise
+        # (NotEnoughShards / CorruptNeedle), never return wrong bytes
+        for s in range(5):
+            p = str(tmp_path / "9") + ec_files.to_ext(s)
+            with open(p, "r+b") as f:
+                f.truncate(10)
+        ev = EcVolume.load(str(tmp_path), 9)
+        from seaweedfs_tpu.storage.needle import CorruptNeedle
+
+        failures = 0
+        for k, data in payload.items():
+            try:
+                got = ev.read_needle(k).data
+                assert got == data, f"needle {k}: wrong bytes returned"
+            except (NotEnoughShards, CorruptNeedle):
+                failures += 1
+        assert failures > 0, "truncating 5 shards of a tiny volume hit nothing"
+        ev.close()
+
+    def test_torn_dat_tail_recovers_on_reload(self, tmp_path):
+        """Crash mid-append: bytes landed in .dat with no idx entry.
+        Reload must keep all indexed needles and keep accepting writes."""
+        v = Volume(str(tmp_path), 3)
+        for k in range(1, 6):
+            v.write_needle(make_needle(k, f"payload-{k}".encode()))
+        v.close()
+        with open(tmp_path / "3.dat", "ab") as f:
+            f.write(b"\xde\xad\xbe\xef" * 7)  # torn partial append
+
+        v2 = Volume(str(tmp_path), 3)
+        for k in range(1, 6):
+            assert bytes(v2.read_needle(k).data) == f"payload-{k}".encode()
+        v2.write_needle(make_needle(99, b"after-recovery"))
+        assert bytes(v2.read_needle(99).data) == b"after-recovery"
+        v2.close()
+        # and it survives another reload
+        v3 = Volume(str(tmp_path), 3)
+        assert bytes(v3.read_needle(99).data) == b"after-recovery"
+        v3.close()
+
+    @pytest.mark.parametrize("kind", ["memory", "db"])
+    def test_needle_map_random_ops_match_model(self, tmp_path, kind):
+        """Random put/overwrite/delete stream vs a plain-dict model,
+        including a save/reload cycle mid-stream. (SortedNeedleMap is a
+        read-only view over a sorted file, exercised by the EC tests.)"""
+        from seaweedfs_tpu.storage import needle_map as nm
+
+        rng = random.Random(7)
+
+        def new_map(idx_path):
+            # .load replays the .idx (the crash-recovery path under test)
+            if kind == "db":
+                return nm.DbNeedleMap.load(idx_path)
+            return nm.CompactNeedleMap.load(idx_path)
+
+        idx_path = str(tmp_path / "m.idx")
+        m = new_map(idx_path)
+        model: dict[int, tuple[int, int]] = {}
+        for step in range(800):
+            op_pick = rng.random()
+            key = rng.randint(1, 120)
+            if op_pick < 0.6:
+                off, size = rng.randint(1, 1 << 20), rng.randint(1, 1 << 16)
+                m.put(key, off, size)
+                model[key] = (off, size)
+            elif key in model:
+                m.delete(key, model[key][0])
+                del model[key]
+            if step == 400:  # crash/reload mid-stream
+                m.close()
+                m = new_map(idx_path)
+        for key in range(1, 130):
+            got = m.get(key)
+            if key in model:
+                assert got is not None and (got.offset, got.size) == model[key], key
+            else:
+                assert got is None or got.size == t.TOMBSTONE_FILE_SIZE, key
+        m.close()
