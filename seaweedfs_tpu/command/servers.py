@@ -144,8 +144,8 @@ class VolumeCommand(Command):
             "-ec.codec",
             dest="ec_codec",
             default="",
-            choices=("", "cpu", "tpu"),
-            help="EC codec backend; empty = auto (tpu when a JAX device is present)",
+            choices=("", "cpu", "native", "tpu"),
+            help="EC codec backend; empty = auto (tpu with a JAX device, else native SIMD, else numpy)",
         )
         p.add_argument("-v", type=int, default=0)
 
@@ -332,8 +332,8 @@ class ServerCommand(Command):
             "-ec.codec",
             dest="ec_codec",
             default="",
-            choices=("", "cpu", "tpu"),
-            help="EC codec backend; empty = auto (tpu when a JAX device is present)",
+            choices=("", "cpu", "native", "tpu"),
+            help="EC codec backend; empty = auto (tpu with a JAX device, else native SIMD, else numpy)",
         )
         p.add_argument("-v", type=int, default=0)
 
