@@ -86,8 +86,9 @@ class VolumeServer:
         fix_jpg_orientation: bool = True,
         needle_map_kind: str = "memory",
     ):
-        # `ec.codec` config: "cpu" | "tpu" | "" (auto: tpu when a JAX
-        # device is present). Threaded into every server-side EC code
+        # `ec.codec` config: "cpu" | "native" | "tpu" | "" (auto: tpu
+        # with a JAX device, else the native SIMD shim, else numpy).
+        # Threaded into every server-side EC code
         # path — generate (ec_encoder.go:173 enc.Encode), rebuild, decode
         # back to a volume, and degraded-read reconstruction
         # (store_ec.go:364 enc.ReconstructData).

@@ -76,7 +76,7 @@ class Store:
         needle_map_kind: str = "memory",
     ):
         counts = max_volume_counts or [7] * len(directories)
-        self.ec_backend = ec_backend  # `ec.codec`: cpu | tpu | None=auto
+        self.ec_backend = ec_backend  # `ec.codec`: cpu|native|tpu|None=auto
         self.needle_map_kind = needle_map_kind
         self.locations = [
             DiskLocation(
