@@ -165,7 +165,16 @@ def s3stack(tmp_path_factory):
     )
     vs.start()
     fport = free_port()
-    filer = FilerServer([f"127.0.0.1:{mport}"], port=fport, store="memory", max_mb=1)
+    # lsm store: the S3 suite doubles as an integration soak of the
+    # embedded LSM engine under multipart/list/delete churn (the other
+    # stack fixture below keeps the memory store covered)
+    filer = FilerServer(
+        [f"127.0.0.1:{mport}"],
+        port=fport,
+        store="lsm",
+        store_path=str(tmp_path_factory.mktemp("s3lsm")),
+        max_mb=1,
+    )
     filer.start()
     s3port = free_port()
     s3 = S3ApiServer(filer=f"127.0.0.1:{fport}", port=s3port)
