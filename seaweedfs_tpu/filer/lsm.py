@@ -182,24 +182,33 @@ class _SSTable:
     def iter_range(self, start_key: bytes, end_key: bytes | None = None):
         """Stream records with start_key <= key (< end_key when given).
 
-        Opens a private file handle — the table is immutable, so the
-        iterator needs no lock and callers can consume it lazily (a
-        paginated listing stops after its page instead of materializing
-        the directory's tail)."""
+        Opens a private file handle EAGERLY (before returning the
+        generator): callers invoke this under the store lock, so a
+        concurrent compaction cannot unlink the path before the open —
+        and once open, the fd keeps the unlinked inode readable for the
+        rest of the (lockless, lazy) iteration. The table bytes are
+        immutable, so no further locking is needed; a paginated listing
+        stops after its page instead of materializing the directory's
+        tail."""
         if not self.index:
-            return
-        with open(self.path, "rb") as f:
-            pos = self._seek_offset(start_key)
-            f.seek(pos)
-            while pos < self._data_end:
-                klen, vlen, op = _REC_HDR.unpack(f.read(_REC_HDR.size))
-                k = f.read(klen)
-                v = f.read(vlen)
-                pos += _REC_HDR.size + klen + vlen
-                if end_key is not None and k >= end_key:
-                    return
-                if k >= start_key:
-                    yield k, op, v
+            return iter(())
+        f = open(self.path, "rb")
+
+        def gen():
+            with f:
+                pos = self._seek_offset(start_key)
+                f.seek(pos)
+                while pos < self._data_end:
+                    klen, vlen, op = _REC_HDR.unpack(f.read(_REC_HDR.size))
+                    k = f.read(klen)
+                    v = f.read(vlen)
+                    pos += _REC_HDR.size + klen + vlen
+                    if end_key is not None and k >= end_key:
+                        return
+                    if k >= start_key:
+                        yield k, op, v
+
+        return gen()
 
     def range_from(
         self, start_key: bytes, end_key: bytes | None = None
@@ -389,27 +398,37 @@ class LsmStore(FilerStore):
         # NUL separates dir from name, so dir+0x01 upper-bounds the
         # directory's whole key range
         end = dir_path.encode() + b"\x01"
-        with self._lock:
-            tables = list(self._tables)
-            mem_slice = sorted(
-                (k, (op, v))
-                for k, (op, v) in self._mem.items()
-                if start <= k < end
-            )
-
         # limit-aware k-way merge, newest-wins per key: each source is
         # already sorted; priority = source recency (memtable > newer
         # table > older). Stops as soon as the page is full instead of
         # materializing the directory's tail (tables stream lazily via
         # iter_range; only the memtable — bounded by memtable_bytes —
-        # is snapshotted above).
-        sources = [
-            ((k, -pri, op, v) for k, op, v in t.iter_range(start, end))
-            for pri, t in enumerate(tables)
-        ]
-        sources.append(
-            (k, -(len(tables)), op, v) for k, (op, v) in mem_slice
-        )
+        # is snapshotted here). Sources are BUILT under the lock:
+        # iter_range opens its file handle eagerly, so a concurrent
+        # flush-triggered compaction can't unlink a snapshotted table
+        # out from under the merge.
+        def _table_source(t: _SSTable, pri: int):
+            # explicit binding: a genexp inside the list comprehension
+            # would close over the loop variable and give every source
+            # the LAST priority, letting ties fall to op where
+            # PUT < DEL — i.e. deletes resurrected across tables
+            it = t.iter_range(start, end)  # opens the fd now, under the lock
+            return ((k, -pri, op, v) for k, op, v in it)
+
+        with self._lock:
+            n_tables = len(self._tables)
+            sources = [
+                _table_source(t, pri) for pri, t in enumerate(self._tables)
+            ]
+            sources.append(
+                iter(
+                    sorted(
+                        (k, -n_tables, op, v)
+                        for k, (op, v) in self._mem.items()
+                        if start <= k < end
+                    )
+                )
+            )
         out = []
         current: bytes | None = None
         for k, neg_pri, op, v in heapq.merge(*sources):

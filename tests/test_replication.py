@@ -665,3 +665,61 @@ class TestPartitionedLogQueue:
         assert 1 in parts, "hot partition starved the cold one"
         assert len(got) == 10, "leftover budget not refilled from the hot partition"
         q.close()
+
+    def test_concurrent_producer_and_consumer_threads(self, tmp_path):
+        """One producer thread appending while a consumer thread
+        polls/commits from the same queue object (the filer process's
+        own drain case): at-least-once, no loss, order kept per key."""
+        import threading
+
+        q = self._mk(tmp_path, partitions=2, segment_bytes=512)
+        total = 300
+        got: list = []
+        errors: list = []
+        produced_all = threading.Event()
+
+        def producer():
+            try:
+                for i in range(total):
+                    q.send_message(f"/k{i % 5}", self._event(f"m{i:04d}"))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                produced_all.set()
+
+        def consumer():
+            try:
+                idle = 0
+                # only count idle polls once the producer is done — a
+                # descheduled producer must not end the drain early
+                while idle < 5:
+                    batch = q.poll("g", max_records=32)
+                    if not batch:
+                        if produced_all.is_set():
+                            idle += 1
+                        import time as _t
+
+                        _t.sleep(0.01)
+                        continue
+                    idle = 0
+                    high: dict[int, int] = {}
+                    for part, off, key, msg in batch:
+                        got.append((key, msg.new_entry.name))
+                        high[part] = off + 1
+                    for part, n in high.items():
+                        q.commit("g", part, n)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        tp = threading.Thread(target=producer)
+        tc = threading.Thread(target=consumer)
+        tp.start(); tc.start()
+        tp.join(); tc.join()
+        assert not errors, errors
+        assert len(got) >= total  # at-least-once
+        assert {n for _, n in got} == {f"m{i:04d}" for i in range(total)}
+        # per-key order preserved (same key -> same partition, append order)
+        for k in range(5):
+            names = [n for key, n in got if key == f"/k{k}"]
+            assert names == sorted(names), f"key {k} out of order"
+        q.close()
