@@ -801,3 +801,69 @@ class TestLsmStore:
             "f0002",
         ]
         s.close()
+
+
+class TestChunkAlgebraProperty:
+    """Randomized model check of the chunk algebra (beyond the ported
+    reference table tests): simulate every write into a byte array
+    tagged per position with (mtime, fid, chunk offset), then compare
+    the visible intervals and read views against the simulation."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_overlapping_writes(self, seed):
+        import random as _r
+
+        rng = _r.Random(seed)
+        file_len = rng.randint(50, 400)
+        n_chunks = rng.randint(1, 12)
+        chunks = []
+        # distinct mtimes: the algebra breaks ties by mtime order, and
+        # real appends always have increasing timestamps
+        mtimes = rng.sample(range(1, 10_000), n_chunks)
+        for i in range(n_chunks):
+            off = rng.randint(0, file_len - 1)
+            size = rng.randint(1, file_len - off)
+            chunks.append(C(off, size, f"fid{i}", mtimes[i]))
+
+        # byte-level simulation: later mtime wins per position
+        owner: list[tuple[int, str, int] | None] = [None] * file_len
+        for c in chunks:
+            for p in range(c.offset, min(c.offset + c.size, file_len)):
+                if owner[p] is None or c.mtime > owner[p][0]:
+                    owner[p] = (c.mtime, c.fid, c.offset)
+
+        visible = fc.non_overlapping_visible_intervals(chunks)
+        # 1) intervals are disjoint, sorted, and match ownership
+        prev_stop = -1
+        covered = [None] * file_len
+        for v in visible:
+            assert v.start >= prev_stop, "overlapping/unsorted intervals"
+            prev_stop = v.stop
+            for p in range(v.start, v.stop):
+                assert owner[p] is not None, f"interval over unwritten byte {p}"
+                assert owner[p][1] == v.fid, f"byte {p}: wrong winner"
+                covered[p] = v.fid
+        # 2) every written byte is covered
+        for p in range(file_len):
+            if owner[p] is not None:
+                assert covered[p] == owner[p][1], f"byte {p} uncovered"
+
+        # 3) read views agree with the simulation for random spans.
+        # Reference semantics (ViewFromVisibleIntervals): a read returns
+        # only the CONTIGUOUS run starting at `offset` — the first hole
+        # ends the view list, and a read starting inside a hole returns
+        # nothing.
+        for _ in range(10):
+            off = rng.randint(0, file_len - 1)
+            size = rng.randint(1, file_len - off)
+            views = fc.view_from_chunks(chunks, off, size)
+            seen = {}
+            for view in views:
+                for j in range(view.size):
+                    seen[view.logic_offset + j] = view.fid
+            expect = {}
+            p = off
+            while p < off + size and owner[p] is not None:
+                expect[p] = owner[p][1]
+                p += 1
+            assert seen == expect, f"span [{off},{off + size})"
