@@ -79,6 +79,13 @@ class MasterCommand(Command):
             help="comma-separated master peers incl. self (HA raft cluster)",
         )
         p.add_argument("-mdir", default="", help="raft/meta data directory")
+        p.add_argument(
+            "-nodeTimeout",
+            type=float,
+            default=30.0,
+            help="seconds of heartbeat silence before a volume server is "
+            "declared dead even if its stream stays open (0 disables)",
+        )
         p.add_argument("-cpuprofile", default="", help="dump pstats profile here on exit")
         p.add_argument("-v", type=int, default=0, help="verbosity")
 
@@ -99,6 +106,7 @@ class MasterCommand(Command):
             guard=_load_guard(),
             peers=args.peers or None,
             raft_dir=args.mdir or None,
+            node_timeout=args.nodeTimeout,
         )
         from seaweedfs_tpu.util.profiling import CpuProfile
 

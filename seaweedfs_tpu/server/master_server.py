@@ -43,6 +43,7 @@ from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
 from seaweedfs_tpu.storage.store import EcShardInfo, VolumeInfo
 from seaweedfs_tpu.storage.ttl import TTL
 from seaweedfs_tpu.topology import Topology
+from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.topology.volume_growth import (
     find_empty_slots_for_one_volume,
     find_volume_count,
@@ -76,6 +77,7 @@ class MasterServer:
         peers: str | list | None = None,
         raft_dir: str | None = None,
         vacuum_interval: float = 15 * 60.0,
+        node_timeout: float = 30.0,
         metrics_address: str = "",
         metrics_interval_sec: int = 15,
     ):
@@ -128,6 +130,15 @@ class MasterServer:
         # leader-only periodic garbage-ratio vacuum sweep
         # (master_server.go:126 StartRefreshWritableVolumes); 0 disables
         self.vacuum_interval = vacuum_interval
+        # liveness: unregister nodes silent for this long even if their
+        # heartbeat STREAM never tore down (frozen process, half-open
+        # TCP) — stream teardown alone leaves the master routing writes
+        # to a dead node until kernel keepalive fires; 0 disables
+        self.node_timeout = node_timeout
+        # serializes node-membership transitions: the sweep's multi-step
+        # unregister vs a Heartbeat handler's check-register-sync
+        # sequence (never held across a yield)
+        self._node_lock = threading.Lock()
         self._stop_event = threading.Event()
         # pushed down to volume servers in HeartbeatResponse
         # (master_grpc_server.go:80-84)
@@ -184,6 +195,7 @@ class MasterServer:
     def Heartbeat(self, request_iterator, context):
         dn = None
         stream_token = object()
+        was_detached = False
         try:
             for req in request_iterator:
                 if not self.is_leader:
@@ -195,54 +207,87 @@ class MasterServer:
                         leader=self.leader_address(),
                     )
                     return
-                if dn is None:
-                    dn = self.topology.register_data_node(
-                        ip=req.ip,
-                        port=req.port,
-                        public_url=req.public_url,
-                        data_center=req.data_center or "DefaultDataCenter",
-                        rack=req.rack or "DefaultRack",
-                        max_volumes=req.max_volume_count or 7,
-                    )
-                    # a reconnect takes ownership; the stale stream's
-                    # teardown must not unregister the live node
-                    dn.stream_token = stream_token
-                dn.last_seen = time.time()
-                self.sequencer.set_max(req.max_file_key)
-                if req.volumes or req.has_no_volumes:
-                    new, deleted = self.topology.sync_volumes(
-                        dn, [_vol_info_from_pb(v) for v in req.volumes]
-                    )
-                    if new or deleted:
-                        self._broadcast(
-                            dn.url,
-                            dn.public_url,
-                            [v.id for v in new],
-                            [v.id for v in deleted],
+                # the whole check-register-sync sequence runs under the
+                # node lock so the liveness sweep can't detach the node
+                # between the parent check and the volume sync (which
+                # would re-register volumes onto an orphan the sweep
+                # never sees again); the lock is NOT held across yield
+                with self._node_lock:
+                    if dn is not None and dn.parent is None:
+                        # the liveness sweep declared this node dead
+                        # while the stream stayed open (frozen process
+                        # that woke up): register afresh. Volume state
+                        # repopulates on the node's next full beat
+                        # (every _FULL_HEARTBEAT_EVERY cycles); until
+                        # then the master routes nothing to it.
+                        dn = None
+                        was_detached = True
+                    if dn is None:
+                        dn = self.topology.register_data_node(
+                            ip=req.ip,
+                            port=req.port,
+                            public_url=req.public_url,
+                            data_center=req.data_center or "DefaultDataCenter",
+                            rack=req.rack or "DefaultRack",
+                            max_volumes=req.max_volume_count or 7,
                         )
-                elif req.new_volumes or req.deleted_volumes:
-                    # delta beat: O(changes) registration. Stat changes
-                    # to already-registered volumes update layouts but
-                    # must not spam KeepConnected clients as "new"
-                    new = [_vol_info_from_pb(v) for v in req.new_volumes]
-                    deleted = [_vol_info_from_pb(v) for v in req.deleted_volumes]
-                    truly_new = [v.id for v in new if v.id not in dn.volumes]
-                    self.topology.delta_sync_volumes(dn, new, deleted)
-                    if truly_new or deleted:
-                        self._broadcast(
-                            dn.url,
-                            dn.public_url,
-                            truly_new,
-                            [v.id for v in deleted],
+                        existing = getattr(dn, "stream_token", None)
+                        if (
+                            was_detached
+                            and existing is not None
+                            and existing is not stream_token
+                        ):
+                            # we were swept AND another live stream has
+                            # since registered this node: ours is the
+                            # obsolete one — end it without stealing
+                            # ownership (the finally's token check then
+                            # leaves the live node alone)
+                            return
+                        # a fresh reconnect takes ownership; the stale
+                        # stream's teardown must not unregister the
+                        # live node
+                        dn.stream_token = stream_token
+                    dn.last_seen = time.time()
+                    self.sequencer.set_max(req.max_file_key)
+                    if req.volumes or req.has_no_volumes:
+                        new, deleted = self.topology.sync_volumes(
+                            dn, [_vol_info_from_pb(v) for v in req.volumes]
                         )
-                if req.ec_shards or req.has_no_ec_shards:
-                    self.topology.sync_ec_shards(
-                        dn,
-                        [
-                            EcShardInfo(s.id, s.collection, s.ec_index_bits)
-                            for s in req.ec_shards
-                        ],
-                    )
+                        if new or deleted:
+                            self._broadcast(
+                                dn.url,
+                                dn.public_url,
+                                [v.id for v in new],
+                                [v.id for v in deleted],
+                            )
+                    elif req.new_volumes or req.deleted_volumes:
+                        # delta beat: O(changes) registration. Stat
+                        # changes to already-registered volumes update
+                        # layouts but must not spam KeepConnected
+                        # clients as "new"
+                        new = [_vol_info_from_pb(v) for v in req.new_volumes]
+                        deleted = [
+                            _vol_info_from_pb(v) for v in req.deleted_volumes
+                        ]
+                        truly_new = [
+                            v.id for v in new if v.id not in dn.volumes
+                        ]
+                        self.topology.delta_sync_volumes(dn, new, deleted)
+                        if truly_new or deleted:
+                            self._broadcast(
+                                dn.url,
+                                dn.public_url,
+                                truly_new,
+                                [v.id for v in deleted],
+                            )
+                    if req.ec_shards or req.has_no_ec_shards:
+                        self.topology.sync_ec_shards(
+                            dn,
+                            [
+                                EcShardInfo(s.id, s.collection, s.ec_index_bits)
+                                for s in req.ec_shards
+                            ],
+                        )
                 yield pb.HeartbeatResponse(
                     volume_size_limit=self.topology.volume_size_limit,
                     leader=self.leader_address(),
@@ -250,11 +295,15 @@ class MasterServer:
                     metrics_interval_seconds=self.metrics_interval_sec,
                 )
         finally:
-            if dn is not None and getattr(dn, "stream_token", None) is stream_token:
-                vids = list(dn.volumes)
-                self.topology.unregister_data_node(dn)
-                if vids:
-                    self._broadcast(dn.url, dn.public_url, [], vids)
+            with self._node_lock:
+                if (
+                    dn is not None
+                    and getattr(dn, "stream_token", None) is stream_token
+                ):
+                    vids = list(dn.volumes)
+                    self.topology.unregister_data_node(dn)
+                    if vids:
+                        self._broadcast(dn.url, dn.public_url, [], vids)
 
     def KeepConnected(self, request_iterator, context):
         with self._clients_lock:
@@ -786,6 +835,33 @@ class MasterServer:
                 except Exception:  # noqa: BLE001 - loop must survive
                     pass
 
+    def _liveness_loop(self) -> None:
+        """Sweep out data nodes whose beats stopped arriving without a
+        stream teardown (the stream-break path at Heartbeat's finally
+        covers clean deaths; this covers frozen/half-open ones)."""
+        interval = max(1.0, self.node_timeout / 3)
+        while not self._stop_event.wait(interval):
+            if not self.is_leader:
+                continue
+            now = time.time()
+            for dn in self.topology.data_nodes():
+                with self._node_lock:
+                    if dn.parent is None:  # a teardown beat us to it
+                        continue
+                    if not (
+                        dn.last_seen and now - dn.last_seen > self.node_timeout
+                    ):
+                        continue
+                    wlog.warning(
+                        "master: node %s silent for %.0fs; unregistering",
+                        dn.url,
+                        now - dn.last_seen,
+                    )
+                    vids = list(dn.volumes)
+                    self.topology.unregister_data_node(dn)
+                    if vids:
+                        self._broadcast(dn.url, dn.public_url, [], vids)
+
     def start(self) -> None:
         self._grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         self._grpc_server.add_generic_rpc_handlers(
@@ -810,6 +886,8 @@ class MasterServer:
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
         if self.vacuum_interval > 0:
             threading.Thread(target=self._vacuum_loop, daemon=True).start()
+        if self.node_timeout > 0:
+            threading.Thread(target=self._liveness_loop, daemon=True).start()
 
     def stop(self) -> None:
         self._stop_event.set()

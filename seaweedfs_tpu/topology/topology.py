@@ -152,7 +152,8 @@ class Topology(Node):
         return self.get_layout(v.collection, rp, ttl)
 
     def unregister_data_node(self, dn: DataNode) -> None:
-        """Node lost (heartbeat stream broke, master_grpc_server.go:22)."""
+        """Node lost (heartbeat stream broke, master_grpc_server.go:22,
+        or declared dead by the master's liveness sweep)."""
         for v in dn.volumes.values():
             self._layout_for(v).unregister_volume(v.id, dn)
         for vid in list(dn.ec_shards):
@@ -160,6 +161,10 @@ class Topology(Node):
         rack = dn.parent
         if rack is not None:
             rack.children.pop(dn.id, None)
+        # detachment marker: a Heartbeat handler still holding this
+        # object must re-register instead of mutating an orphan (whose
+        # volumes would re-enter layouts referencing a detached node)
+        dn.parent = None
 
     # --- EC shard registry (topology_ec.go) ---
     def sync_ec_shards(self, dn: DataNode, infos: list[EcShardInfo]) -> None:

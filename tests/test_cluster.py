@@ -627,3 +627,79 @@ class TestStatusUi:
         status, body = http_get(f"http://127.0.0.1:{volume_servers[0].port}/ui/index.html")
         assert status == 200
         assert "Volume Server" in body.decode()
+
+
+class TestNodeLiveness:
+    """The master's liveness sweep: a volume server whose heartbeat
+    STREAM never tears down (frozen process, half-open TCP) must still
+    be unregistered after node_timeout of silence — stream teardown
+    alone leaves writes routed at a dead node until kernel keepalive."""
+
+    def test_silent_node_swept_and_locations_dropped(self):
+        master = MasterServer(
+            port=free_port(), volume_size_limit_mb=64, node_timeout=0.6
+        )
+        master.start()
+        try:
+            dn = master.topology.register_data_node(
+                ip="127.0.0.1", port=65000, max_volumes=10
+            )
+            from seaweedfs_tpu.storage.store import VolumeInfo
+
+            master.topology.sync_volumes(
+                dn,
+                [VolumeInfo(id=5, size=0, collection="", file_count=1,
+                            delete_count=0, deleted_byte_count=0,
+                            read_only=False, replica_placement=0,
+                            version=3, ttl=0)],
+            )
+            assert master.topology.lookup("", 5), "volume 5 should be locatable"
+            dn.last_seen = time.time() - 10  # silent for much longer than 0.6s
+
+            deadline = time.time() + 10
+            while time.time() < deadline and master.topology.data_nodes():
+                time.sleep(0.05)
+            assert not master.topology.data_nodes(), "silent node never swept"
+            assert not master.topology.lookup("", 5), "stale location still served"
+        finally:
+            master.stop()
+
+    def test_swept_node_reregisters_on_next_beat(self, tmp_path):
+        """A frozen-then-woken server keeps its old stream: the
+        Heartbeat loop must notice the sweep detached its node object
+        and register afresh instead of mutating an orphan."""
+        master = MasterServer(
+            port=free_port(), volume_size_limit_mb=64, node_timeout=0
+        )
+        master.start()
+        try:
+            dn = master.topology.register_data_node(
+                ip="127.0.0.1", port=65001, max_volumes=10
+            )
+            master.topology.unregister_data_node(dn)  # what the sweep does
+            assert dn.parent is None, "unregister must mark detachment"
+
+            # the live-stream path registers a fresh node on the next beat
+            vs = VolumeServer(
+                [str(tmp_path)],
+                port=free_port(),
+                master=f"127.0.0.1:{master.port}",
+                heartbeat_interval=0.1,
+                max_volume_counts=[10],
+            )
+            vs.start()
+            try:
+                deadline = time.time() + 15
+                while time.time() < deadline:
+                    nodes = master.topology.data_nodes()
+                    if any(n.port == vs.port for n in nodes):
+                        break
+                    time.sleep(0.05)
+                assert any(
+                    n.port == vs.port and n.parent is not None
+                    for n in master.topology.data_nodes()
+                )
+            finally:
+                vs.stop()
+        finally:
+            master.stop()
