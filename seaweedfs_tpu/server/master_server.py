@@ -196,6 +196,7 @@ class MasterServer:
         dn = None
         stream_token = object()
         was_detached = False
+        need_full = False  # ask the node to resend its full inventory
         try:
             for req in request_iterator:
                 if not self.is_leader:
@@ -247,6 +248,11 @@ class MasterServer:
                         # stream's teardown must not unregister the
                         # live node
                         dn.stream_token = stream_token
+                        if was_detached:
+                            # we registered a blank node mid-stream: the
+                            # node's delta beats are useless until it
+                            # resends the full inventory
+                            need_full = True
                     dn.last_seen = time.time()
                     self.sequencer.set_max(req.max_file_key)
                     if req.volumes or req.has_no_volumes:
@@ -288,11 +294,14 @@ class MasterServer:
                                 for s in req.ec_shards
                             ],
                         )
+                    if need_full and (req.volumes or req.has_no_volumes):
+                        need_full = False  # full inventory received
                 yield pb.HeartbeatResponse(
                     volume_size_limit=self.topology.volume_size_limit,
                     leader=self.leader_address(),
                     metrics_address=self.metrics_address,
                     metrics_interval_seconds=self.metrics_interval_sec,
+                    request_full_heartbeat=need_full,
                 )
         finally:
             with self._node_lock:

@@ -125,6 +125,7 @@ class VolumeServer:
         self.fix_jpg_orientation = fix_jpg_orientation
         self.volume_size_limit = 30 * 1024 * 1024 * 1024
         self._stop = threading.Event()
+        self._force_full_heartbeat = threading.Event()
         self._grpc_server: grpc.Server | None = None
         self._http_server: ThreadingHTTPServer | None = None
         self._hb_thread: threading.Thread | None = None
@@ -196,6 +197,11 @@ class VolumeServer:
         last_full_infos: dict[int, object] = {}
         beat = 0
         while not self._stop.is_set():
+            if self._force_full_heartbeat.is_set():
+                # master asked for the full inventory (it lost our
+                # state to a liveness sweep or a leader change)
+                self._force_full_heartbeat.clear()
+                last_vids = None
             hb = self.store.collect_heartbeat()
             req = master_pb2.HeartbeatRequest(
                 ip=self.host,
@@ -251,6 +257,8 @@ class VolumeServer:
                     for resp in stub.Heartbeat(self._heartbeat_requests()):
                         if resp.volume_size_limit:
                             self.volume_size_limit = resp.volume_size_limit
+                        if resp.request_full_heartbeat:
+                            self._force_full_heartbeat.set()
                         if resp.metrics_address:
                             # master ships the pushgateway config in the
                             # heartbeat response (master_grpc_server.go:80);

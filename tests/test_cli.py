@@ -21,6 +21,57 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def spawn_cli(*args):
+    """A real `python -m seaweedfs_tpu ...` subprocess (cpu-forced jax)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", WEED_EC_CODEC="cpu")
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            "import jax; jax.config.update('jax_platforms', 'cpu');"
+            "from seaweedfs_tpu.__main__ import main; main()",
+            *args,
+        ],
+        env=env,
+        cwd="/root/repo",
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT,
+    )
+
+
+def wait_until(pred, what, deadline_s=40):
+    """Poll pred() (exceptions count as not-ready) until truthy; returns
+    the elapsed seconds. Raises RuntimeError on timeout."""
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        try:
+            if pred():
+                return time.time() - t0
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise RuntimeError(f"timed out waiting for {what}")
+
+
+def reap(procs):
+    """SIGCONT (in case of SIGSTOP tests) then kill+wait each process."""
+    import signal
+
+    for p in procs:
+        try:
+            p.send_signal(signal.SIGCONT)
+        except OSError:
+            pass
+        try:
+            p.kill()
+            p.wait(timeout=10)
+        except OSError:
+            pass
+
+
 class TestOfflineTools:
     def _make_volume(self, tmp_path, vid=7):
         vol = Volume(str(tmp_path), vid)
@@ -382,47 +433,14 @@ class TestCrashRecovery:
     survive (appends flush to the OS per write; .idx tail is validated
     against .dat on load) and the node must rejoin the master."""
 
-    @staticmethod
-    def _spawn(*args):
-        import subprocess
-        import sys
-
-        env = dict(os.environ, JAX_PLATFORMS="cpu", WEED_EC_CODEC="cpu")
-        return subprocess.Popen(
-            [
-                sys.executable,
-                "-c",
-                "import jax; jax.config.update('jax_platforms', 'cpu');"
-                "from seaweedfs_tpu.__main__ import main; main()",
-                *args,
-            ],
-            env=env,
-            cwd="/root/repo",
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.STDOUT,
-        )
-
     def test_sigkill_volume_server_and_restart(self, tmp_path):
         import signal
-        import urllib.error
         import urllib.request
 
         def http(url, data=None, method="GET", timeout=5):
             req = urllib.request.Request(url, data=data, method=method)
             with urllib.request.urlopen(req, timeout=timeout) as r:
                 return r.read()
-
-        def wait_until(fn, what, deadline_s=30):
-            deadline = time.time() + deadline_s
-            while time.time() < deadline:
-                try:
-                    out = fn()
-                    if out is not None:
-                        return out
-                except Exception:
-                    pass
-                time.sleep(0.3)
-            raise RuntimeError(f"timed out waiting for {what}")
 
         def assign():
             a = json.loads(http(f"http://127.0.0.1:{mport}/dir/assign"))
@@ -431,12 +449,12 @@ class TestCrashRecovery:
         mport, vport = free_port(), free_port()
         vol_dir = tmp_path / "vol"
         vol_dir.mkdir()
-        procs = [self._spawn("master", "-port", str(mport))]
+        procs = [spawn_cli("master", "-port", str(mport))]
         try:
             wait_until(
                 lambda: http(f"http://127.0.0.1:{mport}/cluster/status"), "master"
             )
-            volume = self._spawn(
+            volume = spawn_cli(
                 "volume", "-port", str(vport), "-dir", str(vol_dir),
                 "-mserver", f"127.0.0.1:{mport}",
             )
@@ -445,17 +463,18 @@ class TestCrashRecovery:
 
             blobs = {}
             for i in range(20):
-                a = wait_until(assign, "assign")
+                wait_until(assign, "assign")
+                a = assign()
                 payload = f"crash-survivor-{i:03d}".encode() * 10
                 http(f"http://{a['url']}/{a['fid']}", data=payload, method="POST")
                 blobs[a["fid"]] = payload
-            known_fid, known_payload = next(iter(blobs.items()))
+            known_fid = next(iter(blobs))
 
             volume.send_signal(signal.SIGKILL)  # hard crash, no cleanup
             volume.wait(timeout=10)
 
             procs.append(
-                self._spawn(
+                spawn_cli(
                     "volume", "-port", str(vport), "-dir", str(vol_dir),
                     "-mserver", f"127.0.0.1:{mport}",
                 )
@@ -471,13 +490,81 @@ class TestCrashRecovery:
             for fid, payload in blobs.items():
                 assert http(f"http://127.0.0.1:{vport}/{fid}") == payload, fid
             # and it still accepts writes
-            a = wait_until(assign, "post-restart assign")
+            wait_until(assign, "post-restart assign")
+            a = assign()
             http(f"http://{a['url']}/{a['fid']}", data=b"post-crash", method="POST")
             assert http(f"http://127.0.0.1:{vport}/{a['fid']}") == b"post-crash"
         finally:
-            for p in procs:
+            reap(procs)
+
+
+class TestLivenessSweep:
+    """End-to-end master liveness: SIGSTOP a volume-server subprocess
+    (stream stays open, beats stop) → master sweeps it and drops its
+    volume locations; SIGCONT → the woken node re-registers AND its
+    volumes reappear promptly (the master requests a full heartbeat
+    instead of waiting ~10 delta cycles)."""
+
+    def test_sigstop_sweep_sigcont_recover(self, tmp_path):
+        import signal
+        import urllib.error
+        import urllib.request
+
+        def http_json(url, timeout=2):
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                return json.loads(r.read())
+
+        mport, vport = free_port(), free_port()
+        vol_dir = tmp_path / "vol"
+        vol_dir.mkdir()
+        procs = [spawn_cli("master", "-port", str(mport), "-nodeTimeout", "3")]
+        try:
+            wait_until(
+                lambda: http_json(f"http://127.0.0.1:{mport}/cluster/status"),
+                "master",
+            )
+            volume = spawn_cli(
+                "volume", "-port", str(vport), "-dir", str(vol_dir),
+                "-mserver", f"127.0.0.1:{mport}",
+            )
+            procs.append(volume)
+
+            def assign():
+                a = http_json(f"http://127.0.0.1:{mport}/dir/assign")
+                return None if a.get("error") else a
+
+            wait_until(assign, "writable")
+            a = assign()
+            vid = a["fid"].split(",")[0]
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://{a['url']}/{a['fid']}", data=b"sweep-me", method="POST"
+                ),
+                timeout=5,
+            ).close()
+
+            def located():
                 try:
-                    p.kill()
-                    p.wait(timeout=10)
-                except OSError:
-                    pass
+                    out = http_json(
+                        f"http://127.0.0.1:{mport}/dir/lookup?volumeId={vid}"
+                    )
+                except urllib.error.HTTPError:
+                    return False  # 404: not located (the swept state)
+                return bool(out.get("locations"))
+
+            assert located()
+            volume.send_signal(signal.SIGSTOP)  # freeze: stream survives
+            wait_until(lambda: not located(), "volume swept", 30)
+
+            volume.send_signal(signal.SIGCONT)
+            dt = wait_until(located, "volume re-announced", 30)
+            # the requested full beat re-announces within ~2 beat
+            # intervals (2s each); without it the delta protocol would
+            # wait for the 10-cycle full beat (~20s)
+            assert dt < 15, "re-announcement took a full-cycle wait"
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{vport}/{a['fid']}", timeout=5
+            ) as r:
+                assert r.read() == b"sweep-me"
+        finally:
+            reap(procs)
