@@ -371,3 +371,33 @@ class TestNativeBackend:
         monkeypatch.delenv("WEED_EC_CODEC", raising=False)
         monkeypatch.setattr(codec, "_default_backend", "")
         assert codec.default_backend() == "native"
+
+    def test_thread_safety_parallel_calls(self, nat):
+        """Server handler threads run EC ops concurrently; the shim's
+        tables are read-only after dlopen and every call writes only
+        its own output — N threads hammering apply_matrix must all get
+        byte-identical results (ctypes releases the GIL, so the C code
+        really runs in parallel)."""
+        import threading
+
+        from seaweedfs_tpu.ec.codec import cpu_apply_matrix
+
+        rng = np.random.default_rng(11)
+        matrix = rng.integers(0, 256, (4, 10), dtype=np.uint8)
+        data = rng.integers(0, 256, (10, 1 << 18), dtype=np.uint8)
+        want = cpu_apply_matrix(matrix, data)
+        errors = []
+
+        def worker():
+            try:
+                for _ in range(8):
+                    np.testing.assert_array_equal(nat(matrix, data), want)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:1]
