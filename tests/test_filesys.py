@@ -211,3 +211,63 @@ class TestMountedFileSystem:
 
         with pytest.raises(NotFound):
             mounted.open("/nope.txt", "r")
+
+
+class TestMountConcurrency:
+    """Concurrent writers/readers through the in-process mount: each
+    thread owns its own files (FUSE guarantees per-handle ordering, not
+    cross-file atomicity) and every byte must survive the dirty-page →
+    flush → chunk pipeline; one thread re-reads flushed files while
+    others are still dirtying theirs."""
+
+    def test_parallel_writers_and_reader(self, mounted):
+        import threading
+
+        fs = mounted
+        errors: list = []
+        payloads: dict[str, bytes] = {}
+        lock = threading.Lock()
+
+        def writer(wid: int):
+            try:
+                import random
+
+                rng = random.Random(wid)
+                for i in range(8):
+                    path = f"/stress/w{wid}/f{i}.bin"
+                    # multi-write files: exercises interval merging
+                    parts = [
+                        bytes(rng.randbytes(rng.randint(100, 60_000)))
+                        for _ in range(3)
+                    ]
+                    with fs.open(path, "w") as f:
+                        for p in parts:
+                            f.write(p)
+                    with lock:
+                        payloads[path] = b"".join(parts)
+            except Exception as e:  # noqa: BLE001
+                errors.append(("w", wid, e))
+
+        def reader():
+            try:
+                for _ in range(40):
+                    with lock:
+                        items = list(payloads.items())[:5]
+                    for path, want in items:
+                        assert fs.read_file(path) == want, path
+            except Exception as e:  # noqa: BLE001
+                errors.append(("r", e))
+
+        threads = [threading.Thread(target=writer, args=(w,)) for w in range(4)]
+        threads.append(threading.Thread(target=reader))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:2]
+        for path, want in payloads.items():
+            assert fs.read_file(path) == want, path
+        # and the namespace agrees
+        for wid in range(4):
+            names = sorted(fs.listdir(f"/stress/w{wid}"))
+            assert names == [f"f{i}.bin" for i in range(8)]
