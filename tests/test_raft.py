@@ -414,3 +414,70 @@ class TestRaftLogRepair:
             )
         )
         assert resp.vote_granted
+
+
+class TestStaleLeaderStepsDown:
+    """Partition-heal at the handler level: a leader isolated during a
+    new election must step down the moment it hears a higher term —
+    from either RPC — and a stale candidate must not split the new
+    leader's cluster."""
+
+    def _node(self, tmp_path):
+        return RaftNode(
+            "127.0.0.1:19333",
+            ["127.0.0.1:19333", "127.0.0.1:19334", "127.0.0.1:19335"],
+            lambda cmd: None,
+            data_dir=str(tmp_path),
+        )
+
+    def test_leader_steps_down_on_higher_term_append(self, tmp_path):
+        from seaweedfs_tpu.cluster.raft import FOLLOWER, LEADER
+        from seaweedfs_tpu.pb import raft_pb2 as rpb
+
+        n = self._node(tmp_path)
+        n.current_term = 2
+        n.role = LEADER
+        resp = n.AppendEntries(
+            rpb.AppendEntriesRequest(
+                term=3, leader_id="127.0.0.1:19334",
+                prev_log_index=0, prev_log_term=0,
+            )
+        )
+        assert resp.success
+        assert n.role == FOLLOWER
+        assert n.current_term == 3
+        assert n.leader_id == "127.0.0.1:19334"
+
+    def test_leader_steps_down_on_higher_term_vote(self, tmp_path):
+        from seaweedfs_tpu.cluster.raft import FOLLOWER, LEADER
+        from seaweedfs_tpu.pb import raft_pb2 as rpb
+
+        n = self._node(tmp_path)
+        n.current_term = 2
+        n.role = LEADER
+        resp = n.RequestVote(
+            rpb.RequestVoteRequest(
+                term=3, candidate_id="127.0.0.1:19335",
+                last_log_index=0, last_log_term=0,
+            )
+        )
+        assert n.role == FOLLOWER
+        assert n.current_term == 3
+        assert resp.vote_granted  # our log is empty too: candidate is current
+
+    def test_stale_candidate_cannot_disrupt_newer_term(self, tmp_path):
+        """A node returning from a partition with an old term must get
+        term=current back and no vote (it then becomes a follower of
+        the real leader instead of forcing a re-election)."""
+        from seaweedfs_tpu.pb import raft_pb2 as rpb
+
+        n = self._node(tmp_path)
+        n.current_term = 5
+        resp = n.RequestVote(
+            rpb.RequestVoteRequest(
+                term=3, candidate_id="127.0.0.1:19334",
+                last_log_index=9, last_log_term=3,
+            )
+        )
+        assert not resp.vote_granted
+        assert resp.term == 5
