@@ -193,7 +193,21 @@ def req(url, method="GET", data=None, headers=None):
     r = urllib.request.Request(url, data=data, method=method)
     for k, v in (headers or {}).items():
         r.add_header(k, v)
-    return urllib.request.urlopen(r, timeout=15)
+    # one retry on a transport-level drop (full-suite thread/fd pressure
+    # can surface as RemoteDisconnected on this 1-vCPU rig) — real S3
+    # clients retry these; HTTP-status errors still raise immediately
+    import http.client
+
+    try:
+        return urllib.request.urlopen(r, timeout=15)
+    except (http.client.RemoteDisconnected, ConnectionResetError):
+        return urllib.request.urlopen(r, timeout=15)
+    except urllib.error.URLError as e:
+        if isinstance(
+            e.reason, (http.client.RemoteDisconnected, ConnectionResetError)
+        ):
+            return urllib.request.urlopen(r, timeout=15)
+        raise
 
 
 def xml_of(body: bytes) -> ET.Element:
