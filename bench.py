@@ -1,8 +1,10 @@
 """RS(10,4) erasure-codec throughput on one TPU chip.
 
-Default config prints ONE JSON line:
+With no argument, runs the WHOLE BASELINE matrix (encode, rebuild,
+batch, decode4, stream), printing one JSON line per config, e.g.:
   {"metric": "ec_encode_rs10_4", "value": <GB/s>, "unit": "GB/s",
    "vs_baseline": <value / 40.0>}
+A single config name as argv[1] runs just that config.
 
 value   = data bytes erasure-coded per second (the bytes of the sealed
           volume stream, i.e. the 10 data shards — same accounting as
@@ -331,21 +333,35 @@ def bench_stream() -> None:
     _report("ec_encode_stream_e2e", gbps, "GB/s", gbps / cpu_gbps)
 
 
+CONFIGS = {
+    "encode": bench_encode,
+    "rebuild": bench_rebuild,
+    "batch": bench_batch,
+    "decode4": bench_decode4,
+    "stream": bench_stream,
+}
+
+
 def main() -> None:
-    config = sys.argv[1] if len(sys.argv) > 1 else "encode"
-    if config == "encode":
-        bench_encode()
-    elif config == "rebuild":
-        bench_rebuild()
-    elif config == "batch":
-        bench_batch()
-    elif config == "decode4":
-        bench_decode4()
-    elif config == "stream":
-        bench_stream()
+    config = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if config == "all":
+        # The driver records whatever this prints: run the whole
+        # BASELINE matrix, one JSON line per config. A config that
+        # fails must not silence the rest.
+        failures = []
+        for name, fn in CONFIGS.items():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 - report and continue
+                failures.append(name)
+                print(json.dumps({"metric": name, "error": str(e)[:200]}))
+        if failures:
+            raise SystemExit(f"bench configs failed: {failures}")
+    elif config in CONFIGS:
+        CONFIGS[config]()
     else:
         raise SystemExit(
-            f"unknown bench config {config!r} (encode|rebuild|batch|decode4|stream)"
+            f"unknown bench config {config!r} (all|{'|'.join(CONFIGS)})"
         )
 
 

@@ -187,10 +187,13 @@ def http_call(
     for _hop in range(max_redirects + 1):
         netloc, slash, rest = url.partition("/")
         path = slash + rest or "/"
+        idempotent = method in ("GET", "HEAD", "PUT", "DELETE", "OPTIONS")
         while True:
             c, reused = _pooled_conn(netloc, timeout)
+            sent = False
             try:
                 c.request(method, path, body=body, headers=headers)
+                sent = True
                 resp = c.getresponse()
                 data = resp.read()
                 break
@@ -200,8 +203,16 @@ def http_call(
                 # connection that turned out stale. A fresh dial that
                 # fails means the server is down; a timeout means it is
                 # slow — re-sending there doubles the wait and can
-                # double-apply a non-idempotent request.
-                if reused and not isinstance(e, TimeoutError):
+                # double-apply a non-idempotent request. And once the
+                # request went out in full (`sent`), the server may have
+                # processed it even though the response never arrived —
+                # replaying is only safe for idempotent methods (a POST
+                # replayed there double-applies).
+                if (
+                    reused
+                    and not isinstance(e, TimeoutError)
+                    and (idempotent or not sent)
+                ):
                     continue  # next _pooled_conn dials fresh (sock is gone)
                 raise
         if resp.status in (301, 302, 303, 307, 308):

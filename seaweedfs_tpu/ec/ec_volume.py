@@ -67,8 +67,19 @@ class EcVolumeShard:
         self.size = os.path.getsize(self.path)
 
     def read_at(self, offset: int, size: int) -> bytes:
-        self._f.seek(offset)
-        data = self._f.read(size)
+        try:
+            # pread is positionless: concurrent handler threads share
+            # this fd safely (seek+read would interleave positions and
+            # misread healthy shards under the ThreadingHTTPServer)
+            data = os.pread(self._f.fileno(), size, offset)
+        except (OSError, ValueError):
+            # fd closed by a concurrent quarantine/unmount — from this
+            # reader's view the shard is gone; treat it as lost so the
+            # caller falls through to remote fetch / reconstruction
+            raise ShardTruncated(
+                f"shard {self.shard_id} of vid {self.volume_id}: "
+                f"closed during read [{offset}, {offset + size})"
+            ) from None
         if len(data) < size:
             # encode materializes zero padding on disk, so every shard
             # file spans the full nominal length — a short read means
@@ -114,6 +125,9 @@ class EcVolume:
         self.shard_locations: dict[int, list[str]] = {}
         self.shard_locations_lock = threading.Lock()
         self.shard_locations_refresh_time = 0.0
+        # serializes quarantine decisions so only one thread verifies
+        # and unmounts a suspect shard
+        self._quarantine_lock = threading.Lock()
 
     # --- mounting (disk_location_ec.go) ---
     @classmethod
@@ -140,9 +154,13 @@ class EcVolume:
             )
 
     def unmount_shard(self, shard_id: int) -> None:
-        shard = self.shards.pop(shard_id, None)
-        if shard:
-            shard.close()
+        # deliberately does NOT close the shard's fd: handler threads
+        # may hold a reference and be mid-pread — closing here would at
+        # best EBADF them and at worst recycle the fd number into an
+        # unrelated open() whose bytes pread would then silently serve
+        # as shard data. The file object closes when the last reference
+        # (this dict's or a reader's local) is dropped.
+        self.shards.pop(shard_id, None)
 
     def shard_ids(self) -> list[int]:
         return sorted(self.shards)
@@ -208,6 +226,41 @@ class EcVolume:
             out += self._read_interval(shard_id, shard_off, iv.size, fetch)
         return bytes(out)
 
+    def _quarantine_if_truncated(self, shard_id: int) -> bool:
+        """Unmount a suspect shard only after re-verifying the on-disk
+        file really is shorter than its nominal length (a short pread
+        can also mean the fd was closed under us, or a racing replace).
+        Serialized so concurrent failing readers don't double-close.
+        Returns True when the shard is quarantined (or already gone)."""
+        with self._quarantine_lock:
+            shard = self.shards.get(shard_id)
+            if shard is None:
+                return True  # another thread already quarantined it
+            try:
+                actual = os.path.getsize(shard.path)
+            except OSError:
+                actual = -1  # file vanished: certainly not servable
+            # nominal length comes from the siblings (every intact shard
+            # of a volume shares it — the dat_file_size derivation), not
+            # from this shard's own mount-time size: a shard mounted
+            # already-truncated would otherwise equal its own "nominal"
+            # and never be evicted
+            nominal = max(s.size for s in self.shards.values())
+            if actual < nominal:
+                # self-heal beyond the reference: quarantine the corrupt
+                # shard (unmount) so this and every later read treats it
+                # exactly like a lost shard — direct remote fetch first,
+                # reconstruction fallback — and its short length can
+                # never poison dat_file_size()'s geometry
+                wlog.warning(
+                    "ec read: shard %d of vid %d is %d bytes, nominal %d; "
+                    "quarantining",
+                    shard_id, self.volume_id, actual, nominal,
+                )
+                self.unmount_shard(shard_id)
+                return True
+            return False
+
     def _read_interval(
         self, shard_id: int, offset: int, size: int, fetch: ShardFetcher | None
     ) -> bytes:
@@ -216,13 +269,20 @@ class EcVolume:
             try:
                 return shard.read_at(offset, size)
             except ShardTruncated as e:
-                # self-heal beyond the reference: quarantine the corrupt
-                # shard (unmount) so this and every later read treats it
-                # exactly like a lost shard — direct remote fetch first,
-                # reconstruction fallback — and its short length can
-                # never poison dat_file_size()'s geometry
-                wlog.warning("ec read: %s; quarantining shard", e)
-                self.unmount_shard(shard_id)
+                if not self._quarantine_if_truncated(shard_id):
+                    # healthy full-size file: the failure was transient
+                    # (racing close+remount, or interleaved replace) —
+                    # one retry against the current mount
+                    cur = self.shards.get(shard_id)
+                    if cur is not None:
+                        try:
+                            return cur.read_at(offset, size)
+                        except ShardTruncated:
+                            # still verify before evicting: a second
+                            # transient race must not permanently
+                            # quarantine a healthy on-disk shard
+                            self._quarantine_if_truncated(shard_id)
+                wlog.warning("ec read: %s; falling back to recovery", e)
         if fetch is not None:
             data = fetch(shard_id, offset, size)
             if data is not None:
@@ -250,8 +310,8 @@ class EcVolume:
                     local.read_at(offset, size), dtype=np.uint8
                 )
             except ShardTruncated as e:
-                wlog.warning("ec rebuild: %s; quarantining shard", e)
-                self.unmount_shard(sid)
+                wlog.warning("ec rebuild: %s", e)
+                self._quarantine_if_truncated(sid)
                 continue  # a corrupt survivor counts as missing
             available += 1
         missing = [

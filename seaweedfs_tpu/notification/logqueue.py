@@ -167,6 +167,27 @@ class _Partition:
         except (OSError, ValueError):
             return 0
 
+    def register(self, group: str) -> None:
+        """Materialize a zero offset for a group that has never
+        committed, so trim()'s low-water mark accounts for it from its
+        first poll — otherwise its unread segments could be deleted out
+        from under it by groups that are further ahead. (A group that
+        has never even polled still starts at the oldest retained
+        segment, Kafka-style retention-by-consumption.)
+
+        For an existing offset file this refreshes its mtime: polling
+        is the liveness signal trim()'s staleness cutoff reads, so an
+        abandoned group (one-off diagnostic poll, decommissioned
+        consumer) stops pinning retention once it goes quiet."""
+        p = self._offset_path(group)
+        if os.path.exists(p):
+            try:
+                os.utime(p)
+            except OSError:
+                pass
+        else:
+            self.commit(group, 0)
+
     def commit(self, group: str, offset: int) -> None:
         p = self._offset_path(group)
         tmp = p + ".tmp"
@@ -179,13 +200,29 @@ class _Partition:
     def groups(self) -> list[str]:
         return os.listdir(os.path.join(self.dir, "offsets"))
 
-    def trim(self) -> int:
-        """Delete whole segments every group has consumed. Returns the
-        number of segments removed. Never removes the active segment."""
+    def trim(self, stale_after: float | None = None) -> int:
+        """Delete whole segments every live group has consumed. Returns
+        the number of segments removed. Never removes the active
+        segment. Groups whose offset file hasn't been touched (by a
+        commit or a poll's register) in `stale_after` seconds are
+        treated as abandoned and stop pinning retention."""
         groups = self.groups()
         if not groups:
             return 0
-        low = min(self.committed(g) for g in groups)
+        now = time.time()
+        low = None
+        for g in groups:
+            if stale_after is not None:
+                try:
+                    mtime = os.stat(self._offset_path(g)).st_mtime
+                except OSError:
+                    continue
+                if now - mtime > stale_after:
+                    continue
+            off = self.committed(g)
+            low = off if low is None else min(low, off)
+        if low is None:
+            return 0
         removed = 0
         with self._lock:
             while len(self.segments) > 1:
@@ -236,7 +273,9 @@ class PartitionedLogQueue:
         directory: str,
         partitions: int = 4,
         segment_bytes: int = 8 * 1024 * 1024,
+        stale_group_seconds: float = 24 * 3600.0,
     ):
+        self.stale_group_seconds = stale_group_seconds
         if partitions < 1:
             raise ValueError("partitions must be >= 1")
         self.dir = directory
@@ -300,6 +339,8 @@ class PartitionedLogQueue:
         out = []
         budget = max_records
         leftovers = []
+        for p in self.partitions:
+            p.register(group)  # first poll pins the trim low-water mark
         for i, p in enumerate(self.partitions):
             if budget <= 0:
                 break
@@ -333,7 +374,7 @@ class PartitionedLogQueue:
         return self.partitions[partition].committed(group)
 
     def trim(self) -> int:
-        return sum(p.trim() for p in self.partitions)
+        return sum(p.trim(self.stale_group_seconds) for p in self.partitions)
 
     def depth(self, group: str) -> int:
         """Unconsumed record count for a group (lag), synced with

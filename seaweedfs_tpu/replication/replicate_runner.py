@@ -117,25 +117,61 @@ def run_replicate(
             time.sleep(poll_interval)
 
 
+_MAX_EVENT_RETRIES = 8
+
+
 def _consume_logqueue(lq, replicator, poll_interval, stop_after_idle) -> int:
     """Drain loop over the partitioned log: poll → replicate →
-    commit-per-partition (at-least-once), then trim consumed segments."""
+    commit-per-partition, then trim consumed segments.
+
+    At-least-once for real: a failed event does NOT advance its
+    partition's committed offset — the next poll() re-delivers from the
+    last success, preserving per-partition order behind the failure.
+    After _MAX_EVENT_RETRIES redeliveries the event is declared poison
+    and skipped (committed past) so one bad event can't wedge its
+    partition forever."""
     group = "replicate"
     idle_since = time.time()
+    retries: dict[tuple[int, int], int] = {}  # (partition, offset) → attempts
     while True:
         batch = lq.poll(group)
         if batch:
             high: dict[int, int] = {}
+            stalled: set[int] = set()
             for part, offset, key, msg in batch:
+                if part in stalled:
+                    continue  # order: nothing commits past the failure
                 try:
                     replicator.replicate(key, msg)
-                except Exception as e:  # noqa: BLE001 — keep consuming
-                    wlog.error("replicate %s: %s", key, e)
+                except Exception as e:  # noqa: BLE001 — redeliver next poll
+                    attempts = retries.get((part, offset), 0) + 1
+                    if attempts >= _MAX_EVENT_RETRIES:
+                        wlog.error(
+                            "replicate %s: %s — poison after %d attempts, skipping",
+                            key, e, attempts,
+                        )
+                        retries.pop((part, offset), None)
+                        high[part] = offset + 1  # give up: commit past it
+                    else:
+                        wlog.error(
+                            "replicate %s: %s (attempt %d; partition %d "
+                            "redelivers from offset %d)",
+                            key, e, attempts, part, offset,
+                        )
+                        retries[(part, offset)] = attempts
+                        stalled.add(part)
+                    continue
+                retries.pop((part, offset), None)
                 high[part] = offset + 1
             for part, next_off in high.items():
                 lq.commit(group, part, next_off)
             lq.trim()
-            idle_since = time.time()
+            if high:
+                idle_since = time.time()
+            if stalled:
+                if stop_after_idle and time.time() - idle_since > stop_after_idle:
+                    return 1  # stuck on failures, not idle: nonzero
+                time.sleep(poll_interval)  # backoff before redelivery
         elif stop_after_idle and time.time() - idle_since > stop_after_idle:
             return 0
         else:
