@@ -281,6 +281,60 @@ def bench_decode4() -> None:
     _report("ec_decode_4missing", gbps, "GB/s", gbps / 40.0)
 
 
+def bench_shardmap() -> None:
+    """shard_map(SWAR) through the mesh tier (parallel/mesh_codec.py)
+    on one chip: the multi-chip program shape — a [B, 10, n32] volume
+    batch laid out P('vol', None, 'stripe') on a 1×1 Mesh with the
+    SWAR Pallas kernel per device — should cost ~nothing vs the plain
+    single-chip kernel (compare with ec_encode_rs10_4 in the same
+    run). On a real v5e slice the same program spreads the batch over
+    the mesh; this pins the per-chip rate of that tier."""
+    import numpy as np
+
+    from seaweedfs_tpu.ec.codec import new_encoder
+    from seaweedfs_tpu.parallel import MeshCodec, make_mesh
+
+    dev, on_tpu = _chip()
+    mesh = make_mesh([dev], stripe=1)
+    codec = MeshCodec(mesh)
+    b = 8
+    shard_bytes = (8 if on_tpu else 1) * 1024 * 1024  # per volume in the batch
+    n32 = shard_bytes // 4
+
+    @jax.jit
+    def gen(key):
+        return jax.random.randint(
+            key, (b, 10, n32), 0, (1 << 31) - 1, dtype=jnp.int32
+        ).astype(jnp.uint32)
+
+    data = gen(jax.random.PRNGKey(7))
+    data.block_until_ready()
+
+    # integrity gate: volume 0's first 4096 bytes vs the CPU reference
+    sample_u32 = np.asarray(jax.device_get(data[:1, :, :1024]))
+    sample = sample_u32.view(np.uint8).reshape(10, 4096)
+    rs = new_encoder(backend="cpu")
+    full = rs.encode([sample[i].copy() for i in range(10)] + [None] * 4)
+    got = (
+        np.asarray(jax.device_get(codec.encode_batch_u32(jnp.asarray(sample_u32))))
+        .view(np.uint8)
+        .reshape(4, 4096)
+    )
+    for i in range(4):
+        assert np.array_equal(got[i], full[10 + i]), (
+            "mesh-tier kernel diverges from the CPU reference; refusing "
+            "to publish a throughput number for wrong bytes"
+        )
+
+    def step(d):
+        return d.at[:, 0].set(d[:, 0] ^ codec.encode_batch_u32(d)[:, 0])
+
+    iters = 64 if on_tpu else 2
+    elapsed = _time_chain(step, data, iters)
+    gbps = b * 10 * shard_bytes * iters / elapsed / 1e9
+    _report("ec_encode_shardmap", gbps, "GB/s", gbps / 40.0)
+
+
 def bench_stream() -> None:
     """End-to-end file encode: .dat → .ec00..13 via write_ec_files.
 
@@ -333,12 +387,95 @@ def bench_stream() -> None:
     _report("ec_encode_stream_e2e", gbps, "GB/s", gbps / cpu_gbps)
 
 
+def bench_stream_rebuild() -> None:
+    """End-to-end single-shard rebuild of a real on-disk EC volume:
+    delete .ec00, rebuild it from the 10 survivors through the
+    threaded stream_rebuild_ec_files driver with the best local codec
+    backend (see bench_stream's rationale for excluding the tunneled
+    TPU). value = volume data bytes (10 survivor shards in) per
+    second; vs_baseline = speedup over the numpy "cpu" backend on the
+    same machine — the software-RS role the reference fills with
+    klauspost AVX2 in RebuildEcFiles (ec_encoder.go:227-281)."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from seaweedfs_tpu.ec import ec_files, ec_stream, gf256
+    from seaweedfs_tpu.ec.codec import new_encoder
+
+    def make_rebuild_fns(rs):
+        rows_cache = {}
+
+        def rebuild_fn(survivors, targets, tile):
+            key = survivors + (256,) + targets
+            rows = rows_cache.get(key)
+            if rows is None:
+                rows = gf256.decode_rows(rs.matrix, survivors, targets)
+                rows_cache[key] = rows
+            return rs._apply(rows, tile)
+
+        return rebuild_fn, lambda h: h
+
+    def best_rate(base: str, rs, runs: int) -> float:
+        dat_bytes = os.path.getsize(base + ".dat")
+        rebuild_fn, fetch = make_rebuild_fns(rs)
+        best = float("inf")
+        for _ in range(runs):
+            os.remove(base + ec_files.to_ext(0))
+            t0 = time.perf_counter()
+            rebuilt = ec_stream.stream_rebuild_ec_files(
+                base, rebuild_fn=rebuild_fn, fetch_fn=fetch
+            )
+            best = min(best, time.perf_counter() - t0)
+            assert rebuilt == [0]
+        return dat_bytes / best / 1e9
+
+    size = 256 * 1024 * 1024
+    with tempfile.TemporaryDirectory() as d:
+        base = os.path.join(d, "1")
+        rng = np.random.default_rng(0)
+        with open(base + ".dat", "wb") as f:
+            for _ in range(size // (16 * 1024 * 1024)):
+                f.write(
+                    rng.integers(0, 256, 16 * 1024 * 1024, dtype=np.uint8).tobytes()
+                )
+        try:
+            rs = new_encoder(backend="native")
+        except (ImportError, ValueError):
+            rs = new_encoder(backend="cpu")
+        ec_files.write_ec_files(base, rs=rs)
+        # integrity gate: the rebuilt shard must equal the original
+        shard0 = base + ec_files.to_ext(0)
+        want = open(shard0, "rb").read()
+        rebuild_fn, fetch = make_rebuild_fns(rs)
+        os.remove(shard0)
+        ec_stream.stream_rebuild_ec_files(base, rebuild_fn=rebuild_fn, fetch_fn=fetch)
+        assert open(shard0, "rb").read() == want, (
+            "stream rebuild diverges from the encoded shard; refusing to "
+            "publish a throughput number for wrong bytes"
+        )
+        gbps = best_rate(base, rs, runs=3)
+
+        # numpy-backend baseline on a 32 MiB volume, same warm protocol
+        cpu_base = os.path.join(d, "2")
+        with open(base + ".dat", "rb") as src, open(cpu_base + ".dat", "wb") as dst:
+            dst.write(src.read(32 * 1024 * 1024))
+        cpu_rs = new_encoder(backend="cpu")
+        ec_files.write_ec_files(cpu_base, rs=cpu_rs)
+        cpu_gbps = best_rate(cpu_base, cpu_rs, runs=2)
+
+    _report("ec_rebuild_stream_e2e", gbps, "GB/s", gbps / cpu_gbps)
+
+
 CONFIGS = {
     "encode": bench_encode,
     "rebuild": bench_rebuild,
     "batch": bench_batch,
     "decode4": bench_decode4,
+    "shardmap": bench_shardmap,
     "stream": bench_stream,
+    "stream-rebuild": bench_stream_rebuild,
 }
 
 

@@ -111,7 +111,9 @@ _SWAR_TN = 32768
 _SWAR_MIN_BYTES = 64 * 1024
 
 
-def _make_swar_kernel(rows_tuple: tuple[int, ...], r_out: int, k: int):
+def _make_swar_kernel(
+    rows_tuple: tuple[int, ...], r_out: int, k: int, batched: bool = False
+):
     """Build the Pallas kernel body for one GF coefficient matrix.
 
     The matrix is baked into the kernel as XOR schedules: for output
@@ -119,6 +121,11 @@ def _make_swar_kernel(rows_tuple: tuple[int, ...], r_out: int, k: int):
     has bit j set. The kernel computes, per uint32 lane,
     y[p] = Σ_j u_j · 2^j in GF(2^8) via Horner, where the GF doubling
     is branchless SWAR on 4 packed bytes.
+
+    batched=True builds the body for refs with a leading batch-block
+    dim of 1 (the grid walks volumes × stream tiles), so one
+    pallas_call serves a whole [B, k, n32] volume batch without a
+    host-side transpose into the flat [k, B*n32] layout.
     """
     rows = np.array(rows_tuple, dtype=np.uint8).reshape(r_out, k)
     sel = [
@@ -126,12 +133,13 @@ def _make_swar_kernel(rows_tuple: tuple[int, ...], r_out: int, k: int):
         for p in range(r_out)
     ]
     maxj = [max((j for j in range(8) if sel[p][j]), default=0) for p in range(r_out)]
+    lead = (0,) if batched else ()  # ref index prefix for the batch dim
 
     def kernel(x_ref, o_ref):
         m_fe = jnp.uint32(0xFEFEFEFE)
         m_hb = jnp.uint32(0x80808080)
         red = jnp.uint32(0x1D)  # x^8 reduction polynomial tail (0x11D)
-        xs = [x_ref[c, :] for c in range(k)]
+        xs = [x_ref[lead + (c, slice(None))] for c in range(k)]
 
         def xor_set(cs):
             acc = xs[cs[0]]
@@ -148,7 +156,9 @@ def _make_swar_kernel(rows_tuple: tuple[int, ...], r_out: int, k: int):
                 if sel[p][j]:
                     u = xor_set(sel[p][j])
                     y = u if y is None else y ^ u
-            o_ref[p, :] = y if y is not None else jnp.zeros_like(xs[0])
+            o_ref[lead + (p, slice(None))] = (
+                y if y is not None else jnp.zeros_like(xs[0])
+            )
 
     return kernel
 
@@ -179,6 +189,66 @@ def swar_apply_u32(
         out_shape=jax.ShapeDtypeStruct((r_out, n), jnp.uint32),
         interpret=interpret,
     )(data_u32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tn", "r_out", "k", "rows_tuple", "interpret")
+)
+def swar_apply_u32_batch(
+    data_u32: jnp.ndarray,
+    tn: int,
+    r_out: int,
+    k: int,
+    rows_tuple: tuple[int, ...],
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """data [B, k, n32] uint32 → [B, r_out, n32] uint32 (one kernel,
+    grid = volumes × stream tiles). n32 must be a multiple of tn."""
+    b, _, n = data_u32.shape
+    return pl.pallas_call(
+        _make_swar_kernel(rows_tuple, r_out, k, batched=True),
+        grid=(b, n // tn),
+        in_specs=[
+            pl.BlockSpec(
+                (1, k, tn), lambda bi, i: (bi, 0, i), memory_space=pltpu.VMEM
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (1, r_out, tn), lambda bi, i: (bi, 0, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, r_out, n), jnp.uint32),
+        interpret=interpret,
+    )(data_u32)
+
+
+def swar_apply_matrix_u32_batch(
+    matrix: np.ndarray, inputs_u32: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """Batched device-resident SWAR: [B, k, n32] uint32 → [B, R, n32].
+    Same packing contract as swar_apply_matrix_u32."""
+    rows_tuple = tuple(int(v) for v in np.asarray(matrix, dtype=np.uint8).reshape(-1))
+    r_out, k = matrix.shape
+    return swar_apply_u32_batch(
+        inputs_u32,
+        _swar_tn(inputs_u32.shape[2]),
+        r_out,
+        k,
+        rows_tuple,
+        interpret,
+    )
+
+
+def apply_matrix_bits_u32_batch(
+    a_bits: jnp.ndarray, inputs_u32: jnp.ndarray
+) -> jnp.ndarray:
+    """Matmul path on u32-lane data: bitcast to bytes, apply, bitcast
+    back — byte-identical to the SWAR path on the same lanes (the CPU
+    fallback inside mesh shard_map programs)."""
+    b, k, n32 = inputs_u32.shape
+    u8 = jax.lax.bitcast_convert_type(inputs_u32, jnp.uint8).reshape(b, k, n32 * 4)
+    out = apply_matrix_bits_batch(a_bits, u8)
+    r = out.shape[1]
+    return jax.lax.bitcast_convert_type(out.reshape(b, r, n32, 4), jnp.uint32)
 
 
 def _swar_tn(n32: int) -> int:

@@ -9,12 +9,18 @@ matching the *output bytes* of ec_files.py exactly while overlapping:
   disk read (tile t+1)  ‖  H2D + SWAR kernel (tile t)  ‖  parity D2H +
   file writes (tile t-1)
 
-JAX dispatch is async, so the pipeline needs no device-side threading:
-`device_put` and the encode call return immediately; a bounded
-in-flight deque defers the blocking parity fetch until the device has
-had a full tile's worth of wall-clock to work. Only the [4, N] parity
-ever crosses device→host — the ten data-shard files are byte copies of
-the blocks read from the .dat, written straight from the host buffer.
+The host side is a three-thread pipeline: a reader thread fills a
+bounded tile queue from disk, the caller's thread dispatches the codec
+(JAX dispatch is async — `device_put` and the encode call return
+immediately), and a writer thread blocks on the parity fetch and lands
+all 14 shard files. So disk reads, device compute, and file writes
+genuinely overlap even though the fetch is blocking — on a local-PCIe
+TPU host the pipeline is no longer capped by one thread's read+write
+rate. Only the [4, N] parity ever crosses device→host — the ten
+data-shard files are byte copies of the blocks read from the .dat,
+written straight from the host buffer. The single writer thread
+preserves tile order (queue FIFO), so output bytes stay identical to
+the synchronous ec_files.py drivers.
 
 Role match: the 256 KB-batch loops at reference
 weed/storage/erasure_coding/ec_encoder.go:188-225 (encodeDatFile) and
@@ -24,7 +30,8 @@ weed/storage/erasure_coding/ec_encoder.go:188-225 (encodeDatFile) and
 from __future__ import annotations
 
 import os
-from collections import deque
+import queue
+import threading
 from typing import Callable
 
 import numpy as np
@@ -38,9 +45,69 @@ LARGE_BLOCK_SIZE = locate.LARGE_BLOCK_SIZE
 SMALL_BLOCK_SIZE = locate.SMALL_BLOCK_SIZE
 
 # Per-shard bytes per pipelined tile. 16 MiB x 10 shards = 160 MiB of
-# host buffer per in-flight stage; two stages in flight.
+# host buffer per in-flight stage.
 DEFAULT_TILE_BYTES = 16 * 1024 * 1024
+# Dispatched-but-unfetched tiles queued toward the writer thread; with
+# the 1-deep read queue and the tile in the dispatcher's hands, at most
+# _INFLIGHT + 2 tiles of host memory are live.
 _INFLIGHT = 2
+
+_EOF = object()  # end-of-stream marker flowing through the queues
+_STOPPED = object()  # returned by _q_get when the pipeline aborted
+
+_Q_TICK = 0.2  # seconds between stop-flag checks while blocked
+
+
+def _q_put(q: queue.Queue, item, stop: threading.Event) -> bool:
+    """put() that gives up when the pipeline aborts (a dead consumer
+    must not leave the producer blocked forever)."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=_Q_TICK)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _q_get(q: queue.Queue, stop: threading.Event):
+    while not stop.is_set():
+        try:
+            return q.get(timeout=_Q_TICK)
+        except queue.Empty:
+            continue
+    return _STOPPED
+
+
+class _Pipeline:
+    """Reader + writer threads around the caller's dispatch loop, with
+    first-error propagation and deadlock-free shutdown."""
+
+    def __init__(self):
+        self.stop = threading.Event()
+        self.errors: list[BaseException] = []
+        self._threads: list[threading.Thread] = []
+
+    def spawn(self, fn) -> None:
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised on join
+                self.errors.append(e)
+                self.stop.set()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def finish(self, caller_error: bool = False) -> None:
+        """Join the stage threads; re-raise the first stage error."""
+        if caller_error:
+            self.stop.set()
+        for t in self._threads:
+            t.join()
+        if not caller_error and self.errors:
+            raise self.errors[0]
 
 
 def stream_write_ec_files(
@@ -71,30 +138,50 @@ def stream_write_ec_files(
     from seaweedfs_tpu.ec.ec_files import iter_ec_tiles, read_dat_tile, to_ext
 
     outputs = [open(base_file_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
-    inflight: deque[tuple[np.ndarray, object]] = deque()
+    pipe = _Pipeline()
+    read_q: queue.Queue = queue.Queue(maxsize=1)
+    write_q: queue.Queue = queue.Queue(maxsize=_INFLIGHT)
 
-    def drain_one() -> None:
-        tile, handle = inflight.popleft()
-        parity = fetch_fn(handle)
-        for i in range(DATA_SHARDS):
-            outputs[i].write(tile[i].tobytes())
-        for i in range(PARITY_SHARDS):
-            outputs[DATA_SHARDS + i].write(parity[i].tobytes())
-
-    try:
+    def reader():
         with open(dat_path, "rb") as dat:
             for row_off, block, batch_off, step in iter_ec_tiles(
                 dat_size, tile_bytes, large_block_size, small_block_size
             ):
                 tile = read_dat_tile(dat, dat_size, row_off, block, batch_off, step)
-                inflight.append((tile, parity_fn(tile)))
-                if len(inflight) >= _INFLIGHT:
-                    drain_one()
-        while inflight:
-            drain_one()
+                if not _q_put(read_q, tile, pipe.stop):
+                    return
+        _q_put(read_q, _EOF, pipe.stop)
+
+    def writer():
+        while True:
+            item = _q_get(write_q, pipe.stop)
+            if item is _EOF or item is _STOPPED:
+                return
+            tile, handle = item
+            parity = fetch_fn(handle)
+            for i in range(DATA_SHARDS):
+                outputs[i].write(tile[i].tobytes())
+            for i in range(PARITY_SHARDS):
+                outputs[DATA_SHARDS + i].write(parity[i].tobytes())
+
+    pipe.spawn(reader)
+    pipe.spawn(writer)
+    ok = False
+    try:
+        while True:
+            tile = _q_get(read_q, pipe.stop)
+            if tile is _EOF or tile is _STOPPED:
+                break
+            if not _q_put(write_q, (tile, parity_fn(tile)), pipe.stop):
+                break
+        _q_put(write_q, _EOF, pipe.stop)
+        ok = True
     finally:
-        for f in outputs:
-            f.close()
+        try:
+            pipe.finish(caller_error=not ok)  # may re-raise a stage error
+        finally:
+            for f in outputs:
+                f.close()
 
 
 def stream_rebuild_ec_files(
@@ -129,39 +216,57 @@ def stream_rebuild_ec_files(
 
     inputs = {i: open(base_file_name + to_ext(i), "rb") for i in survivors}
     outputs = {i: open(base_file_name + to_ext(i), "wb") for i in missing}
-    inflight: deque[object] = deque()
+    pipe = _Pipeline()
+    read_q: queue.Queue = queue.Queue(maxsize=1)
+    write_q: queue.Queue = queue.Queue(maxsize=_INFLIGHT)
 
-    def drain_one() -> None:
-        rebuilt = fetch_fn(inflight.popleft())
-        for j, i in enumerate(targets):
-            outputs[i].write(rebuilt[j].tobytes())
-
-    try:
+    def reader():
         shard_size = os.path.getsize(base_file_name + to_ext(survivors[0]))
         offset = 0
         while offset < shard_size:
             step = min(tile_bytes, shard_size - offset)
             tile = np.empty((DATA_SHARDS, step), dtype=np.uint8)
             for j, i in enumerate(survivors):
-                f = inputs[i]
-                f.seek(offset)
-                raw = f.read(step)
+                raw = os.pread(inputs[i].fileno(), step, offset)
                 if len(raw) != step:
                     raise ValueError(
                         f"ec shard {i} truncated: expected {step} at {offset}"
                     )
                 tile[j] = np.frombuffer(raw, dtype=np.uint8)
-            inflight.append(rebuild_fn(survivors, targets, tile))
-            if len(inflight) >= _INFLIGHT:
-                drain_one()
+            if not _q_put(read_q, tile, pipe.stop):
+                return
             offset += step
-        while inflight:
-            drain_one()
+        _q_put(read_q, _EOF, pipe.stop)
+
+    def writer():
+        while True:
+            item = _q_get(write_q, pipe.stop)
+            if item is _EOF or item is _STOPPED:
+                return
+            rebuilt = fetch_fn(item)
+            for j, i in enumerate(targets):
+                outputs[i].write(rebuilt[j].tobytes())
+
+    pipe.spawn(reader)
+    pipe.spawn(writer)
+    ok = False
+    try:
+        while True:
+            tile = _q_get(read_q, pipe.stop)
+            if tile is _EOF or tile is _STOPPED:
+                break
+            if not _q_put(write_q, rebuild_fn(survivors, targets, tile), pipe.stop):
+                break
+        _q_put(write_q, _EOF, pipe.stop)
+        ok = True
     finally:
-        for f in inputs.values():
-            f.close()
-        for f in outputs.values():
-            f.close()
+        try:
+            pipe.finish(caller_error=not ok)  # may re-raise a stage error
+        finally:
+            for f in inputs.values():
+                f.close()
+            for f in outputs.values():
+                f.close()
     return missing
 
 

@@ -25,21 +25,55 @@ class Query:
 
 
 def get_path(obj: Any, path: str):
-    """Dotted-path lookup ("a.b.2.c"); integer segments index arrays.
-    Returns _MISSING when any segment is absent (gjson.Get role)."""
-    cur = obj
-    for seg in path.split("."):
+    """gjson-style path lookup (gjson.Get role, query_json.go:18).
+
+    Supported path syntax (the subset the reference's queries use):
+      a.b.c    dotted descent through objects
+      a.2.c    integer segments index arrays (no negative indices,
+               matching gjson)
+      a.*.c    `*`/`?` glob segments match object keys; the FIRST
+               matching key wins (gjson's wildcard rule)
+      a.#      length of the array at `a`
+      a.#.c    collects `c` from every element of `a` (elements where
+               the sub-path is absent are skipped, like gjson)
+    Returns _MISSING when any segment can't resolve."""
+    return _get(obj, path.split("."))
+
+
+def _get(cur: Any, segs: list[str]):
+    for i, seg in enumerate(segs):
         if isinstance(cur, dict):
-            if seg not in cur:
+            if seg in cur:
+                cur = cur[seg]
+                continue
+            if "*" in seg or "?" in seg:
+                rest = segs[i + 1 :]
+                for k in cur:
+                    if fnmatch.fnmatchcase(k, seg):
+                        v = _get(cur[k], rest)
+                        if v is not _MISSING:
+                            return v
                 return _MISSING
-            cur = cur[seg]
-        elif isinstance(cur, list):
-            try:
-                cur = cur[int(seg)]
-            except (ValueError, IndexError):
-                return _MISSING
-        else:
             return _MISSING
+        if isinstance(cur, list):
+            if seg == "#":
+                rest = segs[i + 1 :]
+                if not rest:
+                    return len(cur)
+                return [
+                    v
+                    for el in cur
+                    if (v := _get(el, rest)) is not _MISSING
+                ]
+            try:
+                idx = int(seg)
+            except ValueError:
+                return _MISSING
+            if idx < 0 or idx >= len(cur):
+                return _MISSING
+            cur = cur[idx]
+            continue
+        return _MISSING
     return cur
 
 

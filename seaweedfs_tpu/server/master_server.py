@@ -10,8 +10,9 @@ master_server_handlers*.go):
     liveness IS the stream (SURVEY §5 failure detection);
   * gRPC KeepConnected: filers/shells hold this open and receive
     vid→location deltas as volumes appear/disappear;
-  * HTTP /dir/assign /dir/lookup /vol/grow /col/delete /cluster/status
-    /stats/health — the public control API;
+  * HTTP /dir/assign /dir/lookup /submit /vol/grow /vol/vacuum
+    /col/delete /cluster/status /stats/health — the public control API
+    (master_server.go:108-121);
   * automatic volume growth when an assign finds no writable volume
     (AutomaticGrowByType), allocating on rack-aware placed nodes via
     the volume servers' AllocateVolume RPC.
@@ -127,6 +128,7 @@ class MasterServer:
             )
         self._vid_alloc_lock = threading.Lock()
         self._grow_lock = threading.Lock()
+        self._vacuum_sweep_lock = threading.Lock()
         # leader-only periodic garbage-ratio vacuum sweep
         # (master_server.go:126 StartRefreshWritableVolumes); 0 disables
         self.vacuum_interval = vacuum_interval
@@ -674,9 +676,110 @@ class MasterServer:
                         return self._json({"error": str(e)}, 500)
                 if url.path == "/col/delete":
                     return self._json({"error": "use gRPC CollectionDelete"}, 400)
+                if url.path == "/submit":
+                    return self._submit(q)
+                if url.path == "/vol/vacuum":
+                    return self._vol_vacuum(q)
                 self._json({"error": f"unknown path {url.path}"}, 404)
 
             do_POST = do_GET
+
+            def _submit(self, q):
+                """Assign + proxy upload in one call — the curl
+                one-liner path (master_server.go:116 /submit →
+                submitForClientHandler). Routes through the client
+                submit op against this master, so auto-chunking
+                (?maxMB=) and assign's leader proxying both apply."""
+                from seaweedfs_tpu.client.operation import submit_file
+                from seaweedfs_tpu.util.multipart import (
+                    MalformedUpload,
+                    parse_upload,
+                )
+
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    max_mb = int(q.get("maxMB", "0") or "0")
+                except ValueError:
+                    return self._json(
+                        {"error": "maxMB / Content-Length must be integers"},
+                        400,
+                    )
+                body = self.rfile.read(length)
+                try:
+                    part = parse_upload(
+                        body, self.headers.get("Content-Type", "")
+                    )
+                except MalformedUpload as e:
+                    return self._json({"error": str(e)}, 400)
+                try:
+                    res = submit_file(
+                        f"{server.host}:{server.port}",
+                        q.get("filename", "") or part.filename,
+                        part.data,
+                        replication=q.get("replication", ""),
+                        collection=q.get("collection", ""),
+                        ttl=q.get("ttl", ""),
+                        mime=part.mime,
+                        max_mb=max_mb,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    return self._json({"error": str(e)}, 500)
+                if res.error:
+                    return self._json({"error": res.error}, 500)
+                self._json(
+                    {
+                        "fileName": res.file_name,
+                        "fid": res.fid,
+                        "fileUrl": res.file_url,
+                        "size": res.size,
+                    }
+                )
+
+            def _vol_vacuum(self, q):
+                """Force one garbage-ratio vacuum sweep now
+                (master_server.go:117 /vol/vacuum); optional
+                ?garbageThreshold= overrides the configured ratio.
+                Followers proxy to the leader, who owns the topology."""
+                if not server.is_leader:
+                    return self._proxy_http_to_leader()
+                try:
+                    threshold = (
+                        float(q["garbageThreshold"])
+                        if "garbageThreshold" in q
+                        else None
+                    )
+                except ValueError:
+                    return self._json(
+                        {"error": "garbageThreshold must be a float"}, 400
+                    )
+                try:
+                    count = server._vacuum_once(threshold=threshold)
+                except Exception as e:  # noqa: BLE001
+                    return self._json({"error": str(e)}, 500)
+                self._json(
+                    {"vacuumed": count, "Topology": server._topology_dump()}
+                )
+
+            def _proxy_http_to_leader(self):
+                from seaweedfs_tpu.client.operation import http_call
+
+                leader = server.leader_address()
+                if not leader or leader == f"{server.host}:{server.port}":
+                    return self._json({"error": "no leader to proxy to"}, 503)
+                try:
+                    status, headers, body = http_call(
+                        "GET", f"{leader}{self.path}", timeout=630
+                    )
+                except Exception as e:  # noqa: BLE001
+                    return self._json({"error": f"leader proxy: {e}"}, 502)
+                self.send_response(status)
+                self.send_header(
+                    "Content-Type",
+                    headers.get("Content-Type", "application/json"),
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def _assign(self, q):
                 try:
@@ -778,10 +881,21 @@ class MasterServer:
     # ------------------------------------------------------------------
     # leader vacuum loop (topology_vacuum.go:16-160 via
     # topology_event_handling.go StartRefreshWritableVolumes)
-    def _vacuum_once(self) -> int:
+    def _vacuum_once(self, threshold: float | None = None) -> int:
         """One garbage-ratio sweep: replica-consistent check → compact
         all replicas → commit all (cleanup on failure). Returns the
-        number of vacuumed volumes."""
+        number of vacuumed volumes. `threshold` overrides the
+        configured garbage ratio for this sweep (the /vol/vacuum
+        ?garbageThreshold= path)."""
+        if threshold is None:
+            threshold = self.garbage_threshold
+        # serialize sweeps: the 15-min loop and HTTP /vol/vacuum handler
+        # threads must never overlap-compact the same volume (the second
+        # compact would race the first commit's makeup-diff replay)
+        with self._vacuum_sweep_lock:
+            return self._vacuum_once_locked(threshold)
+
+    def _vacuum_once_locked(self, threshold: float) -> int:
         compacted = 0
         for dn in self.topology.data_nodes():
             for vid, info in list(dn.volumes.items()):
@@ -798,7 +912,7 @@ class MasterServer:
                                 timeout=30,
                             )
                         ratios.append(resp.garbage_ratio)
-                    if not ratios or min(ratios) < self.garbage_threshold:
+                    if not ratios or min(ratios) < threshold:
                         continue
                     # no write fence needed: each replica's compact
                     # snapshots without blocking writes and its commit

@@ -216,6 +216,71 @@ class TestGrpcPlane:
             )
             assert check.garbage_ratio == 0
 
+    def test_submit_http(self, cluster):
+        """POST /submit on the master: assign + proxied upload in one
+        call (master_server.go:116), multipart and raw bodies."""
+        master, _ = cluster
+        payload = b"one-liner upload " * 100
+        boundary = "testsubmitboundary"
+        body = (
+            f"--{boundary}\r\n"
+            'Content-Disposition: form-data; name="file"; filename="hello.txt"\r\n'
+            "Content-Type: text/plain\r\n\r\n"
+        ).encode() + payload + f"\r\n--{boundary}--\r\n".encode()
+        req = urllib.request.Request(
+            master_url(master, "/submit?collection=sub"),
+            data=body,
+            method="POST",
+            headers={"Content-Type": f"multipart/form-data; boundary={boundary}"},
+        )
+        with urllib.request.urlopen(req, timeout=20) as r:
+            res = json.loads(r.read())
+        assert res.get("fid") and res.get("size") == len(payload), res
+        assert res.get("fileName") == "hello.txt"
+        status, got = http_get(f"http://{res['fileUrl']}")
+        assert status == 200 and got == payload
+
+        # raw-body submit (no multipart): payload passes through whole
+        req = urllib.request.Request(
+            master_url(master, "/submit"), data=b"rawbytes", method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=20) as r:
+            res = json.loads(r.read())
+        assert res.get("size") == len(b"rawbytes")
+        status, got = http_get(f"http://{res['fileUrl']}")
+        assert status == 200 and got == b"rawbytes"
+
+    def test_vol_vacuum_http(self, cluster):
+        """GET /vol/vacuum?garbageThreshold= forces a sweep now
+        (master_server.go:117); live data survives, garbage is gone."""
+        master, _ = cluster
+        _, assign = http_json(master_url(master, "/dir/assign?collection=vh"))
+        dead_url = f"http://{assign['url']}/{assign['fid']}"
+        urllib.request.urlopen(
+            urllib.request.Request(dead_url, data=b"g" * 50_000, method="POST"),
+            timeout=10,
+        ).close()
+        _, keep = http_json(master_url(master, "/dir/assign?collection=vh"))
+        keep_url = f"http://{keep['url']}/{keep['fid']}"
+        urllib.request.urlopen(
+            urllib.request.Request(keep_url, data=b"live", method="POST"),
+            timeout=10,
+        ).close()
+        urllib.request.urlopen(
+            urllib.request.Request(dead_url, method="DELETE"), timeout=10
+        ).close()
+
+        _, res = http_json(
+            master_url(master, "/vol/vacuum?garbageThreshold=0.001")
+        )
+        assert res.get("vacuumed", 0) >= 1, res
+        assert "Topology" in res
+        status, got = http_get(keep_url)
+        assert status == 200 and got == b"live"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            http_get(dead_url)
+        assert exc.value.code == 404
+
     def test_batch_delete(self, cluster):
         master, _ = cluster
         fids = []

@@ -356,6 +356,71 @@ class TestStreamDrivers:
             ), i
 
 
+    def test_stream_write_stage_error_propagates(self, tmp_path):
+        """A kernel-stage failure mid-stream must raise on the caller
+        (not hang the reader/writer threads and not leave them alive)."""
+        import threading
+
+        import numpy as np
+        import pytest as _pytest
+
+        from seaweedfs_tpu.ec import ec_stream
+
+        rng = np.random.default_rng(19)
+        (tmp_path / "1.dat").write_bytes(
+            rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+        )
+        calls = {"n": 0}
+
+        def parity_fn(tile):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("kernel died")
+            return np.zeros((4, tile.shape[1]), dtype=np.uint8)
+
+        before = threading.active_count()
+        with _pytest.raises(RuntimeError, match="kernel died"):
+            ec_stream.stream_write_ec_files(
+                str(tmp_path / "1"),
+                tile_bytes=16_000,
+                large_block_size=40_000,
+                small_block_size=4_000,
+                parity_fn=parity_fn,
+                fetch_fn=lambda h: h,
+            )
+        assert threading.active_count() <= before  # stage threads joined
+
+    def test_stream_rebuild_read_error_propagates(self, tmp_path):
+        """A truncated survivor detected by the reader THREAD must
+        surface as the caller's exception."""
+        import os
+
+        import numpy as np
+        import pytest as _pytest
+
+        from seaweedfs_tpu.ec import ec_files, ec_stream
+
+        rng = np.random.default_rng(20)
+        (tmp_path / "1.dat").write_bytes(
+            rng.integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+        )
+        base = str(tmp_path / "1")
+        ec_files.write_ec_files(
+            base, buffer_size=2_000, large_block_size=40_000, small_block_size=4_000
+        )
+        os.remove(base + ec_files.to_ext(12))
+        # truncate a survivor below one tile so the reader's pread fails
+        surv = base + ec_files.to_ext(3)
+        with open(surv, "r+b") as f:
+            f.truncate(1_000)
+
+        _, rebuild_fn, fetch = self._cpu_stages()
+        with _pytest.raises(ValueError, match="truncated"):
+            ec_stream.stream_rebuild_ec_files(
+                base, tile_bytes=12_000, rebuild_fn=rebuild_fn, fetch_fn=fetch
+            )
+
+
 class TestLocateProperty:
     """Randomized cross-check of the striping math against the actual
     encoder: encode random .dat sizes with tiny block sizes, then for

@@ -215,6 +215,34 @@ class TestHaMasters:
         assert not ur2.error
 
 
+    def test_submit_and_vacuum_proxied_through_follower(self, ha_cluster):
+        """/submit works via any master (assign proxies to the leader
+        internally) and /vol/vacuum on a follower is HTTP-proxied to
+        the leader (followers hold no topology)."""
+        import json
+        import urllib.request
+
+        masters, vs = ha_cluster
+        follower = next(m for m in masters if not m.is_leader)
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{follower.port}/submit",
+            data=b"via follower",
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            res = json.loads(r.read())
+        assert res.get("fid"), res
+        with urllib.request.urlopen(f"http://{res['fileUrl']}", timeout=10) as r:
+            assert r.read() == b"via follower"
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{follower.port}/vol/vacuum", timeout=60
+        ) as r:
+            res = json.loads(r.read())
+        assert "vacuumed" in res and "Topology" in res, res
+
+
 class TestFilerHaFailover:
     def test_filer_writes_survive_leader_loss(self, tmp_path_factory):
         """A filer configured with all three masters keeps serving

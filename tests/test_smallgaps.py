@@ -269,6 +269,47 @@ class TestJsonQuery:
         assert passed
         assert values == [1, 20, None]
 
+    def test_gjson_path_table(self):
+        """gjson.Get path semantics table (query_json.go:18 →
+        tidwall/gjson): wildcards match keys first-wins, `#` is array
+        length / per-element collection, no negative indices."""
+        from seaweedfs_tpu.query.json_query import _MISSING, get_path
+
+        doc = {
+            "name": {"first": "Tom", "last": "Anderson"},
+            "age": 37,
+            "children": ["Sara", "Alex", "Jack"],
+            "friends": [
+                {"first": "Dale", "last": "Murphy", "age": 44},
+                {"first": "Roger", "last": "Craig", "age": 68},
+                {"first": "Jane", "last": "Murphy"},
+            ],
+            "fav.movie": "Deer Hunter",
+        }
+        cases = [
+            # (path, expected) — mirrors the gjson README examples
+            ("name.last", "Anderson"),
+            ("age", 37),
+            ("children", ["Sara", "Alex", "Jack"]),
+            ("children.#", 3),
+            ("children.1", "Alex"),
+            ("child*.2", "Jack"),
+            ("c?ildren.0", "Sara"),
+            ("friends.#.first", ["Dale", "Roger", "Jane"]),
+            ("friends.#.age", [44, 68]),  # missing elements skipped
+            ("friends.1.last", "Craig"),
+            ("friends.#", 3),
+            ("name.*", "Tom"),  # wildcard: first matching key wins
+            ("x*", _MISSING),
+            ("children.-1", _MISSING),  # gjson has no negative indexing
+            ("children.9", _MISSING),
+            ("friends.#.nope", []),
+            ("age.#", _MISSING),  # `#` only applies to arrays
+        ]
+        for path, expect in cases:
+            got = get_path(doc, path)
+            assert got == expect or (got is expect), (path, got, expect)
+
 
 # ---------------------------------------------------------------------------
 # cluster-level: Query RPC + delta heartbeats
