@@ -444,7 +444,7 @@ class ShellCommand(Command):
 @register
 class MountCommand(Command):
     name = "mount"
-    help = "mount the filer as a FUSE filesystem (requires a fuse binding)"
+    help = "mount the filer as a FUSE filesystem (command/mount_std.go)"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
         p.add_argument("-filer", default="127.0.0.1:8888")
@@ -452,6 +452,10 @@ class MountCommand(Command):
         p.add_argument("-filer.path", dest="filer_path", default="/")
 
     def run(self, args) -> int:
+        from seaweedfs_tpu.filesys.fuse_kernel import (
+            kernel_fuse_available,
+            mount_kernel,
+        )
         from seaweedfs_tpu.filesys.mount import mount_fuse
         from seaweedfs_tpu.filesys.wfs import WfsOption
 
@@ -459,10 +463,30 @@ class MountCommand(Command):
             print("usage: mount -dir=<mountpoint>")
             return 2
         option = WfsOption(args.filer, filer_mount_root_path=args.filer_path)
+        if kernel_fuse_available():
+            # first choice: the in-repo wire-protocol transport on
+            # /dev/fuse (filesys/fuse_kernel.py) — no libfuse needed.
+            # /dev/fuse is world-rw on stock Linux but mount(2) needs
+            # CAP_SYS_ADMIN; unprivileged users fall through to fusepy
+            # (whose fusermount helper is setuid).
+            from seaweedfs_tpu.filesys.fuse_kernel import FuseProtocolError
+
+            try:
+                km = mount_kernel(option, args.dir)
+            except FuseProtocolError as e:
+                print(f"kernel mount unavailable ({e}); trying fusepy")
+            else:
+                print(f"mounted {args.filer}{args.filer_path} on {args.dir}")
+                try:
+                    km._thread.join()
+                except KeyboardInterrupt:
+                    km.unmount()
+                return 0
         try:
+            # second choice: a fusepy binding if one is installed
             mount_fuse(option, args.dir)
         except RuntimeError as e:
-            # no fuse binding in this environment; the in-process VFS
+            # no /dev/fuse and no binding; the in-process VFS
             # (seaweedfs_tpu.filesys.MountedFileSystem) is the
             # supported surface here
             print(f"mount unavailable: {e}")

@@ -273,6 +273,16 @@ def new_store(kind: str, path: str = "") -> FilerStore:
         return MemoryStore()
     if kind == "sqlite":
         return SqliteStore(path or ":memory:")
+    if kind == "sql":
+        # the abstract_sql dialect layer over stdlib sqlite3
+        # (filer2/abstract_sql/abstract_sql_store.go role)
+        from seaweedfs_tpu.filer.abstract_sql import new_sqlite_sql_store
+
+        return new_sqlite_sql_store(path or ":memory:")
+    if kind in ("mysql", "postgres"):
+        from seaweedfs_tpu.filer.abstract_sql import new_gated_sql_store
+
+        return new_gated_sql_store(kind)
     if kind == "sortedlog":
         if not path:
             raise ValueError("sortedlog store needs a path")
@@ -283,4 +293,10 @@ def new_store(kind: str, path: str = "") -> FilerStore:
         from seaweedfs_tpu.filer.lsm import LsmStore
 
         return LsmStore(path)
-    raise ValueError(f"unknown filer store {kind!r}")
+    raise ValueError(
+        f"unknown filer store {kind!r}: embedded kinds are memory | sqlite"
+        " | sql | sortedlog | lsm; mysql | postgres speak the reference"
+        " SQL dialects but need their client libraries (see"
+        " filer/abstract_sql.py); redis/cassandra/etcd/tikv have no"
+        " in-image counterpart — use an embedded store"
+    )

@@ -1,0 +1,561 @@
+"""Kernel FUSE transport: the wire protocol on /dev/fuse, no libfuse.
+
+`weed mount` in the reference attaches the WFS to a real mountpoint
+through the FUSE kernel module (command/mount_std.go:27 via
+bazil.org/fuse, itself a from-scratch wire-protocol implementation —
+the same choice made here). This module speaks that protocol directly:
+
+  * mount(2) with fstype "fuse", passing the opened /dev/fuse fd and
+    rootmode/user_id/group_id options (what fusermount does under the
+    hood; this process runs with CAP_SYS_ADMIN in the target images);
+  * a single-threaded request loop reading fuse_in_header-framed
+    requests and dispatching ~25 opcodes onto the existing
+    MountedFileSystem path API (filesys/mount.py) — the node layer,
+    dirty-page pipeline, and filer RPCs underneath are exactly the
+    ones the in-process facade exercises in CI;
+  * nodeids are handed out per path and remapped on rename, mirroring
+    bazil/fs's NodeRef bookkeeping (wfs.go:46-70 registers the same
+    maps).
+
+Struct layouts follow include/uapi/linux/fuse.h at interface 7.31
+(declared in our INIT reply; the kernel feature-gates accordingly).
+Gated at runtime on /dev/fuse being openable — sandboxes without the
+device keep the in-process MountedFileSystem surface.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import errno
+import os
+import stat as stat_mod
+import struct
+import threading
+
+from seaweedfs_tpu.filesys.mount import MountedFileSystem, OpenFile
+from seaweedfs_tpu.filesys.nodes import NotEmpty, NotFound
+from seaweedfs_tpu.util import wlog
+
+# --- wire structs (uapi/linux/fuse.h), little-endian ----------------------
+
+_IN_HDR = struct.Struct("<IIQQIIII")  # len opcode unique nodeid uid gid pid pad
+_OUT_HDR = struct.Struct("<IiQ")  # len error unique
+_ATTR = struct.Struct("<QQQQQQIIIIIIIIII")  # 88 bytes (7.9+ with blksize)
+_ENTRY_OUT = struct.Struct("<QQQQII")  # nodeid gen entry_valid attr_valid nsecs
+_INIT_IN = struct.Struct("<IIII")
+_INIT_OUT = struct.Struct("<IIIIHHIIHHI28s")  # 64 bytes (7.23+ layout)
+_GETATTR_IN = struct.Struct("<IIQ")
+_SETATTR_IN = struct.Struct("<IIQQQQQQIIIIIIII")
+_MKDIR_IN = struct.Struct("<II")
+_RENAME_IN = struct.Struct("<Q")
+_OPEN_IN = struct.Struct("<II")
+_OPEN_OUT = struct.Struct("<QII")  # fh, open_flags, padding — 16 bytes
+_READ_IN = struct.Struct("<QQIIQII")
+_WRITE_IN = struct.Struct("<QQIIQII")
+_WRITE_OUT = struct.Struct("<II")
+_RELEASE_IN = struct.Struct("<QIIQ")
+_FLUSH_IN = struct.Struct("<QIIQ")
+_FSYNC_IN = struct.Struct("<QII")
+_KSTATFS = struct.Struct("<QQQQQQIIII24x")
+_GETXATTR_IN = struct.Struct("<II")
+_CREATE_IN = struct.Struct("<IIII")
+_DIRENT_HDR = struct.Struct("<QQII")
+
+# opcodes
+LOOKUP, FORGET, GETATTR, SETATTR, READLINK, SYMLINK = 1, 2, 3, 4, 5, 6
+MKDIR, UNLINK, RMDIR, RENAME, LINK, OPEN, READ, WRITE = 9, 10, 11, 12, 13, 14, 15, 16
+STATFS, RELEASE, FSYNC, SETXATTR, GETXATTR, LISTXATTR = 17, 18, 20, 21, 22, 23
+REMOVEXATTR, FLUSH, INIT, OPENDIR, READDIR, RELEASEDIR = 24, 25, 26, 27, 28, 29
+FSYNCDIR, ACCESS, CREATE, INTERRUPT, DESTROY, RENAME2 = 30, 34, 35, 36, 38, 45
+BATCH_FORGET = 42
+_NO_REPLY = {FORGET, BATCH_FORGET, INTERRUPT}
+
+FATTR_MODE, FATTR_UID, FATTR_GID, FATTR_SIZE = 1 << 0, 1 << 1, 1 << 2, 1 << 3
+
+_MAX_WRITE = 128 * 1024
+_TTL_SEC = 1  # entry/attr cache validity handed to the kernel
+
+
+class FuseProtocolError(RuntimeError):
+    pass
+
+
+def _libc():
+    return ctypes.CDLL(ctypes.util.find_library("c") or "libc.so.6", use_errno=True)
+
+
+def kernel_fuse_available() -> bool:
+    """True when this process can open /dev/fuse (the runtime gate)."""
+    try:
+        fd = os.open("/dev/fuse", os.O_RDWR)
+    except OSError:
+        return False
+    os.close(fd)
+    return True
+
+
+class KernelFuseMount:
+    """One kernel mountpoint served by a MountedFileSystem."""
+
+    def __init__(self, mfs: MountedFileSystem, mountpoint: str):
+        self.mfs = mfs
+        self.mountpoint = os.path.abspath(mountpoint)
+        self._fd = -1
+        self._nodes: dict[int, str] = {1: "/"}  # nodeid -> mfs path
+        self._ids: dict[str, int] = {"/": 1}
+        self._nlookup: dict[int, int] = {}  # kernel reference counts
+        self._next_node = 2
+        self._handles: dict[int, OpenFile] = {}
+        self._dirbufs: dict[int, bytes] = {}
+        self._next_fh = 1
+        self._alive = False
+        self._thread: threading.Thread | None = None
+
+    # --- mount / unmount --------------------------------------------------
+    def mount(self) -> None:
+        self._fd = os.open("/dev/fuse", os.O_RDWR)
+        opts = (
+            f"fd={self._fd},rootmode=40000,"
+            f"user_id={os.getuid()},group_id={os.getgid()},"
+            f"max_read={_MAX_WRITE}"
+        ).encode()
+        libc = _libc()
+        ret = libc.mount(
+            b"seaweedfs", self.mountpoint.encode(), b"fuse.seaweedfs", 0, opts
+        )
+        if ret != 0:
+            err = ctypes.get_errno()
+            os.close(self._fd)
+            self._fd = -1
+            raise FuseProtocolError(
+                f"mount({self.mountpoint}): {os.strerror(err)} "
+                "(needs CAP_SYS_ADMIN; as non-root use fusermount)"
+            )
+        self._alive = True
+
+    def unmount(self) -> None:
+        self._alive = False
+        libc = _libc()
+        MNT_DETACH = 2
+        # order matters: umount first (wakes the serve thread's blocked
+        # read with ENODEV), join it, and only THEN close the fd — a
+        # close while the thread may still enter os.read would race the
+        # fd number being recycled into an unrelated descriptor
+        libc.umount2(self.mountpoint.encode(), MNT_DETACH)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._fd >= 0:
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = -1
+
+    def serve_background(self) -> None:
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread.start()
+
+    # --- request loop -----------------------------------------------------
+    def serve_forever(self) -> None:
+        bufsize = _MAX_WRITE + 4096
+        while self._alive:
+            try:
+                req = os.read(self._fd, bufsize)
+            except OSError as e:
+                if e.errno == errno.ENODEV:  # unmounted
+                    break
+                if e.errno in (errno.EINTR, errno.EAGAIN):
+                    continue
+                break
+            if len(req) < _IN_HDR.size:
+                continue
+            (_len, opcode, unique, nodeid, uid, gid, _pid, _pad) = _IN_HDR.unpack_from(
+                req
+            )
+            body = req[_IN_HDR.size : _len]
+            try:
+                out = self._dispatch(opcode, nodeid, body)
+            except NotFound:
+                out = -errno.ENOENT
+            except NotEmpty:
+                out = -errno.ENOTEMPTY
+            except FileExistsError:
+                out = -errno.EEXIST
+            except IsADirectoryError:
+                out = -errno.EISDIR
+            except KeyError:
+                out = -errno.ENOENT
+            except OSError as e:
+                out = -(e.errno or errno.EIO)
+            except Exception as e:  # noqa: BLE001 — a 500 is EIO, not a crash
+                wlog.warning("fuse op %d failed: %s", opcode, e)
+                out = -errno.EIO
+            if opcode in _NO_REPLY:
+                continue
+            if opcode == DESTROY:
+                self._reply(unique, b"")
+                break
+            if isinstance(out, int):
+                self._reply_err(unique, out)
+            else:
+                self._reply(unique, out)
+
+    def _reply(self, unique: int, payload: bytes) -> None:
+        try:
+            os.write(
+                self._fd,
+                _OUT_HDR.pack(_OUT_HDR.size + len(payload), 0, unique) + payload,
+            )
+        except OSError:
+            pass  # request aborted (e.g. interrupted read)
+
+    def _reply_err(self, unique: int, negerrno: int) -> None:
+        try:
+            os.write(self._fd, _OUT_HDR.pack(_OUT_HDR.size, negerrno, unique))
+        except OSError:
+            pass
+
+    # --- node bookkeeping ---------------------------------------------------
+    def _path(self, nodeid: int) -> str:
+        return self._nodes[nodeid]
+
+    def _node_for(self, path: str) -> int:
+        nid = self._ids.get(path)
+        if nid is None:
+            nid = self._next_node
+            self._next_node += 1
+            self._ids[path] = nid
+            self._nodes[nid] = path
+        return nid
+
+    def _child(self, nodeid: int, name: str) -> str:
+        parent = self._path(nodeid)
+        return (parent.rstrip("/") + "/" + name) if name else parent
+
+    def _rekey(self, old: str, new: str) -> None:
+        """Rename moved a subtree: remap every known path under it."""
+        prefix = old.rstrip("/") + "/"
+        for nid, p in list(self._nodes.items()):
+            if p == old or p.startswith(prefix):
+                np = new + p[len(old) :]
+                del self._ids[p]
+                self._ids[np] = nid
+                self._nodes[nid] = np
+
+    # --- attr marshalling ---------------------------------------------------
+    def _attr_bytes(self, path: str, nodeid: int) -> bytes:
+        st = self.mfs.stat(path)
+        size = st.size
+        mode = st.mode or 0
+        if st.is_dir:
+            mode = stat_mod.S_IFDIR | (mode & 0o7777 or 0o755)
+        elif not stat_mod.S_IFMT(mode):
+            mode |= stat_mod.S_IFREG
+        if not (mode & 0o7777):
+            mode |= 0o644
+        mtime = int(getattr(st, "mtime", 0) or 0)
+        return _ATTR.pack(
+            nodeid,  # ino
+            size,
+            (size + 511) // 512,  # blocks
+            mtime,
+            mtime,
+            mtime,
+            0,
+            0,
+            0,
+            mode,
+            2 if st.is_dir else 1,
+            getattr(st, "uid", 0) or 0,
+            getattr(st, "gid", 0) or 0,
+            0,  # rdev
+            4096,  # blksize
+            0,
+        )
+
+    def _entry_out(self, path: str) -> bytes:
+        nid = self._node_for(path)
+        # each entry reply the kernel keeps counts as one lookup; the
+        # matching FORGET(nlookup) releases them (bazil fs NodeRef role)
+        self._nlookup[nid] = self._nlookup.get(nid, 0) + 1
+        return (
+            _ENTRY_OUT.pack(nid, 0, _TTL_SEC, _TTL_SEC, 0, 0)
+            + self._attr_bytes(path, nid)
+        )
+
+    def _forget(self, nodeid: int, nlookup: int) -> None:
+        if nodeid == 1:
+            return
+        left = self._nlookup.get(nodeid, 0) - nlookup
+        if left > 0:
+            self._nlookup[nodeid] = left
+            return
+        self._nlookup.pop(nodeid, None)
+        path = self._nodes.pop(nodeid, None)
+        if path is not None and self._ids.get(path) == nodeid:
+            del self._ids[path]
+
+    def _attr_out(self, path: str, nodeid: int) -> bytes:
+        return struct.pack("<QII", _TTL_SEC, 0, 0) + self._attr_bytes(path, nodeid)
+
+    # --- dispatch -----------------------------------------------------------
+    def _dispatch(self, opcode: int, nodeid: int, body: bytes):
+        if opcode == INIT:
+            major, minor, _ra, kflags = _INIT_IN.unpack_from(body)
+            if major < 7:
+                raise FuseProtocolError(f"kernel FUSE {major}.{minor} too old")
+            FUSE_BIG_WRITES = 1 << 5  # WRITEs up to max_write, not 1 page
+            FUSE_MAX_PAGES = 1 << 22  # honor our max_pages field
+            # reply flags must be a subset of what the kernel offered
+            flags = kflags & (FUSE_BIG_WRITES | FUSE_MAX_PAGES)
+            return _INIT_OUT.pack(
+                7, 31, 128 * 1024, flags, 12, 10, _MAX_WRITE, 1,
+                _MAX_WRITE // 4096, 0, 0, b"",
+            )
+        if opcode == LOOKUP:
+            name = body.rstrip(b"\0").decode()
+            path = self._child(nodeid, name)
+            if not self.mfs.exists(path):
+                raise NotFound(path)
+            return self._entry_out(path)
+        if opcode == FORGET:
+            (nlookup,) = struct.unpack_from("<Q", body)
+            self._forget(nodeid, nlookup)
+            return b""  # no reply sent (see _NO_REPLY)
+        if opcode == BATCH_FORGET:
+            count, _dummy = struct.unpack_from("<II", body)
+            off = 8
+            for _ in range(count):
+                nid, nlookup = struct.unpack_from("<QQ", body, off)
+                off += 16
+                self._forget(nid, nlookup)
+            return b""
+        if opcode == INTERRUPT:
+            return b""
+        if opcode == GETATTR:
+            _gflags, _d, fh = _GETATTR_IN.unpack_from(body)
+            return self._attr_out(self._path(nodeid), nodeid)
+        if opcode == SETATTR:
+            return self._setattr(nodeid, body)
+        if opcode == READLINK:
+            return self.mfs.readlink(self._path(nodeid)).encode()
+        if opcode == SYMLINK:
+            name, target = body.split(b"\0")[:2]
+            path = self._child(nodeid, name.decode())
+            self.mfs.symlink(target.decode(), path)
+            return self._entry_out(path)
+        if opcode == MKDIR:
+            mode, _umask = _MKDIR_IN.unpack_from(body)
+            name = body[_MKDIR_IN.size :].rstrip(b"\0").decode()
+            path = self._child(nodeid, name)
+            self.mfs.mkdir(path, mode & 0o7777)
+            return self._entry_out(path)
+        if opcode == UNLINK:
+            self.mfs.unlink(self._child(nodeid, body.rstrip(b"\0").decode()))
+            return b""
+        if opcode == RMDIR:
+            self.mfs.rmdir(self._child(nodeid, body.rstrip(b"\0").decode()))
+            return b""
+        if opcode in (RENAME, RENAME2):
+            if opcode == RENAME2:
+                hdr = struct.Struct("<QII")
+                newdir, rflags, _pad = hdr.unpack_from(body)
+            else:
+                hdr = _RENAME_IN
+                newdir, rflags = hdr.unpack_from(body)[0], 0
+            oldname, newname = body[hdr.size :].split(b"\0")[:2]
+            old = self._child(nodeid, oldname.decode())
+            new = self._child(newdir, newname.decode())
+            RENAME_NOREPLACE, RENAME_EXCHANGE = 1, 2
+            if rflags & ~RENAME_NOREPLACE:
+                return -errno.EINVAL  # EXCHANGE/WHITEOUT unsupported
+            if rflags & RENAME_NOREPLACE and self.mfs.exists(new):
+                return -errno.EEXIST
+            self.mfs.rename(old, new)
+            self._rekey(old, new)
+            return b""
+        if opcode in (OPEN, OPENDIR):
+            flags, _ = _OPEN_IN.unpack_from(body)
+            return self._open(opcode, nodeid, flags)
+        if opcode == READ:
+            fh, offset, size, *_ = _READ_IN.unpack_from(body)
+            f = self._handles[fh]
+            f.seek(offset)
+            return f.read(size)
+        if opcode == WRITE:
+            fh, offset, size, *_ = _WRITE_IN.unpack_from(body)
+            data = body[_WRITE_IN.size : _WRITE_IN.size + size]
+            f = self._handles[fh]
+            f.seek(offset)
+            return _WRITE_OUT.pack(f.write(data), 0)
+        if opcode == STATFS:
+            return _KSTATFS.pack(1 << 30, 1 << 29, 1 << 29, 1 << 20, 1 << 19, 0,
+                                 4096, 255, 4096, 0)
+        if opcode in (RELEASE, RELEASEDIR):
+            fh, *_ = _RELEASE_IN.unpack_from(body)
+            self._dirbufs.pop(fh, None)
+            f = self._handles.pop(fh, None)
+            if f is not None:
+                f.close()
+            return b""
+        if opcode == FLUSH:
+            fh, *_ = _FLUSH_IN.unpack_from(body)
+            f = self._handles.get(fh)
+            if f is not None:
+                f.flush()
+            return b""
+        if opcode in (FSYNC, FSYNCDIR):
+            fh, *_ = _FSYNC_IN.unpack_from(body)
+            f = self._handles.get(fh)
+            if f is not None:
+                f.flush()
+            return b""
+        if opcode == READDIR:
+            fh, offset, size, *_ = _READ_IN.unpack_from(body)
+            buf = self._dirbufs.get(fh)
+            if buf is None or offset == 0:
+                buf = self._dirents(nodeid)
+                self._dirbufs[fh] = buf
+            # whole records only: the kernel cannot parse a dirent cut
+            # mid-record, so stop at the last boundary that fits
+            end = offset
+            while end < len(buf):
+                namelen = _DIRENT_HDR.unpack_from(buf, end)[2]
+                rec = _DIRENT_HDR.size + namelen
+                rec += -rec % 8
+                if end + rec - offset > size:
+                    break
+                end += rec
+            return buf[offset:end]
+        if opcode == ACCESS:
+            return b""  # permission model is the filer's, not the kernel's
+        if opcode == CREATE:
+            flags, mode, _umask, _of = _CREATE_IN.unpack_from(body)
+            name = body[_CREATE_IN.size :].rstrip(b"\0").decode()
+            path = self._child(nodeid, name)
+            f = self.mfs.open(path, "w")
+            fh = self._next_fh
+            self._next_fh += 1
+            self._handles[fh] = f
+            return self._entry_out(path) + _OPEN_OUT.pack(fh, 0, 0)
+        if opcode == SETXATTR:
+            xattr_hdr = struct.Struct("<II")
+            vsize, _flags = xattr_hdr.unpack_from(body)
+            rest = body[xattr_hdr.size :]
+            name, rest = rest.split(b"\0", 1)
+            self.mfs.setxattr(self._path(nodeid), name.decode(), rest[:vsize])
+            return b""
+        if opcode == GETXATTR:
+            vsize, _pad = _GETXATTR_IN.unpack_from(body)
+            name = body[_GETXATTR_IN.size :].rstrip(b"\0").decode()
+            try:
+                value = self.mfs.getxattr(self._path(nodeid), name)
+            except (KeyError, NotFound, AttributeError):
+                # AttributeError: Dir nodes carry no xattrs — `ls -la`
+                # probes security.* on every directory
+                return -errno.ENODATA
+            if vsize == 0:
+                return struct.pack("<II", len(value), 0)
+            if len(value) > vsize:
+                return -errno.ERANGE
+            return value
+        if opcode == LISTXATTR:
+            vsize, _pad = _GETXATTR_IN.unpack_from(body)
+            try:
+                xnames = self.mfs.listxattr(self._path(nodeid))
+            except (NotFound, AttributeError):
+                xnames = []
+            names = b"".join(n.encode() + b"\0" for n in xnames)
+            if vsize == 0:
+                return struct.pack("<II", len(names), 0)
+            if len(names) > vsize:
+                return -errno.ERANGE
+            return names
+        if opcode == REMOVEXATTR:
+            name = body.rstrip(b"\0").decode()
+            d, fname = self.mfs._split(self._path(nodeid))
+            from seaweedfs_tpu.filesys.nodes import Dir
+
+            Dir(self.mfs.wfs, d).lookup(fname).remove_xattr(name)
+            return b""
+        if opcode == DESTROY:
+            return b""
+        return -errno.ENOSYS
+
+    def _open(self, opcode: int, nodeid: int, flags: int):
+        path = self._path(nodeid)
+        fh = self._next_fh
+        self._next_fh += 1
+        if opcode == OPENDIR:
+            self._dirbufs[fh] = self._dirents(nodeid)
+            return _OPEN_OUT.pack(fh, 0, 0)
+        acc = flags & os.O_ACCMODE
+        if flags & os.O_TRUNC:
+            self.mfs.truncate(path, 0)
+        mode = "r" if acc == os.O_RDONLY else "r+"
+        self._handles[fh] = self.mfs.open(path, mode)
+        return _OPEN_OUT.pack(fh, 0, 0)
+
+    def _setattr(self, nodeid: int, body: bytes):
+        (valid, _pad, fh, size, _lock, _at, mt, _ct, _ans, _mns, _cns,
+         mode, _u4, uid, gid, _u5) = _SETATTR_IN.unpack_from(body)
+        path = self._path(nodeid)
+        if valid & FATTR_SIZE:
+            f = self._handles.get(fh)
+            if f is not None:
+                f.flush()
+            self.mfs.truncate(path, size)
+        if valid & (FATTR_MODE | FATTR_UID | FATTR_GID):
+            from seaweedfs_tpu.filesys.nodes import Dir
+
+            d, fname = self.mfs._split(path)
+            if fname:
+                node = Dir(self.mfs.wfs, d).lookup(fname)
+                ent = node.entry if hasattr(node, "entry") else None
+                if ent is not None:
+                    if valid & FATTR_MODE:
+                        ent.attributes.file_mode = mode
+                    if valid & FATTR_UID:
+                        ent.attributes.uid = uid
+                    if valid & FATTR_GID:
+                        ent.attributes.gid = gid
+                    if hasattr(node, "save"):
+                        node.save()
+        return self._attr_out(path, nodeid)
+
+    def _dirents(self, nodeid: int) -> bytes:
+        from seaweedfs_tpu.filesys.nodes import Dir
+
+        path = self._path(nodeid)
+        entries = [(".", nodeid, 4), ("..", 1, 4)]
+        for e in Dir(self.mfs.wfs, self.mfs._full(path)).readdir():
+            mode = e.attributes.file_mode
+            dtype = (
+                4
+                if e.is_directory
+                else (10 if stat_mod.S_ISLNK(mode) else 8)
+            )
+            child = self._child(nodeid, e.name)
+            entries.append((e.name, self._node_for(child), dtype))
+        out = bytearray()
+        for name, ino, dtype in entries:
+            nb = name.encode()
+            reclen = _DIRENT_HDR.size + len(nb)
+            padded = reclen + (-reclen % 8)
+            # `off` is the kernel's resume cookie: the byte offset of
+            # the NEXT record in this buffer (READDIR slices by it)
+            out += _DIRENT_HDR.pack(ino, len(out) + padded, len(nb), dtype)
+            out += nb + b"\0" * (padded - reclen)
+        return bytes(out)
+
+
+def mount_kernel(option, mountpoint: str) -> KernelFuseMount:
+    """Mount and serve in a background thread; returns the mount for
+    unmount(). Raises FuseProtocolError when /dev/fuse is unusable."""
+    mfs = MountedFileSystem(option)
+    km = KernelFuseMount(mfs, mountpoint)
+    km.mount()
+    km.serve_background()
+    return km

@@ -66,6 +66,10 @@ def _vol_info_from_pb(v: pb.VolumeStat) -> VolumeInfo:
     )
 
 
+# /submit buffers its body in master memory; cap it (see _submit)
+SUBMIT_MAX_BYTES = 256 * 1024 * 1024
+
+
 class MasterServer:
     def __init__(
         self,
@@ -703,6 +707,20 @@ class MasterServer:
                     return self._json(
                         {"error": "maxMB / Content-Length must be integers"},
                         400,
+                    )
+                if length > SUBMIT_MAX_BYTES:
+                    # /submit buffers the upload in master memory before
+                    # assign+proxy (the convenience path); bound it so a
+                    # huge or malicious body can't OOM the control
+                    # plane — bulk ingest belongs on the volume/filer
+                    # data planes
+                    return self._json(
+                        {
+                            "error": f"/submit caps uploads at "
+                            f"{SUBMIT_MAX_BYTES >> 20} MiB; upload via "
+                            "assign + volume POST (or the filer) instead"
+                        },
+                        413,
                     )
                 body = self.rfile.read(length)
                 try:

@@ -211,6 +211,12 @@ def _lsm_factory(tmp):
     return LsmStore(str(tmp / "lsm"))
 
 
+def _sql_factory(tmp):
+    from seaweedfs_tpu.filer.abstract_sql import new_sqlite_sql_store
+
+    return new_sqlite_sql_store(str(tmp / "filer.sql.db"))
+
+
 @pytest.mark.parametrize(
     "store_factory",
     [
@@ -218,8 +224,9 @@ def _lsm_factory(tmp):
         lambda tmp: SqliteStore(str(tmp / "filer.db")),
         lambda tmp: SortedLogStore(str(tmp / "filer.log")),
         _lsm_factory,
+        _sql_factory,
     ],
-    ids=["memory", "sqlite", "sortedlog", "lsm"],
+    ids=["memory", "sqlite", "sortedlog", "lsm", "sql"],
 )
 class TestFilerStores:
     def test_crud_and_list(self, store_factory, tmp_path):
@@ -258,6 +265,86 @@ class TestFilerStores:
         assert got.chunks[0].size == 100
         assert got.attr.mime == "application/x-bin"
         store.close()
+
+
+class TestAbstractSql:
+    """The dialect layer itself (filer2/abstract_sql/): dirhash
+    compatibility, dialect SQL parity, gating of driverless kinds."""
+
+    def test_dirhash_matches_reference_fold(self):
+        """HashStringToLong (util/bytes.go:53) = first 8 md5 bytes
+        folded big-endian into a SIGNED int64. Golden values pinned so
+        the schema stays row-compatible with reference deployments."""
+        from seaweedfs_tpu.filer.abstract_sql import hash_string_to_long
+
+        assert hash_string_to_long("/home/user") == 1669289113769266586
+        assert hash_string_to_long("/") == 7378810950367401542
+        assert hash_string_to_long("") == -3162216497309240828  # sign wrap
+
+    def test_mysql_postgres_dialects_mirror_reference_sql(self):
+        """Each dialect's statements are the reference's verbatim SQL
+        shapes (mysql_store.go:45-52, postgres_store.go:47-54)."""
+        from seaweedfs_tpu.filer.abstract_sql import (
+            MYSQL_DIALECT,
+            POSTGRES_DIALECT,
+        )
+
+        assert (
+            MYSQL_DIALECT.insert
+            == "INSERT INTO filemeta (dirhash,name,directory,meta) VALUES(%s,%s,%s,%s)"
+        )
+        assert "name>=%s" in MYSQL_DIALECT.list_inclusive
+        assert (
+            POSTGRES_DIALECT.update
+            == "UPDATE filemeta SET meta=$1 WHERE dirhash=$2 AND name=$3 AND directory=$4"
+        )
+        assert "name>$2" in POSTGRES_DIALECT.list_exclusive
+
+    def test_gated_kinds_raise_with_guidance(self):
+        from seaweedfs_tpu.filer.filerstore import new_store
+
+        for kind in ("mysql", "postgres"):
+            with pytest.raises(RuntimeError, match="client library"):
+                new_store(kind)
+        with pytest.raises(ValueError, match="embedded kinds"):
+            new_store("cassandra")
+
+    def test_insert_degrades_to_update_on_duplicate(self, tmp_path):
+        from seaweedfs_tpu.filer.filerstore import new_store
+
+        s = new_store("sql", str(tmp_path / "d.db"))
+        s.insert_entry(Entry("/a/x", attr=Attr(mtime=1)))
+        s.insert_entry(Entry("/a/x", attr=Attr(mtime=2)))  # dup key
+        assert s.find_entry("/a/x").attr.mtime == 2
+        s.close()
+
+    def test_transaction_rollback_undoes_batch(self, tmp_path):
+        from seaweedfs_tpu.filer.filerstore import new_store
+
+        s = new_store("sql", str(tmp_path / "t.db"))
+        s.insert_entry(Entry("/a/keep", attr=Attr(mtime=1)))
+        s.begin_transaction()
+        s.insert_entry(Entry("/a/tmp1", attr=Attr(mtime=2)))
+        s.delete_entry("/a/keep")
+        s.rollback_transaction()
+        assert s.find_entry("/a/keep").attr.mtime == 1
+        with pytest.raises(EntryNotFound):
+            s.find_entry("/a/tmp1")
+        s.close()
+
+    def test_filer_atomic_rename_over_sql_store(self, tmp_path):
+        """The Filer's AtomicRenameEntry runs inside the store tx hooks
+        — the seam the reference created abstract_sql's BeginTransaction
+        for (filer_grpc_server_rename.go)."""
+        from seaweedfs_tpu.filer.filer import Filer
+        from seaweedfs_tpu.filer.filerstore import new_store
+
+        f = Filer(new_store("sql", str(tmp_path / "r.db")))
+        f.create_entry(Entry("/dir/old", attr=Attr(mtime=1)))
+        f.atomic_rename("/dir/old", "/dir/new")
+        assert f.find_entry("/dir/new").attr.mtime == 1
+        with pytest.raises(EntryNotFound):
+            f.find_entry("/dir/old")
 
 
 class TestSortedLogPersistence:
