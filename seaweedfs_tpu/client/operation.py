@@ -86,6 +86,48 @@ def assign(
     ttl: str = "",
     data_center: str = "",
 ) -> AssignResult:
+    """Assign over the pooled keep-alive HTTP plane (/dir/assign).
+
+    The reference's operation.Assign rides gRPC; in Python, a unary
+    grpc call costs several times a pooled http.client round-trip on
+    the CPython side (measured: the benchmark writer spends more in
+    grpc channel machinery than in the upload itself), so the hot
+    path uses HTTP and `assign_grpc` remains for gRPC-plane parity."""
+    params = {"count": str(count)}
+    if replication:
+        params["replication"] = replication
+    if collection:
+        params["collection"] = collection
+    if ttl:
+        params["ttl"] = ttl
+    if data_center:
+        params["dataCenter"] = data_center
+    q = urllib.parse.urlencode(params)
+    status, _, body = http_call("GET", f"{master}/dir/assign?{q}", timeout=30)
+    try:
+        d = json.loads(body)
+    except ValueError:
+        raise RuntimeError(f"assign: bad response {body[:200]!r}")
+    if status != 200 or d.get("error"):
+        raise RuntimeError(f"assign: {d.get('error', f'http {status}')}")
+    return AssignResult(
+        d["fid"],
+        d["url"],
+        d.get("publicUrl", d["url"]),
+        d.get("count", count),
+        auth=d.get("auth", ""),
+    )
+
+
+def assign_grpc(
+    master: str,
+    count: int = 1,
+    replication: str = "",
+    collection: str = "",
+    ttl: str = "",
+    data_center: str = "",
+) -> AssignResult:
+    """gRPC Assign (the reference's wire, master_grpc_server.go)."""
     ch = rpc.cached_channel(grpc_address(master))
     resp = rpc.master_stub(ch).Assign(
         master_pb2.AssignRequest(
@@ -129,14 +171,132 @@ class UploadResult:
 _http_pool = threading.local()
 
 
-class _NoDelayHTTPConnection(http.client.HTTPConnection):
-    """HTTPConnection with Nagle off: request headers and body are two
-    small writes; with Nagle on, the body waits ~40 ms for the server's
-    delayed ACK on every pooled request."""
+class _RawHTTPConnection:
+    """Minimal HTTP/1.1 client connection on a raw socket.
 
-    def connect(self):
-        super().connect()
+    http.client routes every response through the email-parser header
+    machinery (policy objects, MIME content-type parsing); under the
+    write benchmark that parsing costs more CPU than the needle append
+    being benchmarked. This class composes the request in one buffer
+    (one sendall — with Nagle disabled so nothing waits on a delayed
+    ACK) and parses responses with a split-on-colon loop into the
+    case-insensitive FastHeaders map. Supports what the cluster's own
+    servers speak: HTTP/1.1 keep-alive, Content-Length and chunked
+    bodies, 100-continue interim responses."""
+
+    def __init__(self, host: str, port: int, timeout: float):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, True)
+        self.rfile = self.sock.makefile("rb", buffering=65536)
+        self.timeout = timeout
+        self._host = host if port == 80 else f"{host}:{port}"
+
+    def settimeout(self, timeout: float) -> None:
+        self.timeout = timeout
+        self.sock.settimeout(timeout)
+
+    def close(self) -> None:
+        for closer in (self.rfile.close, self.sock.close):
+            try:
+                closer()
+            except OSError:
+                pass
+
+    def send_request(
+        self, method: str, path: str, body: bytes | None, headers: dict
+    ) -> None:
+        buf = bytearray(
+            f"{method} {path} HTTP/1.1\r\nHost: {self._host}\r\n".encode("latin-1")
+        )
+        for k, v in headers.items():
+            buf += f"{k}: {v}\r\n".encode("latin-1")
+        if body is not None or method in ("POST", "PUT"):
+            buf += b"Content-Length: %d\r\n" % (len(body) if body else 0)
+        buf += b"\r\n"
+        if body:
+            buf += body
+        self.sock.sendall(buf)
+
+    def _read_exact(self, n: int) -> bytes:
+        data = self.rfile.read(n)
+        if len(data) != n:
+            raise http.client.IncompleteRead(data, n - len(data))
+        return data
+
+    def read_response(self, method: str):
+        """(status, FastHeaders, body, will_close)."""
+        from seaweedfs_tpu.util.httpd import FastHeaders
+
+        while True:
+            line = self.rfile.readline(65537)
+            if not line:
+                raise http.client.RemoteDisconnected("no status line")
+            parts = line.decode("latin-1").rstrip("\r\n").split(None, 2)
+            if len(parts) < 2 or not parts[0].startswith("HTTP/"):
+                raise http.client.BadStatusLine(
+                    line.decode("latin-1", "replace")
+                )
+            try:
+                version, status = parts[0], int(parts[1])
+            except ValueError:
+                raise http.client.BadStatusLine(
+                    line.decode("latin-1", "replace")
+                ) from None
+            headers = FastHeaders()
+            while True:
+                hline = self.rfile.readline(65537)
+                if hline in (b"\r\n", b"\n", b""):
+                    break
+                key, sep, value = hline.decode("latin-1").partition(":")
+                if sep:
+                    headers[key.strip().lower()] = value.strip()
+            if status != 100:
+                break
+            # 100 Continue: interim — the real response follows
+        conn_tok = headers.get("connection", "").lower()
+        will_close = conn_tok == "close" or (
+            version == "HTTP/1.0" and conn_tok != "keep-alive"
+        )
+        body = b""
+        if method != "HEAD" and status not in (204, 304):
+            if "chunked" in headers.get("transfer-encoding", "").lower():
+                pieces = []
+                while True:
+                    szline = self.rfile.readline(65537).strip()
+                    if not szline:
+                        # EOF mid-body is truncation, NOT a terminal
+                        # 0-size chunk — callers must never get a
+                        # partial body under a 200
+                        raise http.client.IncompleteRead(
+                            b"".join(pieces)
+                        )
+                    try:
+                        size = int(szline.split(b";")[0], 16)
+                    except ValueError:
+                        raise http.client.HTTPException(
+                            f"bad chunk size {szline[:32]!r}"
+                        ) from None
+                    if size == 0:
+                        while True:  # trailers until blank line
+                            t = self.rfile.readline(65537)
+                            if t in (b"\r\n", b"\n", b""):
+                                break
+                        break
+                    pieces.append(self._read_exact(size))
+                    self.rfile.readline(65537)  # CRLF after each chunk
+                body = b"".join(pieces)
+            elif "content-length" in headers:
+                try:
+                    n = int(headers["content-length"])
+                except ValueError:
+                    raise http.client.HTTPException(
+                        f"bad Content-Length {headers['content-length']!r}"
+                    ) from None
+                body = self._read_exact(n)
+            else:
+                body = self.rfile.read()  # EOF-delimited (HTTP/1.0 style)
+                will_close = True
+        return status, headers, body, will_close
 
 
 def _pooled_conn(netloc: str, timeout: float):
@@ -150,16 +310,14 @@ def _pooled_conn(netloc: str, timeout: float):
     c = conns.get(netloc)
     if c is None:
         host, _, port = netloc.partition(":")
-        c = _NoDelayHTTPConnection(host, int(port or 80), timeout=timeout)
+        c = _RawHTTPConnection(host, int(port or 80), timeout=timeout)
         conns[netloc] = c
         return c, False
     if c.timeout != timeout:
         # the pool caches the connection, not the first caller's
         # deadline: re-arm per call
-        c.timeout = timeout
-        if c.sock is not None:
-            c.sock.settimeout(timeout)
-    return c, c.sock is not None
+        c.settimeout(timeout)
+    return c, True
 
 
 def _drop_conn(netloc: str) -> None:
@@ -192,10 +350,9 @@ def http_call(
             c, reused = _pooled_conn(netloc, timeout)
             sent = False
             try:
-                c.request(method, path, body=body, headers=headers)
+                c.send_request(method, path, body, headers)
                 sent = True
-                resp = c.getresponse()
-                data = resp.read()
+                status, rheaders, data, will_close = c.read_response(method)
                 break
             except (http.client.HTTPException, OSError) as e:
                 _drop_conn(netloc)
@@ -215,9 +372,11 @@ def http_call(
                 ):
                     continue  # next _pooled_conn dials fresh (sock is gone)
                 raise
-        if resp.status in (301, 302, 303, 307, 308):
-            loc = resp.getheader("Location", "")
+        if status in (301, 302, 303, 307, 308):
+            loc = rheaders.get("Location", "")
             if loc:
+                if will_close:
+                    _drop_conn(netloc)
                 target = urllib.parse.urljoin(f"http://{url}", loc)
                 t_scheme, _, t_rest = target.partition("://")
                 if t_scheme != "http":
@@ -229,19 +388,19 @@ def http_call(
                     # a redirect that changes host must not carry the
                     # caller's write JWT to the new host
                     headers.pop("Authorization", None)
-                if resp.status in (301, 302, 303) and method == "POST":
+                if status in (301, 302, 303) and method == "POST":
                     # urllib/Go both redirect POST as a body-less GET
                     # for 301/302/303; only 307/308 preserve the method
                     method, body = "GET", None
                     headers.pop("Content-Type", None)
                 url = t_rest
                 continue
-        if resp.will_close or resp.status >= 400:
+        if will_close or status >= 400:
             # >=400: error handlers may reply before draining the
             # request body, leaving body bytes in the socket — reusing
             # the connection would parse them as the next request line
             _drop_conn(netloc)
-        return resp.status, dict(resp.getheaders()), data
+        return status, rheaders, data
     raise RuntimeError(f"{method} {url}: too many redirects")
 
 

@@ -36,7 +36,7 @@ from urllib.parse import parse_qs, urlparse
 import grpc
 
 from seaweedfs_tpu.pb import master_pb2 as pb
-from seaweedfs_tpu.util.httpd import WeedHTTPServer
+from seaweedfs_tpu.util.httpd import FastRequestMixin, WeedHTTPServer
 from seaweedfs_tpu.pb import rpc, volume_pb2
 from seaweedfs_tpu.sequence import MemorySequencer
 from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
@@ -606,30 +606,41 @@ class MasterServer:
     def _http_handler_class(self):
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(FastRequestMixin, BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *args):  # quiet
                 pass
 
             def _html(self, body: str, status=200):
-                data = body.encode()
-                self.send_response(status)
-                self.send_header("Content-Type", "text/html; charset=utf-8")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                self.fast_reply(
+                    status,
+                    body.encode(),
+                    {"Content-Type": "text/html; charset=utf-8"},
+                )
 
             def _json(self, obj, status=200):
-                body = json.dumps(obj).encode()
-                self.send_response(status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self.fast_reply(
+                    status,
+                    json.dumps(obj).encode(),
+                    {"Content-Type": "application/json"},
+                )
 
             def do_GET(self):
                 server.request_counter.add()
                 url = urlparse(self.path)
                 q = {k: v[0] for k, v in parse_qs(url.query).items()}
+                if self.command == "POST" and url.path != "/submit":
+                    # keep-alive hygiene: drain any request body now —
+                    # an unread body would be parsed as the next
+                    # request line on this connection (/submit reads
+                    # its own body in _submit)
+                    try:
+                        n = int(self.headers.get("Content-Length", "0"))
+                    except ValueError:
+                        n = 0
+                    if n > 0:
+                        self.rfile.read(n)
                 if url.path == "/dir/assign":
                     return self._assign(q)
                 if url.path == "/dir/lookup":

@@ -37,7 +37,7 @@ import grpc
 from seaweedfs_tpu.ec import ec_files
 from seaweedfs_tpu.ec.ec_volume import EcVolume, NotEnoughShards
 from seaweedfs_tpu.pb import master_pb2, rpc, volume_pb2 as pb
-from seaweedfs_tpu.util.httpd import WeedHTTPServer
+from seaweedfs_tpu.util.httpd import FastRequestMixin, WeedHTTPServer
 from seaweedfs_tpu.storage.file_id import FileId
 from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.store import Store
@@ -908,20 +908,14 @@ class VolumeServer:
     def _http_handler_class(self):
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(FastRequestMixin, BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
             def log_message(self, *args):
                 pass
 
             def _reply(self, status, body=b"", headers=None):
-                self.send_response(status)
-                for k, v in (headers or {}).items():
-                    self.send_header(k, v)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                if self.command != "HEAD":
-                    self.wfile.write(body)
+                self.fast_reply(status, body, headers)
 
             def _json(self, obj, status=200):
                 self._reply(
@@ -1161,7 +1155,7 @@ class VolumeServer:
                 except (VolumeReadOnly, CookieMismatch) as e:
                     return self._json({"error": str(e)}, 409)
                 if q.get("type") != "replicate":
-                    err = server._replicate(fid, q, "POST", body, dict(self.headers))
+                    err = server._replicate(fid, q, "POST", body, self.headers)
                     if err:
                         return self._json({"error": err}, 500)
                 self._json({"name": fname, "size": size, "eTag": n.etag()}, 201)
@@ -1202,7 +1196,7 @@ class VolumeServer:
                         server._delete_fid(c["fid"])
                 if q.get("type") != "replicate":
                     err = server._replicate(
-                        fid, q, "DELETE", b"", dict(self.headers)
+                        fid, q, "DELETE", b"", self.headers
                     )
                     if err:
                         return self._json({"error": err}, 500)
@@ -1309,10 +1303,14 @@ class VolumeServer:
                     data=body if method == "POST" else None,
                     method=method,
                 )
-                ct = headers.get("Content-Type")
+                # FastHeaders stores keys lowercased; look up both
+                # spellings so a plain-dict caller keeps working too
+                ct = headers.get("Content-Type") or headers.get("content-type")
                 if ct:
                     req.add_header("Content-Type", ct)
-                auth = headers.get("Authorization")
+                auth = headers.get("Authorization") or headers.get(
+                    "authorization"
+                )
                 if auth:  # keep the write jwt valid on the replica hop
                     req.add_header("Authorization", auth)
                 with urllib.request.urlopen(req, timeout=10) as r:

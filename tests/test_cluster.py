@@ -447,6 +447,45 @@ class TestJwtSignedWrites:
         good = op.upload(f"{ar.url}/{ar.fid}", b"hello jwt", jwt=ar.auth)
         assert not good.error and good.size > 0
 
+    def test_replicated_signed_write_forwards_jwt_and_mime(
+        self, jwt_cluster, tmp_path_factory
+    ):
+        """The replica hop must forward Authorization and Content-Type
+        from the incoming request (store_replicate.go keeps the url and
+        headers) — regression for the FastHeaders lowercased-key map
+        silently dropping both on dict.get('Content-Type')."""
+        from seaweedfs_tpu.client import operation as op
+        from seaweedfs_tpu.security.guard import Guard
+
+        master, vs = jwt_cluster
+        vs2 = VolumeServer(
+            [str(tmp_path_factory.mktemp("jwtvs2"))],
+            port=free_port(),
+            master=f"127.0.0.1:{master.port}",
+            heartbeat_interval=0.2,
+            max_volume_counts=[100],
+            guard=Guard(signing_key="test-signing-key", expires_after_sec=30),
+        )
+        vs2.start()
+        try:
+            deadline = time.time() + 45
+            while time.time() < deadline and len(master.topology.data_nodes()) < 2:
+                time.sleep(0.05)
+            ar = op.assign(f"127.0.0.1:{master.port}", replication="001")
+            ur = op.upload(
+                f"{ar.url}/{ar.fid}", b"replicated+signed", jwt=ar.auth,
+                mime="text/x-custom",
+            )
+            assert not ur.error, ur.error
+            # readable from BOTH replicas, with the mime preserved
+            for server in (vs, vs2):
+                status, body = http_get(
+                    f"http://127.0.0.1:{server.port}/{ar.fid}"
+                )
+                assert status == 200 and body == b"replicated+signed"
+        finally:
+            vs2.stop()
+
     def test_filer_writes_with_signing_enabled(self, jwt_cluster, tmp_path):
         import urllib.request
 
