@@ -380,8 +380,13 @@ class ServerCommand(Command):
         volume.start()
         started.append(volume)
         if args.filer or args.s3 or args.webdav:
+            from seaweedfs_tpu import notification
             from seaweedfs_tpu.server.filer_server import FilerServer
+            from seaweedfs_tpu.util.config import load_config
 
+            # same notification.toml wiring as the standalone `filer`
+            # command — the all-in-one filer must publish events too
+            notification.configure(load_config("notification"))
             filer = FilerServer(
                 [f"{args.ip}:{args.master_port}"],
                 host=args.ip,

@@ -6,10 +6,11 @@ EventNotification) messages into (filer2/filer_notify.go:9-39).
 Backends here: log (glog-style), memory (in-process, subscribable),
 dirqueue (durable file-per-message directory), logqueue (embedded
 partitioned segmented log with consumer groups — the Kafka-role broker,
-notification/logqueue.py). Broker-backed kinds that need client
-libraries not present in this image (kafka, aws_sqs, google_pub_sub)
-remain GatedQueue stubs pointing at logqueue as the built-in
-equivalent.
+notification/logqueue.py), and kafka — a real wire-protocol producer
+(notification/kafka.py, no client library; gated on broker
+connectivity). aws_sqs / google_pub_sub still need client libraries
+not present in this image and remain GatedQueue stubs pointing at
+logqueue as the built-in equivalent.
 """
 
 from __future__ import annotations
@@ -152,7 +153,15 @@ def configure(cfg) -> NotificationQueue | None:
             partitions=cfg.get_int("notification.logqueue.partitions", 4),
         )
     elif cfg.get_bool("notification.kafka.enabled"):
-        queue = GatedQueue("kafka")
+        # real wire-protocol producer (notification/kafka.py); the gate
+        # is connectivity, not a library — constructing raises with
+        # guidance when no broker answers
+        from seaweedfs_tpu.notification.kafka import KafkaQueue
+
+        queue = KafkaQueue(
+            cfg.get_string("notification.kafka.hosts", "localhost:9092"),
+            topic=cfg.get_string("notification.kafka.topic", "seaweedfs_filer"),
+        )
     elif cfg.get_bool("notification.aws_sqs.enabled"):
         queue = GatedQueue("aws_sqs")
     elif cfg.get_bool("notification.google_pub_sub.enabled"):

@@ -89,6 +89,24 @@ def run_replicate(
         return _consume_logqueue(
             lq, replicator, poll_interval, stop_after_idle
         )
+    if notif_cfg.get_bool("notification.kafka.enabled"):
+        from seaweedfs_tpu.notification.kafka import KafkaSubscriber
+
+        hosts = notif_cfg.get_string("notification.kafka.hosts", "localhost:9092")
+        sub = KafkaSubscriber(
+            hosts,
+            topic=notif_cfg.get_string(
+                "notification.kafka.topic", "seaweedfs_filer"
+            ),
+        )
+        adapter = _KafkaOffsetAdapter(
+            sub,
+            notif_cfg.get_string(
+                "notification.kafka.offset_dir", "./kafka_offsets"
+            ),
+        )
+        wlog.info("filer.replicate consuming kafka %s", hosts)
+        return _consume_logqueue(adapter, replicator, poll_interval, stop_after_idle)
     qdir = notif_cfg.get_string("notification.dirqueue.dir", "./notifications")
     dirqueue = notification.DirQueue(qdir)
     offset_file = os.path.join(qdir, ".replicate_offset")
@@ -115,6 +133,41 @@ def run_replicate(
             return 0
         else:
             time.sleep(poll_interval)
+
+
+class _KafkaOffsetAdapter:
+    """Present a KafkaSubscriber through the logqueue consumer surface
+    (poll/commit/trim) so the at-least-once drain loop below serves
+    both. Offsets are durable on the consumer side (one file per
+    partition, atomic replace) — the reference's sarama consumer keeps
+    them broker-side via group coordination, which kafka.py
+    deliberately omits (single subscriber per topic; see its module
+    docstring)."""
+
+    def __init__(self, sub, offset_dir: str):
+        self._sub = sub
+        self._dir = offset_dir
+        os.makedirs(offset_dir, exist_ok=True)
+        for p in sub.partitions:
+            try:
+                with open(os.path.join(offset_dir, f"p{p:03d}")) as f:
+                    sub.offsets[p] = int(f.read().strip() or "0")
+            except (OSError, ValueError):
+                pass
+
+    def poll(self, group: str, max_records: int = 256):
+        return self._sub.poll(max_records)
+
+    def commit(self, group: str, partition: int, next_offset: int) -> None:
+        self._sub.commit(partition, next_offset)
+        path = os.path.join(self._dir, f"p{partition:03d}")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(next_offset))
+        os.replace(tmp, path)
+
+    def trim(self) -> int:
+        return 0  # retention is the broker's concern
 
 
 _MAX_EVENT_RETRIES = 8

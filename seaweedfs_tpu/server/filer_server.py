@@ -25,6 +25,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 import grpc
 
 from seaweedfs_tpu.client import operation as op
+from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.filer import filechunks, stream
 from seaweedfs_tpu.filer.entry import Attr, Entry, normalize_path
 from seaweedfs_tpu.filer.filer import Filer
@@ -53,7 +54,15 @@ def _queue_publisher():
         if new is not None:
             msg.new_entry.CopyFrom(new.to_pb())
             msg.new_parent_path = new.directory
-        notification.queue.send_message(key, msg)
+        try:
+            notification.queue.send_message(key, msg)
+        except Exception as e:  # noqa: BLE001 — never fail the write
+            # the entry is already durably stored; a broker hiccup must
+            # not turn the client's POST into a 500 (filer_notify.go
+            # logs SendMessage errors and continues). Matters since the
+            # kafka queue does real network IO; embedded queues never
+            # raised here.
+            wlog.error("notify %s: %s", key, e)
 
     return publish
 
