@@ -182,6 +182,105 @@ def write_ec_files(
             f.close()
 
 
+def write_ec_files_batch(
+    base_file_names: list[str],
+    codec=None,
+    tile_bytes: int | None = None,
+    large_block_size: int = LARGE_BLOCK_SIZE,
+    small_block_size: int = SMALL_BLOCK_SIZE,
+) -> None:
+    """Encode N sealed volumes' .dat files through ONE mesh program per
+    tile round — the §2.6.2 volume-parallelism story end-to-end: each
+    round stacks one [10, W] tile per volume into a [B, 10, W/4]-lane
+    batch laid out P('vol', None, 'stripe') over the process Mesh
+    (parallel/mesh_codec.py; SWAR per device on TPU meshes). Output
+    bytes are identical to write_ec_files per volume — the reference's
+    goroutine-per-volume encode fan-out (command_ec_encode.go:153),
+    lifted to SPMD.
+
+    Shapes stay static across rounds (finished volumes contribute zero
+    tiles that are discarded) so the whole run compiles once."""
+    from seaweedfs_tpu.parallel import MeshCodec, make_mesh
+
+    if not base_file_names:
+        return
+    if codec is None:
+        codec = MeshCodec(make_mesh())
+    tile_bytes = tile_bytes or DEFAULT_BUFFER_SIZE
+    for block in (large_block_size, small_block_size):
+        if block % tile_bytes != 0 and tile_bytes % block != 0:
+            raise ValueError("tile size must tile the block sizes")
+
+    b = len(base_file_names)
+    stripe = codec.mesh.devices.shape[1]
+    if b % codec.mesh.devices.shape[0]:
+        raise ValueError(
+            f"batch of {b} volumes does not shard over the mesh's "
+            f"{codec.mesh.devices.shape[0]}-way 'vol' axis"
+        )
+    tiles: list[list] = []
+    dats = []
+    sizes = []
+    outs = []
+    try:
+        for base in base_file_names:
+            size = os.path.getsize(base + ".dat")
+            sizes.append(size)
+            dats.append(open(base + ".dat", "rb"))
+            outs.append(
+                [open(base + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
+            )
+            tiles.append(
+                list(
+                    iter_ec_tiles(
+                        size, tile_bytes, large_block_size, small_block_size
+                    )
+                )
+            )
+        if not any(tiles):
+            return  # all .dat files empty: 14 empty shards each, done
+        # one static tile width for every round: the max step, rounded
+        # so the u32 lane count splits over the stripe axis in whole
+        # SWAR-friendly chunks (1024 lanes per device minimum)
+        max_step = max(step for ts in tiles for _, _, _, step in ts)
+        gran = 4 * 1024 * stripe
+        width = -(-max_step // gran) * gran
+        rounds = max(len(ts) for ts in tiles)
+        batch = np.zeros((b, DATA_SHARDS, width), dtype=np.uint8)
+        for r in range(rounds):
+            batch[:] = 0
+            steps = [0] * b
+            for v in range(b):
+                if r >= len(tiles[v]):
+                    continue  # volume done: zero tile, output discarded
+                row_off, block, batch_off, step = tiles[v][r]
+                batch[v, :, :step] = read_dat_tile(
+                    dats[v], sizes[v], row_off, block, batch_off, step
+                )
+                steps[v] = step
+            parity = np.asarray(
+                codec.encode_batch_u32(
+                    codec.shard_volumes(batch.view(np.uint32))
+                )
+            ).view(np.uint8)
+            for v in range(b):
+                step = steps[v]
+                if not step:
+                    continue
+                for i in range(DATA_SHARDS):
+                    outs[v][i].write(batch[v, i, :step].tobytes())
+                for i in range(PARITY_SHARDS):
+                    outs[v][DATA_SHARDS + i].write(
+                        parity[v, i, :step].tobytes()
+                    )
+    finally:
+        for f in dats:
+            f.close()
+        for fs in outs:
+            for f in fs:
+                f.close()
+
+
 def rebuild_ec_files(
     base_file_name: str,
     rs: ReedSolomon | None = None,

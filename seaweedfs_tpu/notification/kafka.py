@@ -28,7 +28,6 @@ varints (the v2 format).
 
 from __future__ import annotations
 
-import hashlib
 import socket
 import struct
 import threading
@@ -401,12 +400,11 @@ class KafkaClient:
 # --- the notification queue -------------------------------------------------
 
 
-def _partition_of(key: str, n: int) -> int:
-    """Stable key → partition (same blake2b routing as the embedded
-    logqueue; sarama's default hash partitioner differs — documented
-    deviation, both give per-key ordering which is the contract)."""
-    d = hashlib.blake2b(key.encode(), digest_size=4).digest()
-    return int.from_bytes(d, "little") % n
+# stable key → partition slot: the SAME blake2b router the embedded
+# logqueue uses (one implementation — they must never drift; sarama's
+# default hash partitioner differs, a documented deviation: both give
+# per-key ordering, which is the contract)
+from seaweedfs_tpu.notification.logqueue import _partition_of  # noqa: E402
 
 
 class KafkaQueue:
@@ -428,10 +426,12 @@ class KafkaQueue:
             ) from e
 
     def send_message(self, key: str, message: fpb.EventNotification) -> None:
+        # index into the partition-ID list: metadata() can return a
+        # non-contiguous set (a partition mid-leader-election is
+        # skipped), so the hash picks a slot, not an id
+        pid = self.partitions[_partition_of(key, len(self.partitions))]
         self.client.produce(
-            self.topic,
-            _partition_of(key, len(self.partitions)),
-            [(key.encode(), message.SerializeToString())],
+            self.topic, pid, [(key.encode(), message.SerializeToString())]
         )
 
     def close(self) -> None:

@@ -147,6 +147,11 @@ VOLUME_METHODS = {
         v.VolumeEcShardsGenerateResponse,
         UNARY_UNARY,
     ),
+    "VolumeEcShardsBatchGenerate": (
+        v.VolumeEcShardsBatchGenerateRequest,
+        v.VolumeEcShardsBatchGenerateResponse,
+        UNARY_UNARY,
+    ),
     "VolumeEcShardsRebuild": (
         v.VolumeEcShardsRebuildRequest,
         v.VolumeEcShardsRebuildResponse,

@@ -600,6 +600,36 @@ class VolumeServer:
         ec_files.write_sorted_file_from_idx(base)
         return pb.VolumeEcShardsGenerateResponse()
 
+    def VolumeEcShardsBatchGenerate(self, req, context):
+        """N local sealed volumes → shard files through ONE mesh
+        program per tile round (ec_files.write_ec_files_batch over
+        parallel/mesh_codec.py). The mesh's 'vol' axis is sized to the
+        gcd of batch and device count so any batch shards cleanly."""
+        import math
+
+        import jax
+
+        from seaweedfs_tpu.parallel import MeshCodec, make_mesh
+
+        bases = []
+        for vid in req.volume_ids:
+            v = self.store.find_volume(vid)
+            if v is None:
+                context.abort(
+                    grpc.StatusCode.NOT_FOUND, f"volume {vid} not found"
+                )
+            bases.append(v.base_name)
+        if bases:
+            devices = jax.devices()
+            vol_axis = math.gcd(len(bases), len(devices))
+            codec = MeshCodec(
+                make_mesh(devices, stripe=len(devices) // vol_axis)
+            )
+            ec_files.write_ec_files_batch(bases, codec=codec)
+            for base in bases:
+                ec_files.write_sorted_file_from_idx(base)
+        return pb.VolumeEcShardsBatchGenerateResponse()
+
     def VolumeEcShardsRebuild(self, req, context):
         base = self._base_name(req.collection, req.volume_id)
         rebuilt = ec_files.rebuild_ec_files(base, rs=self._new_rs())

@@ -2,7 +2,7 @@
 
 Behavioral match of weed/shell/ (the reference's full REPL command set).
 Implemented here:
-  ec.encode  ec.decode  ec.rebuild  ec.balance
+  ec.encode  ec.batch  ec.decode  ec.rebuild  ec.balance
   volume.balance  volume.fix.replication  volume.vacuum  volume.list
   volume.delete  volume.mount  volume.unmount  volume.move  volume.copy
   volume.tier.upload  volume.tier.download
@@ -506,6 +506,87 @@ class EcEncode(Command):
             )
         for vid in vids:
             do_ec_encode(env, vid, collection, out)
+
+
+@register
+class EcBatch(Command):
+    name = "ec.batch"
+    help = (
+        "ec.batch -volumeIds 1,2,3 — encode N sealed volumes per server "
+        "in ONE mesh program (volume-parallel SPMD batch over the device "
+        "mesh), then mount their shards in place (collections resolved "
+        "from topology)"
+    )
+
+    def run(self, env, args, out):
+        vid_flag = _flag(args, "volumeIds")
+        if not vid_flag:
+            raise ValueError("ec.batch needs -volumeIds vid,vid,...")
+        vids = [int(x) for x in vid_flag.split(",") if x]
+        # each volume's real collection names its base files; resolve
+        # from topology (same as ec.encode's -volumeId path)
+        dump = env.collect_topology()
+        collections = {
+            v["Id"]: v["Collection"] for n in dump.nodes for v in n.volumes
+        }
+
+        # group by the server holding each volume: batching is local to
+        # a node's device mesh (each node encodes its own batch)
+        with env.master_channel() as ch:
+            resp = rpc.master_stub(ch).LookupVolume(
+                master_pb2.LookupVolumeRequest(vids=[str(v) for v in vids])
+            )
+        by_server: dict[str, list[int]] = {}
+        replicas: dict[int, list[str]] = {}
+        for entry in resp.vid_locations:
+            if not entry.locations:
+                raise ValueError(f"volume {entry.vid} not found")
+            vid = int(entry.vid)
+            replicas[vid] = [l.url for l in entry.locations]
+            by_server.setdefault(entry.locations[0].url, []).append(vid)
+
+        for url, server_vids in sorted(by_server.items()):
+            # readonly on EVERY replica (markVolumeReadonly, like
+            # do_ec_encode): a replica left writable would diverge from
+            # the EC set the moment a write lands on it
+            for vid in server_vids:
+                for rurl in replicas[vid]:
+                    with env.volume_channel(rurl) as ch:
+                        rpc.volume_stub(ch).VolumeMarkReadonly(
+                            volume_pb2.VolumeMarkReadonlyRequest(volume_id=vid)
+                        )
+            with env.volume_channel(url) as ch:
+                rpc.volume_stub(ch).VolumeEcShardsBatchGenerate(
+                    volume_pb2.VolumeEcShardsBatchGenerateRequest(
+                        volume_ids=server_vids
+                    ),
+                    timeout=600,
+                )
+            print(
+                f"batch-generated ec shards for volumes {server_vids} "
+                f"on {url} (one mesh program)",
+                file=out,
+            )
+            # serve from EC in place: mount all 14 shards, drop the
+            # originals (spreading stays ec.encode/ec.balance's job)
+            for vid in server_vids:
+                with env.volume_channel(url) as ch:
+                    stub = rpc.volume_stub(ch)
+                    stub.VolumeEcShardsMount(
+                        volume_pb2.VolumeEcShardsMountRequest(
+                            volume_id=vid,
+                            collection=collections.get(vid, ""),
+                            shard_ids=list(range(ec_common.TOTAL_SHARDS_COUNT)),
+                        )
+                    )
+                # drop EVERY replica of the original volume, not just
+                # the encoding server's copy
+                for rurl in replicas[vid]:
+                    with env.volume_channel(rurl) as ch:
+                        rpc.volume_stub(ch).VolumeDelete(
+                            volume_pb2.VolumeDeleteRequest(volume_id=vid)
+                        )
+                print(f"volume {vid} now serves from ec shards", file=out)
 
 
 def find_missing_shards(nodes: list[ec_common.EcNode], vid: int) -> list[int]:

@@ -486,3 +486,84 @@ class TestFsCommands:
         assert "loaded" in loaded
         assert "a.txt" in self._run(env, "fs.ls /docs")
         assert self._run(env, "fs.cat /docs/a.txt") == "alpha"
+
+
+class TestEcBatchVerb:
+    def test_batch_encode_four_volumes_one_program(self, cluster):
+        """ec.batch: 4 sealed volumes encoded through ONE MeshCodec
+        program per tile round on the 8-device CPU mesh, then serving
+        reads from their EC shards — and the shard bytes are identical
+        to the per-volume classic encoder's (§2.6.2 volume parallelism
+        end-to-end, VERDICT r2 item 10)."""
+        import os
+
+        import numpy as np
+
+        from seaweedfs_tpu.ec import ec_files
+        from seaweedfs_tpu.ec.codec import new_encoder
+        from seaweedfs_tpu.shell.commands import run_command
+
+        master, volume_servers = cluster
+        env = CommandEnv([f"127.0.0.1:{master.port}"])
+
+        rng = np.random.default_rng(9)
+        writes = {}  # vid -> (url, fid, payload)
+        # distinct collections => distinct volumes (growth per collection)
+        for i in range(4):
+            _, assign = http_json(
+                f"http://127.0.0.1:{master.port}/dir/assign?collection=ecb{i}"
+            )
+            payload = rng.integers(
+                0, 256, int(rng.integers(20_000, 90_000)), dtype=np.uint8
+            ).tobytes()
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://{assign['url']}/{assign['fid']}",
+                    data=payload,
+                    method="POST",
+                ),
+                timeout=10,
+            ).close()
+            vid = int(assign["fid"].split(",")[0])
+            writes[vid] = (assign["url"], assign["fid"], payload)
+
+        # snapshot each volume's .dat BEFORE the verb (ec.batch deletes
+        # the original volume once its EC set is mounted)
+        import shutil
+        import tempfile
+
+        snap = tempfile.mkdtemp()
+        refs = {}
+        for server in volume_servers:
+            for loc in server.store.locations:
+                for vid, vol in loc.volumes.items():
+                    if vid in writes:
+                        ref_base = os.path.join(snap, f"ref{vid}")
+                        shutil.copyfile(
+                            vol.base_name + ".dat", ref_base + ".dat"
+                        )
+                        refs[vid] = (server, vol.base_name, ref_base)
+        assert set(refs) == set(writes)
+
+        vids = ",".join(str(v) for v in sorted(writes))
+        out = io.StringIO()
+        run_command(env, f"ec.batch -volumeIds {vids}", out)
+        assert "one mesh program" in out.getvalue()
+
+        # shard bytes == classic per-volume encoder's on the snapshot
+        for vid, (server, base, ref_base) in refs.items():
+            ec_files.write_ec_files(ref_base, rs=new_encoder(backend="cpu"))
+            for i in range(14):
+                got = open(base + ec_files.to_ext(i), "rb").read()
+                want = open(ref_base + ec_files.to_ext(i), "rb").read()
+                assert got == want, (vid, i)
+        shutil.rmtree(snap)
+
+        # every payload still readable — served from the EC shards now
+        for vid, (url, fid, payload) in writes.items():
+            status, body = http_get(f"http://{url}/{fid}")
+            assert status == 200 and body == payload, vid
+            # the original volume is gone; the ec volume serves
+            srv, _, _ = refs[vid]
+            assert srv.store.find_volume(vid) is None
+            assert srv.store.find_ec_volume(vid) is not None
