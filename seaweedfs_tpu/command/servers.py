@@ -87,6 +87,13 @@ class MasterCommand(Command):
             "declared dead even if its stream stays open (0 disables)",
         )
         p.add_argument("-cpuprofile", default="", help="dump pstats profile here on exit")
+        p.add_argument(
+            "-sequencer.etcd",
+            dest="sequencer_etcd",
+            default="",
+            help="etcd endpoint(s) for the external-KV sequencer "
+            "(sequence/etcd_sequencer.go role); default: file/memory",
+        )
         p.add_argument("-v", type=int, default=0, help="verbosity")
 
     def run(self, args) -> int:
@@ -97,6 +104,11 @@ class MasterCommand(Command):
             print("master: -peers requires -mdir (persistent raft state)")
             return 2
         _configure_tls("master")
+        sequencer = None
+        if args.sequencer_etcd:
+            from seaweedfs_tpu.sequence import EtcdSequencer
+
+            sequencer = EtcdSequencer(args.sequencer_etcd)
         server = MasterServer(
             host=args.ip,
             port=args.port,
@@ -107,6 +119,7 @@ class MasterCommand(Command):
             peers=args.peers or None,
             raft_dir=args.mdir or None,
             node_timeout=args.nodeTimeout,
+            sequencer=sequencer,
         )
         from seaweedfs_tpu.util.profiling import CpuProfile
 

@@ -7,7 +7,7 @@ import os
 import time
 
 from seaweedfs_tpu.replication.replicator import Replicator
-from seaweedfs_tpu.replication.sink import FilerSink, GatedSink, LocalSink, S3Sink
+from seaweedfs_tpu.replication.sink import FilerSink, LocalSink, S3Sink
 from seaweedfs_tpu.replication.source import FilerSource
 from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.util.config import load_config, Configuration
@@ -41,11 +41,55 @@ def build_replicator(repl_cfg: Configuration) -> Replicator:
             region=s.get("region", "us-east-1"),
         )
     elif repl_cfg.get_bool("sink.gcs.enabled"):
-        sink = GatedSink("gcs")
+        from seaweedfs_tpu.replication.cloud_sinks import GcsSink
+
+        s = repl_cfg.sub("sink.gcs")
+        endpoint = s.get("endpoint", "https://storage.googleapis.com")
+        if not s.get("token", "") and "googleapis.com" in endpoint:
+            # real GCS always needs a bearer token; only custom
+            # endpoints (emulators, the test fake) may go tokenless
+            raise RuntimeError(
+                "sink.gcs needs an OAuth bearer `token` (see "
+                "replication/cloud_sinks.py), or a custom `endpoint`"
+            )
+        sink = GcsSink(
+            s.get("bucket", ""),
+            token=s.get("token", ""),
+            directory=s.get("directory", ""),
+            endpoint=endpoint,
+        )
     elif repl_cfg.get_bool("sink.azure.enabled"):
-        sink = GatedSink("azure")
+        from seaweedfs_tpu.replication.cloud_sinks import AzureSink
+
+        s = repl_cfg.sub("sink.azure")
+        if not s.get("account_key", ""):
+            raise RuntimeError(
+                "sink.azure needs account_name/account_key (the SharedKey "
+                "credentials); see replication/cloud_sinks.py"
+            )
+        sink = AzureSink(
+            s.get("account_name", ""),
+            s.get("account_key", ""),
+            s.get("container", ""),
+            directory=s.get("directory", ""),
+            endpoint=s.get("endpoint", ""),
+        )
     elif repl_cfg.get_bool("sink.backblaze.enabled"):
-        sink = GatedSink("backblaze")
+        from seaweedfs_tpu.replication.cloud_sinks import B2Sink
+
+        s = repl_cfg.sub("sink.backblaze")
+        if not s.get("b2_master_application_key", ""):
+            raise RuntimeError(
+                "sink.backblaze needs b2_account_id/"
+                "b2_master_application_key; see replication/cloud_sinks.py"
+            )
+        sink = B2Sink(
+            s.get("b2_account_id", ""),
+            s.get("b2_master_application_key", ""),
+            s.get("bucket", ""),
+            directory=s.get("directory", ""),
+            endpoint=s.get("endpoint", "https://api.backblazeb2.com"),
+        )
     else:
         raise RuntimeError("no enabled sink in replication.toml")
     return Replicator(source, sink)

@@ -277,13 +277,5 @@ class S3Sink(ReplicationSink):
         self.client.delete_object(self.bucket, self._key(key))
 
 
-class GatedSink(ReplicationSink):
-    """Placeholder for the remaining cloud sinks (gcs, azure,
-    backblaze) whose SDKs are absent here; constructing one raises
-    with guidance."""
-
-    def __init__(self, kind: str):
-        raise RuntimeError(
-            f"replication sink {kind!r} needs a cloud SDK not present in "
-            "this environment; use [sink.filer], [sink.local], or [sink.s3]"
-        )
+# gcs / azure / backblaze live in replication/cloud_sinks.py — real
+# wire-protocol implementations (no SDKs), gated only on credentials.

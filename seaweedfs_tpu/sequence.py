@@ -98,3 +98,168 @@ class FileSequencer:
     def peek(self) -> int:
         with self._lock:
             return self._counter
+
+
+class EtcdSequencer:
+    """Sequencer backed by an external etcd cluster — the multi-master
+    external-KV role of sequence/etcd_sequencer.go, speaking etcd's v3
+    grpc-gateway REST API directly (/v3/kv/range, /v3/kv/put,
+    /v3/kv/txn) instead of a client library. (The reference rides the
+    long-dead etcd v2 client API; the semantics are the same: reserve
+    [current, max) ranges with a compare-and-swap step bump, lift the
+    stored max when heartbeats report larger keys.)
+
+    Gated on connectivity: constructing dials the endpoint and raises
+    with guidance when no etcd (or the in-repo fake,
+    tests/cloud_fakes.FakeEtcd) answers."""
+
+    KEY = "/seaweedfs/master/sequence"
+    STEP = 500  # ids reserved per etcd CAS (DefaultEtcdSteps)
+
+    def __init__(self, urls: str, step: int = STEP):
+        import base64
+
+        self._endpoints = []
+        for u in urls.split(","):
+            u = u.strip().rstrip("/")
+            if not u:
+                continue
+            if not u.startswith("http"):
+                u = "http://" + u
+            self._endpoints.append(u)
+        if not self._endpoints:
+            raise ValueError("etcd sequencer needs at least one endpoint")
+        self._step = step
+        self._lock = threading.Lock()
+        self._key_b64 = base64.b64encode(self.KEY.encode()).decode()
+        try:
+            stored = self._get()
+        except OSError as e:
+            raise RuntimeError(
+                f"etcd sequencer cannot reach {urls!r} ({e}); start etcd "
+                "(or use the default file-backed sequencer via -mdir)"
+            ) from e
+        if stored is None:
+            self._cas_create(0)
+            stored = self._get() or 0
+        # ids start at 1 (memory_sequencer.go convention)
+        self._current = max(stored, 1)
+        self._max = stored
+
+    # --- etcd v3 gateway primitives ------------------------------------
+    def _call(self, op: str, payload: dict) -> dict:
+        """POST to the first endpoint that answers; rotate the working
+        one to the front so steady state dials it directly (the flag
+        advertises endpoint failover, not just a list of one)."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        last: OSError | None = None
+        for i, endpoint in enumerate(self._endpoints):
+            req = urllib.request.Request(
+                f"{endpoint}/v3/kv/{op}",
+                data=_json.dumps(payload).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    if i:
+                        self._endpoints.insert(0, self._endpoints.pop(i))
+                    return _json.loads(r.read())
+            except urllib.error.HTTPError:
+                raise  # reachable: a protocol error is not failover-able
+            except OSError as e:
+                last = e
+        raise last if last is not None else OSError("no endpoints")
+
+    def _get(self) -> int | None:
+        import base64
+
+        resp = self._call("range", {"key": self._key_b64})
+        kvs = resp.get("kvs", [])
+        if not kvs:
+            return None
+        return int(base64.b64decode(kvs[0]["value"]))
+
+    def _b64(self, n: int) -> str:
+        import base64
+
+        return base64.b64encode(str(n).encode()).decode()
+
+    def _cas_create(self, value: int) -> bool:
+        """Create-if-absent (createRevision == 0 compare)."""
+        resp = self._call(
+            "txn",
+            {
+                "compare": [
+                    {
+                        "key": self._key_b64,
+                        "target": "CREATE",
+                        "createRevision": "0",
+                    }
+                ],
+                "success": [
+                    {
+                        "requestPut": {
+                            "key": self._key_b64,
+                            "value": self._b64(value),
+                        }
+                    }
+                ],
+            },
+        )
+        return bool(resp.get("succeeded"))
+
+    def _cas_swap(self, prev: int, new: int) -> bool:
+        resp = self._call(
+            "txn",
+            {
+                "compare": [
+                    {
+                        "key": self._key_b64,
+                        "target": "VALUE",
+                        "value": self._b64(prev),
+                    }
+                ],
+                "success": [
+                    {
+                        "requestPut": {
+                            "key": self._key_b64,
+                            "value": self._b64(new),
+                        }
+                    }
+                ],
+            },
+        )
+        return bool(resp.get("succeeded"))
+
+    def _reserve_locked(self, at_least: int) -> None:
+        """CAS-bump the stored max until [current, max) covers
+        at_least ids (batchGetSequenceFromEtcd's retry loop)."""
+        while self._max - self._current < at_least:
+            stored = self._get() or 0
+            new_max = max(stored, self._current) + max(self._step, at_least)
+            if self._cas_swap(stored, new_max):
+                self._current = max(self._current, stored)
+                self._max = new_max
+
+    # --- Sequencer API --------------------------------------------------
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            self._reserve_locked(count)
+            start = self._current
+            self._current += count
+            return start
+
+    def set_max(self, seen_value: int) -> None:
+        with self._lock:
+            if seen_value < self._current:
+                return
+            self._current = seen_value + 1
+            self._reserve_locked(1)
+
+    def peek(self) -> int:
+        with self._lock:
+            return self._current

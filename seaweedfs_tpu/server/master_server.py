@@ -85,14 +85,18 @@ class MasterServer:
         node_timeout: float = 30.0,
         metrics_address: str = "",
         metrics_interval_sec: int = 15,
+        sequencer=None,
     ):
         self.host = host
         self.port = port
         self.grpc_port = port + 10000  # reference convention: http port + 10000
         self.topology = Topology(volume_size_limit_mb * 1024 * 1024)
-        # durable (file-backed, etcd_sequencer.go role) when the master
-        # has a meta directory; in-memory otherwise
-        if raft_dir:
+        # sequencer: injected (e.g. EtcdSequencer for external-KV
+        # coordination), else durable file-backed when the master has a
+        # meta directory (etcd_sequencer.go role), else in-memory
+        if sequencer is not None:
+            self.sequencer = sequencer
+        elif raft_dir:
             import os as _os
 
             from seaweedfs_tpu.sequence import FileSequencer

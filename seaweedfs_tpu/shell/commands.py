@@ -522,7 +522,10 @@ class EcBatch(Command):
         vid_flag = _flag(args, "volumeIds")
         if not vid_flag:
             raise ValueError("ec.batch needs -volumeIds vid,vid,...")
-        vids = [int(x) for x in vid_flag.split(",") if x]
+        # dedupe: a repeated id would open two write handles onto the
+        # same shard files and interleave-corrupt them before the
+        # originals get deleted
+        vids = sorted({int(x) for x in vid_flag.split(",") if x})
         # each volume's real collection names its base files; resolve
         # from topology (same as ec.encode's -volumeId path)
         dump = env.collect_topology()

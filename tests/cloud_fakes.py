@@ -1,0 +1,406 @@
+"""In-memory protocol fakes for the cloud replication sinks.
+
+Each speaks exactly the REST surface its sink uses (tests drive the
+real wire protocol over a real socket, offline):
+
+  FakeGcs    GCS JSON API: media upload, objects list/delete
+  FakeAzure  Azure Blob REST: Put/Delete Blob, List Blobs; validates
+             the SharedKey signature with the same canonicalization
+             the sink computes (self-consistency, not Azure itself)
+  FakeB2     B2 native API: authorize_account, list_buckets,
+             get_upload_url, upload, list_file_names,
+             delete_file_version
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _FakeBase:
+    page_size = 1000  # tests shrink this to exercise pagination
+
+    def __init__(self):
+        self.objects: dict[str, bytes] = {}
+        self._server = ThreadingHTTPServer(
+            ("127.0.0.1", 0), self._handler_class()
+        )
+        self.port = self._server.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+
+    def start(self):
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class FakeGcs(_FakeBase):
+    def _handler_class(self):
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, status=200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                u = urllib.parse.urlparse(self.path)
+                q = dict(urllib.parse.parse_qsl(u.query))
+                if u.path.startswith("/upload/storage/v1/b/"):
+                    name = q["name"]
+                    n = int(self.headers.get("Content-Length", "0"))
+                    fake.objects[name] = self.rfile.read(n)
+                    return self._json({"name": name})
+                self._json({"error": "bad path"}, 404)
+
+            def do_GET(self):
+                u = urllib.parse.urlparse(self.path)
+                q = dict(urllib.parse.parse_qsl(u.query))
+                if u.path.endswith("/o"):
+                    prefix = q.get("prefix", "")
+                    names = [
+                        k for k in sorted(fake.objects) if k.startswith(prefix)
+                    ]
+                    start = int(q.get("pageToken", "0") or "0")
+                    page = names[start : start + fake.page_size]
+                    resp = {"items": [{"name": k} for k in page]}
+                    if start + fake.page_size < len(names):
+                        resp["nextPageToken"] = str(start + fake.page_size)
+                    return self._json(resp)
+                self._json({"error": "bad path"}, 404)
+
+            def do_DELETE(self):
+                u = urllib.parse.urlparse(self.path)
+                name = urllib.parse.unquote(u.path.rsplit("/o/", 1)[-1])
+                existed = fake.objects.pop(name, None)
+                self._json({}, 204 if existed is not None else 404)
+
+        return H
+
+
+class FakeAzure(_FakeBase):
+    def __init__(self, account: str, key_b64: str, container: str):
+        self.account = account
+        self.key = base64.b64decode(key_b64)
+        self.container = container
+        super().__init__()
+
+    def _check_sig(self, handler, method, query, body_len, ctype) -> bool:
+        auth = handler.headers.get("Authorization", "")
+        if not auth.startswith(f"SharedKey {self.account}:"):
+            return False
+        headers = {
+            k.lower(): v
+            for k, v in handler.headers.items()
+            if k.lower().startswith("x-ms-")
+        }
+        canon_headers = "".join(
+            f"{k}:{v}\n" for k, v in sorted(headers.items())
+        )
+        # canonicalize the path AS SENT (percent-encoded) — the Azure
+        # spec's rule, and what the sink signs
+        path = urllib.parse.urlparse(handler.path).path
+        canon_resource = f"/{self.account}{path}"
+        for k in sorted(query):
+            canon_resource += f"\n{k.lower()}:{query[k]}"
+        string_to_sign = "\n".join(
+            [method, "", "", str(body_len) if body_len else "", "",
+             ctype, "", "", "", "", "", ""]
+        ) + "\n" + canon_headers + canon_resource
+        want = base64.b64encode(
+            hmac.new(self.key, string_to_sign.encode(), hashlib.sha256).digest()
+        ).decode()
+        return auth == f"SharedKey {self.account}:{want}"
+
+    def _handler_class(self):
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, status, body=b""):
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                data = self.rfile.read(n)
+                q = dict(
+                    urllib.parse.parse_qsl(
+                        urllib.parse.urlparse(self.path).query
+                    )
+                )
+                if not fake._check_sig(
+                    self, "PUT", q, n,
+                    self.headers.get("Content-Type", ""),
+                ):
+                    return self._reply(403, b"bad signature")
+                name = urllib.parse.unquote(
+                    urllib.parse.urlparse(self.path).path
+                ).split(f"/{fake.container}/", 1)[-1]
+                fake.objects[name] = data
+                self._reply(201)
+
+            def do_DELETE(self):
+                q = dict(
+                    urllib.parse.parse_qsl(
+                        urllib.parse.urlparse(self.path).query
+                    )
+                )
+                if not fake._check_sig(self, "DELETE", q, 0, ""):
+                    return self._reply(403, b"bad signature")
+                name = urllib.parse.unquote(
+                    urllib.parse.urlparse(self.path).path
+                ).split(f"/{fake.container}/", 1)[-1]
+                existed = fake.objects.pop(name, None)
+                self._reply(202 if existed is not None else 404)
+
+            def do_GET(self):
+                u = urllib.parse.urlparse(self.path)
+                q = dict(urllib.parse.parse_qsl(u.query))
+                if not fake._check_sig(self, "GET", q, 0, ""):
+                    return self._reply(403, b"bad signature")
+                if q.get("comp") == "list":
+                    prefix = q.get("prefix", "")
+                    marker = q.get("marker", "")
+                    names = [
+                        k
+                        for k in sorted(fake.objects)
+                        if k.startswith(prefix) and k > marker
+                    ]
+                    page = names[: fake.page_size]
+                    blobs = "".join(
+                        f"<Blob><Name>{k}</Name></Blob>" for k in page
+                    )
+                    nxt = (
+                        f"<NextMarker>{page[-1]}</NextMarker>"
+                        if len(names) > fake.page_size
+                        else ""
+                    )
+                    xml = (
+                        "<?xml version='1.0'?><EnumerationResults>"
+                        f"<Blobs>{blobs}</Blobs>{nxt}</EnumerationResults>"
+                    )
+                    return self._reply(200, xml.encode())
+                self._reply(404)
+
+        return H
+
+
+class FakeB2(_FakeBase):
+    def __init__(self, key_id: str, app_key: str, bucket: str):
+        self.key_id = key_id
+        self.app_key = app_key
+        self.bucket_name = bucket
+        self.bucket_id = "bkt001"
+        self._next_id = 0
+        # B2 keeps every uploaded version: name -> [(fileId, data)],
+        # newest last; `objects` mirrors the latest-visible view
+        self.versions: dict[str, list[tuple[str, bytes]]] = {}
+        super().__init__()
+
+    def _refresh_latest(self, name: str) -> None:
+        vs = self.versions.get(name)
+        if vs:
+            self.objects[name] = vs[-1][1]
+        else:
+            self.versions.pop(name, None)
+            self.objects.pop(name, None)
+
+    def _handler_class(self):
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, status=200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.endswith("/b2_authorize_account"):
+                    basic = base64.b64encode(
+                        f"{fake.key_id}:{fake.app_key}".encode()
+                    ).decode()
+                    if self.headers.get("Authorization") != f"Basic {basic}":
+                        return self._json({"code": "unauthorized"}, 401)
+                    return self._json(
+                        {
+                            "apiUrl": fake.endpoint,
+                            "authorizationToken": "tok123",
+                            "accountId": "acct",
+                        }
+                    )
+                self._json({"code": "not_found"}, 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                data = self.rfile.read(n)
+                if self.path.endswith("/b2_upload"):
+                    if self.headers.get("Authorization") != "uptok":
+                        return self._json({"code": "unauthorized"}, 401)
+                    name = urllib.parse.unquote(
+                        self.headers["X-Bz-File-Name"]
+                    )
+                    if (
+                        hashlib.sha1(data).hexdigest()
+                        != self.headers.get("X-Bz-Content-Sha1")
+                    ):
+                        return self._json({"code": "bad_hash"}, 400)
+                    fake._next_id += 1
+                    fid = f"f{fake._next_id:06d}"
+                    fake.versions.setdefault(name, []).append((fid, data))
+                    fake._refresh_latest(name)
+                    return self._json({"fileId": fid, "fileName": name})
+                if self.headers.get("Authorization") != "tok123":
+                    return self._json({"code": "unauthorized"}, 401)
+                payload = json.loads(data or b"{}")
+                if self.path.endswith("/b2_list_buckets"):
+                    return self._json(
+                        {
+                            "buckets": [
+                                {
+                                    "bucketId": fake.bucket_id,
+                                    "bucketName": fake.bucket_name,
+                                }
+                            ]
+                        }
+                    )
+                if self.path.endswith("/b2_get_upload_url"):
+                    return self._json(
+                        {
+                            "uploadUrl": f"{fake.endpoint}/b2_upload",
+                            "authorizationToken": "uptok",
+                        }
+                    )
+                if self.path.endswith("/b2_list_file_names"):
+                    prefix = payload.get("prefix", "")
+                    start = payload.get("startFileName", "")
+                    names = [
+                        k
+                        for k in sorted(fake.objects)
+                        if k.startswith(prefix) and k >= start
+                    ]
+                    page = names[: fake.page_size]
+                    files = [
+                        {"fileName": k, "fileId": fake.versions[k][-1][0]}
+                        for k in page
+                    ]
+                    nxt = (
+                        names[fake.page_size]
+                        if len(names) > fake.page_size
+                        else None
+                    )
+                    return self._json({"files": files, "nextFileName": nxt})
+                if self.path.endswith("/b2_list_file_versions"):
+                    prefix = payload.get("prefix", "")
+                    files = [
+                        {"fileName": k, "fileId": fid}
+                        for k in sorted(fake.versions)
+                        if k.startswith(prefix)
+                        for fid, _ in fake.versions[k]
+                    ]
+                    return self._json({"files": files, "nextFileName": None})
+                if self.path.endswith("/b2_delete_file_version"):
+                    name = payload["fileName"]
+                    fid = payload["fileId"]
+                    vs = fake.versions.get(name, [])
+                    fake.versions[name] = [v for v in vs if v[0] != fid]
+                    if not fake.versions[name]:
+                        del fake.versions[name]
+                    fake._refresh_latest(name)
+                    return self._json({})
+                self._json({"code": "not_found"}, 404)
+
+        return H
+
+
+class FakeEtcd(_FakeBase):
+    """etcd v3 grpc-gateway KV subset: range / put / txn with VALUE and
+    CREATE compares — what EtcdSequencer speaks."""
+
+    def __init__(self):
+        self.kv: dict[str, str] = {}  # b64 key -> b64 value
+        self.create_rev: dict[str, int] = {}
+        self._rev = 0
+        self._lock = threading.Lock()
+        super().__init__()
+
+    def _handler_class(self):
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, status=200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                with fake._lock:
+                    if self.path.endswith("/kv/range"):
+                        key = payload["key"]
+                        kvs = (
+                            [{"key": key, "value": fake.kv[key]}]
+                            if key in fake.kv
+                            else []
+                        )
+                        return self._json({"kvs": kvs})
+                    if self.path.endswith("/kv/put"):
+                        fake._put(payload["key"], payload["value"])
+                        return self._json({})
+                    if self.path.endswith("/kv/txn"):
+                        ok = all(
+                            fake._compare(c) for c in payload.get("compare", [])
+                        )
+                        if ok:
+                            for op in payload.get("success", []):
+                                put = op.get("requestPut")
+                                if put:
+                                    fake._put(put["key"], put["value"])
+                        return self._json({"succeeded": ok})
+                self._json({"error": "bad path"}, 404)
+
+        return H
+
+    def _put(self, key: str, value: str) -> None:
+        self._rev += 1
+        if key not in self.kv:
+            self.create_rev[key] = self._rev
+        self.kv[key] = value
+
+    def _compare(self, c: dict) -> bool:
+        key = c["key"]
+        if c.get("target") == "CREATE":
+            want = int(c.get("createRevision", c.get("create_revision", 0)))
+            return self.create_rev.get(key, 0) == want
+        if c.get("target") == "VALUE":
+            return self.kv.get(key) == c.get("value")
+        return False
