@@ -22,7 +22,7 @@ import hashlib
 import threading
 from dataclasses import dataclass
 
-from seaweedfs_tpu.filer.entry import Entry, normalize_path, split_path
+from seaweedfs_tpu.filer.entry import Entry, child_path, normalize_path, split_path
 from seaweedfs_tpu.filer.filerstore import EntryNotFound, FilerStore
 
 
@@ -226,7 +226,7 @@ class AbstractSqlStore(FilerStore):
             cur.execute(sql, (hash_string_to_long(d), start_file_name, d, limit))
             rows = cur.fetchall()
             cur.close()
-        return [Entry.decode(f"{d}/{name}", meta) for name, meta in rows]
+        return [Entry.decode(child_path(d, name), meta) for name, meta in rows]
 
     # tx: same deferred-commit protocol as the embedded SqliteStore
     def begin_transaction(self) -> None:

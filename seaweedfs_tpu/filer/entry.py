@@ -31,6 +31,12 @@ def normalize_path(p: str) -> str:
     return p
 
 
+def child_path(directory: str, name: str) -> str:
+    """Join a directory and child name; correct at the root
+    ("/", "x") → "/x", not "//x"."""
+    return f"{directory.rstrip('/')}/{name}"
+
+
 @dataclass
 class Attr:
     mtime: int = 0  # seconds

@@ -23,7 +23,7 @@ import sqlite3
 import struct
 import threading
 
-from seaweedfs_tpu.filer.entry import Entry, normalize_path, split_path
+from seaweedfs_tpu.filer.entry import Entry, child_path, normalize_path, split_path
 
 
 class EntryNotFound(KeyError):
@@ -106,7 +106,7 @@ class MemoryStore(FilerStore):
                     if not include_start and n <= start_file_name:
                         continue
                 out.append(
-                    Entry.decode(f"{dir_path}/{n}", self._dirs[dir_path][n])
+                    Entry.decode(child_path(dir_path, n), self._dirs[dir_path][n])
                 )
                 if len(out) >= limit:
                     break
@@ -180,7 +180,7 @@ class SqliteStore(FilerStore):
                 " ORDER BY name LIMIT ?",
                 (d, start_file_name, limit),
             ).fetchall()
-        return [Entry.decode(f"{d}/{name}", meta) for name, meta in rows]
+        return [Entry.decode(child_path(d, name), meta) for name, meta in rows]
 
     def begin_transaction(self) -> None:
         # per-op commits are deferred while _tx_depth > 0 so a rollback
@@ -283,6 +283,11 @@ def new_store(kind: str, path: str = "") -> FilerStore:
         from seaweedfs_tpu.filer.abstract_sql import new_gated_sql_store
 
         return new_gated_sql_store(kind)
+    if kind == "redis":
+        # real RESP-protocol store, gated on connectivity
+        from seaweedfs_tpu.filer.redis_store import RedisStore
+
+        return RedisStore(path or "localhost:6379")
     if kind == "sortedlog":
         if not path:
             raise ValueError("sortedlog store needs a path")
@@ -295,8 +300,9 @@ def new_store(kind: str, path: str = "") -> FilerStore:
         return LsmStore(path)
     raise ValueError(
         f"unknown filer store {kind!r}: embedded kinds are memory | sqlite"
-        " | sql | sortedlog | lsm; mysql | postgres speak the reference"
-        " SQL dialects but need their client libraries (see"
-        " filer/abstract_sql.py); redis/cassandra/etcd/tikv have no"
-        " in-image counterpart — use an embedded store"
+        " | sql | sortedlog | lsm; redis speaks RESP to a live server"
+        " (kind 'redis', path 'host:port'); mysql | postgres speak the"
+        " reference SQL dialects but need their client libraries (see"
+        " filer/abstract_sql.py); cassandra/tikv have no in-image"
+        " counterpart — use an embedded store"
     )

@@ -32,9 +32,7 @@ import urllib.parse
 import urllib.request
 from email.utils import formatdate
 
-from seaweedfs_tpu.pb import filer_pb2 as fpb
-from seaweedfs_tpu.replication.sink import ReplicationSink
-from seaweedfs_tpu.replication.source import FilerSource
+from seaweedfs_tpu.replication.sink import AssemblingObjectSink
 
 
 def _request(
@@ -54,67 +52,7 @@ def _request(
         return e.code, dict(e.headers), e.read()
 
 
-class _AssemblingSink(ReplicationSink):
-    """Shared chunk-assembly + directory-sweep shape of the object-store
-    sinks (same algebra as S3Sink._assemble)."""
-
-    def __init__(self, directory: str = ""):
-        self.dir = directory.strip("/")
-        self.source: FilerSource | None = None
-
-    def get_sink_to_directory(self) -> str:
-        return ""
-
-    def set_source_filer(self, source: FilerSource) -> None:
-        self.source = source
-
-    def _key(self, key: str) -> str:
-        k = key.lstrip("/")
-        return f"{self.dir}/{k}" if self.dir else k
-
-    def _assemble(self, entry: fpb.Entry) -> bytes:
-        from seaweedfs_tpu.filer import filechunks
-
-        size = entry.attributes.file_size or sum(c.size for c in entry.chunks)
-        buf = bytearray(size)
-        for view in filechunks.view_from_chunks(list(entry.chunks), 0, size):
-            data = self.source.read_chunk(view.fid)
-            piece = data[view.offset : view.offset + view.size]
-            buf[view.logic_offset : view.logic_offset + len(piece)] = piece
-        return bytes(buf)
-
-    # object stores: create == update (idempotent upsert)
-    def create_entry(self, key: str, entry: fpb.Entry) -> None:
-        if entry.is_directory:
-            return
-        self._put(self._key(key), self._assemble(entry))
-
-    def update_entry(
-        self, key, old_entry, new_parent_path, new_entry, delete_chunks
-    ) -> bool:
-        self.create_entry(key, new_entry)
-        return True
-
-    def delete_entry(self, key: str, is_directory: bool, delete_chunks: bool) -> None:
-        if is_directory:
-            prefix = self._key(key).rstrip("/") + "/"
-            for name in self._list(prefix):
-                self._delete(name)
-            return
-        self._delete(self._key(key))
-
-    # provider-specific primitives
-    def _put(self, name: str, data: bytes) -> None:
-        raise NotImplementedError
-
-    def _delete(self, name: str) -> None:
-        raise NotImplementedError
-
-    def _list(self, prefix: str) -> list[str]:
-        raise NotImplementedError
-
-
-class GcsSink(_AssemblingSink):
+class GcsSink(AssemblingObjectSink):
     """GCS over the JSON API (storage/v1). `token` is an OAuth bearer
     token (how the SDK authenticates after its token dance); the fake
     accepts any."""
@@ -176,7 +114,7 @@ class GcsSink(_AssemblingSink):
                 return names
 
 
-class AzureSink(_AssemblingSink):
+class AzureSink(AssemblingObjectSink):
     """Azure Blob storage over its REST API with SharedKey signing —
     the exact scheme the Azure SDK computes (Put Blob / Delete Blob /
     List Blobs, x-ms-version 2020-10-02)."""
@@ -286,15 +224,21 @@ class AzureSink(_AssemblingSink):
             )
             if status != 200:
                 raise RuntimeError(f"azure list {prefix}: http {status}")
+            from xml.sax.saxutils import unescape
+
             text = body.decode()
-            names.extend(re.findall(r"<Name>([^<]+)</Name>", text))
+            # XML-unescape: a blob named "a&b.bin" lists as a&amp;b.bin,
+            # and sweeping the escaped name would 404 and strand the blob
+            names.extend(
+                unescape(n) for n in re.findall(r"<Name>([^<]+)</Name>", text)
+            )
             m = re.search(r"<NextMarker>([^<]+)</NextMarker>", text)
             if not m:
                 return names
-            marker = m.group(1)
+            marker = unescape(m.group(1))
 
 
-class B2Sink(_AssemblingSink):
+class B2Sink(AssemblingObjectSink):
     """Backblaze B2 over the native API: authorize_account once, then
     get_upload_url/upload_file per object (b2_sink.go's SDK flow)."""
 
@@ -341,21 +285,35 @@ class B2Sink(_AssemblingSink):
                 return b["bucketId"]
         raise RuntimeError(f"b2: bucket {self.bucket_name!r} not found")
 
+    _upload: tuple[str, str] | None = None  # cached (uploadUrl, token)
+
     def _put(self, name: str, data: bytes) -> None:
-        up = self._api("b2_get_upload_url", {"bucketId": self.bucket_id})
-        status, _, body = _request(
-            "POST",
-            up["uploadUrl"],
-            body=data,
-            headers={
-                "Authorization": up["authorizationToken"],
-                "X-Bz-File-Name": urllib.parse.quote(name),
-                "Content-Type": "b2/x-auto",
-                "X-Bz-Content-Sha1": hashlib.sha1(data).hexdigest(),
-            },
-        )
-        if status != 200:
-            raise RuntimeError(f"b2 upload {name}: http {status} {body[:200]!r}")
+        # B2 lets an upload URL/token be reused until it errors; the
+        # SDK flow caches it and re-fetches on failure — one extra API
+        # round-trip per bulk sync instead of one per object
+        for attempt in (0, 1):
+            if self._upload is None:
+                up = self._api("b2_get_upload_url", {"bucketId": self.bucket_id})
+                self._upload = (up["uploadUrl"], up["authorizationToken"])
+            url, token = self._upload
+            status, _, body = _request(
+                "POST",
+                url,
+                body=data,
+                headers={
+                    "Authorization": token,
+                    "X-Bz-File-Name": urllib.parse.quote(name),
+                    "Content-Type": "b2/x-auto",
+                    "X-Bz-Content-Sha1": hashlib.sha1(data).hexdigest(),
+                },
+            )
+            if status == 200:
+                return
+            self._upload = None  # expired/rotated: fetch a fresh one
+            if attempt:
+                raise RuntimeError(
+                    f"b2 upload {name}: http {status} {body[:200]!r}"
+                )
 
     def _delete(self, name: str) -> None:
         # B2 keeps every uploaded version of a name: deleting only the

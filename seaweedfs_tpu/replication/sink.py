@@ -208,42 +208,30 @@ class LocalSink(ReplicationSink):
             os.remove(path)
 
 
-class S3Sink(ReplicationSink):
-    """Replicate into an S3-compatible bucket (sink/s3sink/s3_sink.go):
-    each file entry becomes one object (chunks fetched from the source
-    cluster and assembled), directories are implicit in the keys. Works
-    against any SigV4 endpoint including this repo's own gateway."""
+class AssemblingObjectSink(ReplicationSink):
+    """Shared shape of the object-store sinks (S3/GCS/Azure/B2): each
+    file entry becomes one object, assembled from its chunks through
+    the visible-interval algebra (mtime-resolved overlaps, size-clamped
+    views — NOT a raw offset sort, which would resurrect overwritten
+    bytes and let truncated entries grow back past their EOF);
+    directories are implicit in keys, recursive deletes sweep the
+    replicated prefix. Providers implement _put/_delete/_list."""
 
-    name = "s3"
-
-    def __init__(
-        self,
-        endpoint: str,
-        bucket: str,
-        access_key: str = "",
-        secret_key: str = "",
-        directory: str = "",
-        region: str = "us-east-1",
-    ):
-        from seaweedfs_tpu.s3api.client import S3Client
-
-        self.client = S3Client(endpoint, access_key, secret_key, region=region)
-        self.bucket = bucket
+    def __init__(self, directory: str = ""):
         self.dir = directory.strip("/")
         self.source: FilerSource | None = None
 
     def get_sink_to_directory(self) -> str:
         return ""
 
+    def set_source_filer(self, source: FilerSource) -> None:
+        self.source = source
+
     def _key(self, key: str) -> str:
         k = key.lstrip("/")
         return f"{self.dir}/{k}" if self.dir else k
 
     def _assemble(self, entry: fpb.Entry) -> bytes:
-        """Assemble the file through the visible-interval algebra
-        (mtime-resolved overlaps, size-clamped views) — NOT a raw
-        offset sort, which would resurrect overwritten bytes and let
-        truncated entries grow back past their EOF."""
         from seaweedfs_tpu.filer import filechunks
 
         size = entry.attributes.file_size or sum(c.size for c in entry.chunks)
@@ -257,7 +245,7 @@ class S3Sink(ReplicationSink):
     def create_entry(self, key: str, entry: fpb.Entry) -> None:
         if entry.is_directory:
             return  # object stores have no directories
-        self.client.put_object(self.bucket, self._key(key), self._assemble(entry))
+        self._put(self._key(key), self._assemble(entry))
 
     def update_entry(
         self, key, old_entry, new_parent_path, new_entry, delete_chunks
@@ -271,10 +259,51 @@ class S3Sink(ReplicationSink):
             # directory; sweep the whole replicated prefix or every
             # object under it is orphaned in the bucket forever
             prefix = self._key(key).rstrip("/") + "/"
-            for obj_key in self.client.list_objects(self.bucket, prefix):
-                self.client.delete_object(self.bucket, obj_key)
+            for obj_key in self._list(prefix):
+                self._delete(obj_key)
             return
-        self.client.delete_object(self.bucket, self._key(key))
+        self._delete(self._key(key))
+
+    # provider primitives
+    def _put(self, name: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def _delete(self, name: str) -> None:
+        raise NotImplementedError
+
+    def _list(self, prefix: str) -> list[str]:
+        raise NotImplementedError
+
+
+class S3Sink(AssemblingObjectSink):
+    """Replicate into an S3-compatible bucket (sink/s3sink/s3_sink.go).
+    Works against any SigV4 endpoint including this repo's own gateway."""
+
+    name = "s3"
+
+    def __init__(
+        self,
+        endpoint: str,
+        bucket: str,
+        access_key: str = "",
+        secret_key: str = "",
+        directory: str = "",
+        region: str = "us-east-1",
+    ):
+        super().__init__(directory)
+        from seaweedfs_tpu.s3api.client import S3Client
+
+        self.client = S3Client(endpoint, access_key, secret_key, region=region)
+        self.bucket = bucket
+
+    def _put(self, name: str, data: bytes) -> None:
+        self.client.put_object(self.bucket, name, data)
+
+    def _delete(self, name: str) -> None:
+        self.client.delete_object(self.bucket, name)
+
+    def _list(self, prefix: str) -> list[str]:
+        return list(self.client.list_objects(self.bucket, prefix))
 
 
 # gcs / azure / backblaze live in replication/cloud_sinks.py — real

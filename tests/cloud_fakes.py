@@ -185,12 +185,14 @@ class FakeAzure(_FakeBase):
                         for k in sorted(fake.objects)
                         if k.startswith(prefix) and k > marker
                     ]
+                    from xml.sax.saxutils import escape
+
                     page = names[: fake.page_size]
                     blobs = "".join(
-                        f"<Blob><Name>{k}</Name></Blob>" for k in page
+                        f"<Blob><Name>{escape(k)}</Name></Blob>" for k in page
                     )
                     nxt = (
-                        f"<NextMarker>{page[-1]}</NextMarker>"
+                        f"<NextMarker>{escape(page[-1])}</NextMarker>"
                         if len(names) > fake.page_size
                         else ""
                     )
@@ -404,3 +406,79 @@ class FakeEtcd(_FakeBase):
         if c.get("target") == "VALUE":
             return self.kv.get(key) == c.get("value")
         return False
+
+
+class FakeRedis:
+    """Minimal RESP2 server over a dict: the command subset the redis
+    filer store speaks (SET GET DEL SADD SREM SMEMBERS PING)."""
+
+    def __init__(self):
+        import socketserver
+
+        self.strings: dict[bytes, bytes] = {}
+        self.sets: dict[bytes, set[bytes]] = {}
+        self._lock = threading.Lock()
+        fake = self
+
+        class H(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    if not line.startswith(b"*"):
+                        return
+                    argc = int(line[1:].strip())
+                    args = []
+                    for _ in range(argc):
+                        hdr = self.rfile.readline()
+                        n = int(hdr[1:].strip())
+                        args.append(self.rfile.read(n + 2)[:-2])
+                    self.wfile.write(fake._dispatch(args))
+                    self.wfile.flush()
+
+        self._server = socketserver.ThreadingTCPServer(("127.0.0.1", 0), H)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.address = f"127.0.0.1:{self.port}"
+
+    def _dispatch(self, args: list[bytes]) -> bytes:
+        cmd = args[0].upper()
+        with self._lock:
+            if cmd == b"PING":
+                return b"+PONG\r\n"
+            if cmd == b"SET":
+                self.strings[args[1]] = args[2]
+                return b"+OK\r\n"
+            if cmd == b"GET":
+                v = self.strings.get(args[1])
+                if v is None:
+                    return b"$-1\r\n"
+                return b"$%d\r\n%s\r\n" % (len(v), v)
+            if cmd == b"DEL":
+                n = 1 if self.strings.pop(args[1], None) is not None else 0
+                return b":%d\r\n" % n
+            if cmd == b"SADD":
+                s = self.sets.setdefault(args[1], set())
+                added = sum(1 for m in args[2:] if m not in s)
+                s.update(args[2:])
+                return b":%d\r\n" % added
+            if cmd == b"SREM":
+                s = self.sets.get(args[1], set())
+                removed = sum(1 for m in args[2:] if m in s)
+                s.difference_update(args[2:])
+                return b":%d\r\n" % removed
+            if cmd == b"SMEMBERS":
+                s = sorted(self.sets.get(args[1], set()))
+                out = b"*%d\r\n" % len(s)
+                for m in s:
+                    out += b"$%d\r\n%s\r\n" % (len(m), m)
+                return out
+        return b"-ERR unknown command\r\n"
+
+    def start(self):
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

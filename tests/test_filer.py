@@ -217,6 +217,27 @@ def _sql_factory(tmp):
     return new_sqlite_sql_store(str(tmp / "filer.sql.db"))
 
 
+class _RedisFactory:
+    """Starts a fresh in-repo RESP fake per store instance and stops it
+    when the store closes."""
+
+    def __call__(self, tmp):
+        from seaweedfs_tpu.filer.redis_store import RedisStore
+        from tests.cloud_fakes import FakeRedis
+
+        fake = FakeRedis()
+        fake.start()
+        store = RedisStore(fake.address)
+        orig_close = store.close
+
+        def close():
+            orig_close()
+            fake.stop()
+
+        store.close = close
+        return store
+
+
 @pytest.mark.parametrize(
     "store_factory",
     [
@@ -225,8 +246,9 @@ def _sql_factory(tmp):
         lambda tmp: SortedLogStore(str(tmp / "filer.log")),
         _lsm_factory,
         _sql_factory,
+        _RedisFactory(),
     ],
-    ids=["memory", "sqlite", "sortedlog", "lsm", "sql"],
+    ids=["memory", "sqlite", "sortedlog", "lsm", "sql", "redis"],
 )
 class TestFilerStores:
     def test_crud_and_list(self, store_factory, tmp_path):
@@ -308,6 +330,9 @@ class TestAbstractSql:
                 new_store(kind)
         with pytest.raises(ValueError, match="embedded kinds"):
             new_store("cassandra")
+        # redis gates on connectivity, not a library
+        with pytest.raises(RuntimeError, match="cannot reach"):
+            new_store("redis", "127.0.0.1:1")
 
     def test_insert_degrades_to_update_on_duplicate(self, tmp_path):
         from seaweedfs_tpu.filer.filerstore import new_store
