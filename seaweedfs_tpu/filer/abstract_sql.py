@@ -11,8 +11,7 @@ B-tree clusters siblings and list queries stay range scans.
 
 mysql / postgres construct with their reference SQL but gate on their
 client libraries, which are not in this image — new_store("mysql"|
-"postgres") raises with guidance (the notification.GatedQueue
-convention); the `sql` kind runs the SAME dialect machinery over
+"postgres") raises with guidance; the `sql` kind runs the SAME dialect machinery over
 stdlib sqlite3 and is what the conformance matrix exercises.
 """
 

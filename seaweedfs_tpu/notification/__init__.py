@@ -6,11 +6,11 @@ EventNotification) messages into (filer2/filer_notify.go:9-39).
 Backends here: log (glog-style), memory (in-process, subscribable),
 dirqueue (durable file-per-message directory), logqueue (embedded
 partitioned segmented log with consumer groups — the Kafka-role broker,
-notification/logqueue.py), and kafka — a real wire-protocol producer
-(notification/kafka.py, no client library; gated on broker
-connectivity). aws_sqs / google_pub_sub still need client libraries
-not present in this image and remain GatedQueue stubs pointing at
-logqueue as the built-in equivalent.
+notification/logqueue.py), kafka (real wire-protocol producer,
+notification/kafka.py), and aws_sqs / google_pub_sub (the AWS Query
+protocol with SigV4 and the Pub/Sub REST publish endpoint,
+notification/cloud_queues.py). None need client libraries; the gates
+are connectivity and credentials.
 """
 
 from __future__ import annotations
@@ -121,18 +121,9 @@ class DirQueue(NotificationQueue):
             yield seq, header["key"], msg
 
 
-class GatedQueue(NotificationQueue):
-    """Placeholder for broker-backed queues (kafka, aws_sqs,
-    google_pub_sub, gocdk_pub_sub) whose client libraries are not in
-    this image; constructing one raises with guidance."""
-
-    def __init__(self, kind: str):
-        raise RuntimeError(
-            f"notification queue {kind!r} requires an external client "
-            "library not present in this environment; use [notification."
-            "logqueue] (embedded partitioned log with consumer groups) "
-            "or [notification.dirqueue] / [notification.memory]"
-        )
+# kafka / aws_sqs / google_pub_sub live in kafka.py and cloud_queues.py
+# — real wire-protocol implementations, gated on connectivity or
+# credentials rather than on client libraries.
 
 
 def configure(cfg) -> NotificationQueue | None:
@@ -163,9 +154,27 @@ def configure(cfg) -> NotificationQueue | None:
             topic=cfg.get_string("notification.kafka.topic", "seaweedfs_filer"),
         )
     elif cfg.get_bool("notification.aws_sqs.enabled"):
-        queue = GatedQueue("aws_sqs")
+        from seaweedfs_tpu.notification.cloud_queues import SqsQueue
+
+        queue = SqsQueue(
+            cfg.get_string("notification.aws_sqs.aws_access_key_id", ""),
+            cfg.get_string("notification.aws_sqs.aws_secret_access_key", ""),
+            cfg.get_string("notification.aws_sqs.region", "us-east-1"),
+            cfg.get_string("notification.aws_sqs.sqs_queue_name", ""),
+            endpoint=cfg.get_string("notification.aws_sqs.endpoint", ""),
+        )
     elif cfg.get_bool("notification.google_pub_sub.enabled"):
-        queue = GatedQueue("google_pub_sub")
+        from seaweedfs_tpu.notification.cloud_queues import PubSubQueue
+
+        queue = PubSubQueue(
+            cfg.get_string("notification.google_pub_sub.project_id", ""),
+            cfg.get_string("notification.google_pub_sub.topic", "seaweedfs_filer_topic"),
+            token=cfg.get_string("notification.google_pub_sub.token", ""),
+            endpoint=cfg.get_string(
+                "notification.google_pub_sub.endpoint",
+                "https://pubsub.googleapis.com",
+            ),
+        )
     else:
         queue = None
     return queue

@@ -41,6 +41,59 @@ def derive_signing_key(secret: str, date: str, region: str, service: str) -> byt
     return _hmac(k, "aws4_request")
 
 
+def sigv4_sign(
+    method: str,
+    path: str,
+    query_string: str,
+    headers: dict,
+    payload_hash: str,
+    access_key: str,
+    secret_key: str,
+    region: str,
+    service: str,
+    amz_date: str,
+) -> str:
+    """The client-side SigV4 Authorization header value — the single
+    home of the canonical-request → string-to-sign → signature chain
+    for every AWS-protocol client in the repo (S3 data plane, SQS
+    notifications). `headers` must already include host and x-amz-date;
+    values are trimmed per the spec."""
+    import hashlib as _hashlib
+    import hmac as _hmac_mod
+
+    date = amz_date[:8]
+    signed = sorted(k.lower() for k in headers)
+    lower = {k.lower(): str(v).strip() for k, v in headers.items()}
+    canonical = "\n".join(
+        [
+            method,
+            path,
+            query_string,
+            "".join(f"{k}:{lower[k]}\n" for k in signed),
+            ";".join(signed),
+            payload_hash,
+        ]
+    )
+    scope = f"{date}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            _hashlib.sha256(canonical.encode()).hexdigest(),
+        ]
+    )
+    signature = _hmac_mod.new(
+        derive_signing_key(secret_key, date, region, service),
+        string_to_sign.encode(),
+        _hashlib.sha256,
+    ).hexdigest()
+    return (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={signature}"
+    )
+
+
 def uri_encode(value: str, encode_slash: bool = True) -> str:
     safe = "-_.~" + ("" if encode_slash else "/")
     return urllib.parse.quote(value, safe=safe)

@@ -10,12 +10,10 @@ from __future__ import annotations
 
 import datetime
 import hashlib
-import hmac
 import urllib.error
 import urllib.parse
 import urllib.request
 
-from seaweedfs_tpu.s3api.auth import derive_signing_key
 
 
 class _ProgressReader:
@@ -91,34 +89,19 @@ class S3Client:
         if extra_headers:
             headers.update({k.lower(): v for k, v in extra_headers.items()})
 
-        signed = sorted(headers)
-        canonical_headers = "".join(f"{k}:{headers[k].strip()}\n" for k in signed)
-        canonical = "\n".join(
-            [
-                method,
-                urllib.parse.quote(path),
-                query_string,
-                canonical_headers,
-                ";".join(signed),
-                payload_hash,
-            ]
-        )
-        scope = f"{date}/{self.region}/s3/aws4_request"
-        string_to_sign = "\n".join(
-            [
-                "AWS4-HMAC-SHA256",
-                amz_date,
-                scope,
-                hashlib.sha256(canonical.encode()).hexdigest(),
-            ]
-        )
-        key_bytes = derive_signing_key(self.secret_key, date, self.region, "s3")
-        signature = hmac.new(
-            key_bytes, string_to_sign.encode(), hashlib.sha256
-        ).hexdigest()
-        auth = (
-            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
-            f"SignedHeaders={';'.join(signed)}, Signature={signature}"
+        from seaweedfs_tpu.s3api.auth import sigv4_sign
+
+        auth = sigv4_sign(
+            method,
+            urllib.parse.quote(path),
+            query_string,
+            headers,
+            payload_hash,
+            self.access_key,
+            self.secret_key,
+            self.region,
+            "s3",
+            amz_date,
         )
 
         url = f"http://{self.endpoint}{urllib.parse.quote(path)}"

@@ -482,3 +482,140 @@ class FakeRedis:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+
+
+class FakeSqs(_FakeBase):
+    """AWS SQS Query-protocol subset: GetQueueUrl + SendMessage.
+    Validates the SigV4 signature with the same derivation the queue
+    computes (self-consistency)."""
+
+    def __init__(self, access_key: str, secret_key: str, region: str, queue: str):
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.queue_name = queue
+        self.messages: list[tuple[str, str]] = []  # (key, body)
+        super().__init__()
+
+    def _check_sig(self, handler, body: bytes) -> bool:
+        from seaweedfs_tpu.s3api.auth import derive_signing_key
+
+        auth = handler.headers.get("Authorization", "")
+        if f"Credential={self.access_key}/" not in auth:
+            return False
+        amz_date = handler.headers.get("x-amz-date", "")
+        date = amz_date[:8]
+        headers = {
+            "content-type": handler.headers.get("Content-Type", ""),
+            "host": handler.headers.get("Host", ""),
+            "x-amz-date": amz_date,
+        }
+        signed = sorted(headers)
+        canonical = "\n".join(
+            [
+                "POST", "/", "",
+                "".join(f"{k}:{headers[k]}\n" for k in signed),
+                ";".join(signed),
+                hashlib.sha256(body).hexdigest(),
+            ]
+        )
+        scope = f"{date}/{self.region}/sqs/aws4_request"
+        sts = "\n".join(
+            ["AWS4-HMAC-SHA256", amz_date, scope,
+             hashlib.sha256(canonical.encode()).hexdigest()]
+        )
+        want = hmac.new(
+            derive_signing_key(self.secret_key, date, self.region, "sqs"),
+            sts.encode(), hashlib.sha256,
+        ).hexdigest()
+        return f"Signature={want}" in auth
+
+    def _handler_class(self):
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _xml(self, body: str, status=200):
+                b = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(b)))
+                self.end_headers()
+                self.wfile.write(b)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(n)
+                if not fake._check_sig(self, body):
+                    return self._xml("<Error>SignatureDoesNotMatch</Error>", 403)
+                params = dict(urllib.parse.parse_qsl(body.decode()))
+                action = params.get("Action")
+                if action == "GetQueueUrl":
+                    if params.get("QueueName") != fake.queue_name:
+                        return self._xml(
+                            "<Error><Code>AWS.SimpleQueueService."
+                            "NonExistentQueue</Code></Error>", 400,
+                        )
+                    return self._xml(
+                        "<GetQueueUrlResponse><GetQueueUrlResult><QueueUrl>"
+                        f"{fake.endpoint}/123/{fake.queue_name}"
+                        "</QueueUrl></GetQueueUrlResult></GetQueueUrlResponse>"
+                    )
+                if action == "SendMessage":
+                    key = params.get(
+                        "MessageAttribute.1.Value.StringValue", ""
+                    )
+                    fake.messages.append((key, params.get("MessageBody", "")))
+                    return self._xml(
+                        "<SendMessageResponse><SendMessageResult>"
+                        "<MessageId>m1</MessageId>"
+                        "</SendMessageResult></SendMessageResponse>"
+                    )
+                self._xml("<Error>bad action</Error>", 400)
+
+        return H
+
+
+class FakePubSub(_FakeBase):
+    """Google Pub/Sub REST publish subset."""
+
+    def __init__(self, project: str, topic: str):
+        self.path = f"/v1/projects/{project}/topics/{topic}:publish"
+        self.messages: list[tuple[str, bytes]] = []  # (key, data)
+        super().__init__()
+
+    def _handler_class(self):
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, status=200):
+                b = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(b)))
+                self.end_headers()
+                self.wfile.write(b)
+
+            def do_GET(self):
+                # topic existence probe (GET /v1/projects/p/topics/t)
+                if self.path == fake.path.removesuffix(":publish"):
+                    return self._json({"name": self.path[4:]})
+                self._json({"error": {"code": 404}}, 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if self.path != fake.path:
+                    return self._json({"error": {"code": 404}}, 404)
+                ids = []
+                for m in payload.get("messages", []):
+                    data = base64.b64decode(m.get("data", ""))
+                    key = m.get("attributes", {}).get("key", "")
+                    fake.messages.append((key, data))
+                    ids.append(str(len(fake.messages)))
+                return self._json({"messageIds": ids})
+
+        return H
