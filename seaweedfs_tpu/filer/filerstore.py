@@ -288,6 +288,11 @@ def new_store(kind: str, path: str = "") -> FilerStore:
         from seaweedfs_tpu.filer.redis_store import RedisStore
 
         return RedisStore(path or "localhost:6379")
+    if kind == "cassandra":
+        # real CQL-v4-protocol store, gated on connectivity
+        from seaweedfs_tpu.filer.cassandra_store import CassandraStore
+
+        return CassandraStore(path or "localhost:9042")
     if kind == "sortedlog":
         if not path:
             raise ValueError("sortedlog store needs a path")
@@ -300,9 +305,10 @@ def new_store(kind: str, path: str = "") -> FilerStore:
         return LsmStore(path)
     raise ValueError(
         f"unknown filer store {kind!r}: embedded kinds are memory | sqlite"
-        " | sql | sortedlog | lsm; redis speaks RESP to a live server"
-        " (kind 'redis', path 'host:port'); mysql | postgres speak the"
-        " reference SQL dialects but need their client libraries (see"
-        " filer/abstract_sql.py); cassandra/tikv have no in-image"
-        " counterpart — use an embedded store"
+        " | sql | sortedlog | lsm; redis (RESP) and cassandra (CQL v4)"
+        " speak their wire protocols to a live server (path ="
+        " 'host:port'); mysql | postgres speak the reference SQL"
+        " dialects but need their client libraries (see"
+        " filer/abstract_sql.py); tikv has no in-image counterpart —"
+        " use an embedded store"
     )

@@ -170,6 +170,9 @@ def configure(cfg) -> NotificationQueue | None:
             cfg.get_string("notification.google_pub_sub.project_id", ""),
             cfg.get_string("notification.google_pub_sub.topic", "seaweedfs_filer_topic"),
             token=cfg.get_string("notification.google_pub_sub.token", ""),
+            token_file=cfg.get_string(
+                "notification.google_pub_sub.token_file", ""
+            ),
             endpoint=cfg.get_string(
                 "notification.google_pub_sub.endpoint",
                 "https://pubsub.googleapis.com",

@@ -144,46 +144,85 @@ class PubSubQueue:
         project_id: str,
         topic: str,
         token: str = "",
+        token_file: str = "",
         endpoint: str = "https://pubsub.googleapis.com",
     ):
         self.endpoint = endpoint.rstrip("/")
         self.path = f"/v1/projects/{project_id}/topics/{topic}"
-        self._headers = {"Content-Type": "application/json"}
-        if token:
-            self._headers["Authorization"] = f"Bearer {token}"
-        elif "googleapis.com" in self.endpoint:
+        # token_file is re-read per request so an external refresher
+        # (e.g. a cron running `gcloud auth print-access-token`) keeps
+        # publishes working past the ~1 h OAuth token lifetime — the
+        # role the reference's SDK credential auto-refresh plays
+        self._token_file = token_file
+        self._token = token
+        if not token and not token_file and "googleapis.com" in self.endpoint:
             raise RuntimeError(
                 "notification queue 'google_pub_sub' needs an OAuth bearer "
-                "`token` (or a custom `endpoint` for an emulator); or use "
-                "the embedded [notification.logqueue]"
+                "`token` or a `token_file` (or a custom `endpoint` for an "
+                "emulator); or use the embedded [notification.logqueue]"
             )
-        # existence probe, the role of the reference's topic.Exists
-        # check: GET the topic resource (an empty :publish would 400 on
-        # request validation BEFORE topic resolution, hiding a typo'd
-        # topic until every later event silently 404s)
+        # existence probe, the role of the reference's topic.Exists →
+        # CreateTopic flow: GET the topic; 404 → try to create it;
+        # 403 → proceed (publisher-only credentials can publish but not
+        # get/create — hard-failing would reject a valid config)
+        status, body = self._get_topic()
+        if status == 404:
+            status, body = self._request(
+                "PUT", self.path, json.dumps({}).encode()
+            )
+            if status not in (200, 409):
+                raise RuntimeError(
+                    f"google_pub_sub: topic missing and create failed "
+                    f"(http {status} {body[:200]!r})"
+                )
+        elif status == 403:
+            from seaweedfs_tpu.util import wlog
+
+            wlog.warning(
+                "google_pub_sub: cannot GET topic %s (403; publisher-only "
+                "credentials?) — proceeding, publishes will tell",
+                self.path,
+            )
+        elif status != 200:
+            raise RuntimeError(
+                f"google_pub_sub: topic at {self.endpoint}{self.path} not "
+                f"usable (http {status} {body[:200]!r})"
+            )
+
+    def _headers_now(self) -> dict:
+        headers = {"Content-Type": "application/json"}
+        token = self._token
+        if self._token_file:
+            try:
+                with open(self._token_file) as f:
+                    token = f.read().strip()
+            except OSError:
+                pass  # fall back to the static token, if any
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        return headers
+
+    def _request(self, method: str, path: str, body: bytes | None):
+        headers = self._headers_now()
+        if body is None:
+            headers.pop("Content-Type", None)
         req = urllib.request.Request(
-            f"{self.endpoint}{self.path}",
-            method="GET",
-            headers={
-                k: v for k, v in self._headers.items() if k != "Content-Type"
-            },
+            f"{self.endpoint}{path}", data=body, method=method, headers=headers
         )
         try:
             with urllib.request.urlopen(req, timeout=30) as r:
-                status, body = r.status, b""
+                return r.status, r.read()
         except urllib.error.HTTPError as e:
-            status, body = e.code, e.read()
+            return e.code, e.read()
         except OSError as e:
             raise RuntimeError(
                 f"notification queue 'google_pub_sub' cannot reach "
                 f"{self.endpoint} ({e}); check the endpoint/network, or "
                 "use the embedded [notification.logqueue]"
             ) from e
-        if status != 200:
-            raise RuntimeError(
-                f"google_pub_sub: topic at {self.endpoint}{self.path} not "
-                f"usable (http {status} {body[:200]!r})"
-            )
+
+    def _get_topic(self):
+        return self._request("GET", self.path, None)
 
     def send_message(self, key: str, message: fpb.EventNotification) -> None:
         payload = {
@@ -196,10 +235,8 @@ class PubSubQueue:
                 }
             ]
         }
-        status, body = _post(
-            f"{self.endpoint}{self.path}:publish",
-            json.dumps(payload).encode(),
-            self._headers,
+        status, body = self._request(
+            "POST", f"{self.path}:publish", json.dumps(payload).encode()
         )
         if status != 200:
             raise RuntimeError(

@@ -58,9 +58,6 @@ def sigv4_sign(
     for every AWS-protocol client in the repo (S3 data plane, SQS
     notifications). `headers` must already include host and x-amz-date;
     values are trimmed per the spec."""
-    import hashlib as _hashlib
-    import hmac as _hmac_mod
-
     date = amz_date[:8]
     signed = sorted(k.lower() for k in headers)
     lower = {k.lower(): str(v).strip() for k, v in headers.items()}
@@ -80,13 +77,13 @@ def sigv4_sign(
             "AWS4-HMAC-SHA256",
             amz_date,
             scope,
-            _hashlib.sha256(canonical.encode()).hexdigest(),
+            hashlib.sha256(canonical.encode()).hexdigest(),
         ]
     )
-    signature = _hmac_mod.new(
+    signature = hmac.new(
         derive_signing_key(secret_key, date, region, service),
         string_to_sign.encode(),
-        _hashlib.sha256,
+        hashlib.sha256,
     ).hexdigest()
     return (
         f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "

@@ -605,6 +605,14 @@ class FakePubSub(_FakeBase):
                     return self._json({"name": self.path[4:]})
                 self._json({"error": {"code": 404}}, 404)
 
+            def do_PUT(self):
+                # topic auto-create (reference: topic.Exists → CreateTopic)
+                n = int(self.headers.get("Content-Length", "0"))
+                self.rfile.read(n)
+                if self.path == fake.path.removesuffix(":publish"):
+                    return self._json({"name": self.path[4:]})
+                self._json({"error": {"code": 404}}, 404)
+
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", "0"))
                 payload = json.loads(self.rfile.read(n) or b"{}")
@@ -619,3 +627,158 @@ class FakePubSub(_FakeBase):
                 return self._json({"messageIds": ids})
 
         return H
+
+
+class FakeCassandra:
+    """CQL v4 binary-protocol subset: STARTUP/READY + the five
+    filemeta statements (native frames both directions, so the store's
+    framing, value encoding, and rows decoding are all exercised)."""
+
+    def __init__(self, keyspace: str = "seaweedfs"):
+        import re
+        import socketserver
+        import struct
+
+        self.keyspace = keyspace
+        # (directory, name) -> meta, kept sorted per directory on read
+        self.rows: dict[tuple[str, str], bytes] = {}
+        self._lock = threading.Lock()
+        fake = self
+        _re, _struct = re, struct
+
+        OP_ERROR, OP_READY, OP_QUERY, OP_RESULT = 0x00, 0x02, 0x07, 0x08
+
+        class H(socketserver.StreamRequestHandler):
+            def _frame(self, stream, opcode, body):
+                self.wfile.write(
+                    _struct.pack(">BBhBi", 0x84, 0, stream, opcode, len(body))
+                    + body
+                )
+                self.wfile.flush()
+
+            def _rows(self, stream, cols, rows):
+                # metadata with global_tables_spec; all cols varchar/blob
+                body = _struct.pack(">i", 0x0002)  # kind = Rows
+                body += _struct.pack(">ii", 0x0001, len(cols))
+                for s in (fake.keyspace, "filemeta"):
+                    b = s.encode()
+                    body += _struct.pack(">H", len(b)) + b
+                for cname, ctype in cols:
+                    b = cname.encode()
+                    body += _struct.pack(">H", len(b)) + b
+                    body += _struct.pack(">h", ctype)
+                body += _struct.pack(">i", len(rows))
+                for row in rows:
+                    for v in row:
+                        body += _struct.pack(">i", len(v)) + v
+                self._frame(stream, OP_RESULT, body)
+
+            def handle(self):
+                while True:
+                    hdr = self.rfile.read(9)
+                    if len(hdr) < 9:
+                        return
+                    _v, _f, stream, opcode, length = _struct.unpack(
+                        ">BBhBi", hdr
+                    )
+                    body = self.rfile.read(length)
+                    if opcode == 0x01:  # STARTUP
+                        self._frame(stream, OP_READY, b"")
+                        continue
+                    if opcode != OP_QUERY:
+                        return
+                    off = 0
+                    (qlen,) = _struct.unpack_from(">i", body, off)
+                    off += 4
+                    cql = body[off : off + qlen].decode()
+                    off += qlen
+                    off += 2  # consistency
+                    (flags,) = _struct.unpack_from(">B", body, off)
+                    off += 1
+                    values = []
+                    if flags & 0x01:
+                        (n,) = _struct.unpack_from(">H", body, off)
+                        off += 2
+                        for _ in range(n):
+                            (vlen,) = _struct.unpack_from(">i", body, off)
+                            off += 4
+                            values.append(body[off : off + vlen])
+                            off += max(vlen, 0)
+                    self._dispatch(stream, cql.strip(), values)
+
+            def _void(self, stream):
+                self._frame(stream, OP_RESULT, _struct.pack(">i", 0x0001))
+
+            def _dispatch(self, stream, cql, values):
+                up = cql.upper()
+                with fake._lock:
+                    if up.startswith("USE "):
+                        name = cql.split()[1].strip().encode()
+                        body = _struct.pack(">i", 0x0003)
+                        body += _struct.pack(">H", len(name)) + name
+                        return self._frame(stream, OP_RESULT, body)
+                    if up.startswith("INSERT INTO FILEMETA"):
+                        d, name, meta = (
+                            values[0].decode(),
+                            values[1].decode(),
+                            values[2],
+                        )
+                        fake.rows[(d, name)] = meta
+                        return self._void(stream)
+                    if up.startswith("SELECT META"):
+                        d, name = values[0].decode(), values[1].decode()
+                        meta = fake.rows.get((d, name))
+                        rows = [[meta]] if meta is not None else []
+                        return self._rows(
+                            stream, [("meta", 0x0003)], rows
+                        )
+                    if up.startswith("DELETE FROM FILEMETA WHERE DIRECTORY=? AND NAME=?"):
+                        d, name = values[0].decode(), values[1].decode()
+                        fake.rows.pop((d, name), None)
+                        return self._void(stream)
+                    if up.startswith("DELETE FROM FILEMETA WHERE DIRECTORY=?"):
+                        d = values[0].decode()
+                        for k in [k for k in fake.rows if k[0] == d]:
+                            del fake.rows[k]
+                        return self._void(stream)
+                    if up.startswith("SELECT NAME, META"):
+                        d = values[0].decode()
+                        start = values[1].decode()
+                        (limit,) = _struct.unpack(">i", values[2])
+                        inclusive = "NAME>=?" in up.replace(" ", "")
+                        names = sorted(
+                            n for (dd, n) in fake.rows if dd == d
+                        )
+                        out = []
+                        for n in names:
+                            if inclusive and n < start:
+                                continue
+                            if not inclusive and n <= start:
+                                continue
+                            out.append(
+                                [n.encode(), fake.rows[(d, n)]]
+                            )
+                            if len(out) >= limit:
+                                break
+                        return self._rows(
+                            stream,
+                            [("name", 0x000D), ("meta", 0x0003)],
+                            out,
+                        )
+                # unknown statement
+                err = _struct.pack(">i", 0x2200)
+                msg = b"unknown statement"
+                err += _struct.pack(">H", len(msg)) + msg
+                self._frame(stream, OP_ERROR, err)
+
+        self._server = socketserver.ThreadingTCPServer(("127.0.0.1", 0), H)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.address = f"127.0.0.1:{self.port}"
+
+    def start(self):
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

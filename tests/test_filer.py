@@ -217,17 +217,18 @@ def _sql_factory(tmp):
     return new_sqlite_sql_store(str(tmp / "filer.sql.db"))
 
 
-class _RedisFactory:
-    """Starts a fresh in-repo RESP fake per store instance and stops it
-    when the store closes."""
+class _FakeBackedFactory:
+    """Starts a fresh in-repo protocol fake per store instance and
+    stops it when the store closes."""
+
+    def __init__(self, fake_cls, store_builder):
+        self._fake_cls = fake_cls
+        self._build = store_builder
 
     def __call__(self, tmp):
-        from seaweedfs_tpu.filer.redis_store import RedisStore
-        from tests.cloud_fakes import FakeRedis
-
-        fake = FakeRedis()
+        fake = self._fake_cls()
         fake.start()
-        store = RedisStore(fake.address)
+        store = self._build(fake)
         orig_close = store.close
 
         def close():
@@ -238,6 +239,22 @@ class _RedisFactory:
         return store
 
 
+def _redis_factory():
+    from seaweedfs_tpu.filer.redis_store import RedisStore
+    from tests.cloud_fakes import FakeRedis
+
+    return _FakeBackedFactory(FakeRedis, lambda f: RedisStore(f.address))
+
+
+def _cassandra_factory():
+    from seaweedfs_tpu.filer.cassandra_store import CassandraStore
+    from tests.cloud_fakes import FakeCassandra
+
+    return _FakeBackedFactory(
+        FakeCassandra, lambda f: CassandraStore(f.address)
+    )
+
+
 @pytest.mark.parametrize(
     "store_factory",
     [
@@ -246,9 +263,10 @@ class _RedisFactory:
         lambda tmp: SortedLogStore(str(tmp / "filer.log")),
         _lsm_factory,
         _sql_factory,
-        _RedisFactory(),
+        _redis_factory(),
+        _cassandra_factory(),
     ],
-    ids=["memory", "sqlite", "sortedlog", "lsm", "sql", "redis"],
+    ids=["memory", "sqlite", "sortedlog", "lsm", "sql", "redis", "cassandra"],
 )
 class TestFilerStores:
     def test_crud_and_list(self, store_factory, tmp_path):
@@ -329,10 +347,14 @@ class TestAbstractSql:
             with pytest.raises(RuntimeError, match="client library"):
                 new_store(kind)
         with pytest.raises(ValueError, match="embedded kinds"):
-            new_store("cassandra")
-        # redis gates on connectivity, not a library
+            new_store("no-such-store")
+        # redis / cassandra gate on connectivity, not a library
         with pytest.raises(RuntimeError, match="cannot reach"):
             new_store("redis", "127.0.0.1:1")
+        with pytest.raises(RuntimeError, match="cannot reach"):
+            new_store("cassandra", "127.0.0.1:1")
+        with pytest.raises(ValueError, match="tikv"):
+            new_store("tikv")
 
     def test_insert_degrades_to_update_on_duplicate(self, tmp_path):
         from seaweedfs_tpu.filer.filerstore import new_store
