@@ -293,6 +293,11 @@ def new_store(kind: str, path: str = "") -> FilerStore:
         from seaweedfs_tpu.filer.cassandra_store import CassandraStore
 
         return CassandraStore(path or "localhost:9042")
+    if kind == "etcd":
+        # etcd v3 gateway REST store, gated on connectivity
+        from seaweedfs_tpu.filer.etcd_store import EtcdFilerStore
+
+        return EtcdFilerStore(path or "localhost:2379")
     if kind == "sortedlog":
         if not path:
             raise ValueError("sortedlog store needs a path")
@@ -305,8 +310,8 @@ def new_store(kind: str, path: str = "") -> FilerStore:
         return LsmStore(path)
     raise ValueError(
         f"unknown filer store {kind!r}: embedded kinds are memory | sqlite"
-        " | sql | sortedlog | lsm; redis (RESP) and cassandra (CQL v4)"
-        " speak their wire protocols to a live server (path ="
+        " | sql | sortedlog | lsm; redis (RESP), cassandra (CQL v4) and etcd (v3"
+        " gateway REST) speak their wire protocols to a live server (path ="
         " 'host:port'); mysql | postgres speak the reference SQL"
         " dialects but need their client libraries (see"
         " filer/abstract_sql.py); tikv has no in-image counterpart —"

@@ -119,16 +119,9 @@ class EtcdSequencer:
     def __init__(self, urls: str, step: int = STEP):
         import base64
 
-        self._endpoints = []
-        for u in urls.split(","):
-            u = u.strip().rstrip("/")
-            if not u:
-                continue
-            if not u.startswith("http"):
-                u = "http://" + u
-            self._endpoints.append(u)
-        if not self._endpoints:
-            raise ValueError("etcd sequencer needs at least one endpoint")
+        from seaweedfs_tpu.util.etcd import EtcdKv
+
+        self._kv = EtcdKv(urls)
         self._step = step
         self._lock = threading.Lock()
         self._key_b64 = base64.b64encode(self.KEY.encode()).decode()
@@ -148,31 +141,7 @@ class EtcdSequencer:
 
     # --- etcd v3 gateway primitives ------------------------------------
     def _call(self, op: str, payload: dict) -> dict:
-        """POST to the first endpoint that answers; rotate the working
-        one to the front so steady state dials it directly (the flag
-        advertises endpoint failover, not just a list of one)."""
-        import json as _json
-        import urllib.error
-        import urllib.request
-
-        last: OSError | None = None
-        for i, endpoint in enumerate(self._endpoints):
-            req = urllib.request.Request(
-                f"{endpoint}/v3/kv/{op}",
-                data=_json.dumps(payload).encode(),
-                method="POST",
-                headers={"Content-Type": "application/json"},
-            )
-            try:
-                with urllib.request.urlopen(req, timeout=10) as r:
-                    if i:
-                        self._endpoints.insert(0, self._endpoints.pop(i))
-                    return _json.loads(r.read())
-            except urllib.error.HTTPError:
-                raise  # reachable: a protocol error is not failover-able
-            except OSError as e:
-                last = e
-        raise last if last is not None else OSError("no endpoints")
+        return self._kv.call(op, payload)
 
     def _get(self) -> int | None:
         import base64

@@ -1,0 +1,60 @@
+"""Shared etcd v3 grpc-gateway REST client (/v3/kv/*).
+
+One client for everything that speaks to etcd — the EtcdSequencer and
+the etcd filer store — so endpoint parsing, failover, and error
+classification live in exactly one place."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+
+class EtcdKv:
+    """POST /v3/kv/<op> against the first endpoint that answers; a
+    working endpoint rotates to the front so steady state dials it
+    directly. HTTP errors (the endpoint answered) are not
+    failover-able and propagate; connection-level failures try the
+    next endpoint."""
+
+    def __init__(self, urls: str, timeout: float = 10.0):
+        endpoints = []
+        for u in urls.split(","):
+            u = u.strip().rstrip("/")
+            if not u:
+                continue
+            if not u.startswith("http"):
+                u = "http://" + u
+            endpoints.append(u)
+        if not endpoints:
+            raise ValueError("etcd client needs at least one endpoint")
+        self._endpoints = endpoints
+        self._lock = threading.Lock()  # guards the rotation
+        self.timeout = timeout
+
+    def call(self, op: str, payload: dict) -> dict:
+        with self._lock:
+            endpoints = list(self._endpoints)
+        last: OSError | None = None
+        for endpoint in endpoints:
+            req = urllib.request.Request(
+                f"{endpoint}/v3/kv/{op}",
+                data=json.dumps(payload).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    if endpoint != endpoints[0]:
+                        with self._lock:
+                            if endpoint in self._endpoints:
+                                self._endpoints.remove(endpoint)
+                                self._endpoints.insert(0, endpoint)
+                    return json.loads(r.read())
+            except urllib.error.HTTPError:
+                raise  # reachable: protocol errors are not failover-able
+            except OSError as e:
+                last = e
+        raise last if last is not None else OSError("no endpoints")

@@ -368,13 +368,54 @@ class FakeEtcd(_FakeBase):
                 payload = json.loads(self.rfile.read(n) or b"{}")
                 with fake._lock:
                     if self.path.endswith("/kv/range"):
-                        key = payload["key"]
-                        kvs = (
-                            [{"key": key, "value": fake.kv[key]}]
-                            if key in fake.kv
-                            else []
+                        key = base64.b64decode(payload["key"])
+                        end = payload.get("range_end")
+                        if end is None:
+                            b64k = payload["key"]
+                            kvs = (
+                                [{"key": b64k, "value": fake.kv[b64k]}]
+                                if b64k in fake.kv
+                                else []
+                            )
+                            return self._json({"kvs": kvs})
+                        end_b = base64.b64decode(end)
+                        hits = sorted(
+                            (base64.b64decode(k), v)
+                            for k, v in fake.kv.items()
+                            if key <= base64.b64decode(k) < end_b
                         )
-                        return self._json({"kvs": kvs})
+                        if payload.get("sort_order") == "DESCEND":
+                            hits.reverse()
+                        limit = int(payload.get("limit", 0) or 0)
+                        if limit:
+                            hits = hits[:limit]
+                        return self._json(
+                            {
+                                "kvs": [
+                                    {
+                                        "key": base64.b64encode(k).decode(),
+                                        "value": v,
+                                    }
+                                    for k, v in hits
+                                ]
+                            }
+                        )
+                    if self.path.endswith("/kv/deleterange"):
+                        key = base64.b64decode(payload["key"])
+                        end = payload.get("range_end")
+                        if end is None:
+                            fake.kv.pop(payload["key"], None)
+                            fake.create_rev.pop(payload["key"], None)
+                            return self._json({})
+                        end_b = base64.b64decode(end)
+                        for k in [
+                            k
+                            for k in fake.kv
+                            if key <= base64.b64decode(k) < end_b
+                        ]:
+                            del fake.kv[k]
+                            fake.create_rev.pop(k, None)
+                        return self._json({})
                     if self.path.endswith("/kv/put"):
                         fake._put(payload["key"], payload["value"])
                         return self._json({})

@@ -255,6 +255,13 @@ def _cassandra_factory():
     )
 
 
+def _etcd_factory():
+    from seaweedfs_tpu.filer.etcd_store import EtcdFilerStore
+    from tests.cloud_fakes import FakeEtcd
+
+    return _FakeBackedFactory(FakeEtcd, lambda f: EtcdFilerStore(f.endpoint))
+
+
 @pytest.mark.parametrize(
     "store_factory",
     [
@@ -265,8 +272,9 @@ def _cassandra_factory():
         _sql_factory,
         _redis_factory(),
         _cassandra_factory(),
+        _etcd_factory(),
     ],
-    ids=["memory", "sqlite", "sortedlog", "lsm", "sql", "redis", "cassandra"],
+    ids=["memory", "sqlite", "sortedlog", "lsm", "sql", "redis", "cassandra", "etcd"],
 )
 class TestFilerStores:
     def test_crud_and_list(self, store_factory, tmp_path):
@@ -353,6 +361,8 @@ class TestAbstractSql:
             new_store("redis", "127.0.0.1:1")
         with pytest.raises(RuntimeError, match="cannot reach"):
             new_store("cassandra", "127.0.0.1:1")
+        with pytest.raises(RuntimeError, match="cannot reach"):
+            new_store("etcd", "127.0.0.1:1")
         with pytest.raises(ValueError, match="tikv"):
             new_store("tikv")
 
