@@ -15,6 +15,7 @@ Behavioral match of weed/operation/:
 
 from __future__ import annotations
 
+import functools
 import http.client
 import json
 import socket
@@ -78,6 +79,39 @@ class AssignResult:
     auth: str = ""  # write-JWT for the fid; pass as upload(jwt=...)
 
 
+@functools.lru_cache(maxsize=1024)
+def _upload_query(filename: str, ttl: str, is_chunk_manifest: bool) -> str:
+    """Encoded upload query params, memoized (filenames repeat heavily
+    in bulk ingest: the benchmark, filer chunk uploads)."""
+    q: dict[str, str] = {}
+    if filename:
+        q["filename"] = filename
+    if ttl:
+        q["ttl"] = ttl
+    if is_chunk_manifest:
+        q["cm"] = "true"
+    return urllib.parse.urlencode(q)
+
+
+@functools.lru_cache(maxsize=1024)
+def _assign_query(
+    count: int, replication: str, collection: str, ttl: str, data_center: str
+) -> str:
+    """Encoded /dir/assign query, memoized — writers issue the same
+    parameter tuple per call, and urllib quoting is a measurable share
+    of the client's per-write CPU."""
+    params = {"count": str(count)}
+    if replication:
+        params["replication"] = replication
+    if collection:
+        params["collection"] = collection
+    if ttl:
+        params["ttl"] = ttl
+    if data_center:
+        params["dataCenter"] = data_center
+    return urllib.parse.urlencode(params)
+
+
 def assign(
     master: str,
     count: int = 1,
@@ -93,16 +127,7 @@ def assign(
     the CPython side (measured: the benchmark writer spends more in
     grpc channel machinery than in the upload itself), so the hot
     path uses HTTP and `assign_grpc` remains for gRPC-plane parity."""
-    params = {"count": str(count)}
-    if replication:
-        params["replication"] = replication
-    if collection:
-        params["collection"] = collection
-    if ttl:
-        params["ttl"] = ttl
-    if data_center:
-        params["dataCenter"] = data_center
-    q = urllib.parse.urlencode(params)
+    q = _assign_query(count, replication, collection, ttl, data_center)
     status, _, body = http_call("GET", f"{master}/dir/assign?{q}", timeout=30)
     try:
         d = json.loads(body)
@@ -415,16 +440,10 @@ def upload(
     timeout: float = 30.0,
 ) -> UploadResult:
     """POST a blob to ``http://<url>`` (url is "host:port/fid")."""
-    q: dict[str, str] = {}
-    if filename:
-        q["filename"] = filename
-    if ttl:
-        q["ttl"] = ttl
-    if is_chunk_manifest:
-        q["cm"] = "true"
+    q = _upload_query(filename, ttl, is_chunk_manifest)
     full = url
     if q:
-        full += ("&" if "?" in full else "?") + urllib.parse.urlencode(q)
+        full += ("&" if "?" in full else "?") + q
     headers = {"Content-Type": mime or "application/octet-stream"}
     if jwt:
         headers["Authorization"] = f"BEARER {jwt}"

@@ -15,7 +15,7 @@ import base64
 
 from seaweedfs_tpu.filer.entry import Entry, child_path, normalize_path, split_path
 from seaweedfs_tpu.filer.filerstore import EntryNotFound, FilerStore
-from seaweedfs_tpu.util.etcd import EtcdKv
+from seaweedfs_tpu.util.etcd import EtcdHttpError, EtcdKv
 
 DIR_FILE_SEPARATOR = b"\x00"
 
@@ -41,6 +41,12 @@ class EtcdFilerStore(FilerStore):
         self._kv = EtcdKv(urls)
         try:
             self._kv.call("range", {"key": _b64(b"\x00")})  # connectivity
+        except EtcdHttpError as e:
+            raise RuntimeError(
+                f"filer store 'etcd': {urls!r} answered but not as an "
+                f"etcd v3 gateway ({e}); check the endpoint/gateway "
+                "config, or use an embedded kind"
+            ) from e
         except OSError as e:
             raise RuntimeError(
                 f"filer store 'etcd' cannot reach {urls!r} ({e}); start "
