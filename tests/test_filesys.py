@@ -378,3 +378,38 @@ class TestKernelFuseMount:
             ["ls", kmount], capture_output=True, text=True, timeout=30
         )
         assert "proc.txt" in out.stdout
+
+    def test_parallel_writers_through_kernel_mount(self, kmount):
+        """Concurrent OS-level file IO through the real mount: the
+        single-threaded FUSE loop serializes requests, but interleaved
+        open/write/close from many threads must stay byte-correct."""
+        import threading
+
+        payloads = {}
+        errors = []
+        lock = threading.Lock()
+
+        def writer(wid):
+            try:
+                rng_data = bytes((wid * 37 + i) % 256 for i in range(30_000))
+                for i in range(4):
+                    p = os.path.join(kmount, f"kstress_{wid}_{i}.bin")
+                    with open(p, "wb") as f:
+                        for off in range(0, len(rng_data), 7000):
+                            f.write(rng_data[off : off + 7000])
+                    with lock:
+                        payloads[p] = rng_data
+            except Exception as e:  # noqa: BLE001
+                errors.append((wid, repr(e)))
+
+        threads = [threading.Thread(target=writer, args=(w,)) for w in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        for p, want in payloads.items():
+            with open(p, "rb") as f:
+                assert f.read() == want, p
+        for p in payloads:
+            os.unlink(p)
