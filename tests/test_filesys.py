@@ -413,3 +413,77 @@ class TestKernelFuseMount:
                 assert f.read() == want, p
         for p in payloads:
             os.unlink(p)
+
+
+@pytest.mark.skipif(
+    not _kernel_fuse_usable(), reason="/dev/fuse not openable in this sandbox"
+)
+class TestKernelFuseProtocol:
+    """Wire-level dispatch semantics that shell IO doesn't reach:
+    RENAME2 flag handling and FORGET nodeid reclamation."""
+
+    @pytest.fixture()
+    def km(self, mounted):
+        from seaweedfs_tpu.filesys.fuse_kernel import KernelFuseMount
+
+        # dispatch-level tests need no real mount: drive _dispatch
+        return KernelFuseMount(mounted, "/nonexistent-not-mounted")
+
+    def test_rename2_noreplace_and_exchange(self, km):
+        import errno
+        import struct
+
+        from seaweedfs_tpu.filesys import fuse_kernel as fk
+
+        km.mfs.write_file("/r2a.txt", b"a")
+        km.mfs.write_file("/r2b.txt", b"b")
+        hdr = struct.Struct("<QII")
+
+        def rename2(old, new, flags):
+            body = hdr.pack(1, flags, 0) + old + b"\0" + new + b"\0"
+            return km._dispatch(fk.RENAME2, 1, body)
+
+        # NOREPLACE onto an existing target: EEXIST, target untouched
+        assert rename2(b"r2a.txt", b"r2b.txt", 1) == -errno.EEXIST
+        assert km.mfs.read_file("/r2b.txt") == b"b"
+        # EXCHANGE is unsupported: EINVAL, nothing moved
+        assert rename2(b"r2a.txt", b"r2b.txt", 2) == -errno.EINVAL
+        assert km.mfs.read_file("/r2a.txt") == b"a"
+        # NOREPLACE onto a fresh name succeeds
+        assert rename2(b"r2a.txt", b"r2c.txt", 1) == b""
+        assert km.mfs.read_file("/r2c.txt") == b"a"
+
+    def test_forget_reclaims_nodeids(self, km):
+        import struct
+
+        from seaweedfs_tpu.filesys import fuse_kernel as fk
+
+        km.mfs.write_file("/fg.txt", b"x")
+        out = km._dispatch(fk.LOOKUP, 1, b"fg.txt\0")
+        assert isinstance(out, bytes)
+        (nid,) = struct.unpack_from("<Q", out)
+        assert nid in km._nodes and km._nlookup[nid] == 1
+        # second lookup bumps the kernel refcount
+        km._dispatch(fk.LOOKUP, 1, b"fg.txt\0")
+        assert km._nlookup[nid] == 2
+        # forget with the full count reclaims the id
+        km._dispatch(fk.FORGET, nid, struct.pack("<Q", 2))
+        assert nid not in km._nodes and nid not in km._nlookup
+
+    def test_batch_forget(self, km):
+        import struct
+
+        from seaweedfs_tpu.filesys import fuse_kernel as fk
+
+        km.mfs.write_file("/bf1.txt", b"x")
+        km.mfs.write_file("/bf2.txt", b"y")
+        n1 = struct.unpack_from(
+            "<Q", km._dispatch(fk.LOOKUP, 1, b"bf1.txt\0")
+        )[0]
+        n2 = struct.unpack_from(
+            "<Q", km._dispatch(fk.LOOKUP, 1, b"bf2.txt\0")
+        )[0]
+        body = struct.pack("<II", 2, 0) + struct.pack("<QQ", n1, 1)
+        body += struct.pack("<QQ", n2, 1)
+        km._dispatch(fk.BATCH_FORGET, 0, body)
+        assert n1 not in km._nodes and n2 not in km._nodes
