@@ -150,10 +150,14 @@ def run_benchmark(
                     )
                     ok = not ur.error
                     if ok:
-                        with fid_lock:
-                            fids.append(ar.fid)
                         if delete_percent and random.randrange(100) < delete_percent:
                             op.delete_files(master, [ar.fid])
+                        else:
+                            # deleted fids stay out of the read pool so
+                            # the read phase doesn't report their 404s
+                            # as failures
+                            with fid_lock:
+                                fids.append(ar.fid)
                 except Exception:
                     ok = False
                 stats.add(time.perf_counter() - t0, size, ok)
