@@ -230,16 +230,74 @@ def _rebuild_idx(base: str) -> int:
 @register
 class ExportCommand(Command):
     name = "export"
-    help = "list or extract needles from a local volume"
+    help = (
+        "list needles in a local volume, or export them to a dir / a "
+        ".tar (command/export.go)"
+    )
+
+    # export.go:44 default tar member name template
+    DEFAULT_NAME_FORMAT = "{{.Mime}}/{{.Id}}:{{.Name}}"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
         p.add_argument("-dir", default=".")
         p.add_argument("-volumeId", type=int, required=True)
         p.add_argument("-collection", default="")
-        p.add_argument("-o", dest="output", default="", help="extract files into this dir")
+        p.add_argument(
+            "-o",
+            dest="output",
+            default="",
+            help="a directory to extract into, a .tar file name, or "
+            "'-' for a tar stream on stdout (export.go:57)",
+        )
+        p.add_argument(
+            "-fileNameFormat",
+            default=self.DEFAULT_NAME_FORMAT,
+            help="tar member name template; fields {{.Name}} {{.Id}} "
+            "{{.Mime}} {{.Key}} (export.go:44)",
+        )
+        p.add_argument(
+            "-newer",
+            default="",
+            help="export only files newer than this RFC3339 time "
+            "without timezone, e.g. 2006-01-02T15:04:05 (export.go:59)",
+        )
+
+    @classmethod
+    def _member_name(cls, fmt: str, needle, vid: int) -> str:
+        name = (needle.name or b"").decode("utf-8", "replace")
+        mime = (needle.mime or b"").decode("utf-8", "replace")
+        return (
+            fmt.replace("{{.Name}}", name or f"{needle.id:x}")
+            .replace("{{.Id}}", f"{needle.id:x}")
+            .replace("{{.Key}}", f"{needle.id:x}")
+            .replace("{{.Mime}}", mime or "application/octet-stream")
+        )
 
     def run(self, args) -> int:
+        import datetime
+        import tarfile
+
         from seaweedfs_tpu.storage.volume import scan_volume_file, volume_base_name
+
+        newer_than = None
+        if args.newer:
+            try:
+                dt = datetime.datetime.fromisoformat(args.newer)
+            except ValueError:
+                print(f"cannot parse -newer {args.newer!r}", file=sys.stderr)
+                return 2
+            if dt.tzinfo is not None:
+                # the flag is defined as RFC3339 WITHOUT timezone
+                # (export.go:59) — reinterpreting an explicit offset
+                # as UTC would silently shift the cutoff
+                print(
+                    f"-newer {args.newer!r} must not carry a timezone",
+                    file=sys.stderr,
+                )
+                return 2
+            newer_than = int(
+                dt.replace(tzinfo=datetime.timezone.utc).timestamp()
+            )
 
         base = volume_base_name(args.dir, args.collection, args.volumeId)
         # two passes: resolve final liveness first (later records —
@@ -251,21 +309,47 @@ class ExportCommand(Command):
                 final_offset.pop(needle.id, None)
             else:
                 final_offset[needle.id] = off
+
+        tar = None
+        to_tar = args.output == "-" or args.output.endswith(".tar")
+        if to_tar:
+            if args.output == "-":
+                tar = tarfile.open(fileobj=sys.stdout.buffer, mode="w|")
+            else:
+                tar = tarfile.open(args.output, mode="w")
         count = 0
-        for needle, offset in scan_volume_file(base + ".dat"):
-            if needle.size == 0 or final_offset.get(needle.id) != offset:
-                continue
-            name = (needle.name or b"").decode("utf-8", "replace")
-            print(
-                f"key={needle.id:x} cookie={needle.cookie:08x} size={needle.size} "
-                f"name={name!r} mime={(needle.mime or b'').decode('utf-8', 'replace')!r}"
-            )
-            if args.output:
-                out = os.path.join(
-                    args.output, name or f"{args.volumeId}_{needle.id:x}"
-                )
-                with open(out, "wb") as f:
-                    f.write(needle.data)
-            count += 1
+        try:
+            for needle, offset in scan_volume_file(base + ".dat"):
+                if needle.size == 0 or final_offset.get(needle.id) != offset:
+                    continue
+                if newer_than is not None and needle.last_modified < newer_than:
+                    continue
+                name = (needle.name or b"").decode("utf-8", "replace")
+                if not to_tar or args.output != "-":
+                    print(
+                        f"key={needle.id:x} cookie={needle.cookie:08x} "
+                        f"size={needle.size} name={name!r} "
+                        f"mime={(needle.mime or b'').decode('utf-8', 'replace')!r}"
+                    )
+                if tar is not None:
+                    member = self._member_name(
+                        args.fileNameFormat, needle, args.volumeId
+                    )
+                    info = tarfile.TarInfo(name=member)
+                    info.size = len(needle.data)
+                    info.mtime = needle.last_modified or 0
+                    import io as _io
+
+                    tar.addfile(info, _io.BytesIO(bytes(needle.data)))
+                elif args.output:
+                    out = os.path.join(
+                        args.output, name or f"{args.volumeId}_{needle.id:x}"
+                    )
+                    with open(out, "wb") as f:
+                        f.write(needle.data)
+                count += 1
+        finally:
+            if tar is not None:
+                tar.close()
         print(f"{count} needles", file=sys.stderr)
         return 0

@@ -138,6 +138,81 @@ class TestOfflineTools:
         assert not (out_dir / "file3.txt").exists()
         assert len(list(out_dir.iterdir())) == 18
 
+    def test_export_to_tar_with_name_format(self, tmp_path, capsys):
+        """-o name.tar produces a tar whose member names follow
+        -fileNameFormat (command/export.go:44,57)."""
+        import tarfile
+
+        vid = self._make_volume(tmp_path)
+        tar_path = tmp_path / "vol.tar"
+        assert (
+            cli_main(
+                [
+                    "export",
+                    "-dir", str(tmp_path),
+                    "-volumeId", str(vid),
+                    "-o", str(tar_path),
+                    "-fileNameFormat", "{{.Id}}-{{.Name}}",
+                ]
+            )
+            == 0
+        )
+        with tarfile.open(tar_path) as t:
+            names = t.getnames()
+            assert len(names) == 18  # live needles only
+            assert "5-file5.txt" in names
+            assert not any("file3" in n for n in names)  # deleted
+            data = t.extractfile("5-file5.txt").read()
+            assert data == b"needle-5" * 10
+
+    def test_export_newer_filter(self, tmp_path, capsys):
+        """-newer excludes needles whose last_modified is older
+        (command/export.go:59); needles without a timestamp (0) are
+        excluded by any cutoff, like the reference's comparison."""
+        import time as _time
+
+        from seaweedfs_tpu.storage.needle import Needle
+
+        vol = Volume(str(tmp_path), 42)
+        now = int(_time.time())
+        for i in range(4):
+            n = Needle(cookie=1, id=i + 1, data=b"ts")
+            n.last_modified = now if i < 3 else now - 10 * 24 * 3600
+            n.set_has_last_modified_date()
+            vol.write_needle(n)
+        vol.close()
+
+        assert (
+            cli_main(
+                [
+                    "export",
+                    "-dir", str(tmp_path),
+                    "-volumeId", "42",
+                    "-newer", "2099-01-01T00:00:00",
+                ]
+            )
+            == 0
+        )
+        assert "0 needles" in capsys.readouterr().err
+        # a cutoff between the old needle and the fresh ones keeps 3
+        import datetime as _dt
+
+        cutoff = _dt.datetime.fromtimestamp(
+            now - 3600, _dt.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%S")
+        assert (
+            cli_main(
+                [
+                    "export",
+                    "-dir", str(tmp_path),
+                    "-volumeId", "42",
+                    "-newer", cutoff,
+                ]
+            )
+            == 0
+        )
+        assert "3 needles" in capsys.readouterr().err
+
     def test_compact(self, tmp_path, capsys):
         vid = self._make_volume(tmp_path)
         before = (tmp_path / f"{vid}.dat").stat().st_size
