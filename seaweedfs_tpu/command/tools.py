@@ -61,20 +61,50 @@ class UploadCommand(Command):
     help = "upload local files to the cluster (assign + upload; big files chunked)"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("files", nargs="+")
+        p.add_argument("files", nargs="*")
         p.add_argument("-master", default="127.0.0.1:9333")
         p.add_argument("-collection", default="")
         p.add_argument("-replication", default="")
         p.add_argument("-ttl", default="")
         p.add_argument("-maxMB", type=int, default=32)
+        p.add_argument(
+            "-dir",
+            default="",
+            help="upload the whole folder recursively (upload.go:41)",
+        )
+        p.add_argument(
+            "-include",
+            default="",
+            help="glob for files to include under -dir, e.g. *.pdf "
+            "(upload.go:42; empty = everything)",
+        )
 
     def run(self, args) -> int:
         import dataclasses
+        import fnmatch
 
         from seaweedfs_tpu.client import operation as op
 
+        paths = list(args.files)
+        if args.dir:
+            # recursive directory walk, alphabetical like the reference
+            for root, dirs, names in os.walk(args.dir):
+                dirs.sort()
+                for name in sorted(names):
+                    if args.include and not fnmatch.fnmatch(
+                        name, args.include
+                    ):
+                        continue
+                    paths.append(os.path.join(root, name))
+        if not paths:
+            print(
+                "usage: upload [files...] or upload -dir <folder> "
+                "[-include '*.ext']",
+                file=sys.stderr,
+            )
+            return 2
         results = []
-        for path in args.files:
+        for path in paths:
             with open(path, "rb") as f:
                 data = f.read()
             r = op.submit_file(
