@@ -155,6 +155,18 @@ class BackupCommand(Command):
         p.add_argument("-volumeId", type=int, required=True)
         p.add_argument("-dir", default=".")
         p.add_argument("-collection", default="")
+        p.add_argument(
+            "-ttl",
+            default="",
+            help="backup volume's TTL when created fresh (backup.go:34; "
+            "default: no TTL)",
+        )
+        p.add_argument(
+            "-replication",
+            default="",
+            help="backup volume's replication setting when created "
+            "fresh (backup.go:42)",
+        )
 
     def run(self, args) -> int:
         """Locate the volume, then VolumeIncrementalCopy since our local
@@ -164,13 +176,25 @@ class BackupCommand(Command):
 
         from seaweedfs_tpu.client import operation as op
         from seaweedfs_tpu.pb import rpc, volume_pb2
+        from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
+        from seaweedfs_tpu.storage.ttl import TTL
         from seaweedfs_tpu.storage.volume import Volume, volume_base_name
 
         result = op.lookup(args.master, str(args.volumeId))
         if result.error or not result.locations:
             print(f"volume {args.volumeId} not found: {result.error}")
             return 1
-        vol = Volume(args.dir, args.volumeId, args.collection)
+        vol = Volume(
+            args.dir,
+            args.volumeId,
+            args.collection,
+            replica_placement=(
+                ReplicaPlacement.parse(args.replication)
+                if args.replication
+                else None
+            ),
+            ttl=TTL.parse(args.ttl) if args.ttl else None,
+        )
         since = vol.last_append_at_ns
         vol.close()
         base = volume_base_name(args.dir, args.collection, args.volumeId)
