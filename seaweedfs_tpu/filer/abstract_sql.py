@@ -225,12 +225,24 @@ class AbstractSqlStore(FilerStore):
             cur.execute(sql, (hash_string_to_long(d), start_file_name, d, limit))
             rows = cur.fetchall()
             cur.close()
-        return [Entry.decode(child_path(d, name), meta) for name, meta in rows]
+        out = []
+        for name, meta in rows:
+            # binary-protocol drivers (pg_driver) return text columns
+            # as raw bytes; sqlite returns str
+            if isinstance(name, bytes):
+                name = name.decode()
+            out.append(Entry.decode(child_path(d, name), meta))
+        return out
 
-    # tx: same deferred-commit protocol as the embedded SqliteStore
+    # tx: same deferred-commit protocol as the embedded SqliteStore;
+    # drivers that expose begin() (pg_driver) open a server-side
+    # transaction here — sqlite3 begins implicitly on first statement
     def begin_transaction(self) -> None:
         self._lock.acquire()
         self._tx_depth += 1
+        begin = getattr(self._conn, "begin", None)
+        if begin is not None and self._tx_depth == 1:
+            begin()
 
     def commit_transaction(self) -> None:
         self._tx_depth -= 1
@@ -265,16 +277,60 @@ _GATE_GUIDANCE = (
 )
 
 
-def new_gated_sql_store(kind: str) -> AbstractSqlStore:
-    """mysql / postgres kinds: use the real driver when importable,
-    raise with guidance otherwise (construct-and-gate)."""
-    if kind == "mysql":
-        libs, dialect = ("MySQLdb", "pymysql"), MYSQL_DIALECT
-    elif kind == "postgres":
-        libs, dialect = ("psycopg2", "pg8000"), POSTGRES_DIALECT
-    else:  # pragma: no cover - callers pass validated kinds
+def new_postgres_store(path: str = "") -> AbstractSqlStore:
+    """The postgres kind over the in-repo wire-protocol driver
+    (filer/pg_driver.py) — no psycopg2; gated on connectivity.
+
+    `path` is "host:port" or "host:port/database?user=U&password=P"
+    (defaults: 5432 / seaweedfs / seaweedfs / empty password)."""
+    import urllib.parse
+
+    from seaweedfs_tpu.filer.pg_driver import PgConnection
+
+    raw = path or "localhost:5432"
+    hostport, _, rest = raw.partition("/")
+    host, _, port = hostport.partition(":")
+    try:
+        port_num = int(port or 5432)
+    except ValueError:
+        raise RuntimeError(
+            f"filer store 'postgres': bad port in {raw!r}; expected "
+            "host:port[/database?user=U&password=P]"
+        ) from None
+    database, user, password = "seaweedfs", "seaweedfs", ""
+    if rest:
+        dbpart, _, query = rest.partition("?")
+        if dbpart:
+            database = dbpart
+        params = dict(urllib.parse.parse_qsl(query))
+        user = params.get("user", user)
+        password = params.get("password", password)
+    try:
+        conn = PgConnection(
+            host or "localhost",
+            port_num,
+            user=user,
+            password=password,
+            database=database,
+        )
+    except OSError as e:
+        raise RuntimeError(
+            f"filer store 'postgres' cannot reach a server at {raw!r} "
+            f"({e}); start one (with the filemeta table — the dialect "
+            "DDL is POSTGRES_DIALECT.create_table), or use an embedded "
+            "kind: memory | sqlite | sql | sortedlog | lsm"
+        ) from e
+    return AbstractSqlStore(conn, POSTGRES_DIALECT)
+
+
+def new_gated_sql_store(kind: str, path: str = "") -> AbstractSqlStore:
+    """mysql: use the real driver when importable, raise with guidance
+    otherwise. postgres: the in-repo wire driver (connectivity gate)."""
+    if kind == "postgres":
+        return new_postgres_store(path)
+    if kind != "mysql":  # pragma: no cover - callers pass validated kinds
         raise ValueError(f"not a SQL store kind: {kind!r}")
-    for lib in libs:
+    for lib in ("MySQLdb", "pymysql"):
         try:
             __import__(lib)
         except ImportError:
@@ -282,10 +338,10 @@ def new_gated_sql_store(kind: str) -> AbstractSqlStore:
         raise RuntimeError(
             f"{lib} is importable; wire its connect() parameters through "
             f"filer.toml and pass the connection to AbstractSqlStore "
-            f"(dialect {dialect.name})"
+            "(dialect mysql)"
         )
     raise RuntimeError(
         _GATE_GUIDANCE.format(
-            kind=kind, libs="/".join(libs), dialect=f"{dialect.name.upper()}_DIALECT"
+            kind="mysql", libs="MySQLdb/pymysql", dialect="MYSQL_DIALECT"
         )
     )

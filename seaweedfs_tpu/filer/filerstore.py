@@ -282,7 +282,7 @@ def new_store(kind: str, path: str = "") -> FilerStore:
     if kind in ("mysql", "postgres"):
         from seaweedfs_tpu.filer.abstract_sql import new_gated_sql_store
 
-        return new_gated_sql_store(kind)
+        return new_gated_sql_store(kind, path)
     if kind == "redis":
         # real RESP-protocol store, gated on connectivity
         from seaweedfs_tpu.filer.redis_store import RedisStore

@@ -823,3 +823,194 @@ class FakeCassandra:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+
+
+class FakePostgres:
+    """PostgreSQL protocol-v3 subset: md5 auth handshake, the extended
+    query protocol (Parse/Bind/Execute/Sync, binary formats) for the
+    POSTGRES_DIALECT statements, and simple Query for BEGIN/COMMIT/
+    ROLLBACK (snapshot-restore transactions)."""
+
+    def __init__(self, user="seaweedfs", password="", database="seaweedfs"):
+        import socketserver
+        import struct as _struct
+
+        self.user, self.password, self.database = user, password, database
+        # (directory, name) -> (dirhash, meta)
+        self.rows: dict[tuple[str, str], tuple[int, bytes]] = {}
+        self._lock = threading.Lock()
+        fake = self
+
+        class H(socketserver.StreamRequestHandler):
+            def _msg(self, kind: bytes, body: bytes = b""):
+                self.wfile.write(kind + _struct.pack(">i", len(body) + 4) + body)
+
+            def _ready(self):
+                self._msg(b"Z", b"I")
+                self.wfile.flush()
+
+            def _error(self, sqlstate, message):
+                body = b"S" + b"ERROR\0"
+                body += b"C" + sqlstate.encode() + b"\0"
+                body += b"M" + message.encode() + b"\0\0"
+                self._msg(b"E", body)
+
+            def handle(self):
+                # startup
+                (length,) = _struct.unpack(">i", self.rfile.read(4))
+                self.rfile.read(length - 4)  # protocol + params
+                salt = b"s4lt"
+                self._msg(b"R", _struct.pack(">i", 5) + salt)  # md5
+                self.wfile.flush()
+                kind = self.rfile.read(1)
+                (n,) = _struct.unpack(">i", self.rfile.read(4))
+                pw = self.rfile.read(n - 4).rstrip(b"\0").decode()
+                inner = hashlib.md5(
+                    (fake.password + fake.user).encode()
+                ).hexdigest()
+                want = "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
+                if kind != b"p" or pw != want:
+                    self._error("28P01", "password authentication failed")
+                    self.wfile.flush()
+                    return
+                self._msg(b"R", _struct.pack(">i", 0))
+                self._ready()
+
+                stmt = ""
+                params: list[bytes | None] = []
+                snapshot = None
+                while True:
+                    kind = self.rfile.read(1)
+                    if not kind:
+                        return
+                    (n,) = _struct.unpack(">i", self.rfile.read(4))
+                    body = self.rfile.read(n - 4)
+                    if kind == b"Q":
+                        sql = body.rstrip(b"\0").decode().strip().upper()
+                        with fake._lock:
+                            if sql == "BEGIN":
+                                snapshot = dict(fake.rows)
+                            elif sql.startswith("ROLLBACK TO"):
+                                pass  # statement-level recovery: no-op
+                            elif sql == "ROLLBACK":
+                                if snapshot is not None:
+                                    fake.rows.clear()
+                                    fake.rows.update(snapshot)
+                                snapshot = None
+                            elif sql == "COMMIT":
+                                snapshot = None
+                        self._msg(b"C", b"OK\0")
+                        self._ready()
+                    elif kind == b"P":
+                        rest = body[1:]  # unnamed stmt \0 prefix
+                        stmt = rest.split(b"\0", 1)[0].decode()
+                        self._msg(b"1")
+                    elif kind == b"B":
+                        r = body[2:]  # unnamed portal + stmt
+                        (nfmt,) = _struct.unpack(">h", r[:2])
+                        r = r[2 + 2 * nfmt :]
+                        (nparams,) = _struct.unpack(">h", r[:2])
+                        r = r[2:]
+                        params = []
+                        for _ in range(nparams):
+                            (ln,) = _struct.unpack(">i", r[:4])
+                            r = r[4:]
+                            if ln < 0:
+                                params.append(None)
+                            else:
+                                params.append(r[:ln])
+                                r = r[ln:]
+                        self._msg(b"2")
+                    elif kind == b"E":
+                        err = fake._execute(self, stmt, params)
+                        if err:
+                            self._error(*err)
+                    elif kind == b"S":
+                        self._ready()
+                    else:
+                        return
+
+        self._server = socketserver.ThreadingTCPServer(("127.0.0.1", 0), H)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.address = f"127.0.0.1:{self.port}"
+
+    def _execute(self, h, stmt: str, params):
+        import struct as _struct
+
+        from seaweedfs_tpu.filer.abstract_sql import POSTGRES_DIALECT as D
+
+        def text(i):
+            return params[i].decode()
+
+        def i64(i):
+            return _struct.unpack(">q", params[i])[0]
+
+        def rowmsg(cols):
+            body = _struct.pack(">h", len(cols))
+            for v in cols:
+                body += _struct.pack(">i", len(v)) + v
+            h._msg(b"D", body)
+
+        with self._lock:
+            if stmt == D.create_table or stmt.upper().startswith("CREATE TABLE"):
+                h._msg(b"C", b"CREATE TABLE\0")
+                return None
+            if stmt.upper().startswith("SAVEPOINT"):
+                h._msg(b"C", b"SAVEPOINT\0")
+                return None
+            if stmt == D.insert:
+                key = (text(2), text(1))
+                if key in self.rows:
+                    return ("23505", "duplicate key value")
+                self.rows[key] = (i64(0), params[3])
+                h._msg(b"C", b"INSERT 0 1\0")
+                return None
+            if stmt == D.update:
+                key = (text(3), text(2))
+                if key in self.rows:
+                    self.rows[key] = (i64(1), params[0])
+                h._msg(b"C", b"UPDATE 1\0")
+                return None
+            if stmt == D.find:
+                key = (text(2), text(1))
+                hit = self.rows.get(key)
+                if hit is not None:
+                    rowmsg([hit[1]])
+                h._msg(b"C", b"SELECT\0")
+                return None
+            if stmt == D.delete:
+                self.rows.pop((text(2), text(1)), None)
+                h._msg(b"C", b"DELETE 1\0")
+                return None
+            if stmt == D.delete_folder_children:
+                d = text(1)
+                for k in [k for k in self.rows if k[0] == d]:
+                    del self.rows[k]
+                h._msg(b"C", b"DELETE\0")
+                return None
+            if stmt in (D.list_exclusive, D.list_inclusive):
+                d, start = text(2), text(1)
+                limit = i64(3)
+                inclusive = stmt == D.list_inclusive
+                names = sorted(n for (dd, n) in self.rows if dd == d)
+                emitted = 0
+                for n in names:
+                    if inclusive and n < start:
+                        continue
+                    if not inclusive and n <= start:
+                        continue
+                    rowmsg([n.encode(), self.rows[(d, n)][1]])
+                    emitted += 1
+                    if emitted >= limit:
+                        break
+                h._msg(b"C", b"SELECT\0")
+                return None
+        return ("42601", f"unknown statement {stmt[:60]!r}")
+
+    def start(self):
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

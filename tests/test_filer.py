@@ -262,6 +262,18 @@ def _etcd_factory():
     return _FakeBackedFactory(FakeEtcd, lambda f: EtcdFilerStore(f.endpoint))
 
 
+def _postgres_factory():
+    from seaweedfs_tpu.filer.abstract_sql import new_postgres_store
+    from tests.cloud_fakes import FakePostgres
+
+    return _FakeBackedFactory(
+        lambda: FakePostgres(password="pw"),
+        lambda f: new_postgres_store(
+            f"{f.address}/seaweedfs?user=seaweedfs&password=pw"
+        ),
+    )
+
+
 @pytest.mark.parametrize(
     "store_factory",
     [
@@ -273,8 +285,12 @@ def _etcd_factory():
         _redis_factory(),
         _cassandra_factory(),
         _etcd_factory(),
+        _postgres_factory(),
     ],
-    ids=["memory", "sqlite", "sortedlog", "lsm", "sql", "redis", "cassandra", "etcd"],
+    ids=[
+        "memory", "sqlite", "sortedlog", "lsm", "sql", "redis",
+        "cassandra", "etcd", "postgres",
+    ],
 )
 class TestFilerStores:
     def test_crud_and_list(self, store_factory, tmp_path):
@@ -351,9 +367,8 @@ class TestAbstractSql:
     def test_gated_kinds_raise_with_guidance(self):
         from seaweedfs_tpu.filer.filerstore import new_store
 
-        for kind in ("mysql", "postgres"):
-            with pytest.raises(RuntimeError, match="client library"):
-                new_store(kind)
+        with pytest.raises(RuntimeError, match="client library"):
+            new_store("mysql")
         with pytest.raises(ValueError, match="embedded kinds"):
             new_store("no-such-store")
         # redis / cassandra gate on connectivity, not a library
@@ -363,6 +378,21 @@ class TestAbstractSql:
             new_store("cassandra", "127.0.0.1:1")
         with pytest.raises(RuntimeError, match="cannot reach"):
             new_store("etcd", "127.0.0.1:1")
+        with pytest.raises(RuntimeError, match="cannot reach"):
+            new_store("postgres", "127.0.0.1:1")
+        # wrong password: reachable, clear auth error (not "cannot reach")
+        from tests.cloud_fakes import FakePostgres
+
+        fpg = FakePostgres(password="right")
+        fpg.start()
+        try:
+            with pytest.raises(Exception, match="authentication"):
+                new_store(
+                    "postgres",
+                    f"{fpg.address}/seaweedfs?user=seaweedfs&password=wrong",
+                )
+        finally:
+            fpg.stop()
         with pytest.raises(ValueError, match="tikv"):
             new_store("tikv")
 
@@ -388,6 +418,34 @@ class TestAbstractSql:
         with pytest.raises(EntryNotFound):
             s.find_entry("/a/tmp1")
         s.close()
+
+    def test_pg_transaction_rollback_restores_state(self):
+        """The wire driver's begin()/rollback() run real server-side
+        transactions, and a failed statement inside one rolls back to
+        its savepoint without aborting the transaction (the
+        insert-degrades-to-update path must survive)."""
+        from seaweedfs_tpu.filer.abstract_sql import new_postgres_store
+        from tests.cloud_fakes import FakePostgres
+
+        fake = FakePostgres(password="pw")
+        fake.start()
+        try:
+            s = new_postgres_store(
+                f"{fake.address}/seaweedfs?user=seaweedfs&password=pw"
+            )
+            s.insert_entry(Entry("/t/keep", attr=Attr(mtime=1)))
+            s.begin_transaction()
+            s.insert_entry(Entry("/t/tmp", attr=Attr(mtime=2)))
+            # duplicate insert inside the txn: savepoint recovery, then
+            # the degrade-to-update applies
+            s.insert_entry(Entry("/t/keep", attr=Attr(mtime=5)))
+            s.rollback_transaction()
+            assert s.find_entry("/t/keep").attr.mtime == 1  # rolled back
+            with pytest.raises(EntryNotFound):
+                s.find_entry("/t/tmp")
+            s.close()
+        finally:
+            fake.stop()
 
     def test_filer_atomic_rename_over_sql_store(self, tmp_path):
         """The Filer's AtomicRenameEntry runs inside the store tx hooks
