@@ -268,50 +268,21 @@ def new_sqlite_sql_store(path: str = ":memory:") -> AbstractSqlStore:
     return AbstractSqlStore(conn, SQLITE_DIALECT)
 
 
-_GATE_GUIDANCE = (
-    "filer store {kind!r} speaks the reference SQL dialect "
-    "(filer2/{kind}/{kind}_store.go) but its client library ({libs}) is "
-    "not in this image. Install one and pass a DB-API connection to "
-    "seaweedfs_tpu.filer.abstract_sql.AbstractSqlStore(conn, {dialect}), "
-    "or use an embedded store kind: memory | sqlite | sql | sortedlog | lsm."
-)
-
-
 def new_postgres_store(path: str = "") -> AbstractSqlStore:
     """The postgres kind over the in-repo wire-protocol driver
     (filer/pg_driver.py) — no psycopg2; gated on connectivity.
 
     `path` is "host:port" or "host:port/database?user=U&password=P"
     (defaults: 5432 / seaweedfs / seaweedfs / empty password)."""
-    import urllib.parse
-
     from seaweedfs_tpu.filer.pg_driver import PgConnection
 
     raw = path or "localhost:5432"
-    hostport, _, rest = raw.partition("/")
-    host, _, port = hostport.partition(":")
-    try:
-        port_num = int(port or 5432)
-    except ValueError:
-        raise RuntimeError(
-            f"filer store 'postgres': bad port in {raw!r}; expected "
-            "host:port[/database?user=U&password=P]"
-        ) from None
-    database, user, password = "seaweedfs", "seaweedfs", ""
-    if rest:
-        dbpart, _, query = rest.partition("?")
-        if dbpart:
-            database = dbpart
-        params = dict(urllib.parse.parse_qsl(query))
-        user = params.get("user", user)
-        password = params.get("password", password)
+    host, port, user, password, database = _parse_db_path(
+        raw, 5432, "postgres"
+    )
     try:
         conn = PgConnection(
-            host or "localhost",
-            port_num,
-            user=user,
-            password=password,
-            database=database,
+            host, port, user=user, password=password, database=database
         )
     except OSError as e:
         raise RuntimeError(
@@ -323,25 +294,57 @@ def new_postgres_store(path: str = "") -> AbstractSqlStore:
     return AbstractSqlStore(conn, POSTGRES_DIALECT)
 
 
+def _parse_db_path(raw: str, default_port: int, kind: str):
+    """host:port[/database?user=U&password=P] → connection params."""
+    import urllib.parse
+
+    hostport, _, rest = raw.partition("/")
+    host, _, port = hostport.partition(":")
+    try:
+        port_num = int(port or default_port)
+    except ValueError:
+        raise RuntimeError(
+            f"filer store {kind!r}: bad port in {raw!r}; expected "
+            "host:port[/database?user=U&password=P]"
+        ) from None
+    database, user, password = "seaweedfs", "seaweedfs", ""
+    if rest:
+        dbpart, _, query = rest.partition("?")
+        if dbpart:
+            database = dbpart
+        params = dict(urllib.parse.parse_qsl(query))
+        user = params.get("user", user)
+        password = params.get("password", password)
+    return host or "localhost", port_num, user, password, database
+
+
+def new_mysql_store(path: str = "") -> AbstractSqlStore:
+    """The mysql kind over the in-repo wire-protocol driver
+    (filer/mysql_driver.py) — no MySQLdb/pymysql; gated on
+    connectivity. Same `path` shape as postgres."""
+    from seaweedfs_tpu.filer.mysql_driver import MysqlConnection
+
+    raw = path or "localhost:3306"
+    host, port, user, password, database = _parse_db_path(raw, 3306, "mysql")
+    try:
+        conn = MysqlConnection(
+            host, port, user=user, password=password, database=database
+        )
+    except OSError as e:
+        raise RuntimeError(
+            f"filer store 'mysql' cannot reach a server at {raw!r} ({e}); "
+            "start one (with the filemeta table — the dialect DDL is "
+            "MYSQL_DIALECT.create_table), or use an embedded kind: "
+            "memory | sqlite | sql | sortedlog | lsm"
+        ) from e
+    return AbstractSqlStore(conn, MYSQL_DIALECT)
+
+
 def new_gated_sql_store(kind: str, path: str = "") -> AbstractSqlStore:
-    """mysql: use the real driver when importable, raise with guidance
-    otherwise. postgres: the in-repo wire driver (connectivity gate)."""
+    """Both SQL kinds now run on in-repo wire drivers, gated on
+    connectivity rather than client libraries."""
     if kind == "postgres":
         return new_postgres_store(path)
-    if kind != "mysql":  # pragma: no cover - callers pass validated kinds
-        raise ValueError(f"not a SQL store kind: {kind!r}")
-    for lib in ("MySQLdb", "pymysql"):
-        try:
-            __import__(lib)
-        except ImportError:
-            continue
-        raise RuntimeError(
-            f"{lib} is importable; wire its connect() parameters through "
-            f"filer.toml and pass the connection to AbstractSqlStore "
-            "(dialect mysql)"
-        )
-    raise RuntimeError(
-        _GATE_GUIDANCE.format(
-            kind="mysql", libs="MySQLdb/pymysql", dialect="MYSQL_DIALECT"
-        )
-    )
+    if kind == "mysql":
+        return new_mysql_store(path)
+    raise ValueError(f"not a SQL store kind: {kind!r}")

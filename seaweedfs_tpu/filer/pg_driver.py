@@ -164,17 +164,22 @@ class PgConnection:
         whole transaction and wedging the connection. All frames for
         the statement go out in ONE write."""
         with self._lock:
+            def bare(stmt_sql: str) -> bytes:
+                # Parse/Bind/Execute for a no-param, no-result utility
+                # statement; Bind = unnamed portal + stmt + 3 zero
+                # int16 counts (formats, params, result formats)
+                out = self._frame(
+                    b"P", b"\0" + _cstr(stmt_sql) + struct.pack(">h", 0)
+                )
+                out += self._frame(
+                    b"B", b"\0\0" + struct.pack(">hhh", 0, 0, 0)
+                )
+                out += self._frame(b"E", b"\0" + struct.pack(">i", 0))
+                return out
+
             buf = bytearray()
             if self._in_txn:
-                # re-declaring the same savepoint name replaces it:
-                # no pileup across many statements in one transaction
-                buf += self._frame(
-                    b"P", b"\0" + _cstr("SAVEPOINT _sw") + struct.pack(">h", 0)
-                )
-                buf += self._frame(
-                    b"B", b"\0\0" + struct.pack(">hhhh", 0, 0, 0, 0)
-                )
-                buf += self._frame(b"E", b"\0" + struct.pack(">i", 0))
+                buf += bare("SAVEPOINT _sw")
             buf += self._frame(
                 b"P", b"\0" + _cstr(sql) + struct.pack(">h", 0)
             )
@@ -190,6 +195,11 @@ class PgConnection:
             bind += struct.pack(">hh", 1, 1)  # all results binary
             buf += self._frame(b"B", bind)
             buf += self._frame(b"E", b"\0" + struct.pack(">i", 0))
+            if self._in_txn:
+                # pg skips messages after an error until Sync, so this
+                # RELEASE runs only when the statement succeeded —
+                # savepoints never pile up on the happy path
+                buf += bare("RELEASE SAVEPOINT _sw")
             buf += self._frame(b"S", b"")
             self.sock.sendall(bytes(buf))
             rows: list[list] = []
@@ -216,8 +226,11 @@ class PgConnection:
             if err is not None:
                 if self._in_txn:
                     # restore the transaction to the savepoint so the
-                    # caller can continue (insert→update degrade)
+                    # caller can continue (insert→update degrade), then
+                    # drop the savepoint so error paths don't pile them
+                    # up either
                     self._simple("ROLLBACK TO SAVEPOINT _sw")
+                    self._simple("RELEASE SAVEPOINT _sw")
                 self._raise(err)
             return rows
 

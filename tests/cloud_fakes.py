@@ -959,6 +959,9 @@ class FakePostgres:
             if stmt.upper().startswith("SAVEPOINT"):
                 h._msg(b"C", b"SAVEPOINT\0")
                 return None
+            if stmt.upper().startswith("RELEASE"):
+                h._msg(b"C", b"RELEASE\0")
+                return None
             if stmt == D.insert:
                 key = (text(2), text(1))
                 if key in self.rows:
@@ -1007,6 +1010,284 @@ class FakePostgres:
                 h._msg(b"C", b"SELECT\0")
                 return None
         return ("42601", f"unknown statement {stmt[:60]!r}")
+
+    def start(self):
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class FakeMysql:
+    """MySQL client/server protocol subset: handshake v10 with
+    mysql_native_password validation, COM_STMT_PREPARE/EXECUTE with
+    binary rows for the MYSQL_DIALECT statements, COM_QUERY for
+    BEGIN/COMMIT/ROLLBACK (snapshot transactions) and DDL."""
+
+    def __init__(self, user="seaweedfs", password="", database="seaweedfs"):
+        import socketserver
+        import struct as _struct
+
+        self.user, self.password = user, password
+        self.rows: dict[tuple[str, str], tuple[int, bytes]] = {}
+        self._lock = threading.Lock()
+        fake = self
+
+        def lenenc(n):
+            if n < 0xFB:
+                return bytes([n])
+            if n < 1 << 16:
+                return b"\xfc" + _struct.pack("<H", n)
+            return b"\xfd" + _struct.pack("<I", n)[:3]
+
+        class H(socketserver.StreamRequestHandler):
+            def _send(self, payload):
+                self.wfile.write(
+                    len(payload).to_bytes(3, "little")
+                    + bytes([self.seq])
+                    + payload
+                )
+                self.seq += 1
+                self.wfile.flush()
+
+            def _read(self):
+                hdr = self.rfile.read(4)
+                if len(hdr) < 4:
+                    return None
+                self.seq = hdr[3] + 1
+                return self.rfile.read(int.from_bytes(hdr[:3], "little"))
+
+            def _ok(self):
+                self._send(b"\x00\x00\x00\x02\x00\x00\x00")
+
+            def _err(self, errno, msg):
+                self._send(
+                    b"\xff"
+                    + _struct.pack("<H", errno)
+                    + b"#42000"
+                    + msg.encode()
+                )
+
+            def _eof(self):
+                self._send(b"\xfe\x00\x00\x02\x00")
+
+            def _coldef(self, name, ctype):
+                d = b""
+                for part in (b"def", b"db", b"t", b"t", name.encode(), name.encode()):
+                    d += lenenc(len(part)) + part
+                d += lenenc(0x0C)
+                d += _struct.pack("<HIBHB2x", 0x21, 1024, ctype, 0, 0)
+                self._send(d)
+
+            def handle(self):
+                import os as _os
+
+                self.seq = 0
+                salt = _os.urandom(8) + _os.urandom(12)
+                greet = b"\x0a" + b"5.7-fake\0" + _struct.pack("<I", 1)
+                greet += salt[:8] + b"\0"
+                greet += _struct.pack("<H", 0xFFFF)  # caps low
+                greet += b"\x21" + _struct.pack("<H", 2)
+                greet += _struct.pack("<H", 0xFFFF)  # caps high
+                greet += bytes([21]) + b"\0" * 10
+                greet += salt[8:20] + b"\0"
+                greet += b"mysql_native_password\0"
+                self._send(greet)
+                resp = self._read()
+                if resp is None:
+                    return
+                # parse user + token
+                off = 4 + 4 + 1 + 23
+                end = resp.index(0, off)
+                user = resp[off:end].decode()
+                off = end + 1
+                tlen = resp[off]
+                token = resp[off + 1 : off + 1 + tlen]
+                from seaweedfs_tpu.filer.mysql_driver import _scramble_native
+
+                want = _scramble_native(fake.password, salt[:20])
+                if user != fake.user or token != want:
+                    self._err(1045, "Access denied")
+                    return
+                self._ok()
+
+                stmts: dict[int, str] = {}
+                next_id = 1
+                snapshot = None
+                while True:
+                    pkt = self._read()
+                    if pkt is None:
+                        return
+                    cmd = pkt[0]
+                    if cmd == 0x03:  # COM_QUERY
+                        sql = pkt[1:].decode().strip().upper()
+                        with fake._lock:
+                            if sql == "BEGIN":
+                                snapshot = dict(fake.rows)
+                            elif sql == "ROLLBACK":
+                                if snapshot is not None:
+                                    fake.rows.clear()
+                                    fake.rows.update(snapshot)
+                                snapshot = None
+                            elif sql == "COMMIT":
+                                snapshot = None
+                        self._ok()
+                    elif cmd == 0x16:  # COM_STMT_PREPARE
+                        sql = pkt[1:].decode()
+                        sid = next_id
+                        next_id += 1
+                        stmts[sid] = sql
+                        nparams = sql.count("?")
+                        self._send(
+                            b"\x00"
+                            + _struct.pack("<IHH", sid, 0, nparams)
+                            + b"\x00" + _struct.pack("<H", 0)
+                        )
+                        for _ in range(nparams):
+                            self._coldef("?", 0xFD)
+                        if nparams:
+                            self._eof()
+                    elif cmd == 0x17:  # COM_STMT_EXECUTE
+                        sid = _struct.unpack("<I", pkt[1:5])[0]
+                        sql = stmts.get(sid, "")
+                        nparams = sql.count("?")
+                        off = 10
+                        nb = (nparams + 7) // 8
+                        null_bm = pkt[off : off + nb]
+                        off += nb
+                        params = []
+                        if nparams:
+                            bound = pkt[off]
+                            off += 1
+                            types = []
+                            if bound:
+                                for _ in range(nparams):
+                                    types.append(pkt[off])
+                                    off += 2
+                            for i in range(nparams):
+                                if null_bm[i // 8] & (1 << (i % 8)):
+                                    params.append(None)
+                                    continue
+                                t = types[i]
+                                if t == 0x08:  # LONGLONG
+                                    params.append(
+                                        _struct.unpack(
+                                            "<q", pkt[off : off + 8]
+                                        )[0]
+                                    )
+                                    off += 8
+                                else:  # lenenc bytes
+                                    first = pkt[off]
+                                    off += 1
+                                    if first < 0xFB:
+                                        n = first
+                                    elif first == 0xFC:
+                                        n = _struct.unpack(
+                                            "<H", pkt[off : off + 2]
+                                        )[0]
+                                        off += 2
+                                    else:
+                                        n = int.from_bytes(
+                                            pkt[off : off + 3], "little"
+                                        )
+                                        off += 3
+                                    params.append(pkt[off : off + n])
+                                    off += n
+                        err = fake._execute(self, lenenc, sql, params)
+                        if err:
+                            self._err(*err)
+                    elif cmd == 0x19:  # COM_STMT_CLOSE (no response)
+                        pass
+                    else:
+                        self._ok()
+
+        self._server = socketserver.ThreadingTCPServer(("127.0.0.1", 0), H)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.address = f"127.0.0.1:{self.port}"
+
+    def _execute(self, h, lenenc, sql, params):
+        import struct as _struct
+
+        from seaweedfs_tpu.filer.abstract_sql import MYSQL_DIALECT as D
+
+        def q(stmt):
+            return stmt.replace("%s", "?")
+
+        def text(i):
+            return params[i].decode()
+
+        def binrow(cols):
+            # binary row: 0x00 header + null bitmap (offset 2) + values
+            nb = (len(cols) + 9) // 8
+            body = b"\x00" + b"\x00" * nb
+            for v in cols:
+                if isinstance(v, int):
+                    body += _struct.pack("<q", v)
+                else:
+                    body += lenenc(len(v)) + v
+            h._send(body)
+
+        def send_rows(col_defs, rows):
+            h._send(lenenc(len(col_defs)))
+            for name, ctype in col_defs:
+                h._coldef(name, ctype)
+            h._eof()
+            for row in rows:
+                binrow(row)
+            h._eof()
+
+        with self._lock:
+            if sql.upper().startswith("CREATE TABLE"):
+                h._ok()
+                return None
+            if sql == q(D.insert):
+                key = (text(2), text(1))
+                if key in self.rows:
+                    return (1062, "Duplicate entry")
+                self.rows[key] = (params[0], params[3])
+                h._ok()
+                return None
+            if sql == q(D.update):
+                key = (text(3), text(2))
+                if key in self.rows:
+                    self.rows[key] = (params[1], params[0])
+                h._ok()
+                return None
+            if sql == q(D.find):
+                hit = self.rows.get((text(2), text(1)))
+                send_rows(
+                    [("meta", 0xFC)], [[hit[1]]] if hit is not None else []
+                )
+                return None
+            if sql == q(D.delete):
+                self.rows.pop((text(2), text(1)), None)
+                h._ok()
+                return None
+            if sql == q(D.delete_folder_children):
+                d = text(1)
+                for k in [k for k in self.rows if k[0] == d]:
+                    del self.rows[k]
+                h._ok()
+                return None
+            if sql in (q(D.list_exclusive), q(D.list_inclusive)):
+                d, start = text(2), text(1)
+                limit = params[3]
+                inclusive = sql == q(D.list_inclusive)
+                names = sorted(n for (dd, n) in self.rows if dd == d)
+                out = []
+                for n in names:
+                    if inclusive and n < start:
+                        continue
+                    if not inclusive and n <= start:
+                        continue
+                    out.append([n.encode(), self.rows[(d, n)][1]])
+                    if len(out) >= limit:
+                        break
+                send_rows([("name", 0xFD), ("meta", 0xFC)], out)
+                return None
+        return (1064, f"unknown statement {sql[:60]!r}")
 
     def start(self):
         threading.Thread(target=self._server.serve_forever, daemon=True).start()

@@ -262,6 +262,18 @@ def _etcd_factory():
     return _FakeBackedFactory(FakeEtcd, lambda f: EtcdFilerStore(f.endpoint))
 
 
+def _mysql_factory():
+    from seaweedfs_tpu.filer.abstract_sql import new_mysql_store
+    from tests.cloud_fakes import FakeMysql
+
+    return _FakeBackedFactory(
+        lambda: FakeMysql(password="pw"),
+        lambda f: new_mysql_store(
+            f"{f.address}/seaweedfs?user=seaweedfs&password=pw"
+        ),
+    )
+
+
 def _postgres_factory():
     from seaweedfs_tpu.filer.abstract_sql import new_postgres_store
     from tests.cloud_fakes import FakePostgres
@@ -286,10 +298,11 @@ def _postgres_factory():
         _cassandra_factory(),
         _etcd_factory(),
         _postgres_factory(),
+        _mysql_factory(),
     ],
     ids=[
         "memory", "sqlite", "sortedlog", "lsm", "sql", "redis",
-        "cassandra", "etcd", "postgres",
+        "cassandra", "etcd", "postgres", "mysql",
     ],
 )
 class TestFilerStores:
@@ -367,8 +380,21 @@ class TestAbstractSql:
     def test_gated_kinds_raise_with_guidance(self):
         from seaweedfs_tpu.filer.filerstore import new_store
 
-        with pytest.raises(RuntimeError, match="client library"):
-            new_store("mysql")
+        with pytest.raises(RuntimeError, match="cannot reach"):
+            new_store("mysql", "127.0.0.1:1")
+        # wrong mysql password: reachable, clear auth error
+        from tests.cloud_fakes import FakeMysql
+
+        fmy = FakeMysql(password="right")
+        fmy.start()
+        try:
+            with pytest.raises(Exception, match="Access denied"):
+                new_store(
+                    "mysql",
+                    f"{fmy.address}/seaweedfs?user=seaweedfs&password=nope",
+                )
+        finally:
+            fmy.stop()
         with pytest.raises(ValueError, match="embedded kinds"):
             new_store("no-such-store")
         # redis / cassandra gate on connectivity, not a library
