@@ -189,10 +189,13 @@ class CassandraStore(FilerStore):
 
     def insert_entry(self, entry: Entry) -> None:
         d, name = split_path(entry.full_path)
+        # Bind the entry's TTL (reference cassandra_store.go:63 binds
+        # entry.TtlSec) so TTL'd entries expire server-side.
+        ttl = entry.attr.ttl_sec
         self._conn.query(
             "INSERT INTO filemeta (directory,name,meta) VALUES(?,?,?) "
             "USING TTL ? ",
-            [d.encode(), name.encode(), entry.encode(), struct.pack(">i", 0)],
+            [d.encode(), name.encode(), entry.encode(), struct.pack(">i", ttl)],
         )
 
     update_entry = insert_entry

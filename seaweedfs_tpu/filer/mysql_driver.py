@@ -136,26 +136,51 @@ class MysqlConnection:
             raise
 
     # --- packet framing -------------------------------------------------
+    # A payload length of 0xFFFFFF marks a continuation: the logical packet
+    # carries on in the next frame (and a payload of exactly 16 MiB - 1 must
+    # be followed by an empty terminator frame on send).
+    _MAX_FRAME = 0xFFFFFF
+
     def _read_packet(self) -> bytes:
-        hdr = self.rfile.read(4)
-        if len(hdr) < 4:
-            raise ConnectionError("mysql: connection closed")
-        length = int.from_bytes(hdr[:3], "little")
-        self._seq = hdr[3] + 1
-        payload = self.rfile.read(length)
-        if len(payload) < length:
-            raise ConnectionError("mysql: short packet")
-        return payload
+        chunks = []
+        while True:
+            hdr = self.rfile.read(4)
+            if len(hdr) < 4:
+                raise ConnectionError("mysql: connection closed")
+            length = int.from_bytes(hdr[:3], "little")
+            self._seq = hdr[3] + 1
+            payload = self.rfile.read(length)
+            if len(payload) < length:
+                raise ConnectionError("mysql: short packet")
+            chunks.append(payload)
+            if length < self._MAX_FRAME:
+                return b"".join(chunks) if len(chunks) > 1 else chunks[0]
 
     def _send_packet(self, payload: bytes, reset_seq: bool = False) -> None:
         if reset_seq:
             self._seq = 0
-        self.sock.sendall(
-            len(payload).to_bytes(3, "little")
-            + bytes([self._seq])
-            + payload
-        )
-        self._seq += 1
+        if len(payload) < self._MAX_FRAME:  # common case: one frame, one send
+            self.sock.sendall(
+                len(payload).to_bytes(3, "little")
+                + bytes([self._seq & 0xFF])
+                + payload
+            )
+            self._seq += 1
+            return
+        view = memoryview(payload)
+        off = 0
+        while True:
+            frame = view[off : off + self._MAX_FRAME]
+            self.sock.sendall(
+                len(frame).to_bytes(3, "little") + bytes([self._seq & 0xFF])
+            )
+            if frame:
+                self.sock.sendall(frame)
+            self._seq += 1
+            off += len(frame)
+            # A max-size frame always needs a follow-up (possibly empty).
+            if len(frame) < self._MAX_FRAME:
+                break
 
     def _raise_err(self, payload: bytes) -> None:
         r = _Reader(payload)

@@ -435,7 +435,17 @@ class KernelFuseMount:
             flags, mode, _umask, _of = _CREATE_IN.unpack_from(body)
             name = body[_CREATE_IN.size :].rstrip(b"\0").decode()
             path = self._child(nodeid, name)
-            f = self.mfs.open(path, "w")
+            # CREATE must enforce O_EXCL/O_TRUNC itself: with no cached
+            # negative dentry the kernel forwards O_CREAT opens on files
+            # that already exist, and only O_TRUNC may clobber them
+            if self.mfs.exists(path):
+                if flags & os.O_EXCL:
+                    return -errno.EEXIST
+                if flags & os.O_TRUNC:
+                    self.mfs.truncate(path, 0)
+                f = self.mfs.open(path, "r+")
+            else:
+                f = self.mfs.open(path, "w")
             fh = self._next_fh
             self._next_fh += 1
             self._handles[fh] = f

@@ -208,10 +208,16 @@ class EtcdSequencer:
         """CAS-bump the stored max until [current, max) covers
         at_least ids (batchGetSequenceFromEtcd's retry loop)."""
         while self._max - self._current < at_least:
-            stored = self._get() or 0
-            new_max = max(stored, self._current) + max(self._step, at_least)
-            if self._cas_swap(stored, new_max):
-                self._current = max(self._current, stored)
+            stored = self._get()
+            new_max = max(stored or 0, self._current) + max(self._step, at_least)
+            if stored is None:
+                # key vanished (deleted externally): a VALUE compare can
+                # never match an absent key, so create-if-absent instead
+                ok = self._cas_create(new_max)
+            else:
+                ok = self._cas_swap(stored, new_max)
+            if ok:
+                self._current = max(self._current, stored or 0)
                 self._max = new_max
 
     # --- Sequencer API --------------------------------------------------

@@ -643,8 +643,16 @@ class MasterServer:
                         n = int(self.headers.get("Content-Length", "0"))
                     except ValueError:
                         n = 0
-                    if n > 0:
-                        self.rfile.read(n)
+                    if n > 64 << 20:
+                        # nothing but /submit legitimately posts a large
+                        # body here; don't buffer-drain unbounded data
+                        self.close_connection = True
+                        return self._json({"error": "request body too large"}, 413)
+                    while n > 0:
+                        chunk = self.rfile.read(min(n, 1 << 20))
+                        if not chunk:
+                            break
+                        n -= len(chunk)
                 if url.path == "/dir/assign":
                     return self._assign(q)
                 if url.path == "/dir/lookup":

@@ -377,6 +377,46 @@ class TestAbstractSql:
         )
         assert "name>$2" in POSTGRES_DIALECT.list_exclusive
 
+    def test_mysql_packet_framing_splits_at_16mib(self):
+        """MySQL frames cap at 0xFFFFFF payload bytes; a max-size frame
+        signals continuation and a >=16MiB logical packet must be split
+        on send and reassembled on read — a near-16MiB filemeta blob
+        must not desync the connection."""
+        import io
+
+        from seaweedfs_tpu.filer.mysql_driver import MysqlConnection
+
+        conn = MysqlConnection.__new__(MysqlConnection)
+        sent: list[bytes] = []
+
+        class _Sock:
+            @staticmethod
+            def sendall(b):
+                sent.append(bytes(b))
+
+        conn.sock = _Sock()
+        for size in (0xFFFFFF - 1, 0xFFFFFF, 0xFFFFFF + 7):
+            sent.clear()
+            payload = (b"0123456789abcdef" * ((size // 16) + 1))[:size]
+            conn._seq = 0
+            conn._send_packet(payload)
+            wire = b"".join(sent)
+            # frame walk: every non-final frame is exactly max-size,
+            # sequence ids increment per frame
+            off, frames = 0, []
+            while off < len(wire):
+                ln = int.from_bytes(wire[off : off + 3], "little")
+                seq = wire[off + 3]
+                frames.append((ln, seq))
+                off += 4 + ln
+            assert off == len(wire)
+            assert [s for _, s in frames] == list(range(len(frames)))
+            assert all(ln == 0xFFFFFF for ln, _ in frames[:-1])
+            assert frames[-1][0] < 0xFFFFFF  # incl. empty terminator
+            # reassembly round-trips
+            conn.rfile = io.BytesIO(wire)
+            assert conn._read_packet() == payload
+
     def test_gated_kinds_raise_with_guidance(self):
         from seaweedfs_tpu.filer.filerstore import new_store
 

@@ -315,6 +315,20 @@ class TestKernelFuseMount:
             assert f.read() == data
         assert os.path.getsize(p) == len(data)
 
+    def test_o_excl_create_fails_on_existing(self, kmount):
+        """open(O_CREAT|O_EXCL) on an existing file must raise EEXIST
+        and leave the content intact — the kernel forwards exclusivity
+        enforcement to the CREATE handler when no negative dentry is
+        cached."""
+        p = os.path.join(kmount, "excl-k.txt")
+        with open(p, "wb") as f:
+            f.write(b"keep me")
+        with pytest.raises(FileExistsError):
+            fd = os.open(p, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+            os.close(fd)
+        with open(p, "rb") as f:
+            assert f.read() == b"keep me"
+
     def test_mkdir_listdir_rename_unlink(self, kmount):
         d = os.path.join(kmount, "kdir")
         os.mkdir(d)
@@ -487,3 +501,38 @@ class TestKernelFuseProtocol:
         body += struct.pack("<QQ", n2, 1)
         km._dispatch(fk.BATCH_FORGET, 0, body)
         assert n1 not in km._nodes and n2 not in km._nodes
+
+    def test_create_o_excl_on_existing_file(self, km):
+        """CREATE must enforce O_EXCL itself: with no cached negative
+        dentry the kernel forwards O_CREAT|O_EXCL for existing files,
+        and truncating instead of failing EEXIST loses data."""
+        import errno
+        import os
+        import struct
+
+        from seaweedfs_tpu.filesys import fuse_kernel as fk
+
+        km.mfs.write_file("/excl.txt", b"precious")
+        body = (
+            struct.pack(
+                "<IIII", os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644, 0, 0
+            )
+            + b"excl.txt\0"
+        )
+        assert km._dispatch(fk.CREATE, 1, body) == -errno.EEXIST
+        assert km.mfs.read_file("/excl.txt") == b"precious"
+        # O_CREAT without O_TRUNC on an existing file preserves content
+        # (read-modify-write openers must not lose data)
+        body = struct.pack("<IIII", os.O_CREAT | os.O_WRONLY, 0o644, 0, 0)
+        body += b"excl.txt\0"
+        out = km._dispatch(fk.CREATE, 1, body)
+        assert isinstance(out, bytes)
+        assert km.mfs.read_file("/excl.txt") == b"precious"
+        # O_CREAT|O_TRUNC clobbers, as it should
+        body = struct.pack(
+            "<IIII", os.O_CREAT | os.O_TRUNC | os.O_WRONLY, 0o644, 0, 0
+        )
+        body += b"excl.txt\0"
+        out = km._dispatch(fk.CREATE, 1, body)
+        assert isinstance(out, bytes)
+        assert km.mfs.read_file("/excl.txt") == b""

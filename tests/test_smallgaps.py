@@ -690,6 +690,19 @@ class TestEtcdSequencer:
         fresh = [s2.next_file_id(1) for _ in range(15)]
         assert not set(issued) & set(fresh)
 
+    def test_key_deleted_externally_does_not_spin(self, etcd):
+        """If the sequence key is deleted behind the sequencer's back, a
+        VALUE compare can never match the absent key — the reserve loop
+        must fall back to create-if-absent instead of spinning."""
+        from seaweedfs_tpu.sequence import EtcdSequencer
+
+        s = EtcdSequencer(etcd.endpoint, step=5)
+        first = s.next_file_id(1)
+        s._kv.call("deleterange", {"key": s._key_b64})
+        # exhaust the local reservation to force a fresh CAS round
+        ids = [s.next_file_id(1) for _ in range(20)]
+        assert len(set(ids)) == 20 and min(ids) > first
+
     def test_set_max_lifts_stored_value(self, etcd):
         from seaweedfs_tpu.sequence import EtcdSequencer
 
