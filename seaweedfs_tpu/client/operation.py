@@ -252,29 +252,42 @@ class _RawHTTPConnection:
         """(status, FastHeaders, body, will_close)."""
         from seaweedfs_tpu.util.httpd import FastHeaders
 
+        readline = self.rfile.readline
         while True:
-            line = self.rfile.readline(65537)
+            line = readline(65537)
             if not line:
                 raise http.client.RemoteDisconnected("no status line")
-            parts = line.decode("latin-1").rstrip("\r\n").split(None, 2)
-            if len(parts) < 2 or not parts[0].startswith("HTTP/"):
-                raise http.client.BadStatusLine(
-                    line.decode("latin-1", "replace")
-                )
-            try:
-                version, status = parts[0], int(parts[1])
-            except ValueError:
-                raise http.client.BadStatusLine(
-                    line.decode("latin-1", "replace")
-                ) from None
+            # bytes-level fast path for the dominant exact shape; the
+            # decode path handles HTTP/0.9-isms and odd spacing
+            if (
+                (line[:9] == b"HTTP/1.1 " or line[:9] == b"HTTP/1.0 ")
+                and line[9:12].isdigit()
+                and line[12:13] in (b" ", b"\r", b"\n")
+            ):
+                version = "HTTP/1.1" if line[7:8] == b"1" else "HTTP/1.0"
+                status = int(line[9:12])
+            else:
+                parts = line.decode("latin-1").rstrip("\r\n").split(None, 2)
+                if len(parts) < 2 or not parts[0].startswith("HTTP/"):
+                    raise http.client.BadStatusLine(
+                        line.decode("latin-1", "replace")
+                    )
+                try:
+                    version, status = parts[0], int(parts[1])
+                except ValueError:
+                    raise http.client.BadStatusLine(
+                        line.decode("latin-1", "replace")
+                    ) from None
             headers = FastHeaders()
             while True:
-                hline = self.rfile.readline(65537)
+                hline = readline(65537)
                 if hline in (b"\r\n", b"\n", b""):
                     break
-                key, sep, value = hline.decode("latin-1").partition(":")
+                key, sep, value = hline.partition(b":")
                 if sep:
-                    headers[key.strip().lower()] = value.strip()
+                    headers[key.strip().lower().decode("latin-1")] = (
+                        value.strip().decode("latin-1")
+                    )
             if status != 100:
                 break
             # 100 Continue: interim — the real response follows

@@ -36,6 +36,13 @@ class LatencyStats:
             else:
                 self.failed += 1
 
+    def local(self) -> "_LocalStats":
+        """Per-worker accumulator: the hot loop records without touching
+        the shared lock (the reference streams per-request stats over a
+        channel to one aggregator goroutine, benchmark.go:377 — same
+        idea: no cross-thread contention per request)."""
+        return _LocalStats(self)
+
     def report(self, title: str, concurrency: int) -> str:
         elapsed = time.perf_counter() - self.start
         lat = sorted(self.latencies_ms)
@@ -65,6 +72,33 @@ class LatencyStats:
         return "\n".join(lines)
 
 
+class _LocalStats:
+    __slots__ = ("_parent", "latencies_ms", "bytes", "completed", "failed")
+
+    def __init__(self, parent: LatencyStats):
+        self._parent = parent
+        self.latencies_ms: list[float] = []
+        self.bytes = 0
+        self.completed = 0
+        self.failed = 0
+
+    def add(self, latency_sec: float, nbytes: int, ok: bool = True) -> None:
+        if ok:
+            self.completed += 1
+            self.bytes += nbytes
+            self.latencies_ms.append(latency_sec * 1000.0)
+        else:
+            self.failed += 1
+
+    def merge(self) -> None:
+        p = self._parent
+        with p._lock:
+            p.completed += self.completed
+            p.bytes += self.bytes
+            p.failed += self.failed
+            p.latencies_ms.extend(self.latencies_ms)
+
+
 @register
 class BenchmarkCommand(Command):
     name = "benchmark"
@@ -85,8 +119,10 @@ class BenchmarkCommand(Command):
         )
 
     def run(self, args) -> int:
+        from seaweedfs_tpu.command.servers import _tune_gc
         from seaweedfs_tpu.util.profiling import CpuProfile
 
+        _tune_gc()  # the load generator is as hot as the daemons
         with CpuProfile(args.cpuprofile):
             return self._run(args)
 
@@ -134,12 +170,14 @@ def run_benchmark(
         payload = bytes(rng.randrange(256) for _ in range(size))
 
         def writer():
+            local = stats.local()
+            local_fids = []
             while True:
                 with counter_lock:
                     try:
                         next(counter)
                     except StopIteration:
-                        return
+                        break
                 t0 = time.perf_counter()
                 try:
                     ar = op.assign(
@@ -156,11 +194,13 @@ def run_benchmark(
                             # deleted fids stay out of the read pool so
                             # the read phase doesn't report their 404s
                             # as failures
-                            with fid_lock:
-                                fids.append(ar.fid)
+                            local_fids.append(ar.fid)
                 except Exception:
                     ok = False
-                stats.add(time.perf_counter() - t0, size, ok)
+                local.add(time.perf_counter() - t0, size, ok)
+            local.merge()
+            with fid_lock:
+                fids.extend(local_fids)
 
         threads = [threading.Thread(target=writer) for _ in range(concurrency)]
         for t in threads:
@@ -176,20 +216,22 @@ def run_benchmark(
 
         def reader():
             rng = random.Random(threading.get_ident())
+            local = stats.local()
             while True:
                 with counter_lock:
                     try:
                         next(counter)
                     except StopIteration:
-                        return
+                        break
                 fid = rng.choice(fids)
                 t0 = time.perf_counter()
                 try:
                     url = op.lookup_file_id(master, fid)
                     data, _ = op.download(url)
-                    stats.add(time.perf_counter() - t0, len(data), True)
+                    local.add(time.perf_counter() - t0, len(data), True)
                 except Exception:
-                    stats.add(time.perf_counter() - t0, 0, False)
+                    local.add(time.perf_counter() - t0, 0, False)
+            local.merge()
 
         threads = [threading.Thread(target=reader) for _ in range(concurrency)]
         for t in threads:

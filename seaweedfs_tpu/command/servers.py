@@ -13,6 +13,19 @@ from seaweedfs_tpu.command import Command, register
 from seaweedfs_tpu.util import wlog
 
 
+def _tune_gc() -> None:
+    """Daemon-mode GC posture: freeze boot-time objects out of the young
+    generation and raise the gen-0 threshold so the cyclic collector
+    stops running every ~700 allocations mid-request (the request path
+    allocates acyclically; measured ~5% of data-plane CPU). Collections
+    still happen, just far less often — this is tuning, not disabling."""
+    import gc
+
+    gc.collect()
+    gc.freeze()
+    gc.set_threshold(100_000, 1_000, 1_000)
+
+
 def _wait_forever() -> int:
     stop = threading.Event()
 
@@ -21,6 +34,7 @@ def _wait_forever() -> int:
 
     signal.signal(signal.SIGINT, on_signal)
     signal.signal(signal.SIGTERM, on_signal)
+    _tune_gc()
     stop.wait()
     return 0
 

@@ -42,3 +42,12 @@ if _lib is not None:
         if not isinstance(data, bytes):
             data = bytes(data)
         return _lib.weed_crc32c(crc & 0xFFFFFFFF, data, len(data))
+
+
+# needle record serializer: a CPython extension, not ctypes — the
+# 11-field signature would cost more in ctypes conversion than the
+# serialization itself (native/needle_ext.c; staleness tracks its
+# #included sources too)
+needle_ext = _build.load_ext(
+    "needle_ext.c", "_needle_ext", deps=("needle.c", "crc32c.c")
+)

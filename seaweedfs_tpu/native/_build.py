@@ -1,8 +1,11 @@
 """Lazy compile-and-load for the native shims.
 
 Each shim is one C file next to this module, compiled with whatever
-system compiler is present and loaded via ctypes — no pybind11/pip.
-Callers treat a None return as "no native path" and fall back to their
+system compiler is present — no pybind11/pip. Two loaders share one
+compile cache: `load` dlopens a plain shared object via ctypes;
+`load_ext` imports a CPython extension module (for bindings too hot
+for ctypes argument conversion, like the needle serializer). Callers
+treat a None return as "no native path" and fall back to their
 pure-Python/numpy implementations.
 """
 
@@ -15,43 +18,79 @@ import tempfile
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
+_COMPILERS = ("cc", "gcc", "g++", "clang")
 
-def load(src_name: str, so_name: str) -> ctypes.CDLL | None:
-    """Compile src_name → so_name (cached; rebuilt when stale) and dlopen it."""
-    src = os.path.join(_HERE, src_name)
-    so = os.path.join(_HERE, so_name)
-    built = None
+
+def _compile(src: str, so: str, deps: tuple[str, ...], includes: tuple[str, ...]) -> str | None:
+    """Compile src → so unless the cached .so is newer than src AND all
+    #included deps. Returns the .so path, or None when no compiler
+    worked. Builds to a temp file then renames: concurrent importers
+    must never dlopen a half-written .so."""
     try:
-        if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
-            built = so
-        else:
-            for cc in ("cc", "gcc", "g++", "clang"):
-                # build to a temp file then rename: concurrent importers
-                # must never dlopen a half-written .so
-                fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
-                os.close(fd)
+        newest_src = max(
+            os.path.getmtime(p)
+            for p in (src, *(os.path.join(_HERE, d) for d in deps))
+            if os.path.exists(p)
+        )
+        if os.path.exists(so) and os.path.getmtime(so) >= newest_src:
+            return so
+        for cc in _COMPILERS:
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+            os.close(fd)
+            try:
+                proc = subprocess.run(
+                    [cc, "-O2", "-shared", "-fPIC"]
+                    + [f"-I{i}" for i in includes]
+                    + ["-o", tmp, src],
+                    capture_output=True,
+                    timeout=60,
+                )
+                if proc.returncode == 0:
+                    os.replace(tmp, so)
+                    return so
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+            finally:
                 try:
-                    proc = subprocess.run(
-                        [cc, "-O2", "-shared", "-fPIC", "-o", tmp, src],
-                        capture_output=True,
-                        timeout=60,
-                    )
-                    if proc.returncode == 0:
-                        os.replace(tmp, so)
-                        built = so
-                        break
-                except (OSError, subprocess.TimeoutExpired):
+                    os.unlink(tmp)
+                except OSError:
                     pass
-                finally:
-                    try:
-                        os.unlink(tmp)
-                    except OSError:
-                        pass
     except OSError:
         pass
+    return None
+
+
+def load(src_name: str, so_name: str, deps: tuple[str, ...] = ()) -> ctypes.CDLL | None:
+    """Compile src_name → so_name (cached; rebuilt when stale) and dlopen it."""
+    built = _compile(os.path.join(_HERE, src_name), os.path.join(_HERE, so_name), deps, ())
     if built is None:
         return None
     try:
         return ctypes.CDLL(built)
     except OSError:
+        return None
+
+
+def load_ext(src_name: str, mod_name: str, deps: tuple[str, ...] = ()):
+    """Compile a CPython extension source → <mod_name>.so and import it.
+    Returns the module, or None (callers fall back to pure Python)."""
+    import importlib.util
+    import sysconfig
+
+    paths = sysconfig.get_paths()
+    includes = tuple(dict.fromkeys((paths["include"], paths["platinclude"])))
+    built = _compile(
+        os.path.join(_HERE, src_name),
+        os.path.join(_HERE, mod_name + ".so"),
+        deps,
+        includes,
+    )
+    if built is None:
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location(mod_name, built)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except (ImportError, OSError):
         return None

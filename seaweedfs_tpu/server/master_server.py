@@ -24,6 +24,7 @@ possible leader election first, raft-compatible interface later").
 
 from __future__ import annotations
 
+import functools
 import json
 import queue
 import random
@@ -31,12 +32,16 @@ import threading
 import time
 from concurrent import futures
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, urlparse
 
 import grpc
 
 from seaweedfs_tpu.pb import master_pb2 as pb
-from seaweedfs_tpu.util.httpd import FastRequestMixin, WeedHTTPServer
+from seaweedfs_tpu.util.httpd import (
+    JSON_HDR as _JSON_HDR,
+    FastRequestMixin,
+    WeedHTTPServer,
+    fast_query,
+)
 from seaweedfs_tpu.pb import rpc, volume_pb2
 from seaweedfs_tpu.sequence import MemorySequencer
 from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
@@ -49,6 +54,16 @@ from seaweedfs_tpu.topology.volume_growth import (
     find_empty_slots_for_one_volume,
     find_volume_count,
 )
+
+
+@functools.lru_cache(maxsize=256)
+def _canonical_rp(s: str) -> str:
+    return str(ReplicaPlacement.parse(s))
+
+
+@functools.lru_cache(maxsize=256)
+def _canonical_ttl(s: str) -> str:
+    return str(TTL.parse(s))
 
 
 def _vol_info_from_pb(v: pb.VolumeStat) -> VolumeInfo:
@@ -513,9 +528,10 @@ class MasterServer:
                 count, replication, collection, ttl, data_center
             )
         # normalize to the same canonical forms heartbeat registration
-        # uses, so both paths land in the same layout
-        rp = str(ReplicaPlacement.parse(replication or self.default_replication))
-        ttl = str(TTL.parse(ttl))
+        # uses, so both paths land in the same layout (memoized: the
+        # same handful of strings arrive on every assign)
+        rp = _canonical_rp(replication or self.default_replication)
+        ttl = _canonical_ttl(ttl)
         if not self.topology.has_writable_volume(collection, rp, ttl):
             if self.topology.free_space() <= 0:
                 raise RuntimeError("no free volumes left")
@@ -624,17 +640,13 @@ class MasterServer:
                 )
 
             def _json(self, obj, status=200):
-                self.fast_reply(
-                    status,
-                    json.dumps(obj).encode(),
-                    {"Content-Type": "application/json"},
-                )
+                self.fast_reply(status, json.dumps(obj).encode(), _JSON_HDR)
 
             def do_GET(self):
                 server.request_counter.add()
-                url = urlparse(self.path)
-                q = {k: v[0] for k, v in parse_qs(url.query).items()}
-                if self.command == "POST" and url.path != "/submit":
+                path, _, qs = self.path.partition("?")
+                q = fast_query(qs)
+                if self.command == "POST" and path != "/submit":
                     # keep-alive hygiene: drain any request body now —
                     # an unread body would be parsed as the next
                     # request line on this connection (/submit reads
@@ -653,13 +665,13 @@ class MasterServer:
                         if not chunk:
                             break
                         n -= len(chunk)
-                if url.path == "/dir/assign":
+                if path == "/dir/assign":
                     return self._assign(q)
-                if url.path == "/dir/lookup":
+                if path == "/dir/lookup":
                     return self._lookup(q)
-                if url.path in ("/", "/ui/index.html"):
+                if path in ("/", "/ui/index.html"):
                     return self._html(server._render_master_ui())
-                if url.path == "/cluster/status":
+                if path == "/cluster/status":
                     return self._json(
                         {
                             "IsLeader": server.is_leader,
@@ -667,18 +679,18 @@ class MasterServer:
                             "Peers": server._raft.peers if server._raft else [],
                         }
                     )
-                if url.path == "/dir/status":
+                if path == "/dir/status":
                     return self._json({"Topology": server._topology_dump()})
-                if url.path == "/stats/health":
+                if path == "/stats/health":
                     return self._json({"ok": True})
-                if url.path == "/stats/counter":
+                if path == "/stats/counter":
                     return self._json(server.request_counter.snapshot())
-                if url.path == "/stats/memory":
+                if path == "/stats/memory":
                     import resource
 
                     ru = resource.getrusage(resource.RUSAGE_SELF)
                     return self._json({"maxrss_kb": ru.ru_maxrss})
-                if url.path == "/metrics":
+                if path == "/metrics":
                     from seaweedfs_tpu.stats.metrics import DEFAULT_REGISTRY
 
                     body = DEFAULT_REGISTRY.render_text().encode()
@@ -689,7 +701,7 @@ class MasterServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     return self.wfile.write(body)
-                if url.path == "/vol/grow":
+                if path == "/vol/grow":
                     try:
                         count = server.grow_volumes(
                             q.get("collection", ""),
@@ -701,13 +713,13 @@ class MasterServer:
                         return self._json({"count": count})
                     except Exception as e:  # noqa: BLE001
                         return self._json({"error": str(e)}, 500)
-                if url.path == "/col/delete":
+                if path == "/col/delete":
                     return self._json({"error": "use gRPC CollectionDelete"}, 400)
-                if url.path == "/submit":
+                if path == "/submit":
                     return self._submit(q)
-                if url.path == "/vol/vacuum":
+                if path == "/vol/vacuum":
                     return self._vol_vacuum(q)
-                self._json({"error": f"unknown path {url.path}"}, 404)
+                self._json({"error": f"unknown path {path}"}, 404)
 
             do_POST = do_GET
 

@@ -24,20 +24,27 @@ VolumeEcShardRead stream the reference uses (store_ec.go:279).
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import threading
 import time
 from concurrent import futures
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs
 
 import grpc
 
 from seaweedfs_tpu.ec import ec_files
 from seaweedfs_tpu.ec.ec_volume import EcVolume, NotEnoughShards
 from seaweedfs_tpu.pb import master_pb2, rpc, volume_pb2 as pb
-from seaweedfs_tpu.util.httpd import FastRequestMixin, WeedHTTPServer
+from seaweedfs_tpu.util.httpd import (
+    JSON_HDR as _JSON_HDR,
+    FastRequestMixin,
+    WeedHTTPServer,
+    fast_query,
+)
+
 from seaweedfs_tpu.storage.file_id import FileId
 from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.store import Store
@@ -47,6 +54,14 @@ from seaweedfs_tpu.storage.volume import (
     VolumeReadOnly,
     volume_base_name,
 )
+
+_esc_json = functools.lru_cache(maxsize=2048)(json.dumps)
+
+
+@functools.lru_cache(maxsize=4096)
+def _http_date(ts: int) -> str:
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(ts))
+
 
 COPY_CHUNK = 1024 * 1024
 
@@ -948,21 +963,15 @@ class VolumeServer:
                 self.fast_reply(status, body, headers)
 
             def _json(self, obj, status=200):
-                self._reply(
-                    status,
-                    json.dumps(obj).encode(),
-                    {"Content-Type": "application/json"},
-                )
+                self._reply(status, json.dumps(obj).encode(), _JSON_HDR)
 
             def _parse_fid(self):
-                url = urlparse(self.path)
-                path = url.path.lstrip("/")
+                path, _, qs = self.path.partition("?")
+                path = path.lstrip("/")
                 if "," not in path:
                     return None, None
                 try:
-                    return FileId.parse(path), {
-                        k: v[0] for k, v in parse_qs(url.query).items()
-                    }
+                    return FileId.parse(path), fast_query(qs)
                 except ValueError:
                     return None, None
 
@@ -974,11 +983,11 @@ class VolumeServer:
                     return True
                 from seaweedfs_tpu.security import UnauthorizedError, jwt_from_headers
 
-                url = urlparse(self.path)
-                token = jwt_from_headers(parse_qs(url.query), self.headers)
+                path, _, qs = self.path.partition("?")
+                token = jwt_from_headers(parse_qs(qs), self.headers)
                 try:
                     server.guard.check_write(
-                        self.client_address[0], token, url.path.lstrip("/")
+                        self.client_address[0], token, path.lstrip("/")
                     )
                     return True
                 except UnauthorizedError as e:
@@ -986,7 +995,7 @@ class VolumeServer:
                     return False
 
             def do_GET(self):
-                url_path = urlparse(self.path).path
+                url_path = self.path.partition("?")[0]
                 if url_path in ("/", "/ui/index.html"):
                     return self._reply(
                         200,
@@ -1051,9 +1060,7 @@ class VolumeServer:
                         f'inline; filename="{n.name.decode("latin-1")}"'
                     )
                 if n.has_last_modified_date():
-                    headers["Last-Modified"] = time.strftime(
-                        "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified)
-                    )
+                    headers["Last-Modified"] = _http_date(n.last_modified)
                 data = bytes(n.data)
                 # on-read image resizing (?width=&height=&mode=,
                 # volume_server_handlers_read.go:224 images.Resized);
@@ -1143,28 +1150,32 @@ class VolumeServer:
                     return self._json({"error": "invalid file id"}, 400)
                 if not self._check_write_auth():
                     return
-                length = int(self.headers.get("Content-Length", "0"))
+                length = int(self.headers.get("content-length", "0"))
                 body = self.rfile.read(length)
                 # `curl -F file=@x` multipart forms carry the payload,
                 # filename, and mime inside the body (needle.go:85
-                # ParseUpload); raw bodies pass through unchanged
-                from seaweedfs_tpu.util.multipart import (
-                    MalformedUpload,
-                    parse_upload,
-                )
-
-                try:
-                    part = parse_upload(
-                        body, self.headers.get("Content-Type", "")
+                # ParseUpload); raw bodies pass through inline — the
+                # parser call is only paid when the request is a form
+                ctype = self.headers.get("content-type", "")
+                part_filename = ""
+                if ctype[:19].lower() == "multipart/form-data":
+                    from seaweedfs_tpu.util.multipart import (
+                        MalformedUpload,
+                        parse_upload,
                     )
-                except MalformedUpload as e:
-                    return self._json({"error": str(e)}, 400)
-                n = Needle(cookie=fid.cookie, id=fid.key, data=part.data)
-                ctype = part.mime
+
+                    try:
+                        part = parse_upload(body, ctype)
+                    except MalformedUpload as e:
+                        return self._json({"error": str(e)}, 400)
+                    data, ctype, part_filename = part.data, part.mime, part.filename
+                else:
+                    data = body
+                n = Needle(cookie=fid.cookie, id=fid.key, data=data)
                 if ctype and len(ctype) < 256 and ctype != "application/octet-stream":
                     n.mime = ctype.encode()
                     n.set_has_mime()
-                fname = q.get("filename", "") or part.filename
+                fname = q.get("filename", "") or part_filename
                 if fname and len(fname) < 256:
                     n.name = fname.encode()
                     n.set_has_name()
@@ -1188,7 +1199,12 @@ class VolumeServer:
                     err = server._replicate(fid, q, "POST", body, self.headers)
                     if err:
                         return self._json({"error": err}, 500)
-                self._json({"name": fname, "size": size, "eTag": n.etag()}, 201)
+                self._reply(
+                    201,
+                    b'{"name": %s, "size": %d, "eTag": "%s"}'
+                    % (_esc_json(fname).encode(), size, n.etag().encode()),
+                    _JSON_HDR,
+                )
 
             def do_DELETE(self):
                 fid, q = self._parse_fid()

@@ -7,9 +7,13 @@ leading zeros stripped (minimum one nibble pair), the cookie is always
 
 from __future__ import annotations
 
+import re
+
 from dataclasses import dataclass
 
 from seaweedfs_tpu.storage.types import parse_cookie, parse_needle_id
+
+_HEX_RE = re.compile(r"[0-9a-fA-F]+\Z")
 
 
 @dataclass(frozen=True)
@@ -50,10 +54,20 @@ _MAX_KEY_COOKIE_LEN = (8 + 4) * 2  # (NeedleIdSize + CookieSize) hex chars
 
 
 def parse_needle_id_cookie(key_cookie: str) -> tuple[int, int]:
-    """needle.go:181 ParseNeedleIdCookie (incl. the max-length check)."""
-    if len(key_cookie) <= 8:
+    """needle.go:181 ParseNeedleIdCookie (incl. the max-length check).
+
+    One strict-hex validation over the whole string (Go ParseUint
+    semantics: no sign/prefix/underscore), then plain slicing — this
+    runs once per data-plane request, so it avoids the two-regex
+    two-call shape of parse_needle_id + parse_cookie."""
+    n = len(key_cookie)
+    if n <= 8:
         raise ValueError(f"needle id too short: {key_cookie!r}")
-    if len(key_cookie) > _MAX_KEY_COOKIE_LEN:
+    if n > _MAX_KEY_COOKIE_LEN:
         raise ValueError(f"key hash too long: {key_cookie!r}")
-    split = len(key_cookie) - 8
-    return parse_needle_id(key_cookie[:split]), parse_cookie(key_cookie[split:])
+    if not _HEX_RE.match(key_cookie):
+        # delegate for the exact per-field error text
+        split = n - 8
+        return parse_needle_id(key_cookie[:split]), parse_cookie(key_cookie[split:])
+    split = n - 8
+    return int(key_cookie[:split], 16), int(key_cookie[split:], 16)

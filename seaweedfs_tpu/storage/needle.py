@@ -30,6 +30,11 @@ from seaweedfs_tpu.storage.ttl import TTL
 from seaweedfs_tpu.util import bytesutil
 from seaweedfs_tpu.util.crc import crc32c, masked_value
 
+try:  # one-call C record serializer (native/needle_ext.c); None = Python path
+    from seaweedfs_tpu.native import needle_ext as _needle_ext
+except ImportError:  # pragma: no cover - no compiler on host
+    _needle_ext = None
+
 FLAG_GZIP = 0x01
 FLAG_HAS_NAME = 0x02
 FLAG_HAS_MIME = 0x04
@@ -214,6 +219,28 @@ class Needle:
         out += bytes(padding_length(self.size, version))
         return bytes(out)
 
+    def encode_record(self, version: int = VERSION3) -> bytes:
+        """`to_bytes` fast path: the whole record (header..padding) in
+        ONE native call (native/needle_ext.c — the prepareWriteBuffer
+        single-pass shape, needle_read_write.go:31). Byte-identical to
+        to_bytes; falls back to it when the shim didn't build."""
+        if _needle_ext is None:
+            return self.to_bytes(version)
+        blob, self.size, self.checksum = _needle_ext.encode(
+            self.cookie,
+            self.id,
+            self.data,
+            self.flags,
+            self.name,
+            self.mime,
+            self.last_modified,
+            (self.ttl or TTL()).to_bytes() if self.has_ttl() else None,
+            self.pairs,
+            version,
+            self.append_at_ns,
+        )
+        return blob
+
     # --- decode ---
     @staticmethod
     def parse_header(blob: bytes) -> tuple[int, int, int]:
@@ -231,8 +258,40 @@ class Needle:
         """Parse a full on-disk record (ReadBytes, needle_read_write.go:163).
 
         `size` — expected stored size from the index; mismatch raises.
-        Verifies the data CRC.
+        Verifies the data CRC. Fast path: one native parse+verify call
+        (native/needle_ext.c decode); any native rejection re-parses in
+        Python so error messages and edge semantics stay identical.
         """
+        if _needle_ext is not None:
+            try:
+                (
+                    cookie,
+                    nid,
+                    nsize,
+                    data,
+                    flags,
+                    name,
+                    mime,
+                    last_modified,
+                    ttl2,
+                    pairs,
+                    append_at_ns,
+                    crc,
+                ) = _needle_ext.decode(blob, version, -1 if size is None else size)
+            except ValueError:
+                pass  # cold path: Python parse below raises the exact error
+            else:
+                n = Needle()
+                n.cookie, n.id, n.size = cookie, nid, nsize
+                n.data, n.flags, n.name, n.mime = data, flags, name, mime
+                n.last_modified = last_modified
+                if ttl2 is not None:
+                    n.ttl = TTL.from_bytes(ttl2)
+                n.pairs = pairs
+                n.append_at_ns = append_at_ns
+                if nsize > 0:
+                    n.checksum = crc
+                return n
         n = Needle()
         n.cookie, n.id, n.size = Needle.parse_header(blob)
         if size is not None and n.size != size:

@@ -156,6 +156,7 @@ class Volume:
             with open(dat_path, "wb") as f:
                 f.write(self.super_block.to_bytes())
         self._dat = open(dat_path, "r+b")
+        self._bind_fd()
         if exists:
             self.super_block = SuperBlock.read_from(self._dat)
         self.nm = self._load_needle_map()
@@ -184,6 +185,7 @@ class Volume:
         self._dat = _FileLikeOverBackend(
             storage.new_storage_file(rf.key, rf.file_size)
         )
+        self._fd = None
 
     def has_remote_file(self) -> bool:
         return self.volume_info.has_remote_file()
@@ -258,6 +260,7 @@ class Volume:
             size = storage.download_file(dat_path, rf.key, progress)
             self._dat.close()
             self._dat = open(dat_path, "r+b")
+            self._bind_fd()
             if not keep_remote:
                 storage.delete_file(rf.key)
                 self.volume_info.files.remove(rf)
@@ -337,20 +340,46 @@ class Volume:
         except CorruptNeedle:
             raise
 
+    def _bind_fd(self) -> None:
+        """Arm the pread/pwrite fast path on the freshly (re)opened
+        .dat: positionless IO needs no seek syscall, no flush, and no
+        buffered-layer bookkeeping on the data plane."""
+        self._fd = self._dat.fileno()
+        self._append_end = os.fstat(self._fd).st_size
+
     def _read_at(self, offset: int, length: int) -> bytes:
+        if self._fd is not None:
+            return os.pread(self._fd, length, offset)
         self._dat.seek(offset)
         return self._dat.read(length)
 
-    def _append_blob(self, blob: bytes) -> int:
-        self._dat.seek(0, os.SEEK_END)
-        offset = self._dat.tell()
+    def _append_blob(self, blob) -> int:
+        if self._fd is None:
+            self._dat.seek(0, os.SEEK_END)
+            offset = self._dat.tell()
+            if offset % t.NEEDLE_PADDING_SIZE != 0:
+                pad = t.NEEDLE_PADDING_SIZE - offset % t.NEEDLE_PADDING_SIZE
+                self._dat.write(bytes(pad))
+                offset += pad
+            self._dat.write(blob)
+            self._dat.flush()
+            return offset
+        offset = self._append_end
         if offset % t.NEEDLE_PADDING_SIZE != 0:
             # realign, matching the reference's defensive padding
             pad = t.NEEDLE_PADDING_SIZE - offset % t.NEEDLE_PADDING_SIZE
-            self._dat.write(bytes(pad))
+            if os.pwrite(self._fd, bytes(pad), offset) != pad:
+                raise OSError(f"volume {self.id}: short pad write at {offset}")
             offset += pad
-        self._dat.write(blob)
-        self._dat.flush()
+        # a short write (ENOSPC/RLIMIT) must raise BEFORE the needle map
+        # records the offset, else a truncated record is indexed as live
+        written = os.pwrite(self._fd, blob, offset)
+        if written != len(blob):
+            raise OSError(
+                f"volume {self.id}: short append at {offset}: "
+                f"{written}/{len(blob)} bytes"
+            )
+        self._append_end = offset + len(blob)
         return offset
 
     def _now_ns(self) -> int:
@@ -380,7 +409,7 @@ class Volume:
                     )
 
             n.append_at_ns = self._now_ns()
-            blob = n.to_bytes(self.version)
+            blob = n.encode_record(self.version)
             offset = self._append_blob(blob)
             self.last_append_at_ns = n.append_at_ns
 
@@ -422,7 +451,7 @@ class Volume:
             freed = nv.size
             n.data = b""
             n.append_at_ns = self._now_ns()
-            blob = n.to_bytes(self.version)
+            blob = n.encode_record(self.version)
             offset = self._append_blob(blob)
             self.last_append_at_ns = n.append_at_ns
             self.nm.delete(n.id, t.offset_to_units(offset))
@@ -570,6 +599,7 @@ class Volume:
             os.replace(cpd, self.base_name + ".dat")
             os.replace(cpx, self.base_name + ".idx")
             self._dat = open(self.base_name + ".dat", "r+b")
+            self._bind_fd()
             self.super_block = SuperBlock.read_from(self._dat)
             # rebuild the map from the fresh index; a db map's stale
             # sqlite table must go too — the watermark can't detect a

@@ -14,6 +14,32 @@ from __future__ import annotations
 
 import socket
 from http.server import ThreadingHTTPServer
+from urllib.parse import unquote_plus
+
+
+# pre-encoded header block for fast_reply's bytes-headers contract —
+# the data-plane's universal reply Content-Type
+JSON_HDR = b"Content-Type: application/json\r\n"
+
+
+def fast_query(qs: str) -> dict:
+    """parse_qs-equivalent for the data plane's flat query strings:
+    first value wins, blank values dropped, percent/plus decoding only
+    when present (the stdlib pays regex + list machinery per call)."""
+    q = {}
+    if not qs:
+        return q
+    for part in qs.split("&"):
+        k, _, v = part.partition("=")
+        if not v:
+            continue
+        if "%" in k or "+" in k:
+            k = unquote_plus(k)
+        if "%" in v or "+" in v:
+            v = unquote_plus(v)
+        if k not in q:
+            q[k] = v
+    return q
 
 
 class FastHeaders(dict):
@@ -25,13 +51,23 @@ class FastHeaders(dict):
     of a small-request's CPU)."""
 
     def get(self, key, default=None):
+        # exact-hit first: hot call sites already pass lowercase names,
+        # and str.lower() allocates on every miss-free access
+        v = dict.get(self, key)
+        if v is not None:
+            return v
         return dict.get(self, key.lower(), default)
 
     def __getitem__(self, key):
-        return dict.__getitem__(self, key.lower())
+        try:
+            return dict.__getitem__(self, key)
+        except KeyError:
+            return dict.__getitem__(self, key.lower())
 
     def __contains__(self, key):
-        return dict.__contains__(self, key.lower())
+        return dict.__contains__(self, key) or dict.__contains__(
+            self, key.lower()
+        )
 
 
 class FastRequestMixin:
@@ -105,11 +141,18 @@ class FastRequestMixin:
         return True
 
     def fast_reply(self, status: int, body: bytes = b"", headers=None) -> None:
-        """status + headers + Content-Length + body in ONE write."""
+        """status + headers + Content-Length + body in ONE write.
+
+        `headers` may be a dict or pre-encoded header bytes
+        (b"Name: value\\r\\n"...) — hot handlers pass module-level
+        constants so nothing is formatted per request."""
         buf = bytearray(b"HTTP/1.1 %d %s\r\n" % (status, _REASON.get(status, b"OK")))
         if headers:
-            for k, v in headers.items():
-                buf += f"{k}: {v}\r\n".encode("latin-1")
+            if isinstance(headers, (bytes, bytearray)):
+                buf += headers
+            else:
+                for k, v in headers.items():
+                    buf += f"{k}: {v}\r\n".encode("latin-1")
         if self.close_connection:
             buf += b"Connection: close\r\n"
         buf += b"Content-Length: %d\r\n\r\n" % len(body)
