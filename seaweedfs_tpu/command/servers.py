@@ -182,6 +182,15 @@ class VolumeCommand(Command):
             choices=("", "cpu", "native", "tpu"),
             help="EC codec backend; empty = auto (tpu with a JAX device, else native SIMD, else numpy)",
         )
+        p.add_argument(
+            "-workers",
+            type=int,
+            default=1,
+            help="data-plane processes sharing this port via SO_REUSEPORT "
+            "(1 = classic single process; N>1 adds N-1 read workers so "
+            "multi-core hosts scale the GIL-bound read path — see "
+            "server/volume_workers.py)",
+        )
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
@@ -194,6 +203,18 @@ class VolumeCommand(Command):
         if len(maxes) == 1:
             maxes = maxes * len(dirs)
         _configure_tls("volume")
+        workers = max(1, args.workers)
+        internal_port = 0
+        if workers > 1:
+            # loopback listener the read workers proxy through; +20000
+            # mirrors the gRPC +10000 convention, wrapping below the
+            # ephemeral range when the public port sits too high
+            internal_port = args.port + 20000
+            if internal_port > 65535:
+                internal_port = args.port - 20000
+            if not 0 < internal_port <= 65535:
+                print(f"volume: no usable internal port for -port {args.port}")
+                return 1
         server = VolumeServer(
             dirs,
             host=args.ip,
@@ -208,18 +229,65 @@ class VolumeCommand(Command):
             ec_codec=args.ec_codec,
             storage_backends=load_config("master").sub("storage.backend"),
             needle_map_kind=args.index,
+            reuse_port=workers > 1,
+            internal_port=internal_port,
         )
         from seaweedfs_tpu.util.profiling import CpuProfile
 
         with CpuProfile(args.cpuprofile):
             server.start()
+            procs = []
+            if workers > 1:
+                from seaweedfs_tpu.server.volume_workers import spawn_read_workers
+
+                procs = spawn_read_workers(
+                    workers - 1,
+                    dirs,
+                    args.ip,
+                    args.port,
+                    f"127.0.0.1:{internal_port}",
+                )
             wlog.info(
-                "volume server %s:%d -> master %s", args.ip, args.port, args.mserver
+                "volume server %s:%d -> master %s (%d worker(s))",
+                args.ip, args.port, args.mserver, workers,
             )
             try:
                 return _wait_forever()
             finally:
+                for pr in procs:
+                    pr.terminate()
                 server.stop()
+
+
+@register
+class VolumeWorkerCommand(Command):
+    name = "volume.worker"
+    help = "internal: one SO_REUSEPORT read worker (spawned by volume -workers N)"
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-ip", default="127.0.0.1")
+        p.add_argument("-port", type=int, required=True)
+        p.add_argument("-dir", required=True)
+        p.add_argument("-lead", required=True, help="lead's internal host:port")
+        p.add_argument("-workerPort", type=int, default=0)
+        p.add_argument("-v", type=int, default=0)
+
+    def run(self, args) -> int:
+        from seaweedfs_tpu.server.volume_workers import VolumeReadWorker
+
+        wlog.set_verbosity(args.v)
+        worker = VolumeReadWorker(
+            args.dir.split(","),
+            host=args.ip,
+            port=args.port,
+            lead=args.lead,
+            worker_port=args.workerPort,
+        )
+        worker.start()
+        try:
+            return _wait_forever()
+        finally:
+            worker.stop()
 
 
 @register

@@ -100,6 +100,8 @@ class VolumeServer:
         storage_backends: dict | None = None,
         fix_jpg_orientation: bool = True,
         needle_map_kind: str = "memory",
+        reuse_port: bool = False,
+        internal_port: int = 0,
     ):
         # `ec.codec` config: "cpu" | "native" | "tpu" | "" (auto: tpu
         # with a JAX device, else the native SIMD shim, else numpy).
@@ -150,6 +152,12 @@ class VolumeServer:
         # hot path (the reference's wdclient vidMap role)
         self._location_cache: dict[int, tuple[float, list[str]]] = {}
         self._location_cache_ttl = 10.0
+        # -workers mode (server/volume_workers.py): SO_REUSEPORT on the
+        # public listener so read-worker processes can share the port,
+        # plus a loopback internal listener the workers proxy through
+        self.reuse_port = reuse_port
+        self.internal_port = internal_port
+        self._internal_server: ThreadingHTTPServer | None = None
 
     # ------------------------------------------------------------------
     # status UI (server/volume_server_ui/templates.go role)
@@ -1374,10 +1382,19 @@ class VolumeServer:
         )
         rpc.add_port(self._grpc_server, f"{self.host}:{self.grpc_port}")
         self._grpc_server.start()
-        self._http_server = WeedHTTPServer(
-            (self.host, self.port), self._http_handler_class()
-        )
+        from seaweedfs_tpu.util.httpd import ReusePortWeedHTTPServer
+
+        handler = self._http_handler_class()
+        server_cls = ReusePortWeedHTTPServer if self.reuse_port else WeedHTTPServer
+        self._http_server = server_cls((self.host, self.port), handler)
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
+        if self.internal_port:
+            self._internal_server = WeedHTTPServer(
+                ("127.0.0.1", self.internal_port), handler
+            )
+            threading.Thread(
+                target=self._internal_server.serve_forever, daemon=True
+            ).start()
         if self.master:
             self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
             self._hb_thread.start()
@@ -1389,6 +1406,9 @@ class VolumeServer:
         if self._http_server:
             self._http_server.shutdown()
             self._http_server.server_close()
+        if self._internal_server:
+            self._internal_server.shutdown()
+            self._internal_server.server_close()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
         self.store.close()

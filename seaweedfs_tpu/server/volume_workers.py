@@ -1,0 +1,389 @@
+"""SO_REUSEPORT read workers: per-core scaling for the volume data plane.
+
+The reference volume server scales across cores for free — Go
+schedules request goroutines onto every CPU (bazil-style concurrency
+behind weed/server/volume_server_handlers_read.go). A CPython process
+is pinned to one core by the GIL, so `volume -workers N` spawns N-1
+extra *read worker* processes that share the SAME host:port through
+SO_REUSEPORT (the kernel distributes accepted connections across the
+listeners — the mechanism nginx/envoy use for per-core workers):
+
+  * worker processes serve plain GET/HEAD straight from the shared
+    volume directories — each opens the volumes read-only and keeps
+    its needle map fresh by replaying the append-only `.idx` tail
+    (one fstat per lookup; an inode change means the lead vacuumed
+    the volume, which triggers a clean reopen);
+  * everything else — writes, deletes, EC/chunk-manifest reads, the
+    UI/status pages, image resizing — is proxied over a pooled
+    keep-alive connection to the lead's internal listener, so the
+    whole surface stays available on every accepted connection;
+  * the LEAD (worker 0) remains the one full volume server: it owns
+    all writes (single-writer per volume, like the reference), runs
+    the gRPC admin plane, and sends the heartbeats. Its inventory
+    covers the shared directories, so the master sees one data node.
+
+Read-your-writes holds because the lead appends the `.idx` entry (and
+flushes it) before replying 201, and workers re-check the idx size on
+every lookup miss-or-hit cycle. Vacuum is safe because a worker keeps
+serving the old inode until the commit renames land, then reopens.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+from seaweedfs_tpu.storage import idx as idx_codec
+from seaweedfs_tpu.storage.disk_location import parse_volume_file_name
+from seaweedfs_tpu.storage.file_id import FileId
+from seaweedfs_tpu.storage.needle import Needle  # noqa: F401 (re-export for tests)
+from seaweedfs_tpu.storage.volume import (
+    CookieMismatch,
+    NeedleNotFound,
+    Volume,
+)
+from seaweedfs_tpu.util import wlog
+from seaweedfs_tpu.util.httpd import (
+    JSON_HDR,
+    FastRequestMixin,
+    WeedHTTPServer,
+    fast_query,
+)
+
+from http.server import BaseHTTPRequestHandler
+
+_HOP_HEADERS = {
+    "connection",
+    "keep-alive",
+    "transfer-encoding",
+    "content-length",
+    "host",
+}
+
+
+class SharedReadVolume:
+    """A read-only view of a volume whose writer lives in the lead
+    process, kept fresh from the on-disk `.idx` (see module docstring)."""
+
+    def __init__(self, directory: str, vid: int, collection: str = ""):
+        self.directory = directory
+        self.vid = vid
+        self.collection = collection
+        self._lock = threading.Lock()
+        self._open()
+
+    _ENTRY = 16  # NEEDLE_MAP_ENTRY_SIZE
+
+    def _open(self) -> None:
+        from seaweedfs_tpu.storage.volume import volume_base_name
+
+        # stat BEFORE loading: entries appended between the stat and
+        # the load replay twice, which is safe (idx replay is last-wins
+        # idempotent; metrics are lead-owned). Statting after would
+        # skip the [loaded, stat] window forever.
+        self._idx_path = (
+            volume_base_name(self.directory, self.collection, self.vid) + ".idx"
+        )
+        st = os.stat(self._idx_path)
+        self._idx_ino = st.st_ino
+        self._replayed = st.st_size - (st.st_size % self._ENTRY)
+        self._vol = Volume(self.directory, self.vid, self.collection, create=False)
+
+    def _refresh(self) -> None:
+        st = os.stat(self._idx_path)
+        if st.st_ino != self._idx_ino:
+            # vacuum/compact committed: whole new .dat/.idx pair
+            old = self._vol
+            self._open()
+            old.close()
+            return
+        if st.st_size > self._replayed:
+            with open(self._idx_path, "rb") as f:
+                f.seek(self._replayed)
+                tail = f.read(st.st_size - self._replayed)
+            # whole entries only: a read racing the lead's 16-byte
+            # append may end mid-entry, and advancing past those bytes
+            # would shift every later decode
+            usable = len(tail) - (len(tail) % self._ENTRY)
+            for key, offset, size in idx_codec.iter_entries(tail[:usable]):
+                self._vol.nm._replay(key, offset, size)
+            self._replayed += usable
+
+    def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:
+        with self._lock:
+            self._refresh()
+        return self._vol.read_needle(needle_id, cookie=cookie)
+
+    def close(self) -> None:
+        self._vol.close()
+
+
+class VolumeReadWorker:
+    """One worker process: shared-port listener + blob read fast path."""
+
+    def __init__(
+        self,
+        directories: list[str],
+        host: str,
+        port: int,
+        lead: str,
+        worker_port: int = 0,
+    ):
+        self.directories = directories
+        self.host = host
+        self.port = port
+        self.lead = lead  # host:port of the lead's internal listener
+        self.worker_port = worker_port  # optional private listener (tests)
+        self._volumes: dict[int, SharedReadVolume] = {}
+        self._vol_lock = threading.Lock()
+        self._servers: list[WeedHTTPServer] = []
+        self._threads: list[threading.Thread] = []
+
+    # --- volume discovery ------------------------------------------------
+    def _find_volume(self, vid: int) -> SharedReadVolume | None:
+        v = self._volumes.get(vid)
+        if v is not None:
+            return v
+        with self._vol_lock:
+            v = self._volumes.get(vid)
+            if v is not None:
+                return v
+            for d in self.directories:
+                try:
+                    names = os.listdir(d)
+                except OSError:
+                    continue
+                for name in names:
+                    parsed = parse_volume_file_name(name)
+                    if parsed is None or parsed[1] != vid:
+                        continue
+                    try:
+                        v = SharedReadVolume(d, vid, parsed[0])
+                    except (OSError, ValueError, RuntimeError):
+                        # unreadable, mid-commit, or remote-tiered
+                        # (workers carry no backend config) — the lead
+                        # serves it via the proxy path
+                        return None
+                    self._volumes[vid] = v
+                    return v
+        return None
+
+    def _drop_volume(self, vid: int) -> None:
+        with self._vol_lock:
+            v = self._volumes.pop(vid, None)
+        if v is not None:
+            try:
+                v.close()
+            except OSError:
+                pass
+
+    # --- HTTP ------------------------------------------------------------
+    def _make_handler(self):
+        worker = self
+
+        class Handler(FastRequestMixin, BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                path, _, qs = self.path.partition("?")
+                fid_part = path.lstrip("/")
+                if "," in fid_part and "/" not in fid_part:
+                    q = fast_query(qs)
+                    if not ("width" in q or "height" in q):
+                        try:
+                            fid = FileId.parse(fid_part)
+                        except ValueError:
+                            fid = None
+                        if fid is not None and self._serve_blob(fid):
+                            return
+                self._proxy()
+
+            do_HEAD = do_GET
+
+            def _serve_blob(self, fid) -> bool:
+                """True when served locally; False = hand to the proxy
+                (unknown volume, EC volume, chunk manifest, expired…)."""
+                v = worker._find_volume(fid.volume_id)
+                if v is None:
+                    return False
+                try:
+                    n = v.read_needle(fid.key, cookie=fid.cookie)
+                except FileNotFoundError:
+                    worker._drop_volume(fid.volume_id)
+                    return False
+                except CookieMismatch:
+                    self._json({"error": "cookie mismatch"}, 404)
+                    return True
+                except NeedleNotFound:
+                    self._json({"error": "not found"}, 404)
+                    return True
+                except (OSError, ValueError, RuntimeError):
+                    worker._drop_volume(fid.volume_id)
+                    return False
+                if n.is_chunked_manifest():
+                    return False  # manifest fan-in needs the lead's store
+                etag = f'"{n.etag()}"'
+                if self.headers.get("if-none-match") == etag:
+                    self.fast_reply(304)
+                    return True
+                headers = {
+                    "ETag": etag,
+                    "Content-Type": "application/octet-stream",
+                    "Accept-Ranges": "bytes",
+                }
+                if n.has_mime() and n.mime:
+                    headers["Content-Type"] = n.mime.decode("latin-1")
+                if n.has_name() and n.name:
+                    headers["Content-Disposition"] = (
+                        f'inline; filename="{n.name.decode("latin-1")}"'
+                    )
+                if n.has_last_modified_date():
+                    from seaweedfs_tpu.server.volume_server import _http_date
+
+                    headers["Last-Modified"] = _http_date(n.last_modified)
+                data = n.data
+                from seaweedfs_tpu.util.http_range import (
+                    RangeNotSatisfiable,
+                    parse_range,
+                )
+
+                try:
+                    span = parse_range(self.headers.get("range", ""), len(data))
+                except RangeNotSatisfiable:
+                    self.fast_reply(
+                        416, b"", {"Content-Range": f"bytes */{len(data)}"}
+                    )
+                    return True
+                if span is None:
+                    self.fast_reply(200, data, headers)
+                else:
+                    start, end = span
+                    headers["Content-Range"] = (
+                        f"bytes {start}-{end}/{len(data)}"
+                    )
+                    self.fast_reply(206, data[start : end + 1], headers)
+                return True
+
+            def _json(self, obj, status=200):
+                import json
+
+                self.fast_reply(status, json.dumps(obj).encode(), JSON_HDR)
+
+            def _proxy(self):
+                """Forward this request verbatim to the lead and relay
+                the response (one pooled keep-alive conn per handler
+                thread, via the client transport)."""
+                from seaweedfs_tpu.client.operation import _drop_conn, _pooled_conn
+
+                body = None
+                if self.command in ("POST", "PUT", "DELETE"):
+                    try:
+                        n = int(self.headers.get("content-length", "0"))
+                    except ValueError:
+                        n = 0
+                    body = self.rfile.read(n) if n else b""
+                fwd = {
+                    k: v
+                    for k, v in self.headers.items()
+                    if k not in _HOP_HEADERS
+                }
+                try:
+                    c, reused = _pooled_conn(worker.lead, 30.0)
+                    try:
+                        c.send_request(self.command, self.path, body, fwd)
+                        status, rheaders, data, will_close = c.read_response(
+                            self.command
+                        )
+                    except OSError:
+                        _drop_conn(worker.lead)
+                        if not reused:
+                            raise
+                        c, _ = _pooled_conn(worker.lead, 30.0)
+                        c.send_request(self.command, self.path, body, fwd)
+                        status, rheaders, data, will_close = c.read_response(
+                            self.command
+                        )
+                    if will_close:
+                        _drop_conn(worker.lead)
+                except OSError as e:
+                    return self._json({"error": f"lead unreachable: {e}"}, 502)
+                out = {
+                    k: v for k, v in rheaders.items() if k not in _HOP_HEADERS
+                }
+                self.fast_reply(status, data, out)
+
+            do_POST = _proxy
+            do_DELETE = _proxy
+            do_PUT = _proxy
+
+        return Handler
+
+    # --- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        from seaweedfs_tpu.util.httpd import ReusePortWeedHTTPServer
+
+        handler = self._make_handler()
+        srv = ReusePortWeedHTTPServer((self.host, self.port), handler)
+        self._servers.append(srv)
+        if self.worker_port:
+            self._servers.append(
+                WeedHTTPServer((self.host, self.worker_port), handler)
+            )
+        for s in self._servers:
+            t = threading.Thread(target=s.serve_forever, daemon=True)
+            t.start()
+            self._threads.append(t)
+        wlog.info(
+            "volume read worker on %s:%d (lead %s)", self.host, self.port, self.lead
+        )
+
+    def stop(self) -> None:
+        for s in self._servers:
+            s.shutdown()
+            s.server_close()
+        self._servers.clear()
+        for v in list(self._volumes.values()):
+            try:
+                v.close()
+            except OSError:
+                pass
+        self._volumes.clear()
+
+
+def spawn_read_workers(
+    n: int,
+    directories: list[str],
+    host: str,
+    port: int,
+    lead_internal: str,
+    worker_port_base: int = 0,
+) -> list:
+    """Lead-side helper: launch n worker subprocesses sharing host:port.
+    Returns the Popen handles (terminate them on shutdown)."""
+    import subprocess
+    import sys
+
+    procs = []
+    for k in range(n):
+        cmd = [
+            sys.executable,
+            "-m",
+            "seaweedfs_tpu",
+            "volume.worker",
+            "-ip",
+            host,
+            "-port",
+            str(port),
+            "-dir",
+            ",".join(directories),
+            "-lead",
+            lead_internal,
+        ]
+        if worker_port_base:
+            cmd += ["-workerPort", str(worker_port_base + k)]
+        procs.append(subprocess.Popen(cmd))
+    return procs

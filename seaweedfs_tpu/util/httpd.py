@@ -194,3 +194,11 @@ class WeedHTTPServer(ThreadingHTTPServer):
         sock, addr = super().get_request()
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, True)
         return sock, addr
+
+
+class ReusePortWeedHTTPServer(WeedHTTPServer):
+    """SO_REUSEPORT listener for per-core worker processes sharing one
+    host:port (`volume -workers N`); every binder of the port must set
+    the option, so lead and workers use this same class."""
+
+    allow_reuse_port = True

@@ -383,3 +383,117 @@ class TestMetricsPushPlumbing:
             master.stop()
             gw.shutdown()
             gw.server_close()
+
+
+class TestMultipartParser:
+    """From-scratch multipart/form-data parser (util/multipart.py) —
+    the ParseUpload role (needle.go:85): first file part wins, raw
+    bodies pass through, boundary bytes inside payloads stay intact."""
+
+    CT = "multipart/form-data; boundary=bndX"
+
+    @staticmethod
+    def _mp(*parts):
+        out = b""
+        for headers, payload in parts:
+            out += b"--bndX\r\n" + headers + b"\r\n\r\n" + payload + b"\r\n"
+        return out + b"--bndX--\r\n"
+
+    def test_file_part_with_mime(self):
+        from seaweedfs_tpu.util.multipart import parse_upload
+
+        body = self._mp(
+            (
+                b'Content-Disposition: form-data; name="file"; '
+                b'filename="a.txt"\r\nContent-Type: text/plain',
+                b"hello",
+            )
+        )
+        p = parse_upload(body, self.CT)
+        assert (p.data, p.filename, p.mime) == (b"hello", "a.txt", "text/plain")
+
+    def test_file_part_preferred_over_fields(self):
+        from seaweedfs_tpu.util.multipart import parse_upload
+
+        body = self._mp(
+            (b'Content-Disposition: form-data; name="k"', b"v"),
+            (
+                b'Content-Disposition: form-data; name="file"; filename="b.bin"',
+                b"\x00\x01\r\n\x02",
+            ),
+        )
+        p = parse_upload(body, self.CT)
+        assert (p.data, p.filename) == (b"\x00\x01\r\n\x02", "b.bin")
+
+    def test_first_field_when_no_file(self):
+        from seaweedfs_tpu.util.multipart import parse_upload
+
+        body = self._mp(
+            (b'Content-Disposition: form-data; name="k"', b"value1"),
+            (b'Content-Disposition: form-data; name="j"', b"value2"),
+        )
+        assert parse_upload(body, self.CT).data == b"value1"
+        # quoted boundary spelling
+        q = 'multipart/form-data; boundary="bndX"'
+        assert parse_upload(body, q).data == b"value1"
+
+    def test_raw_body_passthrough(self):
+        from seaweedfs_tpu.util.multipart import parse_upload
+
+        p = parse_upload(b"raw", "application/octet-stream")
+        assert p.data == b"raw" and p.mime == "application/octet-stream"
+
+    def test_base64_transfer_encoding(self):
+        import base64
+
+        from seaweedfs_tpu.util.multipart import parse_upload
+
+        body = self._mp(
+            (
+                b'Content-Disposition: form-data; name="file"; filename="c"'
+                b"\r\nContent-Transfer-Encoding: base64",
+                base64.b64encode(b"decoded!"),
+            )
+        )
+        assert parse_upload(body, self.CT).data == b"decoded!"
+
+    def test_boundary_bytes_inside_payload_survive(self):
+        from seaweedfs_tpu.util.multipart import parse_upload
+
+        tricky = b"data --bndX mid-line and\r\n --bndX with space"
+        body = self._mp(
+            (b'Content-Disposition: form-data; name="file"; filename="t"', tricky)
+        )
+        assert parse_upload(body, self.CT).data == tricky
+        # preamble before the first delimiter is skipped (RFC 2046)
+        assert parse_upload(b"preamble\r\n" + body, self.CT).data == tricky
+        # line-anchored but trailing-garbage boundary runs are DATA: a
+        # delimiter line must end in padding+CRLF (or "--" + padding)
+        for inner in (
+            b"A\r\n--bndXtra not a delimiter\r\nB",
+            b"A\r\n--bndX--data after\r\nB",
+        ):
+            body = self._mp(
+                (
+                    b'Content-Disposition: form-data; name="file"; filename="t"',
+                    inner,
+                )
+            )
+            assert parse_upload(body, self.CT).data == inner
+        # transport padding after the boundary is still a delimiter
+        body = (
+            b"--bndX  \t\r\n"
+            b'Content-Disposition: form-data; name="file"; filename="p"'
+            b"\r\n\r\npadded\r\n--bndX--\r\n"
+        )
+        assert parse_upload(body, self.CT).data == b"padded"
+
+    def test_malformed_raises(self):
+        import pytest as _pytest
+
+        from seaweedfs_tpu.util.multipart import MalformedUpload, parse_upload
+
+        with _pytest.raises(MalformedUpload):
+            parse_upload(b"no boundary in here", self.CT)
+        with _pytest.raises(MalformedUpload):
+            parse_upload(b"x", "multipart/form-data")

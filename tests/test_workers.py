@@ -1,0 +1,247 @@
+"""`volume -workers N` SO_REUSEPORT read workers (server/volume_workers.py).
+
+The lead stays the single writer (the reference's per-volume write
+ordering, volume_read_write.go:66); workers serve GET/HEAD from the
+shared directories with `.idx` tail-replay freshness and proxy
+everything else to the lead's internal listener.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.server.volume_workers import SharedReadVolume, VolumeReadWorker
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import NeedleNotFound, Volume
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestSharedReadVolume:
+    def _needle(self, nid: int, data: bytes) -> Needle:
+        n = Needle(cookie=0x42, id=nid, data=data)
+        n.name = b"w.bin"
+        n.set_has_name()
+        return n
+
+    def test_sees_writes_made_after_open(self, tmp_path):
+        owner = Volume(str(tmp_path), 5)
+        owner.write_needle(self._needle(1, b"first"))
+        reader = SharedReadVolume(str(tmp_path), 5)
+        assert reader.read_needle(1, cookie=0x42).data == b"first"
+        # writes landing AFTER the reader opened must become visible
+        # (idx tail replay — read-your-writes across processes)
+        owner.write_needle(self._needle(2, b"second"))
+        assert reader.read_needle(2, cookie=0x42).data == b"second"
+        # overwrite: the reader must serve the new version
+        owner.write_needle(self._needle(1, b"first-v2"))
+        assert reader.read_needle(1, cookie=0x42).data == b"first-v2"
+
+    def test_sees_deletes(self, tmp_path):
+        owner = Volume(str(tmp_path), 6)
+        owner.write_needle(self._needle(1, b"doomed"))
+        reader = SharedReadVolume(str(tmp_path), 6)
+        assert reader.read_needle(1).data == b"doomed"
+        owner.delete_needle(Needle(cookie=0x42, id=1))
+        with pytest.raises(NeedleNotFound):
+            reader.read_needle(1)
+
+    def test_survives_vacuum_commit(self, tmp_path):
+        owner = Volume(str(tmp_path), 7)
+        for i in range(1, 6):
+            owner.write_needle(self._needle(i, b"x%d" % i))
+        owner.delete_needle(Needle(cookie=0x42, id=2))
+        reader = SharedReadVolume(str(tmp_path), 7)
+        assert reader.read_needle(3).data == b"x3"
+        owner.compact()
+        owner.commit_compact()
+        # new inode pair: the reader reopens and keeps serving
+        assert reader.read_needle(3).data == b"x3"
+        with pytest.raises(NeedleNotFound):
+            reader.read_needle(2)
+        # post-vacuum writes flow through the reopened index
+        owner.write_needle(self._needle(9, b"after-vacuum"))
+        assert reader.read_needle(9).data == b"after-vacuum"
+
+
+class TestVolumeReadWorker:
+    @pytest.fixture(scope="class")
+    def stack(self, tmp_path_factory):
+        mport, vport, wport = free_port(), free_port(), free_port()
+        iport = free_port()
+        master = MasterServer(port=mport)
+        master.start()
+        vdir = str(tmp_path_factory.mktemp("wvol"))
+        lead = VolumeServer(
+            [vdir],
+            port=vport,
+            master=f"127.0.0.1:{mport}",
+            heartbeat_interval=0.2,
+            internal_port=iport,
+        )
+        lead.start()
+        deadline = time.time() + 20
+        while time.time() < deadline and not master.topology.data_nodes():
+            time.sleep(0.05)
+        worker = VolumeReadWorker(
+            [vdir],
+            host="127.0.0.1",
+            port=free_port(),  # its own shared-port stand-in
+            lead=f"127.0.0.1:{iport}",
+            worker_port=wport,
+        )
+        worker.start()
+        yield master, lead, worker, mport, vport, wport
+        worker.stop()
+        lead.stop()
+        master.stop()
+
+    def _assign(self, mport):
+        import json
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{mport}/dir/assign"
+        ) as r:
+            return json.load(r)
+
+    def test_worker_serves_lead_writes(self, stack):
+        master, lead, worker, mport, vport, wport = stack
+        a = self._assign(mport)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{vport}/{a['fid']}?filename=t.txt",
+            data=b"through the lead",
+            method="POST",
+        )
+        urllib.request.urlopen(req).read()
+        # read via the WORKER port: local fast path, not the lead
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{wport}/{a['fid']}"
+        ) as r:
+            assert r.read() == b"through the lead"
+            assert r.headers.get("ETag")
+
+    def test_worker_proxies_writes_to_lead(self, stack):
+        master, lead, worker, mport, vport, wport = stack
+        a = self._assign(mport)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{wport}/{a['fid']}",
+            data=b"written via worker proxy",
+            method="POST",
+        )
+        body = urllib.request.urlopen(req).read()
+        assert b"eTag" in body
+        # and the lead really owns it
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{vport}/{a['fid']}"
+        ) as r:
+            assert r.read() == b"written via worker proxy"
+
+    def test_worker_read_your_write_after_proxy(self, stack):
+        master, lead, worker, mport, vport, wport = stack
+        a = self._assign(mport)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{wport}/{a['fid']}",
+            data=b"immediately visible",
+            method="POST",
+        )
+        urllib.request.urlopen(req).read()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{wport}/{a['fid']}"
+        ) as r:
+            assert r.read() == b"immediately visible"
+
+    def test_worker_proxies_deletes_and_sees_tombstone(self, stack):
+        master, lead, worker, mport, vport, wport = stack
+        a = self._assign(mport)
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{wport}/{a['fid']}",
+                data=b"doomed",
+                method="POST",
+            )
+        ).read()
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{wport}/{a['fid']}", method="DELETE"
+            )
+        ).read()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{wport}/{a['fid']}")
+        assert ei.value.code == 404
+
+    def test_worker_proxies_status_pages(self, stack):
+        master, lead, worker, mport, vport, wport = stack
+        with urllib.request.urlopen(f"http://127.0.0.1:{wport}/status") as r:
+            assert b"Volumes" in r.read()
+
+    def test_worker_range_and_304(self, stack):
+        master, lead, worker, mport, vport, wport = stack
+        a = self._assign(mport)
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{vport}/{a['fid']}",
+                data=b"0123456789",
+                method="POST",
+            )
+        ).read()
+        req = urllib.request.Request(f"http://127.0.0.1:{wport}/{a['fid']}")
+        req.add_header("Range", "bytes=2-5")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 206 and r.read() == b"2345"
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{wport}/{a['fid']}"
+        ) as r:
+            etag = r.headers["ETag"]
+        req = urllib.request.Request(f"http://127.0.0.1:{wport}/{a['fid']}")
+        req.add_header("If-None-Match", etag)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 304
+
+    def test_concurrent_mixed_load(self, stack):
+        """Writes proxied + reads served locally under concurrency —
+        the worker must never serve stale or torn data."""
+        master, lead, worker, mport, vport, wport = stack
+        errors = []
+
+        def one(i):
+            try:
+                a = self._assign(mport)
+                payload = b"payload-%d" % i
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://127.0.0.1:{wport}/{a['fid']}",
+                        data=payload,
+                        method="POST",
+                    )
+                ).read()
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{wport}/{a['fid']}"
+                ) as r:
+                    got = r.read()
+                if got != payload:
+                    errors.append((i, got, payload))
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, repr(e)))
+
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(24)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
