@@ -33,6 +33,9 @@ import stat as stat_mod
 import struct
 import threading
 
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
 from seaweedfs_tpu.filesys.mount import MountedFileSystem, OpenFile
 from seaweedfs_tpu.filesys.nodes import NotEmpty, NotFound
 from seaweedfs_tpu.util import wlog
@@ -95,8 +98,31 @@ def kernel_fuse_available() -> bool:
     return True
 
 
+class _NodeStrand:
+    """FIFO of pending requests for one nodeid (the per-node ordered
+    queue that keeps concurrent dispatch safe: ops on the same node —
+    WRITE sequences on a file, LOOKUP vs UNLINK on a name — run in
+    arrival order, while different nodes run in parallel)."""
+
+    __slots__ = ("queue", "active")
+
+    def __init__(self):
+        self.queue: deque = deque()
+        self.active = False
+
+
 class KernelFuseMount:
-    """One kernel mountpoint served by a MountedFileSystem."""
+    """One kernel mountpoint served by a MountedFileSystem.
+
+    Requests are decoded on the reader thread and dispatched onto a
+    small thread pool (bazil.org/fuse spawns a goroutine per request
+    behind the reference's wfs, fs/serve.go — same concurrency model,
+    bounded): a READ blocked on a chunk fetch over HTTP no longer
+    stalls an unrelated GETATTR. Per-nodeid strands keep same-node
+    ordering; FORGET/BATCH_FORGET mutate only the node tables and run
+    inline on the reader thread under the same lock the pool uses."""
+
+    POOL_WORKERS = 8
 
     def __init__(self, mfs: MountedFileSystem, mountpoint: str):
         self.mfs = mfs
@@ -111,6 +137,11 @@ class KernelFuseMount:
         self._next_fh = 1
         self._alive = False
         self._thread: threading.Thread | None = None
+        # concurrency plumbing (see class docstring)
+        self._maps_lock = threading.RLock()  # node/handle table guard
+        self._strands: dict[int, _NodeStrand] = {}
+        self._strand_lock = threading.Lock()
+        self._pool: ThreadPoolExecutor | None = None
 
     # --- mount / unmount --------------------------------------------------
     def mount(self) -> None:
@@ -143,10 +174,14 @@ class KernelFuseMount:
         # close while the thread may still enter os.read would race the
         # fd number being recycled into an unrelated descriptor
         libc.umount2(self.mountpoint.encode(), MNT_DETACH)
+        stuck = False
         if self._thread is not None:
             self._thread.join(timeout=10)
+            stuck = self._thread.is_alive()
             self._thread = None
-        if self._fd >= 0:
+        if self._fd >= 0 and not stuck:
+            # a stuck serve thread (wedged backend RPC) keeps the fd
+            # leaked rather than closed under it — see serve_forever
             try:
                 os.close(self._fd)
             except OSError:
@@ -160,47 +195,86 @@ class KernelFuseMount:
     # --- request loop -----------------------------------------------------
     def serve_forever(self) -> None:
         bufsize = _MAX_WRITE + 4096
-        while self._alive:
-            try:
-                req = os.read(self._fd, bufsize)
-            except OSError as e:
-                if e.errno == errno.ENODEV:  # unmounted
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.POOL_WORKERS, thread_name_prefix="fuse"
+        )
+        try:
+            while self._alive:
+                try:
+                    req = os.read(self._fd, bufsize)
+                except OSError as e:
+                    if e.errno == errno.ENODEV:  # unmounted
+                        break
+                    if e.errno in (errno.EINTR, errno.EAGAIN):
+                        continue
                     break
-                if e.errno in (errno.EINTR, errno.EAGAIN):
+                if len(req) < _IN_HDR.size:
                     continue
-                break
-            if len(req) < _IN_HDR.size:
-                continue
-            (_len, opcode, unique, nodeid, uid, gid, _pid, _pad) = _IN_HDR.unpack_from(
-                req
-            )
-            body = req[_IN_HDR.size : _len]
-            try:
-                out = self._dispatch(opcode, nodeid, body)
-            except NotFound:
-                out = -errno.ENOENT
-            except NotEmpty:
-                out = -errno.ENOTEMPTY
-            except FileExistsError:
-                out = -errno.EEXIST
-            except IsADirectoryError:
-                out = -errno.EISDIR
-            except KeyError:
-                out = -errno.ENOENT
-            except OSError as e:
-                out = -(e.errno or errno.EIO)
-            except Exception as e:  # noqa: BLE001 — a 500 is EIO, not a crash
-                wlog.warning("fuse op %d failed: %s", opcode, e)
-                out = -errno.EIO
-            if opcode in _NO_REPLY:
-                continue
-            if opcode == DESTROY:
-                self._reply(unique, b"")
-                break
-            if isinstance(out, int):
-                self._reply_err(unique, out)
-            else:
-                self._reply(unique, out)
+                (_len, opcode, unique, nodeid, uid, gid, _pid, _pad) = (
+                    _IN_HDR.unpack_from(req)
+                )
+                body = req[_IN_HDR.size : _len]
+                if opcode in _NO_REPLY or opcode in (INIT, DESTROY):
+                    # node-table-only (or handshake) ops: inline on the
+                    # reader thread, under the same lock the pool uses
+                    self._handle_one(opcode, nodeid, unique, body)
+                    if opcode == DESTROY:
+                        break
+                    continue
+                self._enqueue(nodeid, (opcode, nodeid, unique, body))
+        finally:
+            # drain in-flight handlers BEFORE unmount() may close the
+            # fuse fd: a pending _reply on a recycled fd number would
+            # write into an unrelated descriptor
+            self._pool.shutdown(wait=True)
+
+    # --- concurrent dispatch (per-nodeid strands) --------------------------
+    def _enqueue(self, nodeid: int, item: tuple) -> None:
+        with self._strand_lock:
+            strand = self._strands.get(nodeid)
+            if strand is None:
+                strand = self._strands[nodeid] = _NodeStrand()
+            strand.queue.append(item)
+            if strand.active:
+                return  # the draining worker will pick it up
+            strand.active = True
+        self._pool.submit(self._drain_strand, nodeid, strand)
+
+    def _drain_strand(self, nodeid: int, strand: _NodeStrand) -> None:
+        while True:
+            with self._strand_lock:
+                if not strand.queue:
+                    strand.active = False
+                    if self._strands.get(nodeid) is strand:
+                        del self._strands[nodeid]
+                    return
+                item = strand.queue.popleft()
+            self._handle_one(*item)
+
+    def _handle_one(self, opcode: int, nodeid: int, unique: int, body: bytes) -> None:
+        try:
+            out = self._dispatch(opcode, nodeid, body)
+        except NotFound:
+            out = -errno.ENOENT
+        except NotEmpty:
+            out = -errno.ENOTEMPTY
+        except FileExistsError:
+            out = -errno.EEXIST
+        except IsADirectoryError:
+            out = -errno.EISDIR
+        except KeyError:
+            out = -errno.ENOENT
+        except OSError as e:
+            out = -(e.errno or errno.EIO)
+        except Exception as e:  # noqa: BLE001 — a 500 is EIO, not a crash
+            wlog.warning("fuse op %d failed: %s", opcode, e)
+            out = -errno.EIO
+        if opcode in _NO_REPLY:
+            return
+        if isinstance(out, int):
+            self._reply_err(unique, out)
+        else:
+            self._reply(unique, out)
 
     def _reply(self, unique: int, payload: bytes) -> None:
         try:
@@ -219,16 +293,18 @@ class KernelFuseMount:
 
     # --- node bookkeeping ---------------------------------------------------
     def _path(self, nodeid: int) -> str:
-        return self._nodes[nodeid]
+        with self._maps_lock:
+            return self._nodes[nodeid]
 
     def _node_for(self, path: str) -> int:
-        nid = self._ids.get(path)
-        if nid is None:
-            nid = self._next_node
-            self._next_node += 1
-            self._ids[path] = nid
-            self._nodes[nid] = path
-        return nid
+        with self._maps_lock:
+            nid = self._ids.get(path)
+            if nid is None:
+                nid = self._next_node
+                self._next_node += 1
+                self._ids[path] = nid
+                self._nodes[nid] = path
+            return nid
 
     def _child(self, nodeid: int, name: str) -> str:
         parent = self._path(nodeid)
@@ -237,12 +313,13 @@ class KernelFuseMount:
     def _rekey(self, old: str, new: str) -> None:
         """Rename moved a subtree: remap every known path under it."""
         prefix = old.rstrip("/") + "/"
-        for nid, p in list(self._nodes.items()):
-            if p == old or p.startswith(prefix):
-                np = new + p[len(old) :]
-                del self._ids[p]
-                self._ids[np] = nid
-                self._nodes[nid] = np
+        with self._maps_lock:
+            for nid, p in list(self._nodes.items()):
+                if p == old or p.startswith(prefix):
+                    np = new + p[len(old) :]
+                    del self._ids[p]
+                    self._ids[np] = nid
+                    self._nodes[nid] = np
 
     # --- attr marshalling ---------------------------------------------------
     def _attr_bytes(self, path: str, nodeid: int) -> bytes:
@@ -276,10 +353,14 @@ class KernelFuseMount:
         )
 
     def _entry_out(self, path: str) -> bytes:
-        nid = self._node_for(path)
-        # each entry reply the kernel keeps counts as one lookup; the
+        # node creation and the lookup-count bump must be ONE critical
+        # section: an inline FORGET interleaving between them could
+        # reclaim the nodeid while this reply hands it to the kernel.
+        # Each entry reply the kernel keeps counts as one lookup; the
         # matching FORGET(nlookup) releases them (bazil fs NodeRef role)
-        self._nlookup[nid] = self._nlookup.get(nid, 0) + 1
+        with self._maps_lock:
+            nid = self._node_for(path)
+            self._nlookup[nid] = self._nlookup.get(nid, 0) + 1
         return (
             _ENTRY_OUT.pack(nid, 0, _TTL_SEC, _TTL_SEC, 0, 0)
             + self._attr_bytes(path, nid)
@@ -288,14 +369,15 @@ class KernelFuseMount:
     def _forget(self, nodeid: int, nlookup: int) -> None:
         if nodeid == 1:
             return
-        left = self._nlookup.get(nodeid, 0) - nlookup
-        if left > 0:
-            self._nlookup[nodeid] = left
-            return
-        self._nlookup.pop(nodeid, None)
-        path = self._nodes.pop(nodeid, None)
-        if path is not None and self._ids.get(path) == nodeid:
-            del self._ids[path]
+        with self._maps_lock:
+            left = self._nlookup.get(nodeid, 0) - nlookup
+            if left > 0:
+                self._nlookup[nodeid] = left
+                return
+            self._nlookup.pop(nodeid, None)
+            path = self._nodes.pop(nodeid, None)
+            if path is not None and self._ids.get(path) == nodeid:
+                del self._ids[path]
 
     def _attr_out(self, path: str, nodeid: int) -> bytes:
         return struct.pack("<QII", _TTL_SEC, 0, 0) + self._attr_bytes(path, nodeid)
@@ -373,8 +455,13 @@ class KernelFuseMount:
                 return -errno.EINVAL  # EXCHANGE/WHITEOUT unsupported
             if rflags & RENAME_NOREPLACE and self.mfs.exists(new):
                 return -errno.EEXIST
-            self.mfs.rename(old, new)
-            self._rekey(old, new)
+            # rename + table rekey are one critical section so no
+            # concurrent op resolves a nodeid to the stale path between
+            # them (ops that resolved earlier match bazil's model: the
+            # kernel's VFS rename locking shields path resolution)
+            with self._maps_lock:
+                self.mfs.rename(old, new)
+                self._rekey(old, new)
             return b""
         if opcode in (OPEN, OPENDIR):
             flags, _ = _OPEN_IN.unpack_from(body)
@@ -446,9 +533,10 @@ class KernelFuseMount:
                 f = self.mfs.open(path, "r+")
             else:
                 f = self.mfs.open(path, "w")
-            fh = self._next_fh
-            self._next_fh += 1
-            self._handles[fh] = f
+            with self._maps_lock:
+                fh = self._next_fh
+                self._next_fh += 1
+                self._handles[fh] = f
             return self._entry_out(path) + _OPEN_OUT.pack(fh, 0, 0)
         if opcode == SETXATTR:
             xattr_hdr = struct.Struct("<II")
@@ -496,16 +584,22 @@ class KernelFuseMount:
 
     def _open(self, opcode: int, nodeid: int, flags: int):
         path = self._path(nodeid)
-        fh = self._next_fh
-        self._next_fh += 1
         if opcode == OPENDIR:
-            self._dirbufs[fh] = self._dirents(nodeid)
+            buf = self._dirents(nodeid)
+            with self._maps_lock:
+                fh = self._next_fh
+                self._next_fh += 1
+                self._dirbufs[fh] = buf
             return _OPEN_OUT.pack(fh, 0, 0)
         acc = flags & os.O_ACCMODE
         if flags & os.O_TRUNC:
             self.mfs.truncate(path, 0)
         mode = "r" if acc == os.O_RDONLY else "r+"
-        self._handles[fh] = self.mfs.open(path, mode)
+        f = self.mfs.open(path, mode)
+        with self._maps_lock:
+            fh = self._next_fh
+            self._next_fh += 1
+            self._handles[fh] = f
         return _OPEN_OUT.pack(fh, 0, 0)
 
     def _setattr(self, nodeid: int, body: bytes):
