@@ -300,7 +300,7 @@ class FilerCommand(Command):
         p.add_argument("-port", type=int, default=8888)
         p.add_argument("-master", default="127.0.0.1:9333")
         p.add_argument(
-            "-store", default="memory", help="memory | sqlite | sortedlog | lsm"
+            "-store", default="memory", help="memory | sqlite | sql | sortedlog | lsm | redis | cassandra | etcd | tikv | mysql | postgres"
         )
         p.add_argument("-storePath", default="")
         p.add_argument("-collection", default="")

@@ -298,6 +298,11 @@ def new_store(kind: str, path: str = "") -> FilerStore:
         from seaweedfs_tpu.filer.etcd_store import EtcdFilerStore
 
         return EtcdFilerStore(path or "localhost:2379")
+    if kind == "tikv":
+        # raw-KV gRPC store via PD routing, gated on connectivity
+        from seaweedfs_tpu.filer.tikv_store import TikvStore
+
+        return TikvStore(path or "localhost:2379")
     if kind == "sortedlog":
         if not path:
             raise ValueError("sortedlog store needs a path")
@@ -310,10 +315,8 @@ def new_store(kind: str, path: str = "") -> FilerStore:
         return LsmStore(path)
     raise ValueError(
         f"unknown filer store {kind!r}: embedded kinds are memory | sqlite"
-        " | sql | sortedlog | lsm; redis (RESP), cassandra (CQL v4) and etcd (v3"
-        " gateway REST) speak their wire protocols to a live server (path ="
-        " 'host:port'); mysql | postgres speak the reference SQL"
-        " dialects but need their client libraries (see"
-        " filer/abstract_sql.py); tikv has no in-image counterpart —"
-        " use an embedded store"
+        " | sql | sortedlog | lsm; redis (RESP), cassandra (CQL v4), etcd (v3"
+        " gateway REST), tikv (raw-KV gRPC via PD), mysql and postgres"
+        " (their own wire protocols) all speak to a live server"
+        " (path = 'host:port' / PD address / DSN)"
     )

@@ -3,4 +3,4 @@
 # The gRPC service layer is hand-bound in rpc.py, so only --python_out
 # is needed (no grpc_tools in this environment).
 set -e
-protoc --python_out=. seaweedfs_tpu/pb/master.proto seaweedfs_tpu/pb/volume.proto
+protoc --python_out=. seaweedfs_tpu/pb/master.proto seaweedfs_tpu/pb/volume.proto seaweedfs_tpu/pb/tikv.proto

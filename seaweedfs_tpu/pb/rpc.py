@@ -281,3 +281,40 @@ def volume_stub(channel: grpc.Channel) -> Stub:
 
 def filer_stub(channel: grpc.Channel) -> Stub:
     return Stub(channel, FILER_SERVICE, FILER_METHODS)
+
+
+# --- TiKV raw-KV + PD routing (pingcap/kvproto wire) ------------------------
+# Service full names are the REAL kvproto ones so these stubs speak to
+# an actual PD/TiKV deployment; messages live in tikv.proto (semantic
+# clone with kvproto field numbers). Used by filer/tikv_store.py and
+# served offline by tests/cloud_fakes.FakeTikv.
+
+from seaweedfs_tpu.pb import tikv_pb2 as tk
+
+PD_SERVICE = "pdpb.PD"
+PD_METHODS = {
+    "GetMembers": (tk.GetMembersRequest, tk.GetMembersResponse, UNARY_UNARY),
+    "GetRegion": (tk.GetRegionRequest, tk.GetRegionResponse, UNARY_UNARY),
+    "GetStore": (tk.GetStoreRequest, tk.GetStoreResponse, UNARY_UNARY),
+}
+
+TIKV_SERVICE = "tikvpb.Tikv"
+TIKV_METHODS = {
+    "RawGet": (tk.RawGetRequest, tk.RawGetResponse, UNARY_UNARY),
+    "RawPut": (tk.RawPutRequest, tk.RawPutResponse, UNARY_UNARY),
+    "RawDelete": (tk.RawDeleteRequest, tk.RawDeleteResponse, UNARY_UNARY),
+    "RawDeleteRange": (
+        tk.RawDeleteRangeRequest,
+        tk.RawDeleteRangeResponse,
+        UNARY_UNARY,
+    ),
+    "RawScan": (tk.RawScanRequest, tk.RawScanResponse, UNARY_UNARY),
+}
+
+
+def pd_stub(channel: grpc.Channel) -> Stub:
+    return Stub(channel, PD_SERVICE, PD_METHODS)
+
+
+def tikv_stub(channel: grpc.Channel) -> Stub:
+    return Stub(channel, TIKV_SERVICE, TIKV_METHODS)

@@ -1295,3 +1295,145 @@ class FakeMysql:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+
+
+class FakeTikv:
+    """One-region TiKV + PD on a single gRPC port: serves the pdpb.PD
+    routing verbs (GetMembers/GetRegion/GetStore) and the tikvpb.Tikv
+    raw-KV verbs against an in-memory ordered map — the offline stand-in
+    for a real PD+TiKV deployment (filer/tikv_store.py client)."""
+
+    CLUSTER_ID = 7881
+    REGION_ID = 2
+    STORE_ID = 1
+    PEER_ID = 3
+
+    def __init__(self):
+        import grpc
+        from concurrent import futures as _futures
+
+        from seaweedfs_tpu.pb import rpc as _rpc
+
+        self.kv: dict[bytes, bytes] = {}
+        self.epoch_version = 1  # bump to force client region refresh
+        self.fail_next_with_region_error = 0  # injected staleness
+        self._server = grpc.server(_futures.ThreadPoolExecutor(max_workers=4))
+        self._server.add_generic_rpc_handlers(
+            (
+                _rpc.servicer_handler(_rpc.PD_SERVICE, _rpc.PD_METHODS, self),
+                _rpc.servicer_handler(_rpc.TIKV_SERVICE, _rpc.TIKV_METHODS, self),
+            )
+        )
+        self._port = self._server.add_insecure_port("127.0.0.1:0")
+        self.address = f"127.0.0.1:{self._port}"
+
+    # --- pdpb.PD ----------------------------------------------------------
+    def _t(self):
+        from seaweedfs_tpu.pb import tikv_pb2 as t
+
+        return t
+
+    def GetMembers(self, req, context):
+        t = self._t()
+        m = t.Member(
+            name="pd-fake", member_id=1, client_urls=[f"http://{self.address}"]
+        )
+        return t.GetMembersResponse(
+            header=t.ResponseHeader(cluster_id=self.CLUSTER_ID),
+            members=[m],
+            leader=m,
+        )
+
+    def _region(self):
+        t = self._t()
+        return t.Region(
+            id=self.REGION_ID,
+            start_key=b"",
+            end_key=b"",
+            region_epoch=t.RegionEpoch(conf_ver=1, version=self.epoch_version),
+            peers=[t.Peer(id=self.PEER_ID, store_id=self.STORE_ID)],
+        )
+
+    def GetRegion(self, req, context):
+        t = self._t()
+        return t.GetRegionResponse(
+            header=t.ResponseHeader(cluster_id=self.CLUSTER_ID),
+            region=self._region(),
+            leader=t.Peer(id=self.PEER_ID, store_id=self.STORE_ID),
+        )
+
+    def GetStore(self, req, context):
+        t = self._t()
+        return t.GetStoreResponse(
+            header=t.ResponseHeader(cluster_id=self.CLUSTER_ID),
+            store=t.Store(id=self.STORE_ID, address=self.address),
+        )
+
+    # --- tikvpb.Tikv raw-KV ----------------------------------------------
+    def _check_ctx(self, req):
+        """Region-epoch staleness, as a real TiKV would report it."""
+        t = self._t()
+        if self.fail_next_with_region_error > 0:
+            self.fail_next_with_region_error -= 1
+            return t.RegionError(message="epoch_not_match (injected)")
+        if (
+            req.context.region_id != self.REGION_ID
+            or req.context.region_epoch.version != self.epoch_version
+        ):
+            return t.RegionError(message="epoch_not_match")
+        return None
+
+    def RawGet(self, req, context):
+        t = self._t()
+        err = self._check_ctx(req)
+        if err:
+            return t.RawGetResponse(region_error=err)
+        v = self.kv.get(bytes(req.key))
+        if v is None:
+            return t.RawGetResponse(not_found=True)
+        return t.RawGetResponse(value=v)
+
+    def RawPut(self, req, context):
+        t = self._t()
+        err = self._check_ctx(req)
+        if err:
+            return t.RawPutResponse(region_error=err)
+        self.kv[bytes(req.key)] = bytes(req.value)
+        return t.RawPutResponse()
+
+    def RawDelete(self, req, context):
+        t = self._t()
+        err = self._check_ctx(req)
+        if err:
+            return t.RawDeleteResponse(region_error=err)
+        self.kv.pop(bytes(req.key), None)
+        return t.RawDeleteResponse()
+
+    def RawDeleteRange(self, req, context):
+        t = self._t()
+        err = self._check_ctx(req)
+        if err:
+            return t.RawDeleteRangeResponse(region_error=err)
+        start, end = bytes(req.start_key), bytes(req.end_key)
+        for k in [k for k in self.kv if start <= k and (not end or k < end)]:
+            del self.kv[k]
+        return t.RawDeleteRangeResponse()
+
+    def RawScan(self, req, context):
+        t = self._t()
+        err = self._check_ctx(req)
+        if err:
+            return t.RawScanResponse(region_error=err)
+        start, end = bytes(req.start_key), bytes(req.end_key)
+        hits = sorted(
+            k for k in self.kv if k >= start and (not end or k < end)
+        )[: req.limit or 256]
+        return t.RawScanResponse(
+            kvs=[t.KvPair(key=k, value=self.kv[k]) for k in hits]
+        )
+
+    def start(self):
+        self._server.start()
+
+    def stop(self):
+        self._server.stop(grace=0.2)

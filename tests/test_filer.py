@@ -262,6 +262,13 @@ def _etcd_factory():
     return _FakeBackedFactory(FakeEtcd, lambda f: EtcdFilerStore(f.endpoint))
 
 
+def _tikv_factory():
+    from seaweedfs_tpu.filer.tikv_store import TikvStore
+    from tests.cloud_fakes import FakeTikv
+
+    return _FakeBackedFactory(FakeTikv, lambda f: TikvStore(f.address))
+
+
 def _mysql_factory():
     from seaweedfs_tpu.filer.abstract_sql import new_mysql_store
     from tests.cloud_fakes import FakeMysql
@@ -299,10 +306,11 @@ def _postgres_factory():
         _etcd_factory(),
         _postgres_factory(),
         _mysql_factory(),
+        _tikv_factory(),
     ],
     ids=[
         "memory", "sqlite", "sortedlog", "lsm", "sql", "redis",
-        "cassandra", "etcd", "postgres", "mysql",
+        "cassandra", "etcd", "postgres", "mysql", "tikv",
     ],
 )
 class TestFilerStores:
@@ -459,8 +467,9 @@ class TestAbstractSql:
                 )
         finally:
             fpg.stop()
-        with pytest.raises(ValueError, match="tikv"):
-            new_store("tikv")
+        # tikv gates on PD connectivity like the others
+        with pytest.raises(RuntimeError, match="cannot reach PD"):
+            new_store("tikv", "127.0.0.1:1")
 
     def test_insert_degrades_to_update_on_duplicate(self, tmp_path):
         from seaweedfs_tpu.filer.filerstore import new_store
@@ -1135,3 +1144,85 @@ class TestChunkAlgebraProperty:
                 expect[p] = owner[p][1]
                 p += 1
             assert seen == expect, f"span [{off},{off + size})"
+
+
+class TestTikvStore:
+    """tikv-specific behaviors beyond the conformance matrix: PD region
+    routing with epoch-retry, scans that cross RawScan batch limits,
+    and the md5(dir)+name key scheme (tikv_store.go:223-247)."""
+
+    @pytest.fixture()
+    def tikv(self):
+        from tests.cloud_fakes import FakeTikv
+
+        f = FakeTikv()
+        f.start()
+        yield f
+        f.stop()
+
+    def test_region_error_refreshes_and_retries(self, tikv):
+        from seaweedfs_tpu.filer.tikv_store import TikvStore
+
+        s = TikvStore(tikv.address)
+        s.insert_entry(Entry("/d/one", attr=Attr(mtime=1)))
+        # stale epoch on the next op: the client must invalidate its
+        # region cache, re-route via PD, and succeed on the retry
+        tikv.fail_next_with_region_error = 1
+        assert s.find_entry("/d/one").attr.mtime == 1
+        tikv.fail_next_with_region_error = 1
+        s.insert_entry(Entry("/d/two", attr=Attr(mtime=2)))
+        assert s.find_entry("/d/two").attr.mtime == 2
+
+    def test_scan_crosses_batch_limit(self, tikv):
+        import seaweedfs_tpu.filer.tikv_store as ts
+
+        s = ts.TikvStore(tikv.address)
+        old = ts.SCAN_BATCH
+        ts.SCAN_BATCH = 7  # force multi-batch iteration
+        try:
+            names = [f"f{i:03d}" for i in range(25)]
+            for n in names:
+                s.insert_entry(Entry(f"/big/{n}", attr=Attr(mtime=1)))
+            got = [
+                e.name
+                for e in s.list_directory_entries("/big", "", True, 100)
+            ]
+            assert got == names
+            # pagination across batches too
+            got = [
+                e.name
+                for e in s.list_directory_entries("/big", "f009", False, 100)
+            ]
+            assert got == names[10:]
+            s.delete_folder_children("/big")
+            assert s.list_directory_entries("/big", "", True, 100) == []
+        finally:
+            ts.SCAN_BATCH = old
+
+    def test_key_scheme_matches_reference(self, tikv):
+        """Key = md5(dir) + name; sibling dirs with a shared string
+        prefix must not bleed into each other's listings (the md5 hash
+        is what isolates them, exactly as genKey does)."""
+        from seaweedfs_tpu.filer.tikv_store import TikvStore, _gen_key
+        import hashlib
+
+        assert _gen_key("/home/user", "a.txt") == (
+            hashlib.md5(b"/home/user").digest() + b"a.txt"
+        )
+        s = TikvStore(tikv.address)
+        s.insert_entry(Entry("/pre/x", attr=Attr(mtime=1)))
+        s.insert_entry(Entry("/prefix/y", attr=Attr(mtime=2)))
+        assert [e.name for e in s.list_directory_entries("/pre", "", True, 10)] == ["x"]
+
+    def test_filer_runs_on_tikv(self, tikv):
+        """The whole Filer on a tikv store (the -store tikv path)."""
+        from seaweedfs_tpu.filer.filerstore import new_store
+
+        f = Filer(store=new_store("tikv", tikv.address))
+        f.create_entry(Entry("/docs/readme.md", attr=Attr(mtime=3, crtime=3)))
+        assert f.find_entry("/docs/readme.md").attr.mtime == 3
+        names = [e.name for e in f.list_entries("/docs", "", True, 10)]
+        assert names == ["readme.md"]
+        f.delete_entry("/docs/readme.md")
+        with pytest.raises(EntryNotFound):
+            f.find_entry("/docs/readme.md")
