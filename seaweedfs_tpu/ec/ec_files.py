@@ -137,12 +137,18 @@ def write_ec_files(
     buffer_size: int | None = None,
     large_block_size: int = LARGE_BLOCK_SIZE,
     small_block_size: int = SMALL_BLOCK_SIZE,
+    stats: dict | None = None,
 ) -> None:
     """Generate .ec00-.ec13 next to `base_file_name`.dat
     (ec_encoder.go:53 WriteEcFiles).
 
     buffer_size=None lets each driver pick its default (4 MiB classic
-    IO batches; 16 MiB pipelined tiles on a TPU host)."""
+    IO batches; 16 MiB pipelined tiles on a TPU host). A `stats` dict
+    collects per-phase busy seconds so e2e throughput numbers stay
+    attributable (bench.py stream): the classic loop reports
+    read_s/encode_s/write_s; the pipelined stream driver reports
+    read_s/dispatch_s/fetch_s/write_s (its encode splits into a
+    dispatch and a blocking fetch on either side of the queue)."""
     rs = rs or new_encoder()
     if rs.data_shards != DATA_SHARDS or rs.parity_shards != PARITY_SHARDS:
         raise ValueError("shard-file layout is fixed at RS(10,4)")
@@ -155,6 +161,7 @@ def write_ec_files(
             tile_bytes=buffer_size,
             large_block_size=large_block_size,
             small_block_size=small_block_size,
+            stats=stats,
         )
         return
 
@@ -163,6 +170,9 @@ def write_ec_files(
         if block % buffer_size != 0 and buffer_size % block != 0:
             raise ValueError("buffer size must tile the block sizes")
 
+    import time as _time
+
+    read_s = encode_s = write_s = 0.0
     dat_size = os.path.getsize(base_file_name + ".dat")
     outputs = [open(base_file_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
     try:
@@ -170,16 +180,29 @@ def write_ec_files(
             for row_off, block, batch_off, step in iter_ec_tiles(
                 dat_size, buffer_size, large_block_size, small_block_size
             ):
+                t0 = _time.perf_counter()
                 tile = read_dat_tile(dat, dat_size, row_off, block, batch_off, step)
+                t1 = _time.perf_counter()
                 shards: list[np.ndarray | None] = [
                     tile[i] for i in range(DATA_SHARDS)
                 ] + [None] * PARITY_SHARDS
                 rs.encode(shards)
+                t2 = _time.perf_counter()
                 for i in range(TOTAL_SHARDS):
                     outputs[i].write(shards[i].tobytes())  # type: ignore[union-attr]
+                t3 = _time.perf_counter()
+                read_s += t1 - t0
+                encode_s += t2 - t1
+                write_s += t3 - t2
     finally:
         for f in outputs:
             f.close()
+        if stats is not None:
+            stats.update(
+                read_s=round(read_s, 4),
+                encode_s=round(encode_s, 4),
+                write_s=round(write_s, 4),
+            )
 
 
 def write_ec_files_batch(

@@ -32,6 +32,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from typing import Callable
 
 import numpy as np
@@ -117,6 +118,7 @@ def stream_write_ec_files(
     small_block_size: int = SMALL_BLOCK_SIZE,
     parity_fn: Callable[[np.ndarray], "object"] | None = None,
     fetch_fn: Callable[["object"], np.ndarray] | None = None,
+    stats: dict | None = None,
 ) -> None:
     """Pipelined .dat → .ec00…13, byte-identical to write_ec_files.
 
@@ -141,13 +143,18 @@ def stream_write_ec_files(
     pipe = _Pipeline()
     read_q: queue.Queue = queue.Queue(maxsize=1)
     write_q: queue.Queue = queue.Queue(maxsize=_INFLIGHT)
+    # per-stage busy seconds (queue waits excluded): read | dispatch |
+    # fetch (codec drain) | write — how e2e numbers stay attributable
+    busy = {"read_s": 0.0, "dispatch_s": 0.0, "fetch_s": 0.0, "write_s": 0.0}
 
     def reader():
         with open(dat_path, "rb") as dat:
             for row_off, block, batch_off, step in iter_ec_tiles(
                 dat_size, tile_bytes, large_block_size, small_block_size
             ):
+                t0 = time.perf_counter()
                 tile = read_dat_tile(dat, dat_size, row_off, block, batch_off, step)
+                busy["read_s"] += time.perf_counter() - t0
                 if not _q_put(read_q, tile, pipe.stop):
                     return
         _q_put(read_q, _EOF, pipe.stop)
@@ -158,11 +165,15 @@ def stream_write_ec_files(
             if item is _EOF or item is _STOPPED:
                 return
             tile, handle = item
+            t0 = time.perf_counter()
             parity = fetch_fn(handle)
+            t1 = time.perf_counter()
             for i in range(DATA_SHARDS):
                 outputs[i].write(tile[i].tobytes())
             for i in range(PARITY_SHARDS):
                 outputs[DATA_SHARDS + i].write(parity[i].tobytes())
+            busy["fetch_s"] += t1 - t0
+            busy["write_s"] += time.perf_counter() - t1
 
     pipe.spawn(reader)
     pipe.spawn(writer)
@@ -172,7 +183,10 @@ def stream_write_ec_files(
             tile = _q_get(read_q, pipe.stop)
             if tile is _EOF or tile is _STOPPED:
                 break
-            if not _q_put(write_q, (tile, parity_fn(tile)), pipe.stop):
+            t0 = time.perf_counter()
+            handle = parity_fn(tile)
+            busy["dispatch_s"] += time.perf_counter() - t0
+            if not _q_put(write_q, (tile, handle), pipe.stop):
                 break
         _q_put(write_q, _EOF, pipe.stop)
         ok = True
@@ -182,6 +196,8 @@ def stream_write_ec_files(
         finally:
             for f in outputs:
                 f.close()
+            if stats is not None:
+                stats.update({k: round(v, 4) for k, v in busy.items()})
 
 
 def stream_rebuild_ec_files(
@@ -190,6 +206,7 @@ def stream_rebuild_ec_files(
     rebuild_fn: Callable[[tuple[int, ...], tuple[int, ...], np.ndarray], "object"]
     | None = None,
     fetch_fn: Callable[["object"], np.ndarray] | None = None,
+    stats: dict | None = None,
 ) -> list[int]:
     """Pipelined shard rebuild, byte-identical to rebuild_ec_files.
 
@@ -219,11 +236,13 @@ def stream_rebuild_ec_files(
     pipe = _Pipeline()
     read_q: queue.Queue = queue.Queue(maxsize=1)
     write_q: queue.Queue = queue.Queue(maxsize=_INFLIGHT)
+    busy = {"read_s": 0.0, "dispatch_s": 0.0, "fetch_s": 0.0, "write_s": 0.0}
 
     def reader():
         shard_size = os.path.getsize(base_file_name + to_ext(survivors[0]))
         offset = 0
         while offset < shard_size:
+            t0 = time.perf_counter()
             step = min(tile_bytes, shard_size - offset)
             tile = np.empty((DATA_SHARDS, step), dtype=np.uint8)
             for j, i in enumerate(survivors):
@@ -233,6 +252,7 @@ def stream_rebuild_ec_files(
                         f"ec shard {i} truncated: expected {step} at {offset}"
                     )
                 tile[j] = np.frombuffer(raw, dtype=np.uint8)
+            busy["read_s"] += time.perf_counter() - t0
             if not _q_put(read_q, tile, pipe.stop):
                 return
             offset += step
@@ -243,9 +263,13 @@ def stream_rebuild_ec_files(
             item = _q_get(write_q, pipe.stop)
             if item is _EOF or item is _STOPPED:
                 return
+            t0 = time.perf_counter()
             rebuilt = fetch_fn(item)
+            t1 = time.perf_counter()
             for j, i in enumerate(targets):
                 outputs[i].write(rebuilt[j].tobytes())
+            busy["fetch_s"] += t1 - t0
+            busy["write_s"] += time.perf_counter() - t1
 
     pipe.spawn(reader)
     pipe.spawn(writer)
@@ -255,7 +279,10 @@ def stream_rebuild_ec_files(
             tile = _q_get(read_q, pipe.stop)
             if tile is _EOF or tile is _STOPPED:
                 break
-            if not _q_put(write_q, rebuild_fn(survivors, targets, tile), pipe.stop):
+            t0 = time.perf_counter()
+            handle = rebuild_fn(survivors, targets, tile)
+            busy["dispatch_s"] += time.perf_counter() - t0
+            if not _q_put(write_q, handle, pipe.stop):
                 break
         _q_put(write_q, _EOF, pipe.stop)
         ok = True
@@ -263,6 +290,8 @@ def stream_rebuild_ec_files(
         try:
             pipe.finish(caller_error=not ok)  # may re-raise a stage error
         finally:
+            if stats is not None:
+                stats.update({k: round(v, 4) for k, v in busy.items()})
             for f in inputs.values():
                 f.close()
             for f in outputs.values():

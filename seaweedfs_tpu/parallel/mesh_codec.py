@@ -111,11 +111,73 @@ class MeshCodec:
         )
         return jax.jit(fn)
 
+    def _swar_ok(self, n_bytes: int) -> bool:
+        """True when the byte-layout APIs can ride the SWAR u32 kernel:
+        a TPU mesh (or forced interpret mode) and a per-device stripe
+        block that views as whole u32 lanes in SWAR-tileable counts."""
+        stripe = self.mesh.shape[STRIPE_AXIS]
+        if n_bytes % stripe:
+            return False
+        per_dev = n_bytes // stripe
+        return (
+            (self._tpu_mesh or self._swar_interpret)
+            and per_dev % 4 == 0
+            and (per_dev // 4) % 256 == 0
+        )
+
+    def _swar_bytes_per_device(self, rows: np.ndarray):
+        """One device's byte-tile apply: u8 [Bb, C, Nb] → u8 [Bb, R, Nb]
+        through the SWAR u32 kernel, bitcast views at the edges. The
+        single home of the byte↔u32 packing contract — encode,
+        reconstruct, and verify all ride this."""
+        interpret = not self._tpu_mesh
+
+        def per_device(vols_u8):  # [Bb, C, Nb]
+            b, c, nb = vols_u8.shape
+            u32 = jax.lax.bitcast_convert_type(
+                vols_u8.reshape(b, c, nb // 4, 4), jnp.uint32
+            )
+            out32 = swar_apply_matrix_u32_batch(rows, u32, interpret)
+            out8 = jax.lax.bitcast_convert_type(out32, jnp.uint8)
+            return out8.reshape(b, out32.shape[1], nb)
+
+        return per_device
+
+    def _apply_sharded_bytes(self, rows: np.ndarray):
+        """Sharded byte-layout [B, C, N] u8 → [B, R, N] u8 program that
+        runs the SWAR u32 kernel per device, with free bitcast views at
+        the edges (cached per coefficient matrix). This is how the byte
+        APIs reach the same ~100 GB/s/chip tier as the *_u32 entry
+        points — the 4×-slower bit-matmul only serves misaligned
+        blocks and CPU meshes."""
+        rows = np.asarray(rows, dtype=np.uint8)
+        key = b"u8" + rows.tobytes() + bytes(rows.shape)
+        fn = self._sharded_u32_cache.get(key)
+        if fn is not None:
+            return fn
+        per_device = self._swar_bytes_per_device(rows)
+        fn = jax.jit(
+            shard_map(
+                per_device,
+                mesh=self.mesh,
+                in_specs=P(VOL_AXIS, None, STRIPE_AXIS),
+                out_specs=P(VOL_AXIS, None, STRIPE_AXIS),
+                check_vma=False,
+            )
+        )
+        self._sharded_u32_cache[key] = fn
+        return fn
+
     def encode_batch(self, volumes: jnp.ndarray) -> jnp.ndarray:
         """volumes [B, k, N] (sharded) → parity [B, p, N] (sharded).
 
         Positionwise GF math: no collectives; each device encodes its
-        (volume-block × stripe-block) tile independently."""
+        (volume-block × stripe-block) tile independently. TPU meshes
+        run the SWAR u32 kernel internally (byte views at the edges)."""
+        if self._swar_ok(volumes.shape[-1]):
+            return self._apply_sharded_bytes(self.matrix[self.data_shards :])(
+                volumes
+            )
         return self._encode_sharded(self._parity_bits, volumes)
 
     # --- u32-lane fast path (SWAR per device on TPU meshes) ---
@@ -199,16 +261,22 @@ class MeshCodec:
         The gather of surviving shards into `shard_data` rides DCN
         (gRPC shard reads); the decode is one SPMD program — the
         store_ec.go:364 ReconstructData hot path, batched."""
+        if self._swar_ok(shard_data.shape[-1]):
+            return self._apply_sharded_bytes(
+                self._kern.decode_rows_for(survivors, targets)
+            )(shard_data)
         return self._encode_sharded(self._decode_bits(survivors, targets), shard_data)
 
     # --- verify with a stripe-axis collective ---
     @functools.cached_property
     def _verify_sharded(self):
         def per_device(bits, vols, parity):
-            # [Bb, p, Nb] recomputed on this device's tile
+            # [Bb, p, Nb] recomputed on this device's tile; residual =
+            # COUNT of mismatched bytes (a byte-value sum would overflow
+            # int32 on the multi-MiB blocks the SWAR tier serves)
             recomputed = apply_matrix_bits_batch(bits, vols)
             local = jnp.sum(
-                (recomputed ^ parity).astype(jnp.int32), axis=(1, 2)
+                (recomputed != parity).astype(jnp.int32), axis=(1, 2)
             )  # [Bb]
             return jax.lax.psum(local, STRIPE_AXIS)
 
@@ -224,10 +292,40 @@ class MeshCodec:
         )
         return jax.jit(fn)
 
+    @functools.cached_property
+    def _verify_sharded_swar(self):
+        recompute = self._swar_bytes_per_device(
+            np.asarray(self.matrix[self.data_shards :], dtype=np.uint8)
+        )
+
+        def per_device(vols_u8, parity):
+            recomputed = recompute(vols_u8)
+            local = jnp.sum(
+                (recomputed != parity).astype(jnp.int32), axis=(1, 2)
+            )  # [Bb] — mismatched-byte count, identical to the matmul tier
+            return jax.lax.psum(local, STRIPE_AXIS)
+
+        return jax.jit(
+            shard_map(
+                per_device,
+                mesh=self.mesh,
+                in_specs=(
+                    P(VOL_AXIS, None, STRIPE_AXIS),
+                    P(VOL_AXIS, None, STRIPE_AXIS),
+                ),
+                out_specs=P(VOL_AXIS),
+                check_vma=False,
+            )
+        )
+
     def verify_batch(
         self, volumes: jnp.ndarray, parity: jnp.ndarray
     ) -> jnp.ndarray:
-        """Per-volume XOR residual between recomputed and given parity:
-        [B] int32, 0 = verified. The stripe-axis psum is the mesh
-        collective of the degraded-read fan-in story (§2.6.5)."""
+        """Per-volume mismatched-byte count between recomputed and
+        given parity: [B] int32, 0 = verified. The stripe-axis psum is
+        the mesh collective of the degraded-read fan-in story (§2.6.5);
+        the parity recompute itself rides the SWAR u32 kernel on TPU
+        meshes, so verify runs at the encode tier's rate."""
+        if self._swar_ok(volumes.shape[-1]):
+            return self._verify_sharded_swar(volumes, parity)
         return self._verify_sharded(self._parity_bits, volumes, parity)
