@@ -35,7 +35,9 @@ import time
 
 from seaweedfs_tpu.pb import filer_pb2 as fpb
 
-API_PRODUCE, API_FETCH, API_METADATA = 0, 1, 3
+API_PRODUCE, API_FETCH, API_METADATA, API_VERSIONS = 0, 1, 3, 18
+# the pinned wire versions this client speaks (module docstring)
+PINNED_VERSIONS = {API_PRODUCE: 3, API_FETCH: 4, API_METADATA: 0}
 _CLIENT_ID = "seaweedfs-tpu"
 
 
@@ -283,11 +285,53 @@ class KafkaClient:
         self.host, self.port = host, int(port or 9092)
         self.timeout = timeout
         self._conn: KafkaConnection | None = None
+        self._versions_checked = False
 
     def _connection(self) -> KafkaConnection:
         if self._conn is None:
-            self._conn = KafkaConnection(self.host, self.port, self.timeout)
+            conn = KafkaConnection(self.host, self.port, self.timeout)
+            if not self._versions_checked:
+                conn = self._negotiate(conn)
+                self._versions_checked = True
+            self._conn = conn
         return self._conn
+
+    def _negotiate(self, conn: KafkaConnection) -> KafkaConnection:
+        """ApiVersions handshake at dial (sarama negotiates the same
+        way behind the reference's kafka queue): confirm the broker
+        supports the pinned Metadata/Produce/Fetch versions, raising
+        with guidance when it does not — a graceful gate instead of a
+        mid-publish protocol error against a too-new/too-old broker.
+        Brokers that kill the connection on the probe (pre-0.10, or
+        proxies dropping unknown api keys) get the pinned versions
+        optimistically on a fresh dial."""
+        try:
+            r = conn.call(API_VERSIONS, 0, b"")
+            if r.i16() != 0:  # e.g. 35 UNSUPPORTED_VERSION — proceed
+                return conn
+            ranges = {}
+            for _ in range(r.i32()):
+                key, lo, hi = r.i16(), r.i16(), r.i16()
+                ranges[key] = (lo, hi)
+        except (OSError, ValueError, ConnectionError, struct.error, IndexError):
+            # no/odd ApiVersions support (pre-0.10 broker, proxy with
+            # strange framing): optimistic pinned versions, fresh dial
+            conn.close()
+            return KafkaConnection(self.host, self.port, self.timeout)
+        names = {API_PRODUCE: "Produce", API_FETCH: "Fetch", API_METADATA: "Metadata"}
+        for key, pinned in PINNED_VERSIONS.items():
+            lo, hi = ranges.get(key, (None, None))
+            if lo is None or not lo <= pinned <= hi:
+                conn.close()
+                raise RuntimeError(
+                    f"kafka broker {self.host}:{self.port} does not support "
+                    f"{names[key]} v{pinned} (broker offers "
+                    f"{'nothing' if lo is None else f'v{lo}..v{hi}'}); this "
+                    "client speaks pinned versions (Metadata v0 / Produce "
+                    "v3 / Fetch v4, notification/kafka.py) — use a broker "
+                    "in that range or bridge through one"
+                )
+        return conn
 
     def _call(self, api_key: int, api_version: int, body: bytes) -> _Reader:
         """call() with reconnect: a dead or desynced connection (broker

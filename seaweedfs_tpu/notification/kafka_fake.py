@@ -34,6 +34,12 @@ from seaweedfs_tpu.notification.kafka import (
 class FakeKafkaBroker:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, partitions: int = 2):
         self.partitions = partitions
+        # ApiVersions ranges advertised to clients; tests shrink these
+        # to exercise the client's unsupported-version gate
+        self.api_ranges = {0: (0, 8), 1: (0, 11), 3: (0, 9), 18: (0, 0)}
+        # drop connections on the ApiVersions probe like a pre-0.10
+        # broker (tests of the client's optimistic fallback)
+        self.drop_api_versions = False
         # (topic, partition) -> list[(key, value)]; index == offset
         self.logs: dict[tuple[str, int], list] = {}
         self._lock = threading.Lock()
@@ -56,7 +62,13 @@ class FakeKafkaBroker:
                     r = _Reader(payload)
                     api_key, api_version, corr = r.i16(), r.i16(), r.i32()
                     r.string()  # client id
-                    if api_key == API_METADATA:
+                    if api_key == 18:  # ApiVersions
+                        if broker.drop_api_versions:
+                            return  # pre-0.10 behavior: kill the conn
+                        body = struct.pack(">hi", 0, len(broker.api_ranges))
+                        for k, (lo, hi) in sorted(broker.api_ranges.items()):
+                            body += struct.pack(">hhh", k, lo, hi)
+                    elif api_key == API_METADATA:
                         body = broker._metadata(r)
                     elif api_key == API_PRODUCE:
                         body = broker._produce(r)

@@ -294,6 +294,62 @@ class FilerServer:
         )
 
     # ------------------------------------------------------------------
+    # directory browser (server/filer_ui/templates.go role)
+    def _render_dir_html(
+        self, path: str, entries, limit: int, last: str, more: bool
+    ) -> str:
+        """Breadcrumbed directory listing for browsers, with the
+        reference's lastFileName/limit load-more pagination link
+        (filer_ui/templates.go, breadcrumb.go ToBreadcrumb)."""
+        import html as _html
+        import time as _time
+        from urllib.parse import quote
+
+        from seaweedfs_tpu.util.status_ui import status_page
+
+        crumbs = ["<a href='/'>/</a>"]
+        parts = [p for p in path.split("/") if p]
+        for i, part in enumerate(parts):
+            link = quote("/" + "/".join(parts[: i + 1]) + "/")
+            crumbs.append(f"<a href='{link}'>{_html.escape(part)} /</a>")
+        rows = []
+        for e in entries:
+            name = _html.escape(e.name)
+            href = quote(e.full_path) + ("/" if e.is_directory else "")
+            size = "" if e.is_directory else str(e.size())
+            mtime = (
+                _time.strftime(
+                    "%Y-%m-%d %H:%M:%S", _time.localtime(e.attr.mtime)
+                )
+                if e.attr.mtime
+                else ""
+            )
+            mime = "dir" if e.is_directory else _html.escape(e.attr.mime or "")
+            rows.append(
+                f"<tr><td><a href='{href}'>{name}</a></td>"
+                f"<td>{size}</td><td>{mtime}</td><td>{mime}</td></tr>"
+            )
+        if more:
+            next_link = (
+                quote(path) + f"/?limit={limit}&lastFileName={quote(last)}"
+                if path != "/"
+                else f"/?limit={limit}&lastFileName={quote(last)}"
+            )
+            rows.append(
+                f"<tr><td colspan=4><a href='{next_link}'>load more…</a>"
+                "</td></tr>"
+            )
+        return status_page(
+            "SeaweedFS-TPU Filer",
+            " ".join(crumbs),
+            f"{len(entries)} entries &middot; limit {limit}",
+            ["Name", "Size", "Modified", "Type"],
+            "".join(rows),
+            ["/", "/metrics"],
+            section_heading="Entries",
+        )
+
+    # ------------------------------------------------------------------
     # HTTP
     def _http_handler_class(self):
         server = self
@@ -334,10 +390,33 @@ class FilerServer:
                 except EntryNotFound:
                     return self._json({"error": "not found"}, 404)
                 if entry.is_directory:
-                    limit = int(q.get("limit", "100"))
+                    try:
+                        limit = max(1, int(q.get("limit", "100")))
+                    except ValueError:
+                        limit = 100
+                    # limit+1 fetch decides the pagination flag exactly
+                    # (the reference's extra-entry trick) — no phantom
+                    # load-more page on exact-multiple directories
                     entries = server.filer.list_entries(
-                        path, start_file_name=q.get("lastFileName", ""), limit=limit
+                        path,
+                        start_file_name=q.get("lastFileName", ""),
+                        limit=limit + 1,
                     )
+                    more = len(entries) > limit
+                    entries = entries[:limit]
+                    last = entries[-1].name if entries else q.get("lastFileName", "")
+                    # browsers get the breadcrumbed HTML listing the
+                    # reference renders (filer_ui/templates.go via
+                    # filer_server_handlers_read_dir.go:16-45); API
+                    # clients keep the JSON shape
+                    if "text/html" in self.headers.get("Accept", ""):
+                        return self._reply(
+                            200,
+                            server._render_dir_html(
+                                path, entries, limit, last, more
+                            ).encode(),
+                            {"Content-Type": "text/html; charset=utf-8"},
+                        )
                     return self._json(
                         {
                             "Path": path,
@@ -352,6 +431,8 @@ class FilerServer:
                                 for e in entries
                             ],
                             "Limit": limit,
+                            "LastFileName": last,
+                            "ShouldDisplayLoadMore": more,
                         }
                     )
                 headers = {

@@ -12,15 +12,73 @@ from seaweedfs_tpu.shell.command_env import CommandEnv
 from seaweedfs_tpu.shell.commands import COMMANDS, run_command
 
 
+def _arm_readline():
+    """Line editing + history + tab completion on a real terminal —
+    the operator experience the reference gets from peterh/liner
+    (shell_liner.go: history file, prompt editing, command completion).
+    No-op when stdin is piped/scripted or readline is unavailable."""
+    import atexit
+    import os
+
+    try:
+        import readline
+    except ImportError:  # pragma: no cover - always present on linux
+        return None
+
+    def complete(text, state):
+        names = sorted(n for n in COMMANDS if n.startswith(text))
+        return names[state] if state < len(names) else None
+
+    readline.set_completer(complete)
+    readline.set_completer_delims(" \t")
+    readline.parse_and_bind("tab: complete")
+    hist = os.path.expanduser("~/.seaweedfs_tpu_shell_history")
+    try:
+        readline.read_history_file(hist)
+    except OSError:
+        pass
+    readline.set_history_length(1000)
+    atexit.register(lambda: _save_history(readline, hist))
+    return readline
+
+
+def _save_history(readline, hist: str) -> None:
+    try:
+        readline.write_history_file(hist)
+    except OSError:
+        pass
+
+
 def run_shell(masters: list[str], stdin=None, stdout=None) -> None:
     stdin = stdin or sys.stdin
     stdout = stdout or sys.stdout
     env = CommandEnv(masters)
+    # readline only drives the REAL tty path: input() reads through it
+    # when stdin/stdout are the process's own terminal
+    interactive = (
+        stdin is sys.stdin
+        and stdout is sys.stdout
+        and hasattr(stdin, "isatty")
+        and stdin.isatty()
+    )
+    if interactive:
+        _arm_readline()
     print("seaweedfs-tpu shell; `help` lists commands, `exit` quits", file=stdout)
     while True:
-        print("> ", end="", file=stdout, flush=True)
-        line = stdin.readline()
-        if not line or line.strip() in ("exit", "quit"):
+        if interactive:
+            try:
+                line = input("> ")
+            except EOFError:
+                return
+            except KeyboardInterrupt:
+                print(file=stdout)
+                continue
+        else:
+            print("> ", end="", file=stdout, flush=True)
+            line = stdin.readline()
+            if not line:
+                return
+        if line.strip() in ("exit", "quit"):
             return
         line = line.strip()
         if not line:

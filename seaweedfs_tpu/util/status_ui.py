@@ -17,16 +17,19 @@ def status_page(
     table_header_cells: list[str],
     table_rows_html: str,
     footer_links: list[str],
+    section_heading: str | None = None,
 ) -> str:
     header = "".join(f"<th>{c}</th>" for c in table_header_cells)
     links = " &middot; ".join(
         f"<a href='{href}'>{href}</a>" for href in footer_links
     )
+    if section_heading is None:
+        section_heading = "Topology" if "Master" in title else "Volumes"
     return (
         f"<!DOCTYPE html><html><head><title>{title}</title>"
         f"<style>{_STYLE}</style></head><body>"
         f"<h1>{heading}</h1><p>{intro_html}</p>"
-        f"<h2>{'Topology' if 'Master' in title else 'Volumes'}</h2>"
+        f"<h2>{section_heading}</h2>"
         f"<table><tr>{header}</tr>{table_rows_html}</table>"
         f"<p>{links}</p></body></html>"
     )

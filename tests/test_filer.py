@@ -670,6 +670,42 @@ class TestFilerServer:
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(filer_url(filer, "/docs/hello.txt"), timeout=10)
 
+    def test_html_directory_browser(self, filer_cluster):
+        """Browsers (Accept: text/html) get the breadcrumbed listing
+        the reference renders (filer_ui/templates.go); API clients keep
+        JSON, now with the reference's LastFileName/ShouldDisplayLoadMore
+        pagination fields (filer_server_handlers_read_dir.go:54-66)."""
+        _, _, filer = filer_cluster
+        for name in ("ua.txt", "ub.txt", "uc.txt"):
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    filer_url(filer, f"/ui/{name}"), data=b"x", method="POST"
+                ),
+                timeout=10,
+            ).read()
+        req = urllib.request.Request(
+            filer_url(filer, "/ui/"),
+            headers={"Accept": "text/html,application/xhtml+xml"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            page = r.read().decode()
+            assert r.headers["Content-Type"].startswith("text/html")
+        assert "ua.txt" in page and "ui /" in page  # rows + breadcrumb
+        # pagination: limit smaller than the dir shows a load-more link
+        req = urllib.request.Request(
+            filer_url(filer, "/ui/?limit=2"), headers={"Accept": "text/html"}
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            page = r.read().decode()
+        assert "load more" in page and "lastFileName=ub.txt" in page
+        # JSON default unchanged + new pagination fields
+        with urllib.request.urlopen(
+            filer_url(filer, "/ui/?limit=2"), timeout=10
+        ) as r:
+            d = json.loads(r.read())
+        assert d["ShouldDisplayLoadMore"] is True
+        assert d["LastFileName"] == "ub.txt"
+
     def test_autochunk_large_file(self, filer_cluster):
         _, _, filer = filer_cluster
         # max_mb=1 → 2.5 MiB body becomes 3 chunks

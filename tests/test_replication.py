@@ -855,6 +855,36 @@ class TestKafkaWireProtocol:
         got = decode_record_batches(blob)
         assert got == [(0, b"k1", b"v1"), (1, None, b"v2"), (2, b"k3", b"x" * 3000)]
 
+    def test_api_versions_handshake_accepts_supported(self, broker):
+        """The dial-time ApiVersions probe (sarama's negotiation role,
+        behind notification/kafka/kafka_queue.go) passes on a broker
+        advertising the pinned versions, and the probe runs once."""
+        from seaweedfs_tpu.notification.kafka import KafkaClient
+
+        c = KafkaClient(f"{broker.host}:{broker.port}")
+        assert c.metadata("t") == [0, 1]
+        assert c._versions_checked
+
+    def test_api_versions_handshake_rejects_unsupported(self, broker):
+        """A broker whose Produce range excludes the pinned v3 must be
+        rejected at dial with guidance, not a mid-publish wire error."""
+        from seaweedfs_tpu.notification.kafka import KafkaClient
+
+        broker.api_ranges[0] = (6, 8)  # Produce v6..v8 only (too new)
+        c = KafkaClient(f"{broker.host}:{broker.port}")
+        with pytest.raises(RuntimeError, match="Produce v3"):
+            c.metadata("t")
+
+    def test_api_versions_probe_killed_falls_back(self, broker):
+        """A pre-ApiVersions broker (drops the probe connection) still
+        serves: the client redials and proceeds on pinned versions."""
+        from seaweedfs_tpu.notification import kafka_fake
+        from seaweedfs_tpu.notification.kafka import KafkaClient
+
+        broker.drop_api_versions = True
+        c = KafkaClient(f"{broker.host}:{broker.port}")
+        assert c.metadata("t") == [0, 1]
+
     def test_metadata_produce_fetch_over_socket(self, broker):
         from seaweedfs_tpu.notification.kafka import KafkaClient
 
