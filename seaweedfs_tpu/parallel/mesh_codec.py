@@ -112,15 +112,22 @@ class MeshCodec:
         return jax.jit(fn)
 
     def _swar_ok(self, n_bytes: int) -> bool:
-        """True when the byte-layout APIs can ride the SWAR u32 kernel:
-        a TPU mesh (or forced interpret mode) and a per-device stripe
-        block that views as whole u32 lanes in SWAR-tileable counts."""
+        """True when the byte-layout APIs route through the SWAR u32
+        kernel — interpret mode only (byte-identity tests). On REAL TPU
+        meshes the byte APIs keep the bit-matmul tier: materializing a
+        device-side u8↔u32 view around a pallas call costs a relayout
+        copy whose (8,128)-tiled padding measured 12.8× the array size
+        on v5e (a 2.5 GB block tried to allocate 34 GB) — byte views
+        are free on the HOST (np.view), so production TPU callers use
+        the *_u32 APIs end-to-end (ec_files.py serving batch path,
+        verify_batch_u32) and the byte layout stays a host-edge/test
+        convenience."""
         stripe = self.mesh.shape[STRIPE_AXIS]
         if n_bytes % stripe:
             return False
         per_dev = n_bytes // stripe
         return (
-            (self._tpu_mesh or self._swar_interpret)
+            self._swar_interpret
             and per_dev % 4 == 0
             and (per_dev // 4) % 256 == 0
         )
@@ -145,11 +152,10 @@ class MeshCodec:
 
     def _apply_sharded_bytes(self, rows: np.ndarray):
         """Sharded byte-layout [B, C, N] u8 → [B, R, N] u8 program that
-        runs the SWAR u32 kernel per device, with free bitcast views at
-        the edges (cached per coefficient matrix). This is how the byte
-        APIs reach the same ~100 GB/s/chip tier as the *_u32 entry
-        points — the 4×-slower bit-matmul only serves misaligned
-        blocks and CPU meshes."""
+        runs the SWAR u32 kernel per device with bitcast views at the
+        edges — interpret-mode only (byte-identity tests; see _swar_ok
+        for why real TPU meshes keep the bit-matmul on byte layouts and
+        do their fast-tier work through the *_u32 APIs)."""
         rows = np.asarray(rows, dtype=np.uint8)
         key = b"u8" + rows.tobytes() + bytes(rows.shape)
         fn = self._sharded_u32_cache.get(key)
@@ -172,8 +178,10 @@ class MeshCodec:
         """volumes [B, k, N] (sharded) → parity [B, p, N] (sharded).
 
         Positionwise GF math: no collectives; each device encodes its
-        (volume-block × stripe-block) tile independently. TPU meshes
-        run the SWAR u32 kernel internally (byte views at the edges)."""
+        (volume-block × stripe-block) tile independently. Production
+        TPU callers use encode_batch_u32 (u32 lanes are the native
+        device layout — _swar_ok); this byte-layout API runs the
+        bit-matmul tier on device meshes, SWAR under interpret mode."""
         if self._swar_ok(volumes.shape[-1]):
             return self._apply_sharded_bytes(self.matrix[self.data_shards :])(
                 volumes
@@ -318,14 +326,61 @@ class MeshCodec:
             )
         )
 
+    @functools.cached_property
+    def _verify_sharded_u32(self):
+        """One builder for both tiers: the per-device parity recompute
+        reuses the exact tier dispatch _apply_sharded_u32 encodes
+        (SWAR on TPU/interpret, bit-matmul on CPU meshes)."""
+        rows = np.asarray(self.matrix[self.data_shards :], dtype=np.uint8)
+        if self._tpu_mesh or self._swar_interpret:
+            interpret = not self._tpu_mesh
+
+            def recompute(vols_u32):
+                return swar_apply_matrix_u32_batch(rows, vols_u32, interpret)
+
+        else:
+            bits = gf_matrix_to_bits(rows)
+
+            def recompute(vols_u32):
+                return apply_matrix_bits_u32_batch(jnp.asarray(bits), vols_u32)
+
+        def per_device(vols_u32, parity_u32):
+            local = jnp.sum(
+                (recompute(vols_u32) != parity_u32).astype(jnp.int32),
+                axis=(1, 2),
+            )  # [Bb] — mismatched-LANE count (u32 lanes; 0 = verified)
+            return jax.lax.psum(local, STRIPE_AXIS)
+
+        return jax.jit(
+            shard_map(
+                per_device,
+                mesh=self.mesh,
+                in_specs=(
+                    P(VOL_AXIS, None, STRIPE_AXIS),
+                    P(VOL_AXIS, None, STRIPE_AXIS),
+                ),
+                out_specs=P(VOL_AXIS),
+                check_vma=False,
+            )
+        )
+
+    def verify_batch_u32(
+        self, volumes_u32: jnp.ndarray, parity_u32: jnp.ndarray
+    ) -> jnp.ndarray:
+        """u32-lane verify at the SWAR encode rate: recompute parity per
+        device and psum the mismatched-lane count over the stripe axis.
+        [B] int32, 0 = verified. This is the TPU production tier — the
+        u32 packing is the native device layout (see _swar_ok)."""
+        return self._verify_sharded_u32(volumes_u32, parity_u32)
+
     def verify_batch(
         self, volumes: jnp.ndarray, parity: jnp.ndarray
     ) -> jnp.ndarray:
         """Per-volume mismatched-byte count between recomputed and
         given parity: [B] int32, 0 = verified. The stripe-axis psum is
-        the mesh collective of the degraded-read fan-in story (§2.6.5);
-        the parity recompute itself rides the SWAR u32 kernel on TPU
-        meshes, so verify runs at the encode tier's rate."""
+        the mesh collective of the degraded-read fan-in story (§2.6.5).
+        The SWAR-rate tier is verify_batch_u32; this byte-layout API
+        recomputes via the bit-matmul on device meshes (_swar_ok)."""
         if self._swar_ok(volumes.shape[-1]):
             return self._verify_sharded_swar(volumes, parity)
         return self._verify_sharded(self._parity_bits, volumes, parity)
