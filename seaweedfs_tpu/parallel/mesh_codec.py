@@ -128,6 +128,7 @@ class MeshCodec:
         per_dev = n_bytes // stripe
         return (
             self._swar_interpret
+            and not self._tpu_mesh  # never device-side byte views on TPU
             and per_dev % 4 == 0
             and (per_dev // 4) % 256 == 0
         )
@@ -189,16 +190,11 @@ class MeshCodec:
         return self._encode_sharded(self._parity_bits, volumes)
 
     # --- u32-lane fast path (SWAR per device on TPU meshes) ---
-    def _apply_sharded_u32(self, rows: np.ndarray):
-        """Sharded [B, k, N32] u32 → [B, R, N32] u32 program for one
-        GF coefficient matrix, cached per matrix. Per-device kernel is
-        the SWAR Pallas kernel on TPU meshes (the ~4× fast path the
-        single-chip tier runs), the bit-matmul elsewhere."""
+    def _per_device_u32_apply(self, rows: np.ndarray):
+        """ONE home for the u32 tier dispatch: SWAR Pallas kernel on
+        TPU meshes (interpret under the test flag), bit-matmul on CPU
+        meshes. encode/reconstruct/verify all build on this."""
         rows = np.asarray(rows, dtype=np.uint8)
-        key = rows.tobytes() + bytes(rows.shape)
-        fn = self._sharded_u32_cache.get(key)
-        if fn is not None:
-            return fn
         if self._tpu_mesh or self._swar_interpret:
             interpret = not self._tpu_mesh
 
@@ -211,6 +207,19 @@ class MeshCodec:
             def per_device(vols_u32):
                 return apply_matrix_bits_u32_batch(jnp.asarray(bits), vols_u32)
 
+        return per_device
+
+    def _apply_sharded_u32(self, rows: np.ndarray):
+        """Sharded [B, k, N32] u32 → [B, R, N32] u32 program for one
+        GF coefficient matrix, cached per matrix. Per-device kernel is
+        the SWAR Pallas kernel on TPU meshes (the ~4× fast path the
+        single-chip tier runs), the bit-matmul elsewhere."""
+        rows = np.asarray(rows, dtype=np.uint8)
+        key = rows.tobytes() + bytes(rows.shape)
+        fn = self._sharded_u32_cache.get(key)
+        if fn is not None:
+            return fn
+        per_device = self._per_device_u32_apply(rows)
         fn = jax.jit(
             shard_map(
                 per_device,
@@ -331,18 +340,7 @@ class MeshCodec:
         """One builder for both tiers: the per-device parity recompute
         reuses the exact tier dispatch _apply_sharded_u32 encodes
         (SWAR on TPU/interpret, bit-matmul on CPU meshes)."""
-        rows = np.asarray(self.matrix[self.data_shards :], dtype=np.uint8)
-        if self._tpu_mesh or self._swar_interpret:
-            interpret = not self._tpu_mesh
-
-            def recompute(vols_u32):
-                return swar_apply_matrix_u32_batch(rows, vols_u32, interpret)
-
-        else:
-            bits = gf_matrix_to_bits(rows)
-
-            def recompute(vols_u32):
-                return apply_matrix_bits_u32_batch(jnp.asarray(bits), vols_u32)
+        recompute = self._per_device_u32_apply(self.matrix[self.data_shards :])
 
         def per_device(vols_u32, parity_u32):
             local = jnp.sum(
@@ -370,7 +368,9 @@ class MeshCodec:
         """u32-lane verify at the SWAR encode rate: recompute parity per
         device and psum the mismatched-lane count over the stripe axis.
         [B] int32, 0 = verified. This is the TPU production tier — the
-        u32 packing is the native device layout (see _swar_ok)."""
+        u32 packing is the native device layout (see _swar_ok). Shape
+        contract matches encode_batch_u32: per-device N32 must divide
+        the stripe axis and stay a multiple of 256 lanes."""
         return self._verify_sharded_u32(volumes_u32, parity_u32)
 
     def verify_batch(

@@ -1094,7 +1094,15 @@ class VolumeServer:
             def _serve_maybe_ranged(self, data: bytes, headers: dict):
                 """Full 200 or single-range 206 per the Range header
                 (volume_server_handlers_read.go serves ranges via
-                http.ServeContent; suffix and open-ended forms too)."""
+                http.ServeContent; suffix and open-ended forms too).
+                Takes OWNERSHIP of `headers` (callers pass a fresh
+                per-request dict, never a shared constant): no-Range
+                requests — the hot read path — mutate it in place
+                instead of copying."""
+                rng = self.headers.get("range")
+                if not rng:
+                    headers["Accept-Ranges"] = "bytes"
+                    return self._reply(200, data, headers)
                 from seaweedfs_tpu.util.http_range import (
                     RangeNotSatisfiable,
                     parse_range,
@@ -1104,7 +1112,7 @@ class VolumeServer:
                 headers["Accept-Ranges"] = "bytes"
                 total = len(data)
                 try:
-                    span = parse_range(self.headers.get("Range", ""), total)
+                    span = parse_range(rng, total)
                 except RangeNotSatisfiable:
                     return self._reply(
                         416, b"", {"Content-Range": f"bytes */{total}"}
