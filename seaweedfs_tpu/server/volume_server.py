@@ -45,7 +45,7 @@ from seaweedfs_tpu.util.httpd import (
     fast_query,
 )
 
-from seaweedfs_tpu.storage.file_id import FileId
+from seaweedfs_tpu.storage.file_id import FileId, parse_path_fid, parse_url_path
 from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.volume import (
@@ -974,14 +974,19 @@ class VolumeServer:
                 self._reply(status, json.dumps(obj).encode(), _JSON_HDR)
 
             def _parse_fid(self):
+                """(FileId, query, filename, ext) from any of the
+                reference's addressing forms (common.go:152
+                parseURLPath + needle.go:149 ParsePath — comma/slash
+                forms, optional extension and filename, `_delta`
+                appendix fids). (None, None, "", "") = unparseable."""
                 path, _, qs = self.path.partition("?")
-                path = path.lstrip("/")
-                if "," not in path:
-                    return None, None
+                vid, fid_str, filename, ext, vid_only = parse_url_path(path)
+                if vid_only or not fid_str:
+                    return None, None, "", ""
                 try:
-                    return FileId.parse(path), fast_query(qs)
+                    return parse_path_fid(vid, fid_str), fast_query(qs), filename, ext
                 except ValueError:
-                    return None, None
+                    return None, None, "", ""
 
             def _check_write_auth(self) -> bool:
                 """JWT/white-list gate on mutating requests; True = allowed
@@ -993,14 +998,30 @@ class VolumeServer:
 
                 path, _, qs = self.path.partition("?")
                 token = jwt_from_headers(parse_qs(qs), self.headers)
-                try:
-                    server.guard.check_write(
-                        self.client_address[0], token, path.lstrip("/")
-                    )
-                    return True
-                except UnauthorizedError as e:
-                    self._json({"error": str(e)}, 401)
-                    return False
+                # every addressing form must authorize against the fid
+                # the token was minted for: the assign hands out the
+                # comma form, so slash/extension/_delta spellings
+                # normalize to their comma-form candidates
+                candidates = [path.lstrip("/")]
+                vid, fid_str, _fn, _ext, vid_only = parse_url_path(path)
+                if fid_str and not vid_only:
+                    comma = f"{vid},{fid_str}"
+                    if comma not in candidates:
+                        candidates.append(comma)
+                    base = comma.rsplit("_", 1)[0]  # count=N sub-fids
+                    if base not in candidates:
+                        candidates.append(base)
+                err = None
+                for cand in candidates:
+                    try:
+                        server.guard.check_write(
+                            self.client_address[0], token, cand
+                        )
+                        return True
+                    except UnauthorizedError as e:
+                        err = e
+                self._json({"error": str(err)}, 401)
+                return False
 
             def do_GET(self):
                 url_path = self.path.partition("?")[0]
@@ -1024,7 +1045,7 @@ class VolumeServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     return self.wfile.write(body)
-                fid, q = self._parse_fid()
+                fid, q, url_filename, url_ext = self._parse_fid()
                 if fid is None:
                     return self._json({"error": "invalid file id"}, 400)
                 try:
@@ -1057,19 +1078,85 @@ class VolumeServer:
                     return self._json({"error": str(e)}, 500)
                 if n.is_chunked_manifest():
                     return self._serve_chunked_manifest(n)
-                etag = f'"{n.etag()}"'
+                # conditional gets: If-Modified-Since (RFC 1123, like
+                # the reference's time.Parse(http.TimeFormat) check at
+                # volume_server_handlers_read.go:102-112) and ETag
+                if n.has_last_modified_date():
+                    ims = self.headers.get("if-modified-since")
+                    if ims:
+                        from email.utils import parsedate_to_datetime
+
+                        try:
+                            t = parsedate_to_datetime(ims).timestamp()
+                        except (TypeError, ValueError):
+                            t = None
+                        if t is not None and t >= n.last_modified:
+                            return self._reply(304)
+                data = bytes(n.data)
+                if self.headers.get("etag-md5") == "True":
+                    # opt-in md5 validator (crc.go:33 n.MD5 + ETag-MD5);
+                    # picked BEFORE the If-None-Match compare so md5
+                    # revalidations can actually 304
+                    import hashlib
+
+                    etag = f'"{hashlib.md5(data).hexdigest()}"'
+                else:
+                    etag = f'"{n.etag()}"'
                 if self.headers.get("If-None-Match") == etag:
                     return self._reply(304)
                 headers = {"ETag": etag, "Content-Type": "application/octet-stream"}
-                if n.has_mime() and n.mime:
+                # URL filename wins; else the stored name; ext feeds the
+                # mime guess and the resizer (read handler :138-150)
+                fname = url_filename
+                if not fname and n.has_name() and n.name:
+                    fname = n.name.decode("latin-1")
+                ext = url_ext or (os.path.splitext(fname)[1] if fname else "")
+                if n.has_mime() and n.mime and not n.mime.startswith(
+                    b"application/octet-stream"
+                ):
                     headers["Content-Type"] = n.mime.decode("latin-1")
-                if n.has_name() and n.name:
+                elif ext:
+                    import mimetypes
+
+                    guessed = mimetypes.types_map.get(ext.lower())
+                    if guessed:
+                        headers["Content-Type"] = guessed
+                if fname:
+                    disp = "inline"
+                    if q.get("dl", "").lower() in ("true", "1"):
+                        disp = "attachment"
+                    escaped = fname.replace("\\", "\\\\").replace('"', '\\"')
                     headers["Content-Disposition"] = (
-                        f'inline; filename="{n.name.decode("latin-1")}"'
+                        f'{disp}; filename="{escaped}"'
                     )
                 if n.has_last_modified_date():
                     headers["Last-Modified"] = _http_date(n.last_modified)
-                data = bytes(n.data)
+                if n.has_pairs() and n.pairs:
+                    # stored extended pairs surface as response headers
+                    # (read handler :123-133)
+                    try:
+                        for k, pv in json.loads(n.pairs).items():
+                            headers[str(k)] = str(pv)
+                    except ValueError:
+                        pass
+                if n.is_gzipped() and ext != ".gz":
+                    # stored-gzipped: pass through to gzip-accepting
+                    # clients, transparently decompress for the rest
+                    # (read handler :152-162); an explicit .gz URL gets
+                    # the raw bytes
+                    if "gzip" in self.headers.get("accept-encoding", ""):
+                        headers["Content-Encoding"] = "gzip"
+                    else:
+                        import gzip as _gzip
+
+                        try:
+                            data = _gzip.decompress(data)
+                        except OSError as e:
+                            # serve the stored bytes, as the reference
+                            # does on ungzip errors — but say so
+                            wlog.warning(
+                                "ungzip %s: %s", self.path, e
+                            )
                 # on-read image resizing (?width=&height=&mode=,
                 # volume_server_handlers_read.go:224 images.Resized);
                 # unparseable dims serve the original, as the reference
@@ -1079,15 +1166,13 @@ class VolumeServer:
                 except ValueError:
                     width = height = 0
                 if width or height:
-                    ext = ""
-                    if n.has_name() and n.name:
-                        ext = os.path.splitext(n.name.decode("latin-1"))[1]
-                    elif headers["Content-Type"].startswith("image/"):
-                        ext = "." + headers["Content-Type"].split("/")[1]
+                    rext = ext
+                    if not rext and headers["Content-Type"].startswith("image/"):
+                        rext = "." + headers["Content-Type"].split("/")[1]
                     from seaweedfs_tpu import images
 
-                    if images.is_image_ext(ext):
-                        data, _, _ = images.resized(ext, data, width, height, q.get("mode", ""))
+                    if images.is_image_ext(rext):
+                        data, _, _ = images.resized(rext, data, width, height, q.get("mode", ""))
                         headers.pop("ETag", None)  # derived variant
                 self._serve_maybe_ranged(data, headers)
 
@@ -1161,7 +1246,7 @@ class VolumeServer:
             do_HEAD = do_GET
 
             def do_POST(self):
-                fid, q = self._parse_fid()
+                fid, q, url_filename, _url_ext = self._parse_fid()
                 if fid is None:
                     return self._json({"error": "invalid file id"}, 400)
                 if not self._check_write_auth():
@@ -1191,7 +1276,7 @@ class VolumeServer:
                 if ctype and len(ctype) < 256 and ctype != "application/octet-stream":
                     n.mime = ctype.encode()
                     n.set_has_mime()
-                fname = q.get("filename", "") or part_filename
+                fname = q.get("filename", "") or part_filename or url_filename
                 if fname and len(fname) < 256:
                     n.name = fname.encode()
                     n.set_has_name()
@@ -1223,7 +1308,7 @@ class VolumeServer:
                 )
 
             def do_DELETE(self):
-                fid, q = self._parse_fid()
+                fid, q, _fn, _ext = self._parse_fid()
                 if fid is None:
                     return self._json({"error": "invalid file id"}, 400)
                 if not self._check_write_auth():

@@ -53,6 +53,67 @@ def format_needle_id_cookie(key: int, cookie: int) -> str:
 _MAX_KEY_COOKIE_LEN = (8 + 4) * 2  # (NeedleIdSize + CookieSize) hex chars
 
 
+def parse_path_fid(vid_str: str, fid_str: str) -> "FileId":
+    """fid string with the optional `_delta` appendix → FileId
+    (needle.go:149 ParsePath): `01637037d6_3` reads needle id+3 —
+    the addressing scheme chunked uploads use for the sub-fids minted
+    from one assign with count=N."""
+    if not vid_str.isdigit():
+        raise ValueError(f"unknown volume id in {vid_str!r}")
+    delta = 0
+    sep = fid_str.rfind("_")
+    if sep > 0:
+        delta_str = fid_str[sep + 1 :]
+        if not delta_str.isdigit():
+            raise ValueError(f"bad fid delta in {fid_str!r}")
+        fid_str, delta = fid_str[:sep], int(delta_str)
+    key, cookie = parse_needle_id_cookie(fid_str)
+    return FileId(int(vid_str), key + delta, cookie)
+
+
+def parse_url_path(path: str) -> tuple[str, str, str, str, bool]:
+    """Volume-server URL → (vid, fid, filename, ext, is_vid_only),
+    the reference's public addressing forms (server/common.go:152
+    parseURLPath):
+
+      /3,01637037d6[.ext]          comma form (+optional extension)
+      /3/01637037d6[.ext]          slash form
+      /3/01637037d6/my photo.jpg   slash form with an explicit filename
+      /3                           volume id only
+
+    Percent-escapes are decoded PER SEGMENT after splitting (the
+    filename may encode "/" or "," without changing the structure —
+    Go's mux decodes the same way)."""
+    from urllib.parse import unquote
+    vid = fid = filename = ext = ""
+    is_vid_only = False
+    slashes = path.count("/")
+    if slashes == 3:
+        _, vid, fid, filename = path.split("/")
+        filename = unquote(filename)
+        i = filename.rfind(".")
+        if i > 0:
+            ext = filename[i:]
+    elif slashes == 2:
+        _, vid, fid = path.split("/")
+        i = fid.rfind(".")
+        if i > 0:
+            fid, ext = fid[:i], fid[i:]
+    else:
+        sep = path.rfind("/")
+        tail = path[sep + 1 :]
+        comma = tail.rfind(",")
+        if comma <= 0:
+            return tail, "", "", "", True
+        dot = tail.rfind(".")
+        vid = tail[:comma]
+        if dot > 0:
+            fid, ext = tail[comma + 1 : dot], tail[dot:]
+        else:
+            fid = tail[comma + 1 :]
+    return vid, fid, filename, ext, is_vid_only
+
+
 def parse_needle_id_cookie(key_cookie: str) -> tuple[int, int]:
     """needle.go:181 ParseNeedleIdCookie (incl. the max-length check).
 

@@ -152,7 +152,12 @@ class FastRequestMixin:
                 buf += headers
             else:
                 for k, v in headers.items():
-                    buf += f"{k}: {v}\r\n".encode("latin-1")
+                    line = f"{k}: {v}"
+                    if "\r" in line or "\n" in line:
+                        # request-derived values (URL filenames, stored
+                        # pairs) must never split the response
+                        line = line.replace("\r", "").replace("\n", "")
+                    buf += line.encode("latin-1", "replace") + b"\r\n"
         if self.close_connection:
             buf += b"Connection: close\r\n"
         buf += b"Content-Length: %d\r\n\r\n" % len(body)

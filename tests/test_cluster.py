@@ -807,3 +807,163 @@ class TestNodeLiveness:
                 vs.stop()
         finally:
             master.stop()
+
+
+class TestUrlAddressingForms:
+    """The reference's public URL forms and read-path conditionals
+    (server/common.go:152 parseURLPath, needle.go:149 ParsePath,
+    volume_server_handlers_read.go:102-162): comma/slash addressing,
+    extensions, explicit filenames, `_delta` fids, If-Modified-Since,
+    ETag-MD5, pairs-as-headers, and stored-gzip serving."""
+
+    def _put(self, cluster, data, suffix="", headers=None):
+        master, _ = cluster
+        _, assign = http_json(master_url(master, "/dir/assign"))
+        req = urllib.request.Request(
+            f"http://{assign['url']}/{assign['fid']}{suffix}",
+            data=data,
+            method="POST",
+            # octet-stream is never STORED as a mime (needle.go:96), so
+            # the extension-guess path below stays reachable — urllib
+            # would otherwise default to x-www-form-urlencoded
+            headers=headers or {"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+        return assign
+
+    def test_slash_and_extension_forms(self, cluster):
+        a = self._put(cluster, b"formful payload")
+        vid, fid = a["fid"].split(",")
+        base = f"http://{a['url']}"
+        # comma form with extension
+        status, got = http_get(f"{base}/{vid},{fid}.txt")
+        assert (status, got) == (200, b"formful payload")
+        # slash form, with and without extension
+        status, got = http_get(f"{base}/{vid}/{fid}")
+        assert (status, got) == (200, b"formful payload")
+        status, got = http_get(f"{base}/{vid}/{fid}.txt")
+        assert (status, got) == (200, b"formful payload")
+        # slash form with an explicit filename: body + disposition +
+        # mime guessed from the extension
+        with urllib.request.urlopen(
+            f"{base}/{vid}/{fid}/pretty%20name.txt", timeout=10
+        ) as r:
+            assert r.read() == b"formful payload"
+            assert "pretty name.txt" in r.headers.get("Content-Disposition", "")
+            assert r.headers["Content-Type"].startswith("text/plain")
+        # dl=true flips the disposition to attachment
+        with urllib.request.urlopen(
+            f"{base}/{vid}/{fid}/x.txt?dl=true", timeout=10
+        ) as r:
+            assert r.headers["Content-Disposition"].startswith("attachment")
+
+    def test_delta_fid_addressing(self, cluster):
+        """`fid_N` reads needle id+N — the sub-fid scheme chunked
+        uploads mint from one count=N assign (needle.go:149)."""
+        master, _ = cluster
+        _, assign = http_json(master_url(master, "/dir/assign?count=3"))
+        vid, fid = assign["fid"].split(",")
+        base = f"http://{assign['url']}"
+        for i, payload in enumerate([b"chunk zero", b"chunk one", b"chunk two"]):
+            suffix = "" if i == 0 else f"_{i}"
+            req = urllib.request.Request(
+                f"{base}/{vid},{fid}{suffix}", data=payload, method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 201
+        for i, payload in enumerate([b"chunk zero", b"chunk one", b"chunk two"]):
+            suffix = "" if i == 0 else f"_{i}"
+            status, got = http_get(f"{base}/{vid},{fid}{suffix}")
+            assert (status, got) == (200, payload), i
+
+    def test_if_modified_since(self, cluster):
+        a = self._put(cluster, b"conditional body")
+        url = f"http://{a['url']}/{a['fid']}"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            lm = r.headers["Last-Modified"]
+        req = urllib.request.Request(url, headers={"If-Modified-Since": lm})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 304
+        # an older validator still gets the body
+        req = urllib.request.Request(
+            url, headers={"If-Modified-Since": "Mon, 01 Jan 2001 00:00:00 GMT"}
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.read() == b"conditional body"
+
+    def test_etag_md5_opt_in(self, cluster):
+        import hashlib
+
+        a = self._put(cluster, b"md5 etag body")
+        url = f"http://{a['url']}/{a['fid']}"
+        req = urllib.request.Request(url, headers={"ETag-MD5": "True"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            want = hashlib.md5(b"md5 etag body").hexdigest()
+            assert r.headers["ETag"] == f'"{want}"'
+
+    def test_pairs_surface_as_response_headers(self, cluster):
+        """Stored extended pairs come back as response headers
+        (volume_server_handlers_read.go:123-133)."""
+        import json as _json
+
+        master, _ = cluster
+        _, assign = http_json(master_url(master, "/dir/assign"))
+        url = f"http://{assign['url']}/{assign['fid']}"
+        # store pairs via the gRPC write surface? the HTTP POST path
+        # does not take pairs — write the needle directly through the
+        # store like the reference's needle pairs tests do
+        from seaweedfs_tpu.storage.file_id import FileId
+        from seaweedfs_tpu.storage.needle import Needle
+
+        fid = FileId.parse(assign["fid"])
+        n = Needle(cookie=fid.cookie, id=fid.key, data=b"paired body")
+        n.pairs = _json.dumps({"X-Custom-One": "alpha", "X-Custom-Two": "beta"}).encode()
+        n.set_has_pairs()
+        # find the owning in-process server and write through its store
+        for vs in cluster[1]:
+            if f"{vs.host}:{vs.port}" == assign["url"]:
+                vs.store.write_needle(fid.volume_id, n)
+                break
+        with urllib.request.urlopen(url, timeout=10) as r:
+            assert r.read() == b"paired body"
+            assert r.headers["X-Custom-One"] == "alpha"
+            assert r.headers["X-Custom-Two"] == "beta"
+
+    def test_gzipped_needle_serving(self, cluster):
+        """Stored-gzipped needles: gzip-accepting clients get the raw
+        stream + Content-Encoding, others get transparent decompression,
+        and an explicit .gz URL always gets the stored bytes."""
+        import gzip as _gzip
+
+        master, _ = cluster
+        _, assign = http_json(master_url(master, "/dir/assign"))
+        from seaweedfs_tpu.storage.file_id import FileId
+        from seaweedfs_tpu.storage.needle import Needle
+
+        fid = FileId.parse(assign["fid"])
+        plain = b"gzip me please " * 50
+        packed = _gzip.compress(plain)
+        n = Needle(cookie=fid.cookie, id=fid.key, data=packed)
+        n.set_gzipped()
+        for vs in cluster[1]:
+            if f"{vs.host}:{vs.port}" == assign["url"]:
+                vs.store.write_needle(fid.volume_id, n)
+                break
+        url = f"http://{assign['url']}/{assign['fid']}"
+        # no Accept-Encoding: transparently decompressed
+        req = urllib.request.Request(url)
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.read() == plain
+            assert r.headers.get("Content-Encoding") is None
+        # gzip-accepting client: raw stream passthrough
+        req = urllib.request.Request(url, headers={"Accept-Encoding": "gzip"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.headers.get("Content-Encoding") == "gzip"
+            assert r.read() == packed
+        # .gz extension: the stored bytes, no decoding header games
+        vid, fid_hex = assign["fid"].split(",")
+        req = urllib.request.Request(f"http://{assign['url']}/{vid},{fid_hex}.gz")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.read() == packed

@@ -245,3 +245,115 @@ class TestVolumeReadWorker:
         for t in threads:
             t.join()
         assert not errors, errors[:3]
+
+
+class TestWorkersCli:
+    """The real `volume -workers N` spawn path: a CLI lead brings up
+    SO_REUSEPORT worker subprocesses sharing its port; fresh-connection
+    reads spread across processes and writes land through whichever
+    process accepts."""
+
+    def test_cli_workers_share_port(self, tmp_path):
+        import json
+        import os
+        import subprocess
+        import sys
+
+        mport, vport = free_port(), free_port()
+        env = dict(os.environ, JAX_PLATFORMS="cpu", WEED_EC_CODEC="cpu")
+
+        def spawn(*args):
+            return subprocess.Popen(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax; jax.config.update('jax_platforms', 'cpu');"
+                    "from seaweedfs_tpu.__main__ import main; main()",
+                    *args,
+                ],
+                env=env,
+                cwd="/root/repo",
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT,
+            )
+
+        procs = [spawn("master", "-port", str(mport))]
+        try:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{mport}/stats/health", timeout=2
+                    ).read()
+                    break
+                except OSError:
+                    time.sleep(0.2)
+            procs.append(
+                spawn(
+                    "volume",
+                    "-port", str(vport),
+                    "-mserver", f"127.0.0.1:{mport}",
+                    "-dir", str(tmp_path),
+                    "-max", "8",
+                    "-workers", "3",
+                )
+            )
+            # lead + 2 worker subprocesses all listening (workers take a
+            # few seconds each: fresh interpreter + jax import)
+            def assigned():
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{mport}/dir/assign", timeout=2
+                ) as r:
+                    return json.loads(r.read())
+
+            deadline = time.time() + 60
+            fid = None
+            while time.time() < deadline:
+                try:
+                    a = assigned()
+                    if "fid" in a:
+                        fid = a["fid"]
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.3)
+            assert fid, "volume lead never registered"
+            url = f"http://127.0.0.1:{vport}/{fid}"
+            urllib.request.urlopen(
+                urllib.request.Request(url, data=b"cli worker payload", method="POST"),
+                timeout=10,
+            ).read()
+            # give worker subprocesses time to finish binding, then read
+            # over MANY fresh connections: the kernel spreads them over
+            # all SO_REUSEPORT listeners, so every process must serve
+            deadline = time.time() + 45
+            while time.time() < deadline:
+                try:
+                    ok = all(
+                        urllib.request.urlopen(url, timeout=5).read()
+                        == b"cli worker payload"
+                        for _ in range(12)
+                    )
+                    if ok:
+                        break
+                except (OSError, AssertionError):
+                    pass
+                time.sleep(0.5)
+            for _ in range(12):
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    assert r.read() == b"cli worker payload"
+            # delete propagates through whichever process accepts
+            urllib.request.urlopen(
+                urllib.request.Request(url, method="DELETE"), timeout=10
+            ).read()
+            for _ in range(6):
+                with pytest.raises(urllib.error.HTTPError):
+                    urllib.request.urlopen(url, timeout=10)
+        finally:
+            for p in reversed(procs):
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
