@@ -389,6 +389,10 @@ class ExportCommand(Command):
                     member = self._member_name(
                         args.fileNameFormat, needle, args.volumeId
                     )
+                    if needle.is_gzipped() and not member.endswith(".gz"):
+                        # exported bytes stay as stored; the name says
+                        # so (export.go:243)
+                        member += ".gz"
                     info = tarfile.TarInfo(name=member)
                     info.size = len(needle.data)
                     info.mtime = needle.last_modified or 0
@@ -399,6 +403,8 @@ class ExportCommand(Command):
                     out = os.path.join(
                         args.output, name or f"{args.volumeId}_{needle.id:x}"
                     )
+                    if needle.is_gzipped() and not out.endswith(".gz"):
+                        out += ".gz"
                     with open(out, "wb") as f:
                         f.write(needle.data)
                 count += 1

@@ -66,6 +66,18 @@ def _http_date(ts: int) -> str:
 COPY_CHUNK = 1024 * 1024
 
 
+def _needle_manifest_bytes(n: Needle) -> bytes:
+    """A chunk manifest's JSON, decompressed per the needle's gzip flag
+    (operation.LoadChunkManifest(n.Data, n.IsGzipped()) role): manifests
+    are text, so the write path's transparent compression applies."""
+    data = bytes(n.data)
+    if n.is_gzipped():
+        from seaweedfs_tpu.util.compression import try_gunzip
+
+        return try_gunzip(data)
+    return data
+
+
 def _parse_manifest_chunks(data: bytes) -> list[dict] | None:
     """Validate + sort a chunk manifest's chunk list; None if malformed.
     Manifests are client-supplied JSON, so every field is checked."""
@@ -1005,12 +1017,14 @@ class VolumeServer:
                 candidates = [path.lstrip("/")]
                 vid, fid_str, _fn, _ext, vid_only = parse_url_path(path)
                 if fid_str and not vid_only:
+                    # normalize slash/extension spellings to the comma
+                    # form the token was minted for; a _delta suffix
+                    # stays part of the claimed id (reference-strict:
+                    # a base-fid token must NOT authorize arbitrary
+                    # key+N writes)
                     comma = f"{vid},{fid_str}"
                     if comma not in candidates:
                         candidates.append(comma)
-                    base = comma.rsplit("_", 1)[0]  # count=N sub-fids
-                    if base not in candidates:
-                        candidates.append(base)
                 err = None
                 for cand in candidates:
                     try:
@@ -1133,38 +1147,48 @@ class VolumeServer:
                     headers["Last-Modified"] = _http_date(n.last_modified)
                 if n.has_pairs() and n.pairs:
                     # stored extended pairs surface as response headers
-                    # (read handler :123-133)
+                    # (read handler :123-133) — minus framing headers a
+                    # hostile uploader could use to desync keep-alive
                     try:
-                        for k, pv in json.loads(n.pairs).items():
+                        pair_obj = json.loads(n.pairs)
+                        items = (
+                            pair_obj.items() if isinstance(pair_obj, dict) else ()
+                        )
+                        for k, pv in items:
+                            if str(k).lower() in (
+                                "content-length", "connection",
+                                "transfer-encoding", "content-encoding",
+                            ):
+                                continue
                             headers[str(k)] = str(pv)
                     except ValueError:
                         pass
-                if n.is_gzipped() and ext != ".gz":
-                    # stored-gzipped: pass through to gzip-accepting
-                    # clients, transparently decompress for the rest
-                    # (read handler :152-162); an explicit .gz URL gets
-                    # the raw bytes
-                    if "gzip" in self.headers.get("accept-encoding", ""):
-                        headers["Content-Encoding"] = "gzip"
-                    else:
-                        import gzip as _gzip
-
-                        try:
-                            data = _gzip.decompress(data)
-                        except OSError as e:
-                            # serve the stored bytes, as the reference
-                            # does on ungzip errors — but say so
-                            wlog.warning(
-                                "ungzip %s: %s", self.path, e
-                            )
-                # on-read image resizing (?width=&height=&mode=,
-                # volume_server_handlers_read.go:224 images.Resized);
-                # unparseable dims serve the original, as the reference
                 try:
                     width = int(q.get("width", "0") or 0)
                     height = int(q.get("height", "0") or 0)
                 except ValueError:
                     width = height = 0
+                if n.is_gzipped() and ext != ".gz":
+                    # stored-gzipped: pass through to gzip-accepting
+                    # clients, transparently decompress for the rest
+                    # (read handler :152-162); an explicit .gz URL gets
+                    # the raw bytes. Resizes always decompress — the
+                    # resizer needs pixels, not a gzip stream.
+                    if (
+                        not (width or height)
+                        and "gzip" in self.headers.get("accept-encoding", "")
+                    ):
+                        headers["Content-Encoding"] = "gzip"
+                    else:
+                        from seaweedfs_tpu.util.compression import try_gunzip
+
+                        decoded = try_gunzip(data)
+                        if decoded is data:
+                            wlog.warning("ungzip %s: corrupt stream", self.path)
+                        data = decoded
+                # on-read image resizing (?width=&height=&mode=,
+                # volume_server_handlers_read.go:224 images.Resized);
+                # unparseable dims serve the original, as the reference
                 if width or height:
                     rext = ext
                     if not rext and headers["Content-Type"].startswith("image/"):
@@ -1212,10 +1236,11 @@ class VolumeServer:
                 """Chunk-manifest fan-in: stream each chunk fid in offset
                 order without buffering the whole file
                 (volume_server_handlers_read.go:171, ChunkedFileReader)."""
-                chunks = _parse_manifest_chunks(n.data)
+                raw = _needle_manifest_bytes(n)
+                chunks = _parse_manifest_chunks(raw)
                 if chunks is None:
                     return self._json({"error": "invalid chunk manifest"}, 500)
-                manifest = json.loads(n.data)
+                manifest = json.loads(raw)
                 # Content-Length must match what we actually stream, so
                 # it comes from the validated chunk sizes, never the
                 # client-declared manifest "size"
@@ -1259,6 +1284,7 @@ class VolumeServer:
                 # parser call is only paid when the request is a form
                 ctype = self.headers.get("content-type", "")
                 part_filename = ""
+                is_gzipped = False
                 if ctype[:19].lower() == "multipart/form-data":
                     from seaweedfs_tpu.util.multipart import (
                         MalformedUpload,
@@ -1270,8 +1296,13 @@ class VolumeServer:
                     except MalformedUpload as e:
                         return self._json({"error": str(e)}, 400)
                     data, ctype, part_filename = part.data, part.mime, part.filename
+                    is_gzipped = part.is_gzipped
                 else:
                     data = body
+                    # raw bodies may arrive pre-gzipped (Content-Encoding)
+                    is_gzipped = (
+                        self.headers.get("content-encoding", "").lower() == "gzip"
+                    )
                 n = Needle(cookie=fid.cookie, id=fid.key, data=data)
                 if ctype and len(ctype) < 256 and ctype != "application/octet-stream":
                     n.mime = ctype.encode()
@@ -1286,10 +1317,56 @@ class VolumeServer:
                         from seaweedfs_tpu import images
 
                         n.data = images.fix_jpg_orientation(bytes(n.data))
+                if is_gzipped:
+                    n.set_gzipped()
+                elif len(n.data) > 128:
+                    # transparent server-side compression when the type
+                    # says it pays (needle_parse_multipart.go:86-97 +
+                    # util/compression.go IsGzippable); deterministic,
+                    # so replica fan-out re-derives identical needles
+                    from seaweedfs_tpu.util.compression import is_gzippable
+
+                    fext = os.path.splitext(fname)[1] if fname else ""
+                    if is_gzippable(fext, ctype or "", bytes(n.data)):
+                        import gzip as _gzip
+
+                        # mtime=0: replicas re-derive the needle from
+                        # the raw body, so the stream must be identical
+                        packed = _gzip.compress(bytes(n.data), 6, mtime=0)
+                        if len(packed) < len(n.data):
+                            n.data = packed
+                            n.set_gzipped()
                 if q.get("cm") == "true":
                     n.set_is_chunk_manifest()
-                n.last_modified = int(time.time())
+                # Seaweed-* request headers persist as needle pairs
+                # (needle.go:37-42 PairNamePrefix + :101-113)
+                pair_map = {
+                    k[8:]: v
+                    for k, v in self.headers.items()
+                    if k.startswith("seaweed-")
+                }
+                if pair_map:
+                    pairs = json.dumps(pair_map).encode()
+                    if len(pairs) < 65536:
+                        n.pairs = pairs
+                        n.set_has_pairs()
+                # ts= overrides the modification stamp; ttl= stores a
+                # per-needle ttl (needle.go:79-81)
+                try:
+                    n.last_modified = int(q.get("ts", "") or 0) or int(time.time())
+                except ValueError:
+                    n.last_modified = int(time.time())
                 n.set_has_last_modified_date()
+                ttl_param = q.get("ttl", "")
+                if ttl_param:
+                    from seaweedfs_tpu.storage.ttl import TTL
+
+                    try:
+                        n.ttl = TTL.parse(ttl_param)
+                        if n.ttl.count:
+                            n.set_has_ttl()
+                    except ValueError:
+                        pass
                 try:
                     size, unchanged = server.store.write_needle(fid.volume_id, n)
                 except NeedleNotFound:
@@ -1339,7 +1416,7 @@ class VolumeServer:
                 if existing.is_chunked_manifest():
                     # cascade: delete every chunk the manifest points at
                     # (volume_server_handlers_write.go DeleteHandler)
-                    for c in _parse_manifest_chunks(existing.data) or []:
+                    for c in _parse_manifest_chunks(_needle_manifest_bytes(existing)) or []:
                         server._delete_fid(c["fid"])
                 if q.get("type") != "replicate":
                     err = server._replicate(
@@ -1385,9 +1462,14 @@ class VolumeServer:
         v = self.store.find_volume(fid.volume_id)
         if v is not None:
             try:
-                return v.read_needle(fid.key, cookie=fid.cookie).data
+                n = v.read_needle(fid.key, cookie=fid.cookie)
             except (NeedleNotFound, CookieMismatch):
                 return None
+            if n.is_gzipped():
+                from seaweedfs_tpu.util.compression import try_gunzip
+
+                return try_gunzip(bytes(n.data))
+            return n.data
         locations = self._lookup_locations(fid.volume_id) or []
         for url in locations:
             try:
@@ -1455,6 +1537,14 @@ class VolumeServer:
                 ct = headers.get("Content-Type") or headers.get("content-type")
                 if ct:
                     req.add_header("Content-Type", ct)
+                ce = headers.get("Content-Encoding") or headers.get(
+                    "content-encoding"
+                )
+                if ce:  # pre-gzipped uploads must stay flagged on replicas
+                    req.add_header("Content-Encoding", ce)
+                for hk, hv in headers.items():
+                    if hk.lower().startswith("seaweed-"):
+                        req.add_header(hk, hv)  # pairs replicate too
                 auth = headers.get("Authorization") or headers.get(
                     "authorization"
                 )

@@ -226,6 +226,27 @@ class VolumeReadWorker:
                     return False
                 if n.is_chunked_manifest():
                     return False  # manifest fan-in needs the lead's store
+                if (
+                    n.is_gzipped()
+                    or n.has_pairs()
+                    or self.headers.get("etag-md5") == "True"
+                ):
+                    # content-encoding negotiation, pair headers, and the
+                    # md5-validator variant live in the lead's full
+                    # read handler
+                    return False
+                if n.has_last_modified_date():
+                    ims = self.headers.get("if-modified-since")
+                    if ims:
+                        from email.utils import parsedate_to_datetime
+
+                        try:
+                            t = parsedate_to_datetime(ims).timestamp()
+                        except (TypeError, ValueError):
+                            t = None
+                        if t is not None and t >= n.last_modified:
+                            self.fast_reply(304)
+                            return True
                 etag = f'"{n.etag()}"'
                 if self.headers.get("if-none-match") == etag:
                     self.fast_reply(304)

@@ -26,6 +26,7 @@ class UploadPart:
     data: bytes
     filename: str = ""
     mime: str = ""
+    is_gzipped: bool = False  # part arrived Content-Encoding: gzip
 
 
 class MalformedUpload(ValueError):
@@ -136,7 +137,12 @@ def parse_upload(body: bytes, content_type: str) -> UploadPart:
         if fm:
             filename = (fm.group(1) or fm.group(2) or "").replace('\\"', '"')
         ctype = headers.get("content-type", "")
-        candidate = UploadPart(data=payload, filename=filename, mime=ctype)
+        candidate = UploadPart(
+            data=payload,
+            filename=filename,
+            mime=ctype,
+            is_gzipped=headers.get("content-encoding", "").lower() == "gzip",
+        )
         if filename:
             # the reference takes the first part that carries a file
             return candidate

@@ -392,6 +392,16 @@ class TestServerDaemon:
                 proc.kill()
 
 
+def _needle_payload(n) -> bytes:
+    """A needle's logical payload: the volume auto-gzips compressible
+    uploads (util/compression.py, the reference's IsGzippable), so raw
+    record comparisons decode the flag first."""
+    import gzip
+
+    data = bytes(n.data)
+    return gzip.decompress(data) if n.is_gzipped() else data
+
+
 class TestBackupCommand:
     def test_incremental_backup_roundtrip(self, mini_cluster, tmp_path, capsys):
         """backup pulls a volume's records locally and resumes
@@ -425,7 +435,7 @@ class TestBackupCommand:
 
         fid1 = FileId.parse(ar.fid)
         v = Volume(str(tmp_path), vid, "bak", create=False)
-        assert bytes(v.read_needle(fid1.key, cookie=fid1.cookie).data) == payload1
+        assert _needle_payload(v.read_needle(fid1.key, cookie=fid1.cookie)) == payload1
         first_size = v.data_file_size()
         v.close()
 
@@ -457,8 +467,8 @@ class TestBackupCommand:
         assert rc == 0
         fid2 = FileId.parse(ar2.fid)
         v = Volume(str(tmp_path), vid, "bak", create=False)
-        assert bytes(v.read_needle(fid1.key, cookie=fid1.cookie).data) == payload1
-        assert bytes(v.read_needle(fid2.key, cookie=fid2.cookie).data) == payload2
+        assert _needle_payload(v.read_needle(fid1.key, cookie=fid1.cookie)) == payload1
+        assert _needle_payload(v.read_needle(fid2.key, cookie=fid2.cookie)) == payload2
         assert v.data_file_size() > first_size
         v.close()
 

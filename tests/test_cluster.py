@@ -967,3 +967,139 @@ class TestUrlAddressingForms:
         req = urllib.request.Request(f"http://{assign['url']}/{vid},{fid_hex}.gz")
         with urllib.request.urlopen(req, timeout=10) as r:
             assert r.read() == packed
+
+
+class TestTransparentCompression:
+    """The write path's server-side compression (util/compression.py,
+    the reference's IsGzippable + parseMultipart auto-gzip): text
+    uploads store gzipped+flagged, binary uploads stay raw, and every
+    read surface round-trips the original bytes."""
+
+    def _assign(self, cluster):
+        master, _ = cluster
+        _, assign = http_json(master_url(master, "/dir/assign"))
+        return assign
+
+    def _upload(self, assign, data, filename="", ctype="application/octet-stream"):
+        url = f"http://{assign['url']}/{assign['fid']}"
+        if filename:
+            url += f"?filename={filename}"
+        req = urllib.request.Request(
+            url, data=data, method="POST", headers={"Content-Type": ctype}
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+
+    def _stored_needle(self, cluster, assign):
+        from seaweedfs_tpu.storage.file_id import FileId
+
+        fid = FileId.parse(assign["fid"])
+        for vs in cluster[1]:
+            if f"{vs.host}:{vs.port}" == assign["url"]:
+                return vs.store.read_needle(fid.volume_id, fid.key)
+        raise AssertionError("owner not found")
+
+    def test_text_upload_stored_gzipped_and_roundtrips(self, cluster):
+        import gzip
+
+        text = b"compress me, I repeat myself " * 100
+        a = self._assign(cluster)
+        self._upload(a, text, filename="notes.txt", ctype="text/plain")
+        n = self._stored_needle(cluster, a)
+        assert n.is_gzipped(), "text should be stored compressed"
+        assert gzip.decompress(bytes(n.data)) == text
+        # plain client gets the original bytes
+        status, got = http_get(f"http://{a['url']}/{a['fid']}")
+        assert (status, got) == (200, text)
+
+    def test_binary_upload_stays_raw(self, cluster):
+        blob = bytes(range(256)) * 20
+        a = self._assign(cluster)
+        self._upload(a, blob, filename="blob.bin")
+        n = self._stored_needle(cluster, a)
+        assert not n.is_gzipped()
+        status, got = http_get(f"http://{a['url']}/{a['fid']}")
+        assert (status, got) == (200, blob)
+
+    def test_pre_gzipped_upload_respected(self, cluster):
+        import gzip
+
+        plain = b"pre-compressed content " * 40
+        packed = gzip.compress(plain, mtime=0)
+        a = self._assign(cluster)
+        req = urllib.request.Request(
+            f"http://{a['url']}/{a['fid']}",
+            data=packed,
+            method="POST",
+            headers={
+                "Content-Type": "text/plain",
+                "Content-Encoding": "gzip",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+        n = self._stored_needle(cluster, a)
+        assert n.is_gzipped() and bytes(n.data) == packed
+        status, got = http_get(f"http://{a['url']}/{a['fid']}")
+        assert (status, got) == (200, plain)
+
+    def test_seaweed_pair_headers_roundtrip(self, cluster):
+        """Seaweed-* request headers persist as pairs and come back as
+        response headers (needle.go PairNamePrefix)."""
+        a = self._assign(cluster)
+        req = urllib.request.Request(
+            f"http://{a['url']}/{a['fid']}",
+            data=bytes(range(256)),
+            method="POST",
+            headers={
+                "Content-Type": "application/octet-stream",
+                "Seaweed-Origin": "unit-test",
+                "Seaweed-Tag": "42",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+        with urllib.request.urlopen(
+            f"http://{a['url']}/{a['fid']}", timeout=10
+        ) as r:
+            assert r.headers["origin"] == "unit-test"
+            assert r.headers["tag"] == "42"
+
+    def test_ts_param_overrides_mtime(self, cluster):
+        a = self._assign(cluster)
+        req = urllib.request.Request(
+            f"http://{a['url']}/{a['fid']}?ts=1500000000",
+            data=bytes(range(256)),
+            method="POST",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+        assert self._stored_needle(cluster, a).last_modified == 1500000000
+
+    def test_ttl_param_stored_and_expiry_enforced(self, cluster):
+        a = self._assign(cluster)
+        req = urllib.request.Request(
+            f"http://{a['url']}/{a['fid']}?ttl=5m",
+            data=bytes(range(256)),
+            method="POST",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+        n = self._stored_needle(cluster, a)
+        assert n.has_ttl() and str(n.ttl) == "5m"
+        # a back-dated ts + ttl is already expired: the read path must
+        # 404 it (read-path expiry semantics, storage/ttl.py)
+        a2 = self._assign(cluster)
+        req = urllib.request.Request(
+            f"http://{a2['url']}/{a2['fid']}?ts=1500000000&ttl=5m",
+            data=bytes(range(256)),
+            method="POST",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{a2['url']}/{a2['fid']}", timeout=10)
+        assert ei.value.code == 404

@@ -499,7 +499,10 @@ class TestTailRpcs:
         master, vs = mini_cluster
         ar = op.assign(f"127.0.0.1:{master.port}", collection="tail")
         vid = int(ar.fid.split(",")[0])
-        payload = b"tail me " * 100
+        # incompressible payload: a text one would be stored gzipped
+        # (the write path's transparent compression), and this test
+        # asserts on the RAW tailed record bytes
+        payload = bytes(range(256)) * 4
         assert not op.upload(f"{ar.url}/{ar.fid}", payload, jwt=ar.auth).error
 
         # sender drains after the idle timeout and delivers the needle

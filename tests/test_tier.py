@@ -192,7 +192,8 @@ class TestTierLifecycle:
         m1, v1, s3 = tier_env
         master = f"127.0.0.1:{m1.port}"
         ar = op.assign(master, collection="reload")
-        payload = b"reload me" * 99
+        # incompressible: raw-needle asserts below (see tail test note)
+        payload = bytes(range(256)) * 4
         assert not op.upload(f"{ar.url}/{ar.fid}", payload, jwt=ar.auth).error
         vid = int(ar.fid.split(",")[0])
 
