@@ -22,8 +22,11 @@ def _is_mostly_text(data: bytes) -> bool:
     sample = data[:1024]
     if not sample or b"\x00" in sample:
         return False
-    printable = sum(1 for b in sample if b in _TEXTCHARS)
-    return printable / len(sample) > 0.85
+    # translate-delete counts non-text bytes in C: this runs on the
+    # volume write hot path for every extension the type rules do not
+    # decide (a Python per-byte loop here costs ~40 us/write)
+    non_text = len(sample.translate(None, _TEXTCHARS))
+    return non_text / len(sample) < 0.15
 
 
 def is_gzippable_file_type(ext: str, mtype: str) -> tuple[bool, bool]:
