@@ -44,7 +44,7 @@ from seaweedfs_tpu.util.httpd import (
 )
 from seaweedfs_tpu.pb import rpc, volume_pb2
 from seaweedfs_tpu.sequence import MemorySequencer
-from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+from seaweedfs_tpu.storage.file_id import format_needle_id_cookie, parse_url_path
 from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
 from seaweedfs_tpu.storage.store import EcShardInfo, VolumeInfo
 from seaweedfs_tpu.storage.ttl import TTL
@@ -719,7 +719,39 @@ class MasterServer:
                     return self._submit(q)
                 if path == "/vol/vacuum":
                     return self._vol_vacuum(q)
+                if path == "/vol/status":
+                    return self._json(
+                        {
+                            "Version": "seaweedfs_tpu",
+                            "Volumes": server.topology.to_volume_map(),
+                        }
+                    )
+                # fallthrough: GET /<fid> on the master 301s to a
+                # volume server holding it (master_server.go:121
+                # redirectHandler) — the curl-the-master convenience
+                redirected = self._redirect_fid(path, q)
+                if redirected:
+                    return
                 self._json({"error": f"unknown path {path}"}, 404)
+
+            def _redirect_fid(self, path, q) -> bool:
+                vid_str, fid_str, _fn, _ext, _vo = parse_url_path(path)
+                # isascii guard: str.isdigit() accepts unicode digits
+                # that int() then rejects
+                if not (vid_str.isascii() and vid_str.isdigit()) or not fid_str:
+                    return False
+                nodes = server.topology.lookup(
+                    q.get("collection", ""), int(vid_str)
+                )
+                if not nodes:
+                    self._json(
+                        {"error": f"volume id {vid_str} not found"}, 404
+                    )
+                    return True
+                dn = random.choice(nodes)
+                target = f"http://{dn.public_url}{self.path}"
+                self.fast_reply(301, b"", {"Location": target})
+                return True
 
             do_POST = do_GET
 

@@ -244,6 +244,47 @@ class Topology(Node):
             self, rp, data_center=data_center
         )
 
+    @staticmethod
+    def _volume_stat(v) -> dict:
+        return {
+            "Id": v.id,
+            "Size": v.size,
+            "Collection": v.collection,
+            "FileCount": v.file_count,
+            "DeleteCount": v.delete_count,
+            "DeletedByteCount": v.deleted_byte_count,
+            "ReadOnly": v.read_only,
+            "Version": v.version,
+            "ReplicaPlacement": v.replica_placement,
+            "Ttl": v.ttl,
+        }
+
+    def to_volume_map(self) -> dict:
+        """/vol/status shape (topology_map.go:30 ToVolumeMap): capacity
+        totals plus dc -> rack -> node dicts of raw volume stats.
+
+        Tree mutations happen under the MASTER's node lock (heartbeat
+        delta sync, liveness sweeps), not self._lock, so this walk
+        takes list() snapshots at every level — each is atomic under
+        the GIL — instead of pretending a lock helps; a status dump may
+        be a heartbeat out of date, never a RuntimeError."""
+        dcs: dict = {}
+        for dc in list(self.children.values()):
+            racks: dict = {}
+            for rack in list(dc.children.values()):
+                nodes: dict = {}
+                for dn in list(rack.children.values()):
+                    nodes[dn.id] = [
+                        self._volume_stat(v) for v in list(dn.volumes.values())
+                    ]
+                racks[rack.id] = nodes
+            dcs[dc.id] = racks
+        return {
+            "Max": self.max_volume_count(),
+            "Free": self.free_space(),
+            "DataCenters": dcs,
+        }
+
     def to_map(self) -> dict:
         """Status-UI topology dump (master_server_handlers_admin.go)."""
         return {
@@ -267,18 +308,8 @@ class Topology(Node):
                                     # VolumeList call, like the
                                     # reference's TopologyInfo proto
                                     "VolumeInfos": [
-                                        {
-                                            "Id": v.id,
-                                            "Collection": v.collection,
-                                            "Size": v.size,
-                                            "FileCount": v.file_count,
-                                            "DeleteCount": v.delete_count,
-                                            "DeletedByteCount": v.deleted_byte_count,
-                                            "ReadOnly": v.read_only,
-                                            "ReplicaPlacement": v.replica_placement,
-                                            "Ttl": v.ttl,
-                                        }
-                                        for v in dn.volumes.values()
+                                        self._volume_stat(v)
+                                        for v in list(dn.volumes.values())
                                     ],
                                     "EcShardInfos": [
                                         {

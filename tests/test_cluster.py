@@ -1103,3 +1103,40 @@ class TestTransparentCompression:
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(f"http://{a2['url']}/{a2['fid']}", timeout=10)
         assert ei.value.code == 404
+
+
+class TestMasterRedirectAndVolStatus:
+    """Master conveniences: GET /<fid> 301s to an owning volume server
+    (master_server.go:121 redirectHandler) and /vol/status dumps the
+    ToVolumeMap shape (topology_map.go:30)."""
+
+    def test_fid_redirect(self, cluster):
+        master, _ = cluster
+        _, assign = http_json(master_url(master, "/dir/assign"))
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://{assign['url']}/{assign['fid']}",
+                data=bytes(range(256)),
+                method="POST",
+            ),
+            timeout=10,
+        ).read()
+        # urllib follows the 301 chain master -> volume
+        with urllib.request.urlopen(
+            master_url(master, f"/{assign['fid']}"), timeout=10
+        ) as r:
+            assert r.read() == bytes(range(256))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(master_url(master, "/999,0123456789"), timeout=10)
+        assert ei.value.code == 404
+
+    def test_vol_status_shape(self, cluster):
+        master, _ = cluster
+        _, d = http_json(master_url(master, "/vol/status"))
+        vols = d["Volumes"]
+        assert vols["Max"] > 0 and "DataCenters" in vols
+        some_rack = next(iter(next(iter(vols["DataCenters"].values())).values()))
+        some_node_vols = next(iter(some_rack.values()))
+        assert isinstance(some_node_vols, list)
+        if some_node_vols:
+            assert {"Id", "Size", "Collection"} <= set(some_node_vols[0])
