@@ -572,6 +572,10 @@ class FilerServer:
                     return self._json({"error": str(e)}, 409)
                 self._reply(204)
 
+            # the reference routes PUT through the same PostHandler
+            # (filer_server_handlers.go:25-28)
+            do_PUT = do_POST
+
         return Handler
 
     # ------------------------------------------------------------------
