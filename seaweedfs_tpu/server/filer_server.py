@@ -500,8 +500,21 @@ class FilerServer:
                 # normalize_path strips trailing slashes, so check the
                 # raw URL to tell "POST /dir/" (mkdir) from "POST /dir"
                 raw_path = unquote(urlparse(self.path).path)
-                length = int(self.headers.get("Content-Length", "0"))
-                data = self.rfile.read(length)
+                if "chunked" in self.headers.get(
+                    "Transfer-Encoding", ""
+                ).lower():
+                    # chunked uploads (Go clients PUT unknown-length
+                    # readers this way); an unread chunked body would
+                    # desync the keep-alive connection
+                    try:
+                        data = self._read_chunked_body()
+                    except ValueError as e:
+                        self.close_connection = True
+                        return self._json({"error": str(e)}, 400)
+                    length = len(data)
+                else:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    data = self.rfile.read(length)
                 mime = self.headers.get("Content-Type", "")
                 upload_filename = ""
                 if mime.lower().startswith("multipart/form-data"):
@@ -524,10 +537,11 @@ class FilerServer:
                         path = f"{path.rstrip('/')}/{upload_filename}"
                         raw_path = path
                 if (raw_path.endswith("/") and raw_path != "/") or (
-                    not data and not length
+                    not data and not length and self.command == "POST"
                 ):
                     # mkdir (the reference creates dirs via FUSE/gRPC;
-                    # HTTP POST with no body maps to mkdir here)
+                    # HTTP POST with no body maps to mkdir here — but a
+                    # zero-byte PUT means an EMPTY FILE, as everywhere)
                     from seaweedfs_tpu.filer.entry import new_directory_entry
 
                     server.filer.create_entry(new_directory_entry(path))
@@ -571,6 +585,30 @@ class FilerServer:
                 except ValueError as e:
                     return self._json({"error": str(e)}, 409)
                 self._reply(204)
+
+            def _read_chunked_body(self, limit=1 << 30) -> bytes:
+                pieces = []
+                total = 0
+                while True:
+                    szline = self.rfile.readline(1026).strip()
+                    try:
+                        size = int(szline.split(b";")[0], 16)
+                    except ValueError:
+                        raise ValueError(f"bad chunk size {szline[:32]!r}")
+                    if size == 0:
+                        while True:  # trailers until blank line
+                            t = self.rfile.readline(65537)
+                            if t in (b"\r\n", b"\n", b""):
+                                break
+                        return b"".join(pieces)
+                    total += size
+                    if total > limit:
+                        raise ValueError("chunked body too large")
+                    piece = self.rfile.read(size)
+                    if len(piece) != size:
+                        raise ValueError("truncated chunk")
+                    pieces.append(piece)
+                    self.rfile.readline(3)  # CRLF after each chunk
 
             # the reference routes PUT through the same PostHandler
             # (filer_server_handlers.go:25-28)
