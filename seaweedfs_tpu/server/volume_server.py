@@ -155,6 +155,15 @@ class VolumeServer:
         self.volume_size_limit = 30 * 1024 * 1024 * 1024
         self._stop = threading.Event()
         self._force_full_heartbeat = threading.Event()
+        # set by Store.notify_change on any inventory change: wakes the
+        # heartbeat generator so the delta beat goes out NOW instead of
+        # on the next tick. This is what makes the EC-migration
+        # pipeline's mount-before-delete ordering visible to the master
+        # in order (reference: the NewVolumes/NewEcShards channel pushes
+        # in volume_grpc_client_to_master.go — mount/delete events
+        # interleave the ticker there too).
+        self._hb_wake = threading.Event()
+        self.store.notify_change = self._hb_wake.set
         self._grpc_server: grpc.Server | None = None
         self._http_server: ThreadingHTTPServer | None = None
         self._hb_thread: threading.Thread | None = None
@@ -232,6 +241,10 @@ class VolumeServer:
         last_full_infos: dict[int, object] = {}
         beat = 0
         while not self._stop.is_set():
+            # clear BEFORE collecting: a change landing mid-collect
+            # re-sets the event and triggers another immediate beat
+            # rather than being absorbed into this one and lost
+            self._hb_wake.clear()
             if self._force_full_heartbeat.is_set():
                 # master asked for the full inventory (it lost our
                 # state to a liveness sweep or a leader change)
@@ -282,7 +295,9 @@ class VolumeServer:
                     id=s.id, collection=s.collection, ec_index_bits=s.ec_index_bits
                 )
             yield req
-            self._stop.wait(self.heartbeat_interval)
+            # next beat on the tick, on an inventory change, or on stop
+            # — whichever comes first
+            self._hb_wake.wait(self.heartbeat_interval)
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.is_set():
@@ -1046,9 +1061,19 @@ class VolumeServer:
                         {"Content-Type": "text/html; charset=utf-8"},
                     )
                 if url_path == "/status":
+                    from seaweedfs_tpu import images
+
                     hb = server.store.collect_heartbeat()
                     return self._json(
-                        {"Version": "seaweedfs_tpu", "Volumes": len(hb.volumes)}
+                        {
+                            "Version": "seaweedfs_tpu",
+                            "Volumes": len(hb.volumes),
+                            "Resizing": (
+                                "enabled"
+                                if images.resizing_enabled()
+                                else "disabled"
+                            ),
+                        }
                     )
                 if url_path == "/metrics":
                     from seaweedfs_tpu.stats.metrics import DEFAULT_REGISTRY
@@ -1584,6 +1609,7 @@ class VolumeServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self._hb_wake.set()  # unblock the heartbeat generator's wait
         if self._metrics_push is not None:
             self._metrics_push.stop_event.set()
         if self._http_server:

@@ -20,6 +20,9 @@ from __future__ import annotations
 
 import io
 import shlex
+import time
+
+import grpc
 
 from seaweedfs_tpu.pb import master_pb2, rpc, volume_pb2
 from seaweedfs_tpu.shell import ec_common
@@ -426,7 +429,8 @@ def do_ec_encode(env: CommandEnv, vid: int, collection: str, out) -> None:
     """The 6-step encode pipeline (volume_grpc_erasure_coding.go:25-36 +
     command_ec_encode.go doEcEncode): mark readonly on all replicas →
     generate on one → spread by balanced distribution → mount → delete
-    source shards it no longer owns → delete the original volume."""
+    source shards it no longer owns → confirm all 14 shards registered
+    at the master → delete the original volume."""
     with env.master_channel() as ch:
         resp = rpc.master_stub(ch).LookupVolume(
             master_pb2.LookupVolumeRequest(vids=[str(vid)])
@@ -475,7 +479,35 @@ def do_ec_encode(env: CommandEnv, vid: int, collection: str, out) -> None:
                     volume_id=vid, collection=collection, shard_ids=moved
                 )
             )
-    # 5. delete the original volume on every replica
+    # 5. confirm the master has REGISTERED every mounted shard before
+    # any replica drops the volume. The mount beats ride each holder's
+    # own heartbeat stream (immediate on mount via Store.notify_change,
+    # but a stream mid-reconnect can delay one), so timing alone is not
+    # ordering — this poll is what turns mount-before-delete into
+    # registered-before-delete, the property that keeps reads available
+    # through the cutover (BASELINE config 5;
+    # volume_grpc_erasure_coding.go:25-36 ordering).
+    deadline = time.time() + 30
+    with env.master_channel() as ch:
+        stub = rpc.master_stub(ch)
+        while True:
+            try:
+                ec_resp = stub.LookupEcVolume(
+                    master_pb2.LookupEcVolumeRequest(volume_id=vid), timeout=5
+                )
+                seen = {e.shard_id for e in ec_resp.shard_id_locations if e.locations}
+            except grpc.RpcError:
+                seen = set()
+            if len(seen) >= ec_common.TOTAL_SHARDS_COUNT:
+                break
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"volume {vid}: only shards {sorted(seen)} registered with "
+                    "the master after 30s; refusing to delete the source volume "
+                    "(reads would go dark for the missing shards)"
+                )
+            time.sleep(0.05)
+    # 6. delete the original volume on every replica
     for url in locs:
         with env.volume_channel(url) as ch:
             rpc.volume_stub(ch).VolumeDelete(volume_pb2.VolumeDeleteRequest(volume_id=vid))

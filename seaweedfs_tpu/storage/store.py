@@ -78,6 +78,16 @@ class Store:
         counts = max_volume_counts or [7] * len(directories)
         self.ec_backend = ec_backend  # `ec.codec`: cpu|native|tpu|None=auto
         self.needle_map_kind = needle_map_kind
+        # invoked after any change to the heartbeat-visible inventory
+        # (volume add/delete/mount/unmount, readonly flips, EC shard
+        # mount/unmount). The volume server points this at its
+        # heartbeat wake-up so deltas reach the master immediately —
+        # the role of the reference's NewVolumesChan/NewEcShardsChan
+        # pushes (store.go:110-120, volume_grpc_client_to_master.go:150-170).
+        # The ordering guarantee of the EC migration pipeline (shards
+        # mounted and REGISTERED before the volume is deleted) depends
+        # on this, not on the periodic tick.
+        self.notify_change: callable = lambda: None
         self.locations = [
             DiskLocation(
                 d, c, ec_backend=ec_backend, needle_map_kind=needle_map_kind
@@ -129,23 +139,27 @@ class Store:
             needle_map_kind=self.needle_map_kind,
         )
         loc.volumes[vid] = v
+        self.notify_change()
         return v
 
     def delete_volume(self, vid: int) -> bool:
         for loc in self.locations:
             if loc.delete_volume(vid):
+                self.notify_change()
                 return True
         return False
 
     def mount_volume(self, vid: int) -> bool:
         for loc in self.locations:
             if loc.mount_volume(vid):
+                self.notify_change()
                 return True
         return False
 
     def unmount_volume(self, vid: int) -> bool:
         for loc in self.locations:
             if loc.unmount_volume(vid):
+                self.notify_change()
                 return True
         return False
 
@@ -154,6 +168,7 @@ class Store:
         if v is None:
             return False
         v.read_only = True
+        self.notify_change()
         return True
 
     def mark_volume_writable(self, vid: int) -> bool:
@@ -161,6 +176,7 @@ class Store:
         if v is None:
             return False
         v.read_only = False
+        self.notify_change()
         return True
 
     # --- needle IO (store.go:227-264) ---
@@ -202,6 +218,7 @@ class Store:
             loc.ec_volumes[vid] = ev
         for sid in shard_ids:
             ev.mount_shard(sid)
+        self.notify_change()
         return ev
 
     def unmount_ec_shards(self, vid: int, shard_ids: list[int]) -> None:
@@ -213,6 +230,7 @@ class Store:
         if not ev.shards:
             for loc in self.locations:
                 loc.ec_volumes.pop(vid, None)
+        self.notify_change()
 
     # --- heartbeat (store.go CollectHeartbeat) ---
     def collect_heartbeat(self) -> Heartbeat:

@@ -1,0 +1,142 @@
+"""BASELINE config 5: live replication→EC warm-tier migration under
+concurrent reads with ZERO read unavailability.
+
+The availability guarantee is an ordering property of the encode
+pipeline (reference volume_grpc_erasure_coding.go:25-36 + the read
+fallback volume_server_handlers_read.go:30-45): EC shards are
+generated, spread, and MOUNTED — and their locations registered with
+the master — strictly before the source volume is deleted, so at every
+instant some server can serve every key (from the volume while it
+lives, from the shard set afterwards). Two supporting mechanisms:
+
+  * immediate delta heartbeats (Store.notify_change →
+    VolumeServer._hb_wake): mount/delete inventory changes reach the
+    master NOW, not on the next tick — the reference's
+    NewVolumesChan/NewEcShardsChan pushes
+    (volume_grpc_client_to_master.go);
+  * master lookup falling back to EC shard holders once the volume's
+    locations are gone (topology.lookup → lookup_ec_shards).
+
+TestMigrationAvailability hammers readers through the full ec.encode
+pipeline and asserts zero failed reads. TestHarnessSensitivity proves
+the harness would catch a misordered pipeline: deleting the volume
+before mounting the shards makes the same readers fail.
+"""
+
+import io
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.util.availability import (
+    HammerReader,
+    run_with_readers,
+    start_cluster,
+    write_keyset,
+)
+from seaweedfs_tpu.shell.command_env import CommandEnv
+from seaweedfs_tpu.shell.commands import do_ec_encode
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    master, volume_servers = start_cluster(
+        [str(tmp_path_factory.mktemp(f"mig{i}")) for i in range(3)]
+    )
+    yield master, volume_servers
+    for vs in volume_servers:
+        vs.stop()
+    master.stop()
+
+
+class TestMigrationAvailability:
+    def test_zero_failed_reads_through_ec_encode(self, cluster):
+        master, volume_servers = cluster
+        vid, keys, source_url = write_keyset(master.port, "mig")
+        env = CommandEnv([f"127.0.0.1:{master.port}"])
+
+        readers = [
+            # a client that always asks the master (GET /<fid> 301 →
+            # current location, EC holders after the cutover)
+            HammerReader(f"http://127.0.0.1:{master.port}", keys, "via-master"),
+            # a client with a stale address book: keeps hitting the
+            # original location, which must serve from its shard subset
+            # (remote fan-in) or redirect — never fail
+            HammerReader(f"http://{source_url}", keys, "direct-source"),
+        ]
+        run_with_readers(
+            readers, lambda: do_ec_encode(env, vid, "mig", io.StringIO())
+        )
+
+        all_failures = [f for r in readers for f in r.failures]
+        assert all_failures == [], all_failures[:10]
+        for r in readers:
+            # both readers must have actually spanned the transition
+            assert r.reads >= 2 * len(keys), (r.label, r.reads)
+
+        # volume really is gone — reads are served by the EC set alone
+        assert all(vs.store.find_volume(vid) is None for vs in volume_servers)
+        locs = master.topology.lookup_ec_shards(vid)
+        assert locs is not None
+        assert sum(1 for l in locs.locations if l) == 14
+
+
+class TestHarnessSensitivity:
+    def test_misordered_pipeline_breaks_reads(self, cluster):
+        """Delete-before-mount (the ordering bug the pipeline exists to
+        prevent) must surface as reader failures — otherwise the zero-
+        failure assertion above proves nothing."""
+        import grpc
+
+        from seaweedfs_tpu.pb import rpc, volume_pb2
+
+        master, volume_servers = cluster
+        vid, keys, source_url = write_keyset(master.port, "mig2", n=20)
+        source = next(
+            vs for vs in volume_servers if f"127.0.0.1:{vs.port}" == source_url
+        )
+
+        def misordered():
+            with grpc.insecure_channel(f"127.0.0.1:{source.grpc_port}") as ch:
+                stub = rpc.volume_stub(ch)
+                stub.VolumeMarkReadonly(
+                    volume_pb2.VolumeMarkReadonlyRequest(volume_id=vid)
+                )
+                stub.VolumeEcShardsGenerate(
+                    volume_pb2.VolumeEcShardsGenerateRequest(
+                        volume_id=vid, collection="mig2"
+                    )
+                )
+            # WRONG: drop the volume from every replica before any
+            # shard is mounted anywhere
+            for vs in volume_servers:
+                with grpc.insecure_channel(f"127.0.0.1:{vs.grpc_port}") as ch:
+                    rpc.volume_stub(ch).VolumeDelete(
+                        volume_pb2.VolumeDeleteRequest(volume_id=vid)
+                    )
+            time.sleep(1.0)  # the unavailability window the readers see
+            # recover: mount the generated shards on the source
+            with grpc.insecure_channel(f"127.0.0.1:{source.grpc_port}") as ch:
+                rpc.volume_stub(ch).VolumeEcShardsMount(
+                    volume_pb2.VolumeEcShardsMountRequest(
+                        volume_id=vid,
+                        collection="mig2",
+                        shard_ids=list(range(14)),
+                    )
+                )
+
+        readers = [
+            HammerReader(f"http://127.0.0.1:{master.port}", keys, "via-master")
+        ]
+        run_with_readers(readers, misordered, settle=1.0)
+
+        assert readers[0].failures, (
+            "misordered pipeline produced no read failures — the "
+            "availability harness cannot detect ordering bugs"
+        )
+        # and the tail reads recovered once the shards were mounted
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{master.port}/{next(iter(keys))}", timeout=10
+        ) as r:
+            assert r.status == 200
