@@ -21,6 +21,8 @@ import io
 
 _IMAGE_EXTS = {".jpg", ".jpeg", ".png", ".gif"}
 
+_degrade_warned = False
+
 
 def _pil():
     try:
@@ -28,7 +30,42 @@ def _pil():
 
         return Image
     except ImportError:
+        _warn_degraded()
         return None
+
+
+def _warn_degraded() -> None:
+    # The reference always ships its imaging dep (disintegration/imaging),
+    # so a ?width= GET always resizes. Without Pillow we pass the original
+    # bytes through with a 200 — make that deviation observable instead of
+    # silent: one warning at first degrade, and /status reports it.
+    global _degrade_warned
+    if not _degrade_warned:
+        _degrade_warned = True
+        from seaweedfs_tpu.util import wlog
+
+        wlog.warning(
+            "Pillow unavailable: image resizing/orientation disabled; "
+            "?width=/?height= requests will return original bytes"
+        )
+
+
+_resizing_enabled: bool | None = None
+
+
+def resizing_enabled() -> bool:
+    """True when Pillow is importable (does not emit the degrade
+    warning). Cached: failed imports are not cached by Python, and this
+    sits on the volume /status path."""
+    global _resizing_enabled
+    if _resizing_enabled is None:
+        try:
+            from PIL import Image  # noqa: F401
+
+            _resizing_enabled = True
+        except ImportError:
+            _resizing_enabled = False
+    return _resizing_enabled
 
 
 def is_image_ext(ext: str) -> bool:
