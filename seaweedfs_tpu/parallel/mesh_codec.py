@@ -35,6 +35,7 @@ from seaweedfs_tpu.ec.codec_tpu import (
     apply_matrix_bits_u32_batch,
     gf_matrix_to_bits,
     swar_apply_matrix_u32_batch,
+    swar_verify_matrix_u32_batch,
 )
 
 VOL_AXIS = "vol"
@@ -190,13 +191,22 @@ class MeshCodec:
         return self._encode_sharded(self._parity_bits, volumes)
 
     # --- u32-lane fast path (SWAR per device on TPU meshes) ---
+    def _swar_tier(self) -> tuple[bool, bool]:
+        """(use_swar, interpret): the ONE u32 tier-dispatch predicate —
+        SWAR Pallas kernels on TPU meshes (interpreted under the test
+        flag), bit-matmul otherwise. _per_device_u32_apply (encode /
+        reconstruct) and _verify_sharded_u32 (the fused verify kernel)
+        both dispatch through this."""
+        return (self._tpu_mesh or self._swar_interpret, not self._tpu_mesh)
+
     def _per_device_u32_apply(self, rows: np.ndarray):
-        """ONE home for the u32 tier dispatch: SWAR Pallas kernel on
-        TPU meshes (interpret under the test flag), bit-matmul on CPU
-        meshes. encode/reconstruct/verify all build on this."""
+        """u32 apply for encode/reconstruct on the _swar_tier dispatch.
+        Verify does NOT build on this on the SWAR tier — it uses the
+        fused recompute-compare-count kernel (_verify_sharded_u32)
+        instead of recompute-then-compare."""
         rows = np.asarray(rows, dtype=np.uint8)
-        if self._tpu_mesh or self._swar_interpret:
-            interpret = not self._tpu_mesh
+        use_swar, interpret = self._swar_tier()
+        if use_swar:
 
             def per_device(vols_u32):
                 return swar_apply_matrix_u32_batch(rows, vols_u32, interpret)
@@ -337,17 +347,31 @@ class MeshCodec:
 
     @functools.cached_property
     def _verify_sharded_u32(self):
-        """One builder for both tiers: the per-device parity recompute
-        reuses the exact tier dispatch _apply_sharded_u32 encodes
-        (SWAR on TPU/interpret, bit-matmul on CPU meshes)."""
-        recompute = self._per_device_u32_apply(self.matrix[self.data_shards :])
+        """Tier dispatch mirrors _per_device_u32_apply: on TPU meshes
+        (and under the interpret test flag) the FUSED SWAR verify
+        kernel — recompute, compare, and count in one pallas call, no
+        HBM round-trip for the recomputed parity, which is what held
+        the unfused chain to a third of the encode rate — and the
+        bit-matmul recompute + XLA compare on CPU meshes."""
+        rows = np.asarray(self.matrix[self.data_shards :], dtype=np.uint8)
+        use_swar, interpret = self._swar_tier()
+        if use_swar:
 
-        def per_device(vols_u32, parity_u32):
-            local = jnp.sum(
-                (recompute(vols_u32) != parity_u32).astype(jnp.int32),
-                axis=(1, 2),
-            )  # [Bb] — mismatched-LANE count (u32 lanes; 0 = verified)
-            return jax.lax.psum(local, STRIPE_AXIS)
+            def per_device(vols_u32, parity_u32):
+                local = swar_verify_matrix_u32_batch(
+                    rows, vols_u32, parity_u32, interpret
+                )  # [Bb] — mismatched-LANE count (u32 lanes; 0 = verified)
+                return jax.lax.psum(local, STRIPE_AXIS)
+
+        else:
+            recompute = self._per_device_u32_apply(rows)
+
+            def per_device(vols_u32, parity_u32):
+                local = jnp.sum(
+                    (recompute(vols_u32) != parity_u32).astype(jnp.int32),
+                    axis=(1, 2),
+                )  # [Bb]
+                return jax.lax.psum(local, STRIPE_AXIS)
 
         return jax.jit(
             shard_map(
@@ -365,12 +389,16 @@ class MeshCodec:
     def verify_batch_u32(
         self, volumes_u32: jnp.ndarray, parity_u32: jnp.ndarray
     ) -> jnp.ndarray:
-        """u32-lane verify at the SWAR encode rate: recompute parity per
-        device and psum the mismatched-lane count over the stripe axis.
-        [B] int32, 0 = verified. This is the TPU production tier — the
-        u32 packing is the native device layout (see _swar_ok). Shape
-        contract matches encode_batch_u32: per-device N32 must divide
-        the stripe axis and stay a multiple of 256 lanes."""
+        """u32-lane verify at the SWAR encode rate (measured: 93 GB/s
+        vs 89-104 encode on one v5e chip, BENCH_r05 / docs/EC_KERNEL.md
+        round-5 section): the fused pallas kernel recomputes each
+        parity tile in VMEM, compares in register, and accumulates the
+        mismatched-lane count; the psum over the stripe axis reduces
+        the per-device counts. [B] int32, 0 = verified. This is the
+        TPU production tier — the u32 packing is the native device
+        layout (see _swar_ok). Shape contract matches encode_batch_u32:
+        per-device N32 must divide the stripe axis and stay a multiple
+        of 256 lanes."""
         return self._verify_sharded_u32(volumes_u32, parity_u32)
 
     def verify_batch(
