@@ -130,7 +130,8 @@ def assign(
     q = _assign_query(count, replication, collection, ttl, data_center)
     status, _, body = http_call("GET", f"{master}/dir/assign?{q}", timeout=30)
     try:
-        d = json.loads(body)
+        # decode first: json.loads(bytes) runs detect_encoding per call
+        d = json.loads(body.decode("utf-8", "replace"))
     except ValueError:
         raise RuntimeError(f"assign: bad response {body[:200]!r}")
     if status != 200 or d.get("error"):
@@ -210,9 +211,11 @@ class _RawHTTPConnection:
     bodies, 100-continue interim responses."""
 
     def __init__(self, host: str, port: int, timeout: float):
+        from seaweedfs_tpu.util.httpd import _BufReader
+
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, True)
-        self.rfile = self.sock.makefile("rb", buffering=65536)
+        self.rfile = _BufReader(self.sock)
         self.timeout = timeout
         self._host = host if port == 80 else f"{host}:{port}"
 
@@ -221,11 +224,10 @@ class _RawHTTPConnection:
         self.sock.settimeout(timeout)
 
     def close(self) -> None:
-        for closer in (self.rfile.close, self.sock.close):
-            try:
-                closer()
-            except OSError:
-                pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
     def send_request(
         self, method: str, path: str, body: bytes | None, headers: dict
@@ -252,42 +254,34 @@ class _RawHTTPConnection:
         """(status, FastHeaders, body, will_close)."""
         from seaweedfs_tpu.util.httpd import FastHeaders
 
-        readline = self.rfile.readline
         while True:
-            line = readline(65537)
-            if not line:
+            # whole head in one buffer scan + ONE decode: readline-per-
+            # header and per-line bytes strip/lower/decode were the
+            # client hot loop's biggest Python cost after syscalls
+            head = self.rfile.read_head()
+            if not head:
                 raise http.client.RemoteDisconnected("no status line")
-            # bytes-level fast path for the dominant exact shape; the
-            # decode path handles HTTP/0.9-isms and odd spacing
+            lines = head[:-4].decode("iso-8859-1").split("\r\n")
+            line = lines[0]
             if (
-                (line[:9] == b"HTTP/1.1 " or line[:9] == b"HTTP/1.0 ")
+                (line[:9] == "HTTP/1.1 " or line[:9] == "HTTP/1.0 ")
                 and line[9:12].isdigit()
-                and line[12:13] in (b" ", b"\r", b"\n")
             ):
-                version = "HTTP/1.1" if line[7:8] == b"1" else "HTTP/1.0"
+                version = "HTTP/1.1" if line[7] == "1" else "HTTP/1.0"
                 status = int(line[9:12])
             else:
-                parts = line.decode("latin-1").rstrip("\r\n").split(None, 2)
+                parts = line.split(None, 2)
                 if len(parts) < 2 or not parts[0].startswith("HTTP/"):
-                    raise http.client.BadStatusLine(
-                        line.decode("latin-1", "replace")
-                    )
+                    raise http.client.BadStatusLine(line)
                 try:
                     version, status = parts[0], int(parts[1])
                 except ValueError:
-                    raise http.client.BadStatusLine(
-                        line.decode("latin-1", "replace")
-                    ) from None
+                    raise http.client.BadStatusLine(line) from None
             headers = FastHeaders()
-            while True:
-                hline = readline(65537)
-                if hline in (b"\r\n", b"\n", b""):
-                    break
-                key, sep, value = hline.partition(b":")
+            for hline in lines[1:]:
+                key, sep, value = hline.partition(":")
                 if sep:
-                    headers[key.strip().lower().decode("latin-1")] = (
-                        value.strip().decode("latin-1")
-                    )
+                    headers[key.strip().lower()] = value.strip()
             if status != 100:
                 break
             # 100 Continue: interim — the real response follows
@@ -469,7 +463,7 @@ def upload(
         # them are "the upload failed", not caller crashes
         return UploadResult(error=str(e))
     try:
-        body = json.loads(raw or b"{}")
+        body = json.loads(raw.decode("utf-8", "replace") if raw else "{}")
     except ValueError:
         body = {}
     if status >= 300:

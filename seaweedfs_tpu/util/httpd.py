@@ -71,74 +71,15 @@ class FastHeaders(dict):
 
 
 class FastRequestMixin:
-    """Drop-in replacement for BaseHTTPRequestHandler.parse_request on
-    hot data-plane handlers, plus a one-syscall reply writer.
-
-    The stdlib parses headers through email.feedparser (policy objects,
-    universal newlines, MIME semantics) and writes responses one
-    send_header() call at a time; under `weed benchmark` both together
-    cost more than the actual needle append. This mixin parses headers
-    with a split-on-colon loop into FastHeaders and assembles whole
-    responses in one bytes buffer. Semantics kept: HTTP/1.0 vs 1.1
-    keep-alive defaults, Connection: close/keep-alive, Expect:
-    100-continue, 414/431 guards (matching net/http's behavior the
-    reference leans on)."""
-
-    def parse_request(self) -> bool:  # noqa: C901 - protocol state machine
-        self.command = None
-        self.request_version = version = self.default_request_version
-        self.close_connection = True
-        requestline = str(self.raw_requestline, "iso-8859-1").rstrip("\r\n")
-        self.requestline = requestline
-        words = requestline.split()
-        if len(words) == 3:
-            command, path, version = words
-            if not version.startswith("HTTP/"):
-                self.send_error(400, f"Bad request version ({version!r})")
-                return False
-            self.request_version = version
-            self.close_connection = version <= "HTTP/1.0"
-        elif len(words) == 2:
-            command, path = words  # HTTP/0.9 GET
-            if command != "GET":
-                self.send_error(400, f"Bad HTTP/0.9 request type ({command!r})")
-                return False
-        else:
-            self.send_error(400, f"Bad request syntax ({requestline!r})")
-            return False
-        self.command, self.path = command, path
-
-        headers = FastHeaders()
-        rfile = self.rfile
-        total = 0
-        while True:
-            line = rfile.readline(65537)
-            if len(line) > 65536:
-                self.send_error(431, "Line too long")
-                return False
-            total += len(line)
-            if total > 131072:
-                self.send_error(431, "Too many headers")
-                return False
-            if line in (b"\r\n", b"\n", b""):
-                break
-            key, sep, value = line.decode("iso-8859-1").partition(":")
-            if sep:
-                headers[key.strip().lower()] = value.strip()
-        self.headers = headers
-
-        conn = headers.get("connection", "").lower()
-        if conn == "close":
-            self.close_connection = True
-        elif conn == "keep-alive":
-            self.close_connection = False
-        if (
-            headers.get("expect", "").lower() == "100-continue"
-            and self.protocol_version >= "HTTP/1.1"
-            and self.request_version >= "HTTP/1.1"
-        ):
-            self.wfile.write(b"HTTP/1.1 100 Continue\r\n\r\n")
-        return True
+    """Marks a handler as data-plane: WeedHTTPServer drives it through
+    the mini request loop (serve_connection) instead of the stdlib
+    socketserver/BaseHTTPRequestHandler machinery, and fast_reply
+    writes whole responses (status+headers+body) in ONE buffer/syscall
+    — under `weed benchmark` the stdlib's email.feedparser header
+    parsing plus send_header-per-line writing cost more than the
+    needle append being measured. Head parsing (one-buffer scan,
+    FastHeaders, keep-alive/Expect/431 semantics) lives in
+    serve_connection — ONE parser, not two that drift."""
 
     def fast_reply(self, status: int, body: bytes = b"", headers=None) -> None:
         """status + headers + Content-Length + body in ONE write.
@@ -188,6 +129,229 @@ _REASON = {
 }
 
 
+class _BufReader:
+    """Minimal buffered reader over a socket for the mini request loop:
+    one recv fills a buffer; the request head is scanned out of it in
+    one pass, bodies and chunk lines drain it before hitting the
+    socket again. Tracks total consumed bytes so the connection loop
+    can realign (or bail) when a handler leaves body bytes unread."""
+
+    __slots__ = ("_sock", "_buf", "_pos", "consumed")
+
+    def __init__(self, sock):
+        self._sock = sock
+        self._buf = b""
+        self._pos = 0
+        self.consumed = 0
+
+    def _fill(self) -> bool:
+        chunk = self._sock.recv(65536)
+        if not chunk:
+            return False
+        if self._pos:
+            self._buf = self._buf[self._pos :] + chunk
+            self._pos = 0
+        else:
+            self._buf += chunk
+        return True
+
+    def read_head(self, limit: int = 131072) -> bytes | None:
+        """Bytes up to and including the blank line; None on clean EOF
+        before any byte; raises ValueError past `limit` (431)."""
+        while True:
+            idx = self._buf.find(b"\r\n\r\n", self._pos)
+            if idx >= 0:
+                head = self._buf[self._pos : idx + 4]
+                self._pos = idx + 4
+                self.consumed += len(head)
+                return head
+            if len(self._buf) - self._pos > limit:
+                raise ValueError("request head too large")
+            if not self._fill():
+                return None if len(self._buf) == self._pos else b""
+
+    def read(self, n: int | None = None) -> bytes:
+        if n is None:  # EOF-delimited (HTTP/1.0-style bodies)
+            while self._fill():
+                pass
+            out = self._buf[self._pos :]
+            self._pos = len(self._buf)
+            self.consumed += len(out)
+            return out
+        avail = len(self._buf) - self._pos
+        while avail < n:
+            if not self._fill():
+                break
+            avail = len(self._buf) - self._pos
+        out = self._buf[self._pos : self._pos + n]
+        self._pos += len(out)
+        self.consumed += len(out)
+        return out
+
+    def readline(self, limit: int = 65537) -> bytes:
+        while True:
+            idx = self._buf.find(b"\n", self._pos)
+            if idx >= 0 and idx - self._pos < limit:
+                out = self._buf[self._pos : idx + 1]
+                self._pos = idx + 1
+                self.consumed += len(out)
+                return out
+            if idx < 0 and len(self._buf) - self._pos >= limit:
+                out = self._buf[self._pos : self._pos + limit]
+                self._pos += limit
+                self.consumed += limit
+                return out
+            if not self._fill():
+                out = self._buf[self._pos :]
+                self._pos = len(self._buf)
+                self.consumed += len(out)
+                return out
+
+
+class _SockWriter:
+    """wfile facade: sendall semantics (a raw SocketIO.write may short-
+    write large bodies), no buffering to flush."""
+
+    __slots__ = ("_sock",)
+
+    def __init__(self, sock):
+        self._sock = sock
+
+    def write(self, data) -> int:
+        self._sock.sendall(data)
+        return len(data)
+
+    def flush(self) -> None:
+        pass
+
+
+_DISPATCH_CACHE: dict[type, dict] = {}
+
+
+def _dispatch_table(handler_cls: type) -> dict:
+    table = _DISPATCH_CACHE.get(handler_cls)
+    if table is None:
+        table = {
+            name[3:]: getattr(handler_cls, name)
+            for name in dir(handler_cls)
+            if name.startswith("do_")
+        }
+        _DISPATCH_CACHE[handler_cls] = table
+    return table
+
+
+def serve_connection(sock, addr, server, handler_cls) -> None:
+    """The mini per-connection request loop: replaces the
+    socketserver → BaseHTTPRequestHandler.handle → handle_one_request →
+    parse_request stack on the data plane. One handler object per
+    connection (no per-request construction), the whole request head
+    read and parsed out of one buffer (no per-header readline), dict
+    dispatch instead of getattr-per-request. The handler classes are
+    unchanged — this drives the same do_GET/do_POST/... methods with
+    the same surface (path/command/headers/rfile/wfile/client_address/
+    close_connection, fast_reply, and BaseHTTPRequestHandler's
+    send_response/send_header/end_headers/send_error for the slow
+    paths)."""
+    h = handler_cls.__new__(handler_cls)  # skip BaseHTTPRequestHandler.__init__
+    h.server = server
+    h.client_address = addr
+    h.connection = sock
+    reader = _BufReader(sock)
+    h.rfile = reader
+    h.wfile = _SockWriter(sock)
+    table = _dispatch_table(handler_cls)
+    proto11 = handler_cls.protocol_version >= "HTTP/1.1"
+    try:
+        while True:
+            try:
+                head = reader.read_head()
+            except ValueError:
+                h.close_connection = True
+                h.command = None
+                h.fast_reply(431)
+                return
+            if not head:
+                return
+            lines = head[:-4].decode("iso-8859-1").split("\r\n")
+            requestline = lines[0]
+            words = requestline.split()
+            h.requestline = requestline
+            if len(words) == 3:
+                command, path, version = words
+                if not version.startswith("HTTP/"):
+                    _bad_request(h, f"Bad request version ({version!r})")
+                    return
+            elif len(words) == 2 and words[0] == "GET":
+                command, path = words
+                version = "HTTP/0.9"
+            else:
+                _bad_request(h, f"Bad request syntax ({requestline!r})")
+                return
+            h.command = command
+            h.path = path
+            h.request_version = version
+            close = version <= "HTTP/1.0"
+
+            headers = FastHeaders()
+            for line in lines[1:]:
+                key, sep, value = line.partition(":")
+                if sep:
+                    headers[key.strip().lower()] = value.strip()
+            h.headers = headers
+
+            conn = headers.get("connection", "").lower()
+            if conn == "close":
+                close = True
+            elif conn == "keep-alive":
+                close = False
+            h.close_connection = close
+            if (
+                proto11
+                and version >= "HTTP/1.1"
+                and headers.get("expect", "").lower() == "100-continue"
+            ):
+                sock.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
+
+            method = table.get(command)
+            if method is None:
+                h.close_connection = True
+                h.fast_reply(405)
+                return
+
+            # body accounting: a handler that returns without draining
+            # its request body would desync the next request on this
+            # connection — skip small remainders, close otherwise
+            try:
+                length = int(headers.get("content-length", 0) or 0)
+            except ValueError:
+                _bad_request(h, "Bad Content-Length")
+                return
+            chunked = "chunked" in headers.get("transfer-encoding", "").lower()
+            body_end = reader.consumed + length
+
+            method(h)
+
+            if chunked:
+                # can't know from here whether the terminal chunk was
+                # consumed; never reuse the connection
+                return
+            if reader.consumed < body_end:
+                if body_end - reader.consumed <= 1 << 20:
+                    reader.read(body_end - reader.consumed)
+                else:
+                    return
+            if h.close_connection:
+                return
+    except (ConnectionError, BrokenPipeError, TimeoutError, OSError):
+        pass
+
+
+def _bad_request(h, msg: str) -> None:
+    h.close_connection = True
+    h.request_version = "HTTP/1.1"
+    h.fast_reply(400, msg.encode("latin-1", "replace"))
+
+
 class WeedHTTPServer(ThreadingHTTPServer):
     request_queue_size = 256
 
@@ -199,6 +363,19 @@ class WeedHTTPServer(ThreadingHTTPServer):
         sock, addr = super().get_request()
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, True)
         return sock, addr
+
+    def finish_request(self, request, client_address):
+        # data-plane handlers (FastRequestMixin: volume, master,
+        # workers) ride the mini request loop; plain
+        # BaseHTTPRequestHandler handlers (filer, s3, webdav — they
+        # depend on stdlib header/Message semantics) keep the stdlib
+        # per-request machinery
+        if hasattr(self.RequestHandlerClass, "fast_reply"):
+            serve_connection(
+                request, client_address, self, self.RequestHandlerClass
+            )
+        else:
+            super().finish_request(request, client_address)
 
 
 class ReusePortWeedHTTPServer(WeedHTTPServer):
