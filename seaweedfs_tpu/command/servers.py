@@ -191,6 +191,15 @@ class VolumeCommand(Command):
             "multi-core hosts scale the GIL-bound read path — see "
             "server/volume_workers.py)",
         )
+        p.add_argument(
+            "-shardWrites",
+            action="store_true",
+            help="with -workers N: partition WRITE ownership across the "
+            "N processes by volume id (vid %% N), each appending its own "
+            "volumes' .dat/.idx — multi-core write scaling under the "
+            "single-writer-per-volume invariant; admin ops (vacuum, EC "
+            "encode, readonly) hand ownership back to the lead first",
+        )
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
@@ -215,6 +224,16 @@ class VolumeCommand(Command):
             if not 0 < internal_port <= 65535:
                 print(f"volume: no usable internal port for -port {args.port}")
                 return 1
+        guard = _load_guard()
+        shard_writes = args.shardWrites and workers > 1
+        if shard_writes and guard is not None:
+            # workers cannot validate write JWTs yet; sharded local
+            # writes would bypass the signature check
+            wlog.warning(
+                "-shardWrites disabled: jwt.signing is configured and "
+                "write workers cannot validate tokens"
+            )
+            shard_writes = False
         server = VolumeServer(
             dirs,
             host=args.ip,
@@ -225,12 +244,14 @@ class VolumeCommand(Command):
             rack=args.rack,
             max_volume_counts=maxes,
             read_redirect=args.readRedirect,
-            guard=_load_guard(),
+            guard=guard,
             ec_codec=args.ec_codec,
             storage_backends=load_config("master").sub("storage.backend"),
             needle_map_kind=args.index,
             reuse_port=workers > 1,
             internal_port=internal_port,
+            shard_writes=shard_writes,
+            n_writers=workers if shard_writes else 1,
         )
         from seaweedfs_tpu.util.profiling import CpuProfile
 
@@ -246,6 +267,10 @@ class VolumeCommand(Command):
                     args.ip,
                     args.port,
                     f"127.0.0.1:{internal_port}",
+                    shard_writes=shard_writes,
+                    n_writers=workers,
+                    master=args.mserver,
+                    internal_base=internal_port,
                 )
             wlog.info(
                 "volume server %s:%d -> master %s (%d worker(s))",
@@ -270,6 +295,11 @@ class VolumeWorkerCommand(Command):
         p.add_argument("-dir", required=True)
         p.add_argument("-lead", required=True, help="lead's internal host:port")
         p.add_argument("-workerPort", type=int, default=0)
+        p.add_argument("-shardWrites", action="store_true")
+        p.add_argument("-writerIndex", type=int, default=0)
+        p.add_argument("-writers", type=int, default=1)
+        p.add_argument("-mserver", default="")
+        p.add_argument("-internalPort", type=int, default=0)
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
@@ -282,6 +312,11 @@ class VolumeWorkerCommand(Command):
             port=args.port,
             lead=args.lead,
             worker_port=args.workerPort,
+            shard_writes=args.shardWrites,
+            writer_index=args.writerIndex,
+            n_writers=args.writers,
+            master=args.mserver,
+            internal_port=args.internalPort,
         )
         worker.start()
         try:

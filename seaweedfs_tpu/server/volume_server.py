@@ -45,6 +45,7 @@ from seaweedfs_tpu.util.httpd import (
     fast_query,
 )
 
+from seaweedfs_tpu.server import write_path
 from seaweedfs_tpu.storage.file_id import FileId, parse_path_fid, parse_url_path
 from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.store import Store
@@ -114,6 +115,8 @@ class VolumeServer:
         needle_map_kind: str = "memory",
         reuse_port: bool = False,
         internal_port: int = 0,
+        shard_writes: bool = False,
+        n_writers: int = 1,
     ):
         # `ec.codec` config: "cpu" | "native" | "tpu" | "" (auto: tpu
         # with a JAX device, else the native SIMD shim, else numpy).
@@ -179,6 +182,24 @@ class VolumeServer:
         self.reuse_port = reuse_port
         self.internal_port = internal_port
         self._internal_server: ThreadingHTTPServer | None = None
+        # -shardWrites: volume-ownership write sharding across the
+        # -workers processes. Writer k of n_writers owns vids with
+        # vid % n_writers == k (lead is writer 0) and is the ONLY
+        # process that appends those volumes' .dat/.idx — the
+        # single-writer-per-volume invariant the reference enforces
+        # in-process (volume_read_write.go:66), partitioned across
+        # processes. Ownership of a vid reverts permanently to the
+        # lead (self._shard_taken) before any file-rewriting admin op
+        # — vacuum, EC encode, readonly, delete — via _ensure_owned's
+        # release handshake with the owning worker.
+        self.shard_writes = shard_writes
+        self.n_writers = max(1, n_writers)
+        self._shard_taken: set[int] = set()
+        self._shard_lock = threading.Lock()  # guards the sets/dicts only
+        # per-vid handshake locks: the release round-trip can block for
+        # seconds on a wedged worker and must not serialize takeovers
+        # (or hop-writes) of unrelated vids behind one global lock
+        self._shard_vid_locks: dict[int, threading.Lock] = {}
 
     # ------------------------------------------------------------------
     # status UI (server/volume_server_ui/templates.go role)
@@ -250,6 +271,13 @@ class VolumeServer:
                 # state to a liveness sweep or a leader change)
                 self._force_full_heartbeat.clear()
                 last_vids = None
+            if self.shard_writes:
+                # worker-owned volumes: fold the owners' appended .idx
+                # entries in so file counts ride the beat accurately
+                for loc in self.store.locations:
+                    for vid, v in list(loc.volumes.items()):
+                        if self._shard_is_foreign(vid):
+                            v.refresh_from_idx()
             hb = self.store.collect_heartbeat()
             req = master_pb2.HeartbeatRequest(
                 ip=self.host,
@@ -382,20 +410,24 @@ class VolumeServer:
         return pb.AllocateVolumeResponse()
 
     def VolumeDelete(self, req: pb.VolumeDeleteRequest, context):
+        self._ensure_owned(req.volume_id)
         self.store.delete_volume(req.volume_id)
         return pb.VolumeDeleteResponse()
 
     def VolumeMount(self, req, context):
+        self._ensure_owned(req.volume_id)
         if not self.store.mount_volume(req.volume_id):
             context.abort(grpc.StatusCode.NOT_FOUND, f"volume {req.volume_id} not found")
         return pb.VolumeMountResponse()
 
     def VolumeUnmount(self, req, context):
+        self._ensure_owned(req.volume_id)
         if not self.store.unmount_volume(req.volume_id):
             context.abort(grpc.StatusCode.NOT_FOUND, f"volume {req.volume_id} not found")
         return pb.VolumeUnmountResponse()
 
     def VolumeMarkReadonly(self, req, context):
+        self._ensure_owned(req.volume_id)
         self.store.mark_volume_readonly(req.volume_id)
         return pb.VolumeMarkReadonlyResponse()
 
@@ -445,12 +477,20 @@ class VolumeServer:
 
     # vacuum 4-phase (volume_grpc_vacuum.go)
     def VacuumVolumeCheck(self, req, context):
+        # read-only phase: an accurate garbage ratio needs the owner's
+        # appended entries folded in, NOT a permanent ownership seizure
+        # (the master's periodic sweep checks every volume — takeover
+        # here would collapse -shardWrites to lead-only in one sweep)
+        v0 = self.store.find_volume(req.volume_id)
+        if v0 is not None and self._shard_is_foreign(req.volume_id):
+            v0.refresh_from_idx()
         v = self.store.find_volume(req.volume_id)
         if v is None:
             context.abort(grpc.StatusCode.NOT_FOUND, f"volume {req.volume_id} not found")
         return pb.VacuumVolumeCheckResponse(garbage_ratio=v.garbage_level())
 
     def VacuumVolumeCompact(self, req, context):
+        self._ensure_owned(req.volume_id)
         v = self.store.find_volume(req.volume_id)
         if v is None:
             context.abort(grpc.StatusCode.NOT_FOUND, f"volume {req.volume_id} not found")
@@ -458,6 +498,7 @@ class VolumeServer:
         return pb.VacuumVolumeCompactResponse()
 
     def VacuumVolumeCommit(self, req, context):
+        self._ensure_owned(req.volume_id)
         v = self.store.find_volume(req.volume_id)
         if v is None:
             context.abort(grpc.StatusCode.NOT_FOUND, f"volume {req.volume_id} not found")
@@ -465,6 +506,7 @@ class VolumeServer:
         return pb.VacuumVolumeCommitResponse()
 
     def VacuumVolumeCleanup(self, req, context):
+        self._ensure_owned(req.volume_id)
         v = self.store.find_volume(req.volume_id)
         if v is not None:
             v.cleanup_compact()
@@ -474,6 +516,7 @@ class VolumeServer:
     def VolumeCopy(self, req: pb.VolumeCopyRequest, context):
         """Replicate a whole volume from another node by pulling its
         .dat/.idx over the CopyFile stream (volume_grpc_copy.go:25)."""
+        self._ensure_owned(req.volume_id)
         if self.store.has_volume(req.volume_id):
             context.abort(
                 grpc.StatusCode.ALREADY_EXISTS,
@@ -642,6 +685,7 @@ class VolumeServer:
         return new_encoder(backend=self.ec_codec)
 
     def VolumeEcShardsGenerate(self, req, context):
+        self._ensure_owned(req.volume_id)
         v = self.store.find_volume(req.volume_id)
         if v is None:
             context.abort(grpc.StatusCode.NOT_FOUND, f"volume {req.volume_id} not found")
@@ -770,6 +814,7 @@ class VolumeServer:
     def VolumeEcShardsToVolume(self, req, context):
         """Decode mounted shards back into a normal volume
         (volume_grpc_erasure_coding.go:329)."""
+        self._ensure_owned(req.volume_id)
         ev = self.store.find_ec_volume(req.volume_id)
         if ev is None:
             context.abort(grpc.StatusCode.NOT_FOUND, f"ec volume {req.volume_id} not found")
@@ -836,6 +881,7 @@ class VolumeServer:
     def VolumeTierMoveDatToRemote(self, req, context):
         """Copy a sealed volume's .dat to a remote backend, streaming
         progress; the volume then serves reads via ranged GETs."""
+        self._ensure_owned(req.volume_id)
         v = self.store.find_volume(req.volume_id)
         if v is None:
             context.abort(
@@ -1000,6 +1046,41 @@ class VolumeServer:
             def _json(self, obj, status=200):
                 self._reply(status, json.dumps(obj).encode(), _JSON_HDR)
 
+            def _route_shard_write(self, fid, body: bytes) -> bool:
+                """-shardWrites: forward POST/DELETE for a worker-owned
+                vid to that worker's internal listener. True = replied
+                (routed); False = this process handles the write (it is
+                the owner, took ownership back, or the worker died and
+                ownership fell back here)."""
+                if not server._shard_is_foreign(fid.volume_id):
+                    return False
+                if self.headers.get("x-shard-hop"):
+                    # the owner could not serve this (unparsed form,
+                    # manifest cascade, mid-commit volume): take the
+                    # vid over and handle it here - routing back would
+                    # loop
+                    server._ensure_owned(fid.volume_id)
+                    return False
+                result = server._proxy_to_writer(
+                    server._shard_owner(fid.volume_id),
+                    self.command,
+                    self.path,
+                    body,
+                    self.headers,
+                )
+                if result is None:
+                    # dead worker: permanent takeover, then local write
+                    server._ensure_owned(fid.volume_id)
+                    return False
+                status, rheaders, data = result
+                out = {
+                    k: v
+                    for k, v in rheaders.items()
+                    if k not in ("connection", "keep-alive", "content-length")
+                }
+                self.fast_reply(status, data, out)
+                return True
+
             def _parse_fid(self):
                 """(FileId, query, filename, ext) from any of the
                 reference's addressing forms (common.go:152
@@ -1060,6 +1141,14 @@ class VolumeServer:
                         server._render_ui().encode(),
                         {"Content-Type": "text/html; charset=utf-8"},
                     )
+                if url_path == "/__shard/taken":
+                    # write-sharding control surface (workers sync the
+                    # taken-over vid list at startup) — loopback
+                    # internal listener ONLY; on the public port an
+                    # anonymous client must not even learn it exists
+                    if self.server is not server._internal_server:
+                        return self._json({"error": "not found"}, 404)
+                    return self._json(sorted(server._shard_taken))
                 if url_path == "/status":
                     from seaweedfs_tpu import images
 
@@ -1090,6 +1179,7 @@ class VolumeServer:
                 try:
                     v = server.store.find_volume(fid.volume_id)
                     if v is not None:
+                        server._shard_refresh(v)
                         n = v.read_needle(fid.key, cookie=fid.cookie)
                     else:
                         ev = server.store.find_ec_volume(fid.volume_id)
@@ -1303,95 +1393,20 @@ class VolumeServer:
                     return
                 length = int(self.headers.get("content-length", "0"))
                 body = self.rfile.read(length)
-                # `curl -F file=@x` multipart forms carry the payload,
-                # filename, and mime inside the body (needle.go:85
-                # ParseUpload); raw bodies pass through inline — the
-                # parser call is only paid when the request is a form
-                ctype = self.headers.get("content-type", "")
-                part_filename = ""
-                is_gzipped = False
-                if ctype[:19].lower() == "multipart/form-data":
-                    from seaweedfs_tpu.util.multipart import (
-                        MalformedUpload,
-                        parse_upload,
-                    )
-
-                    try:
-                        part = parse_upload(body, ctype)
-                    except MalformedUpload as e:
-                        return self._json({"error": str(e)}, 400)
-                    data, ctype, part_filename = part.data, part.mime, part.filename
-                    is_gzipped = part.is_gzipped
-                else:
-                    data = body
-                    # raw bodies may arrive pre-gzipped (Content-Encoding)
-                    is_gzipped = (
-                        self.headers.get("content-encoding", "").lower() == "gzip"
-                    )
-                n = Needle(cookie=fid.cookie, id=fid.key, data=data)
-                if ctype and len(ctype) < 256 and ctype != "application/octet-stream":
-                    n.mime = ctype.encode()
-                    n.set_has_mime()
-                fname = q.get("filename", "") or part_filename or url_filename
-                if fname and len(fname) < 256:
-                    n.name = fname.encode()
-                    n.set_has_name()
-                    if server.fix_jpg_orientation and fname.lower().endswith(
-                        (".jpg", ".jpeg")
-                    ):
-                        from seaweedfs_tpu import images
-
-                        n.data = images.fix_jpg_orientation(bytes(n.data))
-                if is_gzipped:
-                    n.set_gzipped()
-                elif len(n.data) > 128:
-                    # transparent server-side compression when the type
-                    # says it pays (needle_parse_multipart.go:86-97 +
-                    # util/compression.go IsGzippable); deterministic,
-                    # so replica fan-out re-derives identical needles
-                    from seaweedfs_tpu.util.compression import is_gzippable
-
-                    fext = os.path.splitext(fname)[1] if fname else ""
-                    if is_gzippable(fext, ctype or "", bytes(n.data)):
-                        import gzip as _gzip
-
-                        # mtime=0: replicas re-derive the needle from
-                        # the raw body, so the stream must be identical
-                        packed = _gzip.compress(bytes(n.data), 6, mtime=0)
-                        if len(packed) < len(n.data):
-                            n.data = packed
-                            n.set_gzipped()
-                if q.get("cm") == "true":
-                    n.set_is_chunk_manifest()
-                # Seaweed-* request headers persist as needle pairs
-                # (needle.go:37-42 PairNamePrefix + :101-113)
-                pair_map = {
-                    k[8:]: v
-                    for k, v in self.headers.items()
-                    if k.startswith("seaweed-")
-                }
-                if pair_map:
-                    pairs = json.dumps(pair_map).encode()
-                    if len(pairs) < 65536:
-                        n.pairs = pairs
-                        n.set_has_pairs()
-                # ts= overrides the modification stamp; ttl= stores a
-                # per-needle ttl (needle.go:79-81)
-                try:
-                    n.last_modified = int(q.get("ts", "") or 0) or int(time.time())
-                except ValueError:
-                    n.last_modified = int(time.time())
-                n.set_has_last_modified_date()
-                ttl_param = q.get("ttl", "")
-                if ttl_param:
-                    from seaweedfs_tpu.storage.ttl import TTL
-
-                    try:
-                        n.ttl = TTL.parse(ttl_param)
-                        if n.ttl.count:
-                            n.set_has_ttl()
-                    except ValueError:
-                        pass
+                if server.shard_writes:
+                    routed = self._route_shard_write(fid, body)
+                    if routed:
+                        return
+                n, fname, err = write_path.build_upload_needle(
+                    fid,
+                    q,
+                    body,
+                    self.headers,
+                    url_filename,
+                    server.fix_jpg_orientation,
+                )
+                if err is not None:
+                    return self._json({"error": err}, 400)
                 try:
                     size, unchanged = server.store.write_needle(fid.volume_id, n)
                 except NeedleNotFound:
@@ -1414,6 +1429,8 @@ class VolumeServer:
                 if fid is None:
                     return self._json({"error": "invalid file id"}, 400)
                 if not self._check_write_auth():
+                    return
+                if server.shard_writes and self._route_shard_write(fid, b""):
                     return
                 n = Needle(cookie=fid.cookie, id=fid.key)
                 try:
@@ -1531,6 +1548,103 @@ class VolumeServer:
             except OSError:
                 continue
 
+    # --- -shardWrites: volume-ownership write sharding -----------------
+    def _shard_owner(self, vid: int) -> int:
+        return vid % self.n_writers
+
+    def _writer_internal_addr(self, writer_index: int) -> str:
+        return f"127.0.0.1:{self.internal_port + writer_index}"
+
+    def _shard_is_foreign(self, vid: int) -> bool:
+        """True while a WORKER owns this vid's writes (so this process
+        must route writes and refresh before reads)."""
+        return (
+            self.shard_writes
+            and self._shard_owner(vid) != 0
+            and vid not in self._shard_taken
+        )
+
+    def _shard_refresh(self, v) -> None:
+        """Replay the owner's .idx tail before serving a read of a
+        worker-owned volume (read-your-writes across processes)."""
+        if self._shard_is_foreign(v.id):
+            v.refresh_from_idx()
+
+    def _ensure_owned(self, vid: int) -> None:
+        """Take a vid's write ownership back from its worker before a
+        file-rewriting admin op (vacuum, EC encode, readonly, delete,
+        copy). Permanent: ownership never returns to the worker (the
+        worker proxies that vid's writes here from then on). The
+        handshake is synchronous — the op must not start while the
+        worker could still append; a connection refusal means the
+        worker is dead, which is an implicit release."""
+        if not self.shard_writes:
+            return
+        owner = self._shard_owner(vid)
+        if owner == 0:
+            return
+        with self._shard_lock:
+            if vid in self._shard_taken:
+                return
+            vlock = self._shard_vid_locks.setdefault(vid, threading.Lock())
+        with vlock:
+            with self._shard_lock:
+                if vid in self._shard_taken:
+                    return
+            import urllib.request
+
+            try:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://{self._writer_internal_addr(owner)}"
+                        f"/__shard/release?vid={vid}",
+                        method="POST",
+                    ),
+                    timeout=10,
+                ).close()
+            except ConnectionError:
+                pass  # dead worker: implicit release
+            except OSError as e:
+                if not isinstance(getattr(e, "reason", None), ConnectionError):
+                    raise  # alive-but-failing worker: do NOT double-write
+            v = self.store.find_volume(vid)
+            if v is not None:
+                v.refresh_from_idx()
+            with self._shard_lock:
+                self._shard_taken.add(vid)
+
+    def _proxy_to_writer(
+        self, writer_index: int, method: str, path: str, body: bytes, headers
+    ):
+        """Forward a write to its owning worker's internal listener.
+        Returns (status, headers, data) or None when unreachable."""
+        from seaweedfs_tpu.client.operation import _drop_conn, _pooled_conn
+
+        addr = self._writer_internal_addr(writer_index)
+        fwd = {
+            k: v
+            for k, v in headers.items()
+            if k not in ("connection", "keep-alive", "content-length", "host")
+        }
+        try:
+            c, reused = _pooled_conn(addr, 30.0)
+            try:
+                c.send_request(method, path, body, fwd)
+                status, rheaders, data, will_close = c.read_response(method)
+            except OSError:
+                _drop_conn(addr)
+                if not reused:
+                    raise
+                c, _ = _pooled_conn(addr, 30.0)
+                c.send_request(method, path, body, fwd)
+                status, rheaders, data, will_close = c.read_response(method)
+            if will_close:
+                _drop_conn(addr)
+            return status, rheaders, data
+        except OSError:
+            _drop_conn(addr)
+            return None
+
     def _replicate(self, fid: FileId, q: dict, method: str, body: bytes, headers: dict) -> str | None:
         """Fan the write to replica peers (store_replicate.go:44-80)."""
         v = self.store.find_volume(fid.volume_id)
@@ -1538,51 +1652,11 @@ class VolumeServer:
             return None
         if not self.master:
             return None
-        import urllib.request
-
         all_locations = self._lookup_locations(fid.volume_id)
         if all_locations is None:
             return "replication lookup failed"
         locations = [u for u in all_locations if u != f"{self.host}:{self.port}"]
-        # forward the original query params (filename/cm/ttl…) so replica
-        # needles carry the same flags (store_replicate.go:44 keeps the url)
-        from urllib.parse import urlencode
-
-        params = {k: v for k, v in q.items() if k != "type"}
-        params["type"] = "replicate"
-        for url in locations:
-            try:
-                req = urllib.request.Request(
-                    f"http://{url}/{fid}?{urlencode(params)}",
-                    data=body if method == "POST" else None,
-                    method=method,
-                )
-                # FastHeaders stores keys lowercased; look up both
-                # spellings so a plain-dict caller keeps working too
-                ct = headers.get("Content-Type") or headers.get("content-type")
-                if ct:
-                    req.add_header("Content-Type", ct)
-                ce = headers.get("Content-Encoding") or headers.get(
-                    "content-encoding"
-                )
-                if ce:  # pre-gzipped uploads must stay flagged on replicas
-                    req.add_header("Content-Encoding", ce)
-                for hk, hv in headers.items():
-                    if hk.lower().startswith("seaweed-"):
-                        req.add_header(hk, hv)  # pairs replicate too
-                auth = headers.get("Authorization") or headers.get(
-                    "authorization"
-                )
-                if auth:  # keep the write jwt valid on the replica hop
-                    req.add_header("Authorization", auth)
-                with urllib.request.urlopen(req, timeout=10) as r:
-                    if r.status >= 300:
-                        return f"replica {url} returned {r.status}"
-            except OSError as e:
-                return f"replica {url} failed: {e}"
-        return None
-
-    # ------------------------------------------------------------------
+        return write_path.replicate_to_peers(fid, q, method, body, headers, locations)
     def start(self) -> None:
         self._grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         self._grpc_server.add_generic_rpc_handlers(

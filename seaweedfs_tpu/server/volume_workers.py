@@ -17,15 +17,21 @@ listeners — the mechanism nginx/envoy use for per-core workers):
     UI/status pages, image resizing — is proxied over a pooled
     keep-alive connection to the lead's internal listener, so the
     whole surface stays available on every accepted connection;
-  * the LEAD (worker 0) remains the one full volume server: it owns
-    all writes (single-writer per volume, like the reference), runs
-    the gRPC admin plane, and sends the heartbeats. Its inventory
+  * the LEAD (worker 0) remains the one full volume server: it runs
+    the gRPC admin plane and sends the heartbeats. Its inventory
     covers the shared directories, so the master sees one data node.
+  * with `-shardWrites`, workers additionally OWN the writes for vids
+    with vid % N == their index: they append those volumes'
+    .dat/.idx themselves (single-writer-per-volume, partitioned
+    across processes), fan out replication on first-hop writes, and
+    hand ownership back to the lead before any file-rewriting admin
+    op (the /__shard/release handshake; see OPERATIONS.md round 5).
 
-Read-your-writes holds because the lead appends the `.idx` entry (and
-flushes it) before replying 201, and workers re-check the idx size on
-every lookup miss-or-hit cycle. Vacuum is safe because a worker keeps
-serving the old inode until the commit renames land, then reopens.
+Read-your-writes holds because every writer appends the `.idx` entry
+(and flushes it) before replying 201, and readers re-check the idx
+size on every lookup miss-or-hit cycle. Vacuum is safe because a
+reader keeps serving the old inode until the commit renames land,
+then reopens (with retry — the reopen itself can straddle a commit).
 """
 
 from __future__ import annotations
@@ -62,6 +68,13 @@ _HOP_HEADERS = {
 }
 
 
+class VolumeReleased(RuntimeError):
+    """Raised under the volume lock when a write's vid was handed back
+    to the lead after the caller's ownership gate (release/write race:
+    the release ack drains this lock, so any append the lead's refresh
+    could miss must abort and re-route instead)."""
+
+
 class SharedReadVolume:
     """A read-only view of a volume whose writer lives in the lead
     process, kept fresh from the on-disk `.idx` (see module docstring)."""
@@ -74,21 +87,54 @@ class SharedReadVolume:
         self._open()
 
     _ENTRY = 16  # NEEDLE_MAP_ENTRY_SIZE
+    _OPEN_RETRIES = 40
+    _OPEN_RETRY_S = 0.005
 
     def _open(self) -> None:
+        import time as _time
+
+        from seaweedfs_tpu.storage.needle import CorruptNeedle
         from seaweedfs_tpu.storage.volume import volume_base_name
 
         # stat BEFORE loading: entries appended between the stat and
         # the load replay twice, which is safe (idx replay is last-wins
         # idempotent; metrics are lead-owned). Statting after would
         # skip the [loaded, stat] window forever.
+        #
+        # The open itself RETRIES: a reopen can straddle a vacuum
+        # commit (commit_compact replaces .dat then .idx), catching an
+        # inconsistent name pair — e.g. the previous index alongside
+        # the next, smaller compacted .dat, which Volume's integrity
+        # check rejects (found by TestTornReadUnderVacuum: ~1 in 50
+        # tight commits). Each retry re-stats, so the loop converges on
+        # the post-commit pair; pinned fds keep already-open volumes
+        # safe — only this reopen window needs the loop.
         self._idx_path = (
             volume_base_name(self.directory, self.collection, self.vid) + ".idx"
         )
-        st = os.stat(self._idx_path)
-        self._idx_ino = st.st_ino
-        self._replayed = st.st_size - (st.st_size % self._ENTRY)
-        self._vol = Volume(self.directory, self.vid, self.collection, create=False)
+        for attempt in range(self._OPEN_RETRIES):
+            st = os.stat(self._idx_path)
+            try:
+                vol = Volume(
+                    self.directory, self.vid, self.collection, create=False
+                )
+            except (CorruptNeedle, OSError, ValueError):
+                if attempt == self._OPEN_RETRIES - 1:
+                    raise
+                _time.sleep(self._OPEN_RETRY_S)
+                continue
+            # the pair must still be the one we statted: an idx swapped
+            # in mid-open would replay with wrong offsets
+            st2 = os.stat(self._idx_path)
+            if st2.st_ino != st.st_ino:
+                vol.close()
+                _time.sleep(self._OPEN_RETRY_S)
+                continue
+            self._idx_ino = st.st_ino
+            self._replayed = st.st_size - (st.st_size % self._ENTRY)
+            self._vol = vol
+            return
+        raise OSError(f"volume {self.vid}: no consistent .dat/.idx pair")
 
     def _refresh(self) -> None:
         st = os.stat(self._idx_path)
@@ -111,9 +157,61 @@ class SharedReadVolume:
             self._replayed += usable
 
     def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:
+        from seaweedfs_tpu.storage.needle import CorruptNeedle, CookieMismatch
+
         with self._lock:
             self._refresh()
-        return self._vol.read_needle(needle_id, cookie=cookie)
+        try:
+            return self._vol.read_needle(needle_id, cookie=cookie)
+        except (CorruptNeedle, CookieMismatch):
+            # reopen-and-retry: a reopen that straddled a commit's
+            # dat→idx rename window can pair an index with a dat whose
+            # offsets moved — the per-needle cookie+CRC catches it
+            # here; a fresh consistent pair must agree. A GENUINE bad
+            # cookie / corrupt blob fails identically on the retry and
+            # the original error propagates.
+            with self._lock:
+                old = self._vol
+                self._open()
+                if old is not self._vol:
+                    old.close()
+            return self._vol.read_needle(needle_id, cookie=cookie)
+
+    # --- -shardWrites owner side -----------------------------------------
+    # When this worker OWNS the vid (vid % n_writers == index), the
+    # wrapped Volume is the volume's single writer: appends go through
+    # the same Volume.write_needle/delete_needle as the lead's (dat
+    # pwrite + idx append + flush before the 201 — read-your-writes for
+    # every other process's tail replay). _refresh first, so overwrite
+    # cookie checks and dedup see anything the lead wrote before
+    # ownership started.
+    def write_needle(self, n: Needle, precheck=None) -> tuple[int, bool]:
+        with self._lock:
+            if precheck is not None and not precheck():
+                # ownership was released between the caller's gate and
+                # this lock: the write must go to the new owner, not
+                # land here after the lead's catch-up refresh
+                raise VolumeReleased(self.vid)
+            self._refresh()
+            _, size, unchanged = self._vol.write_needle(n)
+            # own append is already in the map: advance the replay
+            # cursor past it or the next _refresh re-replays it and
+            # double-counts the map metrics
+            self._replayed = self._vol.nm.index_file_size()
+            return size, unchanged
+
+    def delete_needle(self, n: Needle, precheck=None) -> int:
+        with self._lock:
+            if precheck is not None and not precheck():
+                raise VolumeReleased(self.vid)
+            self._refresh()
+            size = self._vol.delete_needle(n)
+            self._replayed = self._vol.nm.index_file_size()
+            return size
+
+    @property
+    def volume(self):
+        return self._vol
 
     def close(self) -> None:
         self._vol.close()
@@ -129,14 +227,32 @@ class VolumeReadWorker:
         port: int,
         lead: str,
         worker_port: int = 0,
+        shard_writes: bool = False,
+        writer_index: int = 0,
+        n_writers: int = 1,
+        master: str = "",
+        internal_port: int = 0,
     ):
         self.directories = directories
         self.host = host
         self.port = port
         self.lead = lead  # host:port of the lead's internal listener
         self.worker_port = worker_port  # optional private listener (tests)
+        # -shardWrites: this worker OWNS writes for vids with
+        # vid % n_writers == writer_index (lead is writer 0) — see
+        # VolumeServer's shard_writes comment for the ownership story.
+        # `released` holds vids handed back to the lead (admin ops,
+        # takeovers); their writes proxy like everything else.
+        self.shard_writes = shard_writes
+        self.writer_index = writer_index
+        self.n_writers = max(1, n_writers)
+        self.master = master  # for replica fan-out lookups on owned writes
+        self.internal_port = internal_port  # own release/control listener
+        self.released: set[int] = set()
+        self._release_lock = threading.Lock()
         self._volumes: dict[int, SharedReadVolume] = {}
         self._vol_lock = threading.Lock()
+        self._internal_server: WeedHTTPServer | None = None
         self._servers: list[WeedHTTPServer] = []
         self._threads: list[threading.Thread] = []
 
@@ -203,6 +319,176 @@ class VolumeReadWorker:
                 self._proxy()
 
             do_HEAD = do_GET
+
+            def do_POST(self):
+                if self.path.startswith("/__shard/release"):
+                    return self._shard_release()
+                # body read ONCE: the owned-write path consumes the
+                # socket; a declining fallback must hand the SAME bytes
+                # to the proxy, not re-read a drained connection
+                length = int(self.headers.get("content-length", "0") or 0)
+                body = self.rfile.read(length)
+                self._hop_owner_declined = False
+                if worker.shard_writes and self._try_owned_write("POST", body):
+                    return
+                self._proxy(body=body)
+
+            def do_DELETE(self):
+                self._hop_owner_declined = False
+                if worker.shard_writes and self._try_owned_write("DELETE", b""):
+                    return
+                self._proxy(body=b"")
+
+            def _shard_release(self):
+                """Lead handshake: stop writing this vid forever; the
+                lead takes ownership once we acknowledge. Internal
+                listener ONLY — on the public port an anonymous client
+                could strip write ownership vid by vid."""
+                if (
+                    worker._internal_server is None
+                    or self.server is not worker._internal_server
+                ):
+                    return self._json({"error": "not found"}, 404)
+                q = fast_query(self.path.partition("?")[2])
+                try:
+                    vid = int(q.get("vid", ""))
+                except ValueError:
+                    return self._json({"error": "bad vid"}, 400)
+                with worker._release_lock:
+                    worker.released.add(vid)
+                    v = worker._volumes.get(vid)
+                # in-flight owned writes hold the volume lock (their
+                # under-lock precheck ran before our released.add, so
+                # they are appending); taking it once AFTER dropping the
+                # release lock (writers acquire release_lock inside
+                # v._lock — same order here would deadlock) means the
+                # ack orders after every append the lead must replay
+                if v is not None:
+                    with v._lock:
+                        pass
+                self._json({"released": vid})
+
+            def _try_owned_write(self, method: str, body: bytes) -> bool:
+                """True when this worker owned the vid and handled the
+                write/delete locally (byte-identical semantics to the
+                lead via server.write_path)."""
+                from seaweedfs_tpu.server import write_path
+                from seaweedfs_tpu.storage.file_id import (
+                    parse_path_fid,
+                    parse_url_path,
+                )
+
+                path, _, qs = self.path.partition("?")
+                try:
+                    vid_s, fid_str, url_filename, _ext, vid_only = (
+                        parse_url_path(path)
+                    )
+                    if vid_only or not fid_str:
+                        return False
+                    fid = parse_path_fid(vid_s, fid_str)
+                except ValueError:
+                    return False
+                q = fast_query(qs)
+                vid = fid.volume_id
+                if vid % worker.n_writers != worker.writer_index:
+                    return False
+                self._hop_owner_declined = True  # owner from here on
+                with worker._release_lock:
+                    if vid in worker.released:
+                        return False
+                v = worker._find_volume(vid)
+                if v is None:
+                    return False  # not on disk yet / mid-commit: lead's
+                if method == "DELETE":
+                    return self._owned_delete(v, fid)
+                n, fname, err = write_path.build_upload_needle(
+                    fid, q, body, self.headers, url_filename,
+                    fix_jpg_orientation=True,
+                )
+                if err is not None:
+                    self._json({"error": err}, 400)
+                    return True
+                def still_owned():
+                    with worker._release_lock:
+                        return vid not in worker.released
+
+                try:
+                    size, unchanged = v.write_needle(n, precheck=still_owned)
+                except VolumeReleased:
+                    return False  # re-route to the lead (new owner)
+                except (CookieMismatch, ValueError) as e:
+                    self._json({"error": str(e)}, 409)
+                    return True
+                except OSError:
+                    worker._drop_volume(vid)
+                    return False
+                if q.get("type") != "replicate":
+                    err = self._replicate_owned(v, fid, q, body)
+                    if err:
+                        self._json({"error": err}, 500)
+                        return True
+                import json as _json
+
+                self.fast_reply(
+                    201,
+                    (
+                        b'{"name": %s, "size": %d, "eTag": "%s"}'
+                        % (_json.dumps(fname).encode(), size, n.etag().encode())
+                    ),
+                    JSON_HDR,
+                )
+                return True
+
+            def _owned_delete(self, v, fid) -> bool:
+                n = Needle(cookie=fid.cookie, id=fid.key)
+                def still_owned():
+                    with worker._release_lock:
+                        return fid.volume_id not in worker.released
+
+                try:
+                    existing = v.read_needle(fid.key, cookie=fid.cookie)
+                    if existing.is_chunked_manifest():
+                        # manifest cascade needs the lead's fan-out
+                        return False
+                    v.delete_needle(n, precheck=still_owned)
+                except VolumeReleased:
+                    return False
+                except NeedleNotFound:
+                    self._json({"size": 0}, 404)
+                    return True
+                except CookieMismatch as e:
+                    self._json({"error": str(e)}, 409)
+                    return True
+                except OSError:
+                    worker._drop_volume(fid.volume_id)
+                    return False
+                self._json({"size": existing.size})
+                return True
+
+            def _replicate_owned(self, v, fid, q, body) -> str | None:
+                """Replica fan-out for a write this worker first-hop
+                owns (store_replicate.go:44): peers looked up at the
+                master, self excluded by the SHARED public host:port."""
+                from seaweedfs_tpu.server import write_path
+
+                rp = v.volume.super_block.replica_placement
+                if rp.copy_count <= 1 or not worker.master:
+                    return None
+                from seaweedfs_tpu.client import operation as op
+
+                try:
+                    res = op.lookup(worker.master, str(fid.volume_id))
+                except (OSError, RuntimeError) as e:
+                    return f"replication lookup failed: {e}"
+                if res.error:
+                    return "replication lookup failed"
+                me = f"{worker.host}:{worker.port}"
+                locations = [
+                    l["url"] for l in res.locations if l["url"] != me
+                ]
+                return write_path.replicate_to_peers(
+                    fid, q, "POST", body, self.headers, locations
+                )
 
             def _serve_blob(self, fid) -> bool:
                 """True when served locally; False = hand to the proxy
@@ -294,14 +580,15 @@ class VolumeReadWorker:
 
                 self.fast_reply(status, json.dumps(obj).encode(), JSON_HDR)
 
-            def _proxy(self):
+            def _proxy(self, body: bytes | None = None):
                 """Forward this request verbatim to the lead and relay
                 the response (one pooled keep-alive conn per handler
-                thread, via the client transport)."""
+                thread, via the client transport). `body` carries
+                already-consumed request bytes (the owned-write path
+                reads the socket before deciding to decline)."""
                 from seaweedfs_tpu.client.operation import _drop_conn, _pooled_conn
 
-                body = None
-                if self.command in ("POST", "PUT", "DELETE"):
+                if body is None and self.command in ("POST", "PUT", "DELETE"):
                     try:
                         n = int(self.headers.get("content-length", "0"))
                     except ValueError:
@@ -312,6 +599,15 @@ class VolumeReadWorker:
                     for k, v in self.headers.items()
                     if k not in _HOP_HEADERS
                 }
+                if getattr(self, "_hop_owner_declined", False):
+                    # tells the lead: this request already visited the
+                    # vid's OWNER, which declined (released volume,
+                    # manifest cascade, mid-commit) — handle it there
+                    # after taking ownership; never route it back. A
+                    # NON-owner's proxy must NOT set this, or the lead
+                    # would seize vids of healthy third workers
+                    # (-workers >= 3).
+                    fwd["x-shard-hop"] = "1"
                 try:
                     c, reused = _pooled_conn(worker.lead, 30.0)
                     try:
@@ -337,8 +633,6 @@ class VolumeReadWorker:
                 }
                 self.fast_reply(status, data, out)
 
-            do_POST = _proxy
-            do_DELETE = _proxy
             do_PUT = _proxy
 
         return Handler
@@ -348,6 +642,17 @@ class VolumeReadWorker:
         from seaweedfs_tpu.util.httpd import ReusePortWeedHTTPServer
 
         handler = self._make_handler()
+        if self.shard_writes and self.internal_port:
+            # the release/control listener must be up BEFORE any public
+            # write can arrive: the lead treats connection-refused on a
+            # release call as "worker dead" and takes the vid over —
+            # accepting public writes first would race that takeover
+            self._internal_server = WeedHTTPServer(
+                ("127.0.0.1", self.internal_port), handler
+            )
+            self._servers.append(self._internal_server)
+        if self.shard_writes:
+            self._load_taken_vids()
         srv = ReusePortWeedHTTPServer((self.host, self.port), handler)
         self._servers.append(srv)
         if self.worker_port:
@@ -359,8 +664,29 @@ class VolumeReadWorker:
             t.start()
             self._threads.append(t)
         wlog.info(
-            "volume read worker on %s:%d (lead %s)", self.host, self.port, self.lead
+            "volume %s worker %d on %s:%d (lead %s)",
+            "write" if self.shard_writes else "read",
+            self.writer_index,
+            self.host,
+            self.port,
+            self.lead,
         )
+
+    def _load_taken_vids(self) -> None:
+        """Vids the lead already took over (e.g. a takeover while this
+        worker was starting) must never be written here."""
+        import json
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                f"http://{self.lead}/__shard/taken", timeout=10
+            ) as r:
+                taken = json.loads(r.read())
+        except (OSError, ValueError):
+            return  # lead not up yet: it cannot have taken anything over
+        with self._release_lock:
+            self.released.update(int(v) for v in taken)
 
     def stop(self) -> None:
         for s in self._servers:
@@ -382,9 +708,14 @@ def spawn_read_workers(
     port: int,
     lead_internal: str,
     worker_port_base: int = 0,
+    shard_writes: bool = False,
+    n_writers: int = 1,
+    master: str = "",
+    internal_base: int = 0,
 ) -> list:
-    """Lead-side helper: launch n worker subprocesses sharing host:port.
-    Returns the Popen handles (terminate them on shutdown)."""
+    """Lead-side helper: launch n worker subprocesses sharing host:port
+    (writer indices 1..n; the lead is writer 0). Returns the Popen
+    handles (terminate them on shutdown)."""
     import subprocess
     import sys
 
@@ -406,5 +737,14 @@ def spawn_read_workers(
         ]
         if worker_port_base:
             cmd += ["-workerPort", str(worker_port_base + k)]
+        if shard_writes:
+            cmd += [
+                "-shardWrites",
+                "-writerIndex", str(k + 1),
+                "-writers", str(n_writers),
+                "-internalPort", str(internal_base + k + 1),
+            ]
+            if master:
+                cmd += ["-mserver", master]
         procs.append(subprocess.Popen(cmd))
     return procs

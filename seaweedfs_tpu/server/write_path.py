@@ -1,0 +1,159 @@
+"""The shared blob write path: request → Needle, and replica fan-out.
+
+Factored out of the volume server's POST handler so the `-shardWrites`
+write workers (server/volume_workers.py) build byte-identical needles
+with the exact semantics of the lead — multipart forms
+(needle.go:85 ParseUpload), mime/name flags, JPEG orientation fixing,
+transparent + pre-gzipped compression, chunk-manifest flag, Seaweed-*
+pairs, ts=/ttl= params — and run the same replica fan-out
+(store_replicate.go:44-80) when they own the first hop of a write.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from seaweedfs_tpu.storage.file_id import FileId
+from seaweedfs_tpu.storage.needle import Needle
+
+
+def build_upload_needle(
+    fid: FileId,
+    q: dict,
+    body: bytes,
+    headers,
+    url_filename: str = "",
+    fix_jpg_orientation: bool = False,
+) -> tuple[Needle | None, str, str | None]:
+    """(needle, filename, error): error is a client-facing 400 message.
+
+    `headers` is any case-insensitive mapping with .get and .items
+    (FastHeaders on the data plane)."""
+    ctype = headers.get("content-type", "")
+    part_filename = ""
+    is_gzipped = False
+    if ctype[:19].lower() == "multipart/form-data":
+        from seaweedfs_tpu.util.multipart import MalformedUpload, parse_upload
+
+        try:
+            part = parse_upload(body, ctype)
+        except MalformedUpload as e:
+            return None, "", str(e)
+        data, ctype, part_filename = part.data, part.mime, part.filename
+        is_gzipped = part.is_gzipped
+    else:
+        data = body
+        # raw bodies may arrive pre-gzipped (Content-Encoding)
+        is_gzipped = headers.get("content-encoding", "").lower() == "gzip"
+    n = Needle(cookie=fid.cookie, id=fid.key, data=data)
+    if ctype and len(ctype) < 256 and ctype != "application/octet-stream":
+        n.mime = ctype.encode()
+        n.set_has_mime()
+    fname = q.get("filename", "") or part_filename or url_filename
+    if fname and len(fname) < 256:
+        n.name = fname.encode()
+        n.set_has_name()
+        if fix_jpg_orientation and fname.lower().endswith((".jpg", ".jpeg")):
+            from seaweedfs_tpu import images
+
+            n.data = images.fix_jpg_orientation(bytes(n.data))
+    if is_gzipped:
+        n.set_gzipped()
+    elif len(n.data) > 128:
+        # transparent server-side compression when the type says it
+        # pays (needle_parse_multipart.go:86-97 + util/compression.go
+        # IsGzippable); deterministic, so replica fan-out re-derives
+        # identical needles
+        from seaweedfs_tpu.util.compression import is_gzippable
+
+        fext = os.path.splitext(fname)[1] if fname else ""
+        if is_gzippable(fext, ctype or "", bytes(n.data)):
+            import gzip as _gzip
+
+            # mtime=0: replicas re-derive the needle from the raw
+            # body, so the stream must be identical
+            packed = _gzip.compress(bytes(n.data), 6, mtime=0)
+            if len(packed) < len(n.data):
+                n.data = packed
+                n.set_gzipped()
+    if q.get("cm") == "true":
+        n.set_is_chunk_manifest()
+    # Seaweed-* request headers persist as needle pairs
+    # (needle.go:37-42 PairNamePrefix + :101-113)
+    pair_map = {
+        k[8:]: v for k, v in headers.items() if k.lower().startswith("seaweed-")
+    }
+    if pair_map:
+        pairs = json.dumps(pair_map).encode()
+        if len(pairs) < 65536:
+            n.pairs = pairs
+            n.set_has_pairs()
+    # ts= overrides the modification stamp; ttl= stores a per-needle
+    # ttl (needle.go:79-81)
+    try:
+        n.last_modified = int(q.get("ts", "") or 0) or int(time.time())
+    except ValueError:
+        n.last_modified = int(time.time())
+    n.set_has_last_modified_date()
+    ttl_param = q.get("ttl", "")
+    if ttl_param:
+        from seaweedfs_tpu.storage.ttl import TTL
+
+        try:
+            n.ttl = TTL.parse(ttl_param)
+            if n.ttl.count:
+                n.set_has_ttl()
+        except ValueError:
+            pass
+    return n, fname, None
+
+
+def replicate_to_peers(
+    fid: FileId,
+    q: dict,
+    method: str,
+    body: bytes,
+    headers,
+    locations: list[str],
+) -> str | None:
+    """Fan the original write to the replica `locations` (already
+    excluding the sender) with type=replicate so peers store without
+    re-fanning (store_replicate.go:44-80). Returns an error message or
+    None; all-or-error like the reference (a failed replica fails the
+    write)."""
+    import urllib.request
+    from urllib.parse import urlencode
+
+    params = {k: v for k, v in q.items() if k != "type"}
+    params["type"] = "replicate"
+    for url in locations:
+        try:
+            req = urllib.request.Request(
+                f"http://{url}/{fid}?{urlencode(params)}",
+                data=body if method == "POST" else None,
+                method=method,
+            )
+            # FastHeaders stores keys lowercased; look up both
+            # spellings so a plain-dict caller keeps working too
+            ct = headers.get("Content-Type") or headers.get("content-type")
+            if ct:
+                req.add_header("Content-Type", ct)
+            ce = headers.get("Content-Encoding") or headers.get(
+                "content-encoding"
+            )
+            if ce:  # pre-gzipped uploads must stay flagged on replicas
+                req.add_header("Content-Encoding", ce)
+            for hk, hv in headers.items():
+                if hk.lower().startswith("seaweed-"):
+                    req.add_header(hk, hv)  # pairs replicate too
+            auth = headers.get("Authorization") or headers.get("authorization")
+            if auth:  # keep the write jwt valid on the replica hop
+                req.add_header("Authorization", auth)
+            with urllib.request.urlopen(req, timeout=10) as r:
+                if r.status >= 300:
+                    return f"replica {url} returned {r.status}"
+        except OSError as e:
+            return f"replica {url} failed: {e}"
+    return None

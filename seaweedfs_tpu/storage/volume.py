@@ -140,6 +140,7 @@ class Volume:
             self.read_only = True
             self.super_block = SuperBlock.read_from(self._dat)
             self.nm = self._load_needle_map()
+            self._followed = self.nm.index_file_size()
             return
         if has_remote:
             # keep_local_dat_file case: a local copy exists alongside
@@ -160,6 +161,10 @@ class Volume:
         if exists:
             self.super_block = SuperBlock.read_from(self._dat)
         self.nm = self._load_needle_map()
+        # how much of the on-disk .idx this process's map reflects —
+        # refresh_from_idx replays from here when ANOTHER process is
+        # the volume's writer (-shardWrites followers/handback)
+        self._followed = self.nm.index_file_size()
         if exists:
             self._check_integrity()
 
@@ -608,6 +613,40 @@ class Volume:
             if os.path.exists(sdb):
                 os.remove(sdb)
             self.nm = self._load_needle_map()
+            self._followed = self.nm.index_file_size()
+
+    def refresh_from_idx(self) -> None:
+        """Catch this process's map (and append offset) up with .idx
+        entries appended by ANOTHER process — the write-sharding
+        follower/handback path (`volume -workers N -shardWrites`): the
+        lead calls this for worker-owned volumes before reads and
+        heartbeats, and once at ownership handback before any
+        file-rewriting admin op (compaction snapshots the IN-MEMORY
+        map, so a stale map there would silently drop every entry the
+        owner appended — index_file_size() is fstat-based and cannot
+        catch it). Only whole 16-byte entries are replayed: a stat
+        racing the owner's append may see a torn tail entry."""
+        with self._lock:
+            try:
+                size = os.path.getsize(self.base_name + ".idx")
+            except OSError:
+                return
+            pos = self._followed
+            if size <= pos:
+                return
+            with open(self.base_name + ".idx", "rb") as f:
+                f.seek(pos)
+                tail = f.read(size - pos)
+            from seaweedfs_tpu.storage import idx as idx_codec
+
+            usable = len(tail) - (len(tail) % 16)
+            for key, offset, entry_size in idx_codec.iter_entries(tail[:usable]):
+                self.nm._replay(key, offset, entry_size)
+            self._followed = pos + usable
+            # the other process also grew the .dat: re-arm the pwrite
+            # append cursor so a post-handback write lands at the tail
+            # instead of overwriting the owner's records
+            self._append_end = os.fstat(self._fd).st_size
 
     def cleanup_compact(self) -> None:
         self._compact_snapshot_idx = None
