@@ -50,7 +50,16 @@ Other configs (BASELINE.json):
                      vs_baseline = speedup over the numpy "cpu"
                      backend end-to-end on the same machine (the
                      software-RS role the reference fills with
-                     klauspost AVX2).
+                     klauspost AVX2). The classic driver's phases
+                     dict accounts for the whole wall
+                     (read/encode/write/flush/loop; flush_s = kernel
+                     dirty-page writeback at close, the dominant cost
+                     on this host's disk — on tmpfs the same code
+                     measures ~1.0 GB/s with loop_s ~7%, the serial
+                     single-core framework floor). The pipelined
+                     driver on TPU hosts reports overlapped stages
+                     (read/dispatch/fetch/write) whose sum can exceed
+                     wall; its loop_s is wall − flush − max stage.
 """
 
 import json

@@ -197,10 +197,14 @@ def stream_write_ec_files(
         try:
             pipe.finish(caller_error=not ok)  # may re-raise a stage error
         finally:
-            for f in outputs:
-                f.close()
-            if stats is not None:
-                _finish_stats(stats, busy, wall0)
+            tc0 = time.perf_counter()
+            try:
+                for f in outputs:
+                    f.close()
+            finally:
+                busy["flush_s"] = time.perf_counter() - tc0
+                if stats is not None:
+                    _finish_stats(stats, busy, wall0)
 
 
 def stream_rebuild_ec_files(
@@ -295,26 +299,38 @@ def stream_rebuild_ec_files(
         try:
             pipe.finish(caller_error=not ok)  # may re-raise a stage error
         finally:
-            if stats is not None:
-                _finish_stats(stats, busy, wall0)
-            for f in inputs.values():
-                f.close()
-            for f in outputs.values():
-                f.close()
+            tc0 = time.perf_counter()
+            try:
+                for f in outputs.values():
+                    f.close()
+            finally:
+                # an ENOSPC surfacing in a buffered close must not skip
+                # the stats nor leak the 10 survivor read fds
+                busy["flush_s"] = time.perf_counter() - tc0
+                if stats is not None:
+                    _finish_stats(stats, busy, wall0)
+                for f in inputs.values():
+                    f.close()
     return missing
 
 
 def _finish_stats(stats: dict, busy: dict, wall0: float) -> None:
     """Per-stage busy seconds + wall and the unattributed remainder.
-    The stages run in three threads, so Σbusy can legitimately exceed
-    wall (overlap); loop_s = wall − the CALLER thread's busy time
-    (dispatch) − whatever of read/fetch/write the wall couldn't hide,
-    reported simply as wall − max-stage: the honest "pipeline was idle /
-    Python glue" residue for a bench line to carry."""
+    The PIPELINE stages (read/dispatch/fetch/write) run in three
+    threads, so their Σ can legitimately exceed wall (overlap) — the
+    wall they explain is their max. flush_s is different: it is the
+    SERIAL post-pipeline close (kernel writeback) appended to the
+    wall, so it subtracts separately. loop_s = wall − flush − max
+    pipeline stage: the honest "pipeline was idle / Python glue"
+    residue for a bench line to carry."""
     wall = time.perf_counter() - wall0
+    flush = busy.get("flush_s", 0.0)
+    pipeline_max = max(
+        (v for k, v in busy.items() if k != "flush_s"), default=0.0
+    )
     stats.update({k: round(v, 4) for k, v in busy.items()})
     stats["wall_s"] = round(wall, 4)
-    stats["loop_s"] = round(wall - max(busy.values()), 4)
+    stats["loop_s"] = round(wall - flush - pipeline_max, 4)
 
 
 # --- default TPU kernel stages ---------------------------------------------
