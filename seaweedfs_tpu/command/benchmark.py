@@ -111,8 +111,18 @@ class BenchmarkCommand(Command):
         p.add_argument("-size", type=int, default=1024)
         p.add_argument("-collection", default="benchmark")
         p.add_argument("-replication", default="000")
-        p.add_argument("-write", action=argparse.BooleanOptionalAction, default=True)
-        p.add_argument("-read", action=argparse.BooleanOptionalAction, default=True)
+        # the reference's -write=true/-read=false spelling: single-dash
+        # flags get no --no- negative form from BooleanOptionalAction,
+        # so write-only / read-only runs need the =bool style
+        def _bool(v: str) -> bool:
+            return v.lower() not in ("false", "0", "no")
+
+        p.add_argument(
+            "-write", type=_bool, nargs="?", const=True, default=True
+        )
+        p.add_argument(
+            "-read", type=_bool, nargs="?", const=True, default=True
+        )
         p.add_argument("-deletePercent", type=int, default=0)
         p.add_argument(
             "-cpuprofile", default="", help="dump pstats profile here on exit"
