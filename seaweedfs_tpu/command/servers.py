@@ -226,14 +226,6 @@ class VolumeCommand(Command):
                 return 1
         guard = _load_guard()
         shard_writes = args.shardWrites and workers > 1
-        if shard_writes and guard is not None:
-            # workers cannot validate write JWTs yet; sharded local
-            # writes would bypass the signature check
-            wlog.warning(
-                "-shardWrites disabled: jwt.signing is configured and "
-                "write workers cannot validate tokens"
-            )
-            shard_writes = False
         server = VolumeServer(
             dirs,
             host=args.ip,
@@ -317,6 +309,9 @@ class VolumeWorkerCommand(Command):
             n_writers=args.writers,
             master=args.mserver,
             internal_port=args.internalPort,
+            # same security.toml as the lead: sharded local writes
+            # enforce the identical JWT/white-list gate
+            guard=_load_guard(),
         )
         worker.start()
         try:

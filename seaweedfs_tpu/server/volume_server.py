@@ -1097,40 +1097,16 @@ class VolumeServer:
                     return None, None, "", ""
 
             def _check_write_auth(self) -> bool:
-                """JWT/white-list gate on mutating requests; True = allowed
-                (security/guard.go WhiteList+Secure wrapping of the write
-                handlers). The jwt claim must match the request fid."""
-                if server.guard is None or not server.guard.is_write_active:
+                """True = allowed; shared candidate/claim logic lives in
+                write_path.check_write_auth (the -shardWrites workers
+                run the same check on their local writes)."""
+                err = write_path.check_write_auth(
+                    server.guard, self.path, self.headers,
+                    self.client_address[0],
+                )
+                if err is None:
                     return True
-                from seaweedfs_tpu.security import UnauthorizedError, jwt_from_headers
-
-                path, _, qs = self.path.partition("?")
-                token = jwt_from_headers(parse_qs(qs), self.headers)
-                # every addressing form must authorize against the fid
-                # the token was minted for: the assign hands out the
-                # comma form, so slash/extension/_delta spellings
-                # normalize to their comma-form candidates
-                candidates = [path.lstrip("/")]
-                vid, fid_str, _fn, _ext, vid_only = parse_url_path(path)
-                if fid_str and not vid_only:
-                    # normalize slash/extension spellings to the comma
-                    # form the token was minted for; a _delta suffix
-                    # stays part of the claimed id (reference-strict:
-                    # a base-fid token must NOT authorize arbitrary
-                    # key+N writes)
-                    comma = f"{vid},{fid_str}"
-                    if comma not in candidates:
-                        candidates.append(comma)
-                err = None
-                for cand in candidates:
-                    try:
-                        server.guard.check_write(
-                            self.client_address[0], token, cand
-                        )
-                        return True
-                    except UnauthorizedError as e:
-                        err = e
-                self._json({"error": str(err)}, 401)
+                self._json({"error": err}, 401)
                 return False
 
             def do_GET(self):

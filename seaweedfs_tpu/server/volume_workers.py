@@ -232,6 +232,7 @@ class VolumeReadWorker:
         n_writers: int = 1,
         master: str = "",
         internal_port: int = 0,
+        guard=None,
     ):
         self.directories = directories
         self.host = host
@@ -248,6 +249,7 @@ class VolumeReadWorker:
         self.n_writers = max(1, n_writers)
         self.master = master  # for replica fan-out lookups on owned writes
         self.internal_port = internal_port  # own release/control listener
+        self.guard = guard  # same security.toml Guard as the lead
         self.released: set[int] = set()
         self._release_lock = threading.Lock()
         self._volumes: dict[int, SharedReadVolume] = {}
@@ -393,6 +395,13 @@ class VolumeReadWorker:
                 if vid % worker.n_writers != worker.writer_index:
                     return False
                 self._hop_owner_declined = True  # owner from here on
+                auth_err = write_path.check_write_auth(
+                    worker.guard, self.path, self.headers,
+                    self.client_address[0],
+                )
+                if auth_err is not None:
+                    self._json({"error": auth_err}, 401)
+                    return True
                 with worker._release_lock:
                     if vid in worker.released:
                         return False

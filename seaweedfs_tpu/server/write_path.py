@@ -157,3 +157,36 @@ def replicate_to_peers(
         except OSError as e:
             return f"replica {url} failed: {e}"
     return None
+
+
+def check_write_auth(guard, path: str, headers, client_ip: str) -> str | None:
+    """JWT/white-list gate on mutating requests; None = allowed, else
+    the 401 message (security/guard.go WhiteList+Secure wrapping of the
+    write handlers). The jwt claim must match the request fid; every
+    addressing form normalizes to the comma form the assign minted the
+    token for (a _delta suffix stays part of the claimed id). Shared by
+    the lead handler and the -shardWrites workers so sharded local
+    writes enforce the same signature check."""
+    if guard is None or not guard.is_write_active:
+        return None
+    from urllib.parse import parse_qs
+
+    from seaweedfs_tpu.security import UnauthorizedError, jwt_from_headers
+    from seaweedfs_tpu.storage.file_id import parse_url_path
+
+    bare, _, qs = path.partition("?")
+    token = jwt_from_headers(parse_qs(qs), headers)
+    candidates = [bare.lstrip("/")]
+    vid, fid_str, _fn, _ext, vid_only = parse_url_path(bare)
+    if fid_str and not vid_only:
+        comma = f"{vid},{fid_str}"
+        if comma not in candidates:
+            candidates.append(comma)
+    err = None
+    for cand in candidates:
+        try:
+            guard.check_write(client_ip, token, cand)
+            return None
+        except UnauthorizedError as e:
+            err = e
+    return str(err)

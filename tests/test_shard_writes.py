@@ -478,3 +478,96 @@ class TestThreeWriterRouting:
         for port in (vport, wports[1], wports[2]):
             status, body = _get(f"http://127.0.0.1:{port}/{a['fid']}")
             assert status == 200 and body == payload
+
+
+class TestShardWritesWithJwt:
+    """Sharded local writes enforce the same JWT gate as the lead
+    (write_path.check_write_auth): an unsigned write to a worker-owned
+    vid 401s at the WORKER, a signed one lands."""
+
+    @pytest.fixture(scope="class")
+    def jwt_shard_stack(self, tmp_path_factory):
+        from seaweedfs_tpu.security.guard import Guard
+
+        key = "shard-signing-key"
+        mport = free_port()
+        master = MasterServer(
+            port=mport,
+            volume_size_limit_mb=64,
+            guard=Guard(signing_key=key, expires_after_sec=30),
+        )
+        master.start()
+        vdir = str(tmp_path_factory.mktemp("jwtshard"))
+        vport, wport, iport, winternal = (
+            free_port(), free_port(), free_port(), free_port(),
+        )
+        lead = VolumeServer(
+            [vdir],
+            port=vport,
+            master=f"127.0.0.1:{mport}",
+            heartbeat_interval=0.2,
+            max_volume_counts=[100],
+            internal_port=iport,
+            shard_writes=True,
+            n_writers=2,
+            guard=Guard(signing_key=key, expires_after_sec=30),
+        )
+        lead._writer_internal_addr = lambda k: (
+            f"127.0.0.1:{winternal}" if k == 1 else f"127.0.0.1:{iport}"
+        )
+        lead.start()
+        deadline = time.time() + 20
+        while time.time() < deadline and not master.topology.data_nodes():
+            time.sleep(0.05)
+        worker = VolumeReadWorker(
+            [vdir],
+            host="127.0.0.1",
+            port=free_port(),
+            lead=f"127.0.0.1:{iport}",
+            worker_port=wport,
+            shard_writes=True,
+            writer_index=1,
+            n_writers=2,
+            master=f"127.0.0.1:{mport}",
+            internal_port=winternal,
+            guard=Guard(signing_key=key, expires_after_sec=30),
+        )
+        worker.start()
+        yield master, lead, worker, mport, vport, wport
+        worker.stop()
+        lead.stop()
+        master.stop()
+
+    def test_signed_write_lands_unsigned_401s(self, jwt_shard_stack):
+        master, lead, worker, mport, vport, wport = jwt_shard_stack
+        # worker-owned fid WITH its assign-issued token
+        a = None
+        for _ in range(40):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/dir/assign"
+            ) as r:
+                cand = json.load(r)
+            if int(cand["fid"].split(",")[0]) % 2 == 1:
+                a = cand
+                break
+        assert a and a.get("auth"), "assign must mint a write token"
+        payload = b"signed sharded write"
+
+        # unsigned: 401 straight from the worker's local-write path
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"http://127.0.0.1:{wport}/{a['fid']}", payload)
+        assert ei.value.code == 401
+
+        # signed: lands through the worker
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{wport}/{a['fid']}",
+            data=payload,
+            method="POST",
+            headers={"Authorization": f"BEARER {a['auth']}"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+        status, body = _get(f"http://127.0.0.1:{vport}/{a['fid']}")
+        assert status == 200 and body == payload
+        # the WORKER wrote it (not a proxy-to-lead fallback)
+        assert worker._find_volume(int(a["fid"].split(",")[0])) is not None
