@@ -31,6 +31,7 @@ import pytest
 
 from seaweedfs_tpu.util.availability import (
     HammerReader,
+    free_port,
     run_with_readers,
     start_cluster,
     write_keyset,
@@ -140,3 +141,78 @@ class TestHarnessSensitivity:
             f"http://127.0.0.1:{master.port}/{next(iter(keys))}", timeout=10
         ) as r:
             assert r.status == 200
+
+
+class TestS3MigrationAvailability:
+    """BASELINE config 5's literal wording: '…under concurrent S3
+    GETs'. The same zero-unavailability property through the full
+    gateway stack — S3 → filer chunk reads → volume/EC — while every
+    volume of the objects' collection runs the encode pipeline."""
+
+    def test_s3_reads_stay_green_through_migration(
+        self, cluster, tmp_path_factory
+    ):
+        from seaweedfs_tpu.s3api.s3api_server import S3ApiServer
+        from seaweedfs_tpu.server.filer_server import FilerServer
+
+        master, volume_servers = cluster
+        fport = free_port()
+        filer = FilerServer(
+            [f"127.0.0.1:{master.port}"],
+            port=fport,
+            store="memory",
+            collection="migs3",
+            max_mb=1,
+        )
+        filer.start()
+        s3port = free_port()
+        s3 = S3ApiServer(filer=f"127.0.0.1:{fport}", port=s3port)
+        s3.start()
+        try:
+            base = f"http://127.0.0.1:{s3port}"
+            urllib.request.urlopen(
+                urllib.request.Request(f"{base}/migbkt", method="PUT"),
+                timeout=10,
+            ).close()
+            keys: dict[str, bytes] = {}
+            for i in range(18):
+                body = (f"s3 object {i} ".encode() * 931)[: 11_000 + 37 * i]
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"{base}/migbkt/obj-{i}.bin",
+                        data=body,
+                        method="PUT",
+                    ),
+                    timeout=10,
+                ).close()
+                keys[f"migbkt/obj-{i}.bin"] = body
+
+            # every volume of the collection gets migrated under load
+            env = CommandEnv([f"127.0.0.1:{master.port}"])
+            dump = env.collect_topology()
+            vids = sorted(
+                {
+                    v["Id"]
+                    for n in dump.nodes
+                    for v in n.volumes
+                    if v["Collection"] == "migs3"
+                }
+            )
+            assert vids, "no volumes grown for the S3 collection"
+
+            def pipeline():
+                for vid in vids:
+                    do_ec_encode(env, vid, "migs3", io.StringIO())
+
+            readers = [HammerReader(base, keys, "s3")]
+            run_with_readers(readers, pipeline, settle=1.0)
+
+            assert readers[0].failures == [], readers[0].failures[:10]
+            assert readers[0].reads >= 2 * len(keys)
+            # the volumes really are EC now
+            for vid in vids:
+                locs = master.topology.lookup_ec_shards(vid)
+                assert locs is not None, vid
+        finally:
+            s3.stop()
+            filer.stop()
