@@ -797,10 +797,16 @@ class VolumeServer:
             except NeedleNotFound:
                 yield pb.VolumeEcShardReadResponse(is_deleted=True)
                 return
-        remaining = req.size
+        # clamp the span to the shard: read_at treats past-EOF reads as
+        # truncation (it guards the DEGRADED path, where short data must
+        # never silently substitute), but a plain span read walking the
+        # shard end — ec.verify's tile probe — just gets what exists
+        remaining = min(req.size, max(0, shard.size - req.offset))
         offset = req.offset
         while remaining > 0:
             chunk = shard.read_at(offset, min(COPY_CHUNK, remaining))
+            if not chunk:
+                break  # never spin yielding empties
             yield pb.VolumeEcShardReadResponse(data=chunk)
             offset += len(chunk)
             remaining -= len(chunk)
