@@ -263,11 +263,14 @@ def serve_connection(sock, addr, server, handler_cls) -> None:
     proto11 = handler_cls.protocol_version >= "HTTP/1.1"
     try:
         while True:
+            # error replies (fast_reply) read command/close_connection;
+            # arm them before any read/parse step can bail (and clear a
+            # previous keep-alive request's values)
+            h.command = None
+            h.close_connection = True
             try:
                 head = reader.read_head()
             except ValueError:
-                h.close_connection = True
-                h.command = None
                 h.fast_reply(431)
                 return
             if not head:
