@@ -402,12 +402,14 @@ class TestTornReadUnderVacuum:
             # (a single retry can itself land in the next commit's
             # window when the whole host is loaded)
             last = None
-            for _ in range(5):
+            delay = 0.003
+            for _ in range(8):  # ~0.4 s total: spans scheduler stalls
                 try:
                     return reader.read_needle(nid, cookie=0x42).data
                 except OSError as e:
                     last = e
-                    time.sleep(0.005)
+                    time.sleep(delay)
+                    delay *= 2
             raise last
 
         def read_loop():
