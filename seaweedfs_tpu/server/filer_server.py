@@ -31,7 +31,7 @@ from seaweedfs_tpu.filer.entry import Attr, Entry, normalize_path
 from seaweedfs_tpu.filer.filer import Filer
 from seaweedfs_tpu.filer.filerstore import EntryNotFound, new_store
 from seaweedfs_tpu.pb import filer_pb2 as fpb
-from seaweedfs_tpu.util.httpd import WeedHTTPServer
+from seaweedfs_tpu.util.httpd import FastRequestMixin, WeedHTTPServer
 from seaweedfs_tpu.pb import rpc
 
 
@@ -354,7 +354,11 @@ class FilerServer:
     def _http_handler_class(self):
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(FastRequestMixin, BaseHTTPRequestHandler):
+            # FastRequestMixin marks the handler for WeedHTTPServer's
+            # mini request loop (one-scan head parse, FastHeaders,
+            # body realignment — util/httpd.serve_connection); the
+            # send_response/send_header slow paths below are untouched
             protocol_version = "HTTP/1.1"
 
             def log_message(self, *args):
