@@ -39,6 +39,9 @@ Other configs (BASELINE.json):
                      shards are data shards, so every output row needs
                      the full inverted-survivor-matrix path
                      (gf256.decode_rows over survivors 4..13).
+  bench.py http      write/read req/s through the HTTP data plane via
+                     the repo's own `weed benchmark` machinery — the
+                     README's prose numbers, driver-tracked.
   bench.py stream    end-to-end `ec.encode` of a real on-disk volume
                      (.dat → 14 shard files) through write_ec_files
                      with the best LOCAL codec backend (the native
@@ -63,6 +66,7 @@ Other configs (BASELINE.json):
 """
 
 import json
+import os
 import sys
 import time
 
@@ -193,6 +197,60 @@ def _kernel_fn(kern, on_tpu, n32, survivors=None, targets=None):
         return jax.lax.bitcast_convert_type(out, jnp.uint32)
 
     return rec
+
+
+_DISK_CEILING: dict = {}
+
+
+def _disk_ceiling(scratch_dir: str, mb: int = 192) -> dict:
+    """Measured sequential write/read GB/s of `scratch_dir`'s
+    filesystem, cached per st_dev — the hardware bar every
+    `*_stream_e2e` line is judged against (an e2e GB/s number without
+    it is unattributable: driver overhead and a slow disk read the
+    same). Write: raw-fd 16 MiB positioned writes with the fdatasync
+    INSIDE the timed region (the page cache must not impersonate the
+    disk). Read: posix_fadvise(DONTNEED) drops the probe file from
+    cache first; on tmpfs that is a no-op and the probe honestly
+    reports memory bandwidth — which IS that filesystem's ceiling."""
+    import numpy as np
+
+    dev = os.stat(scratch_dir).st_dev
+    cached = _DISK_CEILING.get(dev)
+    if cached:
+        return cached
+    chunk = 16 * 1024 * 1024
+    n = max(1, mb * 1024 * 1024 // chunk)
+    buf = np.random.default_rng(3).integers(0, 256, chunk, dtype=np.uint8)
+    path = os.path.join(scratch_dir, ".disk_probe")
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        t0 = time.perf_counter()
+        for i in range(n):
+            os.pwritev(fd, [buf], i * chunk)
+        os.fdatasync(fd)
+        w_s = time.perf_counter() - t0
+    finally:
+        os.close(fd)
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        try:
+            os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+        except OSError:
+            pass
+        out = np.empty(chunk, dtype=np.uint8)
+        t0 = time.perf_counter()
+        for i in range(n):
+            os.preadv(fd, [out], i * chunk)
+        r_s = time.perf_counter() - t0
+    finally:
+        os.close(fd)
+        os.remove(path)
+    res = {
+        "disk_seq_write_gb_s": round(n * chunk / w_s / 1e9, 3),
+        "disk_seq_read_gb_s": round(n * chunk / r_s / 1e9, 3),
+    }
+    _DISK_CEILING[dev] = res
+    return res
 
 
 def _report(
@@ -458,8 +516,16 @@ def bench_stream() -> None:
         with open(base + ".dat", "rb") as src, open(cpu_base + ".dat", "wb") as dst:
             dst.write(src.read(32 * 1024 * 1024))
         cpu_gbps, _ = best_rate(cpu_base, new_encoder(backend="cpu"), runs=2)
+        ceiling = _disk_ceiling(d)
 
-    _report("ec_encode_stream_e2e", gbps, "GB/s", gbps / cpu_gbps, phases=phases)
+    _report(
+        "ec_encode_stream_e2e",
+        gbps,
+        "GB/s",
+        gbps / cpu_gbps,
+        phases=phases,
+        **ceiling,
+    )
 
 
 def bench_stream_rebuild() -> None:
@@ -471,26 +537,16 @@ def bench_stream_rebuild() -> None:
     second; vs_baseline = speedup over the numpy "cpu" backend on the
     same machine — the software-RS role the reference fills with
     klauspost AVX2 in RebuildEcFiles (ec_encoder.go:227-281)."""
-    import os
     import tempfile
 
     import numpy as np
 
-    from seaweedfs_tpu.ec import ec_files, ec_stream, gf256
+    from seaweedfs_tpu.ec import ec_files, ec_stream
     from seaweedfs_tpu.ec.codec import new_encoder
 
-    def make_rebuild_fns(rs):
-        rows_cache = {}
-
-        def rebuild_fn(survivors, targets, tile):
-            key = survivors + (256,) + targets
-            rows = rows_cache.get(key)
-            if rows is None:
-                rows = gf256.decode_rows(rs.matrix, survivors, targets)
-                rows_cache[key] = rows
-            return rs._apply(rows, tile)
-
-        return rebuild_fn, lambda h: h
+    # the decode-rows-cached stage pair now lives in ec_stream (the
+    # volume server's rack-gather rebuild verb uses the same one)
+    make_rebuild_fns = ec_stream.local_rebuild_fns
 
     def best_rate(base: str, rs, runs: int):
         dat_bytes = os.path.getsize(base + ".dat")
@@ -542,7 +598,10 @@ def bench_stream_rebuild() -> None:
         cpu_rs = new_encoder(backend="cpu")
         ec_files.write_ec_files(cpu_base, rs=cpu_rs)
         cpu_gbps, _ = best_rate(cpu_base, cpu_rs, runs=2)
+        ceiling = _disk_ceiling(d)
 
+    # the rebuild streams 10 survivor-shard bytes in and 1 shard out
+    # per volume byte: its disk bound is the sequential READ rate
     _report(
         "ec_rebuild_stream_e2e",
         gbps,
@@ -552,11 +611,67 @@ def bench_stream_rebuild() -> None:
         # honesty line (VERDICT r4 weak #3): the headline
         # ec_rebuild_one_shard_30gb number is ON-CHIP KERNEL time; this
         # is what a 30 GB volume costs end-to-end through THIS HOST's
-        # file driver at the rate just measured. On a local-PCIe TPU
-        # host the pipelined driver overlaps IO with the kernel and
-        # the gap closes toward the disk rate.
+        # file driver at the rate just measured, judged against the
+        # measured disk ceiling (utilization = fraction of the
+        # sequential-read bar this driver reaches).
         file_path_30gb_s=round(30.0 / gbps, 2),
+        utilization=round(gbps / ceiling["disk_seq_read_gb_s"], 3),
+        **ceiling,
     )
+
+
+def bench_http_reqs() -> None:
+    """Write/read req/s through the full HTTP data plane — the numbers
+    README round 5 carried only as prose, now driver-tracked JSON
+    (VERDICT round-5 ask). An in-process cluster (1 master + 1 volume
+    server) takes the repo's own `weed benchmark` load
+    (command/benchmark.run_benchmark: pooled keep-alive client
+    transport, assign + upload per write, lookup + download per read —
+    the exact workload the README prose was measured with).
+
+    Emits two lines: http_write_req_s (vs the README's ~3,400/s
+    round-5 prose figure) and http_read_req_s (vs ~11,000/s) — a
+    data-plane regression now shows in the driver's record, not just
+    in a stale paragraph. NOTE the README prose was measured across
+    three PROCESSES; here master + volume + load generator share one
+    GIL, so the absolute value is a conservative floor — the line
+    exists for round-over-round regression tracking, vs_baseline for
+    scale."""
+    import tempfile
+
+    from seaweedfs_tpu.command.benchmark import run_benchmark
+    from seaweedfs_tpu.command.servers import _tune_gc
+    from seaweedfs_tpu.util.availability import start_cluster
+
+    _tune_gc()
+    concurrency, num, size = 8, 2000, 1024
+    with tempfile.TemporaryDirectory() as d:
+        master, servers = start_cluster([tempfile.mkdtemp(dir=d)])
+        try:
+            results, _fids = run_benchmark(
+                master=f"127.0.0.1:{master.port}",
+                concurrency=concurrency,
+                num=num,
+                size=size,
+            )
+        finally:
+            for vs in servers:
+                vs.stop()
+            master.stop()
+
+    for (title, s), metric, baseline in zip(
+        results, ("http_write_req_s", "http_read_req_s"), (3400.0, 11000.0)
+    ):
+        rate = s.completed / max(1e-9, (s.ended or time.perf_counter()) - s.start)
+        _report(
+            metric,
+            rate,
+            "req/s",
+            rate / baseline,
+            concurrency=concurrency,
+            requests=s.completed,
+            failed=s.failed,
+        )
 
 
 def bench_migration() -> None:
@@ -652,6 +767,7 @@ CONFIGS = {
     "shardmap-verify": bench_shardmap_verify,
     "stream": bench_stream,
     "stream-rebuild": bench_stream_rebuild,
+    "http": bench_http_reqs,
     "migration": bench_migration_with_retry,
 }
 

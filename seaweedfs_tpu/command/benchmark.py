@@ -26,6 +26,10 @@ class LatencyStats:
         self.completed = 0
         self.failed = 0
         self.start = time.perf_counter()
+        # run_benchmark stamps phase end after the worker joins so
+        # programmatic callers (bench.py http) can compute req/s from
+        # the phase wall, not report() time
+        self.ended: float | None = None
 
     def add(self, latency_sec: float, nbytes: int, ok: bool = True) -> None:
         with self._lock:
@@ -217,6 +221,7 @@ def run_benchmark(
             t.start()
         for t in threads:
             t.join()
+        stats.ended = time.perf_counter()
         results.append((f"Writing Benchmark ({num} x {size}B)", stats))
 
     if do_read and fids:
@@ -248,6 +253,7 @@ def run_benchmark(
             t.start()
         for t in threads:
             t.join()
+        stats.ended = time.perf_counter()
         results.append((f"Random Read Benchmark ({num} reads)", stats))
 
     return results, fids

@@ -96,6 +96,16 @@ def _use_stream_driver(rs: ReedSolomon) -> bool:
     return _on_tpu()
 
 
+def _stream_host_codec(rs: ReedSolomon) -> bool:
+    """Route host codec backends that release the GIL (the native SIMD
+    shim's ctypes call) through the pipelined driver too: the reader
+    and pwritev writer pools overlap disk IO with the C encode, and the
+    flush-free raw-fd writes drop the serial close tail the classic
+    loop pays. The numpy "cpu" backend stays on the classic loop — it
+    is the bit-exact reference the others are judged against."""
+    return rs._backend_name == "native"
+
+
 def iter_ec_tiles(dat_size: int, tile: int, large: int, small: int):
     """Yield (row_offset, block_size, batch_off, step) sub-tiles
     covering the two-tier row layout (strict-`>` row counting,
@@ -151,7 +161,7 @@ def write_ec_files(
     (ec_encoder.go:53 WriteEcFiles).
 
     buffer_size=None lets each driver pick its default (4 MiB classic
-    IO batches; 16 MiB pipelined tiles on a TPU host). A `stats` dict
+    IO batches; 4 MiB pipelined tiles on TPU/native hosts). A `stats` dict
     collects per-phase busy seconds so e2e throughput numbers stay
     attributable (bench.py stream): the classic loop reports
     read_s/encode_s/write_s; the pipelined stream driver reports
@@ -161,14 +171,19 @@ def write_ec_files(
     if rs.data_shards != DATA_SHARDS or rs.parity_shards != PARITY_SHARDS:
         raise ValueError("shard-file layout is fixed at RS(10,4)")
 
-    if _use_stream_driver(rs):
+    if _use_stream_driver(rs) or _stream_host_codec(rs):
         from seaweedfs_tpu.ec import ec_stream
 
+        parity_fn = fetch_fn = None
+        if not _use_stream_driver(rs):
+            parity_fn, fetch_fn = ec_stream.local_encode_fns(rs)
         ec_stream.stream_write_ec_files(
             base_file_name,
             tile_bytes=buffer_size,
             large_block_size=large_block_size,
             small_block_size=small_block_size,
+            parity_fn=parity_fn,
+            fetch_fn=fetch_fn,
             stats=stats,
         )
         return
@@ -347,15 +362,21 @@ def rebuild_ec_files(
     (ec_encoder.go:83 generateMissingEcFiles). Returns rebuilt ids.
 
     buffer_size=None lets each driver pick its default (1 MiB classic
-    batches; 16 MiB pipelined tiles on a TPU host)."""
+    batches; 8 MiB pipelined tiles on TPU/native hosts)."""
     rs = rs or new_encoder()
     if rs.data_shards != DATA_SHARDS or rs.parity_shards != PARITY_SHARDS:
         raise ValueError("shard-file layout is fixed at RS(10,4)")
-    if _use_stream_driver(rs):
+    if _use_stream_driver(rs) or _stream_host_codec(rs):
         from seaweedfs_tpu.ec import ec_stream
 
+        rebuild_fn = fetch_fn = None
+        if not _use_stream_driver(rs):
+            rebuild_fn, fetch_fn = ec_stream.local_rebuild_fns(rs)
         return ec_stream.stream_rebuild_ec_files(
-            base_file_name, tile_bytes=buffer_size
+            base_file_name,
+            tile_bytes=buffer_size,
+            rebuild_fn=rebuild_fn,
+            fetch_fn=fetch_fn,
         )
     buffer_size = buffer_size or SMALL_BLOCK_SIZE
     present, missing = shard_presence(base_file_name)

@@ -1,4 +1,5 @@
-"""Double-buffered host↔HBM streaming drivers for EC encode/rebuild.
+"""Flush-free, pool-parallel host↔HBM streaming drivers for EC
+encode/rebuild.
 
 The classic drivers in ec_files.py are synchronous: read a batch,
 round-trip it through the codec, write, repeat — every stage waits for
@@ -6,33 +7,56 @@ every other. These drivers pipeline the stages the TPU-first way
 (SURVEY §7 step 2 "streaming driver double-buffers tiles host↔HBM"),
 matching the *output bytes* of ec_files.py exactly while overlapping:
 
-  disk read (tile t+1)  ‖  H2D + SWAR kernel (tile t)  ‖  parity D2H +
-  file writes (tile t-1)
+  disk reads (tiles t+1..)  ‖  H2D + SWAR kernel (tile t)  ‖  parity
+  D2H + shard writes (tiles t-1..)
 
-The host side is a three-thread pipeline: a reader thread fills a
-bounded tile queue from disk, the caller's thread dispatches the codec
-(JAX dispatch is async — `device_put` and the encode call return
-immediately), and a writer thread blocks on the parity fetch and lands
-all 14 shard files. So disk reads, device compute, and file writes
-genuinely overlap even though the fetch is blocking — on a local-PCIe
-TPU host the pipeline is no longer capped by one thread's read+write
-rate. Only the [4, N] parity ever crosses device→host — the ten
+Round 5 measured the previous single-reader/single-writer version
+losing 47% of encode wall to a SERIAL buffered-file flush at close and
+the rebuild reader serializing ten preadv calls on one thread. This
+version removes both bottlenecks:
+
+  * shard files are opened as RAW fds, preallocated to their exact
+    final size (posix_fallocate, ftruncate fallback), and written with
+    positioned os.pwritev at each tile's precomputed output offset —
+    no userspace buffering accumulates, so close() is free and
+    `flush_s` measures only the os.close loop;
+  * a READER POOL claims tiles from a shared index and fills a bounded
+    queue (each thread owns its fds: positioned preadv, no seek
+    state), so the ten survivor reads of a rebuild tile — or tiles of
+    the encode .dat — land in parallel instead of one serial loop;
+  * a WRITER POOL drains dispatched tiles: each worker blocks on its
+    tile's parity fetch and lands all rows with pwritev. Positioned
+    writes make tile COMPLETION ORDER irrelevant to the bytes — every
+    byte offset is written exactly once — so the pool needs no
+    re-sequencing to stay byte-identical to the synchronous drivers;
+  * the in-flight window is 3 dispatched-but-unfetched tiles deep (on
+    TPU hosts the H2D stage donates its staging buffer to XLA, see
+    _tpu_encode_fns), so H2D, kernel, and D2H genuinely
+    triple-overlap.
+
+Only the [4, N] parity ever crosses device→host on encode — the ten
 data-shard files are byte copies of the blocks read from the .dat,
-written straight from the host buffer. The single writer thread
-preserves tile order (queue FIFO), so output bytes stay identical to
-the synchronous ec_files.py drivers.
+written straight from the host tile.
+
+The rebuild driver additionally accepts REMOTE survivor readers
+(`remote_readers`: shard id → fetch(offset, size) callables), which is
+how the volume server's VolumeEcShardsRebuild verb overlaps rack-wide
+shard gathering with reconstruction instead of copying every survivor
+to the rebuilder before decoding byte one.
 
 Role match: the 256 KB-batch loops at reference
 weed/storage/erasure_coding/ec_encoder.go:188-225 (encodeDatFile) and
-:227-281 (rebuildEcFiles), rebuilt as a pipelined driver.
+:227-281 (rebuildEcFiles), rebuilt as a pooled pipelined driver.
 """
 
 from __future__ import annotations
 
+import errno
 import os
 import queue
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
 
 import numpy as np
@@ -45,13 +69,25 @@ TOTAL_SHARDS = locate.TOTAL_SHARDS
 LARGE_BLOCK_SIZE = locate.LARGE_BLOCK_SIZE
 SMALL_BLOCK_SIZE = locate.SMALL_BLOCK_SIZE
 
-# Per-shard bytes per pipelined tile. 16 MiB x 10 shards = 160 MiB of
-# host buffer per in-flight stage.
-DEFAULT_TILE_BYTES = 16 * 1024 * 1024
-# Dispatched-but-unfetched tiles queued toward the writer thread; with
-# the 1-deep read queue and the tile in the dispatcher's hands, at most
-# _INFLIGHT + 2 tiles of host memory are live.
-_INFLIGHT = 2
+# Per-shard bytes per pipelined tile. 4 MiB x 10 shards = 40 MiB of
+# host buffer per in-flight stage (on the encode path, up to 4
+# small-tier rows fold into one super-tile — see stream_write's
+# reader). Swept on the 2-core rig: bigger tiles amortize syscalls but
+# starve the pipeline of overlap on small volumes; 4 MiB won on the
+# disk-backed scratch, 1-2 MiB on tmpfs, 8 MiB lost on both.
+DEFAULT_TILE_BYTES = 4 * 1024 * 1024
+# Dispatched-but-unfetched tiles queued toward the writer pool. Live
+# host-tile bound: _INFLIGHT queued + one per writer thread (being
+# fetched/written) + reader_threads + 2 (read queue + the
+# dispatcher's hands) — 10 tiles at the defaults.
+_INFLIGHT = 3
+# Pool widths: the threads spend their time in GIL-released syscalls
+# (preadv/pwritev), GIL-released C codec calls, or blocking device
+# fetches, so a few of them keep the disks busy even on small hosts —
+# but every extra thread costs GIL churn, and a 2-core-host sweep
+# measured w=3/r=2 beating both w=2 and w=8 (BENCH r06 notes).
+DEFAULT_WRITER_THREADS = min(8, max(3, (os.cpu_count() or 2) + 1))
+DEFAULT_READER_THREADS = min(4, max(2, (os.cpu_count() or 2) // 2))
 
 _EOF = object()  # end-of-stream marker flowing through the queues
 _STOPPED = object()  # returned by _q_get when the pipeline aborted
@@ -81,8 +117,8 @@ def _q_get(q: queue.Queue, stop: threading.Event):
 
 
 class _Pipeline:
-    """Reader + writer threads around the caller's dispatch loop, with
-    first-error propagation and deadlock-free shutdown."""
+    """Reader/writer pool threads around the caller's dispatch loop,
+    with first-error propagation and deadlock-free shutdown."""
 
     def __init__(self):
         self.stop = threading.Event()
@@ -111,6 +147,122 @@ class _Pipeline:
             raise self.errors[0]
 
 
+# --- raw-fd IO primitives ---------------------------------------------------
+
+
+def _preallocate(fd: int, size: int) -> None:
+    """Reserve the file's exact final extent up front so ENOSPC fails
+    before the pipeline spins up and close() has no deferred work.
+    posix_fallocate allocates real blocks where the filesystem supports
+    it; anything it can't do degrades to ftruncate (sparse extent —
+    every byte is positioned-written exactly once anyway)."""
+    if size <= 0:
+        return
+    try:
+        os.posix_fallocate(fd, 0, size)
+        return
+    except OSError as e:
+        if e.errno == errno.ENOSPC:
+            raise
+    os.ftruncate(fd, size)
+
+
+def _pwrite_full(fd: int, buf, offset: int) -> None:
+    """Positioned write of the whole buffer (pwritev can short-write on
+    signals / rlimits; a silent short write would corrupt the shard)."""
+    _pwritev_full(fd, [buf], offset)
+
+
+def _pwritev_full(fd: int, bufs, offset: int) -> None:
+    """Positioned gathered write of every buffer, restarting cleanly
+    across short writes. One syscall lands a super-tile's whole run of
+    per-row blocks for a shard (buffers need not be contiguous in
+    memory — they ARE contiguous in the shard file)."""
+    mvs = [memoryview(b).cast("B") for b in bufs]
+    written = 0
+    while mvs:
+        w = os.pwritev(fd, mvs, offset + written)
+        if w <= 0:
+            raise OSError(errno.EIO, f"short pwritev at {offset + written}")
+        written += w
+        while mvs and w >= len(mvs[0]):
+            w -= len(mvs[0])
+            mvs.pop(0)
+        if mvs and w:
+            mvs[0] = mvs[0][w:]
+
+
+def _pread_into(fd: int, view, offset: int) -> int:
+    """Positioned read filling `view` (a writable uint8 buffer); stops
+    early only at EOF. Returns bytes read."""
+    mv = memoryview(view).cast("B")
+    got = 0
+    n = len(mv)
+    while got < n:
+        r = os.preadv(fd, [mv[got:]], offset + got)
+        if r == 0:
+            break
+        got += r
+    return got
+
+
+def _charge(busy: dict, lock: threading.Lock, key: str, dt: float) -> None:
+    """Accumulate per-stage busy seconds across pool threads (a stage
+    total can legitimately exceed wall — it is thread-seconds)."""
+    with lock:
+        busy[key] += dt
+
+
+# --- codec stage factories --------------------------------------------------
+
+
+def local_encode_fns(rs) -> tuple[Callable, Callable]:
+    """(parity_fn, fetch_fn) for a host ReedSolomon backend.
+
+    Unlike the TPU pair — where parity_fn dispatches async device work
+    — a host codec has no async engine, so parity_fn just hands the
+    tile through and fetch_fn runs the actual matrix apply IN THE
+    WRITER POOL. The native SIMD shim releases the GIL inside its C
+    call, so W writer threads encode W tiles concurrently instead of
+    serializing the codec on the dispatcher thread (measured: the
+    single-thread native encode rate was the whole pipeline's cap)."""
+
+    def fetch_fn(tile: np.ndarray):
+        return rs._apply(rs.parity_rows, tile)
+
+    return (lambda tile: tile), fetch_fn
+
+
+def local_rebuild_fns(rs) -> tuple[Callable, Callable]:
+    """(rebuild_fn, fetch_fn) over a host ReedSolomon backend, with the
+    inverted-survivor decode rows cached per (survivors, targets) and
+    the decode itself deferred to the writer pool (see
+    local_encode_fns)."""
+    rows_cache: dict = {}
+    cache_lock = threading.Lock()
+
+    def rebuild_fn(survivors, targets, tile: np.ndarray):
+        return (tuple(survivors), tuple(targets), tile)
+
+    def fetch_fn(handle):
+        survivors, targets, tile = handle
+        key = (survivors, targets)
+        with cache_lock:
+            rows = rows_cache.get(key)
+        if rows is None:
+            from seaweedfs_tpu.ec import gf256
+
+            rows = gf256.decode_rows(rs.matrix, survivors, targets)
+            with cache_lock:
+                rows_cache[key] = rows
+        return rs._apply(rows, tile)
+
+    return rebuild_fn, fetch_fn
+
+
+# --- encode driver ----------------------------------------------------------
+
+
 def stream_write_ec_files(
     base_file_name: str,
     tile_bytes: int | None = None,
@@ -119,79 +271,196 @@ def stream_write_ec_files(
     parity_fn: Callable[[np.ndarray], "object"] | None = None,
     fetch_fn: Callable[["object"], np.ndarray] | None = None,
     stats: dict | None = None,
+    writer_threads: int | None = None,
+    reader_threads: int | None = None,
 ) -> None:
     """Pipelined .dat → .ec00…13, byte-identical to write_ec_files.
 
     parity_fn([10, step] u8 host tile) must *dispatch* the parity
     computation and return an opaque handle immediately; fetch_fn turns
-    the handle into a [4, step] u8 numpy array (blocking). The defaults
-    run the SWAR kernel on the attached TPU. The indirection keeps the
-    pipeline logic testable on CPU hosts (tests inject a numpy
-    parity_fn and still exercise tiling/ordering/write paths).
-    """
+    the handle into a [4, step] u8 numpy array (blocking; called
+    concurrently from the writer pool, so both must be thread-safe).
+    The defaults run the SWAR kernel on the attached TPU. The
+    indirection keeps the pipeline logic testable on CPU hosts (tests
+    inject a numpy parity_fn and still exercise tiling/offsets/write
+    paths)."""
     if (parity_fn is None) != (fetch_fn is None):
         raise ValueError("parity_fn and fetch_fn must be injected together")
     if parity_fn is None:
         parity_fn, fetch_fn = _tpu_encode_fns()
     tile_bytes = tile_bytes or DEFAULT_TILE_BYTES
+    writer_threads = writer_threads or DEFAULT_WRITER_THREADS
+    reader_threads = reader_threads or DEFAULT_READER_THREADS
 
     dat_path = base_file_name + ".dat"
     dat_size = os.path.getsize(dat_path)
-    from seaweedfs_tpu.ec.ec_files import iter_ec_tiles, read_dat_tile, to_ext
+    from seaweedfs_tpu.ec.ec_files import iter_ec_tiles, to_ext
 
-    outputs = [open(base_file_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
+    # tiles and their shard-file output offsets, precomputed: each tile
+    # contributes exactly `width` bytes per shard in generation order,
+    # so positioned writes land it wherever it finishes. Consecutive
+    # FULL-ROW tiles (the whole small-block tier once tile_bytes ≥
+    # small_block_size) merge into SUPER-TILES of up to tile_bytes per
+    # shard: one contiguous .dat read, one codec call, and one pwritev
+    # per shard then carry `rows` rows each — per-row 1 MiB granularity
+    # drowned the pipeline in syscall + GIL round-trips.
+    tiles: list[tuple[int, int, int, int, int]] = []  # (row_off, block, batch_off, step, rows)
+    for row_off, block, batch_off, step in iter_ec_tiles(
+        dat_size, tile_bytes, large_block_size, small_block_size
+    ):
+        if tiles and batch_off == 0 and step == block:
+            p_off, p_block, p_batch, p_step, p_rows = tiles[-1]
+            if (
+                p_batch == 0
+                and p_step == p_block == block
+                and p_off + p_rows * block * DATA_SHARDS == row_off
+                and (p_rows + 1) * block <= tile_bytes
+            ):
+                tiles[-1] = (p_off, p_block, 0, p_step, p_rows + 1)
+                continue
+        tiles.append((row_off, block, batch_off, step, 1))
+    out_offs, shard_bytes = [], 0
+    for _, _, _, step, rows in tiles:
+        out_offs.append(shard_bytes)
+        shard_bytes += step * rows
+
+    out_fds: list[int] = []  # opened inside the try: no leak on ENOSPC
     pipe = _Pipeline()
-    read_q: queue.Queue = queue.Queue(maxsize=1)
+    read_q: queue.Queue = queue.Queue(maxsize=max(2, reader_threads))
     write_q: queue.Queue = queue.Queue(maxsize=_INFLIGHT)
-    # per-stage busy seconds (queue waits excluded): read | dispatch |
-    # fetch (codec drain) | write — how e2e numbers stay attributable
+    # per-stage busy thread-seconds (queue waits excluded): read |
+    # dispatch | fetch (codec drain) | write — how e2e numbers stay
+    # attributable
     busy = {"read_s": 0.0, "dispatch_s": 0.0, "fetch_s": 0.0, "write_s": 0.0}
+    busy_lock = threading.Lock()
     wall0 = time.perf_counter()
 
+    idx_lock = threading.Lock()
+    idx_iter = iter(range(len(tiles)))
+
     def reader():
-        with open(dat_path, "rb") as dat:
-            for row_off, block, batch_off, step in iter_ec_tiles(
-                dat_size, tile_bytes, large_block_size, small_block_size
-            ):
-                t0 = time.perf_counter()
-                tile = read_dat_tile(dat, dat_size, row_off, block, batch_off, step)
-                busy["read_s"] += time.perf_counter() - t0
-                if not _q_put(read_q, tile, pipe.stop):
+        fd = os.open(dat_path, os.O_RDONLY)
+        try:
+            while True:
+                with idx_lock:
+                    k = next(idx_iter, None)
+                if k is None:
                     return
-        _q_put(read_q, _EOF, pipe.stop)
+                row_off, block, batch_off, step, rows = tiles[k]
+                t0 = time.perf_counter()
+                # one flat [rows, 10, step] buffer per tile, preadv
+                # straight into it (no bytes objects, no shared seek
+                # position across the pool), zero-padded past EOF like
+                # read_dat_tile — and only spans the .dat does not
+                # cover pay the memset. NO reshuffling into shard
+                # order: the codec consumes contiguous per-row [10,
+                # step] views and the writer gather-writes each shard's
+                # run of blocks with one iovec pwritev, so the bytes
+                # are copied exactly once between disk reads and
+                # writes.
+                flat = np.empty(rows * DATA_SHARDS * step, dtype=np.uint8)
+                if batch_off == 0 and step == block:
+                    # full rows are CONTIGUOUS in the .dat: one read
+                    # covers the whole super-tile
+                    n = max(0, min(len(flat), dat_size - row_off))
+                    if n < len(flat):
+                        flat[n:] = 0
+                    if n:
+                        got = _pread_into(fd, flat[:n], row_off)
+                        if got < n:  # truncated .dat: pad like classic
+                            flat[got:n] = 0
+                else:
+                    # sub-block tile of the large tier: rows == 1,
+                    # shard blocks are strided through the .dat
+                    for i in range(DATA_SHARDS):
+                        row = flat[i * step : (i + 1) * step]
+                        off = row_off + i * block + batch_off
+                        n = max(0, min(step, dat_size - off))
+                        if n < step:
+                            row[n:] = 0
+                        if n:
+                            got = _pread_into(fd, row[:n], off)
+                            if got < n:
+                                row[got:n] = 0
+                _charge(busy, busy_lock, "read_s", time.perf_counter() - t0)
+                if not _q_put(read_q, (k, flat), pipe.stop):
+                    return
+        finally:
+            os.close(fd)
 
     def writer():
         while True:
             item = _q_get(write_q, pipe.stop)
             if item is _EOF or item is _STOPPED:
                 return
-            tile, handle = item
+            k, flat, handles = item
+            _, _, _, step, rows = tiles[k]
+            off = out_offs[k]
             t0 = time.perf_counter()
-            parity = fetch_fn(handle)
+            parities = [fetch_fn(h) for h in handles]
             t1 = time.perf_counter()
-            # buffer-protocol writes: a tobytes() copy per row doubled
-            # the writer's memory traffic
             for i in range(DATA_SHARDS):
-                outputs[i].write(tile[i])
-            for i in range(PARITY_SHARDS):
-                outputs[DATA_SHARDS + i].write(np.ascontiguousarray(parity[i]))
-            busy["fetch_s"] += t1 - t0
-            busy["write_s"] += time.perf_counter() - t1
+                _pwritev_full(
+                    out_fds[i],
+                    [
+                        flat[
+                            (r * DATA_SHARDS + i) * step : (r * DATA_SHARDS + i + 1)
+                            * step
+                        ]
+                        for r in range(rows)
+                    ],
+                    off,
+                )
+            for p in range(PARITY_SHARDS):
+                _pwritev_full(
+                    out_fds[DATA_SHARDS + p],
+                    [np.ascontiguousarray(parities[r][p]) for r in range(rows)],
+                    off,
+                )
+            t2 = time.perf_counter()
+            _charge(busy, busy_lock, "fetch_s", t1 - t0)
+            _charge(busy, busy_lock, "write_s", t2 - t1)
 
-    pipe.spawn(reader)
-    pipe.spawn(writer)
     ok = False
     try:
-        while True:
-            tile = _q_get(read_q, pipe.stop)
-            if tile is _EOF or tile is _STOPPED:
+        for i in range(TOTAL_SHARDS):
+            out_fds.append(
+                os.open(
+                    base_file_name + to_ext(i),
+                    os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                    0o644,
+                )
+            )
+        for fd in out_fds:
+            _preallocate(fd, shard_bytes)
+        for _ in range(reader_threads):
+            pipe.spawn(reader)
+        for _ in range(writer_threads):
+            pipe.spawn(writer)
+        for _ in range(len(tiles)):
+            item = _q_get(read_q, pipe.stop)
+            if item is _STOPPED:
                 break
+            k, flat = item
+            _, _, _, step, rows = tiles[k]
             t0 = time.perf_counter()
-            handle = parity_fn(tile)
-            busy["dispatch_s"] += time.perf_counter() - t0
-            if not _q_put(write_q, (tile, handle), pipe.stop):
+            # one parity dispatch per row: each [10, step] view is
+            # contiguous in the flat buffer, so the injected stage
+            # contract (and the TPU H2D) sees an ordinary tile
+            handles = [
+                parity_fn(
+                    flat[
+                        r * DATA_SHARDS * step : (r + 1) * DATA_SHARDS * step
+                    ].reshape(DATA_SHARDS, step)
+                )
+                for r in range(rows)
+            ]
+            _charge(busy, busy_lock, "dispatch_s", time.perf_counter() - t0)
+            if not _q_put(write_q, (k, flat, handles), pipe.stop):
                 break
-        _q_put(write_q, _EOF, pipe.stop)
+        for _ in range(writer_threads):
+            if not _q_put(write_q, _EOF, pipe.stop):
+                break
         ok = True
     finally:
         try:
@@ -199,12 +468,33 @@ def stream_write_ec_files(
         finally:
             tc0 = time.perf_counter()
             try:
-                for f in outputs:
-                    f.close()
+                for fd in out_fds:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+                if not ok or pipe.errors:
+                    # a partial shard set must not survive the abort:
+                    # shard_presence treats ANY existing .ecNN as a
+                    # valid shard, so full-size garbage files would
+                    # read as a complete volume to a later rebuild
+                    for i in range(TOTAL_SHARDS):
+                        try:
+                            os.remove(base_file_name + to_ext(i))
+                        except OSError:
+                            pass
             finally:
+                # raw preallocated fds: nothing buffered remains, so
+                # this measures only the close syscalls (the previous
+                # driver lost 47% of wall right here)
                 busy["flush_s"] = time.perf_counter() - tc0
                 if stats is not None:
-                    _finish_stats(stats, busy, wall0)
+                    _finish_stats(
+                        stats, busy, wall0, reader_threads, writer_threads
+                    )
+
+
+# --- rebuild driver ---------------------------------------------------------
 
 
 def stream_rebuild_ec_files(
@@ -214,86 +504,181 @@ def stream_rebuild_ec_files(
     | None = None,
     fetch_fn: Callable[["object"], np.ndarray] | None = None,
     stats: dict | None = None,
+    remote_readers: dict[int, Callable[[int, int], bytes]] | None = None,
+    writer_threads: int | None = None,
+    reader_threads: int | None = None,
 ) -> list[int]:
     """Pipelined shard rebuild, byte-identical to rebuild_ec_files.
 
     rebuild_fn(survivors, targets, [10, step] u8) dispatches
     reconstruction of `targets` from the survivor tile and returns a
-    handle; fetch_fn blocks it into [len(targets), step] u8."""
+    handle; fetch_fn blocks it into [len(targets), step] u8 (called
+    from the writer pool — both must be thread-safe).
+
+    remote_readers maps shard id → fetch(offset, size) -> bytes for
+    survivors that live on OTHER nodes: the reader pool pulls their
+    tiles over the wire in parallel with local preadv and the decode,
+    and shards readable remotely are treated as present (not rebuilt).
+    At least one survivor must be local — its file size fixes the tile
+    walk."""
     if (rebuild_fn is None) != (fetch_fn is None):
         raise ValueError("rebuild_fn and fetch_fn must be injected together")
     if rebuild_fn is None:
         rebuild_fn, fetch_fn = _tpu_rebuild_fns()
-    tile_bytes = tile_bytes or DEFAULT_TILE_BYTES
+    # rebuild tiles read one span from each of 10 FILES (no contiguous
+    # row to coalesce, unlike encode), so bigger tiles amortize better
+    tile_bytes = tile_bytes or 2 * DEFAULT_TILE_BYTES
+    writer_threads = writer_threads or DEFAULT_WRITER_THREADS
+    reader_threads = reader_threads or DEFAULT_READER_THREADS
+    remote_readers = dict(remote_readers or {})
 
     from seaweedfs_tpu.ec.ec_files import shard_presence, to_ext
 
-    present, missing = shard_presence(base_file_name)
-    if not missing:
+    present, local_missing = shard_presence(base_file_name)
+    local_ids = [i for i, p in enumerate(present) if p]
+    # a shard readable remotely exists in the cluster: it can serve as
+    # a survivor but must not be rebuilt here
+    targets = tuple(i for i in local_missing if i not in remote_readers)
+    if not targets:
         return []
-    if sum(present) < DATA_SHARDS:
+    remote_ids = [i for i in remote_readers if not present[i]]
+    if len(local_ids) + len(remote_ids) < DATA_SHARDS:
         raise ValueError(
-            f"too few shard files to rebuild: {sum(present)} of {DATA_SHARDS}"
+            "too few shard files to rebuild: "
+            f"{len(local_ids) + len(remote_ids)} of {DATA_SHARDS}"
         )
-    survivors = tuple(i for i, p in enumerate(present) if p)[:DATA_SHARDS]
-    targets = tuple(missing)
+    if not local_ids:
+        raise ValueError(
+            "rebuild needs at least one local survivor (its size fixes "
+            "the shard length)"
+        )
+    # prefer local survivors (free reads), top up from remote holders;
+    # the decode matrix keeps the chosen set in ascending order — any
+    # 10-of-14 subset reconstructs identical bytes
+    survivors = tuple(
+        sorted((local_ids + sorted(remote_ids))[:DATA_SHARDS])
+    )
+    shard_size = os.path.getsize(base_file_name + to_ext(local_ids[0]))
 
-    inputs = {i: open(base_file_name + to_ext(i), "rb") for i in survivors}
-    outputs = {i: open(base_file_name + to_ext(i), "wb") for i in missing}
+    out_fds: dict[int, int] = {}  # opened inside the try: no leak on ENOSPC
     pipe = _Pipeline()
-    read_q: queue.Queue = queue.Queue(maxsize=1)
+    read_q: queue.Queue = queue.Queue(maxsize=max(2, reader_threads))
     write_q: queue.Queue = queue.Queue(maxsize=_INFLIGHT)
     busy = {"read_s": 0.0, "dispatch_s": 0.0, "fetch_s": 0.0, "write_s": 0.0}
+    busy_lock = threading.Lock()
     wall0 = time.perf_counter()
 
+    offsets = list(range(0, shard_size, tile_bytes))
+    idx_lock = threading.Lock()
+    idx_iter = iter(offsets)
+
+    n_remote = sum(1 for i in survivors if not present[i])
+
     def reader():
-        shard_size = os.path.getsize(base_file_name + to_ext(survivors[0]))
-        offset = 0
-        while offset < shard_size:
-            t0 = time.perf_counter()
-            step = min(tile_bytes, shard_size - offset)
-            tile = np.empty((DATA_SHARDS, step), dtype=np.uint8)
-            for j, i in enumerate(survivors):
-                # preadv straight into the tile row: os.pread would
-                # allocate a bytes object and pay a second memcpy
-                got = os.preadv(inputs[i].fileno(), [tile[j]], offset)
-                if got != step:
-                    raise ValueError(
-                        f"ec shard {i} truncated: expected {step} at {offset}"
-                    )
-            busy["read_s"] += time.perf_counter() - t0
-            if not _q_put(read_q, tile, pipe.stop):
-                return
-            offset += step
-        _q_put(read_q, _EOF, pipe.stop)
+        fds = {
+            i: os.open(base_file_name + to_ext(i), os.O_RDONLY)
+            for i in survivors
+            if present[i]
+        }
+        # remote survivor fetches fan out per tile: serialized, a
+        # tile's latency would be n_remote × RTT and a single slow
+        # holder would stall the whole tile walk
+        fetch_pool = (
+            ThreadPoolExecutor(max_workers=min(n_remote, DATA_SHARDS))
+            if n_remote > 1
+            else None
+        )
+        try:
+            while True:
+                with idx_lock:
+                    offset = next(idx_iter, None)
+                if offset is None:
+                    return
+                t0 = time.perf_counter()
+                step = min(tile_bytes, shard_size - offset)
+                tile = np.empty((DATA_SHARDS, step), dtype=np.uint8)
+                futures = {}
+                if fetch_pool is not None:
+                    futures = {
+                        j: fetch_pool.submit(remote_readers[i], offset, step)
+                        for j, i in enumerate(survivors)
+                        if i not in fds
+                    }
+                for j, i in enumerate(survivors):
+                    if i in fds:
+                        got = _pread_into(fds[i], tile[j], offset)
+                    else:
+                        fut = futures.get(j)
+                        raw = (
+                            fut.result()
+                            if fut is not None
+                            else remote_readers[i](offset, step)
+                        )
+                        got = len(raw)
+                        if got == step:
+                            tile[j] = np.frombuffer(raw, dtype=np.uint8)
+                    if got != step:
+                        raise ValueError(
+                            f"ec shard {i} truncated: expected {step} at "
+                            f"{offset}"
+                        )
+                _charge(busy, busy_lock, "read_s", time.perf_counter() - t0)
+                if not _q_put(read_q, (offset, tile), pipe.stop):
+                    return
+        finally:
+            if fetch_pool is not None:
+                # wait for in-flight remote fetches: the caller closes
+                # the reader channels right after the driver returns,
+                # and an RPC still running on a pool thread would see
+                # its channel yanked (and leak the thread past return)
+                fetch_pool.shutdown(wait=True, cancel_futures=True)
+            for fd in fds.values():
+                os.close(fd)
 
     def writer():
         while True:
             item = _q_get(write_q, pipe.stop)
             if item is _EOF or item is _STOPPED:
                 return
+            offset, handle = item
             t0 = time.perf_counter()
-            rebuilt = fetch_fn(item)
+            rebuilt = fetch_fn(handle)
             t1 = time.perf_counter()
             for j, i in enumerate(targets):
-                outputs[i].write(np.ascontiguousarray(rebuilt[j]))
-            busy["fetch_s"] += t1 - t0
-            busy["write_s"] += time.perf_counter() - t1
+                _pwrite_full(
+                    out_fds[i], np.ascontiguousarray(rebuilt[j]), offset
+                )
+            t2 = time.perf_counter()
+            _charge(busy, busy_lock, "fetch_s", t1 - t0)
+            _charge(busy, busy_lock, "write_s", t2 - t1)
 
-    pipe.spawn(reader)
-    pipe.spawn(writer)
     ok = False
     try:
-        while True:
-            tile = _q_get(read_q, pipe.stop)
-            if tile is _EOF or tile is _STOPPED:
+        for i in targets:
+            out_fds[i] = os.open(
+                base_file_name + to_ext(i),
+                os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                0o644,
+            )
+        for fd in out_fds.values():
+            _preallocate(fd, shard_size)
+        for _ in range(reader_threads):
+            pipe.spawn(reader)
+        for _ in range(writer_threads):
+            pipe.spawn(writer)
+        for _ in range(len(offsets)):
+            item = _q_get(read_q, pipe.stop)
+            if item is _STOPPED:
                 break
+            offset, tile = item
             t0 = time.perf_counter()
             handle = rebuild_fn(survivors, targets, tile)
-            busy["dispatch_s"] += time.perf_counter() - t0
-            if not _q_put(write_q, handle, pipe.stop):
+            _charge(busy, busy_lock, "dispatch_s", time.perf_counter() - t0)
+            if not _q_put(write_q, (offset, handle), pipe.stop):
                 break
-        _q_put(write_q, _EOF, pipe.stop)
+        for _ in range(writer_threads):
+            if not _q_put(write_q, _EOF, pipe.stop):
+                break
         ok = True
     finally:
         try:
@@ -301,36 +686,70 @@ def stream_rebuild_ec_files(
         finally:
             tc0 = time.perf_counter()
             try:
-                for f in outputs.values():
-                    f.close()
+                for fd in out_fds.values():
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+                if not ok or pipe.errors:
+                    # half-written targets must not survive: a later
+                    # shard_presence would count the garbage files as
+                    # valid shards and silently skip rebuilding them
+                    # (e.g. ec.rebuild's full-copy fallback retry)
+                    for i in targets:
+                        try:
+                            os.remove(base_file_name + to_ext(i))
+                        except OSError:
+                            pass
             finally:
-                # an ENOSPC surfacing in a buffered close must not skip
-                # the stats nor leak the 10 survivor read fds
+                # an ENOSPC surfacing mid-stream must not skip the
+                # stats nor leak any fd (the reader pool closes its own
+                # survivor fds in its thread's finally)
                 busy["flush_s"] = time.perf_counter() - tc0
                 if stats is not None:
-                    _finish_stats(stats, busy, wall0)
-                for f in inputs.values():
-                    f.close()
-    return missing
+                    _finish_stats(
+                        stats, busy, wall0, reader_threads, writer_threads
+                    )
+    return list(targets)
 
 
-def _finish_stats(stats: dict, busy: dict, wall0: float) -> None:
-    """Per-stage busy seconds + wall and the unattributed remainder.
-    The PIPELINE stages (read/dispatch/fetch/write) run in three
-    threads, so their Σ can legitimately exceed wall (overlap) — the
-    wall they explain is their max. flush_s is different: it is the
-    SERIAL post-pipeline close (kernel writeback) appended to the
-    wall, so it subtracts separately. loop_s = wall − flush − max
-    pipeline stage: the honest "pipeline was idle / Python glue"
-    residue for a bench line to carry."""
+def _finish_stats(
+    stats: dict,
+    busy: dict,
+    wall0: float,
+    reader_threads: int = 1,
+    writer_threads: int = 1,
+) -> None:
+    """Per-stage busy thread-seconds + wall and the unattributed
+    remainder. The PIPELINE stages (read/dispatch/fetch/write) run in
+    thread POOLS, so a stage's Σ can exceed wall (overlap across
+    threads) — the wall a stage explains is its total divided by its
+    pool width. flush_s is different: it is the SERIAL post-pipeline
+    close of the raw fds appended to the wall (≈0 now that nothing is
+    buffered), so it subtracts separately. loop_s = wall − flush − max
+    per-thread stage share: the honest "pipeline was idle / Python
+    glue" residue for a bench line to carry (clamped at 0 — pool
+    accounting is approximate)."""
     wall = time.perf_counter() - wall0
     flush = busy.get("flush_s", 0.0)
+    widths = {
+        "read_s": reader_threads,
+        "fetch_s": writer_threads,
+        "write_s": writer_threads,
+    }
     pipeline_max = max(
-        (v for k, v in busy.items() if k != "flush_s"), default=0.0
+        (
+            v / widths.get(k, 1)
+            for k, v in busy.items()
+            if k != "flush_s"
+        ),
+        default=0.0,
     )
     stats.update({k: round(v, 4) for k, v in busy.items()})
     stats["wall_s"] = round(wall, 4)
-    stats["loop_s"] = round(wall - flush - pipeline_max, 4)
+    stats["loop_s"] = round(max(0.0, wall - flush - pipeline_max), 4)
+    stats["reader_threads"] = reader_threads
+    stats["writer_threads"] = writer_threads
 
 
 # --- default TPU kernel stages ---------------------------------------------
@@ -352,17 +771,25 @@ def _fetch(handle) -> np.ndarray:
 
 
 def _tpu_encode_fns():
+    import jax
     import jax.numpy as jnp
 
     from seaweedfs_tpu.ec.codec_tpu import TpuCodecKernels
 
     kern = TpuCodecKernels(DATA_SHARDS, PARITY_SHARDS)
+    # donate the H2D staging buffer: the [10, n32] tile is dead the
+    # moment the kernel has read it, and with 3 tiles in flight XLA
+    # recycling the donated extent keeps the deepened window from
+    # growing HBM residency per tile
+    encode_u32_don = jax.jit(
+        lambda u32: kern.encode_u32(u32), donate_argnums=0
+    )
 
     def parity_fn(tile: np.ndarray):
         swar = _swar_ok(tile.shape[1])
         if swar:
             u32 = jnp.asarray(tile.view(np.uint32))  # async H2D
-            out = kern.encode_u32(u32)  # async dispatch
+            out = encode_u32_don(u32)  # async dispatch
         else:
             out = kern.encode(jnp.asarray(tile))
         return out, swar
@@ -371,17 +798,23 @@ def _tpu_encode_fns():
 
 
 def _tpu_rebuild_fns():
+    import jax
     import jax.numpy as jnp
 
     from seaweedfs_tpu.ec.codec_tpu import TpuCodecKernels
 
     kern = TpuCodecKernels(DATA_SHARDS, PARITY_SHARDS)
+    recon_don = jax.jit(
+        lambda s, t, u32: kern.reconstruct_u32(s, t, u32),
+        static_argnums=(0, 1),
+        donate_argnums=2,
+    )
 
     def rebuild_fn(survivors, targets, tile: np.ndarray):
         swar = _swar_ok(tile.shape[1])
         if swar:
             u32 = jnp.asarray(tile.view(np.uint32))
-            out = kern.reconstruct_u32(survivors, targets, u32)
+            out = recon_don(tuple(survivors), tuple(targets), u32)
         else:
             out = kern.reconstruct(survivors, targets, jnp.asarray(tile))
         return out, swar

@@ -725,9 +725,121 @@ class VolumeServer:
         return pb.VolumeEcShardsBatchGenerateResponse()
 
     def VolumeEcShardsRebuild(self, req, context):
+        """Regenerate missing shard files. With every survivor local
+        this is the classic local-file rebuild; when survivors are
+        missing locally but mounted elsewhere (the rack-gather case —
+        ec.rebuild no longer pre-copies them), the pipelined
+        ec_stream driver reads those shards straight off their holders
+        tile by tile, overlapping the remote fetch with reconstruction
+        instead of serializing a full cluster copy before decoding
+        byte one."""
         base = self._base_name(req.collection, req.volume_id)
-        rebuilt = ec_files.rebuild_ec_files(base, rs=self._new_rs())
+        present, missing = ec_files.shard_presence(base)
+        if not missing or not self.master:
+            rebuilt = ec_files.rebuild_ec_files(base, rs=self._new_rs())
+            return pb.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
+        # with a master, always learn which "missing" shards are in
+        # fact mounted elsewhere: they serve as remote survivors and
+        # are EXCLUDED from the rebuild targets — even a rebuilder
+        # holding >= 10 local shards must not regenerate (and later
+        # double-mount) shards the cluster still has
+        readers, close_readers = self._remote_rebuild_readers(
+            req.volume_id, {i for i, p in enumerate(present) if p}
+        )
+        try:
+            if not readers:
+                rebuilt = ec_files.rebuild_ec_files(base, rs=self._new_rs())
+            else:
+                from seaweedfs_tpu.ec import ec_stream
+
+                rs = self._new_rs()
+                rebuild_fn = fetch_fn = None
+                if not ec_files._use_stream_driver(rs):
+                    rebuild_fn, fetch_fn = ec_stream.local_rebuild_fns(rs)
+                try:
+                    rebuilt = ec_stream.stream_rebuild_ec_files(
+                        base,
+                        rebuild_fn=rebuild_fn,
+                        fetch_fn=fetch_fn,
+                        remote_readers=readers,
+                    )
+                except ValueError as e:
+                    context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        finally:
+            close_readers()
         return pb.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
+
+    def _remote_rebuild_readers(self, vid: int, skip: set[int]):
+        """(readers, closer): shard id → fetch(offset, size) callables
+        over VolumeEcShardRead against holders learned from the master,
+        for survivors not in `skip` (the locally-present set). One
+        cached channel per holder — the stream driver's reader pool
+        calls these concurrently, and grpc channels are thread-safe."""
+        if not self.master:
+            return {}, (lambda: None)
+        try:
+            with rpc.dial(self._master_grpc()) as ch:
+                resp = rpc.master_stub(ch).LookupEcVolume(
+                    master_pb2.LookupEcVolumeRequest(volume_id=vid),
+                    timeout=5,
+                )
+        except grpc.RpcError:
+            return {}, (lambda: None)
+        me = f"{self.host}:{self.port}"
+        locations: dict[int, list[str]] = {}
+        for entry in resp.shard_id_locations:
+            urls = [l.url for l in entry.locations if l.url != me]
+            if urls and entry.shard_id not in skip:
+                locations[entry.shard_id] = urls
+        channels: dict[str, grpc.Channel] = {}
+        channels_lock = threading.Lock()
+
+        def channel(url: str) -> grpc.Channel:
+            with channels_lock:
+                ch = channels.get(url)
+                if ch is None:
+                    host, _, port = url.partition(":")
+                    ch = channels[url] = rpc.dial(f"{host}:{int(port) + 10000}")
+                return ch
+
+        def make_reader(sid: int, urls: list[str]):
+            def read(offset: int, size: int) -> bytes:
+                last: Exception | None = None
+                for url in urls:
+                    try:
+                        data = b"".join(
+                            r.data
+                            for r in rpc.volume_stub(channel(url)).VolumeEcShardRead(
+                                pb.VolumeEcShardReadRequest(
+                                    volume_id=vid,
+                                    shard_id=sid,
+                                    offset=offset,
+                                    size=size,
+                                ),
+                                timeout=30,
+                            )
+                        )
+                    except grpc.RpcError as e:
+                        last = e
+                        continue
+                    if len(data) == size:
+                        return data
+                    last = ValueError(
+                        f"shard {sid}@{url} returned {len(data)} of {size} "
+                        f"bytes at {offset}"
+                    )
+                raise last or ValueError(f"no holder for ec shard {sid}")
+
+            return read
+
+        def closer() -> None:
+            for ch in channels.values():
+                ch.close()
+
+        return (
+            {sid: make_reader(sid, urls) for sid, urls in locations.items()},
+            closer,
+        )
 
     def VolumeEcShardsCopy(self, req: pb.VolumeEcShardsCopyRequest, context):
         """Pull shard files from the source node via its CopyFile stream."""
@@ -1061,12 +1173,19 @@ class VolumeServer:
                 if not server._shard_is_foreign(fid.volume_id):
                     return False
                 if self.headers.get("x-shard-hop"):
-                    # the owner could not serve this (unparsed form,
-                    # manifest cascade, mid-commit volume): take the
-                    # vid over and handle it here - routing back would
-                    # loop
-                    server._ensure_owned(fid.volume_id)
-                    return False
+                    # hop signaling is trusted from the loopback
+                    # internal listener ONLY (workers proxy through
+                    # it): honored from the public port, an anonymous
+                    # client could force _ensure_owned per vid and
+                    # strip write ownership from healthy workers
+                    if self.server is server._internal_server:
+                        # the owner could not serve this (unparsed
+                        # form, manifest cascade, mid-commit volume):
+                        # take the vid over and handle it here -
+                        # routing back would loop
+                        server._ensure_owned(fid.volume_id)
+                        return False
+                    self.headers.pop("x-shard-hop", None)
                 result = server._proxy_to_writer(
                     server._shard_owner(fid.volume_id),
                     self.command,

@@ -65,6 +65,10 @@ _HOP_HEADERS = {
     "transfer-encoding",
     "content-length",
     "host",
+    # internal ownership signaling: a client-supplied copy must never
+    # ride through the proxy (the lead would seize the vid); _proxy
+    # re-adds its own AFTER this filter when the owner declined
+    "x-shard-hop",
 }
 
 
@@ -409,7 +413,7 @@ class VolumeReadWorker:
                 if v is None:
                     return False  # not on disk yet / mid-commit: lead's
                 if method == "DELETE":
-                    return self._owned_delete(v, fid)
+                    return self._owned_delete(v, fid, q)
                 n, fname, err = write_path.build_upload_needle(
                     fid, q, body, self.headers, url_filename,
                     fix_jpg_orientation=True,
@@ -448,7 +452,7 @@ class VolumeReadWorker:
                 )
                 return True
 
-            def _owned_delete(self, v, fid) -> bool:
+            def _owned_delete(self, v, fid, q) -> bool:
                 n = Needle(cookie=fid.cookie, id=fid.key)
                 def still_owned():
                     with worker._release_lock:
@@ -471,13 +475,28 @@ class VolumeReadWorker:
                 except OSError:
                     worker._drop_volume(fid.volume_id)
                     return False
-                self._json({"size": existing.size})
+                # first-hop deletes fan out to replica peers exactly
+                # like the lead's do_DELETE — an acknowledged delete
+                # that skipped its replicas would resurrect there
+                # (reference ReplicatedDelete, store_replicate.go)
+                if q.get("type") != "replicate":
+                    err = self._replicate_owned(
+                        v, fid, q, b"", method="DELETE"
+                    )
+                    if err:
+                        self._json({"error": err}, 500)
+                        return True
+                # 202 Accepted, matching the lead's do_DELETE reply
+                self._json({"size": existing.size}, 202)
                 return True
 
-            def _replicate_owned(self, v, fid, q, body) -> str | None:
-                """Replica fan-out for a write this worker first-hop
-                owns (store_replicate.go:44): peers looked up at the
-                master, self excluded by the SHARED public host:port."""
+            def _replicate_owned(
+                self, v, fid, q, body, method: str = "POST"
+            ) -> str | None:
+                """Replica fan-out for a write/delete this worker
+                first-hop owns (store_replicate.go:44): peers looked up
+                at the master, self excluded by the SHARED public
+                host:port."""
                 from seaweedfs_tpu.server import write_path
 
                 rp = v.volume.super_block.replica_placement
@@ -496,7 +515,7 @@ class VolumeReadWorker:
                     l["url"] for l in res.locations if l["url"] != me
                 ]
                 return write_path.replicate_to_peers(
-                    fid, q, "POST", body, self.headers, locations
+                    fid, q, method, body, self.headers, locations
                 )
 
             def _serve_blob(self, fid) -> bool:

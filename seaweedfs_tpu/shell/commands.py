@@ -635,9 +635,18 @@ def find_missing_shards(nodes: list[ec_common.EcNode], vid: int) -> list[int]:
 
 def do_ec_rebuild(env: CommandEnv, vid: int, out, apply: bool = True) -> list[int]:
     """Rebuild missing shards on one rebuilder node
-    (command_ec_rebuild.go rebuildOneEcVolume): copy survivors to the
-    rebuilder, VolumeEcShardsRebuild regenerates the missing ones
-    locally, mount them, master learns via heartbeat."""
+    (command_ec_rebuild.go rebuildOneEcVolume), rack-gather style:
+    survivors STAY on their holders — VolumeEcShardsRebuild's pipelined
+    driver streams their tiles off the holders in parallel with the
+    reconstruction, so the rebuild is not serialized behind a full
+    cluster copy. Only the .ecx index (plus one seed survivor when the
+    rebuilder holds no shard of the volume — a local file fixes the
+    shard size for the tile walk) is copied up front. If the streaming
+    verb fails (holder unreachable, no master route) the classic
+    copy-every-survivor flow runs as the fallback. Rebuilt shards are
+    mounted on the rebuilder; the master learns via heartbeat."""
+    import grpc as _grpc
+
     nodes = ec_common.collect_ec_nodes(env)
     missing = find_missing_shards(nodes, vid)
     if not missing:
@@ -651,31 +660,67 @@ def do_ec_rebuild(env: CommandEnv, vid: int, out, apply: bool = True) -> list[in
     rebuilder = max(nodes, key=lambda n: n.free_ec_slot)
     if not apply:
         return missing
-    # pull surviving shards it doesn't hold yet
     original_local = set(rebuilder.local_shard_ids(vid))
     local = set(original_local)
-    for n in holders:
-        if n.url == rebuilder.url:
-            continue
-        need = [s for s in n.local_shard_ids(vid) if s not in local]
-        if not need:
-            continue
+    if not local:
+        donor = next(n for n in holders if n.url != rebuilder.url)
+        seed = donor.local_shard_ids(vid)[0]
         with env.volume_channel(rebuilder.url) as ch:
             rpc.volume_stub(ch).VolumeEcShardsCopy(
                 volume_pb2.VolumeEcShardsCopyRequest(
                     volume_id=vid,
                     collection=collection,
-                    shard_ids=need,
+                    shard_ids=[seed],
                     copy_ecx_file=True,
-                    source_data_node=n.url,
+                    source_data_node=donor.url,
                 )
             )
-        local.update(need)
+        local.add(seed)
+
+    def rebuild_now() -> list[int]:
+        with env.volume_channel(rebuilder.url) as ch:
+            resp = rpc.volume_stub(ch).VolumeEcShardsRebuild(
+                volume_pb2.VolumeEcShardsRebuildRequest(
+                    volume_id=vid, collection=collection
+                ),
+                timeout=600,
+            )
+        return list(resp.rebuilt_shard_ids)
+
+    _FALLBACK_CODES = (
+        _grpc.StatusCode.FAILED_PRECONDITION,  # verb lacked survivors
+        _grpc.StatusCode.UNAVAILABLE,  # holder/master unreachable
+        _grpc.StatusCode.UNKNOWN,  # server-side exception surfaced
+    )
+    try:
+        rebuilt = rebuild_now()
+    except _grpc.RpcError as e:
+        if e.code() not in _FALLBACK_CODES:
+            # DEADLINE_EXCEEDED etc: the server-side streaming rebuild
+            # may still be RUNNING — a blind retry would race its
+            # preallocated target files and misread them as present
+            raise
+        # fallback: pull every surviving shard the rebuilder lacks,
+        # then rebuild from purely local files
+        for n in holders:
+            if n.url == rebuilder.url:
+                continue
+            need = [s for s in n.local_shard_ids(vid) if s not in local]
+            if not need:
+                continue
+            with env.volume_channel(rebuilder.url) as ch:
+                rpc.volume_stub(ch).VolumeEcShardsCopy(
+                    volume_pb2.VolumeEcShardsCopyRequest(
+                        volume_id=vid,
+                        collection=collection,
+                        shard_ids=need,
+                        copy_ecx_file=True,
+                        source_data_node=n.url,
+                    )
+                )
+            local.update(need)
+        rebuilt = rebuild_now()
     with env.volume_channel(rebuilder.url) as ch:
-        resp = rpc.volume_stub(ch).VolumeEcShardsRebuild(
-            volume_pb2.VolumeEcShardsRebuildRequest(volume_id=vid, collection=collection)
-        )
-        rebuilt = list(resp.rebuilt_shard_ids)
         rpc.volume_stub(ch).VolumeEcShardsMount(
             volume_pb2.VolumeEcShardsMountRequest(
                 volume_id=vid, collection=collection, shard_ids=rebuilt

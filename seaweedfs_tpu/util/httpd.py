@@ -124,7 +124,9 @@ _REASON = {
     413: b"Payload Too Large",
     416: b"Range Not Satisfiable",
     429: b"Too Many Requests",
+    431: b"Request Header Fields Too Large",
     500: b"Internal Server Error",
+    502: b"Bad Gateway",
     503: b"Service Unavailable",
 }
 
@@ -368,11 +370,10 @@ class WeedHTTPServer(ThreadingHTTPServer):
         return sock, addr
 
     def finish_request(self, request, client_address):
-        # data-plane handlers (FastRequestMixin: volume, master,
-        # workers) ride the mini request loop; plain
-        # BaseHTTPRequestHandler handlers (filer, s3, webdav — they
-        # depend on stdlib header/Message semantics) keep the stdlib
-        # per-request machinery
+        # FastRequestMixin handlers (volume, master, workers, filer)
+        # ride the mini request loop; plain BaseHTTPRequestHandler
+        # handlers (s3, webdav — they depend on stdlib header/Message
+        # semantics) keep the stdlib per-request machinery
         if hasattr(self.RequestHandlerClass, "fast_reply"):
             serve_connection(
                 request, client_address, self, self.RequestHandlerClass

@@ -390,6 +390,211 @@ class TestStreamDrivers:
             )
         assert threading.active_count() <= before  # stage threads joined
 
+    def test_stream_write_pool_identical_odd_sizes(self, tmp_path):
+        """The pwritev writer POOL lands tiles in completion order —
+        positioned writes must keep the bytes identical to the classic
+        serial driver on awkward sizes (tail zero-padding, one-tile
+        rows, sub-tile remainders)."""
+        import numpy as np
+
+        from seaweedfs_tpu.ec import ec_files, ec_stream
+
+        LARGE, SMALL = 40_000, 4_000
+        rng = np.random.default_rng(23)
+        parity_fn, _, fetch = self._cpu_stages()
+        for size in (1, 3_999, 123_457, 1_000_001):
+            classic = tmp_path / f"c{size}"
+            stream = tmp_path / f"s{size}"
+            payload = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+            for d in (classic, stream):
+                d.mkdir()
+                (d / "1.dat").write_bytes(payload)
+            ec_files.write_ec_files(
+                str(classic / "1"),
+                rs=new_encoder(backend="cpu"),
+                buffer_size=2_000,
+                large_block_size=LARGE,
+                small_block_size=SMALL,
+            )
+            ec_stream.stream_write_ec_files(
+                str(stream / "1"),
+                tile_bytes=7_000,
+                large_block_size=LARGE,
+                small_block_size=SMALL,
+                parity_fn=parity_fn,
+                fetch_fn=fetch,
+                writer_threads=3,
+                reader_threads=2,
+            )
+            for i in range(14):
+                ext = ec_files.to_ext(i)
+                assert (stream / f"1{ext}").read_bytes() == (
+                    classic / f"1{ext}"
+                ).read_bytes(), (size, ext)
+
+    def test_stream_write_enospc_abort_no_leaks(self, tmp_path, monkeypatch):
+        """A short-write/ENOSPC surfacing in the writer POOL mid-stream
+        must raise on the caller, join every pool thread, and leak no
+        fd (the .dat readers and all 14 preallocated shard fds)."""
+        import errno
+        import os
+        import threading
+
+        import numpy as np
+        import pytest as _pytest
+
+        from seaweedfs_tpu.ec import ec_stream
+
+        rng = np.random.default_rng(29)
+        (tmp_path / "1.dat").write_bytes(
+            rng.integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+        )
+        calls = {"n": 0}
+        real_pwritev = ec_stream._pwritev_full
+
+        def flaky_pwritev(fd, bufs, offset):
+            calls["n"] += 1
+            if calls["n"] == 20:
+                raise OSError(errno.ENOSPC, "No space left on device")
+            return real_pwritev(fd, bufs, offset)
+
+        monkeypatch.setattr(ec_stream, "_pwritev_full", flaky_pwritev)
+        fds_before = len(os.listdir("/proc/self/fd"))
+        threads_before = threading.active_count()
+        with _pytest.raises(OSError, match="No space left"):
+            ec_stream.stream_write_ec_files(
+                str(tmp_path / "1"),
+                tile_bytes=4_000,
+                large_block_size=40_000,
+                small_block_size=4_000,
+                parity_fn=lambda t: np.zeros((4, t.shape[1]), dtype=np.uint8),
+                fetch_fn=lambda h: h,
+                writer_threads=3,
+                reader_threads=2,
+            )
+        assert threading.active_count() <= threads_before
+        assert len(os.listdir("/proc/self/fd")) == fds_before
+        # no half-written shard files survive the abort: shard_presence
+        # would otherwise count the garbage as a complete valid set
+        from seaweedfs_tpu.ec import ec_files
+
+        for i in range(14):
+            assert not os.path.exists(
+                str(tmp_path / "1") + ec_files.to_ext(i)
+            ), i
+
+    def test_stream_rebuild_enospc_abort_no_leaks(self, tmp_path, monkeypatch):
+        import errno
+        import os
+        import threading
+
+        import numpy as np
+        import pytest as _pytest
+
+        from seaweedfs_tpu.ec import ec_files, ec_stream
+
+        rng = np.random.default_rng(31)
+        (tmp_path / "1.dat").write_bytes(
+            rng.integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+        )
+        base = str(tmp_path / "1")
+        ec_files.write_ec_files(
+            base,
+            rs=new_encoder(backend="cpu"),
+            buffer_size=2_000,
+            large_block_size=40_000,
+            small_block_size=4_000,
+        )
+        os.remove(base + ec_files.to_ext(2))
+        _, rebuild_fn, fetch = self._cpu_stages()
+
+        def broken_pwrite(fd, buf, offset):
+            raise OSError(errno.ENOSPC, "No space left on device")
+
+        monkeypatch.setattr(ec_stream, "_pwrite_full", broken_pwrite)
+        fds_before = len(os.listdir("/proc/self/fd"))
+        threads_before = threading.active_count()
+        with _pytest.raises(OSError, match="No space left"):
+            ec_stream.stream_rebuild_ec_files(
+                base,
+                tile_bytes=3_000,
+                rebuild_fn=rebuild_fn,
+                fetch_fn=fetch,
+                writer_threads=2,
+                reader_threads=2,
+            )
+        assert threading.active_count() <= threads_before
+        assert len(os.listdir("/proc/self/fd")) == fds_before
+        # the half-written target was removed (a retry must see it as
+        # still missing), the survivors untouched
+        assert not os.path.exists(base + ec_files.to_ext(2))
+        assert os.path.exists(base + ec_files.to_ext(3))
+
+    def test_stream_rebuild_remote_readers_identical(self, tmp_path):
+        """The rack-gather path: survivors held only by OTHER nodes
+        arrive through injected remote readers; shards readable
+        remotely are treated as present (not rebuilt) and the rebuilt
+        bytes match the originals exactly."""
+        import os
+
+        import numpy as np
+
+        from seaweedfs_tpu.ec import ec_files, ec_stream
+
+        rng = np.random.default_rng(37)
+        (tmp_path / "1.dat").write_bytes(
+            rng.integers(0, 256, 500_000, dtype=np.uint8).tobytes()
+        )
+        base = str(tmp_path / "1")
+        ec_files.write_ec_files(
+            base, buffer_size=2_000, large_block_size=40_000, small_block_size=4_000
+        )
+        originals = {
+            i: open(base + ec_files.to_ext(i), "rb").read() for i in range(14)
+        }
+        # shards 4..9 live only on the "remote holder" (moved away);
+        # shards 2 and 12 are lost cluster-wide
+        remote_dir = tmp_path / "remote"
+        remote_dir.mkdir()
+        remote_held = (4, 5, 6, 7, 8, 9)
+        for sid in remote_held:
+            os.rename(
+                base + ec_files.to_ext(sid),
+                str(remote_dir / f"1{ec_files.to_ext(sid)}"),
+            )
+        for sid in (2, 12):
+            os.remove(base + ec_files.to_ext(sid))
+
+        def make_reader(sid):
+            path = str(remote_dir / f"1{ec_files.to_ext(sid)}")
+
+            def read(offset, size):
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    return f.read(size)
+
+            return read
+
+        _, rebuild_fn, fetch = self._cpu_stages()
+        rebuilt = ec_stream.stream_rebuild_ec_files(
+            base,
+            tile_bytes=12_000,
+            rebuild_fn=rebuild_fn,
+            fetch_fn=fetch,
+            remote_readers={sid: make_reader(sid) for sid in remote_held},
+            writer_threads=2,
+            reader_threads=2,
+        )
+        assert rebuilt == [2, 12]
+        for sid in (2, 12):
+            assert (
+                open(base + ec_files.to_ext(sid), "rb").read()
+                == originals[sid]
+            ), sid
+        # remote-held shards were NOT recreated locally
+        for sid in remote_held:
+            assert not os.path.exists(base + ec_files.to_ext(sid)), sid
+
     def test_stream_rebuild_read_error_propagates(self, tmp_path):
         """A truncated survivor detected by the reader THREAD must
         surface as the caller's exception."""

@@ -167,7 +167,9 @@ class TestShardWriteRouting:
             f"http://127.0.0.1:{vport}/{a['fid']}", method="DELETE"
         )
         with urllib.request.urlopen(req, timeout=10) as r:
-            assert r.status == 200
+            # 202 Accepted like the lead's do_DELETE: the cluster must
+            # answer the same whichever process takes the first hop
+            assert r.status == 202
         with pytest.raises(urllib.error.HTTPError) as ei:
             _get(f"http://127.0.0.1:{vport}/{a['fid']}")
         assert ei.value.code == 404
@@ -175,6 +177,110 @@ class TestShardWriteRouting:
         with pytest.raises(urllib.error.HTTPError) as ei:
             _get(f"http://127.0.0.1:{wport}/{a['fid']}")
         assert ei.value.code == 404
+
+    def test_client_supplied_hop_header_does_not_seize(self, shard_stack):
+        """x-shard-hop is trusted only from the loopback internal
+        listener: an anonymous client setting it on the PUBLIC port
+        must not strip write ownership from a healthy worker."""
+        master, lead, worker, mport, vport, wport = shard_stack
+        a = assign_vid_parity(mport, 1)  # worker-owned vid
+        vid = int(a["fid"].split(",")[0])
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{vport}/{a['fid']}",
+            data=b"hop forgery",
+            method="POST",
+            headers={"x-shard-hop": "1"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+        assert vid not in lead._shard_taken
+        with worker._release_lock:
+            assert vid not in worker.released
+        # the write still landed through the owner and reads back
+        status, body = _get(f"http://127.0.0.1:{vport}/{a['fid']}")
+        assert status == 200 and body == b"hop forgery"
+
+    def test_owned_delete_fans_out_to_replicas(self, shard_stack, monkeypatch):
+        """A first-hop DELETE on a worker-owned vid must run the same
+        replica fan-out as the lead's do_DELETE (store_replicate.go's
+        ReplicatedDelete) — an acknowledged delete that skipped its
+        replicas would resurrect there."""
+        master, lead, worker, mport, vport, wport = shard_stack
+        a = assign_vid_parity(mport, 1)  # worker-owned vid
+        vid = int(a["fid"].split(",")[0])
+        _post(f"http://127.0.0.1:{vport}/{a['fid']}", b"replicated doomed")
+
+        from seaweedfs_tpu.client import operation as op
+        from seaweedfs_tpu.server import write_path
+        from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
+
+        v = worker._find_volume(vid)
+        assert v is not None
+        monkeypatch.setattr(
+            v.volume.super_block,
+            "replica_placement",
+            ReplicaPlacement.parse("001"),
+        )
+        me = f"{worker.host}:{worker.port}"
+
+        class FakeLookup:
+            error = ""
+            locations = [{"url": me}, {"url": "127.0.0.1:59999"}]
+
+        calls = []
+
+        def fake_replicate(fid, q, method, body, headers, locations):
+            calls.append((method, tuple(locations)))
+            return None
+
+        monkeypatch.setattr(op, "lookup", lambda m, vs, collection="": FakeLookup())
+        monkeypatch.setattr(write_path, "replicate_to_peers", fake_replicate)
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{wport}/{a['fid']}", method="DELETE"
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 202
+        assert calls == [("DELETE", ("127.0.0.1:59999",))]
+
+    def test_owned_delete_replica_error_fails_request(
+        self, shard_stack, monkeypatch
+    ):
+        """All-or-error like the reference: a replica that refuses the
+        delete fails the client's request (500), it is not silently
+        acknowledged."""
+        master, lead, worker, mport, vport, wport = shard_stack
+        a = assign_vid_parity(mport, 1)
+        vid = int(a["fid"].split(",")[0])
+        _post(f"http://127.0.0.1:{vport}/{a['fid']}", b"replica refuses")
+
+        from seaweedfs_tpu.client import operation as op
+        from seaweedfs_tpu.server import write_path
+        from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
+
+        v = worker._find_volume(vid)
+        monkeypatch.setattr(
+            v.volume.super_block,
+            "replica_placement",
+            ReplicaPlacement.parse("001"),
+        )
+
+        class FakeLookup:
+            error = ""
+            locations = [{"url": "127.0.0.1:59999"}]
+
+        monkeypatch.setattr(op, "lookup", lambda m, vs, collection="": FakeLookup())
+        monkeypatch.setattr(
+            write_path,
+            "replicate_to_peers",
+            lambda *args: "replica 127.0.0.1:59999 failed",
+        )
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{wport}/{a['fid']}", method="DELETE"
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 500
 
 
 class TestShardHandback:
