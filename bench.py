@@ -758,6 +758,206 @@ def bench_migration_with_retry() -> None:
         bench_migration()
 
 
+def bench_scrub() -> None:
+    """PR-2 config: the scrub plane's two operational numbers.
+
+    Line 1 — `scrub_verify_gb_s`: how fast the background scrubber's
+    EC parity re-verify core (scrub/verify.verify_parity_stream — the
+    same code path the ScrubEngine and the rate-limited ec.verify run)
+    moves shard bytes off THIS host's disk, unthrottled. Judged
+    against the measured disk sequential-read ceiling (same honesty
+    fields as the *_stream_e2e lines): utilization says how much of
+    the hardware bar a full-speed sweep can use — and therefore what a
+    production rate cap (-scrubRate) leaves for foreground reads.
+
+    Line 2 — `scrub_interference_read_p99`: foreground read p99 with a
+    CONTINUOUS rate-capped sweep running vs scrub off, one in-process
+    master + volume server, same keyset. vs_baseline = p99_off/p99_on
+    (1.0 = zero interference; >= 0.8 keeps the acceptance bound of
+    p99-within-25%). The sweep runs at the production default 64 MB/s
+    token bucket — the number the knob actually ships with.
+    """
+    import tempfile
+    import urllib.request as _rq
+
+    import numpy as np
+
+    from seaweedfs_tpu.command.servers import _tune_gc
+    from seaweedfs_tpu.ec.codec import new_encoder
+    from seaweedfs_tpu.scrub.verify import verify_parity_stream
+
+    _tune_gc()
+    # --- line 1: verify core GB/s over real shard files ---
+    shard_mb = 24
+    with tempfile.TemporaryDirectory() as d:
+        rs = new_encoder(backend="native")
+        nbytes = shard_mb * 1024 * 1024
+        rng = np.random.default_rng(11)
+        tile = 4 * 1024 * 1024
+        paths = [os.path.join(d, f"bench.ec{i:02d}") for i in range(14)]
+        files = [open(p, "wb") for p in paths]
+        try:
+            for off in range(0, nbytes, tile):
+                shards = [
+                    rng.integers(0, 256, tile, dtype=np.uint8)
+                    for _ in range(10)
+                ] + [None] * 4
+                rs.encode(shards)
+                for f, s in zip(files, shards):
+                    f.write(s.tobytes())
+        finally:
+            for f in files:
+                f.close()
+        fds = [os.open(p, os.O_RDONLY) for p in paths]
+        try:
+            for fd in fds:
+                try:
+                    os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+                except OSError:
+                    pass
+            readers = [
+                (lambda off, size, _fd=fd: os.pread(_fd, size, off))
+                for fd in fds
+            ]
+            t0 = time.perf_counter()
+            res = verify_parity_stream(readers, rs=rs, tile_bytes=tile)
+            elapsed = time.perf_counter() - t0
+        finally:
+            for fd in fds:
+                os.close(fd)
+        assert res.complete and not res.corrupt, res.mismatch
+        total = res.bytes_per_shard * 14
+        gbps = total / elapsed / 1e9
+        ceiling = _disk_ceiling(d)
+    _report(
+        "scrub_verify_gb_s",
+        gbps,
+        "GB/s",
+        gbps / ceiling["disk_seq_read_gb_s"],
+        shard_bytes=res.bytes_per_shard,
+        utilization=round(
+            min(1.0, gbps / ceiling["disk_seq_read_gb_s"]), 3
+        ),
+        **ceiling,
+    )
+
+    # --- line 2: foreground read p99, scrub off vs on ---
+    import json as _json
+    import threading as _threading
+
+    from seaweedfs_tpu.util.availability import HammerReader, start_cluster
+
+    hammer_seconds = 8.0
+    with tempfile.TemporaryDirectory() as d:
+        vol_dir = tempfile.mkdtemp(dir=d)
+        # a ~256 MB sealed volume pre-seeded on disk: ONE rate-bound
+        # sweep of it outlasts the whole hammer window, so the "on"
+        # phase measures genuine continuous scrubbing (not a loop of
+        # instant sweeps over a toy keyset)
+        from seaweedfs_tpu.storage.needle import Needle as _Needle
+        from seaweedfs_tpu.storage.volume import Volume as _Volume
+
+        big = _Volume(vol_dir, 137)
+        blob = bytes(
+            np.random.default_rng(7).integers(0, 256, 1 << 20, dtype=np.uint8)
+        )
+        for k in range(1, 257):
+            big.write_needle(_Needle(cookie=1, id=k, data=blob))
+        big.close()
+        master, servers = start_cluster(
+            [vol_dir],
+            ec_codec="native",
+            scrub_interval=3600.0,  # engine exists; sweeps only when driven
+            scrub_rate_mb_s=64.0,  # the production default cap
+        )
+        vs = servers[0]
+        try:
+            keys = {}
+            for i in range(24):
+                with _rq.urlopen(
+                    f"http://127.0.0.1:{master.port}/dir/assign", timeout=10
+                ) as r:
+                    assign = _json.loads(r.read())
+                payload = (f"scrub bench {i} ".encode() * 4096)[: 48_000 + i]
+                _rq.urlopen(
+                    _rq.Request(
+                        f"http://{assign['url']}/{assign['fid']}",
+                        data=payload,
+                        method="POST",
+                    ),
+                    timeout=10,
+                ).close()
+                keys[assign["fid"]] = payload
+
+            def p99_for(duration: float, pool: list | None = None) -> tuple[float, int]:
+                reader = HammerReader(
+                    f"http://{vs.host}:{vs.port}", keys, "scrub-bench"
+                )
+                reader.start()
+                time.sleep(duration)
+                reader.stop_event.set()
+                reader.join(timeout=30)
+                assert not reader.failures, reader.failures[:3]
+                # drop the first keyset pass: connection setup and cold
+                # page cache would smear both phases' tails
+                kept = reader.latencies[len(keys):]
+                if pool is not None:
+                    pool.extend(kept)
+                lat = sorted(kept)
+                return (
+                    lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1000,
+                    reader.reads,
+                )
+
+            # continuous sweeping: restart the (rate-capped) sweep in a
+            # loop while the "on" phases run
+            sweeping = _threading.Event()
+
+            def sweep_loop():
+                while sweeping.is_set():
+                    vs.scrub.sweep_once()
+
+            # adjacent OFF/ON pairs, median-of-ratios: this rig's
+            # external throttle swings ±50% on the minute scale, so a
+            # single back-to-back comparison routinely lies in either
+            # direction on the SAME code. Each pair is seconds apart
+            # (drift ~constant within it) and the median across pairs
+            # discards an unlucky window.
+            pairs = []
+            reads_off = reads_on = 0
+            phase = hammer_seconds / 2
+            for _ in range(5):
+                po, r = p99_for(phase)
+                reads_off += r
+                sweeping.set()
+                t = _threading.Thread(target=sweep_loop, daemon=True)
+                t.start()
+                try:
+                    pn, r = p99_for(phase)
+                    reads_on += r
+                finally:
+                    sweeping.clear()
+                    t.join(timeout=30)
+                pairs.append((po, pn))
+            pairs.sort(key=lambda pr: pr[0] / pr[1])
+            p99_off, p99_on = pairs[len(pairs) // 2]
+        finally:
+            for s in servers:
+                s.stop()
+            master.stop()
+    _report(
+        "scrub_interference_read_p99",
+        p99_on,
+        "ms",
+        (p99_off / p99_on) if p99_on > 0 else 1.0,
+        p99_off_ms=round(p99_off, 3),
+        p99_on_ms=round(p99_on, 3),
+        reads_off=reads_off,
+        reads_on=reads_on,
+        scrub_rate_mb_s=64.0,
+    )
+
+
 CONFIGS = {
     "encode": bench_encode,
     "rebuild": bench_rebuild,
@@ -769,6 +969,7 @@ CONFIGS = {
     "stream-rebuild": bench_stream_rebuild,
     "http": bench_http_reqs,
     "migration": bench_migration_with_retry,
+    "scrub": bench_scrub,
 }
 
 

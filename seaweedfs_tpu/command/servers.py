@@ -100,6 +100,28 @@ class MasterCommand(Command):
             help="seconds of heartbeat silence before a volume server is "
             "declared dead even if its stream stays open (0 disables)",
         )
+        p.add_argument(
+            "-repairInterval",
+            type=float,
+            default=30.0,
+            help="seconds between automatic-repair scans (scrub plane: "
+            "rebuild missing EC shards, fix under-replication, replace "
+            "scrub-flagged corrupt replicas; 0 disables — repair goes "
+            "back to manual ec.rebuild / volume.fix.replication)",
+        )
+        p.add_argument(
+            "-repairConcurrency",
+            type=int,
+            default=2,
+            help="global cap on simultaneously running repairs",
+        )
+        p.add_argument(
+            "-repairGrace",
+            type=float,
+            default=30.0,
+            help="seconds damage must persist before repair starts "
+            "(rides out shard moves and node restarts)",
+        )
         p.add_argument("-cpuprofile", default="", help="dump pstats profile here on exit")
         p.add_argument(
             "-sequencer.etcd",
@@ -134,6 +156,9 @@ class MasterCommand(Command):
             raft_dir=args.mdir or None,
             node_timeout=args.nodeTimeout,
             sequencer=sequencer,
+            repair_interval=args.repairInterval,
+            repair_concurrency=args.repairConcurrency,
+            repair_grace=args.repairGrace,
         )
         from seaweedfs_tpu.util.profiling import CpuProfile
 
@@ -200,6 +225,20 @@ class VolumeCommand(Command):
             "single-writer-per-volume invariant; admin ops (vacuum, EC "
             "encode, readonly) hand ownership back to the lead first",
         )
+        p.add_argument(
+            "-scrubInterval",
+            type=float,
+            default=600.0,
+            help="seconds between background integrity sweeps (needle "
+            "CRC re-checks + EC parity re-verify; 0 disables)",
+        )
+        p.add_argument(
+            "-scrubRate",
+            type=float,
+            default=64.0,
+            help="scrub bandwidth cap in MB/s (token bucket protecting "
+            "foreground read p99; <=0 = unlimited)",
+        )
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
@@ -244,6 +283,8 @@ class VolumeCommand(Command):
             internal_port=internal_port,
             shard_writes=shard_writes,
             n_writers=workers if shard_writes else 1,
+            scrub_interval=args.scrubInterval,
+            scrub_rate_mb_s=args.scrubRate,
         )
         from seaweedfs_tpu.util.profiling import CpuProfile
 
@@ -468,6 +509,13 @@ class ServerCommand(Command):
             choices=("", "cpu", "native", "tpu"),
             help="EC codec backend; empty = auto (tpu with a JAX device, else native SIMD, else numpy)",
         )
+        # scrub/self-healing knobs, same semantics as the standalone
+        # master/volume commands (0 disables either plane)
+        p.add_argument("-repairInterval", type=float, default=30.0)
+        p.add_argument("-repairConcurrency", type=int, default=2)
+        p.add_argument("-repairGrace", type=float, default=30.0)
+        p.add_argument("-scrubInterval", type=float, default=600.0)
+        p.add_argument("-scrubRate", type=float, default=64.0)
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
@@ -484,6 +532,11 @@ class ServerCommand(Command):
             volume_size_limit_mb=args.vsl,
             default_replication=args.repl,
             guard=guard,
+            # the all-in-one server gets the full self-healing plane by
+            # default, like the standalone `weed master`
+            repair_interval=args.repairInterval,
+            repair_concurrency=args.repairConcurrency,
+            repair_grace=args.repairGrace,
         )
         master.start()
         started.append(master)
@@ -501,6 +554,8 @@ class ServerCommand(Command):
             max_volume_counts=maxes,
             guard=guard,
             ec_codec=args.ec_codec,
+            scrub_interval=args.scrubInterval,
+            scrub_rate_mb_s=args.scrubRate,
         )
         volume.start()
         started.append(volume)

@@ -84,10 +84,14 @@ class EcVolumeShard:
             # encode materializes zero padding on disk, so every shard
             # file spans the full nominal length — a short read means
             # the file was truncated/corrupted, never legitimate tail
+            try:
+                on_disk = os.path.getsize(self.path)
+            except OSError:
+                on_disk = -1  # renamed away by a racing quarantine
             raise ShardTruncated(
                 f"shard {self.shard_id} of vid {self.volume_id}: "
                 f"read [{offset}, {offset + size}) past file end "
-                f"({os.path.getsize(self.path)} bytes)"
+                f"({on_disk} bytes)"
             )
         return data
 
@@ -128,6 +132,12 @@ class EcVolume:
         # serializes quarantine decisions so only one thread verifies
         # and unmounts a suspect shard
         self._quarantine_lock = threading.Lock()
+        # shard id → reason for every shard quarantined on this node
+        # (scrub-plane surface: rides heartbeats + /status JSON)
+        self.quarantined: dict[int, str] = {}
+        # wired by the Store to its quarantine registry so the event
+        # reaches the heartbeat loop (forced delta beat) immediately
+        self.on_quarantine: Callable[[int, int, str], None] | None = None
 
     # --- mounting (disk_location_ec.go) ---
     @classmethod
@@ -152,6 +162,9 @@ class EcVolume:
             self.shards[shard_id] = EcVolumeShard(
                 self.directory, self.volume_id, shard_id, self.collection
             )
+            # a freshly (re)mounted shard file is a repaired one: the
+            # rebuild path wrote a new full-length file at this path
+            self.quarantined.pop(shard_id, None)
 
     def unmount_shard(self, shard_id: int) -> None:
         # deliberately does NOT close the shard's fd: handler threads
@@ -226,12 +239,40 @@ class EcVolume:
             out += self._read_interval(shard_id, shard_off, iv.size, fetch)
         return bytes(out)
 
+    def quarantine_shard(self, shard_id: int, reason: str) -> bool:
+        """Quarantine a shard this node holds: unmount it (every later
+        read treats it as lost — remote fetch first, reconstruction
+        fallback) AND rename its file to `<shard>.bad` so the rebuild
+        path sees it as MISSING and regenerates it — an unmount alone
+        would leave a full-length corrupt file that shard_presence()
+        counts as present, silently skipping the regeneration (and a
+        restart would remount it). The rename is safe under concurrent
+        preads: open fds follow the inode, so in-flight reads of other
+        (healthy) interleavings finish normally. Returns True when the
+        shard was quarantined by THIS call."""
+        with self._quarantine_lock:
+            shard = self.shards.get(shard_id)
+            if shard is None:
+                return False  # not mounted (or already quarantined)
+            self.unmount_shard(shard_id)
+            try:
+                os.replace(shard.path, shard.path + ".bad")
+            except OSError:
+                pass  # vanished/unwritable dir: unmount still protects
+            self.quarantined[shard_id] = reason
+        cb = self.on_quarantine
+        if cb is not None:
+            # outside the lock: the callback pokes the heartbeat loop
+            cb(self.volume_id, shard_id, reason)
+        return True
+
     def _quarantine_if_truncated(self, shard_id: int) -> bool:
-        """Unmount a suspect shard only after re-verifying the on-disk
-        file really is shorter than its nominal length (a short pread
-        can also mean the fd was closed under us, or a racing replace).
-        Serialized so concurrent failing readers don't double-close.
-        Returns True when the shard is quarantined (or already gone)."""
+        """Quarantine a suspect shard only after re-verifying the
+        on-disk file really is shorter than its nominal length (a short
+        pread can also mean the fd was closed under us, or a racing
+        replace). Serialized so concurrent failing readers don't
+        double-close. Returns True when the shard is quarantined (or
+        already gone)."""
         with self._quarantine_lock:
             shard = self.shards.get(shard_id)
             if shard is None:
@@ -246,20 +287,28 @@ class EcVolume:
             # already-truncated would otherwise equal its own "nominal"
             # and never be evicted
             nominal = max(s.size for s in self.shards.values())
-            if actual < nominal:
-                # self-heal beyond the reference: quarantine the corrupt
-                # shard (unmount) so this and every later read treats it
-                # exactly like a lost shard — direct remote fetch first,
-                # reconstruction fallback — and its short length can
-                # never poison dat_file_size()'s geometry
-                wlog.warning(
-                    "ec read: shard %d of vid %d is %d bytes, nominal %d; "
-                    "quarantining",
-                    shard_id, self.volume_id, actual, nominal,
-                )
-                self.unmount_shard(shard_id)
-                return True
-            return False
+            if actual >= nominal:
+                return False
+            # self-heal beyond the reference: quarantine the corrupt
+            # shard so this and every later read treats it exactly like
+            # a lost shard, and its short length can never poison
+            # dat_file_size()'s geometry
+            wlog.warning(
+                "ec read: shard %d of vid %d is %d bytes, nominal %d; "
+                "quarantining",
+                shard_id, self.volume_id, actual, nominal,
+            )
+            self.unmount_shard(shard_id)
+            try:
+                os.replace(shard.path, shard.path + ".bad")
+            except OSError:
+                pass
+            reason = f"truncated: {actual} bytes, nominal {nominal}"
+            self.quarantined[shard_id] = reason
+        cb = self.on_quarantine
+        if cb is not None:
+            cb(self.volume_id, shard_id, reason)
+        return True
 
     def _read_interval(
         self, shard_id: int, offset: int, size: int, fetch: ShardFetcher | None
@@ -369,8 +418,9 @@ class EcVolume:
         self.close()
         for shard_id in range(ec_files.TOTAL_SHARDS):
             p = self.base_name + ec_files.to_ext(shard_id)
-            if os.path.exists(p):
-                os.remove(p)
+            for path in (p, p + ".bad"):  # .bad = quarantined forensic copy
+                if os.path.exists(path):
+                    os.remove(path)
         for ext in (".ecx", ".ecj"):
             p = self.base_name + ext
             if os.path.exists(p):

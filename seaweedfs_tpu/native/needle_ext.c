@@ -59,13 +59,31 @@ static PyObject *py_encode(PyObject *self, PyObject *const *args,
     if (out == NULL) goto err_all;
 
     uint32_t size, crc;
-    long total = weed_needle_encode(
-        (uint8_t *)PyBytes_AS_STRING(out), cookie, id,
-        (const uint8_t *)data.buf, (uint32_t)data.len, flags,
-        (const uint8_t *)name.buf, (uint32_t)name.len,
-        (const uint8_t *)mime.buf, (uint32_t)mime.len, last_modified,
-        (const uint8_t *)ttl.buf, (const uint8_t *)pairs.buf,
-        (uint32_t)pairs.len, (int)version, append_at_ns, &size, &crc);
+    long total;
+    if (data.len >= 65536) {
+        /* big payloads: the memcpy + CRC32-C dominates — run it
+         * without the GIL so concurrent handler threads (and the
+         * background scrubber) aren't serialized behind it. All
+         * buffers are pinned by the Py_buffer views and `out` is not
+         * yet visible to any other thread. */
+        Py_BEGIN_ALLOW_THREADS
+        total = weed_needle_encode(
+            (uint8_t *)PyBytes_AS_STRING(out), cookie, id,
+            (const uint8_t *)data.buf, (uint32_t)data.len, flags,
+            (const uint8_t *)name.buf, (uint32_t)name.len,
+            (const uint8_t *)mime.buf, (uint32_t)mime.len, last_modified,
+            (const uint8_t *)ttl.buf, (const uint8_t *)pairs.buf,
+            (uint32_t)pairs.len, (int)version, append_at_ns, &size, &crc);
+        Py_END_ALLOW_THREADS
+    } else {
+        total = weed_needle_encode(
+            (uint8_t *)PyBytes_AS_STRING(out), cookie, id,
+            (const uint8_t *)data.buf, (uint32_t)data.len, flags,
+            (const uint8_t *)name.buf, (uint32_t)name.len,
+            (const uint8_t *)mime.buf, (uint32_t)mime.len, last_modified,
+            (const uint8_t *)ttl.buf, (const uint8_t *)pairs.buf,
+            (uint32_t)pairs.len, (int)version, append_at_ns, &size, &crc);
+    }
     if (ttl.buf) PyBuffer_Release(&ttl);
     PyBuffer_Release(&pairs);
     PyBuffer_Release(&mime);
@@ -227,7 +245,18 @@ static PyObject *py_decode(PyObject *self, PyObject *const *args,
         uint32_t stored = (uint32_t)b[HEADER + size] << 24 |
                           b[HEADER + size + 1] << 16 |
                           b[HEADER + size + 2] << 8 | b[HEADER + size + 3];
-        crc = weed_crc32c(0, (const char *)data_p, data_len);
+        if (data_len >= 65536) {
+            /* GIL released for the big-payload CRC: the verify of a
+             * multi-MiB needle is milliseconds of pure C that would
+             * otherwise stall every other handler thread (and inflate
+             * foreground p99 whenever the scrubber is re-reading). The
+             * source buffer is pinned by the caller's Py_buffer. */
+            Py_BEGIN_ALLOW_THREADS
+            crc = weed_crc32c(0, (const char *)data_p, data_len);
+            Py_END_ALLOW_THREADS
+        } else {
+            crc = weed_crc32c(0, (const char *)data_p, data_len);
+        }
         if (stored != masked(crc)) {
             err = "CRC error! Data On Disk Corrupted";
             goto out;

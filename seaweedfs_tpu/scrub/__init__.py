@@ -1,0 +1,28 @@
+"""Scrub & self-healing plane.
+
+Beyond-reference subsystem (the 2019 reference has no background
+integrity machinery at all; see docs/SCRUB.md): background integrity
+sweeps on every volume server, scrub/quarantine health flowing to the
+master over the heartbeat stream, and a master-side repair scheduler
+that turns detected damage into VolumeEcShardsRebuild / re-replication
+work with a global concurrency cap and per-volume backoff.
+
+  ratelimit  — token bucket bounding scrub disk+network bandwidth
+  state      — per-disk-location persisted cursors + health records
+  verify     — parity re-verify / reconstruct-compare / needle CRC walk
+  engine     — the volume-server background sweeper (ScrubEngine)
+  repair     — the master-side repair scheduler (RepairScheduler)
+"""
+
+from seaweedfs_tpu.scrub.engine import ScrubEngine
+from seaweedfs_tpu.scrub.ratelimit import TokenBucket
+from seaweedfs_tpu.scrub.repair import RepairScheduler
+from seaweedfs_tpu.scrub.state import ScrubState, VolumeScrubHealth
+
+__all__ = [
+    "ScrubEngine",
+    "RepairScheduler",
+    "ScrubState",
+    "TokenBucket",
+    "VolumeScrubHealth",
+]

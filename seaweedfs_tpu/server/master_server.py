@@ -46,7 +46,7 @@ from seaweedfs_tpu.pb import rpc, volume_pb2
 from seaweedfs_tpu.sequence import MemorySequencer
 from seaweedfs_tpu.storage.file_id import format_needle_id_cookie, parse_url_path
 from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
-from seaweedfs_tpu.storage.store import EcShardInfo, VolumeInfo
+from seaweedfs_tpu.storage.store import EcShardInfo, ScrubStatInfo, VolumeInfo
 from seaweedfs_tpu.storage.ttl import TTL
 from seaweedfs_tpu.topology import Topology
 from seaweedfs_tpu.util import wlog
@@ -101,6 +101,9 @@ class MasterServer:
         metrics_address: str = "",
         metrics_interval_sec: int = 15,
         sequencer=None,
+        repair_interval: float = 0.0,
+        repair_concurrency: int = 2,
+        repair_grace: float = 30.0,
     ):
         self.host = host
         self.port = port
@@ -169,6 +172,22 @@ class MasterServer:
         # (master_grpc_server.go:80-84)
         self.metrics_address = metrics_address
         self.metrics_interval_sec = metrics_interval_sec
+        # scrub plane: the automatic repair scheduler (scrub/repair.py).
+        # repair_interval <= 0 leaves repair manual (ec.rebuild /
+        # volume.fix.replication in the shell); the `weed` CLI enables
+        # it by default — tests and embedders opt in explicitly because
+        # automatic rebuilds mid-admin-operation are a real behavior
+        # change.
+        self.repair = None
+        if repair_interval > 0:
+            from seaweedfs_tpu.scrub import RepairScheduler
+
+            self.repair = RepairScheduler(
+                self,
+                interval=repair_interval,
+                concurrency=repair_concurrency,
+                grace=repair_grace,
+            )
         self._clients: dict[int, queue.Queue] = {}
         self._clients_seq = 0
         self._clients_lock = threading.Lock()
@@ -319,6 +338,46 @@ class MasterServer:
                                 for s in req.ec_shards
                             ],
                         )
+                    # scrub plane: every beat carries the node's full
+                    # scrub-health snapshot (quarantines arrive on a
+                    # FORCED delta beat, so damage lands here within
+                    # one heartbeat RTT of detection)
+                    def _damage_sig(stats):
+                        # only the damage-relevant fields: scanned_bytes
+                        # advances every beat during a sweep, so a
+                        # whole-row comparison would re-trigger the
+                        # scheduler once per heartbeat
+                        return {
+                            (k, s.corruptions_found, s.quarantined_shard_bits)
+                            for k, s in stats.items()
+                            if s.corruptions_found or s.quarantined_shard_bits
+                        }
+
+                    prev_sig = _damage_sig(dn.scrub_stats)
+                    self.topology.sync_scrub_stats(
+                        dn,
+                        [
+                            ScrubStatInfo(
+                                volume_id=s.volume_id,
+                                is_ec=s.is_ec,
+                                last_sweep_unix=s.last_sweep_unix,
+                                scanned_bytes=s.scanned_bytes,
+                                corruptions_found=s.corruptions_found,
+                                quarantined_shard_bits=s.quarantined_shard_bits,
+                                last_error=s.last_error,
+                            )
+                            for s in req.scrub_stats
+                        ],
+                    )
+                    new_sig = _damage_sig(dn.scrub_stats)
+                    if (
+                        self.repair is not None
+                        and new_sig
+                        and new_sig != prev_sig
+                    ):
+                        # a NEW damage report (not the same rows riding
+                        # every beat): scan now, don't wait the tick
+                        self.repair.trigger()
                     if need_full and (req.volumes or req.has_no_volumes):
                         need_full = False  # full inventory received
                 yield pb.HeartbeatResponse(
@@ -683,6 +742,17 @@ class MasterServer:
                     return self._json({"Topology": server._topology_dump()})
                 if path == "/stats/health":
                     return self._json({"ok": True})
+                if path == "/repair/queue":
+                    # scrub plane operator surface (repair.queue shell
+                    # command): scheduler config, tracked damage with
+                    # backoff state, and recent repair history
+                    if server.repair is None:
+                        return self._json(
+                            {"Disabled": True, "Scrub": server.topology.scrub_summary()}
+                        )
+                    snap = server.repair.queue_snapshot()
+                    snap["Scrub"] = server.topology.scrub_summary()
+                    return self._json(snap)
                 if path == "/stats/counter":
                     return self._json(server.request_counter.snapshot())
                 if path == "/stats/memory":
@@ -1096,9 +1166,13 @@ class MasterServer:
             threading.Thread(target=self._vacuum_loop, daemon=True).start()
         if self.node_timeout > 0:
             threading.Thread(target=self._liveness_loop, daemon=True).start()
+        if self.repair is not None:
+            self.repair.start()
 
     def stop(self) -> None:
         self._stop_event.set()
+        if self.repair is not None:
+            self.repair.stop()
         if self._raft is not None:
             self._raft.stop()
         if self._http_server:

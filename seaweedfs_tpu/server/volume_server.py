@@ -117,6 +117,8 @@ class VolumeServer:
         internal_port: int = 0,
         shard_writes: bool = False,
         n_writers: int = 1,
+        scrub_interval: float = 600.0,
+        scrub_rate_mb_s: float = 64.0,
     ):
         # `ec.codec` config: "cpu" | "native" | "tpu" | "" (auto: tpu
         # with a JAX device, else the native SIMD shim, else numpy).
@@ -192,6 +194,24 @@ class VolumeServer:
         # lead (self._shard_taken) before any file-rewriting admin op
         # — vacuum, EC encode, readonly, delete — via _ensure_owned's
         # release handshake with the owning worker.
+        # scrub plane (docs/SCRUB.md): background integrity sweeps over
+        # every local volume, rate-limited so foreground p99 survives.
+        # scrub_interval <= 0 disables the engine; quarantine reporting
+        # (heartbeats, /status) still works — foreground reads keep
+        # quarantining truncated shards either way.
+        self.store.node_label = f"{host}:{port}"
+        self.scrub: "object | None" = None
+        if scrub_interval > 0:
+            from seaweedfs_tpu.scrub import ScrubEngine
+
+            self.scrub = ScrubEngine(
+                self.store,
+                interval=scrub_interval,
+                rate_mb_s=scrub_rate_mb_s,
+                fetcher_factory=self._remote_shard_fetcher,
+                on_event=self._hb_wake.set,
+                node_label=self.store.node_label,
+            )
         self.shard_writes = shard_writes
         self.n_writers = max(1, n_writers)
         self._shard_taken: set[int] = set()
@@ -322,10 +342,54 @@ class VolumeServer:
                 req.ec_shards.add(
                     id=s.id, collection=s.collection, ec_index_bits=s.ec_index_bits
                 )
+            for row in self._collect_scrub_stats():
+                req.scrub_stats.add(**row)
             yield req
             # next beat on the tick, on an inventory change, or on stop
             # — whichever comes first
             self._hb_wake.wait(self.heartbeat_interval)
+
+    def _collect_scrub_stats(self) -> list[dict]:
+        """ScrubStat heartbeat rows: the engine's health records merged
+        with the store's quarantine registry (which also fills when the
+        engine is off — foreground reads quarantine truncated shards
+        too). Complete snapshot every beat; the master overwrites."""
+        rows: dict[tuple[int, bool], dict] = {}
+        if self.scrub is not None:
+            for h in self.scrub.health_rows():
+                rows[(h.volume_id, h.is_ec)] = {
+                    "volume_id": h.volume_id,
+                    "is_ec": h.is_ec,
+                    "last_sweep_unix": int(h.last_sweep_unix),
+                    "scanned_bytes": h.scanned_bytes,
+                    # CURRENT damage, not history: a repaired volume's
+                    # next clean sweep zeroes this, so the master's
+                    # repair scheduler converges (cumulative totals
+                    # stay in metrics and /scrub/status)
+                    "corruptions_found": h.sweep_corruptions,
+                    "quarantined_shard_bits": 0,
+                    "last_error": h.last_error[:300],
+                }
+        for vid, per_vid in list(self.store.quarantined.items()):
+            row = rows.setdefault(
+                (vid, True),
+                {
+                    "volume_id": vid,
+                    "is_ec": True,
+                    "last_sweep_unix": 0,
+                    "scanned_bytes": 0,
+                    "corruptions_found": 0,
+                    "quarantined_shard_bits": 0,
+                    "last_error": "; ".join(
+                        f"shard {sid}: {why}"
+                        for sid, why in sorted(per_vid.items())
+                    )[:300],
+                },
+            )
+            row["quarantined_shard_bits"] = self.store.quarantined_shard_bits(
+                vid
+            )
+        return list(rows.values())
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.is_set():
@@ -1258,6 +1322,25 @@ class VolumeServer:
                         {
                             "Version": "seaweedfs_tpu",
                             "Volumes": len(hb.volumes),
+                            "EcVolumes": len(hb.ec_shards),
+                            # scrub plane: quarantined shards are no
+                            # longer silent — operators (and the shell's
+                            # scrub.status) see them here, the master
+                            # sees them via ScrubStat heartbeat rows
+                            # list() snapshots: the scrub thread (or a
+                            # foreground quarantine) mutates these dicts
+                            # concurrently with this handler thread
+                            "QuarantinedShards": {
+                                str(vid): sorted(list(per_vid))
+                                for vid, per_vid in list(
+                                    server.store.quarantined.items()
+                                )
+                            },
+                            "Scrub": (
+                                server.scrub.status()
+                                if server.scrub is not None
+                                else {"Disabled": True}
+                            ),
                             "Resizing": (
                                 "enabled"
                                 if images.resizing_enabled()
@@ -1265,6 +1348,25 @@ class VolumeServer:
                             ),
                         }
                     )
+                if url_path == "/scrub/status":
+                    if server.scrub is None:
+                        return self._json({"Disabled": True})
+                    return self._json(server.scrub.status())
+                if url_path == "/scrub/trigger":
+                    # operator surface (scrub.trigger shell command):
+                    # kick a sweep now, optionally one volume first
+                    if server.scrub is None:
+                        return self._json({"error": "scrub disabled"}, 400)
+                    q = fast_query(self.path.partition("?")[2])
+                    vid_arg = q.get("volumeId", "")
+                    try:
+                        vid = int(vid_arg) if vid_arg else None
+                    except ValueError:
+                        return self._json(
+                            {"error": f"bad volumeId {vid_arg!r}"}, 400
+                        )
+                    server.scrub.trigger(vid)
+                    return self._json({"triggered": True, "volumeId": vid})
                 if url_path == "/metrics":
                     from seaweedfs_tpu.stats.metrics import DEFAULT_REGISTRY
 
@@ -1781,10 +1883,14 @@ class VolumeServer:
         if self.master:
             self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
             self._hb_thread.start()
+        if self.scrub is not None:
+            self.scrub.start()
 
     def stop(self) -> None:
         self._stop.set()
         self._hb_wake.set()  # unblock the heartbeat generator's wait
+        if self.scrub is not None:
+            self.scrub.stop()
         if self._metrics_push is not None:
             self._metrics_push.stop_event.set()
         if self._http_server:

@@ -763,7 +763,12 @@ class EcRebuild(Command):
 
 
 def do_ec_verify(
-    env: CommandEnv, vid: int, out, tile_bytes: int = 4 * 1024 * 1024
+    env: CommandEnv,
+    vid: int,
+    out,
+    tile_bytes: int = 4 * 1024 * 1024,
+    rate_mb_s: float = 0.0,
+    as_json: bool = False,
 ) -> list[int]:
     """Scrub one EC volume: stream all 14 shards from their holders,
     recompute the parity from the data shards with the local codec
@@ -771,15 +776,18 @@ def do_ec_verify(
     otherwise — same selection as the serving path), and compare.
     Returns the per-parity-row mismatched-byte counts [4].
 
-    Beyond-reference surface: the reference has no EC scrub command at
-    all; this is the product face of the mesh verify tier
-    (parallel/mesh_codec.verify_batch_u32, bench `shardmap-verify`).
-    A corrupt DATA shard shows as mismatches in ALL four parity rows
-    (every row's recompute consumed the bad bytes); a corrupt PARITY
-    shard shows only in its own row."""
-    import numpy as np
+    Runs through the scrub engine's verify core
+    (scrub/verify.verify_parity_stream — the same code path the
+    background sweeper and the TPU mesh verify tier exercise), which
+    adds `-rate` token-bucket limiting (MB/s; 0 = full speed) so an
+    operator can scrub a live volume without flattening foreground
+    p99, plus corrupt-shard localization and `-json` machine-readable
+    output. A corrupt DATA shard shows as mismatches in ALL four
+    parity rows; a corrupt PARITY shard only in its own row."""
+    import json as _json
 
-    from seaweedfs_tpu.ec.codec import new_encoder
+    from seaweedfs_tpu.scrub.ratelimit import TokenBucket
+    from seaweedfs_tpu.scrub.verify import verify_parity_stream
 
     with env.master_channel() as ch:
         resp = rpc.master_stub(ch).LookupEcVolume(
@@ -797,57 +805,60 @@ def do_ec_verify(
             "run ec.rebuild first"
         )
 
-    def read_span(sid: int, offset: int, size: int) -> bytes:
-        last_err = None
-        for url in holders[sid]:
-            try:
-                with env.volume_channel(url) as ch:
-                    chunks = [
-                        r.data
-                        for r in rpc.volume_stub(ch).VolumeEcShardRead(
-                            volume_pb2.VolumeEcShardReadRequest(
-                                volume_id=vid,
-                                shard_id=sid,
-                                offset=offset,
-                                size=size,
-                            ),
-                            timeout=30,
-                        )
-                    ]
-                return b"".join(chunks)
-            except Exception as e:  # noqa: BLE001 - try the next holder
-                last_err = e
-        raise RuntimeError(f"shard {vid}.{sid} unreadable: {last_err}")
+    def make_reader(sid: int):
+        def read_span(offset: int, size: int) -> bytes:
+            last_err = None
+            for url in holders[sid]:
+                try:
+                    with env.volume_channel(url) as ch:
+                        chunks = [
+                            r.data
+                            for r in rpc.volume_stub(ch).VolumeEcShardRead(
+                                volume_pb2.VolumeEcShardReadRequest(
+                                    volume_id=vid,
+                                    shard_id=sid,
+                                    offset=offset,
+                                    size=size,
+                                ),
+                                timeout=30,
+                            )
+                        ]
+                    return b"".join(chunks)
+                except Exception as e:  # noqa: BLE001 - try the next holder
+                    last_err = e
+            raise RuntimeError(f"shard {vid}.{sid} unreadable: {last_err}")
 
-    rs = new_encoder()
-    mismatch = [0] * ec_common.PARITY_SHARDS
-    offset = 0
-    total = 0
-    while True:
-        tiles = [
-            read_span(sid, offset, tile_bytes)
-            for sid in range(ec_common.TOTAL_SHARDS_COUNT)
-        ]
-        n = len(tiles[0])
-        if any(len(t) != n for t in tiles):
-            lens = [len(t) for t in tiles]
-            raise RuntimeError(f"volume {vid}: shard length skew at {offset}: {lens}")
-        if n == 0:
-            break
-        shards: list = [
-            np.frombuffer(tiles[i], dtype=np.uint8).copy()
-            for i in range(ec_common.DATA_SHARDS)
-        ] + [None] * ec_common.PARITY_SHARDS
-        rs.encode(shards)
-        for p in range(ec_common.PARITY_SHARDS):
-            given = np.frombuffer(
-                tiles[ec_common.DATA_SHARDS + p], dtype=np.uint8
-            )
-            mismatch[p] += int(np.count_nonzero(shards[ec_common.DATA_SHARDS + p] != given))
-        total += n
-        offset += n
-        if n < tile_bytes:
-            break
+        return read_span
+
+    limiter = (
+        TokenBucket(rate_mb_s * 1024 * 1024) if rate_mb_s > 0 else None
+    )
+    try:
+        res = verify_parity_stream(
+            [make_reader(sid) for sid in range(ec_common.TOTAL_SHARDS_COUNT)],
+            tile_bytes=tile_bytes,
+            limiter=limiter,
+        )
+    except RuntimeError as e:
+        raise RuntimeError(f"volume {vid}: {e}") from None
+    mismatch, total = res.mismatch, res.bytes_per_shard
+    if as_json:
+        print(
+            _json.dumps(
+                {
+                    "volumeId": vid,
+                    "corrupt": res.corrupt,
+                    "mismatchPerParityRow": mismatch,
+                    "bytesPerShard": total,
+                    "badTiles": res.bad_tiles,
+                    "culpritShards": sorted(res.culprits),
+                    "unlocalizedTiles": res.unlocalized,
+                    "rateMBs": rate_mb_s,
+                }
+            ),
+            file=out,
+        )
+        return mismatch
     if any(mismatch):
         rows = [p for p, m in enumerate(mismatch) if m]
         kind = (
@@ -857,7 +868,12 @@ def do_ec_verify(
         )
         print(
             f"volume {vid}: CORRUPT — mismatched bytes per parity row "
-            f"{mismatch} over {total} B/shard: {kind}",
+            f"{mismatch} over {total} B/shard: {kind}"
+            + (
+                f"; culprit shard(s) {sorted(res.culprits)}"
+                if res.culprits
+                else ""
+            ),
             file=out,
         )
     else:
@@ -871,10 +887,16 @@ def do_ec_verify(
 @register
 class EcVerify(Command):
     name = "ec.verify"
-    help = "ec.verify -volumeId vid — scrub: stream shards, recompute + compare parity"
+    help = (
+        "ec.verify [-volumeId vid] [-rate MB/s] [-json] — scrub: stream "
+        "shards, recompute + compare parity (rate-limited via the scrub "
+        "engine's token bucket)"
+    )
 
     def run(self, env, args, out):
         vid_flag = _flag(args, "volumeId")
+        rate = float(_flag(args, "rate") or 0)
+        as_json = _has_flag(args, "json")
         nodes = ec_common.collect_ec_nodes(env)
         vids = (
             [int(vid_flag)]
@@ -885,7 +907,7 @@ class EcVerify(Command):
             print("no ec volumes found", file=out)
             return
         for vid in vids:
-            do_ec_verify(env, vid, out)
+            do_ec_verify(env, vid, out, rate_mb_s=rate, as_json=as_json)
 
 
 @register
@@ -1043,3 +1065,171 @@ class VolumeTierDownload(Command):
                     file=out,
                 )
         print(f"volume {vid} dat restored locally", file=out)
+
+
+# ----------------------------------------------------------------------
+# scrub plane operator surface (docs/SCRUB.md — beyond-reference: the
+# 2019 reference has no integrity commands at all)
+
+
+def _http_json(url: str, timeout: float = 10.0) -> dict:
+    import json as _json
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return _json.loads(r.read())
+
+
+@register
+class ScrubStatus(Command):
+    name = "scrub.status"
+    help = (
+        "scrub.status [-json] — per-node background-scrub health: sweep "
+        "progress, corruption counts, quarantined shards"
+    )
+
+    def run(self, env, args, out):
+        import json as _json
+
+        dump = env.collect_topology()
+        report = {}
+        for n in dump.nodes:
+            try:
+                report[n.url] = _http_json(f"http://{n.url}/status")
+            except OSError as e:
+                report[n.url] = {"error": str(e)}
+        if _has_flag(args, "json"):
+            print(
+                _json.dumps(
+                    {
+                        url: {
+                            "Scrub": st.get("Scrub"),
+                            "QuarantinedShards": st.get("QuarantinedShards"),
+                            "error": st.get("error"),
+                        }
+                        for url, st in report.items()
+                    }
+                ),
+                file=out,
+            )
+            return
+        for url, st in sorted(report.items()):
+            if "error" in st and "Scrub" not in st:
+                print(f"{url}: unreachable ({st['error']})", file=out)
+                continue
+            scrub = st.get("Scrub") or {}
+            quarantined = st.get("QuarantinedShards") or {}
+            if scrub.get("Disabled"):
+                print(f"{url}: scrub disabled", file=out)
+            else:
+                vols = scrub.get("Volumes") or []
+                corrupt = sum(v.get("corruptions_found", 0) for v in vols)
+                scanned = sum(v.get("scanned_bytes", 0) for v in vols)
+                print(
+                    f"{url}: sweeps {scrub.get('SweepsCompleted', 0)}"
+                    f"{' (running)' if scrub.get('SweepRunning') else ''}, "
+                    f"{len(vols)} volume(s) tracked, "
+                    f"{scanned >> 20} MiB verified, "
+                    f"{corrupt} corruption(s)",
+                    file=out,
+                )
+                for v in vols:
+                    if v.get("last_error"):
+                        print(
+                            f"  vid {v['volume_id']}"
+                            f"{' (ec)' if v.get('is_ec') else ''}: "
+                            f"{v['last_error']}",
+                            file=out,
+                        )
+            for vid, sids in sorted(quarantined.items()):
+                print(f"  vid {vid}: quarantined shards {sids}", file=out)
+
+
+@register
+class ScrubTrigger(Command):
+    name = "scrub.trigger"
+    help = (
+        "scrub.trigger [-volumeId vid] [-node host:port] — start a sweep "
+        "now (all nodes, or one node; with -volumeId that volume first)"
+    )
+
+    def run(self, env, args, out):
+        vid = _flag(args, "volumeId")
+        node = _flag(args, "node")
+        dump = env.collect_topology()
+        urls = [node] if node else [n.url for n in dump.nodes]
+        qs = f"?volumeId={int(vid)}" if vid else ""
+        for url in urls:
+            try:
+                _http_json(f"http://{url}/scrub/trigger{qs}")
+                print(f"{url}: sweep triggered", file=out)
+            except OSError as e:
+                print(f"{url}: trigger failed: {e}", file=out)
+
+
+@register
+class RepairQueue(Command):
+    name = "repair.queue"
+    help = (
+        "repair.queue [-json] — the master repair scheduler's tracked "
+        "damage, backoff state, and recent repair history"
+    )
+
+    def run(self, env, args, out):
+        import json as _json
+
+        snap = _http_json(f"http://{env.master}/repair/queue")
+        if _has_flag(args, "json"):
+            print(_json.dumps(snap), file=out)
+            return
+        if snap.get("Disabled"):
+            print(
+                "repair scheduler disabled on this master "
+                "(-repairInterval 0); repair is manual "
+                "(ec.rebuild / volume.fix.replication)",
+                file=out,
+            )
+        else:
+            cfg = snap.get("Config", {})
+            print(
+                f"scheduler: every {cfg.get('Interval')}s, "
+                f"concurrency {cfg.get('Concurrency')}, "
+                f"grace {cfg.get('GraceSeconds')}s, "
+                f"active {snap.get('Active', 0)}",
+                file=out,
+            )
+            tasks = snap.get("Tasks", [])
+            if not tasks:
+                print("no damage tracked", file=out)
+            for task in tasks:
+                state = (
+                    "running"
+                    if task["InFlight"]
+                    else f"attempt {task['Attempts']}, next try "
+                    f"{max(0, task['NextTry'] - time.time()):.0f}s"
+                )
+                print(
+                    f"  {task['Kind']} vid {task['VolumeId']}: "
+                    f"{task['Detail']} [{state}]"
+                    + (
+                        f" last error: {task['LastError']}"
+                        if task["LastError"]
+                        else ""
+                    ),
+                    file=out,
+                )
+            for h in snap.get("History", [])[-10:]:
+                print(
+                    f"  done: {h['Kind']} vid {h['VolumeId']} "
+                    f"in {h['RepairSeconds']}s "
+                    f"(time-to-repair {h['TimeToRepairSeconds']}s)",
+                    file=out,
+                )
+        scrub = snap.get("Scrub") or {}
+        for url, s in sorted(scrub.items()):
+            print(
+                f"  scrub@{url}: {s['Volumes']} vol(s), "
+                f"{s['Corruptions']} corruption(s), "
+                f"{s['QuarantinedShards']} quarantined shard(s)",
+                file=out,
+            )

@@ -222,6 +222,44 @@ STORE_HISTOGRAM = DEFAULT_REGISTRY.histogram(
     "weed_filer_store_seconds", "filer store latency", ("store", "type")
 )
 
+# --- scrub & self-healing plane (docs/SCRUB.md) -----------------------------
+SCRUB_SCANNED = DEFAULT_REGISTRY.counter(
+    "weed_scrub_scanned_bytes_total",
+    "bytes verified by the background scrubber",
+    ("server", "kind"),  # kind: plain | ec
+)
+SCRUB_CORRUPTIONS = DEFAULT_REGISTRY.counter(
+    "weed_scrub_corruptions_found_total",
+    "corruption events found by the scrubber",
+    ("server", "kind"),
+)
+SCRUB_QUARANTINED = DEFAULT_REGISTRY.gauge(
+    "scrub_quarantined_shards",
+    "EC shards currently quarantined on this server",
+    ("server",),
+)
+REPAIR_STARTED = DEFAULT_REGISTRY.counter(
+    "weed_repair_started_total",
+    "repairs launched by the master scheduler",
+    ("kind",),  # kind: ec_rebuild | replicate | replace
+)
+REPAIR_SUCCEEDED = DEFAULT_REGISTRY.counter(
+    "weed_repair_succeeded_total",
+    "repairs completed by the master scheduler",
+    ("kind",),
+)
+REPAIR_FAILED = DEFAULT_REGISTRY.counter(
+    "weed_repair_failed_total",
+    "repairs that errored (will back off and retry)",
+    ("kind",),
+)
+TIME_TO_REPAIR = DEFAULT_REGISTRY.histogram(
+    "weed_time_to_repair_seconds",
+    "first detection of damage to verified repair",
+    ("kind",),
+    buckets=(1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 3600.0, 14400.0),
+)
+
 
 def start_push_loop(
     gateway_url: str,

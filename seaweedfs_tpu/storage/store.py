@@ -61,6 +61,20 @@ class EcShardInfo:
 
 
 @dataclass
+class ScrubStatInfo:
+    """One volume's scrub-plane heartbeat row (pb ScrubStat) as the
+    master's topology stores it per data node."""
+
+    volume_id: int
+    is_ec: bool
+    last_sweep_unix: int
+    scanned_bytes: int
+    corruptions_found: int
+    quarantined_shard_bits: int
+    last_error: str
+
+
+@dataclass
 class Heartbeat:
     max_file_key: int
     volumes: list[VolumeInfo] = field(default_factory=list)
@@ -78,6 +92,9 @@ class Store:
         counts = max_volume_counts or [7] * len(directories)
         self.ec_backend = ec_backend  # `ec.codec`: cpu|native|tpu|None=auto
         self.needle_map_kind = needle_map_kind
+        # metric label for this server's scrub gauges ("host:port"; the
+        # volume server sets it right after construction)
+        self.node_label = ""
         # invoked after any change to the heartbeat-visible inventory
         # (volume add/delete/mount/unmount, readonly flips, EC shard
         # mount/unmount). The volume server points this at its
@@ -88,6 +105,12 @@ class Store:
         # mounted and REGISTERED before the volume is deleted) depends
         # on this, not on the periodic tick.
         self.notify_change: callable = lambda: None
+        # scrub plane: vid → {shard id → reason} for every EC shard
+        # quarantined on this server (truncation caught by a foreground
+        # read, or corruption found by the background scrubber). Rides
+        # heartbeats as ScrubStat.quarantined_shard_bits and the volume
+        # server's /status JSON.
+        self.quarantined: dict[int, dict[int, str]] = {}
         self.locations = [
             DiskLocation(
                 d, c, ec_backend=ec_backend, needle_map_kind=needle_map_kind
@@ -96,6 +119,8 @@ class Store:
         ]
         for loc in self.locations:
             loc.load_existing_volumes()
+            for ev in loc.ec_volumes.values():
+                ev.on_quarantine = self.note_quarantine
 
     # --- volume management (store.go:165-226) ---
     def has_volume(self, vid: int) -> bool:
@@ -215,9 +240,11 @@ class Store:
         if ev is None:
             loc = self.locations[0]
             ev = EcVolume(loc.directory, vid, collection, backend=self.ec_backend)
+            ev.on_quarantine = self.note_quarantine
             loc.ec_volumes[vid] = ev
         for sid in shard_ids:
             ev.mount_shard(sid)
+            self.clear_quarantine(vid, sid)
         self.notify_change()
         return ev
 
@@ -230,16 +257,54 @@ class Store:
         if not ev.shards:
             for loc in self.locations:
                 loc.ec_volumes.pop(vid, None)
+            # the whole EC volume left this node: its local quarantine
+            # records are moot
+            if self.quarantined.pop(vid, None):
+                self._update_quarantine_gauge()
         self.notify_change()
+
+    # --- scrub-plane quarantine registry ---
+    def note_quarantine(self, vid: int, shard_id: int, reason: str) -> None:
+        """EcVolume.on_quarantine target: record + force a delta beat
+        so the master hears about the lost shard NOW, not on the tick."""
+        self.quarantined.setdefault(vid, {})[shard_id] = reason
+        self._update_quarantine_gauge()
+        self.notify_change()
+
+    def clear_quarantine(self, vid: int, shard_id: int) -> None:
+        per_vid = self.quarantined.get(vid)
+        if per_vid and per_vid.pop(shard_id, None) is not None:
+            if not per_vid:
+                self.quarantined.pop(vid, None)
+            self._update_quarantine_gauge()
+
+    def quarantined_shard_bits(self, vid: int) -> int:
+        bits = 0
+        for sid in self.quarantined.get(vid, ()):
+            bits |= 1 << sid
+        return bits
+
+    def _update_quarantine_gauge(self) -> None:
+        from seaweedfs_tpu.stats.metrics import SCRUB_QUARANTINED
+
+        SCRUB_QUARANTINED.set(
+            sum(len(d) for d in self.quarantined.values()),
+            self.node_label,
+        )
 
     # --- heartbeat (store.go CollectHeartbeat) ---
     def collect_heartbeat(self) -> Heartbeat:
         hb = Heartbeat(max_file_key=0)
         for loc in self.locations:
-            for v in loc.volumes.values():
+            # list() snapshots: allocate/delete/mount RPCs (and the
+            # repair scheduler's VolumeCopy) mutate these dicts from
+            # other threads; iterating the live dict here killed the
+            # heartbeat STREAM with "dictionary changed size" — the
+            # master then saw the node flap
+            for v in list(loc.volumes.values()):
                 hb.max_file_key = max(hb.max_file_key, v.max_file_key())
                 hb.volumes.append(VolumeInfo.from_volume(v))
-            for vid, ev in loc.ec_volumes.items():
+            for vid, ev in list(loc.ec_volumes.items()):
                 bits = 0
                 for sid in ev.shard_ids():  # type: ignore[attr-defined]
                     bits |= 1 << sid

@@ -13,7 +13,7 @@ from __future__ import annotations
 import random
 from typing import Callable, Optional
 
-from seaweedfs_tpu.storage.store import EcShardInfo, VolumeInfo
+from seaweedfs_tpu.storage.store import EcShardInfo, ScrubStatInfo, VolumeInfo
 
 
 class Node:
@@ -87,6 +87,10 @@ class DataNode(Node):
         self._max_volumes = max_volumes
         self.volumes: dict[int, VolumeInfo] = {}
         self.ec_shards: dict[int, EcShardInfo] = {}  # vid -> shard bits
+        # scrub plane: (vid, is_ec) -> latest ScrubStat row from this
+        # node's heartbeats; the repair scheduler reads corruption and
+        # quarantine signals from here
+        self.scrub_stats: dict[tuple[int, bool], ScrubStatInfo] = {}
         self.last_seen = 0.0
 
     @property

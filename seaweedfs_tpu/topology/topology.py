@@ -166,6 +166,37 @@ class Topology(Node):
         # volumes would re-enter layouts referencing a detached node)
         dn.parent = None
 
+    # --- scrub plane (docs/SCRUB.md) ---
+    @staticmethod
+    def sync_scrub_stats(dn: DataNode, infos: list) -> None:
+        """Overwrite one node's scrub-health view from a heartbeat.
+        Every beat carries the node's complete snapshot, so wholesale
+        replacement is correct (rows for volumes the node no longer
+        holds vanish with it)."""
+        dn.scrub_stats = {(s.volume_id, s.is_ec): s for s in infos}
+
+    def scrub_summary(self) -> dict:
+        """Cluster-wide scrub rollup for status surfaces."""
+        per_node: dict[str, dict] = {}
+        for dn in self.data_nodes():
+            stats = list(dn.scrub_stats.values())
+            if not stats:
+                continue
+            per_node[dn.url] = {
+                "Volumes": len(stats),
+                "Corruptions": sum(s.corruptions_found for s in stats),
+                "QuarantinedShards": sum(
+                    bin(s.quarantined_shard_bits).count("1") for s in stats
+                ),
+                "ScannedBytes": sum(s.scanned_bytes for s in stats),
+                "Errors": [
+                    f"vid {s.volume_id}: {s.last_error}"
+                    for s in stats
+                    if s.last_error
+                ][:10],
+            }
+        return per_node
+
     # --- EC shard registry (topology_ec.go) ---
     def sync_ec_shards(self, dn: DataNode, infos: list[EcShardInfo]) -> None:
         new_or_changed, deleted = dn.update_ec_shards(infos)

@@ -1,0 +1,79 @@
+"""Fault-injection helpers for corruption end-to-end tests.
+
+Bit-flips and truncations against the on-disk formats (.dat needle
+records, .ec shard files, .ecx indexes) so scrub/repair tests inject
+exactly the damage the subsystem claims to detect. Helpers return
+enough to RESTORE the damage, because several suites share live
+cluster fixtures.
+"""
+
+from __future__ import annotations
+
+import os
+
+from seaweedfs_tpu.storage import types as t
+
+
+def flip_byte(path: str, offset: int, xor: int = 0xFF) -> int:
+    """XOR one byte in place; returns the ORIGINAL byte value."""
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        orig = f.read(1)[0]
+        f.seek(offset)
+        f.write(bytes([orig ^ xor]))
+    return orig
+
+
+def restore_byte(path: str, offset: int, value: int) -> None:
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        f.write(bytes([value]))
+
+
+def truncate_by(path: str, nbytes: int) -> int:
+    """Chop `nbytes` off the file's tail; returns the new size."""
+    size = os.path.getsize(path)
+    new = max(0, size - nbytes)
+    with open(path, "r+b") as f:
+        f.truncate(new)
+    return new
+
+
+def find_ec_shard_path(volume_servers, collection: str, vid: int, sid: int):
+    """(path, serving VolumeServer) for the MOUNTED copy of a shard.
+    Mount state is checked first (via the store), not mere file
+    existence: the encode/spread pipeline can leave an unmounted
+    leftover shard file on the encoding node, and corrupting that
+    dead copy instead of the served one makes a detection test pass
+    or fail on spread order. Falls back to any on-disk file when no
+    server has the shard mounted; (None, None) when absent."""
+    for vs in volume_servers:
+        ev = vs.store.find_ec_volume(vid)
+        if ev is not None and sid in ev.shards:
+            return ev.shards[sid].path, vs
+    name = (
+        f"{collection}_{vid}.ec{sid:02d}" if collection else f"{vid}.ec{sid:02d}"
+    )
+    for vs in volume_servers:
+        for loc in vs.store.locations:
+            p = os.path.join(loc.directory, name)
+            if os.path.exists(p):
+                return p, vs
+    return None, None
+
+
+def corrupt_needle_data(volume, needle_id: int, xor: int = 0x5A) -> tuple[str, int, int]:
+    """Flip one byte inside a live needle's DATA region in the .dat so
+    the CRC check fails on re-read. Returns (dat_path, offset, original
+    byte) for restoration.
+
+    v2/v3 record layout: 16-byte header, then u32 data_size, then data
+    — so the first data byte sits at actual_offset + 20."""
+    nv = volume.nm.get(needle_id)
+    assert nv is not None and nv.size != t.TOMBSTONE_FILE_SIZE, (
+        f"needle {needle_id} not live"
+    )
+    dat_path = volume.base_name + ".dat"
+    offset = nv.actual_offset + t.NEEDLE_HEADER_SIZE + 4
+    orig = flip_byte(dat_path, offset, xor)
+    return dat_path, offset, orig
