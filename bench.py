@@ -674,6 +674,154 @@ def bench_http_reqs() -> None:
         )
 
 
+def bench_shard_hop() -> None:
+    """`-shardWrites` loopback-hop cost, measured (VERDICT r5 "Next
+    round" #3): the same write POSTed at a worker that OWNS the vid
+    (local append) vs one that must hop it to the other writer over the
+    loopback internal listener. One in-process master + sharded lead
+    (writer 0 of 2) + write worker (writer 1 of 2), pooled keep-alive
+    connection, median of N per arm — the same-process-pair A/B keeps
+    scheduler noise common-mode.
+
+    value = median added microseconds per hopped write;
+    vs_baseline = owned/hopped latency ratio (1.0 = free hop). The
+    W-core projection table in OPERATIONS.md §round 8 is built from
+    this constant plus the measured per-write CPU split."""
+    import json as _json
+    import statistics
+    import tempfile
+    import urllib.request as _rq
+
+    from seaweedfs_tpu.client.operation import _drop_conn, _pooled_conn
+    from seaweedfs_tpu.command.servers import _tune_gc
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.server.volume_workers import VolumeReadWorker
+    from seaweedfs_tpu.util.availability import free_port
+
+    _tune_gc()
+    n = 400
+    with tempfile.TemporaryDirectory() as vdir:
+        mport = free_port()
+        master = MasterServer(port=mport, volume_size_limit_mb=64)
+        master.start()
+        iport, winternal = free_port(), free_port()
+        lead = VolumeServer(
+            [vdir],
+            port=free_port(),
+            master=f"127.0.0.1:{mport}",
+            heartbeat_interval=0.2,
+            max_volume_counts=[100],
+            internal_port=iport,
+            shard_writes=True,
+            n_writers=2,
+        )
+        lead._writer_internal_addr = lambda k: (
+            f"127.0.0.1:{winternal}" if k == 1 else f"127.0.0.1:{iport}"
+        )
+        lead.start()
+        deadline = time.time() + 30
+        while time.time() < deadline and not master.topology.data_nodes():
+            time.sleep(0.05)
+        wport = free_port()
+        worker = VolumeReadWorker(
+            [vdir],
+            host="127.0.0.1",
+            port=free_port(),
+            lead=f"127.0.0.1:{iport}",
+            worker_port=wport,
+            shard_writes=True,
+            writer_index=1,
+            n_writers=2,
+            master=f"127.0.0.1:{mport}",
+            internal_port=winternal,
+        )
+        worker.start()
+        try:
+            # one fid per parity; unique sub-keys via the ?count= delta
+            # trick would complicate byte-accounting — instead rewrite
+            # the same needle (overwrite path) ... no: overwrites take
+            # the Python dedup path. Use fresh assigns per batch arm.
+            def assign(parity):
+                for _ in range(60):
+                    with _rq.urlopen(
+                        f"http://127.0.0.1:{mport}/dir/assign?count=500",
+                        timeout=10,
+                    ) as r:
+                        a = _json.load(r)
+                    if int(a["fid"].split(",")[0]) % 2 == parity:
+                        return a
+                raise RuntimeError(f"no parity-{parity} vid assigned")
+
+            payload = b"\x00\x01hop-bench-payload\xff" * 50  # ~1 KB binary
+            addr = f"127.0.0.1:{wport}"
+
+            def arm(parity):
+                """(wall latencies, cpu_us/write): master + lead +
+                worker + client all share THIS process, so a
+                process_time delta over the arm is the whole stack's
+                CPU per write — the constant the W-core projection
+                needs (wall on this throttled shared core is too noisy
+                to subtract; the r5 A/B hit the same wall)."""
+                a = assign(parity)
+                base_fid = a["fid"]
+                lat = []
+                c, _ = _pooled_conn(addr, 30.0)
+                try:
+                    warm = n // 10
+                    cpu0 = wall_cpu = None
+                    for i in range(n):
+                        if i == warm:
+                            cpu0 = time.process_time()
+                        fid = f"{base_fid}_{i}" if i else base_fid
+                        t0 = time.perf_counter()
+                        c.send_request(
+                            "POST", f"/{fid}", payload,
+                            {"Content-Type": "application/octet-stream"},
+                        )
+                        status, _h, _b, will_close = c.read_response("POST")
+                        if i >= warm:
+                            lat.append(time.perf_counter() - t0)
+                        assert status == 201, f"write {fid} -> {status}"
+                        if will_close:
+                            _drop_conn(addr)
+                            c, _ = _pooled_conn(addr, 30.0)
+                    wall_cpu = time.process_time() - cpu0
+                finally:
+                    _drop_conn(addr)
+                return lat, wall_cpu / (n - warm) * 1e6
+
+            # interleave arms to keep host-throttle drift common-mode
+            owned, hopped = [], []
+            owned_cpu, hopped_cpu = [], []
+            for _ in range(3):
+                lat, cpu = arm(1)
+                owned += lat
+                owned_cpu.append(cpu)
+                lat, cpu = arm(0)
+                hopped += lat
+                hopped_cpu.append(cpu)
+            owned_us = statistics.median(owned) * 1e6
+            hopped_us = statistics.median(hopped) * 1e6
+            owned_cpu_us = statistics.median(owned_cpu)
+            hopped_cpu_us = statistics.median(hopped_cpu)
+        finally:
+            worker.stop()
+            lead.stop()
+            master.stop()
+    _report(
+        "shard_writes_hop_us",
+        hopped_cpu_us - owned_cpu_us,
+        "us",
+        owned_cpu_us / hopped_cpu_us if hopped_cpu_us > 0 else 1.0,
+        owned_write_cpu_us=round(owned_cpu_us, 1),
+        hopped_write_cpu_us=round(hopped_cpu_us, 1),
+        owned_write_wall_us=round(owned_us, 1),
+        hopped_write_wall_us=round(hopped_us, 1),
+        requests_per_arm=len(owned),
+    )
+
+
 def bench_migration() -> None:
     """BASELINE config 5: live replication→EC warm-tier migration under
     concurrent reads — the availability claim, measured.
@@ -968,12 +1116,87 @@ CONFIGS = {
     "stream": bench_stream,
     "stream-rebuild": bench_stream_rebuild,
     "http": bench_http_reqs,
+    "shard-hop": bench_shard_hop,
     "migration": bench_migration_with_retry,
     "scrub": bench_scrub,
 }
 
 
+def check_native_post() -> int:
+    """`bench.py --check`: smoke the C write path — build the native
+    extension, run ONE write through the C hot loop and one through the
+    forced-Python fallback, and fail loudly unless the .dat/.idx bytes
+    and replies are identical. Cheap enough for the tier-1 budget; the
+    full matrix lives in tests/test_native_post.py."""
+    import tempfile
+
+    from seaweedfs_tpu.server import write_path
+    from seaweedfs_tpu.storage.file_id import FileId
+    from seaweedfs_tpu.storage.volume import Volume
+
+    if write_path._needle_ext is None or not hasattr(
+        write_path._needle_ext, "post"
+    ):
+        print(json.dumps({
+            "metric": "native_post_check",
+            "ok": False,
+            "skipped": True,
+            "reason": "no C toolchain: needle_ext unavailable",
+        }))
+        return 0  # absent toolchain is a skip, not a failure
+    body = b"\x00\x07check-payload\xff" * 64
+    q = {"ts": "1700000000"}
+    fid = FileId(1, 9, 0xBEEF)
+
+    def now_ns(self):
+        # pure function of volume state: both volumes stamp the same
+        # append_at_ns, so byte comparison is exact
+        return self.last_append_at_ns + 1
+
+    orig = Volume._now_ns
+    Volume._now_ns = now_ns
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            os.mkdir(os.path.join(d, "c"))
+            os.mkdir(os.path.join(d, "py"))
+            vc = Volume(os.path.join(d, "c"), 1)
+            vp = Volume(os.path.join(d, "py"), 1)
+            reply_c = write_path.try_native_post(vc, fid, q, body, {}, "", False)
+            n, fname, err = write_path.build_upload_needle(fid, q, body, {}, "")
+            assert err is None, err
+            _, size, _ = vp.write_needle(n)
+            reply_py = b'{"name": %s, "size": %d, "eTag": "%s"}' % (
+                json.dumps(fname).encode(), size, n.etag().encode())
+            vc.close()
+            vp.close()
+            with open(vc.base_name + ".dat", "rb") as f:
+                dat_c = f.read()
+            with open(vp.base_name + ".dat", "rb") as f:
+                dat_py = f.read()
+            with open(vc.base_name + ".idx", "rb") as f:
+                idx_c = f.read()
+            with open(vp.base_name + ".idx", "rb") as f:
+                idx_py = f.read()
+        ok = (
+            reply_c is not None
+            and reply_c == reply_py
+            and dat_c == dat_py
+            and idx_c == idx_py
+        )
+        print(json.dumps({
+            "metric": "native_post_check",
+            "ok": ok,
+            "engaged": reply_c is not None,
+            "dat_bytes": len(dat_c),
+        }))
+        return 0 if ok else 1
+    finally:
+        Volume._now_ns = orig
+
+
 def main() -> None:
+    if "--check" in sys.argv[1:]:
+        raise SystemExit(check_native_post())
     config = sys.argv[1] if len(sys.argv) > 1 else "all"
     if config == "all":
         # The driver records whatever this prints: run the whole

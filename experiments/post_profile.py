@@ -1,0 +1,97 @@
+"""Profile the volume POST serving path end to end, single-threaded.
+
+Drives util/httpd.serve_connection directly over a socketpair (a
+feeder thread writes pipelined POSTs; the serving side runs under
+cProfile in the main thread), so the profile attributes every
+microsecond of the per-request cost: mini-loop head parse, dispatch,
+handler prologue (fid parse, auth, body read), the write work itself
+(C hot loop or Python fallback per WEED_NATIVE_POST), and the reply.
+
+Usage: python experiments/post_profile.py [n] [0|1 native]
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import os
+import pstats
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8000
+    native = sys.argv[2] if len(sys.argv) > 2 else "1"
+    os.environ["WEED_NATIVE_POST"] = native
+
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.util import httpd
+
+    with tempfile.TemporaryDirectory() as d:
+        vs = VolumeServer([d], port=0, master="")
+        vs.store.add_volume(1)
+        handler_cls = vs._http_handler_class()
+
+        payload = b"\x00\x07profile-payload\xff" * 64  # 1 KiB binary
+        reqs = []
+        for i in range(n):
+            fid = f"1,{i + 1:x}00bbccdd"
+            reqs.append(
+                b"POST /%s HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Type: application/octet-stream\r\n"
+                b"Content-Length: %d\r\n\r\n" % (fid.encode(), len(payload))
+                + payload
+            )
+        blob = b"".join(reqs)
+
+        a, b = socket.socketpair()
+
+        def send():
+            a.sendall(blob)
+            a.shutdown(socket.SHUT_WR)  # EOF ends the serve loop cleanly
+
+        def drain():
+            # separate thread: draining must overlap the send or the
+            # pair deadlocks on full buffers in both directions
+            while True:
+                try:
+                    if not a.recv(1 << 20):
+                        return
+                except OSError:
+                    return
+
+        for fn in (send, drain):
+            threading.Thread(target=fn, daemon=True).start()
+
+        class Srv:  # the surface serve_connection touches
+            pass
+
+        prof = cProfile.Profile()
+        t0 = time.perf_counter()
+        prof.enable()
+        httpd.serve_connection(b, ("127.0.0.1", 1), Srv(), handler_cls)
+        prof.disable()
+        wall = time.perf_counter() - t0
+        a.close()
+        b.close()
+        vs.store.close()
+
+    out = io.StringIO()
+    stats = pstats.Stats(prof, stream=out)
+    stats.sort_stats("cumulative").print_stats(22)
+    print(out.getvalue())
+    print(
+        f"ARM={'c-hot-loop' if native != '0' else 'python'} "
+        f"n={n} wall_us_per_req={wall / n * 1e6:.1f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

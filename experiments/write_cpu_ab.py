@@ -1,0 +1,123 @@
+"""Same-method A/B: volume-server CPU per write, C hot loop on vs off.
+
+Method (the OPERATIONS.md §round 5 discipline — both arms measured the
+same way on the same host, minutes apart): the volume server runs ALONE
+in a subprocess (no master, no heartbeats, volume 1 pre-allocated); the
+parent drives N serial 1 KiB binary POSTs over one pooled keep-alive
+connection and reads the CHILD's /proc/<pid>/stat utime+stime around
+the timed region — so the number is volume-server-only CPU, not wall,
+not client, not master. WEED_NATIVE_POST=0/1 selects the arm; arms are
+interleaved twice so host-throttle drift stays common-mode.
+
+Usage: python experiments/write_cpu_ab.py [n_per_arm]
+Prints one JSON line per arm-round plus the medians.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import socket
+import statistics
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+CLK = os.sysconf("SC_CLK_TCK")
+
+CHILD = r"""
+import sys, time
+from seaweedfs_tpu.server.volume_server import VolumeServer
+vs = VolumeServer([sys.argv[1]], port=int(sys.argv[2]), master="")
+vs.store.add_volume(1)
+vs.start()
+print("READY", flush=True)
+time.sleep(3600)
+"""
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def child_cpu_s(pid: int) -> float:
+    with open(f"/proc/{pid}/stat", "rb") as f:
+        fields = f.read().rsplit(b")", 1)[1].split()
+    return (int(fields[11]) + int(fields[12])) / CLK  # utime + stime
+
+
+def run_arm(native: str, n: int, payload: bytes) -> float:
+    """Per-write volume-server CPU in us for one arm-round."""
+    sys.path.insert(0, REPO)
+    from seaweedfs_tpu.client.operation import _drop_conn, _pooled_conn
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        port = free_port()
+        env = dict(os.environ, WEED_NATIVE_POST=native, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", CHILD, d, str(port)],
+            stdout=subprocess.PIPE,
+            env=env,
+            cwd=REPO,
+        )
+        try:
+            line = proc.stdout.readline()
+            assert b"READY" in line, line
+            addr = f"127.0.0.1:{port}"
+            c, _ = _pooled_conn(addr, 30.0)
+            warm = max(50, n // 10)
+            cpu0 = None
+            for i in range(n + warm):
+                if i == warm:
+                    cpu0 = child_cpu_s(proc.pid)
+                fid = f"1,{i + 1:x}00bbccdd"
+                c.send_request(
+                    "POST", f"/{fid}", payload,
+                    {"Content-Type": "application/octet-stream"},
+                )
+                status, _h, _b, will_close = c.read_response("POST")
+                assert status == 201, (fid, status)
+                if will_close:
+                    _drop_conn(addr)
+                    c, _ = _pooled_conn(addr, 30.0)
+            cpu1 = child_cpu_s(proc.pid)
+            _drop_conn(addr)
+            return (cpu1 - cpu0) / n * 1e6
+        finally:
+            proc.kill()
+            proc.wait()
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4000
+    payload = secrets.token_bytes(1024)  # binary: both arms store raw
+    arms: dict[str, list[float]] = {"0": [], "1": []}
+    for round_ in range(2):
+        for native in ("0", "1"):
+            us = run_arm(native, n, payload)
+            arms[native].append(us)
+            print(json.dumps({
+                "arm": "python" if native == "0" else "c-hot-loop",
+                "round": round_,
+                "volume_cpu_us_per_write": round(us, 1),
+                "n": n,
+            }), flush=True)
+    py_us = statistics.median(arms["0"])
+    c_us = statistics.median(arms["1"])
+    print(json.dumps({
+        "metric": "volume_write_cpu_ab",
+        "python_us": round(py_us, 1),
+        "c_us": round(c_us, 1),
+        "ratio": round(c_us / py_us, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -426,7 +426,7 @@ class S3Command(Command):
         wlog.set_verbosity(args.v)
         iam = None
         if args.config:
-            import tomllib
+            from seaweedfs_tpu.util.config import tomllib  # 3.10 fallback parser
 
             with open(args.config, "rb") as f:
                 tree = tomllib.load(f)

@@ -44,10 +44,9 @@ if _lib is not None:
         return _lib.weed_crc32c(crc & 0xFFFFFFFF, data, len(data))
 
 
-# needle record serializer: a CPython extension, not ctypes — the
-# 11-field signature would cost more in ctypes conversion than the
-# serialization itself (native/needle_ext.c; staleness tracks its
-# #included sources too)
-needle_ext = _build.load_ext(
-    "needle_ext.c", "_needle_ext", deps=("needle.c", "crc32c.c")
-)
+# needle record serializer + one-pass POST hot loop: a CPython
+# extension, not ctypes — the many-field signatures would cost more in
+# ctypes conversion than the serialization itself (native/needle_ext.c;
+# _build scans the #include graph, so staleness tracks needle.c,
+# crc32c.c, and post.c without a hand-maintained deps tuple)
+needle_ext = _build.load_ext("needle_ext.c", "_needle_ext")

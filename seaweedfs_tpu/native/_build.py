@@ -7,33 +7,70 @@ compile cache: `load` dlopens a plain shared object via ctypes;
 for ctypes argument conversion, like the needle serializer). Callers
 treat a None return as "no native path" and fall back to their
 pure-Python/numpy implementations.
+
+Staleness: a cached .so is rebuilt whenever the source — or anything
+it (transitively) `#include "..."`s — is newer than the artifact. The
+include graph is scanned from the sources themselves, so adding an
+include never silently ships old code because a caller forgot to
+update a deps tuple (that bit during PR 2's needle_ext GIL change:
+the .so predated the edited needle.c and kept loading). When the
+artifact is stale and no compiler works, the loader WARNS and returns
+None (pure-Python fallback) rather than dlopening the old code.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
+import re
 import subprocess
 import tempfile
+import warnings
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
 _COMPILERS = ("cc", "gcc", "g++", "clang")
 
+_INCLUDE_RE = re.compile(rb'^[ \t]*#[ \t]*include[ \t]*"([^"]+)"', re.M)
+
+
+def _local_includes(src: str, seen: set[str] | None = None) -> set[str]:
+    """Transitive `#include "..."` closure of `src`, resolved relative
+    to this directory (all shims live flat here). Missing files are
+    ignored — the compiler will say so louder."""
+    if seen is None:
+        seen = set()
+    try:
+        with open(src, "rb") as f:
+            text = f.read()
+    except OSError:
+        return seen
+    for m in _INCLUDE_RE.finditer(text):
+        name = m.group(1).decode("utf-8", "replace")
+        path = os.path.join(_HERE, os.path.basename(name))
+        if path in seen or not os.path.exists(path):
+            continue
+        seen.add(path)
+        _local_includes(path, seen)
+    return seen
+
 
 def _compile(src: str, so: str, deps: tuple[str, ...], includes: tuple[str, ...]) -> str | None:
-    """Compile src → so unless the cached .so is newer than src AND all
-    #included deps. Returns the .so path, or None when no compiler
-    worked. Builds to a temp file then renames: concurrent importers
-    must never dlopen a half-written .so."""
+    """Compile src → so unless the cached .so is newer than src AND
+    every #included dep (scanned from the sources + any caller-passed
+    extras). Returns the .so path, or None when no compiler worked.
+    Builds to a temp file then renames: concurrent importers must
+    never dlopen a half-written .so."""
     try:
+        dep_paths = {src}
+        dep_paths.update(os.path.join(_HERE, d) for d in deps)
+        dep_paths.update(_local_includes(src))
         newest_src = max(
-            os.path.getmtime(p)
-            for p in (src, *(os.path.join(_HERE, d) for d in deps))
-            if os.path.exists(p)
+            os.path.getmtime(p) for p in dep_paths if os.path.exists(p)
         )
         if os.path.exists(so) and os.path.getmtime(so) >= newest_src:
             return so
+        stale = os.path.exists(so)
         for cc in _COMPILERS:
             fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
             os.close(fd)
@@ -55,6 +92,17 @@ def _compile(src: str, so: str, deps: tuple[str, ...], includes: tuple[str, ...]
                     os.unlink(tmp)
                 except OSError:
                     pass
+        if stale:
+            # an out-of-date artifact exists but cannot be rebuilt on
+            # this host: never load it silently — the pure-Python
+            # fallback is slower but correct
+            warnings.warn(
+                f"{os.path.basename(so)} is stale (source newer than the "
+                "built artifact) and no working C compiler was found; "
+                "falling back to the pure-Python path",
+                RuntimeWarning,
+                stacklevel=2,
+            )
     except OSError:
         pass
     return None

@@ -16,6 +16,7 @@
 #include <Python.h>
 
 #include "needle.c"
+#include "post.c"
 
 static PyObject *py_encode(PyObject *self, PyObject *const *args,
                            Py_ssize_t nargs) {
@@ -297,11 +298,94 @@ out:
     return result;
 }
 
+/* post(body, content_type, raw_gzipped, q_filename, url_filename,
+ *      pairs, base_flags, cookie, id, version, last_modified,
+ *      append_at_ns, fd, offset, fix_jpg)
+ *   -> None                         needs the Python slow path
+ *    | (reply_bytes, total, size)   record pwritten at `offset`
+ *   raises OSError when the pwrite itself fails (errno preserved).
+ *
+ * The whole hot span — multipart/raw extraction, needle assembly, CRC,
+ * pwrite, reply formatting — runs with the GIL RELEASED (post.c); the
+ * caller holds the volume lock, which a GIL release does not drop, so
+ * the single-writer-per-volume invariant is untouched. */
+static PyObject *py_post(PyObject *self, PyObject *const *args,
+                         Py_ssize_t nargs) {
+    if (nargs != 15) {
+        PyErr_SetString(PyExc_TypeError, "post() takes 15 arguments");
+        return NULL;
+    }
+    weed_post_req r;
+    memset(&r, 0, sizeof(r));
+    r.raw_gzipped = (int)PyLong_AsLong(args[2]);
+    r.base_flags = (uint32_t)PyLong_AsUnsignedLongMask(args[6]);
+    r.cookie = (uint32_t)PyLong_AsUnsignedLongMask(args[7]);
+    r.id = PyLong_AsUnsignedLongLongMask(args[8]);
+    r.version = (int)PyLong_AsLong(args[9]);
+    r.last_modified = PyLong_AsUnsignedLongLongMask(args[10]);
+    r.append_at_ns = PyLong_AsUnsignedLongLongMask(args[11]);
+    r.fd = (int)PyLong_AsLong(args[12]);
+    r.offset = (int64_t)PyLong_AsLongLong(args[13]);
+    r.fix_jpg = (int)PyLong_AsLong(args[14]);
+    if (PyErr_Occurred()) return NULL;
+
+    Py_buffer body, ctype, qname, uname, pairs;
+    if (PyObject_GetBuffer(args[0], &body, PyBUF_SIMPLE) < 0) return NULL;
+    if (PyObject_GetBuffer(args[1], &ctype, PyBUF_SIMPLE) < 0) goto err_body;
+    if (PyObject_GetBuffer(args[3], &qname, PyBUF_SIMPLE) < 0) goto err_ctype;
+    if (PyObject_GetBuffer(args[4], &uname, PyBUF_SIMPLE) < 0) goto err_qname;
+    if (PyObject_GetBuffer(args[5], &pairs, PyBUF_SIMPLE) < 0) goto err_uname;
+
+    r.body = (const uint8_t *)body.buf;
+    r.body_len = (size_t)body.len;
+    r.ctype = (const uint8_t *)ctype.buf;
+    r.ctype_len = (size_t)ctype.len;
+    r.q_name = (const uint8_t *)qname.buf;
+    r.q_name_len = (size_t)qname.len;
+    r.url_name = (const uint8_t *)uname.buf;
+    r.url_name_len = (size_t)uname.len;
+    r.pairs = (const uint8_t *)pairs.buf;
+    r.pairs_len = (size_t)pairs.len;
+
+    int rc;
+    Py_BEGIN_ALLOW_THREADS
+    rc = weed_post(&r);
+    Py_END_ALLOW_THREADS
+
+    PyBuffer_Release(&pairs);
+    PyBuffer_Release(&uname);
+    PyBuffer_Release(&qname);
+    PyBuffer_Release(&ctype);
+    PyBuffer_Release(&body);
+
+    if (rc == WEED_POST_DECLINE) Py_RETURN_NONE;
+    if (rc == WEED_POST_IOERR) {
+        errno = r.io_errno;
+        return PyErr_SetFromErrno(PyExc_OSError);
+    }
+    return Py_BuildValue("(y#lI)", r.reply, (Py_ssize_t)r.reply_len, r.total,
+                         (unsigned int)r.size);
+
+    /* unwind: each label releases ITS OWN buffer then falls through,
+     * so a GetBuffer failure on arg N releases exactly args 0..N-1 */
+err_uname:
+    PyBuffer_Release(&uname);
+err_qname:
+    PyBuffer_Release(&qname);
+err_ctype:
+    PyBuffer_Release(&ctype);
+err_body:
+    PyBuffer_Release(&body);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"encode", (PyCFunction)py_encode, METH_FASTCALL,
      "serialize one needle record"},
     {"decode", (PyCFunction)py_decode, METH_FASTCALL,
      "parse + CRC-verify one needle record"},
+    {"post", (PyCFunction)py_post, METH_FASTCALL,
+     "one-pass volume POST: extract + assemble + CRC + pwrite + reply"},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_needle_ext",

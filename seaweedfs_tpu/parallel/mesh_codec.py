@@ -26,7 +26,19 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.4.4x jax: experimental home + old kwarg name
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, **kwargs):
+        # the modern API spells the replication-check flag check_vma;
+        # the experimental one calls it check_rep — translate so call
+        # sites can stay on the current spelling
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _exp_shard_map(f, **kwargs)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from seaweedfs_tpu.ec.codec_tpu import (

@@ -106,7 +106,7 @@ def run_replicate(
     stop_after_idle > 0 makes the loop exit after that many idle
     seconds (tests / one-shot drains)."""
     if config_path:
-        import tomllib
+        from seaweedfs_tpu.util.config import tomllib  # 3.10 fallback parser
 
         with open(config_path, "rb") as f:
             repl_cfg = Configuration(tomllib.load(f))

@@ -28,13 +28,12 @@ import urllib.parse
 import urllib.request
 import uuid
 import xml.etree.ElementTree as ET
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from xml.sax.saxutils import escape
 
 import grpc
 
 from seaweedfs_tpu.pb import filer_pb2 as fpb
-from seaweedfs_tpu.util.httpd import WeedHTTPServer
+from seaweedfs_tpu.util.httpd import FastHandler, WeedHTTPServer
 from seaweedfs_tpu.pb import rpc
 from seaweedfs_tpu.s3api import auth as s3auth
 from seaweedfs_tpu.s3api import chunked_reader
@@ -58,7 +57,7 @@ class S3ApiServer:
         self.port = port
         self.buckets_path = buckets_path.rstrip("/")
         self.iam = iam or s3auth.IdentityAccessManagement()
-        self._http_server: ThreadingHTTPServer | None = None
+        self._http_server: WeedHTTPServer | None = None
         self._channel: grpc.Channel | None = None
         self._channel_lock = threading.Lock()
 
@@ -181,22 +180,18 @@ class S3ApiServer:
     def _handler_class(self):
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *args):
-                pass
+        class Handler(FastHandler):
+            # rides the util/httpd mini request loop like every other
+            # serving path (one-buffer head parse, FastHeaders, dict
+            # dispatch, keep-alive semantics, fast_reply one-write
+            # responses) — the S3 data path no longer pays the stdlib
+            # email-parser/send_header-per-line overhead the volume
+            # server shed two rounds ago
 
             # ---------- plumbing ----------
             def _send(self, status: int, body: bytes = b"", headers: dict | None = None):
-                self.send_response(status)
-                for k, v in (headers or {}).items():
-                    if v:
-                        self.send_header(k, v)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                if body and self.command != "HEAD":
-                    self.wfile.write(body)
+                out = {k: v for k, v in (headers or {}).items() if v}
+                self.fast_reply(status, body, out or None)
 
             def _send_xml(self, root: ET.Element, status: int = 200):
                 body = b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)

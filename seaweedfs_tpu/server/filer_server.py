@@ -19,7 +19,6 @@ import json
 import threading
 import time
 from concurrent import futures
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, unquote, urlparse
 
 import grpc
@@ -31,7 +30,7 @@ from seaweedfs_tpu.filer.entry import Attr, Entry, normalize_path
 from seaweedfs_tpu.filer.filer import Filer
 from seaweedfs_tpu.filer.filerstore import EntryNotFound, new_store
 from seaweedfs_tpu.pb import filer_pb2 as fpb
-from seaweedfs_tpu.util.httpd import FastRequestMixin, WeedHTTPServer
+from seaweedfs_tpu.util.httpd import FastHandler, WeedHTTPServer
 from seaweedfs_tpu.pb import rpc
 
 
@@ -94,7 +93,7 @@ class FilerServer:
             on_event=on_event or _queue_publisher(),
         )
         self._grpc_server: grpc.Server | None = None
-        self._http_server: ThreadingHTTPServer | None = None
+        self._http_server: WeedHTTPServer | None = None
 
     # ------------------------------------------------------------------
     # master failover: any live master serves (non-leaders proxy writes
@@ -354,15 +353,11 @@ class FilerServer:
     def _http_handler_class(self):
         server = self
 
-        class Handler(FastRequestMixin, BaseHTTPRequestHandler):
-            # FastRequestMixin marks the handler for WeedHTTPServer's
-            # mini request loop (one-scan head parse, FastHeaders,
-            # body realignment — util/httpd.serve_connection); the
-            # send_response/send_header slow paths below are untouched
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *args):
-                pass
+        class Handler(FastHandler):
+            # FastHandler rides WeedHTTPServer's mini request loop
+            # (one-scan head parse, FastHeaders, body realignment —
+            # util/httpd.serve_connection); the send_response/
+            # send_header slow paths below are untouched
 
             def _reply(self, status, body=b"", headers=None):
                 self.send_response(status)

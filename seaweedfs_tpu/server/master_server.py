@@ -31,14 +31,13 @@ import random
 import threading
 import time
 from concurrent import futures
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import grpc
 
 from seaweedfs_tpu.pb import master_pb2 as pb
 from seaweedfs_tpu.util.httpd import (
     JSON_HDR as _JSON_HDR,
-    FastRequestMixin,
+    FastHandler,
     WeedHTTPServer,
     fast_query,
 )
@@ -192,7 +191,7 @@ class MasterServer:
         self._clients_seq = 0
         self._clients_lock = threading.Lock()
         self._grpc_server: grpc.Server | None = None
-        self._http_server: ThreadingHTTPServer | None = None
+        self._http_server: WeedHTTPServer | None = None
 
     @property
     def is_leader(self) -> bool:
@@ -685,12 +684,7 @@ class MasterServer:
     def _http_handler_class(self):
         server = self
 
-        class Handler(FastRequestMixin, BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *args):  # quiet
-                pass
-
+        class Handler(FastHandler):
             def _html(self, body: str, status=200):
                 self.fast_reply(
                     status,

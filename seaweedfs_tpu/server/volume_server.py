@@ -30,7 +30,6 @@ import os
 import threading
 import time
 from concurrent import futures
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
 import grpc
@@ -40,7 +39,7 @@ from seaweedfs_tpu.ec.ec_volume import EcVolume, NotEnoughShards
 from seaweedfs_tpu.pb import master_pb2, rpc, volume_pb2 as pb
 from seaweedfs_tpu.util.httpd import (
     JSON_HDR as _JSON_HDR,
-    FastRequestMixin,
+    FastHandler,
     WeedHTTPServer,
     fast_query,
 )
@@ -170,7 +169,7 @@ class VolumeServer:
         self._hb_wake = threading.Event()
         self.store.notify_change = self._hb_wake.set
         self._grpc_server: grpc.Server | None = None
-        self._http_server: ThreadingHTTPServer | None = None
+        self._http_server: WeedHTTPServer | None = None
         self._hb_thread: threading.Thread | None = None
         self._metrics_push: threading.Thread | None = None
         self._metrics_cfg: tuple | None = None
@@ -183,7 +182,7 @@ class VolumeServer:
         # plus a loopback internal listener the workers proxy through
         self.reuse_port = reuse_port
         self.internal_port = internal_port
-        self._internal_server: ThreadingHTTPServer | None = None
+        self._internal_server: WeedHTTPServer | None = None
         # -shardWrites: volume-ownership write sharding across the
         # -workers processes. Writer k of n_writers owns vids with
         # vid % n_writers == k (lead is writer 0) and is the ONLY
@@ -1216,12 +1215,7 @@ class VolumeServer:
     def _http_handler_class(self):
         server = self
 
-        class Handler(FastRequestMixin, BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *args):
-                pass
-
+        class Handler(FastHandler):
             def _reply(self, status, body=b"", headers=None):
                 self.fast_reply(status, body, headers)
 
@@ -1600,7 +1594,14 @@ class VolumeServer:
                     routed = self._route_shard_write(fid, body)
                     if routed:
                         return
-                n, fname, err = write_path.build_upload_needle(
+                # one-pass C hot loop (native/post.c): extraction →
+                # needle → CRC → pwrite → reply bytes, GIL released;
+                # None = this request needs the Python path below
+                # (which stays byte-identical for what C handles).
+                # Both branches converge on ONE replicate-then-reply
+                # tail so the fan-out/error contract cannot drift.
+                reply = write_path.try_native_post(
+                    server.store.find_volume(fid.volume_id),
                     fid,
                     q,
                     body,
@@ -1608,24 +1609,34 @@ class VolumeServer:
                     url_filename,
                     server.fix_jpg_orientation,
                 )
-                if err is not None:
-                    return self._json({"error": err}, 400)
-                try:
-                    size, unchanged = server.store.write_needle(fid.volume_id, n)
-                except NeedleNotFound:
-                    return self._json({"error": "volume not found"}, 404)
-                except (VolumeReadOnly, CookieMismatch) as e:
-                    return self._json({"error": str(e)}, 409)
+                if reply is None:
+                    n, fname, err = write_path.build_upload_needle(
+                        fid,
+                        q,
+                        body,
+                        self.headers,
+                        url_filename,
+                        server.fix_jpg_orientation,
+                    )
+                    if err is not None:
+                        return self._json({"error": err}, 400)
+                    try:
+                        size, unchanged = server.store.write_needle(
+                            fid.volume_id, n
+                        )
+                    except NeedleNotFound:
+                        return self._json({"error": "volume not found"}, 404)
+                    except (VolumeReadOnly, CookieMismatch) as e:
+                        return self._json({"error": str(e)}, 409)
+                    reply = (
+                        b'{"name": %s, "size": %d, "eTag": "%s"}'
+                        % (_esc_json(fname).encode(), size, n.etag().encode())
+                    )
                 if q.get("type") != "replicate":
                     err = server._replicate(fid, q, "POST", body, self.headers)
                     if err:
                         return self._json({"error": err}, 500)
-                self._reply(
-                    201,
-                    b'{"name": %s, "size": %d, "eTag": "%s"}'
-                    % (_esc_json(fname).encode(), size, n.etag().encode()),
-                    _JSON_HDR,
-                )
+                self._reply(201, reply, _JSON_HDR)
 
             def do_DELETE(self):
                 fid, q, _fn, _ext = self._parse_fid()

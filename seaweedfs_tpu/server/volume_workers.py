@@ -52,12 +52,10 @@ from seaweedfs_tpu.storage.volume import (
 from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.util.httpd import (
     JSON_HDR,
-    FastRequestMixin,
+    FastHandler,
     WeedHTTPServer,
     fast_query,
 )
-
-from http.server import BaseHTTPRequestHandler
 
 _HOP_HEADERS = {
     "connection",
@@ -213,6 +211,28 @@ class SharedReadVolume:
             self._replayed = self._vol.nm.index_file_size()
             return size
 
+    def native_post(
+        self, fid, q, body, headers, url_filename, precheck=None
+    ) -> bytes | None:
+        """The C one-pass POST (write_path.try_native_post) under this
+        wrapper's refresh + release-precheck discipline. None = take
+        the Python slow path (same bytes either way)."""
+        from seaweedfs_tpu.server import write_path
+
+        with self._lock:
+            if precheck is not None and not precheck():
+                raise VolumeReleased(self.vid)
+            self._refresh()
+            reply = write_path.try_native_post(
+                self._vol, fid, q, body, headers, url_filename,
+                fix_jpg_orientation=True,
+            )
+            if reply is not None:
+                # own append is already in the map: advance the replay
+                # cursor past it (same bookkeeping as write_needle)
+                self._replayed = self._vol.nm.index_file_size()
+            return reply
+
     @property
     def volume(self):
         return self._vol
@@ -304,11 +324,7 @@ class VolumeReadWorker:
     def _make_handler(self):
         worker = self
 
-        class Handler(FastRequestMixin, BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *args):
-                pass
+        class Handler(FastHandler):
 
             def do_GET(self):
                 path, _, qs = self.path.partition("?")
@@ -412,52 +428,70 @@ class VolumeReadWorker:
                 v = worker._find_volume(vid)
                 if v is None:
                     return False  # not on disk yet / mid-commit: lead's
-                if method == "DELETE":
-                    return self._owned_delete(v, fid, q)
-                n, fname, err = write_path.build_upload_needle(
-                    fid, q, body, self.headers, url_filename,
-                    fix_jpg_orientation=True,
-                )
-                if err is not None:
-                    self._json({"error": err}, 400)
-                    return True
+
                 def still_owned():
+                    # ONE ownership predicate for the delete, native,
+                    # and Python write paths — they must never diverge
                     with worker._release_lock:
                         return vid not in worker.released
 
+                if method == "DELETE":
+                    return self._owned_delete(v, fid, q, still_owned)
+                # C hot loop first; Python fallback below — both
+                # branches converge on the ONE replicate-then-reply
+                # tail (same shape as the lead's do_POST)
                 try:
-                    size, unchanged = v.write_needle(n, precheck=still_owned)
+                    reply = v.native_post(
+                        fid, q, body, self.headers, url_filename,
+                        precheck=still_owned,
+                    )
                 except VolumeReleased:
                     return False  # re-route to the lead (new owner)
                 except (CookieMismatch, ValueError) as e:
+                    # same contract as the Python branch below: a
+                    # refresh/reopen failure (CorruptNeedle is a
+                    # ValueError) answers 409, never a dropped socket
                     self._json({"error": str(e)}, 409)
                     return True
                 except OSError:
                     worker._drop_volume(vid)
                     return False
+                if reply is None:
+                    n, fname, err = write_path.build_upload_needle(
+                        fid, q, body, self.headers, url_filename,
+                        fix_jpg_orientation=True,
+                    )
+                    if err is not None:
+                        self._json({"error": err}, 400)
+                        return True
+                    try:
+                        size, unchanged = v.write_needle(
+                            n, precheck=still_owned
+                        )
+                    except VolumeReleased:
+                        return False  # re-route to the lead (new owner)
+                    except (CookieMismatch, ValueError) as e:
+                        self._json({"error": str(e)}, 409)
+                        return True
+                    except OSError:
+                        worker._drop_volume(vid)
+                        return False
+                    import json as _json
+
+                    reply = (
+                        b'{"name": %s, "size": %d, "eTag": "%s"}'
+                        % (_json.dumps(fname).encode(), size, n.etag().encode())
+                    )
                 if q.get("type") != "replicate":
                     err = self._replicate_owned(v, fid, q, body)
                     if err:
                         self._json({"error": err}, 500)
                         return True
-                import json as _json
-
-                self.fast_reply(
-                    201,
-                    (
-                        b'{"name": %s, "size": %d, "eTag": "%s"}'
-                        % (_json.dumps(fname).encode(), size, n.etag().encode())
-                    ),
-                    JSON_HDR,
-                )
+                self.fast_reply(201, reply, JSON_HDR)
                 return True
 
-            def _owned_delete(self, v, fid, q) -> bool:
+            def _owned_delete(self, v, fid, q, still_owned) -> bool:
                 n = Needle(cookie=fid.cookie, id=fid.key)
-                def still_owned():
-                    with worker._release_lock:
-                        return fid.volume_id not in worker.released
-
                 try:
                     existing = v.read_needle(fid.key, cookie=fid.cookie)
                     if existing.is_chunked_manifest():

@@ -15,8 +15,118 @@ import json
 import os
 import time
 
+from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.file_id import FileId
-from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.needle import (
+    FLAG_HAS_LAST_MODIFIED_DATE,
+    FLAG_HAS_PAIRS,
+    FLAG_IS_CHUNK_MANIFEST,
+    Needle,
+)
+
+try:  # the one-pass C POST hot loop (native/post.c); None = Python only
+    from seaweedfs_tpu.native import needle_ext as _needle_ext
+except ImportError:  # pragma: no cover - no compiler on host
+    _needle_ext = None
+if _needle_ext is not None and not hasattr(_needle_ext, "post"):
+    _needle_ext = None  # stale artifact without the post entry
+
+# kill switch for A/B measurement and the byte-identity tests
+# (WEED_NATIVE_POST=0 forces every write through the Python path)
+NATIVE_POST_ENABLED = os.environ.get("WEED_NATIVE_POST", "1") != "0"
+
+
+def try_native_post(
+    v,
+    fid: FileId,
+    q: dict,
+    body: bytes,
+    headers,
+    url_filename: str = "",
+    fix_jpg_orientation: bool = False,
+) -> bytes | None:
+    """The volume POST hot path as ONE native call: payload extraction
+    (multipart or raw) → needle assembly → CRC32-C → pwrite at the
+    append cursor → 201 reply bytes, all with the GIL released
+    (native/post.c). Returns the reply body, or None when the request
+    needs the pure-Python path (build_upload_needle + write_needle) —
+    which produces byte-identical .dat/.idx/reply output for everything
+    the C path does handle (tests/test_native_post.py).
+
+    Caller contract: `v` is a storage.volume.Volume (or None). The
+    needle map update + .idx append stay in Python (they are dict/16-
+    byte-append cheap); everything O(body) is the C pass."""
+    if (
+        _needle_ext is None
+        or not NATIVE_POST_ENABLED
+        or v is None
+        or getattr(v, "_fd", None) is None
+        or v.read_only
+        or v.version not in (2, 3)
+        or v.ttl.count != 0  # volume-level TTL injection: Python path
+        or q.get("ttl")  # per-needle TTL parse: Python path
+    ):
+        return None
+    base_flags = FLAG_HAS_LAST_MODIFIED_DATE
+    if q.get("cm") == "true":
+        base_flags |= FLAG_IS_CHUNK_MANIFEST
+    pairs = b""
+    pair_map = {
+        k[8:]: val
+        for k, val in headers.items()
+        if k.lower().startswith("seaweed-")
+    }
+    if pair_map:
+        pairs = json.dumps(pair_map).encode()
+        if len(pairs) >= 65536:
+            pairs = b""  # dropped silently, as build_upload_needle does
+        else:
+            base_flags |= FLAG_HAS_PAIRS
+    try:
+        last_modified = int(q.get("ts", "") or 0) or int(time.time())
+    except ValueError:
+        last_modified = int(time.time())
+    ctype = headers.get("content-type", "") or ""
+    raw_gz = headers.get("content-encoding", "").lower() == "gzip"
+    try:
+        ctype_b = ctype.encode("latin-1")
+        q_name_b = (q.get("filename", "") or "").encode("ascii")
+        url_name_b = (url_filename or "").encode("ascii")
+    except UnicodeEncodeError:
+        return None  # non-latin1 header / non-ascii names: Python path
+    with v._lock:
+        if v.read_only:
+            return None
+        if v.nm.get(fid.key) is not None:
+            return None  # overwrite/dedup/cookie semantics: Python path
+        offset = v._append_end
+        if offset % t.NEEDLE_PADDING_SIZE:
+            return None  # realign via the Python append path
+        append_at_ns = v._now_ns()
+        res = _needle_ext.post(
+            body,
+            ctype_b,
+            1 if raw_gz else 0,
+            q_name_b,
+            url_name_b,
+            pairs,
+            base_flags,
+            fid.cookie,
+            fid.key,
+            v.version,
+            last_modified,
+            append_at_ns,
+            v._fd,
+            offset,
+            1 if fix_jpg_orientation else 0,
+        )
+        if res is None:
+            return None
+        reply, total, size = res
+        v._append_end = offset + total
+        v.last_append_at_ns = append_at_ns
+        v.nm.put(fid.key, t.offset_to_units(offset), size)
+        return reply
 
 
 def build_upload_needle(

@@ -14,7 +14,154 @@ command/scaffold.go:33-45) live in SCAFFOLD_TEMPLATES for the CLI.
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:
+    try:
+        import tomli as tomllib  # type: ignore[no-redef]
+    except ModuleNotFoundError:  # Python < 3.11, no tomli: mini parser
+        class tomllib:  # type: ignore[no-redef]
+            """Fallback reader for the TOML subset this repo's configs
+            use ([dotted.sections], string/int/float/bool scalars,
+            arrays — including multi-line and quoted elements with
+            commas — and # comments). Python 3.11+ ships tomllib and
+            never reaches this; on 3.10 images every subcommand that
+            loads a *.toml (security, master maintenance, notification,
+            replication) would otherwise die at import. Syntax this
+            subset does not cover raises ValueError LOUDLY — silently
+            misloading a security whitelist would be far worse than
+            the crash this class exists to avoid."""
+
+            @staticmethod
+            def _scalar(tok: str):
+                tok = tok.strip()
+                if tok.startswith('"') and tok.endswith('"') and len(tok) >= 2:
+                    return tok[1:-1].encode("raw_unicode_escape").decode(
+                        "unicode_escape"
+                    )
+                if tok.startswith("'") and tok.endswith("'") and len(tok) >= 2:
+                    return tok[1:-1]
+                if tok in ("true", "false"):
+                    return tok == "true"
+                try:
+                    return int(tok, 0)
+                except ValueError:
+                    pass
+                try:
+                    return float(tok)
+                except ValueError:
+                    pass
+                if tok.startswith(("[", "{", '"', "'")):
+                    raise ValueError(
+                        f"unsupported TOML value {tok!r} (fallback parser; "
+                        "install Python 3.11+ or tomli for full TOML)"
+                    )
+                return tok  # bare token: surface as string
+
+            @staticmethod
+            def _strip_comment(line: str) -> str:
+                out = []
+                quote = None
+                for ch in line:
+                    if quote:
+                        if ch == quote:
+                            quote = None
+                    elif ch in "\"'":
+                        quote = ch
+                    elif ch == "#":
+                        break
+                    out.append(ch)
+                return "".join(out).strip()
+
+            @staticmethod
+            def _split_elems(inner: str) -> list[str]:
+                """Quote-aware top-level comma split of an array body."""
+                elems, buf, quote = [], [], None
+                for ch in inner:
+                    if quote:
+                        buf.append(ch)
+                        if ch == quote:
+                            quote = None
+                    elif ch in "\"'":
+                        quote = ch
+                        buf.append(ch)
+                    elif ch == ",":
+                        elems.append("".join(buf))
+                        buf = []
+                    else:
+                        buf.append(ch)
+                if quote:
+                    raise ValueError("unterminated string in TOML array")
+                elems.append("".join(buf))
+                return [e for e in (e.strip() for e in elems) if e]
+
+            @classmethod
+            def load(cls, f) -> dict:
+                tree: dict = {}
+                node = tree
+                lines = f.read().decode("utf-8").splitlines()
+                i = 0
+                while i < len(lines):
+                    line = cls._strip_comment(lines[i])
+                    i += 1
+                    if not line:
+                        continue
+                    if line.startswith("[") and line.endswith("]"):
+                        node = tree
+                        for part in line[1:-1].strip().split("."):
+                            node = node.setdefault(part.strip(), {})
+                        continue
+                    key, sep, val = line.partition("=")
+                    if not sep:
+                        raise ValueError(
+                            f"unsupported TOML line {line!r} (fallback "
+                            "parser; install Python 3.11+ or tomli)"
+                        )
+                    key = key.strip()
+                    target = node
+                    if key.startswith(('"', "'")):
+                        key = key.strip('"').strip("'")
+                    else:
+                        # bare dotted keys nest, like real TOML
+                        # (signing.key = ... under [jwt] must land at
+                        # jwt.signing.key, not a literal 'signing.key')
+                        parts = [p.strip() for p in key.split(".")]
+                        for part in parts[:-1]:
+                            target = target.setdefault(part, {})
+                        key = parts[-1]
+                    val = val.strip()
+                    if val.startswith('"""'):
+                        # multi-line basic string (master.toml's
+                        # maintenance scripts): raw until closing """
+                        body = val[3:]
+                        while '"""' not in body:
+                            if i >= len(lines):
+                                raise ValueError(
+                                    f"unterminated TOML string for {key!r}"
+                                )
+                            body += "\n" + lines[i]
+                            i += 1
+                        target[key] = body[: body.index('"""')].lstrip("\n")
+                        continue
+                    if val.startswith("["):
+                        # multi-line arrays: accumulate until the
+                        # closing bracket (quotes respected by the
+                        # comment stripper; nesting unsupported → loud)
+                        while not val.endswith("]"):
+                            if i >= len(lines):
+                                raise ValueError(
+                                    f"unterminated TOML array for {key!r}"
+                                )
+                            val += " " + cls._strip_comment(lines[i])
+                            i += 1
+                        inner = val[1:-1].strip().rstrip(",")
+                        target[key] = [
+                            cls._scalar(t) for t in cls._split_elems(inner)
+                        ]
+                    else:
+                        target[key] = cls._scalar(val)
+                return tree
 
 
 CONFIG_SEARCH_DIRS = (".", "~/.seaweedfs_tpu", "/etc/seaweedfs_tpu")

@@ -13,7 +13,7 @@ somaxconn; a deep backlog restores that behavior.
 from __future__ import annotations
 
 import socket
-from http.server import ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer  # FastRequestMixin drives these through serve_connection
 from urllib.parse import unquote_plus
 
 
@@ -47,8 +47,8 @@ class FastHeaders(dict):
 
     Supports the `.get(name)` / `in` / `[name]` access the data-plane
     handlers use; deliberately NOT an email.message.Message (no MIME
-    machinery — that parser is where BaseHTTPRequestHandler burns ~40%
-    of a small-request's CPU)."""
+    machinery — that parser is where the stdlib handler stack burns
+    ~40% of a small-request's CPU)."""
 
     def get(self, key, default=None):
         # exact-hit first: hot call sites already pass lowercase names,
@@ -73,7 +73,7 @@ class FastHeaders(dict):
 class FastRequestMixin:
     """Marks a handler as data-plane: WeedHTTPServer drives it through
     the mini request loop (serve_connection) instead of the stdlib
-    socketserver/BaseHTTPRequestHandler machinery, and fast_reply
+    socketserver/handler-per-request machinery, and fast_reply
     writes whole responses (status+headers+body) in ONE buffer/syscall
     — under `weed benchmark` the stdlib's email.feedparser header
     parsing plus send_header-per-line writing cost more than the
@@ -113,22 +113,38 @@ _REASON = {
     202: b"Accepted",
     204: b"No Content",
     206: b"Partial Content",
+    207: b"Multi-Status",
     301: b"Moved Permanently",
     302: b"Found",
     304: b"Not Modified",
     400: b"Bad Request",
     401: b"Unauthorized",
+    403: b"Forbidden",
     404: b"Not Found",
     405: b"Method Not Allowed",
     409: b"Conflict",
+    411: b"Length Required",
     413: b"Payload Too Large",
     416: b"Range Not Satisfiable",
     429: b"Too Many Requests",
     431: b"Request Header Fields Too Large",
     500: b"Internal Server Error",
+    501: b"Not Implemented",
     502: b"Bad Gateway",
     503: b"Service Unavailable",
 }
+
+
+class FastHandler(FastRequestMixin, BaseHTTPRequestHandler):
+    """The one handler base every serving path derives from: marked
+    with FastRequestMixin so WeedHTTPServer drives it through the mini
+    request loop (serve_connection), with the quiet log and HTTP/1.1
+    keep-alive every daemon wants. Subclasses just define do_*."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # the data plane logs via wlog
+        pass
 
 
 class _BufReader:
@@ -244,17 +260,16 @@ def _dispatch_table(handler_cls: type) -> dict:
 
 def serve_connection(sock, addr, server, handler_cls) -> None:
     """The mini per-connection request loop: replaces the
-    socketserver → BaseHTTPRequestHandler.handle → handle_one_request →
-    parse_request stack on the data plane. One handler object per
-    connection (no per-request construction), the whole request head
-    read and parsed out of one buffer (no per-header readline), dict
-    dispatch instead of getattr-per-request. The handler classes are
-    unchanged — this drives the same do_GET/do_POST/... methods with
-    the same surface (path/command/headers/rfile/wfile/client_address/
-    close_connection, fast_reply, and BaseHTTPRequestHandler's
-    send_response/send_header/end_headers/send_error for the slow
-    paths)."""
-    h = handler_cls.__new__(handler_cls)  # skip BaseHTTPRequestHandler.__init__
+    socketserver → handle → handle_one_request → parse_request stack
+    on every serving path. One handler object per connection (no
+    per-request construction), the whole request head read and parsed
+    out of one buffer (no per-header readline), dict dispatch instead
+    of getattr-per-request. The handler classes are unchanged — this
+    drives the same do_GET/do_POST/... methods with the same surface
+    (path/command/headers/rfile/wfile/client_address/close_connection,
+    fast_reply, and the inherited stdlib send_response/send_header/
+    end_headers/send_error for the slow paths)."""
+    h = handler_cls.__new__(handler_cls)  # skip the stdlib per-request __init__
     h.server = server
     h.client_address = addr
     h.connection = sock
@@ -370,10 +385,9 @@ class WeedHTTPServer(ThreadingHTTPServer):
         return sock, addr
 
     def finish_request(self, request, client_address):
-        # FastRequestMixin handlers (volume, master, workers, filer)
-        # ride the mini request loop; plain BaseHTTPRequestHandler
-        # handlers (s3, webdav — they depend on stdlib header/Message
-        # semantics) keep the stdlib per-request machinery
+        # every in-repo serving path carries FastRequestMixin and rides
+        # the mini request loop (volume, master, workers, filer, s3,
+        # webdav); the hasattr gate only guards external/test handlers
         if hasattr(self.RequestHandlerClass, "fast_reply"):
             serve_connection(
                 request, client_address, self, self.RequestHandlerClass

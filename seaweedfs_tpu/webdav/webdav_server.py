@@ -18,12 +18,11 @@ import urllib.error
 import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import grpc
 
 from seaweedfs_tpu.pb import filer_pb2 as fpb
-from seaweedfs_tpu.util.httpd import WeedHTTPServer
+from seaweedfs_tpu.util.httpd import FastHandler, WeedHTTPServer
 from seaweedfs_tpu.pb import rpc
 
 DAV_NS = "DAV:"
@@ -41,7 +40,7 @@ class WebDavServer:
         self.host = host
         self.port = port
         self.root = root.rstrip("/")
-        self._http_server: ThreadingHTTPServer | None = None
+        self._http_server: WeedHTTPServer | None = None
         self._channel: grpc.Channel | None = None
         self._lock = threading.Lock()
 
@@ -100,20 +99,13 @@ class WebDavServer:
     def _handler_class(self):
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *args):
-                pass
+        class Handler(FastHandler):
+            # DAV verbs (PROPFIND/MKCOL/MOVE/...) ride the mini request
+            # loop's dict dispatch exactly like GET/PUT — the loop's
+            # do_* table is built from dir(handler), not a verb list
 
             def _send(self, status: int, body: bytes = b"", headers: dict | None = None):
-                self.send_response(status)
-                for k, v in (headers or {}).items():
-                    self.send_header(k, v)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                if body and self.command != "HEAD":
-                    self.wfile.write(body)
+                self.fast_reply(status, body, headers)
 
             def _dav_path(self) -> str:
                 return urllib.parse.unquote(urllib.parse.urlparse(self.path).path)

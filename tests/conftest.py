@@ -45,3 +45,18 @@ def reference_root() -> pathlib.Path:
     if not REFERENCE_ROOT.exists():
         pytest.skip("reference checkout not available")
     return REFERENCE_ROOT
+
+
+@pytest.fixture(scope="session")
+def native_post_toolchain():
+    """C-path guard: tests that exercise the native write hot loop
+    (native/post.c via needle_ext.post) SKIP — never error — on hosts
+    without a working C toolchain, where the loader returns None and
+    production falls back to the pure-Python path those same tests
+    compare against."""
+    from seaweedfs_tpu.server import write_path
+
+    if write_path._needle_ext is None or not hasattr(
+        write_path._needle_ext, "post"
+    ):
+        pytest.skip("no C toolchain: native needle_ext.post unavailable")

@@ -193,10 +193,17 @@ class TestConfig:
             load_config("nosuch", required=True, search_dirs=(str(tmp_path),))
 
     def test_scaffold_templates_parse(self, tmp_path):
-        import tomllib
+        import io
+
+        # the stdlib parser where the image has one, else the
+        # util/config fallback reader the daemons actually run on
+        from seaweedfs_tpu.util.config import tomllib
 
         for name, text in SCAFFOLD_TEMPLATES.items():
-            tomllib.loads(text)  # all templates must be valid TOML
+            # all templates must be valid TOML for whichever parser
+            # load_config will use on this image
+            tree = tomllib.load(io.BytesIO(text.encode()))
+            assert isinstance(tree, dict) and tree, name
 
     def test_sub_tree(self):
         cfg = Configuration({"sink": {"filer": {"enabled": True}}}, env={})

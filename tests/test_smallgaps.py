@@ -25,9 +25,12 @@ def free_port() -> int:
 
 
 def _make_certs(tmp_path):
-    """Self-signed CA + a server/client cert signed by it."""
+    """Self-signed CA + a server/client cert signed by it. Skips (not
+    errors) on images without the cryptography package — the mTLS code
+    under test only ever runs where certs exist."""
     import datetime
 
+    pytest.importorskip("cryptography", reason="no cryptography package")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
