@@ -1,0 +1,146 @@
+"""weedlint: the repo-native static-analysis & sanitizer plane.
+
+The Go reference inherited `go vet` and the `-race` detector for free;
+this Python/C port lost both exactly as it grew the things they exist
+to catch — ~70 lock sites across the threaded volume/scrub/repair/
+replication planes, and ~900 lines of hand-written C parsing
+adversarial multipart bytes with the GIL released. This package is the
+replacement tooling, purpose-built for THIS codebase's invariants
+rather than generic style lint:
+
+  lockorder   static lock-acquisition graph over the whole package
+              (with-blocks, explicit acquire/release, one-level
+              interprocedural closure incl. callback parameters);
+              reports cycles as deadlock candidates, plus writes to
+              lock-guarded attributes reached without the guard
+  hotloop     blocking calls (sleep, subprocess, socket ops without a
+              timeout, unbounded reads) reachable from the data-plane
+              dispatch paths (FastHandler do_* / serve_connection)
+  ctier       the C shim tier compiled under -Wall -Wextra -Werror
+              (the compiler is the lint tier for code no Python tool
+              can see into), sanitizer build modes, and structural
+              GIL-release checks on the hot entry points
+  witness     the DYNAMIC lock-order witness: a pytest plugin that
+              wraps threading.Lock/RLock allocation and fails the run
+              on any runtime acquisition-order inversion — our
+              `-race`-style complement for lock orders that only
+              materialize through callbacks and cross-object calls
+              the static pass cannot resolve
+  fuzz_post   structured fuzzer hammering the C multipart/POST parser
+              against the byte-identical Python fallback; diverging
+              or crashing inputs persist to tests/corpus/
+
+CLI: `python -m seaweedfs_tpu.analysis` (exit 0 = clean tree).
+
+Suppression policy: a finding is silenced ONLY by an inline
+
+    # weedlint: ignore[rule] — reason
+
+comment on the flagged line (or the line directly above it). The
+reason is mandatory; an ignore without one is itself a finding
+(rule `bare-ignore`), so the tree can never accumulate unexplained
+silence. docs/ANALYSIS.md is the checker catalog.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+
+PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(PACKAGE_ROOT)
+
+# `# weedlint: ignore[rule-a,rule-b] — why this is fine`
+_IGNORE_RE = re.compile(
+    r"#\s*weedlint:\s*ignore\[([a-z0-9_,\s-]+)\]\s*(?:[—:-]+\s*(.*))?"
+)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Suppressions:
+    """Parsed `# weedlint: ignore[...]` comments for one file."""
+
+    # line -> set of rules silenced at that line
+    by_line: dict[int, set[str]] = field(default_factory=dict)
+    # ignores missing the mandatory reason (line, rules)
+    bare: list[tuple[int, str]] = field(default_factory=list)
+
+
+def scan_suppressions(source: str) -> Suppressions:
+    sup = Suppressions()
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _IGNORE_RE.search(text)
+        if m is None:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = (m.group(2) or "").strip()
+        if len(reason) < 3:
+            sup.bare.append((i, ",".join(sorted(rules))))
+            continue
+        if text.lstrip().startswith("#"):
+            # a comment on its OWN line silences only the statement
+            # below it — an inline ignore must never bleed onto the
+            # next line, or an adjacent unannotated finding ships
+            # under a neighbor's justification
+            sup.by_line.setdefault(i + 1, set()).update(rules)
+        else:
+            sup.by_line.setdefault(i, set()).update(rules)
+    return sup
+
+
+def iter_py_files(root: str | None = None):
+    """Yield (abs_path, rel_path) for every package .py file."""
+    root = root or PACKAGE_ROOT
+    base = os.path.dirname(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+        ]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                abs_path = os.path.join(dirpath, name)
+                yield abs_path, os.path.relpath(abs_path, base)
+
+
+def apply_suppressions(
+    findings: list[Finding], sources: dict[str, str]
+) -> tuple[list[Finding], list[Finding]]:
+    """(kept, suppressed). Bare ignores surface as `bare-ignore`
+    findings in `kept` — an unjustified suppression never makes the
+    tree greener."""
+    sup_cache: dict[str, Suppressions] = {}
+    kept: list[Finding] = []
+    suppressed: list[Finding] = []
+    for path, src in sources.items():
+        sup_cache[path] = scan_suppressions(src)
+    for f in findings:
+        sup = sup_cache.get(f.path)
+        rules = sup.by_line.get(f.line, set()) if sup else set()
+        if f.rule in rules or "all" in rules:
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    for path, sup in sup_cache.items():
+        for line, rules in sup.bare:
+            kept.append(
+                Finding(
+                    "bare-ignore",
+                    path,
+                    line,
+                    f"weedlint ignore[{rules}] without a reason — the "
+                    f"justification is mandatory",
+                )
+            )
+    return kept, suppressed

@@ -1,0 +1,150 @@
+"""weedlint CLI: `python -m seaweedfs_tpu.analysis`.
+
+Runs every checker over the package tree and exits 0 only when the
+tree is clean (no unsuppressed findings — and no suppression missing
+its mandatory reason). This is the same gate `bench.py --check` and
+`make lint` drive; docs/ANALYSIS.md is the catalog.
+
+    python -m seaweedfs_tpu.analysis                # all checkers
+    python -m seaweedfs_tpu.analysis --rules lock-order,hot-loop
+    python -m seaweedfs_tpu.analysis --json         # machine-readable
+    python -m seaweedfs_tpu.analysis --fuzz 200     # + fuzz smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from seaweedfs_tpu.analysis import Finding, apply_suppressions
+
+# rule families, in the order they run; --rules filters by prefix,
+# e.g. `--rules lock-order`. lock-order and unguarded-write are
+# separate families that share one index walk — selecting either
+# runs the walk once and keeps only the selected family's findings
+_FAMILIES = ("lock-order", "unguarded-write", "hot-loop", "c")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m seaweedfs_tpu.analysis")
+    ap.add_argument(
+        "--rules",
+        default="",
+        help="comma-separated rule prefixes to run (default: all)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    ap.add_argument(
+        "--fuzz",
+        type=int,
+        default=0,
+        metavar="N",
+        help="also run N iterations of the C-vs-Python POST fuzzer",
+    )
+    args = ap.parse_args(argv)
+    wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
+    for w in wanted:
+        if not any(
+            w.startswith(f) or f.startswith(w) for f in _FAMILIES
+        ):
+            ap.error(
+                f"--rules {w!r} matches no checker family "
+                f"{list(_FAMILIES)}"
+            )
+
+    def active(family: str) -> bool:
+        # both directions: `--rules lock-order` selects the family,
+        # and `--rules hot-loop-no-timeout` (a full rule name) selects
+        # its `hot-loop` family rather than silently selecting nothing
+        return not wanted or any(
+            w.startswith(family) or family.startswith(w) for w in wanted
+        )
+
+    t0 = time.time()
+    findings: list[Finding] = []
+    index = None
+
+    if active("lock-order") or active("unguarded-write"):
+        from seaweedfs_tpu.analysis import lockorder
+
+        lock_findings, index = lockorder.check()
+        if active("lock-order"):
+            findings += [f for f in lock_findings if f.rule == "lock-order"]
+        if active("unguarded-write"):
+            findings += [
+                f for f in lock_findings if f.rule == "unguarded-write"
+            ]
+    elif active("hot-loop"):
+        # hot-loop alone only needs the package index, not the full
+        # lock-graph/cycle/unguarded-write analyses
+        from seaweedfs_tpu.analysis import lockorder
+
+        index = lockorder.build_index()
+    if active("hot-loop"):
+        from seaweedfs_tpu.analysis import hotloop
+
+        hot_findings, index = hotloop.check(index=index)
+        findings += hot_findings
+    if active("c"):
+        from seaweedfs_tpu.analysis import ctier
+
+        findings += ctier.check()
+
+    if index is None:
+        # `--rules c` alone never walked the package, but the bare-ignore
+        # contract (every suppression carries a reason) must hold on
+        # every invocation path, so build the source index regardless
+        from seaweedfs_tpu.analysis import lockorder
+
+        index = lockorder.build_index()
+    kept, suppressed = apply_suppressions(findings, index.sources)
+
+    fuzz_report = None
+    if args.fuzz > 0:
+        from seaweedfs_tpu.analysis import fuzz_post
+
+        fuzz_report = fuzz_post.run(iterations=args.fuzz)
+        for div in fuzz_report.divergences:
+            kept.append(
+                Finding(
+                    "fuzz-divergence",
+                    "seaweedfs_tpu/native/post.c",
+                    1,
+                    f"C and Python POST paths diverged: {div}",
+                )
+            )
+
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    if args.json:
+        out = {
+            "findings": [f.__dict__ for f in kept],
+            "suppressed": [f.__dict__ for f in suppressed],
+            "elapsed_s": round(time.time() - t0, 2),
+            "ok": not kept,
+        }
+        if fuzz_report is not None:
+            out["fuzz"] = fuzz_report.to_dict()
+        print(json.dumps(out, indent=2))
+    else:
+        for f in kept:
+            print(f.format())
+        note = (
+            f"weedlint: {len(kept)} finding(s), "
+            f"{len(suppressed)} suppressed (justified), "
+            f"{time.time() - t0:.1f}s"
+        )
+        if fuzz_report is not None:
+            note += (
+                f"; fuzz {fuzz_report.iterations} iters, "
+                f"{fuzz_report.handled} C-handled, "
+                f"{len(fuzz_report.divergences)} divergence(s)"
+            )
+        print(note)
+    return 1 if kept else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,169 @@
+"""C-tier hardening checks: the compiler as the shims' lint pass.
+
+The native shims (crc32c.c, gf256.c, needle.c, post.c behind the
+needle_ext.c binding) are the one part of the tree no Python-level
+tool can see into — and the part that parses adversarial multipart
+bytes with the GIL released. Three checks:
+
+  c-warnings     every shim must compile clean under
+                 -Wall -Wextra -Werror with the system compiler
+                 (the same flags _build.py now ships with, so a
+                 warning can never reach production silently — it
+                 fails the build into the pure-Python fallback);
+                 with WEED_NATIVE_SAN set, the sanitizer variant of
+                 the build is what gets exercised
+  gil-release    the extension's hot entry points (encode's big-
+                 payload branch, decode's big-payload CRC, the whole
+                 post span) must wrap their C work in
+                 Py_BEGIN/END_ALLOW_THREADS — losing one of those
+                 re-serializes every handler thread behind memcpy+CRC
+  no-compiler    reported as a note, never a failure: hosts without a
+                 toolchain run the pure-Python fallbacks and have no C
+                 attack surface to lint
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+import tempfile
+
+from seaweedfs_tpu.analysis import Finding
+from seaweedfs_tpu.native import _build
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+
+# (source, needs_python_includes); needle.c and post.c compile as part
+# of the needle_ext.c translation unit, exactly as production builds them
+_UNITS = (
+    ("crc32c.c", False),
+    ("gf256.c", False),
+    ("needle_ext.c", True),
+)
+
+
+def _compiler() -> str | None:
+    for cc in _build._COMPILERS:
+        try:
+            proc = subprocess.run(
+                [cc, "--version"], capture_output=True, timeout=10
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if proc.returncode == 0:
+            return cc
+    return None
+
+
+def _rel(name: str) -> str:
+    return os.path.join("seaweedfs_tpu", "native", name)
+
+
+def check_warnings() -> list[Finding]:
+    cc = _compiler()
+    if cc is None:
+        return []  # no toolchain: pure-Python fallbacks serve, nothing to lint
+    paths = sysconfig.get_paths()
+    py_inc = tuple(
+        dict.fromkeys((paths["include"], paths["platinclude"]))
+    )
+    findings: list[Finding] = []
+    for src, needs_py in _UNITS:
+        out = tempfile.NamedTemporaryFile(suffix=".so", delete=False)
+        out.close()
+        # the shared helper IS the production command line — the lint
+        # tier compiles exactly what load_ext ships
+        cmd = _build.compile_cmd(
+            cc,
+            os.path.join(_NATIVE_DIR, src),
+            out.name,
+            includes=py_inc if needs_py else (),
+        )
+        try:
+            proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            findings.append(
+                Finding("c-warnings", _rel(src), 1, f"compile failed: {e}")
+            )
+            continue
+        finally:
+            try:
+                os.unlink(out.name)
+            except OSError:
+                pass
+        if proc.returncode != 0:
+            # surface the first few diagnostic lines with their own
+            # file:line so the finding is actionable
+            diag = proc.stderr.decode("utf-8", "replace")
+            lines = [
+                ln
+                for ln in diag.splitlines()
+                if ": error:" in ln or ": warning:" in ln
+            ][:8] or diag.splitlines()[:4]
+            findings.append(
+                Finding(
+                    "c-warnings",
+                    _rel(src),
+                    1,
+                    f"{cc} -Wall -Wextra -Werror"
+                    + (f" [{mode}]" if mode else "")
+                    + " rejected the unit: "
+                    + " | ".join(ln.strip() for ln in lines),
+                )
+            )
+    return findings
+
+
+# entry point -> marker that must appear between its definition and the
+# next top-level definition (structural, not a parse: the shims are
+# plain C with one function per concern)
+_GIL_SPANS = (
+    ("py_encode", "needle_ext.c"),
+    ("py_decode", "needle_ext.c"),
+    ("py_post", "needle_ext.c"),
+)
+
+
+def check_gil_release() -> list[Finding]:
+    findings: list[Finding] = []
+    path = os.path.join(_NATIVE_DIR, "needle_ext.c")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+    except OSError:
+        return findings
+    for fn, src_name in _GIL_SPANS:
+        start = source.find(f"*{fn}(")
+        if start < 0:
+            findings.append(
+                Finding(
+                    "gil-release",
+                    _rel(src_name),
+                    1,
+                    f"hot entry point {fn}() not found in {src_name}",
+                )
+            )
+            continue
+        # the function body runs to the next PyObject * definition
+        end = source.find("static PyObject *", start + 1)
+        body = source[start : end if end > 0 else len(source)]
+        if "Py_BEGIN_ALLOW_THREADS" not in body:
+            line = source[:start].count("\n") + 1
+            findings.append(
+                Finding(
+                    "gil-release",
+                    _rel(src_name),
+                    line,
+                    f"{fn}() never releases the GIL: its C span "
+                    f"serializes every handler thread behind the "
+                    f"memcpy/CRC work",
+                )
+            )
+    return findings
+
+
+def check() -> list[Finding]:
+    return check_warnings() + check_gil_release()

@@ -1,0 +1,450 @@
+"""Structured fuzzer: the C POST parser vs the Python fallback.
+
+native/post.c re-implements multipart framing, part-header parsing,
+filename extraction, gzippability sniffing, and needle assembly — all
+of it byte-contracted to the pure-Python path (util/multipart.py +
+server/write_path.py): for any request the C path either DECLINES
+(and Python serves it) or produces the exact same .dat bytes, .idx
+bytes, and 201 reply. This driver generates adversarial requests —
+hostile boundaries, escaped/unterminated filenames, transfer
+encodings, embedded delimiter bytes, torn framing, NULs, non-ASCII —
+and checks that contract end-to-end through two real Volumes.
+
+Crash persistence: each candidate is written to the corpus directory
+BEFORE the C call and removed after a clean verdict, so a segfaulting
+input survives the dead process for triage (run the driver under
+WEED_NATIVE_SAN=asan + the LD_PRELOAD recipe from
+_build.asan_preload_env() to catch the heap corruption behind it).
+Diverging inputs persist as regression entries; tests/corpus/ holds
+the standing set and tests/test_fuzz_corpus.py sweeps identity over
+every entry on every tier-1 run.
+
+    python -m seaweedfs_tpu.analysis.fuzz_post --n 500 --seed 7
+    python -m seaweedfs_tpu.analysis.fuzz_post --seed-corpus  # refresh tests/corpus/
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import random
+import tempfile
+import types
+from dataclasses import dataclass, field
+
+from seaweedfs_tpu.analysis import REPO_ROOT
+
+DEFAULT_CORPUS = os.path.join(REPO_ROOT, "tests", "corpus")
+
+_BOUNDARY_CHARS = (
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789"
+    "'()+_,-./:=? "
+)
+
+_NAMES = [
+    "a.bin", "x.txt", "photo.jpg", "img.jpeg", "data", "deep/p/a.th",
+    "sp ace.bin", "q\"uote.bin", "unié.bin", ".hidden", "a..b.gz",
+    "ends.", "x" * 80 + ".bin", "back\\slash.bin", "semi;colon.bin",
+]
+
+_MIMES = [
+    "application/octet-stream", "text/plain", "Image/svg", "image/png",
+    "application/json", "application/weird+xml", "TEXT/PLAIN",
+    "application/x-script", "", "a" * 300,
+]
+
+
+def _payload(rng: random.Random, boundary: str) -> bytes:
+    kind = rng.randrange(7)
+    if kind == 0:
+        return rng.randbytes(rng.randrange(0, 700))
+    if kind == 1:  # compressible text (C must decline > 128 bytes)
+        return b"all work and no play " * rng.randrange(1, 40)
+    if kind == 2:  # embedded delimiter bytes mid-payload
+        filler = rng.randbytes(rng.randrange(3, 60))
+        return (
+            filler + b"\r\n--" + boundary.encode("latin-1", "replace")
+            + rng.choice([b"", b"--", b" junk", b"\ttail", b"\r\n"])
+            + filler
+        )
+    if kind == 3:  # gzip magic without gzip truth
+        return b"\x1f\x8b\x08\x00" + rng.randbytes(rng.randrange(0, 300))
+    if kind == 4:  # NUL-laced
+        return bytes(rng.randrange(0, 3) for _ in range(rng.randrange(1, 400)))
+    if kind == 5:  # exactly around the 128-byte compression threshold
+        return bytes([rng.randrange(200, 256)]) * rng.choice(
+            [127, 128, 129, 130]
+        )
+    return b""
+
+
+def _part_head(rng: random.Random, filename: str | None, mime: str | None
+               ) -> bytes:
+    lines: list[bytes] = []
+    disp = "form-data"
+    if rng.random() < 0.3:
+        disp += f'; name="{rng.choice(["file", "f", "field?*", ""])}"'
+    if filename is not None:
+        quote_style = rng.randrange(4)
+        if quote_style == 0:
+            disp += f'; filename="{filename}"'
+        elif quote_style == 1:
+            disp += f"; filename={filename.replace(' ', '_')}"
+        elif quote_style == 2:  # escaped quote inside quoted string
+            disp += f'; filename="pre\\"post.bin"'
+        else:  # unterminated quote
+            disp += f'; filename="{filename}'
+    key = rng.choice(
+        ["Content-Disposition", "content-disposition", "CONTENT-DISPOSITION",
+         "Content-Disposition "]
+    )
+    lines.append(f"{key}: {disp}".encode("latin-1", "replace"))
+    if mime is not None:
+        lines.append(f"Content-Type: {mime}".encode("latin-1", "replace"))
+    if rng.random() < 0.25:
+        lines.append(
+            b"Content-Transfer-Encoding: "
+            + rng.choice([b"binary", b"8bit", b"base64", b"quoted-printable",
+                          b"7bit", b"x-unknown"])
+        )
+    if rng.random() < 0.2:
+        lines.append(b"Content-Encoding: " + rng.choice([b"gzip", b"GZIP",
+                                                         b"identity"]))
+    if rng.random() < 0.15:  # hostile header shapes
+        lines.append(rng.choice([
+            b"no-colon-line",
+            b": empty-key",
+            b"X-Weird\t: tabbed",
+            b"X-Long: " + b"v" * 2000,
+        ]))
+    return b"\r\n".join(lines)
+
+
+def gen_case(rng: random.Random) -> dict:
+    """One adversarial request: {'q', 'headers', 'url_filename', 'body'}."""
+    case_kind = rng.randrange(10)
+    q: dict[str, str] = {"ts": str(1_700_000_000 + rng.randrange(1000))}
+    headers: dict[str, str] = {}
+    url_filename = rng.choice(["", "u.bin", "u.jpg", "ur l.txt"])
+    if rng.random() < 0.2:
+        q["filename"] = rng.choice(_NAMES)
+    if rng.random() < 0.1:
+        q["cm"] = "true"
+    if rng.random() < 0.15:
+        headers[f"seaweed-{rng.choice(['k', 'key2', 'UPPER'])}"] = (
+            rng.choice(["v", "v" * 50, "späce"])
+        )
+
+    if case_kind == 0:  # raw body, not multipart
+        body = _payload(rng, "x")
+        if rng.random() < 0.4:
+            headers["content-type"] = rng.choice(_MIMES)
+        if rng.random() < 0.2:
+            headers["content-encoding"] = "gzip"
+        return {"q": q, "headers": headers, "url_filename": url_filename,
+                "body": body}
+
+    boundary = "".join(
+        rng.choice(_BOUNDARY_CHARS) for _ in range(rng.randrange(1, 40))
+    ).strip() or "b"
+    quoted = rng.random() < 0.4
+    ct_boundary = f'"{boundary}"' if quoted else boundary
+    sep = rng.choice(["; ", ";", " ; ", ";\t"])
+    headers["content-type"] = (
+        f"multipart/form-data{sep}boundary={ct_boundary}"
+    )
+    if rng.random() < 0.1:  # boundary parameter spacing abuse
+        headers["content-type"] = (
+            f"multipart/form-data; boundary = {ct_boundary}"
+        )
+
+    delim = b"--" + boundary.encode("latin-1", "replace")
+    chunks: list[bytes] = []
+    if rng.random() < 0.2:
+        chunks.append(b"preamble junk " + rng.randbytes(10) + b"\r\n")
+    n_parts = rng.randrange(0, 4)
+    for i in range(n_parts):
+        has_name = rng.random() < 0.6
+        filename = rng.choice(_NAMES) if has_name else None
+        mime = rng.choice(_MIMES) if rng.random() < 0.7 else None
+        head = _part_head(rng, filename, mime)
+        payload = _payload(rng, boundary)
+        glue = rng.choice([b"\r\n\r\n", b"\r\n\r\n", b"\r\n\r\n", b"\n\n",
+                           b"\r\n"])
+        chunks.append(delim + rng.choice([b"", b" ", b"\t \t"]) + b"\r\n")
+        chunks.append(head + glue + payload + b"\r\n")
+    closing = rng.choice(
+        [delim + b"--\r\n", delim + b"--", delim + b"-- \t\r\nepilogue",
+         delim + b"\r\n", b""]
+    )
+    chunks.append(closing)
+    body = b"".join(chunks)
+    if rng.random() < 0.1:  # torn framing
+        body = body[: rng.randrange(0, max(1, len(body)))]
+    return {"q": q, "headers": headers, "url_filename": url_filename,
+            "body": body}
+
+
+# ---------------------------------------------------------------------------
+# the identity oracle
+
+
+def _pin(v) -> None:
+    """Deterministic append stamps, matching tests/test_native_post.py:
+    a pure function of volume state so a declined C attempt does not
+    advance the clock."""
+    v._now_ns = types.MethodType(
+        lambda self: self.last_append_at_ns + 1, v
+    )
+
+
+def run_case(case: dict, workdir: str) -> tuple[str, str | None]:
+    """(verdict, divergence): verdict is 'handled' (C served it),
+    'declined' (Python fallback served it), or 'rejected' (both sides
+    refused the request). Writes nothing outside `workdir`."""
+    from seaweedfs_tpu.server import write_path
+    from seaweedfs_tpu.storage.file_id import FileId
+    from seaweedfs_tpu.storage.volume import Volume
+    from seaweedfs_tpu.util.httpd import FastHeaders
+    from seaweedfs_tpu.util.multipart import MalformedUpload
+
+    headers = FastHeaders()
+    for k, val in case["headers"].items():
+        headers[k.lower()] = val
+    q = dict(case["q"])
+    body = case["body"]
+    url_filename = case["url_filename"]
+    os.mkdir(os.path.join(workdir, "a"))
+    os.mkdir(os.path.join(workdir, "b"))
+    va = Volume(os.path.join(workdir, "a"), 1)
+    vb = Volume(os.path.join(workdir, "b"), 1)
+    _pin(va)
+    _pin(vb)
+    fid = FileId(1, 0x1234, 0xCAFE)
+    try:
+        fast = write_path.try_native_post(
+            va, fid, q, body, headers, url_filename,
+            fix_jpg_orientation=True,
+        )
+        c_handled = fast is not None
+
+        def py_write(v):
+            n, fname, err = write_path.build_upload_needle(
+                fid, q, body, headers, url_filename,
+                fix_jpg_orientation=True,
+            )
+            if err is not None:
+                return None, err
+            try:
+                _off, size, _unchanged = v.write_needle(n)
+            except (OSError, ValueError) as e:
+                return None, f"write_needle: {e}"
+            reply = b'{"name": %s, "size": %d, "eTag": "%s"}' % (
+                json.dumps(fname).encode(), size, n.etag().encode()
+            )
+            return reply, None
+
+        try:
+            py_reply, py_err = py_write(vb)
+        except MalformedUpload as e:
+            py_reply, py_err = None, f"malformed: {e}"
+        if py_err is not None:
+            if c_handled:
+                return "handled", (
+                    f"C accepted a request Python rejects ({py_err})"
+                )
+            return "rejected", None  # both sides reject: fine
+        if not c_handled:
+            # declined: the fallback must serve volume A identically
+            fast, fb_err = py_write(va)
+            if fb_err is not None:
+                return "declined", (
+                    f"fallback failed after decline ({fb_err}) though "
+                    f"the oracle volume accepted"
+                )
+        files = {}
+        for tag, v in (("a", va), ("b", vb)):
+            with open(v.base_name + ".dat", "rb") as f:
+                dat = f.read()
+            with open(v.base_name + ".idx", "rb") as f:
+                idx = f.read()
+            files[tag] = (dat, idx)
+        verdict = "handled" if c_handled else "declined"
+        if files["a"][0] != files["b"][0]:
+            return verdict, ".dat bytes diverged"
+        if files["a"][1] != files["b"][1]:
+            return verdict, ".idx bytes diverged"
+        if fast != py_reply:
+            return verdict, (
+                f"reply diverged: {fast!r:.120} vs {py_reply!r:.120}"
+            )
+        return verdict, None
+    finally:
+        va.close()
+        vb.close()
+
+
+# ---------------------------------------------------------------------------
+# corpus plumbing
+
+
+def case_to_json(case: dict) -> str:
+    return json.dumps(
+        {
+            "q": case["q"],
+            "headers": case["headers"],
+            "url_filename": case["url_filename"],
+            "body_b64": base64.b64encode(case["body"]).decode(),
+        },
+        indent=1,
+        sort_keys=True,
+    )
+
+
+def case_from_json(text: str) -> dict:
+    raw = json.loads(text)
+    return {
+        "q": raw["q"],
+        "headers": raw["headers"],
+        "url_filename": raw.get("url_filename", ""),
+        "body": base64.b64decode(raw["body_b64"]),
+    }
+
+
+def _case_name(case: dict, prefix: str) -> str:
+    digest = hashlib.sha256(
+        case_to_json(case).encode()
+    ).hexdigest()[:12]
+    return f"{prefix}_{digest}.json"
+
+
+@dataclass
+class FuzzReport:
+    iterations: int = 0
+    handled: int = 0  # cases the C path served
+    declined: int = 0
+    rejected: int = 0  # both sides refused (malformed)
+    divergences: list[str] = field(default_factory=list)
+    corpus_written: list[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "iterations": self.iterations,
+            "c_handled": self.handled,
+            "c_declined": self.declined,
+            "both_rejected": self.rejected,
+            "divergences": self.divergences,
+            "corpus_written": self.corpus_written,
+        }
+
+
+def run(
+    iterations: int = 200,
+    seed: int = 0,
+    corpus_dir: str | None = None,
+    persist_divergent: bool = True,
+) -> FuzzReport:
+    rng = random.Random(seed)
+    report = FuzzReport()
+    corpus_dir = corpus_dir or DEFAULT_CORPUS
+    os.makedirs(corpus_dir, exist_ok=True)
+    pending = os.path.join(corpus_dir, f"pending_{seed}.json")
+    try:
+        for i in range(iterations):
+            case = gen_case(rng)
+            # persist BEFORE the C call: a segfault leaves the input behind
+            with open(pending, "w", encoding="utf-8") as f:
+                f.write(case_to_json(case))
+            report.iterations += 1
+            with tempfile.TemporaryDirectory(prefix="weedfuzz") as workdir:
+                verdict, divergence = run_case(case, workdir)
+            if verdict == "handled":
+                report.handled += 1
+            elif verdict == "rejected":
+                report.rejected += 1
+            else:
+                report.declined += 1
+            if divergence is not None:
+                report.divergences.append(
+                    f"iter {i} (seed {seed}): {divergence}"
+                )
+                if persist_divergent:
+                    name = _case_name(case, "div")
+                    os.replace(pending, os.path.join(corpus_dir, name))
+                    report.corpus_written.append(name)
+    finally:
+        # a hard C crash never reaches here, so the repro survives; any
+        # Python-side exit (exception, Ctrl-C) must not leave pending_*
+        # behind in the version-controlled corpus dir
+        try:
+            os.remove(pending)
+        except OSError:
+            pass
+    return report
+
+
+def seed_corpus(
+    corpus_dir: str | None = None, seed: int = 20260803, target: int = 24
+) -> list[str]:
+    """Refresh tests/corpus/ with a spread of adversarial inputs: the
+    generator runs until `target` distinct framing categories × payload
+    kinds are covered. Deterministic for a given seed, so re-seeding
+    produces a stable corpus (plus any div_*/pending_* regressions
+    already present, which are never touched)."""
+    rng = random.Random(seed)
+    corpus_dir = corpus_dir or DEFAULT_CORPUS
+    os.makedirs(corpus_dir, exist_ok=True)
+    written: list[str] = []
+    seen_kinds: set[tuple] = set()
+    guard = 0
+    while len(written) < target and guard < 10000:
+        guard += 1
+        case = gen_case(rng)
+        ct = case["headers"].get("content-type", "")
+        kind = (
+            ct.split(";")[0],
+            "filename=" in ct or b"filename" in case["body"],
+            b"Content-Transfer-Encoding" in case["body"],
+            len(case["body"]) % 3,
+            bool(case["q"].get("cm")),
+        )
+        if kind in seen_kinds:
+            continue
+        seen_kinds.add(kind)
+        name = _case_name(case, "seed")
+        with open(
+            os.path.join(corpus_dir, name), "w", encoding="utf-8"
+        ) as f:
+            f.write(case_to_json(case))
+        written.append(name)
+    return written
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m seaweedfs_tpu.analysis.fuzz_post"
+    )
+    ap.add_argument("--n", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--corpus", default=DEFAULT_CORPUS)
+    ap.add_argument(
+        "--seed-corpus",
+        action="store_true",
+        help="write the deterministic seed corpus and exit",
+    )
+    args = ap.parse_args(argv)
+    if args.seed_corpus:
+        names = seed_corpus(args.corpus)
+        print(f"seeded {len(names)} corpus entries in {args.corpus}")
+        return 0
+    report = run(iterations=args.n, seed=args.seed, corpus_dir=args.corpus)
+    print(json.dumps(report.to_dict(), indent=2))
+    return 1 if report.divergences else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,225 @@
+"""Hot-loop lint: blocking calls on the data-plane dispatch paths.
+
+Every serving daemon rides the mini request loop (util/httpd
+serve_connection → FastHandler.do_*): one handler thread per
+connection, keep-alive, whole-response writes. A blocking call inside
+that dispatch tree is a stalled connection at best and, under the
+SO_REUSEPORT worker model, a stalled accept slot — the Go reference
+never hits this class because goroutines are preemptible and every
+net call carries a deadline.
+
+Entry points are found structurally: `do_*` methods of every class
+deriving (transitively, within the package) from FastHandler /
+FastRequestMixin / BaseHTTPRequestHandler, plus serve_connection
+itself. Reachability then follows the same resolved call graph the
+lock-order pass builds (self-methods, module functions, unique method
+names, local callbacks). Rules:
+
+  hot-loop-sleep           time.sleep() — a dispatch thread parked on
+                           wall-clock time
+  hot-loop-subprocess      subprocess.* — fork+exec latency and an
+                           unbounded child wait
+  hot-loop-no-timeout      urlopen()/create_connection() without a
+                           timeout= (a dead peer pins the thread
+                           forever; sockets must carry deadlines)
+  hot-loop-unbounded-read  rfile.read() with no byte count: an
+                           EOF-delimited read of a keep-alive socket
+                           blocks until the CLIENT closes
+  hot-loop-gil-span        gzip.compress/decompress of request bodies
+                           inline in dispatch — a multi-MiB compress
+                           holds the GIL for milliseconds and stalls
+                           every other handler thread (the C tier
+                           exists precisely because of this class;
+                           suppressions must say why Python is still
+                           the right place)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from seaweedfs_tpu.analysis import Finding
+from seaweedfs_tpu.analysis.lockorder import PackageIndex, build_index
+
+_HANDLER_BASES = {
+    "FastHandler",
+    "FastRequestMixin",
+    "BaseHTTPRequestHandler",
+    "StreamRequestHandler",
+}
+
+_SUBPROCESS_FNS = {
+    "run", "Popen", "call", "check_call", "check_output",
+}
+
+
+def _handler_classes(index: PackageIndex) -> set[str]:
+    """Class names deriving (transitively in-package) from a handler base."""
+    out: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for cls in index.classes.values():
+            if cls.name in out:
+                continue
+            if any(b in _HANDLER_BASES or b in out for b in cls.bases):
+                out.add(cls.name)
+                changed = True
+    return out
+
+
+def _entry_points(index: PackageIndex) -> set[str]:
+    entries: set[str] = set()
+    handler_names = _handler_classes(index)
+    for cls in index.classes.values():
+        if cls.name not in handler_names:
+            continue
+        for mname, qual in cls.methods.items():
+            if mname.startswith("do_") or mname in (
+                "handle", "handle_one_request"
+            ):
+                entries.add(qual)
+    for qual in index.funcs:
+        if qual.endswith(".serve_connection"):
+            entries.add(qual)
+    return entries
+
+
+def _reachable(index: PackageIndex, entries: set[str]) -> dict[str, str]:
+    """qualname -> entry point it is reachable from (first found)."""
+    seen: dict[str, str] = {}
+    stack = [(e, e) for e in sorted(entries)]
+    while stack:
+        qual, origin = stack.pop()
+        if qual in seen:
+            continue
+        seen[qual] = origin
+        rec = index.funcs.get(qual)
+        if rec is None:
+            continue
+        for _held, ref, _line, cb_args in rec.calls:
+            if ref is not None and ref not in seen:
+                stack.append((ref, origin))
+            for _k, cb in cb_args:
+                if cb not in seen:
+                    stack.append((cb, origin))
+    return seen
+
+
+def _dotted(node: ast.expr) -> str:
+    """'urllib.request.urlopen'-style dotted name, '' when not a name."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _has_kw(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+def _scan_function(qual: str, origin: str, fn: ast.FunctionDef,
+                   path: str) -> list[Finding]:
+    findings: list[Finding] = []
+    via = f" (reached from {origin.rsplit('.', 2)[-1]})" if origin != qual \
+        else ""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        tail = dotted.rsplit(".", 1)[-1]
+        # normalize the `import gzip as _gzip` aliasing idiom
+        head = dotted.split(".", 1)[0].lstrip("_")
+        if tail == "sleep" and head == "time":
+            findings.append(Finding(
+                "hot-loop-sleep", path, node.lineno,
+                f"time.sleep() in dispatch path {qual}{via}: parks the "
+                f"connection's handler thread on wall-clock time",
+            ))
+        elif head == "subprocess" and tail in _SUBPROCESS_FNS:
+            findings.append(Finding(
+                "hot-loop-subprocess", path, node.lineno,
+                f"subprocess.{tail}() in dispatch path {qual}{via}: "
+                f"fork+exec and child wait block the request loop",
+            ))
+        elif (
+            tail == "urlopen"
+            and len(node.args) < 3  # timeout is urlopen's 3rd positional
+            and not _has_kw(node, "timeout")
+        ):
+            findings.append(Finding(
+                "hot-loop-no-timeout", path, node.lineno,
+                f"urlopen() without timeout= in dispatch path "
+                f"{qual}{via}: a dead peer pins this handler thread "
+                f"forever",
+            ))
+        elif (
+            tail == "create_connection"
+            and head == "socket"
+            and len(node.args) < 2
+            and not _has_kw(node, "timeout")
+        ):
+            findings.append(Finding(
+                "hot-loop-no-timeout", path, node.lineno,
+                f"socket.create_connection() without a timeout in "
+                f"dispatch path {qual}{via}",
+            ))
+        elif (
+            tail == "settimeout"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value is None
+        ):
+            findings.append(Finding(
+                "hot-loop-no-timeout", path, node.lineno,
+                f"settimeout(None) in dispatch path {qual}{via}: "
+                f"removes the socket deadline",
+            ))
+        elif (
+            tail == "read"
+            and not node.args
+            and not node.keywords
+            and isinstance(node.func, ast.Attribute)
+            and _dotted(node.func.value).endswith("rfile")
+        ):
+            findings.append(Finding(
+                "hot-loop-unbounded-read", path, node.lineno,
+                f"rfile.read() with no byte count in dispatch path "
+                f"{qual}{via}: an EOF-delimited read of a keep-alive "
+                f"socket blocks until the client closes",
+            ))
+        elif head == "gzip" and tail in ("compress", "decompress"):
+            findings.append(Finding(
+                "hot-loop-gil-span", path, node.lineno,
+                f"gzip.{tail}() inline in dispatch path {qual}{via}: "
+                f"holds the GIL for the whole (de)compression of the "
+                f"body",
+            ))
+    return findings
+
+
+def check(root: str | None = None, index: PackageIndex | None = None
+          ) -> tuple[list[Finding], PackageIndex]:
+    index = index or build_index(root)
+    entries = _entry_points(index)
+    reach = _reachable(index, entries)
+    findings: list[Finding] = []
+    for qual, origin in sorted(reach.items()):
+        fn = index.fn_nodes.get(qual)
+        rec = index.funcs.get(qual)
+        if fn is None or rec is None:
+            continue
+        findings.extend(_scan_function(qual, origin, fn, rec.path))
+    # dedupe: one site can be reachable from many entries
+    seen: set[tuple[str, int, str]] = set()
+    out: list[Finding] = []
+    for f in findings:
+        key = (f.path, f.line, f.rule)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out, index

@@ -1,0 +1,931 @@
+"""Static lock-order analyzer: the package-wide acquisition graph.
+
+What `go vet` + code review gave the reference, this pass gives the
+port: every `threading.Lock/RLock/Condition` attribute is mapped to
+its owning class, every `with <lock>:` block and explicit
+`acquire()/release()` pair contributes edges to a static acquisition
+graph (lock A held while lock B is acquired ⇒ edge A→B), and any
+cycle in that graph is a deadlock candidate — two threads walking the
+cycle from different entry points can block each other forever.
+
+Resolution strategy (precision over recall — a finding here should be
+a true positive; recall is the dynamic witness's job, analysis/witness.py):
+
+  * `self.X` resolves against the enclosing class's lock attributes;
+  * `obj.X` resolves only when attribute X names a lock in exactly ONE
+    class package-wide, or the variable's class is knowable from a
+    parameter annotation or a tracked local assignment;
+  * `threading.Condition(self.X)` aliases to X (entering the condition
+    acquires the wrapped lock);
+  * dict-of-locks idioms (`d.setdefault(k, threading.Lock())`) become
+    a single `Class.attr[*]` node — per-key instances share ordering;
+  * calls made while holding locks propagate one-level interprocedural:
+    each function's transitive acquire-set is computed to fixpoint over
+    the package call graph (self-methods, module functions, and
+    methods whose name is unique package-wide);
+  * a LOCAL function passed as an argument (the `precheck=still_owned`
+    callback idiom in server/volume_workers.py) is bound to the callee's
+    parameter, so locks the callback takes are ordered after locks the
+    callee holds at its `param()` call sites.
+
+The same walk also powers the unguarded-write check: an attribute that
+the owning class writes under its own lock at some non-constructor
+site is "lock-guarded"; any other non-constructor write reached
+without that guard is a lost-update candidate (rule unguarded-write).
+A method whose every in-package call site already holds the class's
+lock inherits that guard context (the `_refill_locked` idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from seaweedfs_tpu.analysis import Finding, iter_py_files
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_CTOR_METHODS = {"__init__", "__post_init__", "__new__", "__init_subclass__"}
+# mutating method calls on `self.attr` that count as writes for the
+# unguarded-write check (the attribute itself is reassigned-equivalent)
+_MUTATORS = {
+    "append", "add", "pop", "clear", "update", "remove", "discard",
+    "extend", "insert", "setdefault", "popitem", "appendleft",
+}
+# method names that collide with builtin container/IO protocols: a
+# `x.get(...)` must never resolve to SomeClass.get just because exactly
+# one package class defines a `get` method — x is usually a dict
+_BUILTIN_METHODS: set[str] = (
+    set(dir(list)) | set(dir(dict)) | set(dir(set)) | set(dir(str))
+    | set(dir(bytes)) | set(dir(bytearray)) | set(dir(tuple))
+    | {
+        "read", "write", "close", "open", "flush", "seek", "tell",
+        "readline", "readinto", "fileno", "send", "recv", "sendall",
+        "connect", "bind", "listen", "accept", "settimeout", "shutdown",
+        "join", "start", "wait", "set", "is_set", "put", "get", "result",
+        "submit", "cancel", "acquire", "release",
+    }
+)
+
+
+# ---------------------------------------------------------------------------
+# package index
+
+
+@dataclass
+class FuncRecord:
+    qualname: str
+    cls: str | None  # owning class name, if a method
+    module: str
+    path: str  # repo-relative
+    is_classmethod: bool = False  # @classmethod/@staticmethod: ctor-ish
+    params: list[str] = field(default_factory=list)
+    direct_acquires: set[str] = field(default_factory=set)
+    # (held frozenset, callee reference, line); callee refs are symbolic
+    # ("self.m", "mod.f", "~local.f", "?m") until resolution
+    calls: list = field(default_factory=list)
+    # param name -> [(held frozenset, line)] where the param is CALLED
+    param_call_holds: dict[str, list] = field(default_factory=dict)
+    # (attr, line, held frozenset, is_self, target_hint)
+    writes: list = field(default_factory=list)
+    # acquisition events: (node, line, held frozenset)
+    acquisitions: list = field(default_factory=list)
+
+
+@dataclass
+class ClassRecord:
+    name: str
+    module: str
+    path: str
+    bases: list[str] = field(default_factory=list)  # base-class names
+    lock_attrs: dict[str, str] = field(default_factory=dict)  # attr -> kind
+    methods: dict[str, str] = field(default_factory=dict)  # name -> qualname
+
+
+class PackageIndex:
+    def __init__(self) -> None:
+        # keyed by a unique per-definition key; bare-name lookups go
+        # through classes_by_name, which keeps DISTINCT records for
+        # same-named classes in different modules (the package has
+        # several: Command, _Reader, VolumeInfo…) — merging them would
+        # corrupt method resolution and the uniqueness probes
+        self.classes: dict[str, ClassRecord] = {}
+        self.classes_by_name: dict[str, list[ClassRecord]] = {}
+        self.funcs: dict[str, FuncRecord] = {}  # by qualname
+        self.func_cls: dict[str, ClassRecord] = {}  # method qual -> class
+        self.module_funcs: dict[tuple[str, str], str] = {}  # (mod, name) -> qual
+        # method name -> [qualnames] across every class (uniqueness probe)
+        self.methods_by_name: dict[str, list[str]] = {}
+        self.sources: dict[str, str] = {}  # rel path -> source text
+        self.lock_attr_owners: dict[str, list[str]] = {}  # attr -> [classes]
+        self.fn_nodes: dict[str, ast.FunctionDef] = {}  # qual -> AST node
+        # (module basename, function) -> [quals]: resolves the
+        # `from pkg import write_path; write_path.fn()` idiom
+        self.funcs_by_modbase: dict[tuple[str, str], list[str]] = {}
+
+    def class_by_name(self, name: str) -> "ClassRecord | None":
+        """The record for a bare class name, or None when the name is
+        ambiguous (defined in several modules) — ambiguity means no
+        resolution, never a guess."""
+        recs = self.classes_by_name.get(name, [])
+        return recs[0] if len(recs) == 1 else None
+
+    def finish(self) -> None:
+        for cls in self.classes.values():
+            for attr in cls.lock_attrs:
+                self.lock_attr_owners.setdefault(attr, []).append(cls.name)
+            for mname, qual in cls.methods.items():
+                self.methods_by_name.setdefault(mname, []).append(qual)
+        for (mod, fname), qual in self.module_funcs.items():
+            base = mod.rsplit(".", 1)[-1]
+            self.funcs_by_modbase.setdefault((base, fname), []).append(qual)
+
+
+def _is_lock_call(node: ast.expr) -> str | None:
+    """'Lock'/'RLock'/'Condition' when node is threading.X(...) (or a
+    bare X(...) — the package always imports the module, but be lax)."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_FACTORIES:
+        if isinstance(fn.value, ast.Name) and fn.value.id == "threading":
+            return fn.attr
+    if isinstance(fn, ast.Name) and fn.id in _LOCK_FACTORIES:
+        return fn.id
+    return None
+
+
+def _contains_lock_call(node: ast.expr) -> str | None:
+    for sub in ast.walk(node):
+        kind = _is_lock_call(sub)
+        if kind is not None:
+            return kind
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-function symbolic walk
+
+
+class _FuncWalker:
+    """Walks one function body tracking the stack of held locks.
+
+    Control flow is approximated: branches are visited sequentially
+    with the entry-held stack, which is exact for the dominant
+    `with lock:` idiom and conservative for acquire/release spanning
+    branches (an acquire() inside one branch arm is treated as held
+    for the remainder of the straight-line walk)."""
+
+    def __init__(self, index: PackageIndex, rec: FuncRecord,
+                 cls: ClassRecord | None, local_locks: dict[str, str],
+                 annotations: dict[str, str], local_funcs: dict[str, str]):
+        self.index = index
+        self.rec = rec
+        self.cls = cls
+        self.held: list[str] = []
+        self.local_locks = local_locks  # var name -> lock node
+        self.annotations = annotations  # param name -> class name
+        self.local_funcs = local_funcs  # local def name -> qualname
+
+    def prescan(self, fn_node: ast.FunctionDef) -> None:
+        """Infer entry-held locks: a function that release()s a lock
+        more often than it acquire()s it (the begin_transaction /
+        commit_transaction split-protocol idiom) holds that lock as a
+        precondition — its writes and nested acquisitions are ordered
+        under it."""
+        balance: dict[str, int] = {}
+        for sub in ast.walk(fn_node):
+            if not (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+            ):
+                continue
+            if sub.func.attr == "acquire":
+                lock = self.resolve_lock(sub.func.value)
+                if lock is not None:
+                    balance[lock] = balance.get(lock, 0) + 1
+            elif sub.func.attr == "release":
+                lock = self.resolve_lock(sub.func.value)
+                if lock is not None:
+                    balance[lock] = balance.get(lock, 0) - 1
+        for lock, n in balance.items():
+            if n < 0:
+                self.held.append(lock)
+
+    # -- lock expression resolution ------------------------------------
+    def resolve_lock(self, node: ast.expr) -> str | None:
+        if isinstance(node, ast.Name):
+            if node.id in self.local_locks:
+                return self.local_locks[node.id]
+            return None
+        if isinstance(node, ast.Attribute):
+            attr = node.attr
+            base = node.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and self.cls is not None:
+                    target = self.cls.lock_attrs.get(attr)
+                    if target is not None:
+                        return f"{self.cls.name}.{attr}"
+                    return None
+                # annotated param / tracked variable of a known class
+                cls_name = self.annotations.get(base.id)
+                ann_cls = (
+                    self.index.class_by_name(cls_name) if cls_name else None
+                )
+                if ann_cls is not None and attr in ann_cls.lock_attrs:
+                    return f"{ann_cls.name}.{attr}"
+                # unique lock-attribute name across the package
+                owners = self.index.lock_attr_owners.get(attr, [])
+                if len(owners) == 1:
+                    return f"{owners[0]}.{attr}"
+            return None
+        return None
+
+    # -- events --------------------------------------------------------
+    def _acquire(self, node_id: str, line: int) -> None:
+        self.rec.acquisitions.append(
+            (node_id, line, frozenset(self.held))
+        )
+        self.rec.direct_acquires.add(node_id)
+        self.held.append(node_id)
+
+    def _release(self, node_id: str) -> None:
+        if node_id in self.held:
+            # remove the innermost matching hold
+            for i in range(len(self.held) - 1, -1, -1):
+                if self.held[i] == node_id:
+                    del self.held[i]
+                    break
+
+    def _record_write(self, attr: str, line: int, is_self: bool,
+                      hint: str | None) -> None:
+        self.rec.writes.append(
+            (attr, line, frozenset(self.held), is_self, hint)
+        )
+
+    def _record_call(self, call: ast.Call) -> None:
+        ref = self._callee_ref(call.func)
+        held = frozenset(self.held)
+        cb_args: list[tuple[object, str]] = []  # (pos|kw, local func qual)
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Name) and a.id in self.local_funcs:
+                cb_args.append((i, self.local_funcs[a.id]))
+        for kw in call.keywords:
+            if (
+                kw.arg is not None
+                and isinstance(kw.value, ast.Name)
+                and kw.value.id in self.local_funcs
+            ):
+                cb_args.append((kw.arg, self.local_funcs[kw.value.id]))
+        if ref is not None or cb_args:
+            self.rec.calls.append((held, ref, call.lineno, cb_args))
+        # a call on a tracked PARAM name: witness point for callbacks
+        if isinstance(call.func, ast.Name) and call.func.id in self.rec.params:
+            self.rec.param_call_holds.setdefault(call.func.id, []).append(
+                (held, call.lineno)
+            )
+
+    def _callee_ref(self, fn: ast.expr) -> str | None:
+        if isinstance(fn, ast.Name):
+            if fn.id in self.local_funcs:
+                return self.local_funcs[fn.id]
+            qual = self.index.module_funcs.get((self.rec.module, fn.id))
+            if qual:
+                return qual
+            ctor_cls = self.index.class_by_name(fn.id)
+            if ctor_cls is not None:
+                return ctor_cls.methods.get("__init__")
+            return None
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name):
+                if fn.value.id == "self" and self.cls is not None:
+                    qual = self.cls.methods.get(fn.attr)
+                    if qual:
+                        return qual
+                cls_name = self.annotations.get(fn.value.id)
+                ann_cls = (
+                    self.index.class_by_name(cls_name) if cls_name else None
+                )
+                if ann_cls is not None:
+                    qual = ann_cls.methods.get(fn.attr)
+                    if qual:
+                        return qual
+                # `write_path.fn()`: module referenced by basename
+                mods = self.index.funcs_by_modbase.get(
+                    (fn.value.id, fn.attr), []
+                )
+                if len(mods) == 1:
+                    return mods[0]
+            # method name unique across every class in the package AND
+            # not shadowing a builtin protocol name (x.append must not
+            # resolve to the one package class that defines append)
+            cands = self.index.methods_by_name.get(fn.attr, [])
+            if (
+                len(cands) == 1
+                and fn.attr not in _CTOR_METHODS
+                and fn.attr not in _BUILTIN_METHODS
+            ):
+                return cands[0]
+            return None
+        return None
+
+    # -- statement walk ------------------------------------------------
+    def walk(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.With):
+            pushed: list[str] = []
+            for item in stmt.items:
+                self._expr(item.context_expr)
+                lock = self.resolve_lock(item.context_expr)
+                if lock is not None:
+                    self._acquire(lock, stmt.lineno)
+                    pushed.append(lock)
+            self.walk(stmt.body)
+            for lock in reversed(pushed):
+                self._release(lock)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs are indexed separately
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._assignment(stmt)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._expr(stmt.value)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter)
+            self._target_write(stmt.target)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk(stmt.body)
+            for handler in stmt.handlers:
+                self.walk(handler.body)
+            self.walk(stmt.orelse)
+            self.walk(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    self._record_call(sub)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                self._target_write(tgt)
+            return
+        # Pass/Break/Continue/Import/Global/...: nothing to track
+
+    def _assignment(self, stmt: ast.stmt) -> None:
+        value = getattr(stmt, "value", None)
+        if value is not None:
+            self._expr(value)
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        for tgt in targets:
+            self._target_write(tgt)
+            # local lock tracking: var = <lock expr>
+            if isinstance(tgt, ast.Name) and value is not None:
+                kind = _is_lock_call(value)
+                if kind is not None:
+                    self.local_locks[tgt.id] = (
+                        f"{self.rec.qualname}.{tgt.id}"
+                    )
+                    return
+                resolved = self.resolve_lock(value)
+                if resolved is not None:
+                    self.local_locks[tgt.id] = resolved
+                    return
+                # d.setdefault(key, threading.Lock()) on self.attr
+                if (
+                    isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Attribute)
+                    and value.func.attr in ("setdefault", "get")
+                    and _contains_lock_call(value) is not None
+                    and isinstance(value.func.value, ast.Attribute)
+                    and isinstance(value.func.value.value, ast.Name)
+                    and value.func.value.value.id == "self"
+                    and self.cls is not None
+                ):
+                    self.local_locks[tgt.id] = (
+                        f"{self.cls.name}.{value.func.value.attr}[*]"
+                    )
+
+    def _target_write(self, tgt: ast.expr) -> None:
+        if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name):
+            is_self = tgt.value.id == "self"
+            hint = None if is_self else self.annotations.get(tgt.value.id)
+            self._record_write(tgt.attr, tgt.lineno, is_self, hint)
+        elif isinstance(tgt, ast.Subscript):
+            inner = tgt.value
+            if isinstance(inner, ast.Attribute) and isinstance(
+                inner.value, ast.Name
+            ):
+                is_self = inner.value.id == "self"
+                hint = (
+                    None if is_self else self.annotations.get(inner.value.id)
+                )
+                self._record_write(inner.attr, tgt.lineno, is_self, hint)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._target_write(el)
+
+    def _expr(self, node: ast.expr) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if isinstance(fn, ast.Attribute):
+                if fn.attr == "acquire":
+                    lock = self.resolve_lock(fn.value)
+                    if lock is not None:
+                        self._acquire(lock, sub.lineno)
+                        continue
+                elif fn.attr == "release":
+                    lock = self.resolve_lock(fn.value)
+                    if lock is not None:
+                        self._release(lock)
+                        continue
+                elif (
+                    fn.attr in _MUTATORS
+                    and isinstance(fn.value, ast.Attribute)
+                    and isinstance(fn.value.value, ast.Name)
+                ):
+                    is_self = fn.value.value.id == "self"
+                    hint = (
+                        None
+                        if is_self
+                        else self.annotations.get(fn.value.value.id)
+                    )
+                    self._record_write(
+                        fn.value.attr, sub.lineno, is_self, hint
+                    )
+            self._record_call(sub)
+
+
+# ---------------------------------------------------------------------------
+# index construction
+
+
+def _param_annotations(fn: ast.FunctionDef) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for arg in list(fn.args.args) + list(fn.args.kwonlyargs):
+        ann = arg.annotation
+        if isinstance(ann, ast.Name):
+            out[arg.arg] = ann.id
+        elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            out[arg.arg] = ann.value.strip("'\" ").split(".")[-1].split(
+                " "
+            )[0]
+        elif isinstance(ann, ast.Attribute):
+            out[arg.arg] = ann.attr
+    return out
+
+
+def build_index(root: str | None = None) -> PackageIndex:
+    index = PackageIndex()
+    _PENDING.clear()  # defensive: a prior failed build must not leak
+    for abs_path, rel_path in iter_py_files(root):
+        try:
+            with open(abs_path, "r", encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=rel_path)
+        except (OSError, SyntaxError):
+            continue
+        index.sources[rel_path] = source
+        module = os.path.splitext(rel_path)[0].replace(os.sep, ".")
+        _index_module(index, module, rel_path, tree)
+    index.finish()
+    # walk every function body now that class lock maps are complete
+    for qual, (fn_node, cls) in list(_PENDING.items()):
+        rec = index.funcs[qual]
+        index.fn_nodes[qual] = fn_node
+        if cls is not None:
+            index.func_cls[qual] = cls
+        local_funcs = {
+            n.name: f"{qual}.{n.name}"
+            for n in ast.walk(fn_node)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn_node
+        }
+        walker = _FuncWalker(
+            index, rec, cls, {}, _param_annotations(fn_node), local_funcs
+        )
+        walker.prescan(fn_node)
+        walker.walk(fn_node.body)
+    _PENDING.clear()
+    return index
+
+
+_PENDING: dict[str, tuple[ast.FunctionDef, "ClassRecord | None"]] = {}
+
+
+def _index_module(
+    index: PackageIndex, module: str, path: str, tree: ast.Module
+) -> None:
+    def add_func(fn, cls, prefix):
+        qual = f"{prefix}.{fn.name}"
+        rec = FuncRecord(
+            qualname=qual,
+            cls=cls.name if cls is not None else None,
+            module=module,
+            path=path,
+            params=[a.arg for a in fn.args.args if a.arg != "self"]
+            + [a.arg for a in fn.args.kwonlyargs],
+        )
+        index.funcs[qual] = rec
+        _PENDING[qual] = (fn, cls)
+        for sub in fn.body:
+            _walk_defs(sub, cls, qual)
+
+    def _walk_defs(node, cls, prefix):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            add_func(node, cls, prefix)
+        elif isinstance(node, ast.ClassDef):
+            _index_class(node, f"{prefix}.{node.name}")
+        elif hasattr(node, "body") and isinstance(
+            getattr(node, "body", None), list
+        ):
+            for sub in node.body:
+                _walk_defs(sub, cls, prefix)
+            for sub in getattr(node, "orelse", []) or []:
+                _walk_defs(sub, cls, prefix)
+            for h in getattr(node, "handlers", []) or []:
+                for sub in h.body:
+                    _walk_defs(sub, cls, prefix)
+            for sub in getattr(node, "finalbody", []) or []:
+                _walk_defs(sub, cls, prefix)
+
+    def _index_class(node: ast.ClassDef, qual_prefix: str) -> None:
+        # one record PER DEFINITION, keyed by the (unique) qualname:
+        # distinct classes sharing a bare name must never merge, or the
+        # method-uniqueness probe and lock-attr maps lie about both
+        cls = ClassRecord(name=node.name, module=module, path=path)
+        index.classes[qual_prefix] = cls
+        index.classes_by_name.setdefault(node.name, []).append(cls)
+        for b in node.bases:
+            if isinstance(b, ast.Name):
+                cls.bases.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                cls.bases.append(b.attr)
+        # lock attributes: self.X = threading.Lock() anywhere in the class
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                tgt = sub.targets[0]
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    kind = _is_lock_call(sub.value)
+                    if kind is not None:
+                        cls.lock_attrs[tgt.attr] = kind
+                        # Condition(self.X) aliases the wrapped lock
+                        if (
+                            kind == "Condition"
+                            and isinstance(sub.value, ast.Call)
+                            and sub.value.args
+                            and isinstance(sub.value.args[0], ast.Attribute)
+                            and isinstance(
+                                sub.value.args[0].value, ast.Name
+                            )
+                            and sub.value.args[0].value.id == "self"
+                        ):
+                            cls.lock_attrs[tgt.attr] = (
+                                f"alias:{sub.value.args[0].attr}"
+                            )
+        # resolve aliases to the canonical attr
+        for attr, kind in list(cls.lock_attrs.items()):
+            if kind.startswith("alias:"):
+                cls.lock_attrs[attr] = cls.lock_attrs.get(
+                    kind[6:], "Lock"
+                )
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{qual_prefix}.{item.name}"
+                cls.methods[item.name] = qual
+                rec = FuncRecord(
+                    qualname=qual,
+                    cls=node.name,
+                    module=module,
+                    path=path,
+                    is_classmethod=any(
+                        isinstance(d, ast.Name)
+                        and d.id in ("classmethod", "staticmethod")
+                        for d in item.decorator_list
+                    ),
+                    params=[
+                        a.arg for a in item.args.args if a.arg != "self"
+                    ]
+                    + [a.arg for a in item.args.kwonlyargs],
+                )
+                index.funcs[qual] = rec
+                _PENDING[qual] = (item, cls)
+                for sub in item.body:
+                    _walk_defs(sub, cls, qual)
+            elif isinstance(item, ast.ClassDef):
+                _index_class(item, f"{qual_prefix}.{item.name}")
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = f"{module}.{node.name}"
+            index.module_funcs[(module, node.name)] = qual
+            add_func(node, None, module)
+        elif isinstance(node, ast.ClassDef):
+            _index_class(node, f"{module}.{node.name}")
+
+
+# ---------------------------------------------------------------------------
+# graph construction + reporting
+
+
+def _transitive_acquires(index: PackageIndex) -> dict[str, set[str]]:
+    ta = {q: set(rec.direct_acquires) for q, rec in index.funcs.items()}
+    changed = True
+    # bounded fixpoint; the package call graph is small
+    for _ in range(40):
+        if not changed:
+            break
+        changed = False
+        for qual, rec in index.funcs.items():
+            for _, ref, _, cb_args in rec.calls:
+                if ref in ta and not ta[ref] <= ta[qual]:
+                    ta[qual] |= ta[ref]
+                    changed = True
+                for _, cb_qual in cb_args:
+                    if cb_qual in ta and not ta[cb_qual] <= ta[qual]:
+                        ta[qual] |= ta[cb_qual]
+                        changed = True
+    return ta
+
+
+def build_lock_graph(
+    index: PackageIndex,
+) -> dict[tuple[str, str], list[tuple[str, int]]]:
+    """edges[(A, B)] = [(path, line), ...]: lock B acquired while A held."""
+    ta = _transitive_acquires(index)
+    edges: dict[tuple[str, str], list[tuple[str, int]]] = {}
+
+    def add(a: str, b: str, path: str, line: int) -> None:
+        if a == b:
+            return  # same-site pairs: witness territory (per-instance)
+        edges.setdefault((a, b), []).append((path, line))
+
+    for rec in index.funcs.values():
+        for node, line, held in rec.acquisitions:
+            for h in held:
+                add(h, node, rec.path, line)
+        for held, ref, line, cb_args in rec.calls:
+            callee_locks: set[str] = set()
+            if ref is not None and ref in ta:
+                callee_locks |= ta[ref]
+            for h in held:
+                for b in callee_locks:
+                    add(h, b, rec.path, line)
+            # callback params: locks the callee holds when it CALLS the
+            # parameter are ordered before locks the callback takes
+            if cb_args and ref is not None and ref in index.funcs:
+                callee = index.funcs[ref]
+                for key, cb_qual in cb_args:
+                    pname = (
+                        key
+                        if isinstance(key, str)
+                        else (
+                            callee.params[key]
+                            if isinstance(key, int)
+                            and key < len(callee.params)
+                            else None
+                        )
+                    )
+                    if pname is None or cb_qual not in ta:
+                        continue
+                    for cheld, cline in callee.param_call_holds.get(
+                        pname, []
+                    ):
+                        for h in cheld:
+                            for b in ta[cb_qual]:
+                                add(h, b, callee.path, cline)
+    return edges
+
+
+def _find_cycles(
+    edges: dict[tuple[str, str], list[tuple[str, int]]]
+) -> list[list[str]]:
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    # Tarjan SCC
+    idx_counter = [0]
+    stack: list[str] = []
+    lowlink: dict[str, int] = {}
+    number: dict[str, int] = {}
+    on_stack: set[str] = set()
+    sccs: list[list[str]] = []
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(sorted(graph[v])))]
+        number[v] = lowlink[v] = idx_counter[0]
+        idx_counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in number:
+                    number[w] = lowlink[w] = idx_counter[0]
+                    idx_counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    lowlink[node] = min(lowlink[node], number[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                lowlink[parent] = min(lowlink[parent], lowlink[node])
+            if lowlink[node] == number[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for v in sorted(graph):
+        if v not in number:
+            strongconnect(v)
+    return sccs
+
+
+# ---------------------------------------------------------------------------
+# unguarded-write analysis
+
+
+def _call_contexts(index: PackageIndex) -> tuple[set[str], set[str]]:
+    """(ctor_exempt, guarded): a method is CTOR-EXEMPT when every
+    in-package call site lives in a constructor, a classmethod
+    (`load()`-style alternate constructors), or another ctor-exempt
+    method — the object isn't shared yet, so its writes need no lock.
+    It is GUARDED when every remaining call site holds some lock —
+    the `_refill_locked` idiom of helpers only ever invoked under the
+    caller's lock."""
+    call_sites: dict[str, list[tuple[str, frozenset]]] = {}
+    for rec in index.funcs.values():
+        for held, ref, _line, _cb in rec.calls:
+            if ref is not None:
+                call_sites.setdefault(ref, []).append((rec.qualname, held))
+
+    def own_locks(qual: str) -> frozenset:
+        """Lock node-ids belonging to the function's OWN class — a
+        write is only 'guarded' under one of these; holding some other
+        object's lock does not protect this object's state."""
+        rec = index.funcs.get(qual)
+        cls = index.func_cls.get(qual)
+        if rec is None or rec.cls is None or cls is None:
+            return frozenset()
+        return frozenset(f"{rec.cls}.{a}" for a in cls.lock_attrs)
+
+    def is_ctor_like(qual: str) -> bool:
+        rec = index.funcs.get(qual)
+        return (
+            qual.rsplit(".", 1)[-1] in _CTOR_METHODS
+            or (rec is not None and rec.is_classmethod)
+        )
+
+    ctor_exempt: set[str] = set()
+    for _ in range(20):  # fixpoint over the (small) call graph
+        changed = False
+        for qual in index.funcs:
+            if qual in ctor_exempt:
+                continue
+            sites = call_sites.get(qual, [])
+            if sites and all(
+                is_ctor_like(c) or c in ctor_exempt for c, _ in sites
+            ):
+                ctor_exempt.add(qual)
+                changed = True
+        if not changed:
+            break
+    guarded: set[str] = set()
+    for _ in range(20):  # transitive: guarded callers confer the guard
+        changed = False
+        for qual in index.funcs:
+            if qual in guarded:
+                continue
+            sites = [
+                (c, held)
+                for c, held in call_sites.get(qual, [])
+                if not (is_ctor_like(c) or c in ctor_exempt)
+            ]
+            if sites and all(
+                (held & own_locks(qual)) or c in guarded
+                for c, held in sites
+            ):
+                guarded.add(qual)
+                changed = True
+        if not changed:
+            break
+    return ctor_exempt, guarded
+
+
+def check_unguarded_writes(index: PackageIndex) -> list[Finding]:
+    ctor_exempt, guarded_ctx = _call_contexts(index)
+    # (class, attr) -> [(line, path, guarded_bool, func_qual)]
+    writes: dict[tuple[str, str], list] = {}
+    for rec in index.funcs.values():
+        if rec.cls is None:
+            continue
+        name = rec.qualname.rsplit(".", 1)[-1]
+        if (
+            name in _CTOR_METHODS
+            or rec.is_classmethod
+            or rec.qualname in ctor_exempt
+        ):
+            continue
+        cls = index.func_cls.get(rec.qualname)
+        if cls is None or not cls.lock_attrs:
+            continue
+        ctx = rec.qualname in guarded_ctx
+        own = frozenset(f"{rec.cls}.{a}" for a in cls.lock_attrs)
+        for attr, line, held, is_self, _hint in rec.writes:
+            if not is_self or attr in cls.lock_attrs:
+                continue
+            writes.setdefault((cls.module, rec.cls, attr), []).append(
+                (line, rec.path, ctx or bool(held & own), rec.qualname,
+                 ", ".join(sorted(cls.lock_attrs)))
+            )
+    findings: list[Finding] = []
+    for (_mod, cls_name, attr), sites in sorted(writes.items()):
+        if not any(g for _, _, g, _, _ in sites):
+            continue  # never lock-guarded: not a guarded attribute
+        for line, path, guarded, qual, lock_names in sites:
+            if guarded:
+                continue
+            findings.append(
+                Finding(
+                    "unguarded-write",
+                    path,
+                    line,
+                    f"{qual} writes {cls_name}.{attr} without holding "
+                    f"the class lock ({lock_names}) that guards it at "
+                    f"other write sites",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry point
+
+
+def check(root: str | None = None, index: PackageIndex | None = None
+          ) -> tuple[list[Finding], PackageIndex]:
+    index = index or build_index(root)
+    findings: list[Finding] = []
+    edges = build_lock_graph(index)
+    for scc in _find_cycles(edges):
+        locs = []
+        in_scc = set(scc)
+        for (a, b), sites in sorted(edges.items()):
+            if a in in_scc and b in in_scc:
+                path, line = sites[0]
+                locs.append(f"{a}→{b} at {path}:{line}")
+        anchor_path, anchor_line = "seaweedfs_tpu", 1
+        for (a, b), sites in sorted(edges.items()):
+            if a in in_scc and b in in_scc:
+                anchor_path, anchor_line = sites[0]
+                break
+        findings.append(
+            Finding(
+                "lock-order",
+                anchor_path,
+                anchor_line,
+                "lock-order cycle (deadlock candidate): "
+                + " | ".join(locs),
+            )
+        )
+    findings.extend(check_unguarded_writes(index))
+    return findings, index

@@ -1,0 +1,267 @@
+"""Dynamic lock-order witness: the port's `-race`-style runtime check.
+
+The static pass (analysis/lockorder.py) proves what it can resolve;
+everything that flows through callbacks, cross-object references, or
+data-dependent dispatch only materializes at runtime. This module
+wraps `threading.Lock`/`threading.RLock` ALLOCATION — only for locks
+created from files inside this repository, so third-party locks cost
+nothing and contribute no noise — and records, per thread, the order
+in which witnessed locks nest. Lock identity is the allocation site
+(file:line), the same "lock class" granularity the Linux kernel's
+lockdep uses: two SharedReadVolume instances share one node, so an
+inversion between *classes* of locks is caught even when the two
+offending runs touched different instances.
+
+On every acquisition that nests inside held locks, the witness adds
+edges held→new to a global order graph. An edge whose REVERSE
+direction is already reachable is an inversion: two threads have now
+demonstrated both A→B and B→A nesting, which is exactly the deadlock
+recipe — it only needs the right interleaving to stick. The witness
+records both stacks and the pytest plugin (tests/conftest.py) fails
+the test that completed the cycle, tier-1-wide, by default
+(WEED_LOCK_WITNESS=0 disables).
+
+Same-site edges (instance A then instance B of the same lock class)
+are ignored, as lockdep does without nesting annotations: the
+volume-workers design acquires sibling SharedReadVolume locks only
+sequentially, never nested, and per-instance tracking would need
+object identity that outlives the objects.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+_RAW_LOCK = threading.Lock
+_RAW_RLOCK = threading.RLock
+
+_state_lock = _RAW_LOCK()
+_edges: dict[str, set[str]] = {}  # site -> sites acquired while held
+_edge_examples: dict[tuple[str, str], str] = {}
+_inversions: list[dict] = []
+_installed = False
+
+_tls = threading.local()
+
+
+def _held() -> list:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+def _reachable(frm: str, to: str) -> bool:
+    """True when `to` is reachable from `frm` in the order graph.
+    Caller holds _state_lock."""
+    seen = {frm}
+    stack = [frm]
+    while stack:
+        node = stack.pop()
+        for nxt in _edges.get(node, ()):
+            if nxt == to:
+                return True
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return False
+
+
+def _note_acquired(site: str) -> None:
+    held = _held()
+    if held:
+        new_edges = []
+        with _state_lock:
+            for h in held:
+                if h == site:
+                    continue
+                if site in _edges.get(h, ()):
+                    continue
+                # adding h→site: if site→…→h already exists, two code
+                # paths nest these lock classes in opposite orders
+                if _reachable(site, h):
+                    _inversions.append(
+                        {
+                            "held": h,
+                            "acquiring": site,
+                            "established": _edge_examples.get(
+                                (site, h),
+                                next(
+                                    (
+                                        _edge_examples[(a, b)]
+                                        for (a, b) in _edge_examples
+                                        if a == site
+                                    ),
+                                    "(indirect path)",
+                                ),
+                            ),
+                            "thread": threading.current_thread().name,
+                            "stack": "".join(
+                                traceback.format_stack(limit=12)[:-2]
+                            ),
+                        }
+                    )
+                new_edges.append((h, site))
+            for a, b in new_edges:
+                _edges.setdefault(a, set()).add(b)
+                _edge_examples.setdefault(
+                    (a, b),
+                    f"thread {threading.current_thread().name}",
+                )
+    held.append(site)
+
+
+def _note_released(site: str) -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == site:
+            del held[i]
+            return
+
+
+class _WitnessLock:
+    """Wrapper around one witnessed Lock/RLock instance. Supports the
+    full context-manager + acquire/release surface plus the private
+    Condition protocol (_release_save/_acquire_restore/_is_owned) so
+    `threading.Condition(witnessed_lock)` keeps the held-stack honest
+    across wait()."""
+
+    __slots__ = ("_lk", "_site", "_is_rlock")
+
+    def __init__(self, lk, site: str, is_rlock: bool):
+        self._lk = lk
+        self._site = site
+        self._is_rlock = is_rlock
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._lk.acquire(blocking, timeout)
+        if ok:
+            _note_acquired(self._site)
+        return ok
+
+    def release(self) -> None:
+        self._lk.release()
+        _note_released(self._site)
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lk.locked()
+
+    # --- Condition(lock) protocol ------------------------------------
+    def _release_save(self):
+        state = (
+            self._lk._release_save()
+            if self._is_rlock
+            else self._lk.release()
+        )
+        _note_released(self._site)
+        return state
+
+    def _acquire_restore(self, state) -> None:
+        if self._is_rlock:
+            self._lk._acquire_restore(state)
+        else:
+            self._lk.acquire()
+        _note_acquired(self._site)
+
+    def _is_owned(self) -> bool:
+        if self._is_rlock:
+            return self._lk._is_owned()
+        # mirror threading.Condition's fallback probe for plain locks
+        if self._lk.acquire(False):
+            self._lk.release()
+            return False
+        return True
+
+    def _at_fork_reinit(self) -> None:
+        self._lk._at_fork_reinit()
+        _tls.held = []
+
+    def __repr__(self) -> str:
+        return f"<witnessed {self._lk!r} from {self._site}>"
+
+
+def _alloc_site() -> str | None:
+    """file:line of the Lock()/RLock() call when it lives inside the
+    repo (package or tests); None for foreign allocations."""
+    frame = sys._getframe(2)
+    path = frame.f_code.co_filename
+    if not path.startswith(_REPO_ROOT):
+        return None
+    return f"{os.path.relpath(path, _REPO_ROOT)}:{frame.f_lineno}"
+
+
+def _lock_factory():
+    site = _alloc_site()
+    raw = _RAW_LOCK()
+    if site is None:
+        return raw
+    return _WitnessLock(raw, site, is_rlock=False)
+
+
+def _rlock_factory():
+    site = _alloc_site()
+    raw = _RAW_RLOCK()
+    if site is None:
+        return raw
+    return _WitnessLock(raw, site, is_rlock=True)
+
+
+def install() -> None:
+    """Patch threading.Lock/RLock. Locks allocated BEFORE install (or
+    from outside the repo) stay raw — the witness is additive, never
+    load-bearing. Idempotent."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _RAW_LOCK
+    threading.RLock = _RAW_RLOCK
+    _installed = False
+
+
+def inversions() -> list[dict]:
+    with _state_lock:
+        return list(_inversions)
+
+
+def snapshot() -> dict:
+    """Counters for diagnostics/tests."""
+    with _state_lock:
+        return {
+            "edges": sum(len(v) for v in _edges.values()),
+            "nodes": len(_edges),
+            "inversions": len(_inversions),
+        }
+
+
+def format_inversions(found: list[dict]) -> str:
+    out = []
+    for inv in found:
+        out.append(
+            f"lock-order inversion: thread {inv['thread']} acquired "
+            f"{inv['acquiring']} while holding {inv['held']}, but the "
+            f"opposite nesting was established earlier "
+            f"({inv['established']})\n{inv['stack']}"
+        )
+    return "\n".join(out)

@@ -326,7 +326,11 @@ class _RawHTTPConnection:
                     ) from None
                 body = self._read_exact(n)
             else:
-                body = self.rfile.read()  # EOF-delimited (HTTP/1.0 style)
+                # EOF-delimited HTTP/1.0-style body: unbounded by spec;
+                # the pooled socket carries a recv deadline, so a dead
+                # peer trips the timeout, not an infinite park
+                # weedlint: ignore[hot-loop-unbounded-read] — EOF framing is the protocol here and the socket timeout bounds every recv
+                body = self.rfile.read()
                 will_close = True
         return status, headers, body, will_close
 

@@ -163,8 +163,13 @@ class EcVolume:
                 self.directory, self.volume_id, shard_id, self.collection
             )
             # a freshly (re)mounted shard file is a repaired one: the
-            # rebuild path wrote a new full-length file at this path
-            self.quarantined.pop(shard_id, None)
+            # rebuild path wrote a new full-length file at this path.
+            # The pop takes the quarantine lock: an admin remount racing
+            # a scrub thread's quarantine decision must serialize, or
+            # the marker for a shard quarantined mid-mount is lost
+            # (weedlint unguarded-write finding, OPERATIONS.md round 9)
+            with self._quarantine_lock:
+                self.quarantined.pop(shard_id, None)
 
     def unmount_shard(self, shard_id: int) -> None:
         # deliberately does NOT close the shard's fd: handler threads

@@ -482,8 +482,13 @@ class KernelFuseMount:
                                  4096, 255, 4096, 0)
         if opcode in (RELEASE, RELEASEDIR):
             fh, *_ = _RELEASE_IN.unpack_from(body)
-            self._dirbufs.pop(fh, None)
-            f = self._handles.pop(fh, None)
+            # handle-table pops under _maps_lock: strands for DIFFERENT
+            # nodeids run on pool threads concurrently, and an OPEN
+            # allocating a handle must never interleave a half-done
+            # release (weedlint unguarded-write, OPERATIONS.md round 9)
+            with self._maps_lock:
+                self._dirbufs.pop(fh, None)
+                f = self._handles.pop(fh, None)
             if f is not None:
                 f.close()
             return b""
@@ -504,7 +509,10 @@ class KernelFuseMount:
             buf = self._dirbufs.get(fh)
             if buf is None or offset == 0:
                 buf = self._dirents(nodeid)
-                self._dirbufs[fh] = buf
+                # same _maps_lock discipline as RELEASE's pop of this
+                # table (weedlint unguarded-write, OPERATIONS.md round 9)
+                with self._maps_lock:
+                    self._dirbufs[fh] = buf
             # whole records only: the kernel cannot parse a dirent cut
             # mid-record, so stop at the last boundary that fits
             end = offset

@@ -16,6 +16,16 @@ update a deps tuple (that bit during PR 2's needle_ext GIL change:
 the .so predated the edited needle.c and kept loading). When the
 artifact is stale and no compiler works, the loader WARNS and returns
 None (pure-Python fallback) rather than dlopening the old code.
+
+Hardening (weedlint C tier, docs/ANALYSIS.md): every build runs with
+-Wall -Wextra -Werror — the shims are the one part of the tree no
+interpreter-level tooling can see into, so the compiler's analysis is
+the lint tier and a warning is a build failure, never a note lost in a
+subprocess pipe. `WEED_NATIVE_SAN=asan|ubsan` switches the whole shim
+tier to a sanitizer build (separate artifact names, so sanitized and
+production caches never collide). An ASan .so only dlopens when the
+ASan runtime is preloaded; `asan_preload_env()` hands callers the
+LD_PRELOAD/ASAN_OPTIONS recipe the sanitizer smoke uses.
 """
 
 from __future__ import annotations
@@ -31,7 +41,61 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 
 _COMPILERS = ("cc", "gcc", "g++", "clang")
 
+# the compiler IS the C tier's linter: keep every shim warning-clean
+# (blanket suppressions are a weedlint finding, not a fix)
+_WARN_FLAGS = ("-Wall", "-Wextra", "-Werror")
+
+_SAN_FLAGS = {
+    "asan": (
+        "-O1", "-g", "-fsanitize=address", "-fno-omit-frame-pointer",
+    ),
+    "ubsan": (
+        "-O1", "-g", "-fsanitize=undefined",
+        "-fno-sanitize-recover=undefined", "-fno-omit-frame-pointer",
+    ),
+}
+
 _INCLUDE_RE = re.compile(rb'^[ \t]*#[ \t]*include[ \t]*"([^"]+)"', re.M)
+
+
+def san_mode() -> str:
+    """'' (production), 'asan', or 'ubsan' — from WEED_NATIVE_SAN."""
+    mode = os.environ.get("WEED_NATIVE_SAN", "").strip().lower()
+    return mode if mode in _SAN_FLAGS else ""
+
+
+def _san_so_name(so_name: str, mode: str) -> str:
+    """Sanitized artifacts get their own cache names (_crc32c.asan.so):
+    a sanitizer .so silently replacing the production cache would make
+    every later plain run dlopen-fail into the slow Python fallback."""
+    if not mode:
+        return so_name
+    base, ext = os.path.splitext(so_name)
+    return f"{base}.{mode}{ext}"
+
+
+def asan_preload_env() -> dict[str, str] | None:
+    """Env additions that let a stock (non-ASan) python dlopen an
+    ASan-built shim: LD_PRELOAD the compiler's ASan runtime. None when
+    no compiler can name one. detect_leaks=0 because CPython itself
+    "leaks" interned/static allocations at exit; the point here is
+    heap-corruption coverage of the C parsers, not CPython leak audits."""
+    for cc in _COMPILERS:
+        try:
+            proc = subprocess.run(
+                [cc, "-print-file-name=libasan.so"],
+                capture_output=True,
+                timeout=10,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        path = proc.stdout.decode().strip()
+        if proc.returncode == 0 and os.path.isabs(path) and os.path.exists(path):
+            return {
+                "LD_PRELOAD": path,
+                "ASAN_OPTIONS": "detect_leaks=0:verify_asan_link_order=0",
+            }
+    return None
 
 
 def _local_includes(src: str, seen: set[str] | None = None) -> set[str]:
@@ -55,6 +119,25 @@ def _local_includes(src: str, seen: set[str] | None = None) -> set[str]:
     return seen
 
 
+def compile_cmd(
+    cc: str,
+    src: str,
+    out: str,
+    includes: tuple[str, ...] = (),
+    warn_flags: tuple[str, ...] = _WARN_FLAGS,
+) -> list[str]:
+    """The ONE cc command line for a native shim: production builds
+    (`_compile`) and the weedlint c-warnings tier both use exactly
+    this, so the lint tier can never drift from what actually ships."""
+    mode = san_mode()
+    opt = _SAN_FLAGS[mode] if mode else ("-O2",)
+    return (
+        [cc, *opt, "-shared", "-fPIC", *warn_flags]
+        + [f"-I{i}" for i in includes]
+        + ["-o", out, src]
+    )
+
+
 def _compile(src: str, so: str, deps: tuple[str, ...], includes: tuple[str, ...]) -> str | None:
     """Compile src → so unless the cached .so is newer than src AND
     every #included dep (scanned from the sources + any caller-passed
@@ -72,26 +155,47 @@ def _compile(src: str, so: str, deps: tuple[str, ...], includes: tuple[str, ...]
             return so
         stale = os.path.exists(so)
         for cc in _COMPILERS:
-            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
-            os.close(fd)
-            try:
-                proc = subprocess.run(
-                    [cc, "-O2", "-shared", "-fPIC"]
-                    + [f"-I{i}" for i in includes]
-                    + ["-o", tmp, src],
-                    capture_output=True,
-                    timeout=60,
-                )
-                if proc.returncode == 0:
-                    os.replace(tmp, so)
-                    return so
-            except (OSError, subprocess.TimeoutExpired):
-                pass
-            finally:
+            # -Werror first (the lint contract), but a FUTURE compiler
+            # inventing a new -Wextra diagnostic must not silently
+            # demote the whole native tier to the Python fallback:
+            # when the -Werror failure was warning-promoted (and only
+            # then — a hard error retried is just doubled latency),
+            # retry warnings-non-fatal and make the debt loud. The
+            # weedlint c-warnings check still fails the tree until the
+            # warning is fixed.
+            for warn_flags in (_WARN_FLAGS, _WARN_FLAGS[:-1]):
+                fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+                os.close(fd)
                 try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
+                    proc = subprocess.run(
+                        compile_cmd(
+                            cc, src, tmp, includes, warn_flags
+                        ),
+                        capture_output=True,
+                        timeout=60,
+                    )
+                    if proc.returncode == 0:
+                        if "-Werror" not in warn_flags:
+                            warnings.warn(
+                                f"{os.path.basename(src)} only compiles "
+                                f"with warnings on this host ({cc}); "
+                                f"loading it anyway — run `python -m "
+                                f"seaweedfs_tpu.analysis --rules c` and "
+                                f"fix the diagnostics",
+                                RuntimeWarning,
+                                stacklevel=2,
+                            )
+                        os.replace(tmp, so)
+                        return so
+                    if b"-Werror" not in proc.stderr:
+                        break  # hard error: the retry cannot help
+                except (OSError, subprocess.TimeoutExpired):
+                    break  # no such compiler / wedged: next compiler
+                finally:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
         if stale:
             # an out-of-date artifact exists but cannot be rebuilt on
             # this host: never load it silently — the pure-Python
@@ -110,6 +214,7 @@ def _compile(src: str, so: str, deps: tuple[str, ...], includes: tuple[str, ...]
 
 def load(src_name: str, so_name: str, deps: tuple[str, ...] = ()) -> ctypes.CDLL | None:
     """Compile src_name → so_name (cached; rebuilt when stale) and dlopen it."""
+    so_name = _san_so_name(so_name, san_mode())
     built = _compile(os.path.join(_HERE, src_name), os.path.join(_HERE, so_name), deps, ())
     if built is None:
         return None
@@ -129,7 +234,7 @@ def load_ext(src_name: str, mod_name: str, deps: tuple[str, ...] = ()):
     includes = tuple(dict.fromkeys((paths["include"], paths["platinclude"])))
     built = _compile(
         os.path.join(_HERE, src_name),
-        os.path.join(_HERE, mod_name + ".so"),
+        os.path.join(_HERE, _san_so_name(mod_name + ".so", san_mode())),
         deps,
         includes,
     )

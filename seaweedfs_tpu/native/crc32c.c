@@ -79,11 +79,14 @@ static uint32_t crc_sw(uint32_t c, const uint8_t *p, size_t n) {
     return c;
 }
 
-/* Standard CRC-32C continuing from `crc` (pre-inversion handled here). */
-uint32_t weed_crc32c(uint32_t crc, const uint8_t *data, size_t n) {
+/* Standard CRC-32C continuing from `crc` (pre-inversion handled here).
+ * `data` is const void *: callers hold char/uint8_t buffers alike and
+ * must not need signedness casts (-Wpointer-sign under -Werror). */
+uint32_t weed_crc32c(uint32_t crc, const void *data, size_t n) {
+    const uint8_t *p = (const uint8_t *)data;
     uint32_t c = crc ^ 0xFFFFFFFFu;
 #ifdef HAVE_X86
-    if (use_hw) return crc_hw(c, data, n) ^ 0xFFFFFFFFu;
+    if (use_hw) return crc_hw(c, p, n) ^ 0xFFFFFFFFu;
 #endif
-    return crc_sw(c, data, n) ^ 0xFFFFFFFFu;
+    return crc_sw(c, p, n) ^ 0xFFFFFFFFu;
 }

@@ -38,9 +38,13 @@ static inline uint32_t masked(uint32_t crc) {
 }
 
 /* Worst-case record length for buffer sizing (name/mime capped at 255,
- * pairs < 64KiB enforced by the Python caller). */
+ * pairs < 64KiB enforced by the Python caller). name_len/mime_len stay
+ * in the signature for call-site symmetry with weed_needle_encode, but
+ * the bound uses their 255-byte caps, not the actual lengths. */
 long weed_needle_max_size(uint32_t data_len, uint32_t name_len,
                           uint32_t mime_len, uint32_t pairs_len) {
+    (void)name_len;
+    (void)mime_len;
     return (long)HEADER + 4 + (long)data_len + 1 + 1 + 255 + 1 + 255 + 5 + 2 +
            2 + (long)pairs_len + CHECKSUM + V3_TIMESTAMP + PAD;
 }
@@ -60,7 +64,7 @@ long weed_needle_encode(uint8_t *out, uint32_t cookie, uint64_t id,
         return -1;
     if (name_len > 255) name_len = 255; /* NameSize u8 cap, as to_bytes */
 
-    uint32_t crc = weed_crc32c(0, (const char *)data, data_len);
+    uint32_t crc = weed_crc32c(0, data, data_len);
     *crc_out = crc;
     uint8_t *p = out + HEADER;
     uint32_t size;

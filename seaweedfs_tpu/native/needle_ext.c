@@ -18,7 +18,7 @@
 #include "needle.c"
 #include "post.c"
 
-static PyObject *py_encode(PyObject *self, PyObject *const *args,
+static PyObject *py_encode(PyObject *Py_UNUSED(self), PyObject *const *args,
                            Py_ssize_t nargs) {
     if (nargs != 11) {
         PyErr_SetString(PyExc_TypeError, "encode() takes 11 arguments");
@@ -117,7 +117,7 @@ err_data:
  * expected_size < 0 skips the index-size cross-check.  Raises
  * ValueError with the same messages Needle.from_bytes uses (the Python
  * wrapper re-raises them as CorruptNeedle). */
-static PyObject *py_decode(PyObject *self, PyObject *const *args,
+static PyObject *py_decode(PyObject *Py_UNUSED(self), PyObject *const *args,
                            Py_ssize_t nargs) {
     if (nargs != 3) {
         PyErr_SetString(PyExc_TypeError, "decode() takes 3 arguments");
@@ -253,10 +253,10 @@ static PyObject *py_decode(PyObject *self, PyObject *const *args,
              * foreground p99 whenever the scrubber is re-reading). The
              * source buffer is pinned by the caller's Py_buffer. */
             Py_BEGIN_ALLOW_THREADS
-            crc = weed_crc32c(0, (const char *)data_p, data_len);
+            crc = weed_crc32c(0, data_p, data_len);
             Py_END_ALLOW_THREADS
         } else {
-            crc = weed_crc32c(0, (const char *)data_p, data_len);
+            crc = weed_crc32c(0, data_p, data_len);
         }
         if (stored != masked(crc)) {
             err = "CRC error! Data On Disk Corrupted";
@@ -309,7 +309,7 @@ out:
  * pwrite, reply formatting — runs with the GIL RELEASED (post.c); the
  * caller holds the volume lock, which a GIL release does not drop, so
  * the single-writer-per-volume invariant is untouched. */
-static PyObject *py_post(PyObject *self, PyObject *const *args,
+static PyObject *py_post(PyObject *Py_UNUSED(self), PyObject *const *args,
                          Py_ssize_t nargs) {
     if (nargs != 15) {
         PyErr_SetString(PyExc_TypeError, "post() takes 15 arguments");
@@ -379,16 +379,23 @@ err_body:
     return NULL;
 }
 
+/* METH_FASTCALL entries are _PyCFunctionFast, not PyCFunction; the
+ * double cast through a generic function pointer is the CPython-
+ * sanctioned spelling (what 3.11's _PyCFunction_CAST expands to) and
+ * keeps -Wcast-function-type quiet under -Werror. */
+#define FASTCALL_CAST(f) ((PyCFunction)(void (*)(void))(f))
+
 static PyMethodDef methods[] = {
-    {"encode", (PyCFunction)py_encode, METH_FASTCALL,
+    {"encode", FASTCALL_CAST(py_encode), METH_FASTCALL,
      "serialize one needle record"},
-    {"decode", (PyCFunction)py_decode, METH_FASTCALL,
+    {"decode", FASTCALL_CAST(py_decode), METH_FASTCALL,
      "parse + CRC-verify one needle record"},
-    {"post", (PyCFunction)py_post, METH_FASTCALL,
+    {"post", FASTCALL_CAST(py_post), METH_FASTCALL,
      "one-pass volume POST: extract + assemble + CRC + pwrite + reply"},
     {NULL, NULL, 0, NULL}};
 
-static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_needle_ext",
-                                       NULL, -1, methods};
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_needle_ext", NULL, -1, methods,
+    NULL, NULL, NULL, NULL};
 
 PyMODINIT_FUNC PyInit__needle_ext(void) { return PyModule_Create(&moduledef); }

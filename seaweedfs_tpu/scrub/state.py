@@ -70,12 +70,16 @@ class ScrubState:
                 raw = json.load(f)
         except (OSError, ValueError):
             return
-        for d in raw.get("volumes", []):
-            try:
-                h = VolumeScrubHealth.from_dict(d)
-            except TypeError:
-                continue  # unknown/legacy row: start that volume fresh
-            self.volumes[(h.volume_id, h.is_ec)] = h
+        # same lock as get/forget/save: load() is construction-time
+        # today, but it is a public method on a table that heartbeat
+        # and engine threads read — keep the guard discipline uniform
+        with self._lock:
+            for d in raw.get("volumes", []):
+                try:
+                    h = VolumeScrubHealth.from_dict(d)
+                except TypeError:
+                    continue  # unknown/legacy row: start that volume fresh
+                self.volumes[(h.volume_id, h.is_ec)] = h
 
     def get(self, volume_id: int, is_ec: bool) -> VolumeScrubHealth:
         with self._lock:

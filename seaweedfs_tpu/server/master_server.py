@@ -466,6 +466,7 @@ class MasterServer:
                 return ch, rpc.master_stub(ch)
             if time.time() >= deadline:
                 return "unknown"
+            # weedlint: ignore[hot-loop-sleep] — bounded 3 s leader-election wait; failing instantly would 503 every read during each election window
             time.sleep(0.05)
 
     def _proxy_or_abort(self, context, verb: str, req, timeout: float):

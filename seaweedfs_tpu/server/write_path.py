@@ -184,6 +184,7 @@ def build_upload_needle(
 
             # mtime=0: replicas re-derive the needle from the raw
             # body, so the stream must be identical
+            # weedlint: ignore[hot-loop-gil-span] — transparent compression is the write contract (byte-identical replicas); the C tier declines these bodies by design
             packed = _gzip.compress(bytes(n.data), 6, mtime=0)
             if len(packed) < len(n.data):
                 n.data = packed

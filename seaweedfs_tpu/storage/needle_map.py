@@ -310,19 +310,24 @@ class DbNeedleMap:
         )
 
     def _replay(self, key: int, offset: int, size: int) -> None:
-        self.max_file_key = max(self.max_file_key, key)
-        if offset != 0 and size != t.TOMBSTONE_FILE_SIZE:
-            self.file_count += 1
-            self.file_byte_count += size
-            old = self._db_get(key)
-            self._db_set(key, offset, size)
-            if old is not None and old[0] != 0 and old[1] != t.TOMBSTONE_FILE_SIZE:
+        # same guard as put/delete: replays arrive from the follower
+        # refresh path while handler threads run get() concurrently —
+        # the counters and the sqlite handle share one protection
+        # (weedlint unguarded-write finding, OPERATIONS.md round 9)
+        with self._lock:
+            self.max_file_key = max(self.max_file_key, key)
+            if offset != 0 and size != t.TOMBSTONE_FILE_SIZE:
+                self.file_count += 1
+                self.file_byte_count += size
+                old = self._db_get(key)
+                self._db_set(key, offset, size)
+                if old is not None and old[0] != 0 and old[1] != t.TOMBSTONE_FILE_SIZE:
+                    self.deletion_count += 1
+                    self.deletion_byte_count += old[1]
+            else:
+                freed = self._delete_in_db(key)
                 self.deletion_count += 1
-                self.deletion_byte_count += old[1]
-        else:
-            freed = self._delete_in_db(key)
-            self.deletion_count += 1
-            self.deletion_byte_count += freed
+                self.deletion_byte_count += freed
 
     def _delete_in_db(self, key: int) -> int:
         old = self._db_get(key)
@@ -408,24 +413,30 @@ class DbNeedleMap:
             return 0
 
     def close(self) -> None:
-        if self._index_file is not None:
-            self._index_file.close()
-            self._index_file = None
-        # checkpoint: metrics + watermark + clean flag in one commit;
-        # a crash before this point triggers a full rebuild on load
-        try:
-            self._save_metrics()
-            self._meta_set(
-                "idx_bytes",
-                os.path.getsize(self._index_path)
-                if os.path.exists(self._index_path)
-                else 0,
-            )
-            self._meta_set("clean", 1)
-            self._db.commit()
-            self._db.close()
-        except Exception:  # noqa: BLE001 - already closed
-            pass
+        # under the map lock: close races a concurrent put/_replay from
+        # a handler or follower-refresh thread during volume teardown,
+        # and a half-torn _index_file/_db pair here means the checkpoint
+        # below records a watermark for writes that never committed
+        # (weedlint unguarded-write finding, OPERATIONS.md round 9)
+        with self._lock:
+            if self._index_file is not None:
+                self._index_file.close()
+                self._index_file = None
+            # checkpoint: metrics + watermark + clean flag in one commit;
+            # a crash before this point triggers a full rebuild on load
+            try:
+                self._save_metrics()
+                self._meta_set(
+                    "idx_bytes",
+                    os.path.getsize(self._index_path)
+                    if os.path.exists(self._index_path)
+                    else 0,
+                )
+                self._meta_set("clean", 1)
+                self._db.commit()
+                self._db.close()
+            except Exception:  # noqa: BLE001 - already closed
+                pass
 
     def destroy(self) -> None:
         self.close()

@@ -36,17 +36,22 @@ class VolumeInfo:
 
     @staticmethod
     def from_volume(v: Volume) -> "VolumeInfo":
+        # stats_snapshot holds the volume lock: the heartbeat thread
+        # must not race commit_compact's .dat/.idx + needle-map swap —
+        # an unlocked data_file_size() there seeks a CLOSED file, the
+        # raised ValueError kills the heartbeat stream, the master's
+        # liveness sweep drops the node's volumes, and the next
+        # /dir/assign 500s ("no writable volumes"). Root cause of the
+        # torn-read/vacuum stack-test flake (CHANGES PR 3); found
+        # chasing the weedlint unguarded-write class, OPERATIONS.md
+        # round 9.
         return VolumeInfo(
             id=v.id,
-            size=v.data_file_size(),
             collection=v.collection,
-            file_count=v.file_count(),
-            delete_count=v.deleted_count(),
-            deleted_byte_count=v.deleted_size(),
-            read_only=v.read_only,
             replica_placement=v.super_block.replica_placement.to_byte(),
             version=v.version,
             ttl=v.ttl.to_uint32(),
+            **v.stats_snapshot(),
         )
 
 

@@ -308,6 +308,22 @@ class Volume:
     def max_file_key(self) -> int:
         return self.nm.max_file_key
 
+    def stats_snapshot(self) -> dict:
+        """Consistent stat row for the heartbeat, under the volume
+        lock: commit_compact swaps .dat/.idx and the needle map while
+        holding self._lock, and an unlocked data_file_size() there
+        seeks a CLOSED file — callers must never sample these fields
+        without the lock, and this method is the supported way to get
+        them from outside the class."""
+        with self._lock:
+            return {
+                "size": self.data_file_size(),
+                "file_count": self.file_count(),
+                "delete_count": self.deleted_count(),
+                "deleted_byte_count": self.deleted_size(),
+                "read_only": self.read_only,
+            }
+
     def garbage_level(self) -> float:
         """Fraction of the .dat occupied by deleted records
         (volume_vacuum.go garbageLevel)."""
@@ -649,12 +665,17 @@ class Volume:
             self._append_end = os.fstat(self._fd).st_size
 
     def cleanup_compact(self) -> None:
-        self._compact_snapshot_idx = None
-        self._compact_snapshot_size = None
-        for ext in (".cpd", ".cpx"):
-            path = self.base_name + ext
-            if os.path.exists(path):
-                os.remove(path)
+        # under the volume lock: the snapshot markers are written by
+        # compact()/commit_compact() under it, and an abort racing a
+        # late commit must not clear the boundary mid-makeup-diff
+        # (weedlint unguarded-write finding, OPERATIONS.md round 9)
+        with self._lock:
+            self._compact_snapshot_idx = None
+            self._compact_snapshot_size = None
+            for ext in (".cpd", ".cpx"):
+                path = self.base_name + ext
+                if os.path.exists(path):
+                    os.remove(path)
 
     # --- lifecycle ---
     def close(self) -> None:

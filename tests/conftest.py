@@ -24,6 +24,18 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Dynamic lock-order witness (analysis/witness.py): wraps Lock/RLock
+# allocation for locks created in repo files and fails the run on any
+# runtime acquisition-order inversion — the `-race`-style complement
+# to the static weedlint pass, ON by default in tier-1. Installed here,
+# before any seaweedfs_tpu module import can allocate its locks.
+# WEED_LOCK_WITNESS=0 disables (e.g. when bisecting a perf number).
+_WITNESS_ON = os.environ.get("WEED_LOCK_WITNESS", "1") != "0"
+if _WITNESS_ON:
+    from seaweedfs_tpu.analysis import witness as _witness
+
+    _witness.install()
+
 # Unit tests default to the cpu codec (fast, no per-shape jit compiles);
 # the TPU serving path is covered explicitly by tests that pass
 # ec_codec="tpu" / backend="tpu" (e.g. test_ec_tpu_serving.py), which
@@ -37,6 +49,15 @@ import pytest
 REFERENCE_ROOT = pathlib.Path("/root/reference")
 
 
+def pytest_configure(config):
+    # tier-1 deselects with `-m 'not slow'`; registering the marker
+    # keeps the run warning-clean (unknown-mark warnings drown real
+    # ones in the tail summary)
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from the tier-1 sweep"
+    )
+
+
 @pytest.fixture(scope="session")
 def reference_root() -> pathlib.Path:
     """Path to the read-only reference checkout; tests that golden-check
@@ -45,6 +66,26 @@ def reference_root() -> pathlib.Path:
     if not REFERENCE_ROOT.exists():
         pytest.skip("reference checkout not available")
     return REFERENCE_ROOT
+
+
+@pytest.fixture(autouse=_WITNESS_ON)
+def _lock_order_witness():
+    """Fails the test during which a lock-order inversion completed.
+    The order graph is cumulative across the whole session (an
+    inversion needs one test to establish A→B and possibly a later one
+    to demonstrate B→A), so the failing test is the one that CLOSED
+    the cycle — its stack is in the report."""
+    from seaweedfs_tpu.analysis import witness as _w
+
+    before = len(_w.inversions())
+    yield
+    found = _w.inversions()[before:]
+    if found:
+        pytest.fail(
+            "dynamic lock-order witness detected inversion(s):\n"
+            + _w.format_inversions(found),
+            pytrace=False,
+        )
 
 
 @pytest.fixture(scope="session")

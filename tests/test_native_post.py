@@ -242,7 +242,13 @@ class TestBenchCheckSmoke:
         import subprocess
         import sys
 
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # inner marker: from inside tier-1 the smoke only needs the
+        # one-write C/Python identity leg — the weedlint and sanitizer
+        # legs of --check run their own tests (test_weedlint.py,
+        # test_fuzz_corpus.py) and would recurse/slow the suite here
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu", WEED_BENCH_CHECK_INNER="1"
+        )
         proc = subprocess.run(
             [sys.executable, "bench.py", "--check"],
             capture_output=True,

@@ -280,7 +280,11 @@ class TestWorkersCli:
 
         procs = [spawn("master", "-port", str(mport))]
         try:
-            deadline = time.time() + 30
+            # generous spawn deadlines: each subprocess pays a fresh
+            # interpreter + jax import, which stretches from ~3 s to
+            # tens of seconds when the host throttles mid-suite (this
+            # test failed a full-suite run on exactly that)
+            deadline = time.time() + 60
             while time.time() < deadline:
                 try:
                     urllib.request.urlopen(
@@ -307,7 +311,7 @@ class TestWorkersCli:
                 ) as r:
                     return json.loads(r.read())
 
-            deadline = time.time() + 60
+            deadline = time.time() + 120
             fid = None
             while time.time() < deadline:
                 try:
@@ -466,6 +470,22 @@ class TestTornReadUnderVacuum:
                 owner.compact()
                 owner.commit_compact()
                 commits += 1
+                # PACE, don't race: wait until the readers demonstrably
+                # crossed this commit before firing the next one. The
+                # old free-running loop asserted a read RATE
+                # (reads > 3×commits), which is a scheduler property —
+                # on a loaded 1-vCPU host the readers can legitimately
+                # starve and the assertion flaked (CHANGES PR 3). The
+                # torn-read property needs INTERLEAVING, and pacing
+                # guarantees ≥1 read per commit deterministically.
+                target = reads[0] + 1
+                deadline = time.time() + 30
+                while reads[0] < target and time.time() < deadline:
+                    time.sleep(0.002)
+                assert reads[0] >= target, (
+                    f"readers made no progress across commit {commits} "
+                    f"within 30s; failures so far: {failures[:5]}"
+                )
         finally:
             stop.set()
             for t in threads:
@@ -473,10 +493,8 @@ class TestTornReadUnderVacuum:
 
         assert commits >= 50
         assert not failures, failures[:10]
-        # floor = interleaving, not absolute rate: under full-suite
-        # load on the 1-vCPU host the two readers can get < 10% of
-        # the core, but they must still cross the commit loop often
-        assert reads[0] > 3 * commits, f"only {reads[0]} reads crossed the loop"
+        # interleaving floor now holds by construction (paced loop)
+        assert reads[0] >= commits, f"only {reads[0]} reads crossed the loop"
 
     def test_stack_reader_vs_grpc_vacuum_loop(self, stack):
         """Same property through the wire: hammer the worker's HTTP
@@ -546,15 +564,28 @@ class TestTornReadUnderVacuum:
                         volume_pb2.VacuumVolumeCommitRequest(volume_id=vid)
                     )
                     commits += 1
+                    # PACE the commit loop on demonstrated read
+                    # progress (same deflake as the in-process test):
+                    # the wire property is reads INTERLEAVING commits,
+                    # and the old free-running `reads > 50` floor was
+                    # a scheduler-rate assertion that flaked whenever
+                    # the reader thread starved on a loaded host
+                    target = reads[0] + 1
+                    deadline = time.time() + 30
+                    while reads[0] < target and time.time() < deadline:
+                        time.sleep(0.002)
+                    assert reads[0] >= target, (
+                        f"reader made no progress across commit "
+                        f"{commits} within 30s; failures: {failures[:5]}"
+                    )
         finally:
             stop.set()
             t.join(timeout=30)
 
         assert commits >= 50
         assert not failures, failures[:10]
-        # ~1.6 reads/commit on a loaded 1-vCPU host; the property needs
-        # reads to INTERLEAVE the commits, not any absolute rate
-        assert reads[0] > 50
+        # ≥1 read per commit holds by construction (paced loop)
+        assert reads[0] >= commits
 
     def _assign_to(self, mport):
         import json
