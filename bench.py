@@ -1106,6 +1106,128 @@ def bench_scrub() -> None:
     )
 
 
+def bench_trace() -> None:
+    """Tracing plane A/B + stage attribution (docs/TRACING.md):
+
+    Line 1 — `trace_write_overhead`: the volume write hot path with
+    tracing on (full fidelity), sampled (-traceSample 16), and off,
+    toggled in-process and interleaved PER WRITE so host-throttle
+    drift is common-mode by construction — this rig's CPU clock ticks
+    at 10 ms and its speed swings 2-4x on multi-second timescales
+    (OPERATIONS.md round 10), which poisons every block-level process-
+    CPU estimator; per-write WALL medians resolve sub-microsecond
+    deltas (a planted no-op control measures +0.3 us). vs_baseline =
+    off/on medians; overhead_us is the median-of-arm-medians delta.
+    The acceptance bound (<= 2%, vs_baseline >= 0.98) is met by the
+    sampled arm on this rig; full fidelity measures ~4% here, ~12 us
+    of which is the span lifecycle itself (tight-loop) and the rest
+    this rig's per-request cold-cache residue — see round 10 for the
+    decomposition and the projection to the reference rig.
+
+    Line 2 — `trace_stage_breakdown`: per-stage p50/p99 microseconds
+    across the traced arm's volume.post spans — the stage attribution
+    future perf PRs cite instead of end-to-end guesses.
+    """
+    import json as _json
+    import statistics
+    import tempfile
+    import urllib.request as _rq
+
+    from seaweedfs_tpu import trace
+    from seaweedfs_tpu.client.operation import _drop_conn, _pooled_conn
+    from seaweedfs_tpu.command.servers import _tune_gc
+    from seaweedfs_tpu.util.availability import start_cluster
+
+    _tune_gc()
+    n_writes, warmup, sample_n = 6000, 200, 16
+    payload = b"\x00\x01trace-bench-payload\xff" * 50  # ~1 KB, not gzippable
+    # arm per write, round-robin: off / on (full) / on (sampled 1-in-sample_n)
+    arms = ("off", "on", "sampled")
+    with tempfile.TemporaryDirectory() as d:
+        master, servers = start_cluster([tempfile.mkdtemp(dir=d)])
+        m = f"127.0.0.1:{master.port}"
+        addr = f"127.0.0.1:{servers[0].port}"
+        lat: dict[str, list[float]] = {a: [] for a in arms}
+        try:
+            with _rq.urlopen(
+                f"http://{m}/dir/assign?count={n_writes + 1}", timeout=10
+            ) as r:
+                base_fid = _json.load(r)["fid"]
+            c, _ = _pooled_conn(addr, 30.0)
+            try:
+                for i in range(n_writes):
+                    arm = arms[i % len(arms)]
+                    trace.set_enabled(arm != "off")
+                    trace.set_sample_every(
+                        sample_n if arm == "sampled" else 1
+                    )
+                    fid = f"{base_fid}_{i}" if i else base_fid
+                    t0 = time.perf_counter()
+                    c.send_request(
+                        "POST", f"/{fid}", payload,
+                        {"Content-Type": "application/octet-stream"},
+                    )
+                    status, _h, _b, will_close = c.read_response("POST")
+                    dt = time.perf_counter() - t0
+                    assert status == 201, f"write {fid} -> {status}"
+                    if will_close:
+                        _drop_conn(addr)
+                        c, _ = _pooled_conn(addr, 30.0)
+                    if i >= warmup:
+                        lat[arm].append(dt)
+            finally:
+                _drop_conn(addr)
+                trace.set_enabled(True)
+                trace.set_sample_every(1)
+            # stage attribution: the in-process volume server shares
+            # this process's ring, so read it directly
+            stage_samples: dict[str, list[float]] = {}
+            payload_spans = trace.debug_payload(4096)["recent"]
+            for s in payload_spans:
+                if s["name"] != "volume.post" or "stages_ms" not in s:
+                    continue
+                for k, v in s["stages_ms"].items():
+                    stage_samples.setdefault(k, []).append(v * 1000.0)
+        finally:
+            for vs in servers:
+                vs.stop()
+            master.stop()
+    med = {a: statistics.median(lat[a]) * 1e6 for a in arms}
+    delta_us = med["on"] - med["off"]
+    _report(
+        "trace_write_overhead",
+        delta_us,
+        "us",
+        med["off"] / med["on"] if med["on"] > 0 else 1.0,
+        wall_off_us=round(med["off"], 1),
+        wall_on_us=round(med["on"], 1),
+        wall_sampled_us=round(med["sampled"], 1),
+        vs_baseline_sampled=round(
+            med["off"] / med["sampled"] if med["sampled"] > 0 else 1.0, 4
+        ),
+        sample_every=sample_n,
+        writes_per_arm=(n_writes - warmup) // len(arms),
+    )
+
+    def pct(vals: list[float], p: float) -> float:
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(len(vals) * p))]
+
+    stages = {
+        k: {"p50_us": round(pct(v, 0.5), 2), "p99_us": round(pct(v, 0.99), 2)}
+        for k, v in sorted(stage_samples.items())
+    }
+    total_p99 = sum(v["p99_us"] for v in stages.values()) or 1.0
+    _report(
+        "trace_stage_breakdown",
+        total_p99,
+        "us",
+        1.0,
+        stages=stages,
+        spans=len(next(iter(stage_samples.values()), [])),
+    )
+
+
 CONFIGS = {
     "encode": bench_encode,
     "rebuild": bench_rebuild,
@@ -1119,6 +1241,7 @@ CONFIGS = {
     "shard-hop": bench_shard_hop,
     "migration": bench_migration_with_retry,
     "scrub": bench_scrub,
+    "trace": bench_trace,
 }
 
 
@@ -1192,6 +1315,56 @@ def check_native_post() -> int:
         return 0 if ok else 1
     finally:
         Volume._now_ns = orig
+
+
+def check_trace_smoke() -> int:
+    """`bench.py --check` trace leg: one traced write through the HTTP
+    data plane must yield a span tree with the expected shape — a
+    client root, a volume.post child sharing its trace ID, and the five
+    write-path stage names (identical for the C and Python paths)."""
+    import tempfile
+
+    from seaweedfs_tpu import trace
+    from seaweedfs_tpu.client import operation as op
+    from seaweedfs_tpu.server import write_path
+    from seaweedfs_tpu.util.availability import start_cluster
+
+    trace.set_enabled(True)
+    with tempfile.TemporaryDirectory() as d:
+        master, servers = start_cluster([tempfile.mkdtemp(dir=d)])
+        m = f"127.0.0.1:{master.port}"
+        try:
+            with trace.span("check.client") as root:
+                ar = op.assign(m)
+                ur = op.upload(
+                    f"{ar.url}/{ar.fid}",
+                    b"\x00\x07trace-check\xff" * 64,
+                    jwt=ar.auth,
+                )
+                trace_id, root_span = root.trace_id, root.span_id
+        finally:
+            for vs in servers:
+                vs.stop()
+            master.stop()
+    posts = [
+        s
+        for s in trace.debug_payload(512)["recent"]
+        if s["trace"] == trace_id and s["name"] == "volume.post"
+    ]
+    ok = (
+        not ur.error
+        and len(posts) == 1
+        and posts[0]["parent"] == root_span
+        and posts[0]["status"] == 201
+        and set(posts[0].get("stages_ms", ())) == set(write_path.WRITE_STAGES)
+    )
+    print(json.dumps({
+        "metric": "trace_check",
+        "ok": ok,
+        "trace_id": trace_id,
+        "stages": sorted(posts[0].get("stages_ms", ())) if posts else [],
+    }))
+    return 0 if ok else 1
 
 
 def check_weedlint() -> int:
@@ -1285,6 +1458,7 @@ def main() -> None:
         # analysis (weedlint), and memory safety (ASan matrix+corpus);
         # the inner marker keeps subprocess layers from recursing
         rc = check_native_post()
+        rc = rc or check_trace_smoke()
         if os.environ.get("WEED_BENCH_CHECK_INNER") != "1":
             rc = rc or check_weedlint()
             rc = rc or check_sanitizer_smoke()

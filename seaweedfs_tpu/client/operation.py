@@ -378,6 +378,12 @@ def http_call(
         if scheme != "http":
             raise ValueError(f"pooled transport is http-only, got {scheme!r}")
     headers = dict(headers or {})
+    # tracing plane: every pooled-transport hop (assign, upload,
+    # lookup-download, filer chunk writes, worker proxying) carries the
+    # current span's context so the receiving daemon parents under it
+    from seaweedfs_tpu import trace as _trace
+
+    _trace.inject(headers)
     for _hop in range(max_redirects + 1):
         netloc, slash, rest = url.partition("/")
         path = slash + rest or "/"

@@ -52,6 +52,39 @@ def _configure_tls(component: str) -> None:
         rpc.set_tls(tls, cfg.get_string("grpc.server_name"))
 
 
+def _add_trace_flags(p: argparse.ArgumentParser) -> None:
+    """Tracing-plane knobs shared by every daemon command
+    (docs/TRACING.md): -traceSlowMs writes completed slow traces
+    through wlog with the request ID (0 = off); -traceSample N
+    head-samples 1-in-N headerless roots (1 = trace everything)."""
+    p.add_argument(
+        "-traceSlowMs",
+        type=float,
+        default=None,
+        help="log completed root spans slower than this many ms "
+        "through wlog with their trace ID (an explicit 0 disables; "
+        "unset keeps the WEED_TRACE_SLOW_MS env or 0)",
+    )
+    p.add_argument(
+        "-traceSample",
+        type=int,
+        default=0,
+        help="head-sample 1 in N requests without an inbound trace "
+        "header (1 traces every request; 0/default keeps the "
+        "WEED_TRACE_SAMPLE env or 1)",
+    )
+
+
+def _apply_trace_flags(args) -> None:
+    from seaweedfs_tpu import trace
+
+    slow_ms = getattr(args, "traceSlowMs", None)
+    if slow_ms is not None:  # unset keeps the WEED_TRACE_SLOW_MS env
+        trace.set_slow_threshold_ms(slow_ms)
+    if getattr(args, "traceSample", 0) > 0:
+        trace.set_sample_every(args.traceSample)
+
+
 def _load_guard():
     """security.toml → Guard (None when not configured)."""
     from seaweedfs_tpu.security import Guard
@@ -130,12 +163,14 @@ class MasterCommand(Command):
             help="etcd endpoint(s) for the external-KV sequencer "
             "(sequence/etcd_sequencer.go role); default: file/memory",
         )
+        _add_trace_flags(p)
         p.add_argument("-v", type=int, default=0, help="verbosity")
 
     def run(self, args) -> int:
         from seaweedfs_tpu.server.master_server import MasterServer
 
         wlog.set_verbosity(args.v)
+        _apply_trace_flags(args)
         if args.peers and not args.mdir:
             print("master: -peers requires -mdir (persistent raft state)")
             return 2
@@ -239,6 +274,7 @@ class VolumeCommand(Command):
             help="scrub bandwidth cap in MB/s (token bucket protecting "
             "foreground read p99; <=0 = unlimited)",
         )
+        _add_trace_flags(p)
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
@@ -246,6 +282,7 @@ class VolumeCommand(Command):
         from seaweedfs_tpu.util.config import load_config
 
         wlog.set_verbosity(args.v)
+        _apply_trace_flags(args)
         dirs = args.dir.split(",")
         maxes = [int(m) for m in args.max.split(",")]
         if len(maxes) == 1:
@@ -333,12 +370,14 @@ class VolumeWorkerCommand(Command):
         p.add_argument("-writers", type=int, default=1)
         p.add_argument("-mserver", default="")
         p.add_argument("-internalPort", type=int, default=0)
+        _add_trace_flags(p)
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
         from seaweedfs_tpu.server.volume_workers import VolumeReadWorker
 
         wlog.set_verbosity(args.v)
+        _apply_trace_flags(args)
         worker = VolumeReadWorker(
             args.dir.split(","),
             host=args.ip,
@@ -377,6 +416,7 @@ class FilerCommand(Command):
         p.add_argument("-collection", default="")
         p.add_argument("-replication", default="")
         p.add_argument("-maxMB", type=int, default=32)
+        _add_trace_flags(p)
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
@@ -385,6 +425,7 @@ class FilerCommand(Command):
         from seaweedfs_tpu.util.config import load_config
 
         wlog.set_verbosity(args.v)
+        _apply_trace_flags(args)
         notification.configure(load_config("notification"))
         _configure_tls("filer")
         server = FilerServer(
@@ -416,6 +457,7 @@ class S3Command(Command):
         p.add_argument("-filer", default="127.0.0.1:8888")
         p.add_argument("-bucketsPath", default="/buckets")
         p.add_argument("-config", default="", help="identities toml with access/secret keys")
+        _add_trace_flags(p)
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
@@ -424,6 +466,7 @@ class S3Command(Command):
         from seaweedfs_tpu.s3api.auth import Identity, IdentityAccessManagement
 
         wlog.set_verbosity(args.v)
+        _apply_trace_flags(args)
         iam = None
         if args.config:
             from seaweedfs_tpu.util.config import tomllib  # 3.10 fallback parser
@@ -464,6 +507,7 @@ class WebDavCommand(Command):
         p.add_argument("-ip", default="127.0.0.1")
         p.add_argument("-port", type=int, default=7333)
         p.add_argument("-filer", default="127.0.0.1:8888")
+        _add_trace_flags(p)
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
@@ -471,6 +515,7 @@ class WebDavCommand(Command):
         from seaweedfs_tpu.webdav.webdav_server import WebDavServer
 
         wlog.set_verbosity(args.v)
+        _apply_trace_flags(args)
         server = WebDavServer(filer=args.filer, host=args.ip, port=args.port)
         server.start()
         wlog.info("webdav %s:%d -> filer %s", args.ip, args.port, args.filer)
@@ -516,6 +561,7 @@ class ServerCommand(Command):
         p.add_argument("-repairGrace", type=float, default=30.0)
         p.add_argument("-scrubInterval", type=float, default=600.0)
         p.add_argument("-scrubRate", type=float, default=64.0)
+        _add_trace_flags(p)
         p.add_argument("-v", type=int, default=0)
 
     def run(self, args) -> int:
@@ -524,6 +570,7 @@ class ServerCommand(Command):
         from seaweedfs_tpu.server.volume_server import VolumeServer
 
         wlog.set_verbosity(args.v)
+        _apply_trace_flags(args)
         guard = _load_guard()
         started = []
         master = MasterServer(

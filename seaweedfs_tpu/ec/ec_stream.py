@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import errno
 import os
+import sys
 import queue
 import threading
 import time
@@ -61,6 +62,7 @@ from typing import Callable
 
 import numpy as np
 
+from seaweedfs_tpu import trace
 from seaweedfs_tpu.ec import locate
 
 DATA_SHARDS = locate.DATA_SHARDS
@@ -334,6 +336,11 @@ def stream_write_ec_files(
     busy = {"read_s": 0.0, "dispatch_s": 0.0, "fetch_s": 0.0, "write_s": 0.0}
     busy_lock = threading.Lock()
     wall0 = time.perf_counter()
+    # tracing plane: the encode is one span whose stages are the pool
+    # busy totals; entered manually because the body below already owns
+    # the try/finally structure
+    _sp = trace.span("ec_stream.encode", nbytes=dat_size)
+    _sp.__enter__()
 
     idx_lock = threading.Lock()
     idx_iter = iter(range(len(tiles)))
@@ -492,6 +499,11 @@ def stream_write_ec_files(
                     _finish_stats(
                         stats, busy, wall0, reader_threads, writer_threads
                     )
+                _trace_stages(_sp, busy)
+                # a stage error re-raised by pipe.finish() is live in
+                # this finally; hand it to the span so a failed drive
+                # is distinguishable from a clean one in /debug/traces
+                _sp.__exit__(*sys.exc_info())
 
 
 # --- rebuild driver ---------------------------------------------------------
@@ -567,6 +579,13 @@ def stream_rebuild_ec_files(
     busy = {"read_s": 0.0, "dispatch_s": 0.0, "fetch_s": 0.0, "write_s": 0.0}
     busy_lock = threading.Lock()
     wall0 = time.perf_counter()
+    # tracing plane: rebuild span (inherits the scrub/repair plane tag
+    # when the caller's context carries one — cross-plane interference
+    # is then directly measurable on /debug/traces)
+    _sp = trace.span(
+        "ec_stream.rebuild", nbytes=shard_size * max(1, len(targets))
+    )
+    _sp.__enter__()
 
     offsets = list(range(0, shard_size, tile_bytes))
     idx_lock = threading.Lock()
@@ -710,7 +729,26 @@ def stream_rebuild_ec_files(
                     _finish_stats(
                         stats, busy, wall0, reader_threads, writer_threads
                     )
+                _trace_stages(_sp, busy)
+                # a stage error re-raised by pipe.finish() is live in
+                # this finally; hand it to the span so a failed drive
+                # is distinguishable from a clean one in /debug/traces
+                _sp.__exit__(*sys.exc_info())
     return list(targets)
+
+
+def _trace_stages(sp, busy: dict) -> None:
+    """Fold the driver's per-stage busy thread-seconds onto its span as
+    the three pipeline stages an operator reasons about: reader-pool
+    (disk/remote reads), compute (codec dispatch + drain), writer-pool
+    (shard pwritev)."""
+    sp.add_stages(
+        {
+            "reader-pool": busy.get("read_s", 0.0),
+            "compute": busy.get("dispatch_s", 0.0) + busy.get("fetch_s", 0.0),
+            "writer-pool": busy.get("write_s", 0.0),
+        }
+    )
 
 
 def _finish_stats(

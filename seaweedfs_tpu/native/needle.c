@@ -16,12 +16,23 @@
  *   padding 1..8 bytes to 8B alignment (reference quirk: never 0)
  */
 
+#include <time.h>
+
 #include "crc32c.c"
 
 #define V3_TIMESTAMP 8
 #define HEADER 16
 #define CHECKSUM 4
 #define PAD 8
+
+/* Monotonic seconds for the tracing plane's stage timings. One
+ * clock_gettime is ~20 ns — cheap enough to leave on unconditionally
+ * in the hot loop (docs/TRACING.md budgets the whole span at <2%). */
+static inline double w_monotonic(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
 
 static inline void put_u32(uint8_t *p, uint32_t v) {
     p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
@@ -51,7 +62,9 @@ long weed_needle_max_size(uint32_t data_len, uint32_t name_len,
 
 /* Serialize one record into out; returns total length (>0) or -1 on a
  * constraint violation.  size_out gets the stored `size` field,
- * crc_out the RAW (unmasked) CRC32-C of data. */
+ * crc_out the RAW (unmasked) CRC32-C of data.  crc_seconds (nullable)
+ * receives the CRC pass's wall seconds so the tracing plane can report
+ * the crc stage separately from record assembly. */
 long weed_needle_encode(uint8_t *out, uint32_t cookie, uint64_t id,
                         const uint8_t *data, uint32_t data_len, uint32_t flags,
                         const uint8_t *name, uint32_t name_len,
@@ -59,12 +72,14 @@ long weed_needle_encode(uint8_t *out, uint32_t cookie, uint64_t id,
                         uint64_t last_modified, const uint8_t *ttl2,
                         const uint8_t *pairs, uint32_t pairs_len, int version,
                         uint64_t append_at_ns, uint32_t *size_out,
-                        uint32_t *crc_out) {
+                        uint32_t *crc_out, double *crc_seconds) {
     if (mime_len > 255 || pairs_len > 65535 || (version != 1 && version != 2 && version != 3))
         return -1;
     if (name_len > 255) name_len = 255; /* NameSize u8 cap, as to_bytes */
 
+    double tcrc = w_monotonic();
     uint32_t crc = weed_crc32c(0, data, data_len);
+    if (crc_seconds) *crc_seconds = w_monotonic() - tcrc;
     *crc_out = crc;
     uint8_t *p = out + HEADER;
     uint32_t size;

@@ -74,7 +74,8 @@ static PyObject *py_encode(PyObject *Py_UNUSED(self), PyObject *const *args,
             (const uint8_t *)name.buf, (uint32_t)name.len,
             (const uint8_t *)mime.buf, (uint32_t)mime.len, last_modified,
             (const uint8_t *)ttl.buf, (const uint8_t *)pairs.buf,
-            (uint32_t)pairs.len, (int)version, append_at_ns, &size, &crc);
+            (uint32_t)pairs.len, (int)version, append_at_ns, &size, &crc,
+            NULL);
         Py_END_ALLOW_THREADS
     } else {
         total = weed_needle_encode(
@@ -83,7 +84,8 @@ static PyObject *py_encode(PyObject *Py_UNUSED(self), PyObject *const *args,
             (const uint8_t *)name.buf, (uint32_t)name.len,
             (const uint8_t *)mime.buf, (uint32_t)mime.len, last_modified,
             (const uint8_t *)ttl.buf, (const uint8_t *)pairs.buf,
-            (uint32_t)pairs.len, (int)version, append_at_ns, &size, &crc);
+            (uint32_t)pairs.len, (int)version, append_at_ns, &size, &crc,
+            NULL);
     }
     if (ttl.buf) PyBuffer_Release(&ttl);
     PyBuffer_Release(&pairs);
@@ -302,7 +304,9 @@ out:
  *      pairs, base_flags, cookie, id, version, last_modified,
  *      append_at_ns, fd, offset, fix_jpg)
  *   -> None                         needs the Python slow path
- *    | (reply_bytes, total, size)   record pwritten at `offset`
+ *    | (reply_bytes, total, size, (parse_s, assemble_s, crc_s,
+ *       pwrite_s, reply_s))         record pwritten at `offset`;
+ *      the 5-double tuple is the tracing plane's per-stage wall time
  *   raises OSError when the pwrite itself fails (errno preserved).
  *
  * The whole hot span — multipart/raw extraction, needle assembly, CRC,
@@ -363,8 +367,9 @@ static PyObject *py_post(PyObject *Py_UNUSED(self), PyObject *const *args,
         errno = r.io_errno;
         return PyErr_SetFromErrno(PyExc_OSError);
     }
-    return Py_BuildValue("(y#lI)", r.reply, (Py_ssize_t)r.reply_len, r.total,
-                         (unsigned int)r.size);
+    return Py_BuildValue("(y#lI(ddddd))", r.reply, (Py_ssize_t)r.reply_len,
+                         r.total, (unsigned int)r.size, r.st_parse,
+                         r.st_assemble, r.st_crc, r.st_pwrite, r.st_reply);
 
     /* unwind: each label releases ITS OWN buffer then falls through,
      * so a GetBuffer failure on arg N releases exactly args 0..N-1 */

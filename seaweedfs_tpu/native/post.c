@@ -439,11 +439,17 @@ typedef struct {
     long total;
     uint32_t size;
     int io_errno;
+    /* per-stage wall seconds for the tracing plane (docs/TRACING.md):
+     * the Python fallback emits the SAME five stage names, so a bench
+     * `--trace` breakdown reads identically whichever path served */
+    double st_parse, st_assemble, st_crc, st_pwrite, st_reply;
 } weed_post_req;
 
 static int weed_post(weed_post_req *r) {
     if (r->version != 2 && r->version != 3) return WEED_POST_DECLINE;
     if (r->pairs_len >= 65536) return WEED_POST_DECLINE;
+    r->st_parse = r->st_assemble = r->st_crc = r->st_pwrite = r->st_reply = 0.0;
+    double t_stage = w_monotonic();
 
     const uint8_t *data = r->body;
     size_t data_len = r->body_len;
@@ -481,6 +487,7 @@ static int weed_post(weed_post_req *r) {
         part_name_len = part.filename_len;
         is_gz = part.is_gzipped;
     }
+    r->st_parse = w_monotonic() - t_stage;
 
     int rc = WEED_POST_DECLINE;
     if (data_len == 0) goto out; /* empty body: tombstone-shaped, Python */
@@ -517,6 +524,7 @@ static int weed_post(weed_post_req *r) {
     long cap = weed_needle_max_size((uint32_t)data_len, (uint32_t)name_len,
                                     (uint32_t)(mime_ok ? mime_len : 0),
                                     (uint32_t)r->pairs_len);
+    t_stage = w_monotonic();
     uint8_t *rec = malloc((size_t)cap);
     if (rec == NULL) goto out;
     uint32_t size, crc;
@@ -524,12 +532,15 @@ static int weed_post(weed_post_req *r) {
         rec, r->cookie, r->id, data, (uint32_t)data_len, flags, name,
         (uint32_t)name_len, mime_ok ? mime : (const uint8_t *)"",
         (uint32_t)(mime_ok ? mime_len : 0), r->last_modified, NULL, r->pairs,
-        (uint32_t)r->pairs_len, r->version, r->append_at_ns, &size, &crc);
+        (uint32_t)r->pairs_len, r->version, r->append_at_ns, &size, &crc,
+        &r->st_crc);
     if (total < 0) {
         free(rec);
         goto out;
     }
+    r->st_assemble = w_monotonic() - t_stage - r->st_crc;
 
+    t_stage = w_monotonic();
     size_t done = 0;
     while (done < (size_t)total) {
         ssize_t w = pwrite(r->fd, rec + done, (size_t)total - done,
@@ -550,14 +561,17 @@ static int weed_post(weed_post_req *r) {
         done += (size_t)w;
     }
     free(rec);
+    r->st_pwrite = w_monotonic() - t_stage;
 
     /* b'{"name": %s, "size": %d, "eTag": "%s"}' with %s = json.dumps
      * (trivial for the ascii_clean-gated name) and the etag the raw
      * CRC32-C as 8 lowercase hex digits (bytesutil.put_u32().hex()) */
+    t_stage = w_monotonic();
     r->reply_len = (size_t)snprintf(
         r->reply, sizeof(r->reply),
         "{\"name\": \"%.*s\", \"size\": %u, \"eTag\": \"%08x\"}",
         (int)name_len, name ? (const char *)name : "", size, crc);
+    r->st_reply = w_monotonic() - t_stage;
     r->total = total;
     r->size = size;
     rc = WEED_POST_OK;

@@ -243,6 +243,26 @@ def servicer_handler(service_name: str, methods: dict, impl) -> grpc.GenericRpcH
     return grpc.method_handlers_generic_handler(service_name, handlers)
 
 
+def _traced_call(multicallable):
+    """Auto-inject the current trace context as gRPC invocation
+    metadata (docs/TRACING.md): ONE wrapper here propagates the
+    `X-Weed-Trace` header across every internal gRPC hop — EC shard
+    reads, copies, rebuild verbs, heartbeats — without touching call
+    sites. Explicit metadata= wins (the EC readers capture context at
+    factory time because their calls run on pool threads)."""
+
+    def call(request, timeout=None, metadata=None, **kwargs):
+        if metadata is None:
+            from seaweedfs_tpu.trace import grpc_metadata
+
+            metadata = grpc_metadata()
+        return multicallable(
+            request, timeout=timeout, metadata=metadata, **kwargs
+        )
+
+    return call
+
+
 class Stub:
     """Client stub: one callable attribute per method."""
 
@@ -252,10 +272,12 @@ class Stub:
             setattr(
                 self,
                 name,
-                factory(
-                    f"/{service_name}/{name}",
-                    request_serializer=lambda msg: msg.SerializeToString(),
-                    response_deserializer=resp_cls.FromString,
+                _traced_call(
+                    factory(
+                        f"/{service_name}/{name}",
+                        request_serializer=lambda msg: msg.SerializeToString(),
+                        response_deserializer=resp_cls.FromString,
+                    )
                 ),
             )
 

@@ -32,6 +32,7 @@ from xml.sax.saxutils import escape
 
 import grpc
 
+from seaweedfs_tpu import trace
 from seaweedfs_tpu.pb import filer_pb2 as fpb
 from seaweedfs_tpu.util.httpd import FastHandler, WeedHTTPServer
 from seaweedfs_tpu.pb import rpc
@@ -141,15 +142,16 @@ class S3ApiServer:
         )
         if mime:
             req.add_header("Content-Type", mime)
+        trace.inject_request(req)  # gateway→filer hop, same trace
         with urllib.request.urlopen(req, timeout=60) as r:
             if r.status >= 300:
                 raise s3_error("InternalError")
 
     def _get_from_filer(self, path_segments: list[str]) -> tuple[bytes, str]:
         try:
-            with urllib.request.urlopen(
-                self._filer_url(*path_segments), timeout=60
-            ) as r:
+            req = urllib.request.Request(self._filer_url(*path_segments))
+            trace.inject_request(req)
+            with urllib.request.urlopen(req, timeout=60) as r:
                 return r.read(), r.headers.get("Content-Type", "")
         except urllib.error.HTTPError as e:
             if e.code == 404:
@@ -165,6 +167,23 @@ class S3ApiServer:
     def start(self) -> None:
         handler = self._handler_class()
         self._http_server = WeedHTTPServer((self.host, self.port), handler)
+        # tracing + metrics plane: span per request in the mini loop,
+        # request counters/histograms under the "s3" label, and the
+        # /metrics exposition the gateway previously lacked (served by
+        # the loop — exact-path GET /metrics, so bucket routing keeps
+        # every other path)
+        self._http_server.trace_name = "s3"
+        self._http_server.trace_node = f"{self.host}:{self.port}"
+        self._http_server.gateway_metrics = True
+        # the S3 gateway is the one auth-fronted daemon: with
+        # identities configured, /debug/* and /metrics would otherwise
+        # leak object keys/latencies to unauthenticated peers (and
+        # shadow a bucket literally named "debug"/"metrics"), so only
+        # loopback operators keep the unauthenticated surface
+        self._http_server.debug_gate = (
+            lambda h: not self.iam.is_enabled
+            or h.client_address[0] in ("127.0.0.1", "::1")
+        )
         threading.Thread(
             target=self._http_server.serve_forever, daemon=True, name="s3-http"
         ).start()

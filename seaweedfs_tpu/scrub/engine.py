@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from seaweedfs_tpu import trace
 from seaweedfs_tpu.scrub import verify as _verify
 from seaweedfs_tpu.scrub.ratelimit import TokenBucket
 from seaweedfs_tpu.scrub.state import ScrubState, VolumeScrubHealth
@@ -156,7 +157,16 @@ class ScrubEngine:
                     if v is None:
                         continue
                     try:
-                        r = self._scrub_plain(v, state)
+                        # tracing plane: each volume's scrub is a span
+                        # tagged plane=scrub, so any remote reads it
+                        # triggers are visibly NOT serving traffic
+                        with trace.span(
+                            "scrub.volume", plane="scrub",
+                            node=self.node_label,
+                        ) as sp:
+                            if sp:
+                                sp.annotate("vid", vid)
+                            r = self._scrub_plain(v, state)
                     except Exception as e:  # noqa: BLE001
                         # one un-scrubable volume (deleted/compacted
                         # under us mid-sweep) must not abort the pass
@@ -175,7 +185,13 @@ class ScrubEngine:
                     if ev is None:
                         continue
                     try:
-                        c, q, b = self._scrub_ec(ev, state)
+                        with trace.span(
+                            "scrub.ec_volume", plane="scrub",
+                            node=self.node_label,
+                        ) as sp:
+                            if sp:
+                                sp.annotate("vid", vid)
+                            c, q, b = self._scrub_ec(ev, state)
                     except Exception as e:  # noqa: BLE001
                         wlog.warning(
                             "scrub: ec volume %d sweep failed: %r", vid, e

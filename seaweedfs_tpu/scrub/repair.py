@@ -265,14 +265,23 @@ class RepairScheduler:
         REPAIR_STARTED.labels(task.kind).inc()
         t0 = time.time()
         try:
-            if task.kind == "ec_rebuild":
-                self._repair_ec(task)
-            elif task.kind == "replicate":
-                self._repair_replicate(task)
-            elif task.kind == "replace":
-                self._repair_replace(task)
-            else:
-                raise ValueError(f"unknown repair kind {task.kind}")
+            # tracing plane: the whole repair is one plane=repair span;
+            # every hop it drives (rebuild verbs, copies, EC shard
+            # reads) inherits the tag via gRPC metadata, so rebuild
+            # traffic competing with serving traffic is attributable
+            from seaweedfs_tpu import trace
+
+            with trace.span(f"repair.{task.kind}", plane="repair") as sp:
+                if sp:
+                    sp.annotate("vid", task.volume_id)
+                if task.kind == "ec_rebuild":
+                    self._repair_ec(task)
+                elif task.kind == "replicate":
+                    self._repair_replicate(task)
+                elif task.kind == "replace":
+                    self._repair_replace(task)
+                else:
+                    raise ValueError(f"unknown repair kind {task.kind}")
         except Exception as e:  # noqa: BLE001 - becomes backoff state
             REPAIR_FAILED.labels(task.kind).inc()
             with self._lock:

@@ -627,6 +627,11 @@ class FilerServer:
         self._http_server = WeedHTTPServer(
             (self.host, self.port), self._http_handler_class()
         )
+        # tracing plane: filer spans carry the gateway's trace onward to
+        # the volume hops (assign/upload ride op.http_call, which
+        # injects the header)
+        self._http_server.trace_name = "filer"
+        self._http_server.trace_node = f"{self.host}:{self.port}"
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
 
     def stop(self) -> None:

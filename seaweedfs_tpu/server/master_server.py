@@ -1156,6 +1156,9 @@ class MasterServer:
         self._http_server = WeedHTTPServer(
             (self.host, self.port), self._http_handler_class()
         )
+        # tracing plane: assign/lookup hops get spans + request metrics
+        self._http_server.trace_name = "master"
+        self._http_server.trace_node = f"{self.host}:{self.port}"
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
         if self.vacuum_interval > 0:
             threading.Thread(target=self._vacuum_loop, daemon=True).start()

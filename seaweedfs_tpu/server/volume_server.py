@@ -34,6 +34,7 @@ from urllib.parse import parse_qs
 
 import grpc
 
+from seaweedfs_tpu import trace
 from seaweedfs_tpu.ec import ec_files
 from seaweedfs_tpu.ec.ec_volume import EcVolume, NotEnoughShards
 from seaweedfs_tpu.pb import master_pb2, rpc, volume_pb2 as pb
@@ -796,6 +797,16 @@ class VolumeServer:
         tile by tile, overlapping the remote fetch with reconstruction
         instead of serializing a full cluster copy before decoding
         byte one."""
+        with trace.span(
+            "volume.ec_rebuild",
+            header=trace.header_from_grpc_context(context),
+            node=f"{self.host}:{self.port}",
+        ) as sp:
+            if sp:
+                sp.annotate("vid", req.volume_id)
+            return self._ec_shards_rebuild(req, context)
+
+    def _ec_shards_rebuild(self, req, context):
         base = self._base_name(req.collection, req.volume_id)
         present, missing = ec_files.shard_presence(base)
         if not missing or not self.master:
@@ -865,6 +876,12 @@ class VolumeServer:
                     ch = channels[url] = rpc.dial(f"{host}:{int(port) + 10000}")
                 return ch
 
+        # capture the trace context NOW: the stream driver's reader pool
+        # calls these from its own threads, where the contextvar span is
+        # not ambient — the captured metadata keeps remote-read spans
+        # parented under the rebuild span that built the readers
+        md = trace.grpc_metadata()
+
         def make_reader(sid: int, urls: list[str]):
             def read(offset: int, size: int) -> bytes:
                 last: Exception | None = None
@@ -880,6 +897,7 @@ class VolumeServer:
                                     size=size,
                                 ),
                                 timeout=30,
+                                metadata=md,
                             )
                         )
                     except grpc.RpcError as e:
@@ -956,35 +974,48 @@ class VolumeServer:
         return pb.VolumeEcShardsUnmountResponse()
 
     def VolumeEcShardRead(self, req: pb.VolumeEcShardReadRequest, context):
-        ev = self.store.find_ec_volume(req.volume_id)
-        if ev is None:
-            context.abort(grpc.StatusCode.NOT_FOUND, f"ec volume {req.volume_id} not found")
-        shard = ev.shards.get(req.shard_id)
-        if shard is None:
-            context.abort(
-                grpc.StatusCode.NOT_FOUND,
-                f"ec shard {req.volume_id}.{req.shard_id} not mounted",
-            )
-        if req.file_key:
-            # tombstone check against .ecj-backed index state
-            try:
-                ev.locate_needle(req.file_key)
-            except NeedleNotFound:
-                yield pb.VolumeEcShardReadResponse(is_deleted=True)
-                return
-        # clamp the span to the shard: read_at treats past-EOF reads as
-        # truncation (it guards the DEGRADED path, where short data must
-        # never silently substitute), but a plain span read walking the
-        # shard end — ec.verify's tile probe — just gets what exists
-        remaining = min(req.size, max(0, shard.size - req.offset))
-        offset = req.offset
-        while remaining > 0:
-            chunk = shard.read_at(offset, min(COPY_CHUNK, remaining))
-            if not chunk:
-                break  # never spin yielding empties
-            yield pb.VolumeEcShardReadResponse(data=chunk)
-            offset += len(chunk)
-            remaining -= len(chunk)
+        # tracing: the trace context rides gRPC invocation metadata so a
+        # remote shard read parents under the requesting hop's span and
+        # keeps its plane tag (a scrub/repair-driven read stays visibly
+        # scrub/repair traffic on THIS node's ring too)
+        with trace.span(
+            "volume.ec_shard_read",
+            header=trace.header_from_grpc_context(context),
+            nbytes=req.size,
+            node=f"{self.host}:{self.port}",
+        ) as sp:
+            ev = self.store.find_ec_volume(req.volume_id)
+            if ev is None:
+                context.abort(grpc.StatusCode.NOT_FOUND, f"ec volume {req.volume_id} not found")
+            shard = ev.shards.get(req.shard_id)
+            if shard is None:
+                context.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    f"ec shard {req.volume_id}.{req.shard_id} not mounted",
+                )
+            if sp:
+                sp.annotate("vid", req.volume_id)
+                sp.annotate("shard", req.shard_id)
+            if req.file_key:
+                # tombstone check against .ecj-backed index state
+                try:
+                    ev.locate_needle(req.file_key)
+                except NeedleNotFound:
+                    yield pb.VolumeEcShardReadResponse(is_deleted=True)
+                    return
+            # clamp the span to the shard: read_at treats past-EOF reads as
+            # truncation (it guards the DEGRADED path, where short data must
+            # never silently substitute), but a plain span read walking the
+            # shard end — ec.verify's tile probe — just gets what exists
+            remaining = min(req.size, max(0, shard.size - req.offset))
+            offset = req.offset
+            while remaining > 0:
+                chunk = shard.read_at(offset, min(COPY_CHUNK, remaining))
+                if not chunk:
+                    break  # never spin yielding empties
+                yield pb.VolumeEcShardReadResponse(data=chunk)
+                offset += len(chunk)
+                remaining -= len(chunk)
 
     def VolumeEcBlobDelete(self, req, context):
         ev = self.store.find_ec_volume(req.volume_id)
@@ -1178,6 +1209,11 @@ class VolumeServer:
         # cold-cache LookupEcVolume would hammer the master
         self._cached_lookup_ec_locations(ev)
 
+        # capture trace context at factory time — the fan-out threads
+        # have no ambient span, so the wire metadata carries the parent
+        # (and the scrub plane tag when the scrubber built this fetcher)
+        md = trace.grpc_metadata()
+
         def fetch(shard_id: int, offset: int, size: int):
             with ev.shard_locations_lock:
                 urls = list(ev.shard_locations.get(shard_id, []))
@@ -1199,6 +1235,7 @@ class VolumeServer:
                                     size=size,
                                 ),
                                 timeout=10,
+                                metadata=md,
                             )
                         ]
                     return b"".join(chunks)
@@ -1600,6 +1637,14 @@ class VolumeServer:
                 # (which stays byte-identical for what C handles).
                 # Both branches converge on ONE replicate-then-reply
                 # tail so the fan-out/error contract cannot drift.
+                # `stages` (tracing plane): both paths emit the same
+                # parse/assemble/crc/pwrite/reply names, attached to
+                # the mini loop's volume.post span (handed to us as
+                # _trace_span by serve_connection — reading the warm
+                # handler attr keeps trace-module objects off the hot
+                # path)
+                req_span = getattr(self, "_trace_span", None)
+                stages = {} if req_span is not None else None
                 reply = write_path.try_native_post(
                     server.store.find_volume(fid.volume_id),
                     fid,
@@ -1608,6 +1653,7 @@ class VolumeServer:
                     self.headers,
                     url_filename,
                     server.fix_jpg_orientation,
+                    stages=stages,
                 )
                 if reply is None:
                     n, fname, err = write_path.build_upload_needle(
@@ -1617,21 +1663,27 @@ class VolumeServer:
                         self.headers,
                         url_filename,
                         server.fix_jpg_orientation,
+                        stages=stages,
                     )
                     if err is not None:
                         return self._json({"error": err}, 400)
                     try:
                         size, unchanged = server.store.write_needle(
-                            fid.volume_id, n
+                            fid.volume_id, n, stages=stages
                         )
                     except NeedleNotFound:
                         return self._json({"error": "volume not found"}, 404)
                     except (VolumeReadOnly, CookieMismatch) as e:
                         return self._json({"error": str(e)}, 409)
+                    t_reply = time.perf_counter() if stages is not None else 0.0
                     reply = (
                         b'{"name": %s, "size": %d, "eTag": "%s"}'
                         % (_esc_json(fname).encode(), size, n.etag().encode())
                     )
+                    if stages is not None:
+                        stages["reply"] = time.perf_counter() - t_reply
+                if stages:
+                    req_span.add_stages(stages)
                 if q.get("type") != "replicate":
                     err = server._replicate(fid, q, "POST", body, self.headers)
                     if err:
@@ -1840,6 +1892,9 @@ class VolumeServer:
             for k, v in headers.items()
             if k not in ("connection", "keep-alive", "content-length", "host")
         }
+        # re-stamp the trace header with THIS hop's span so the worker's
+        # span parents here, not at the client's original header
+        trace.inject(fwd)
         try:
             c, reused = _pooled_conn(addr, 30.0)
             try:
@@ -1883,11 +1938,17 @@ class VolumeServer:
         handler = self._http_handler_class()
         server_cls = ReusePortWeedHTTPServer if self.reuse_port else WeedHTTPServer
         self._http_server = server_cls((self.host, self.port), handler)
+        # tracing plane: the mini request loop mints/inherits a span per
+        # request, labeled with this daemon's role and address
+        self._http_server.trace_name = "volume"
+        self._http_server.trace_node = f"{self.host}:{self.port}"
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
         if self.internal_port:
             self._internal_server = WeedHTTPServer(
                 ("127.0.0.1", self.internal_port), handler
             )
+            self._internal_server.trace_name = "volume"
+            self._internal_server.trace_node = f"{self.host}:{self.port}"
             threading.Thread(
                 target=self._internal_server.serve_forever, daemon=True
             ).start()

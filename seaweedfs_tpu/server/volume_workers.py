@@ -189,7 +189,9 @@ class SharedReadVolume:
     # every other process's tail replay). _refresh first, so overwrite
     # cookie checks and dedup see anything the lead wrote before
     # ownership started.
-    def write_needle(self, n: Needle, precheck=None) -> tuple[int, bool]:
+    def write_needle(
+        self, n: Needle, precheck=None, stages=None
+    ) -> tuple[int, bool]:
         with self._lock:
             if precheck is not None and not precheck():
                 # ownership was released between the caller's gate and
@@ -197,7 +199,7 @@ class SharedReadVolume:
                 # land here after the lead's catch-up refresh
                 raise VolumeReleased(self.vid)
             self._refresh()
-            _, size, unchanged = self._vol.write_needle(n)
+            _, size, unchanged = self._vol.write_needle(n, stages=stages)
             # own append is already in the map: advance the replay
             # cursor past it or the next _refresh re-replays it and
             # double-counts the map metrics
@@ -214,7 +216,8 @@ class SharedReadVolume:
             return size
 
     def native_post(
-        self, fid, q, body, headers, url_filename, precheck=None
+        self, fid, q, body, headers, url_filename, precheck=None,
+        stages=None,
     ) -> bytes | None:
         """The C one-pass POST (write_path.try_native_post) under this
         wrapper's refresh + release-precheck discipline. None = take
@@ -227,7 +230,7 @@ class SharedReadVolume:
             self._refresh()
             reply = write_path.try_native_post(
                 self._vol, fid, q, body, headers, url_filename,
-                fix_jpg_orientation=True,
+                fix_jpg_orientation=True, stages=stages,
             )
             if reply is not None:
                 # own append is already in the map: advance the replay
@@ -442,10 +445,12 @@ class VolumeReadWorker:
                 # C hot loop first; Python fallback below — both
                 # branches converge on the ONE replicate-then-reply
                 # tail (same shape as the lead's do_POST)
+                req_span = getattr(self, "_trace_span", None)
+                stages = {} if req_span is not None else None
                 try:
                     reply = v.native_post(
                         fid, q, body, self.headers, url_filename,
-                        precheck=still_owned,
+                        precheck=still_owned, stages=stages,
                     )
                 except VolumeReleased:
                     return False  # re-route to the lead (new owner)
@@ -461,14 +466,14 @@ class VolumeReadWorker:
                 if reply is None:
                     n, fname, err = write_path.build_upload_needle(
                         fid, q, body, self.headers, url_filename,
-                        fix_jpg_orientation=True,
+                        fix_jpg_orientation=True, stages=stages,
                     )
                     if err is not None:
                         self._json({"error": err}, 400)
                         return True
                     try:
                         size, unchanged = v.write_needle(
-                            n, precheck=still_owned
+                            n, precheck=still_owned, stages=stages
                         )
                     except VolumeReleased:
                         return False  # re-route to the lead (new owner)
@@ -484,6 +489,8 @@ class VolumeReadWorker:
                         b'{"name": %s, "size": %d, "eTag": "%s"}'
                         % (_json.dumps(fname).encode(), size, n.etag().encode())
                     )
+                if stages:
+                    req_span.add_stages(stages)
                 if q.get("type") != "replicate":
                     err = self._replicate_owned(v, fid, q, body)
                     if err:
@@ -663,6 +670,12 @@ class VolumeReadWorker:
                     for k, v in self.headers.items()
                     if k not in _HOP_HEADERS
                 }
+                # re-stamp the trace header with THIS hop's span so the
+                # lead's span parents under the worker hop, keeping the
+                # x-shard-hop forwarding chain on one trace
+                from seaweedfs_tpu import trace as _trace
+
+                _trace.inject(fwd)
                 if getattr(self, "_hop_owner_declined", False):
                     # tells the lead: this request already visited the
                     # vid's OWNER, which declined (released volume,
@@ -724,6 +737,10 @@ class VolumeReadWorker:
                 WeedHTTPServer((self.host, self.worker_port), handler)
             )
         for s in self._servers:
+            # tracing plane: worker hops are spans too, labeled so a
+            # shard-hop write reads worker→lead→replica in one trace
+            s.trace_name = "worker"
+            s.trace_node = f"{self.host}:{self.port}#w{self.writer_index}"
             t = threading.Thread(target=s.serve_forever, daemon=True)
             t.start()
             self._threads.append(t)

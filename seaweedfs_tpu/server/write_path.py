@@ -35,6 +35,11 @@ if _needle_ext is not None and not hasattr(_needle_ext, "post"):
 # (WEED_NATIVE_POST=0 forces every write through the Python path)
 NATIVE_POST_ENABLED = os.environ.get("WEED_NATIVE_POST", "1") != "0"
 
+# The write-path stage names, identical on the C hot loop and the
+# Python fallback (docs/TRACING.md): a bench `--trace` breakdown and a
+# `/debug/traces` span read the same whichever path served the write.
+WRITE_STAGES = ("parse", "assemble", "crc", "pwrite", "reply")
+
 
 def try_native_post(
     v,
@@ -44,6 +49,7 @@ def try_native_post(
     headers,
     url_filename: str = "",
     fix_jpg_orientation: bool = False,
+    stages: dict | None = None,
 ) -> bytes | None:
     """The volume POST hot path as ONE native call: payload extraction
     (multipart or raw) → needle assembly → CRC32-C → pwrite at the
@@ -122,7 +128,9 @@ def try_native_post(
         )
         if res is None:
             return None
-        reply, total, size = res
+        reply, total, size, stage_secs = res
+        if stages is not None:
+            stages.update(zip(WRITE_STAGES, stage_secs))
         v._append_end = offset + total
         v.last_append_at_ns = append_at_ns
         v.nm.put(fid.key, t.offset_to_units(offset), size)
@@ -136,11 +144,17 @@ def build_upload_needle(
     headers,
     url_filename: str = "",
     fix_jpg_orientation: bool = False,
+    stages: dict | None = None,
 ) -> tuple[Needle | None, str, str | None]:
     """(needle, filename, error): error is a client-facing 400 message.
 
     `headers` is any case-insensitive mapping with .get and .items
-    (FastHeaders on the data plane)."""
+    (FastHeaders on the data plane). A `stages` dict collects the
+    tracing plane's "parse" (payload extraction) and "assemble" (needle
+    field construction) wall seconds — the Python-path counterparts of
+    the C hot loop's identically-named stages; "crc"/"pwrite" land in
+    Volume.write_needle, "reply" at the handler's formatting site."""
+    t0 = time.perf_counter() if stages is not None else 0.0
     ctype = headers.get("content-type", "")
     part_filename = ""
     is_gzipped = False
@@ -157,6 +171,10 @@ def build_upload_needle(
         data = body
         # raw bodies may arrive pre-gzipped (Content-Encoding)
         is_gzipped = headers.get("content-encoding", "").lower() == "gzip"
+    if stages is not None:
+        t1 = time.perf_counter()
+        stages["parse"] = t1 - t0
+        t0 = t1
     n = Needle(cookie=fid.cookie, id=fid.key, data=data)
     if ctype and len(ctype) < 256 and ctype != "application/octet-stream":
         n.mime = ctype.encode()
@@ -218,6 +236,8 @@ def build_upload_needle(
                 n.set_has_ttl()
         except ValueError:
             pass
+    if stages is not None:
+        stages["assemble"] = time.perf_counter() - t0
     return n, fname, None
 
 
@@ -237,8 +257,13 @@ def replicate_to_peers(
     import urllib.request
     from urllib.parse import urlencode
 
+    from seaweedfs_tpu import trace
+
     params = {k: v for k, v in q.items() if k != "type"}
     params["type"] = "replicate"
+    # replica fan-out is an internal hop: the peer's span must parent
+    # under THIS server's span, not the client's original header
+    trace_hdr = trace.header_value()
     for url in locations:
         try:
             req = urllib.request.Request(
@@ -246,6 +271,8 @@ def replicate_to_peers(
                 data=body if method == "POST" else None,
                 method=method,
             )
+            if trace_hdr:
+                req.add_header(trace.TRACE_HEADER, trace_hdr)
             # FastHeaders stores keys lowercased; look up both
             # spellings so a plain-dict caller keeps working too
             ct = headers.get("Content-Type") or headers.get("content-type")

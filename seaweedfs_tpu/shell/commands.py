@@ -1233,3 +1233,125 @@ class RepairQueue(Command):
                 f"{s['QuarantinedShards']} quarantined shard(s)",
                 file=out,
             )
+
+
+# ----------------------------------------------------------------------
+# tracing plane (docs/TRACING.md)
+
+
+def _trace_nodes(env: CommandEnv) -> list[str]:
+    """master + every volume server — the daemons the shell can reach
+    from topology alone (gateways aren't registered there; query their
+    /debug/traces directly)."""
+    urls = [env.master]
+    for n in env.collect_topology().nodes:
+        urls.append(n.url)
+    return urls
+
+
+@register
+class TraceStatus(Command):
+    name = "trace.status"
+    help = (
+        "trace.status [-json] — per-node tracer health: enabled flag, "
+        "ring occupancy, slow-trace threshold, in-flight requests"
+    )
+
+    def run(self, env, args, out):
+        import json as _json
+
+        report = {}
+        for url in _trace_nodes(env):
+            try:
+                report[url] = _http_json(f"http://{url}/debug/traces?n=0")
+            except (OSError, ValueError) as e:
+                report[url] = {"error": str(e)}
+        if _has_flag(args, "json"):
+            print(_json.dumps(report), file=out)
+            return
+        for url, st in sorted(report.items()):
+            if "error" in st:
+                print(f"{url}: unreachable ({st['error']})", file=out)
+                continue
+            print(
+                f"{url}: tracing {'on' if st.get('enabled') else 'OFF'}, "
+                f"{st.get('recorded', 0)} span(s) recorded "
+                f"(ring {st.get('ring_size')}, dropped {st.get('dropped', 0)}), "
+                f"{st.get('inflight', 0)} in flight, "
+                f"slow threshold {st.get('slow_ms', 0)}ms",
+                file=out,
+            )
+
+
+@register
+class TraceDump(Command):
+    name = "trace.dump"
+    help = (
+        "trace.dump [-traceId <id>] [-n <spans-per-node>] [-slow] — "
+        "merge /debug/traces from every node and print span trees "
+        "(-slow prints each node's slowest-N instead of recent)"
+    )
+
+    def run(self, env, args, out):
+        n = int(_flag(args, "n", "64") or 64)
+        want = _flag(args, "traceId", "")
+        use_slow = _has_flag(args, "slow")
+        spans: list[dict] = []
+        for url in _trace_nodes(env):
+            try:
+                payload = _http_json(f"http://{url}/debug/traces?n={n}")
+            except (OSError, ValueError) as e:
+                print(f"{url}: unreachable ({e})", file=out)
+                continue
+            spans.extend(payload.get("slowest" if use_slow else "recent", []))
+        if want:
+            spans = [s for s in spans if s.get("trace") == want]
+        if not spans:
+            print("no spans", file=out)
+            return
+        # group by trace, dedupe by (node, span) — a span can appear in
+        # both a node's recent and slowest lists, but span ids from
+        # DIFFERENT daemons must never overwrite each other — and order
+        # trees by start time
+        by_trace: dict[str, dict[tuple, dict]] = {}
+        for s in spans:
+            key = (s.get("node", ""), s["span"])
+            by_trace.setdefault(s["trace"], {})[key] = s
+        for trace_id in sorted(
+            by_trace, key=lambda t: min(s["start"] for s in by_trace[t].values())
+        ):
+            tree = by_trace[trace_id]
+            print(f"trace {trace_id}:", file=out)
+            ids = {s["span"] for s in tree.values()}
+            children: dict[str, list[dict]] = {}
+            roots = []
+            for s in sorted(tree.values(), key=lambda s: s["start"]):
+                parent = s.get("parent") or ""
+                # parent == own id only on a (residual) cross-process
+                # id collision; treat as a root instead of a cycle
+                if parent and parent != s["span"] and parent in ids:
+                    children.setdefault(parent, []).append(s)
+                else:
+                    roots.append(s)
+
+            def walk(s, depth):
+                stages = s.get("stages_ms")
+                stage_txt = (
+                    " stages(ms)=" + ",".join(
+                        f"{k}:{v}" for k, v in stages.items()
+                    )
+                    if stages
+                    else ""
+                )
+                print(
+                    "  " * (depth + 1)
+                    + f"{s['name']} [{s['node']}] {s['dur_ms']}ms "
+                    f"status={s['status']} bytes={s['bytes']} "
+                    f"plane={s['plane']}{stage_txt}",
+                    file=out,
+                )
+                for c in children.get(s["span"], []):
+                    walk(c, depth + 1)
+
+            for r in roots:
+                walk(r, 0)

@@ -168,6 +168,15 @@ class Registry:
     def __init__(self):
         self._metrics: list = []
         self._lock = threading.Lock()
+        self._prerender_hooks: list = []
+
+    def add_prerender_hook(self, fn) -> None:
+        """Register a callable run before every text exposition — lets
+        a subsystem that aggregates lazily (the tracing plane drains
+        its span ring into histograms off the hot path) flush right
+        before a scrape or push sees the numbers."""
+        with self._lock:
+            self._prerender_hooks.append(fn)
 
     def counter(self, name: str, help_: str, label_names: tuple[str, ...] = ()) -> Counter:
         m = Counter(name, help_, label_names)
@@ -195,9 +204,12 @@ class Registry:
 
     def render_text(self) -> str:
         """Prometheus text exposition format 0.0.4."""
-        lines: list[str] = []
         with self._lock:
+            hooks = list(self._prerender_hooks)
             metrics = list(self._metrics)
+        for fn in hooks:
+            fn()
+        lines: list[str] = []
         for m in metrics:
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
@@ -220,6 +232,47 @@ STORE_COUNTER = DEFAULT_REGISTRY.counter(
 )
 STORE_HISTOGRAM = DEFAULT_REGISTRY.histogram(
     "weed_filer_store_seconds", "filer store latency", ("store", "type")
+)
+
+# --- request tracing & gateway instrumentation (docs/TRACING.md) ------------
+# One family for EVERY FastHandler server (volume/master/filer/s3/webdav/
+# worker), observed centrally in util/httpd.serve_connection — this is
+# what closes the "S3 and WebDAV expose no metrics" gap: the gateways
+# ride the same mini loop, so they get counters + histograms for free.
+HTTP_REQUEST_COUNTER = DEFAULT_REGISTRY.counter(
+    "weed_http_request_total",
+    "requests served through the mini request loop",
+    ("server", "method", "status"),
+)
+HTTP_REQUEST_HISTOGRAM = DEFAULT_REGISTRY.histogram(
+    "weed_http_request_seconds",
+    "request dispatch latency through the mini request loop",
+    ("server", "method"),
+)
+SPAN_HISTOGRAM = DEFAULT_REGISTRY.histogram(
+    "weed_span_seconds",
+    "trace span durations by span name and plane (serve|scrub|repair)",
+    ("name", "plane"),
+)
+
+# --- push-loop health --------------------------------------------------------
+# The push loop swallows OSError by design (a dead pushgateway must not
+# hurt the server) — these gauges make that death visible on /metrics
+# instead of silent: a scraper alerts on last-success age or up==0.
+PUSH_LAST_SUCCESS = DEFAULT_REGISTRY.gauge(
+    "weed_metrics_push_last_success_unix",
+    "unix time of the last successful pushgateway POST",
+    ("job",),
+)
+PUSH_UP = DEFAULT_REGISTRY.gauge(
+    "weed_metrics_push_up",
+    "1 when the most recent pushgateway POST succeeded, else 0",
+    ("job",),
+)
+PUSH_FAILURES = DEFAULT_REGISTRY.counter(
+    "weed_metrics_push_failures_total",
+    "pushgateway POSTs that failed",
+    ("job",),
 )
 
 # --- scrub & self-healing plane (docs/SCRUB.md) -----------------------------
@@ -284,8 +337,15 @@ def start_push_loop(
                     headers={"Content-Type": "text/plain; version=0.0.4"},
                 )
                 urllib.request.urlopen(req, timeout=5).read()
+                PUSH_LAST_SUCCESS.set(time.time(), job)
+                PUSH_UP.set(1.0, job)
             except OSError:
-                pass  # push gateway being down must not hurt the server
+                # push gateway being down must not hurt the server —
+                # but it must be VISIBLE: /metrics now carries the
+                # loop's own health instead of the config being the
+                # only evidence the loop exists
+                PUSH_UP.set(0.0, job)
+                PUSH_FAILURES.labels(job).inc()
             stop.wait(interval_sec)
 
     t = threading.Thread(target=loop, daemon=True, name="metrics-push")

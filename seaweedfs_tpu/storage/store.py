@@ -210,11 +210,13 @@ class Store:
         return True
 
     # --- needle IO (store.go:227-264) ---
-    def write_needle(self, vid: int, n: Needle) -> tuple[int, bool]:
+    def write_needle(
+        self, vid: int, n: Needle, stages: dict | None = None
+    ) -> tuple[int, bool]:
         v = self.find_volume(vid)
         if v is None:
             raise NeedleNotFound(f"volume {vid} not found")
-        _, size, unchanged = v.write_needle(n)
+        _, size, unchanged = v.write_needle(n, stages=stages)
         return size, unchanged
 
     def read_needle(self, vid: int, needle_id: int, cookie: int | None = None) -> Needle:

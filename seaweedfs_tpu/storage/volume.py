@@ -410,8 +410,15 @@ class Volume:
         return ns
 
     # --- write path (volume_read_write.go:66 writeNeedle) ---
-    def write_needle(self, n: Needle) -> tuple[int, int, bool]:
-        """Returns (offset, size, is_unchanged)."""
+    def write_needle(
+        self, n: Needle, stages: dict | None = None
+    ) -> tuple[int, int, bool]:
+        """Returns (offset, size, is_unchanged).
+
+        `stages` (tracing plane) collects "crc" — the single-pass
+        record serialization, whose cost is the CRC32-C + body memcpy
+        the C hot loop times under the same name — and "pwrite", the
+        positioned append. Names match write_path.WRITE_STAGES."""
         with self._lock:
             if self.read_only:
                 raise VolumeReadOnly(f"volume {self.id} is read-only")
@@ -430,8 +437,18 @@ class Volume:
                     )
 
             n.append_at_ns = self._now_ns()
-            blob = n.encode_record(self.version)
-            offset = self._append_blob(blob)
+            if stages is None:
+                blob = n.encode_record(self.version)
+                offset = self._append_blob(blob)
+            else:
+                import time as _time
+
+                t0 = _time.perf_counter()
+                blob = n.encode_record(self.version)
+                t1 = _time.perf_counter()
+                offset = self._append_blob(blob)
+                stages["crc"] = t1 - t0
+                stages["pwrite"] = _time.perf_counter() - t1
             self.last_append_at_ns = n.append_at_ns
 
             if existing is None or existing.actual_offset < offset:

@@ -12,9 +12,17 @@ somaxconn; a deep backlog restores that behavior.
 
 from __future__ import annotations
 
+import json as _json
 import socket
+import time as _time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer  # FastRequestMixin drives these through serve_connection
 from urllib.parse import unquote_plus
+
+from seaweedfs_tpu import trace as _trace
+from seaweedfs_tpu.stats.metrics import (
+    HTTP_REQUEST_COUNTER,
+    HTTP_REQUEST_HISTOGRAM,
+)
 
 
 # pre-encoded header block for fast_reply's bytes-headers contract —
@@ -87,6 +95,7 @@ class FastRequestMixin:
         `headers` may be a dict or pre-encoded header bytes
         (b"Name: value\\r\\n"...) — hot handlers pass module-level
         constants so nothing is formatted per request."""
+        self._trace_status = status
         buf = bytearray(b"HTTP/1.1 %d %s\r\n" % (status, _REASON.get(status, b"OK")))
         if headers:
             if isinstance(headers, (bytes, bytearray)):
@@ -105,6 +114,13 @@ class FastRequestMixin:
         if body and self.command != "HEAD":
             buf += body
         self.wfile.write(buf)
+
+    # the stdlib slow paths (filer/master streaming replies) pass
+    # through here — recording the code keeps span status and the
+    # request-counter status label accurate on every reply shape
+    def send_response(self, code, message=None):
+        self._trace_status = code
+        super().send_response(code, message)
 
 
 _REASON = {
@@ -278,6 +294,23 @@ def serve_connection(sock, addr, server, handler_cls) -> None:
     h.wfile = _SockWriter(sock)
     table = _dispatch_table(handler_cls)
     proto11 = handler_cls.protocol_version >= "HTTP/1.1"
+    # tracing/metrics identity is per-server, not per-request: resolve
+    # it once per connection, and hoist every module/attribute the
+    # traced dispatch touches into locals — the per-request cost of
+    # tracing is dominated by cold cache lines (distinct shared
+    # objects touched), so the loop below reads only its own warm
+    # frame (docs/TRACING.md)
+    trace_label = getattr(server, "trace_name", "")
+    trace_node = getattr(server, "trace_node", "")
+    gateway_metrics = getattr(server, "gateway_metrics", False)
+    debug_gate = getattr(server, "debug_gate", None)
+    trace_enabled = _trace.enabled
+    span_open, span_close, sample_hit = _trace.connection_tracer(trace_node)
+    trace_hdr_key = _trace.TRACE_HEADER
+    clock = _time.perf_counter
+    hist_observe = HTTP_REQUEST_HISTOGRAM.observe
+    counter_labels = HTTP_REQUEST_COUNTER.labels
+    span_names: dict[str, str] = {}  # method -> span name, per-conn
     try:
         while True:
             # error replies (fast_reply) read command/close_connection;
@@ -285,6 +318,7 @@ def serve_connection(sock, addr, server, handler_cls) -> None:
             # previous keep-alive request's values)
             h.command = None
             h.close_connection = True
+            h._trace_status = 0
             try:
                 head = reader.read_head()
             except ValueError:
@@ -349,7 +383,61 @@ def serve_connection(sock, addr, server, handler_cls) -> None:
             chunked = "chunked" in headers.get("transfer-encoding", "").lower()
             body_end = reader.consumed + length
 
-            method(h)
+            # tracing plane (docs/TRACING.md): the mini loop is the ONE
+            # place every serving daemon's dispatch funnels through, so
+            # span minting/inheritance, the /debug/* operator surface,
+            # and the per-request metrics live here — volume, master,
+            # filer, workers, S3, and WebDAV all get them at once.
+            bare = path.partition("?")[0]
+            if (
+                command == "GET"
+                and (
+                    bare in ("/debug/traces", "/debug/requests")
+                    or (bare == "/metrics" and gateway_metrics)
+                )
+                # an auth-fronted gateway vetoes the interception
+                # (debug_gate False → the request falls through to the
+                # handler's own authenticated routing)
+                and (debug_gate is None or debug_gate(h))
+            ):
+                _serve_debug(h, bare)
+            elif trace_enabled() and (
+                (hdr := headers.get(trace_hdr_key)) is not None
+                or sample_hit()
+            ):
+                t0 = clock()
+                name = span_names.get(command)
+                if name is None:
+                    name = span_names.setdefault(
+                        command, f"{trace_label or 'http'}.{command.lower()}"
+                    )
+                sp = span_open(name, hdr, length, t0)
+                h._trace_span = sp if sp else None
+                try:
+                    method(h)
+                finally:
+                    if sp:  # falsy when the tracer flipped off mid-open
+                        span_close(sp, h._trace_status)
+                if trace_label:
+                    # a real span's duration IS the dispatch latency —
+                    # reuse it instead of a second clock pair
+                    hist_observe(
+                        sp.duration if sp else clock() - t0,
+                        trace_label,
+                        command,
+                    )
+                    counter_labels(
+                        trace_label, command, str(h._trace_status)
+                    ).inc()
+            else:
+                h._trace_span = None
+                t0 = clock()
+                method(h)
+                if trace_label:
+                    hist_observe(clock() - t0, trace_label, command)
+                    counter_labels(
+                        trace_label, command, str(h._trace_status)
+                    ).inc()
 
             if chunked:
                 # can't know from here whether the terminal chunk was
@@ -364,6 +452,34 @@ def serve_connection(sock, addr, server, handler_cls) -> None:
                 return
     except (ConnectionError, BrokenPipeError, TimeoutError, OSError):
         pass
+
+
+def _serve_debug(h, bare: str) -> None:
+    """The tracing plane's operator endpoints, served uniformly on
+    every daemon by the mini loop itself (no per-server routing to
+    drift): `/debug/traces` (recent + slowest-N completed spans,
+    ?n= caps the recent list), `/debug/requests` (in-flight dump), and
+    — on servers that opt in via `server.gateway_metrics` (the S3 and
+    WebDAV gateways, whose handlers have no routing slot for it) —
+    `/metrics` Prometheus text exposition."""
+    if bare == "/metrics":
+        from seaweedfs_tpu.stats.metrics import DEFAULT_REGISTRY
+
+        return h.fast_reply(
+            200,
+            DEFAULT_REGISTRY.render_text().encode(),
+            {"Content-Type": "text/plain; version=0.0.4"},
+        )
+    if bare == "/debug/requests":
+        payload = _trace.inflight_payload()
+    else:
+        q = fast_query(h.path.partition("?")[2])
+        try:
+            n = int(q.get("n", "64"))
+        except ValueError:
+            n = 64
+        payload = _trace.debug_payload(n)
+    h.fast_reply(200, _json.dumps(payload).encode(), JSON_HDR)
 
 
 def _bad_request(h, msg: str) -> None:

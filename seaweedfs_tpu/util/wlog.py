@@ -12,6 +12,7 @@ on. Output goes to stderr and optionally to size-rotated files in
 
 from __future__ import annotations
 
+import contextvars
 import fnmatch
 import inspect
 import logging
@@ -19,6 +20,43 @@ import logging.handlers
 import os
 import sys
 import threading
+
+# Current request (trace) ID: every V(n)/severity line emitted inside
+# a traced request is automatically prefixed `[<trace_id>]`, so
+# grepping a log for one request ID yields its full cross-module
+# story. Two sources, checked per LOG LINE (never per request — log
+# lines are rare, requests are not): the `request_id` contextvar for
+# explicit stamping by non-traced code, then a provider callback the
+# tracing plane registers to expose its current span's trace id
+# (seaweedfs_tpu/trace keeps that in a thread-local; pulling it lazily
+# here keeps the request hot path free of per-span contextvar writes).
+request_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "weed_request_id", default=""
+)
+
+_rid_provider = None
+
+
+def set_request_id_provider(fn) -> None:
+    """Register a zero-arg callable returning the current request
+    (trace) ID or "" — consulted when the contextvar is unset."""
+    global _rid_provider
+    _rid_provider = fn
+
+
+def _rid_prefix(msg: str) -> str:
+    rid = request_id.get()
+    if not rid and _rid_provider is not None:
+        rid = _rid_provider()
+    if not rid:
+        return msg
+    # rid lands inside a %-format string handed to logging with args;
+    # ids are hex-validated at the trust boundary, but escape anyway so
+    # an exotic provider value can never corrupt the format
+    if "%" in rid:
+        rid = rid.replace("%", "%%")
+    return f"[{rid}] {msg}"
+
 
 _lock = threading.Lock()
 _verbosity = 0
@@ -109,7 +147,7 @@ class _Verbose:
     def info(self, msg: str, *args) -> None:
         if self.enabled:
             _ensure_configured()
-            _logger.info(msg, *args, stacklevel=2)
+            _logger.info(_rid_prefix(msg), *args, stacklevel=2)
 
     infof = info
 
@@ -127,21 +165,21 @@ def V(level: int) -> _Verbose:  # noqa: N802 - glog's exported name
 
 def info(msg: str, *args) -> None:
     _ensure_configured()
-    _logger.info(msg, *args, stacklevel=2)
+    _logger.info(_rid_prefix(msg), *args, stacklevel=2)
 
 
 def warning(msg: str, *args) -> None:
     _ensure_configured()
-    _logger.warning(msg, *args, stacklevel=2)
+    _logger.warning(_rid_prefix(msg), *args, stacklevel=2)
 
 
 def error(msg: str, *args) -> None:
     _ensure_configured()
-    _logger.error(msg, *args, stacklevel=2)
+    _logger.error(_rid_prefix(msg), *args, stacklevel=2)
 
 
 def fatal(msg: str, *args) -> None:
     """Log at FATAL severity and exit (glog.Fatalf)."""
     _ensure_configured()
-    _logger.critical(msg, *args, stacklevel=2)
+    _logger.critical(_rid_prefix(msg), *args, stacklevel=2)
     sys.exit(FATAL_EXIT_CODE)

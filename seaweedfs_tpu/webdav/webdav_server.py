@@ -21,6 +21,7 @@ import xml.etree.ElementTree as ET
 
 import grpc
 
+from seaweedfs_tpu import trace
 from seaweedfs_tpu.pb import filer_pb2 as fpb
 from seaweedfs_tpu.util.httpd import FastHandler, WeedHTTPServer
 from seaweedfs_tpu.pb import rpc
@@ -84,6 +85,12 @@ class WebDavServer:
         self._http_server = WeedHTTPServer(
             (self.host, self.port), self._handler_class()
         )
+        # tracing + metrics plane: span per request, request counters/
+        # histograms under "webdav", and /metrics exposition (the
+        # gateway exposed nothing before)
+        self._http_server.trace_name = "webdav"
+        self._http_server.trace_node = f"{self.host}:{self.port}"
+        self._http_server.gateway_metrics = True
         threading.Thread(
             target=self._http_server.serve_forever, daemon=True, name="webdav-http"
         ).start()
@@ -193,6 +200,7 @@ class WebDavServer:
                     # on multi-GB files never read the body
                     method=self.command,
                 )
+                trace.inject_request(req)
                 rng = self.headers.get("Range")
                 if rng:
                     # WebDAV clients (video players, resumable copies)
@@ -224,6 +232,7 @@ class WebDavServer:
                     data=body,
                     method="POST",
                 )
+                trace.inject_request(req)
                 ct = self.headers.get("Content-Type")
                 if ct:
                     req.add_header("Content-Type", ct)
@@ -299,6 +308,7 @@ class WebDavServer:
                         data=data,
                         method="POST",
                     )
+                    trace.inject_request(req)
                     if mime:
                         req.add_header("Content-Type", mime)
                     urllib.request.urlopen(req, timeout=60).close()
@@ -328,6 +338,9 @@ class WebDavServer:
                 self._send(204)
 
         return Handler
+
+
+
 
 
 def _add_response(ms: ET.Element, href: str, entry) -> None:

@@ -378,6 +378,11 @@ class TestStreamDrivers:
                 raise RuntimeError("kernel died")
             return np.zeros((4, tile.shape[1]), dtype=np.uint8)
 
+        # warm the lazy trace-drainer thread before the leak baseline
+        from seaweedfs_tpu import trace
+
+        with trace.span("warmup"):
+            pass
         before = threading.active_count()
         with _pytest.raises(RuntimeError, match="kernel died"):
             ec_stream.stream_write_ec_files(
@@ -459,6 +464,13 @@ class TestStreamDrivers:
             return real_pwritev(fd, bufs, offset)
 
         monkeypatch.setattr(ec_stream, "_pwritev_full", flaky_pwritev)
+        # the first completed span in a process starts the trace
+        # drainer thread lazily — warm it so the leak check below
+        # counts only pool threads
+        from seaweedfs_tpu import trace
+
+        with trace.span("warmup"):
+            pass
         fds_before = len(os.listdir("/proc/self/fd"))
         threads_before = threading.active_count()
         with _pytest.raises(OSError, match="No space left"):
@@ -474,6 +486,18 @@ class TestStreamDrivers:
             )
         assert threading.active_count() <= threads_before
         assert len(os.listdir("/proc/self/fd")) == fds_before
+        # the trace span must record the failure: an aborted encode
+        # that looks clean in /debug/traces would hide exactly the
+        # repair-path behavior the tracing plane exists to attribute
+        from seaweedfs_tpu import trace
+
+        encode_spans = [
+            s
+            for s in trace.debug_payload(n=64)["recent"]
+            if s["name"] == "ec_stream.encode"
+        ]
+        assert encode_spans, "no ec_stream.encode span recorded"
+        assert "No space left" in encode_spans[0].get("error", "")
         # no half-written shard files survive the abort: shard_presence
         # would otherwise count the garbage as a complete valid set
         from seaweedfs_tpu.ec import ec_files
@@ -512,6 +536,11 @@ class TestStreamDrivers:
             raise OSError(errno.ENOSPC, "No space left on device")
 
         monkeypatch.setattr(ec_stream, "_pwrite_full", broken_pwrite)
+        # warm the lazy trace-drainer thread before the leak baseline
+        from seaweedfs_tpu import trace
+
+        with trace.span("warmup"):
+            pass
         fds_before = len(os.listdir("/proc/self/fd"))
         threads_before = threading.active_count()
         with _pytest.raises(OSError, match="No space left"):

@@ -234,6 +234,55 @@ class TestNativePostByteIdentity:
             v.close()
 
 
+class TestStageNameIdentity:
+    """Tracing plane: the C hot loop and the Python fallback must emit
+    the SAME write-path stage names (write_path.WRITE_STAGES), so a
+    bench `--trace` breakdown or a /debug/traces span reads identically
+    whichever path served the write (docs/TRACING.md)."""
+
+    def test_c_and_python_stage_names_identical(self, tmp_path, monkeypatch):
+        _pin_clock(monkeypatch)
+        (tmp_path / "c").mkdir()
+        (tmp_path / "py").mkdir()
+        fid = FileId(1, 0x42, 0xCAFE)
+        h = _headers({})
+
+        vc = Volume(str(tmp_path / "c"), 1)
+        c_stages: dict = {}
+        try:
+            reply = write_path.try_native_post(
+                vc, fid, {"ts": TS}, BIN, h, "", stages=c_stages
+            )
+            assert reply is not None  # the C path must have served this
+        finally:
+            vc.close()
+
+        vp = Volume(str(tmp_path / "py"), 1)
+        py_stages: dict = {}
+        try:
+            n, _fname, err = write_path.build_upload_needle(
+                fid, {"ts": TS}, BIN, h, "", stages=py_stages
+            )
+            assert err is None
+            vp.write_needle(n, stages=py_stages)
+            t0 = 0.0  # reply formatting is the handler's stage; stamp it
+            py_stages["reply"] = t0
+        finally:
+            vp.close()
+
+        assert set(c_stages) == set(write_path.WRITE_STAGES)
+        assert set(py_stages) == set(write_path.WRITE_STAGES)
+        assert set(c_stages) == set(py_stages)
+        # C stage values are real (non-negative, pwrite non-zero)
+        assert all(v >= 0 for v in c_stages.values())
+        assert c_stages["pwrite"] > 0
+
+    def test_stage_order_matches_declaration(self):
+        assert write_path.WRITE_STAGES == (
+            "parse", "assemble", "crc", "pwrite", "reply"
+        )
+
+
 class TestBenchCheckSmoke:
     def test_bench_check(self):
         """`bench.py --check` — the CI smoke that builds the ext and

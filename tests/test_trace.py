@@ -1,0 +1,587 @@
+"""Tracing plane (docs/TRACING.md): ring-buffer semantics, header
+propagation across real cross-process-shaped hops (gateway → filer →
+volume → replica fan-out, EC remote reads over gRPC metadata), the
+slow-trace threshold, the wlog request-id prefix, and the operator
+endpoints. All servers share this process, so the per-process span ring
+doubles as the cross-hop assertion surface — every hop's span lands in
+the same ring, distinguishable by its node label."""
+
+from __future__ import annotations
+
+import json
+import logging
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu import trace
+from seaweedfs_tpu.trace import tracer as tracer_mod
+from seaweedfs_tpu.util import wlog
+from seaweedfs_tpu.util.availability import free_port, start_cluster
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring():
+    trace.reset()
+    trace.set_enabled(True)
+    trace.set_slow_threshold_ms(0)
+    yield
+    trace.reset()
+    trace.set_slow_threshold_ms(0)
+
+
+def _spans_for(trace_id: str) -> list[dict]:
+    return [
+        s
+        for s in trace.debug_payload(tracer_mod._RING_SIZE)["recent"]
+        if s["trace"] == trace_id
+    ]
+
+
+# ----------------------------------------------------------------------
+# unit tier
+
+
+class TestHeader:
+    def test_round_trip(self):
+        with trace.span("a", plane="scrub") as sp:
+            hdr = trace.header_value()
+            assert hdr == f"{sp.trace_id}:{sp.span_id}:scrub"
+            assert trace.parse_header(hdr) == (
+                sp.trace_id, sp.span_id, "scrub"
+            )
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "", "justone", "a:b", "a:b:c:d",
+            "x" * 200,  # over length cap
+            (":" + "p" * 33 + ":serve"),  # empty trace id
+            ("t" * 33 + "::serve"),  # oversized trace id
+            # non-hex ids rejected: a wire id lands inside wlog's
+            # %-format prefix, so '%s' must never survive the parse
+            "%s%s%s%s:0badc0de:serve",
+            "abcd:%s:serve",
+            "xyz!:0badc0de:serve",
+        ],
+    )
+    def test_malformed_rejected(self, bad):
+        assert trace.parse_header(bad) is None
+
+    def test_unknown_plane_normalizes_to_serve(self):
+        assert trace.parse_header("aa:bb:weird") == ("aa", "bb", "serve")
+
+    def test_inherits_header_when_no_ambient_span(self):
+        with trace.span("child", header="cafe01:beef02:repair") as sp:
+            assert sp.trace_id == "cafe01"
+            assert sp.parent_id == "beef02"
+            assert sp.plane == "repair"
+
+    def test_ambient_span_wins_over_header(self):
+        with trace.span("outer") as outer:
+            with trace.span("inner", header="cafe01:beef02:scrub") as sp:
+                assert sp.trace_id == outer.trace_id
+                assert sp.parent_id == outer.span_id
+
+    def test_disabled_is_null_span(self):
+        trace.set_enabled(False)
+        sp = trace.span("x")
+        assert not sp
+        with sp:
+            sp.add_stages({"a": 1.0})
+            sp.annotate("k", "v")
+        assert trace.header_value() is None
+        assert trace.grpc_metadata() is None
+        assert trace.debug_payload(8)["recorded"] == 0
+
+
+class TestRing:
+    def test_overflow_keeps_newest(self):
+        size = tracer_mod._RING_SIZE
+        for i in range(size + 50):
+            with trace.span(f"s{i}"):
+                pass
+        payload = trace.debug_payload(size)
+        assert payload["recorded"] == size + 50
+        assert payload["dropped"] == 50
+        assert len(payload["recent"]) == size
+        # newest first
+        assert payload["recent"][0]["name"] == f"s{size + 49}"
+        # the overwritten oldest are gone
+        names = {s["name"] for s in payload["recent"]}
+        assert "s0" not in names and "s49" not in names
+
+    def test_concurrent_appends_never_lose_count(self):
+        n_threads, per_thread = 8, 500
+
+        def hammer(k):
+            for i in range(per_thread):
+                with trace.span(f"t{k}.{i}"):
+                    pass
+
+        threads = [
+            threading.Thread(target=hammer, args=(k,))
+            for k in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        payload = trace.debug_payload(0)
+        assert payload["recorded"] == n_threads * per_thread
+        assert payload["inflight"] == 0
+        # every surviving slot holds a fully-finished span
+        full = trace.debug_payload(tracer_mod._RING_SIZE)
+        assert len(full["recent"]) == min(
+            tracer_mod._RING_SIZE, n_threads * per_thread
+        )
+
+    def test_slowest_table_tracks_root_spans(self):
+        for ms, name in ((0.0, "fast"), (0.03, "slow")):
+            with trace.span(name):
+                if ms:
+                    time.sleep(ms)
+        slowest = trace.debug_payload(0)["slowest"]
+        assert slowest and slowest[0]["name"] == "slow"
+
+    def test_inflight_visible_while_open(self):
+        with trace.span("open-one"):
+            inflight = trace.inflight_payload()["inflight"]
+            assert any(s["name"] == "open-one" for s in inflight)
+        assert trace.inflight_payload()["inflight"] == []
+
+
+class TestSlowTrace:
+    def test_threshold_logs_through_wlog_with_trace_id(self):
+        wlog._ensure_configured()
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        h = Capture()
+        wlog._logger.addHandler(h)
+        try:
+            trace.set_slow_threshold_ms(1.0)
+            with trace.span("slow.op") as sp:
+                time.sleep(0.01)
+                tid = sp.trace_id
+            trace.set_slow_threshold_ms(0)
+            with trace.span("fast.op"):
+                pass
+        finally:
+            wlog._logger.removeHandler(h)
+        slow_lines = [r for r in records if "slow trace" in r]
+        assert len(slow_lines) == 1
+        assert tid in slow_lines[0]
+        assert "slow.op" in slow_lines[0]
+
+    def test_cli_flag_unset_keeps_env_threshold(self):
+        """An unset -traceSlowMs must not clobber a threshold set via
+        WEED_TRACE_SLOW_MS; an explicit 0 must still disable it."""
+        from types import SimpleNamespace
+
+        from seaweedfs_tpu.command.servers import _apply_trace_flags
+
+        trace.set_slow_threshold_ms(123.0)
+        try:
+            _apply_trace_flags(
+                SimpleNamespace(traceSlowMs=None, traceSample=0)
+            )
+            assert trace.slow_threshold_ms() == 123.0
+            _apply_trace_flags(
+                SimpleNamespace(traceSlowMs=0.0, traceSample=0)
+            )
+            assert trace.slow_threshold_ms() == 0.0
+        finally:
+            trace.set_slow_threshold_ms(0.0)
+
+
+class TestWlogRequestId:
+    def test_lines_inside_span_carry_trace_id(self):
+        wlog._ensure_configured()
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        h = Capture()
+        wlog._logger.addHandler(h)
+        try:
+            with trace.span("rid.test") as sp:
+                wlog.info("inside %d", 42)
+                tid = sp.trace_id
+            wlog.info("outside")
+        finally:
+            wlog._logger.removeHandler(h)
+        inside = [r for r in records if "inside" in r]
+        outside = [r for r in records if "outside" in r]
+        assert inside and inside[0].startswith(f"[{tid}] ")
+        assert outside and not outside[0].startswith("[")
+
+    def test_set_vmodule_enables_tracer_module(self):
+        assert not tracer_mod._vlog_enabled(2)
+        wlog.set_vmodule("tracer=2")
+        try:
+            assert tracer_mod._vlog_enabled(2)
+            assert not tracer_mod._vlog_enabled(3)
+        finally:
+            wlog.set_vmodule("")
+
+
+# ----------------------------------------------------------------------
+# cross-hop tier (in-process cluster; every hop's span shares the ring)
+
+
+@pytest.fixture(scope="class")
+def traced_cluster(tmp_path_factory):
+    """master + 2 volume servers (rack0/rack1) + filer (replication 010)
+    + S3 gateway, all in-process."""
+    from seaweedfs_tpu.s3api.s3api_server import S3ApiServer
+    from seaweedfs_tpu.server.filer_server import FilerServer
+
+    dirs = [
+        str(tmp_path_factory.mktemp("vol0")),
+        str(tmp_path_factory.mktemp("vol1")),
+    ]
+    master, servers = start_cluster(dirs)
+    filer = FilerServer(
+        [f"127.0.0.1:{master.port}"],
+        port=free_port(),
+        replication="010",
+    )
+    filer.start()
+    s3 = S3ApiServer(filer=f"127.0.0.1:{filer.port}", port=free_port())
+    s3.start()
+    yield master, servers, filer, s3
+    s3.stop()
+    filer.stop()
+    master.stop()
+    for vs in servers:
+        vs.stop()
+
+
+class TestCrossHop:
+    def test_s3_put_shares_one_trace_through_replica_fanout(
+        self, traced_cluster
+    ):
+        master, servers, filer, s3 = traced_cluster
+        trace.reset()
+        base = f"http://127.0.0.1:{s3.port}"
+        urllib.request.urlopen(
+            urllib.request.Request(f"{base}/tracebkt", method="PUT"),
+            timeout=30,
+        ).close()
+        # stamp a client-side trace header so the trace id is known
+        req = urllib.request.Request(
+            f"{base}/tracebkt/obj.bin",
+            data=b"\x00\x01s3-trace-payload\xff" * 64,
+            method="PUT",
+        )
+        req.add_header("X-Weed-Trace", "feedfeedfeedfeed:0badc0de:serve")
+        urllib.request.urlopen(req, timeout=60).close()
+
+        spans = _spans_for("feedfeedfeedfeed")
+        by_name: dict[str, list[dict]] = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        # gateway → filer → volume hops all on ONE trace id
+        assert "s3.put" in by_name, spans
+        assert "filer.post" in by_name, spans
+        posts = by_name.get("volume.post", [])
+        # first hop + replica fan-out (type=replicate) = 2 volume hops
+        assert len(posts) == 2, spans
+        s3_span = by_name["s3.put"][0]
+        assert s3_span["parent"] == "0badc0de"
+        filer_span = by_name["filer.post"][0]
+        assert filer_span["parent"] == s3_span["span"]
+        first_hop = [p for p in posts if p["parent"] != filer_span["span"]]
+        # the filer's upload targets one volume server; that hop's span
+        # parents the replica hop
+        direct = [p for p in posts if p["parent"] == filer_span["span"]]
+        assert len(direct) == 1, posts
+        replica = [p for p in posts if p["parent"] == direct[0]["span"]]
+        assert len(replica) == 1, posts
+        assert first_hop[0] is replica[0]
+        # both hops carry the full write-path stage set
+        from seaweedfs_tpu.server import write_path
+
+        for p in posts:
+            assert set(p["stages_ms"]) == set(write_path.WRITE_STAGES)
+        # distinct nodes served the two hops
+        assert direct[0]["node"] != replica[0]["node"]
+
+    def test_debug_endpoints_on_every_server(self, traced_cluster):
+        master, servers, filer, s3 = traced_cluster
+        ports = [master.port, filer.port, s3.port] + [
+            vs.port for vs in servers
+        ]
+        for port in ports:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/traces?n=1", timeout=10
+            ) as r:
+                payload = json.loads(r.read())
+            assert payload["enabled"] is True
+            assert payload["ring_size"] > 0
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/requests", timeout=10
+            ) as r:
+                assert "inflight" in json.loads(r.read())
+
+    def test_gateway_metrics_exposed_with_status_labels(self, traced_cluster):
+        master, servers, filer, s3 = traced_cluster
+        # at least one S3 request has been served by the earlier tests;
+        # issue one more deterministically
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{s3.port}/debug/traces?n=0", timeout=10
+        ).close()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{s3.port}/metrics", timeout=10
+        ) as r:
+            text = r.read().decode()
+        assert "weed_http_request_total" in text
+        assert 'server="s3"' in text
+        assert 'status="200"' in text
+        assert "weed_http_request_seconds" in text
+        assert "weed_span_seconds" in text
+
+
+class TestDebugGate:
+    def test_auth_fronted_gateway_hides_debug(self, traced_cluster):
+        """With IAM identities configured, /debug/* and /metrics on the
+        S3 gateway are served only to loopback peers; everyone else
+        falls through to the authenticated bucket routing."""
+        from seaweedfs_tpu.s3api import auth as s3auth
+
+        master, servers, filer, s3 = traced_cluster
+        gate = s3._http_server.debug_gate
+
+        class H:
+            pass
+
+        local, remote = H(), H()
+        local.client_address = ("127.0.0.1", 40000)
+        remote.client_address = ("203.0.113.9", 40000)
+        # open gateway (no identities): everyone may read the surface
+        assert gate(local) and gate(remote)
+        old_iam = s3.iam
+        s3.iam = s3auth.IdentityAccessManagement(
+            [s3auth.Identity("op", "AK", "SK")]
+        )
+        try:
+            assert gate(local)  # loopback operator keeps access
+            assert not gate(remote)
+        finally:
+            s3.iam = old_iam
+
+    def test_gate_denial_falls_through_to_handler(self, traced_cluster):
+        master, servers, filer, s3 = traced_cluster
+        srv = s3._http_server
+        old_gate = srv.debug_gate
+        srv.debug_gate = lambda h: False
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{s3.port}/debug/traces", timeout=10
+                )
+            # bucket routing answered (no bucket named "debug"), not
+            # the trace JSON payload
+            assert ei.value.code in (403, 404)
+        finally:
+            srv.debug_gate = old_gate
+
+
+class TestShellCommands:
+    def test_trace_status_and_dump(self, traced_cluster):
+        master, servers, filer, s3 = traced_cluster
+        from seaweedfs_tpu.shell.command_env import CommandEnv
+        from seaweedfs_tpu.shell.commands import run_command
+
+        # ensure at least one traced request exists
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{servers[0].port}/status", timeout=10
+        ).close()
+        env = CommandEnv([f"127.0.0.1:{master.port}"])
+        status = run_command(env, "trace.status")
+        assert "tracing on" in status
+        assert f"127.0.0.1:{master.port}" in status
+        dump = run_command(env, "trace.dump -n 16")
+        assert "trace " in dump
+        assert "status=" in dump
+
+    def test_trace_dump_filters_by_trace_id(self, traced_cluster):
+        master, servers, filer, s3 = traced_cluster
+        from seaweedfs_tpu.shell.command_env import CommandEnv
+        from seaweedfs_tpu.shell.commands import run_command
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{servers[0].port}/status"
+        )
+        req.add_header("X-Weed-Trace", "deadbeefdeadbeef:aa00aa00:serve")
+        urllib.request.urlopen(req, timeout=10).close()
+        env = CommandEnv([f"127.0.0.1:{master.port}"])
+        dump = run_command(env, "trace.dump -traceId deadbeefdeadbeef")
+        assert "trace deadbeefdeadbeef:" in dump
+        assert "volume.get" in dump
+
+    def test_span_ids_unique_across_processes(self):
+        """Span ids ride the per-process random base: a bare counter
+        would make every daemon's first span `00000001` and cross-node
+        trace.dump merges would overwrite spans from different nodes."""
+        from seaweedfs_tpu.trace import tracer
+
+        with trace.span("t.unique", plane="serve") as sp:
+            pass
+        raw = int(sp.span_id, 16) ^ tracer._span_id_base
+        # un-XORing the base must recover a small counter value
+        assert 0 < raw < 1 << 20, (sp.span_id, raw)
+
+    def test_trace_dump_merges_colliding_span_ids(self, monkeypatch):
+        """Two daemons whose span counters collide (both minted
+        '00000001') must both survive the trace.dump merge — keyed by
+        (node, span), not span id alone."""
+        from seaweedfs_tpu.shell import commands as shell_commands
+        from seaweedfs_tpu.shell.commands import run_command
+
+        payloads = {
+            "n1:1": {
+                "recent": [{
+                    "trace": "ab" * 8, "span": "00000001", "parent": "",
+                    "name": "filer.post", "plane": "serve", "node": "n1:1",
+                    "start": 1.0, "dur_ms": 5.0, "status": 201, "bytes": 9,
+                }],
+            },
+            "n2:2": {
+                "recent": [{
+                    "trace": "ab" * 8, "span": "00000001",
+                    "parent": "00000001", "name": "volume.post",
+                    "plane": "serve", "node": "n2:2", "start": 2.0,
+                    "dur_ms": 3.0, "status": 201, "bytes": 9,
+                }],
+            },
+        }
+        monkeypatch.setattr(
+            shell_commands, "_trace_nodes", lambda env: list(payloads)
+        )
+        monkeypatch.setattr(
+            shell_commands,
+            "_http_json",
+            lambda url: payloads[url.split("//")[1].split("/")[0]],
+        )
+        dump = run_command(object(), "trace.dump")
+        assert "filer.post" in dump
+        assert "volume.post" in dump
+
+
+class TestEcRemoteReadParenting:
+    def test_shard_read_span_parents_under_caller(self, tmp_path):
+        """VolumeEcShardRead rides gRPC invocation metadata: the
+        server-side span must share the caller's trace id and parent
+        under the caller's span."""
+        import grpc as _grpc
+
+        from seaweedfs_tpu.pb import rpc, volume_pb2 as pb
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+
+        from tests.test_scrub import _local_ec_store  # reuse the fixture
+
+        store, _payload = _local_ec_store(tmp_path)
+        store.close()
+        vs = VolumeServer([str(tmp_path)], port=free_port())
+        vs.start()
+        try:
+            with trace.span("test.ec_read") as caller:
+                with rpc.dial(f"127.0.0.1:{vs.grpc_port}") as ch:
+                    data = b"".join(
+                        r.data
+                        for r in rpc.volume_stub(ch).VolumeEcShardRead(
+                            pb.VolumeEcShardReadRequest(
+                                volume_id=9, shard_id=0, offset=0, size=1024
+                            ),
+                            timeout=10,
+                        )
+                    )
+                assert len(data) == 1024
+                tid, caller_span = caller.trace_id, caller.span_id
+        finally:
+            vs.stop()
+        reads = [
+            s
+            for s in _spans_for(tid)
+            if s["name"] == "volume.ec_shard_read"
+        ]
+        assert len(reads) == 1, _spans_for(tid)
+        assert reads[0]["parent"] == caller_span
+        assert reads[0]["plane"] == "serve"
+
+    def test_scrub_plane_tag_propagates(self, tmp_path):
+        """A shard read driven from inside a plane=scrub span arrives
+        tagged scrub on the serving node's ring."""
+        from seaweedfs_tpu.pb import rpc, volume_pb2 as pb
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+
+        from tests.test_scrub import _local_ec_store
+
+        store, _payload = _local_ec_store(tmp_path)
+        store.close()
+        vs = VolumeServer([str(tmp_path)], port=free_port())
+        vs.start()
+        try:
+            with trace.span("scrub.volume", plane="scrub") as caller:
+                with rpc.dial(f"127.0.0.1:{vs.grpc_port}") as ch:
+                    list(
+                        rpc.volume_stub(ch).VolumeEcShardRead(
+                            pb.VolumeEcShardReadRequest(
+                                volume_id=9, shard_id=1, offset=0, size=64
+                            ),
+                            timeout=10,
+                        )
+                    )
+                tid = caller.trace_id
+        finally:
+            vs.stop()
+        reads = [
+            s
+            for s in _spans_for(tid)
+            if s["name"] == "volume.ec_shard_read"
+        ]
+        assert reads and reads[0]["plane"] == "scrub"
+
+
+class TestPushLoopHealth:
+    def test_dead_gateway_visible_on_metrics(self):
+        from seaweedfs_tpu.stats.metrics import (
+            DEFAULT_REGISTRY,
+            PUSH_FAILURES,
+            PUSH_UP,
+            start_push_loop,
+        )
+
+        stop = threading.Event()
+        port = free_port()  # nothing listens here
+        before = PUSH_FAILURES.value("t-dead")
+        t = start_push_loop(
+            f"http://127.0.0.1:{port}",
+            job="t-dead",
+            interval_sec=30,
+            stop_event=stop,
+        )
+        deadline = time.time() + 10
+        while (
+            PUSH_FAILURES.value("t-dead") == before
+            and time.time() < deadline
+        ):
+            time.sleep(0.05)
+        stop.set()
+        t.join(timeout=5)
+        assert PUSH_FAILURES.value("t-dead") > before
+        assert PUSH_UP.value("t-dead") == 0.0
+        text = DEFAULT_REGISTRY.render_text()
+        assert "weed_metrics_push_up" in text
+        assert "weed_metrics_push_failures_total" in text
