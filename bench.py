@@ -880,17 +880,18 @@ def bench_migration() -> None:
                 vs.stop()
             master.stop()
 
-    lat = sorted(reader.latencies)
-    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1000
+    from seaweedfs_tpu.stats.quantile import percentile
+
+    lat = reader.latencies
     _report(
         "ec_migration_read_availability",
-        p99,
+        percentile(lat, 0.99) * 1000,
         "ms",
         1.0 if not reader.failures else 0.0,
         reads=reader.reads,
         failed_reads=len(reader.failures),
-        p50_ms=round(lat[len(lat) // 2] * 1000, 3),
-        max_ms=round(lat[-1] * 1000, 3),
+        p50_ms=round(percentile(lat, 0.5) * 1000, 3),
+        max_ms=round(max(lat) * 1000, 3),
     )
 
 
@@ -1051,11 +1052,9 @@ def bench_scrub() -> None:
                 kept = reader.latencies[len(keys):]
                 if pool is not None:
                     pool.extend(kept)
-                lat = sorted(kept)
-                return (
-                    lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1000,
-                    reader.reads,
-                )
+                from seaweedfs_tpu.stats.quantile import percentile
+
+                return percentile(kept, 0.99) * 1000, reader.reads
 
             # continuous sweeping: restart the (rate-capped) sweep in a
             # loop while the "on" phases run
@@ -1209,9 +1208,7 @@ def bench_trace() -> None:
         writes_per_arm=(n_writes - warmup) // len(arms),
     )
 
-    def pct(vals: list[float], p: float) -> float:
-        vals = sorted(vals)
-        return vals[min(len(vals) - 1, int(len(vals) * p))]
+    from seaweedfs_tpu.stats.quantile import percentile as pct
 
     stages = {
         k: {"p50_us": round(pct(v, 0.5), 2), "p99_us": round(pct(v, 0.99), 2)}
@@ -1225,6 +1222,205 @@ def bench_trace() -> None:
         1.0,
         stages=stages,
         spans=len(next(iter(stage_samples.values()), [])),
+    )
+
+
+def bench_load() -> None:
+    """Telemetry plane `load` config (docs/TELEMETRY.md, BENCH_r07).
+
+    Lines 1+2 — `load_put` / `load_get`: weedload drives 4 worker
+    PROCESSES (2 assign+PUT, 2 GET) against a REAL multi-process
+    cluster (master + 2 volume servers as `python -m seaweedfs_tpu`
+    subprocesses — every hop crosses a process boundary and a real
+    socket, unlike the in-process `http` config whose tracker shares
+    the servers' GIL, the BENCH_r06 caveat) and reports p50/p99/p99.9
+    from log-bucketed latency histograms. vs_baseline = error-free
+    fraction of ops (1.0 = every request succeeded); the latency value
+    is the p99 in ms. This harness is the measurement substrate for
+    the ROADMAP tail-latency plane (hedging on/off A/Bs).
+
+    Line 3 — `load_profiler_overhead`: the volume write path with the
+    continuous sampling profiler running vs paused, toggled in-process
+    and interleaved PER WRITE (the bench_trace method: wall medians,
+    host-throttle drift common-mode). Acceptance bound: <= 1% serving
+    overhead (vs_baseline >= 0.99).
+    """
+    import statistics
+    import subprocess
+    import tempfile
+    import urllib.request as _rq
+
+    from seaweedfs_tpu.telemetry.weedload import run_load
+
+    def _free_port():
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def _spawn(*args):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", WEED_EC_CODEC="cpu")
+        return subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                "import jax; jax.config.update('jax_platforms', 'cpu');"
+                "from seaweedfs_tpu.__main__ import main; main()",
+                *args,
+            ],
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+
+    mport = _free_port()
+    m = f"127.0.0.1:{mport}"
+    procs = []
+    with tempfile.TemporaryDirectory() as d:
+        try:
+            procs.append(
+                _spawn("master", "-port", str(mport), "-mdir", d,
+                       "-telemetryInterval", "2")
+            )
+            for i in range(2):
+                vdir = os.path.join(d, f"v{i}")
+                os.mkdir(vdir)
+                procs.append(
+                    _spawn(
+                        "volume", "-port", str(_free_port()), "-dir", vdir,
+                        "-mserver", m, "-max", "50", "-rack", f"rack{i}",
+                        "-scrubInterval", "0",
+                    )
+                )
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    with _rq.urlopen(f"http://{m}/dir/status", timeout=2) as r:
+                        topo = json.load(r)["Topology"]
+                    nodes = sum(
+                        len(rk["DataNodes"])
+                        for dc in topo.get("DataCenters", [])
+                        for rk in dc.get("Racks", [])
+                    )
+                    if nodes >= 2:
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.3)
+            else:
+                raise RuntimeError("multi-process cluster never became ready")
+            report = run_load(
+                m, duration_s=8.0, writers=2, readers=2,
+                payload_bytes=1024, rate=0.0, seed_n=48,
+            )
+            # the cluster's own telemetry saw the load: health comes
+            # along as evidence the collector aggregated real traffic
+            try:
+                with _rq.urlopen(f"http://{m}/cluster/health", timeout=5) as r:
+                    health = json.load(r)
+                scraped = sum(
+                    1 for t in health.get("Targets", {}).values()
+                    if t.get("Scrapes", 0) > 0
+                )
+            except (OSError, ValueError):
+                scraped = 0
+        finally:
+            for p in procs:
+                p.kill()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
+    for mode in ("put", "get"):
+        row = report.get(mode)
+        if row is None:
+            continue
+        ok_frac = (
+            (row["ops"] - row["errors"]) / row["ops"] if row["ops"] else 0.0
+        )
+        _report(
+            f"load_{mode}",
+            row["p99_ms"],
+            "ms",
+            round(ok_frac, 4),
+            p50_ms=row["p50_ms"],
+            p999_ms=row["p999_ms"],
+            max_ms=row["max_ms"],
+            req_per_sec=row["req_per_sec"],
+            ops=row["ops"],
+            errors=row["errors"],
+            worker_processes=report["config"]["processes"],
+            multi_process_cluster=len(procs),
+            telemetry_targets_scraped=scraped,
+            co_safe=report["config"]["coordinated_omission_safe"],
+        )
+
+    # --- line 3: profiler serving-path overhead A/B ---------------------
+    from seaweedfs_tpu import trace
+    from seaweedfs_tpu.client.operation import _drop_conn, _pooled_conn
+    from seaweedfs_tpu.command.servers import _tune_gc
+    from seaweedfs_tpu.telemetry import profiler
+    from seaweedfs_tpu.util.availability import start_cluster
+
+    if not profiler.ensure_started():
+        _report("load_profiler_overhead", 0.0, "us", 1.0, skipped=True,
+                reason="WEED_PROF=0")
+        return
+    _tune_gc()
+    trace.set_enabled(False)  # measure the profiler alone, not trace+prof
+    n_writes, warmup = 4200, 300
+    payload = b"\x00\x01prof-bench-payload\xff" * 50
+    arms = ("off", "on")
+    with tempfile.TemporaryDirectory() as d:
+        master, servers = start_cluster([tempfile.mkdtemp(dir=d)])
+        mloc = f"127.0.0.1:{master.port}"
+        addr = f"127.0.0.1:{servers[0].port}"
+        lat: dict[str, list[float]] = {a: [] for a in arms}
+        try:
+            with _rq.urlopen(
+                f"http://{mloc}/dir/assign?count={n_writes + 1}", timeout=10
+            ) as r:
+                base_fid = json.load(r)["fid"]
+            c, _ = _pooled_conn(addr, 30.0)
+            try:
+                for i in range(n_writes):
+                    arm = arms[i % len(arms)]
+                    profiler.set_paused(arm == "off")
+                    fid = f"{base_fid}_{i}" if i else base_fid
+                    t0 = time.perf_counter()
+                    c.send_request(
+                        "POST", f"/{fid}", payload,
+                        {"Content-Type": "application/octet-stream"},
+                    )
+                    status, _h, _b, will_close = c.read_response("POST")
+                    dt = time.perf_counter() - t0
+                    assert status == 201, f"write {fid} -> {status}"
+                    if will_close:
+                        _drop_conn(addr)
+                        c, _ = _pooled_conn(addr, 30.0)
+                    if i >= warmup:
+                        lat[arm].append(dt)
+            finally:
+                _drop_conn(addr)
+                profiler.set_paused(False)
+                trace.set_enabled(True)
+        finally:
+            for vs in servers:
+                vs.stop()
+            master.stop()
+    med = {a: statistics.median(lat[a]) * 1e6 for a in arms}
+    _report(
+        "load_profiler_overhead",
+        med["on"] - med["off"],
+        "us",
+        round(med["off"] / med["on"], 4) if med["on"] > 0 else 1.0,
+        wall_off_us=round(med["off"], 1),
+        wall_on_us=round(med["on"], 1),
+        sample_interval_ms=profiler.capture(0)["interval_ms"],
+        writes_per_arm=(n_writes - warmup) // len(arms),
     )
 
 
@@ -1242,6 +1438,7 @@ CONFIGS = {
     "migration": bench_migration_with_retry,
     "scrub": bench_scrub,
     "trace": bench_trace,
+    "load": bench_load,
 }
 
 
@@ -1367,6 +1564,63 @@ def check_trace_smoke() -> int:
     return 0 if ok else 1
 
 
+def check_telemetry_smoke() -> int:
+    """`bench.py --check` telemetry leg: scrape a live daemon into the
+    ring TSDB, run one alert-evaluation cycle, and pull folded stacks
+    from the continuous profiler — the whole collector→rings→alerts→
+    profiler chain in one cheap pass."""
+    import tempfile
+    import urllib.request as _rq
+
+    from seaweedfs_tpu.telemetry import ClusterCollector
+    from seaweedfs_tpu.util.availability import start_cluster
+
+    with tempfile.TemporaryDirectory() as d:
+        master, servers = start_cluster([tempfile.mkdtemp(dir=d)])
+        try:
+            collector = ClusterCollector(master, interval=0.5)
+            master.telemetry = collector
+            collector.collect_once()
+            collector.collect_once()  # two cycles so rings can rate()
+            targets = list(collector.targets.values())
+            rings_ok = bool(targets) and all(
+                ts.scrapes >= 2 and ts.series_count() > 0 for ts in targets
+            )
+            alerts = collector.alerts.payload()
+            alerts_ok = not alerts["Firing"]  # healthy cluster: quiet
+            health = collector.health_payload()
+            health_ok = all(
+                row["Up"] for row in health["Targets"].values()
+            )
+            with _rq.urlopen(
+                f"http://127.0.0.1:{servers[0].port}"
+                "/debug/profile?seconds=0.4",
+                timeout=10,
+            ) as r:
+                prof = json.loads(r.read())
+            if not prof.get("enabled", True):
+                prof_ok = True  # WEED_PROF=0 opt-out is not a failure
+            else:
+                prof_ok = prof["samples"] > 0 and any(
+                    ";" in stack for stack in prof["stacks"]
+                )
+        finally:
+            for vs in servers:
+                vs.stop()
+            master.stop()
+    ok = rings_ok and alerts_ok and health_ok and prof_ok
+    print(json.dumps({
+        "metric": "telemetry_check",
+        "ok": ok,
+        "rings": rings_ok,
+        "alerts_quiet": alerts_ok,
+        "targets_up": health_ok,
+        "profiler_folded_stacks": prof_ok,
+        "targets": len(health["Targets"]),
+    }))
+    return 0 if ok else 1
+
+
 def check_weedlint() -> int:
     """Static-analysis gate: `python -m seaweedfs_tpu.analysis` must
     exit 0 (no unsuppressed findings, no reasonless suppressions)."""
@@ -1459,6 +1713,7 @@ def main() -> None:
         # the inner marker keeps subprocess layers from recursing
         rc = check_native_post()
         rc = rc or check_trace_smoke()
+        rc = rc or check_telemetry_smoke()
         if os.environ.get("WEED_BENCH_CHECK_INNER") != "1":
             rc = rc or check_weedlint()
             rc = rc or check_sanitizer_smoke()

@@ -155,6 +155,14 @@ class MasterCommand(Command):
             help="seconds damage must persist before repair starts "
             "(rides out shard moves and node restarts)",
         )
+        p.add_argument(
+            "-telemetryInterval",
+            type=float,
+            default=10.0,
+            help="seconds between leader-side cluster telemetry scrapes "
+            "(/metrics from every node into the ring TSDB feeding "
+            "/cluster/health, /cluster/alerts, /cluster/top; 0 disables)",
+        )
         p.add_argument("-cpuprofile", default="", help="dump pstats profile here on exit")
         p.add_argument(
             "-sequencer.etcd",
@@ -194,6 +202,7 @@ class MasterCommand(Command):
             repair_interval=args.repairInterval,
             repair_concurrency=args.repairConcurrency,
             repair_grace=args.repairGrace,
+            telemetry_interval=args.telemetryInterval,
         )
         from seaweedfs_tpu.util.profiling import CpuProfile
 
@@ -457,6 +466,12 @@ class S3Command(Command):
         p.add_argument("-filer", default="127.0.0.1:8888")
         p.add_argument("-bucketsPath", default="/buckets")
         p.add_argument("-config", default="", help="identities toml with access/secret keys")
+        p.add_argument(
+            "-master",
+            default="",
+            help="comma-separated master(s) to announce this gateway to "
+            "(telemetry plane; empty = not scraped by the collector)",
+        )
         _add_trace_flags(p)
         p.add_argument("-v", type=int, default=0)
 
@@ -489,6 +504,7 @@ class S3Command(Command):
             port=args.port,
             buckets_path=args.bucketsPath,
             iam=iam,
+            masters=[m for m in args.master.split(",") if m],
         )
         server.start()
         wlog.info("s3 gateway %s:%d -> filer %s", args.ip, args.port, args.filer)
@@ -507,6 +523,12 @@ class WebDavCommand(Command):
         p.add_argument("-ip", default="127.0.0.1")
         p.add_argument("-port", type=int, default=7333)
         p.add_argument("-filer", default="127.0.0.1:8888")
+        p.add_argument(
+            "-master",
+            default="",
+            help="comma-separated master(s) to announce this gateway to "
+            "(telemetry plane; empty = not scraped by the collector)",
+        )
         _add_trace_flags(p)
         p.add_argument("-v", type=int, default=0)
 
@@ -516,7 +538,12 @@ class WebDavCommand(Command):
 
         wlog.set_verbosity(args.v)
         _apply_trace_flags(args)
-        server = WebDavServer(filer=args.filer, host=args.ip, port=args.port)
+        server = WebDavServer(
+            filer=args.filer,
+            host=args.ip,
+            port=args.port,
+            masters=[m for m in args.master.split(",") if m],
+        )
         server.start()
         wlog.info("webdav %s:%d -> filer %s", args.ip, args.port, args.filer)
         try:
@@ -561,6 +588,7 @@ class ServerCommand(Command):
         p.add_argument("-repairGrace", type=float, default=30.0)
         p.add_argument("-scrubInterval", type=float, default=600.0)
         p.add_argument("-scrubRate", type=float, default=64.0)
+        p.add_argument("-telemetryInterval", type=float, default=10.0)
         _add_trace_flags(p)
         p.add_argument("-v", type=int, default=0)
 
@@ -584,6 +612,7 @@ class ServerCommand(Command):
             repair_interval=args.repairInterval,
             repair_concurrency=args.repairConcurrency,
             repair_grace=args.repairGrace,
+            telemetry_interval=args.telemetryInterval,
         )
         master.start()
         started.append(master)
@@ -626,7 +655,10 @@ class ServerCommand(Command):
             from seaweedfs_tpu.s3api import S3ApiServer
 
             s3 = S3ApiServer(
-                filer=f"{args.ip}:{args.filer_port}", host=args.ip, port=args.s3_port
+                filer=f"{args.ip}:{args.filer_port}",
+                host=args.ip,
+                port=args.s3_port,
+                masters=[f"{args.ip}:{args.master_port}"],
             )
             s3.start()
             started.append(s3)
@@ -634,7 +666,10 @@ class ServerCommand(Command):
             from seaweedfs_tpu.webdav.webdav_server import WebDavServer
 
             wd = WebDavServer(
-                filer=f"{args.ip}:{args.filer_port}", host=args.ip, port=args.webdav_port
+                filer=f"{args.ip}:{args.filer_port}",
+                host=args.ip,
+                port=args.webdav_port,
+                masters=[f"{args.ip}:{args.master_port}"],
             )
             wd.start()
             started.append(wd)

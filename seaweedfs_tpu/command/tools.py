@@ -413,3 +413,48 @@ class ExportCommand(Command):
                 tar.close()
         print(f"{count} needles", file=sys.stderr)
         return 0
+
+
+@register
+class WeedloadCommand(Command):
+    name = "weedload"
+    help = (
+        "multi-process closed-loop load harness: assign+PUT / "
+        "lookup+GET workers, coordinated-omission-safe histograms, "
+        "p50/p99/p99.9 report (telemetry plane, docs/TELEMETRY.md)"
+    )
+
+    def add_arguments(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("-master", default="127.0.0.1:9333")
+        p.add_argument("-duration", type=float, default=10.0, help="seconds")
+        p.add_argument("-writers", type=int, default=2, help="PUT worker processes")
+        p.add_argument("-readers", type=int, default=2, help="GET worker processes")
+        p.add_argument("-size", type=int, default=1024, help="payload bytes")
+        p.add_argument(
+            "-rate",
+            type=float,
+            default=0.0,
+            help="per-worker target req/s; >0 paces against a schedule "
+            "and measures latency from the SCHEDULED start "
+            "(coordinated-omission safe); 0 = unpaced closed loop",
+        )
+        p.add_argument("-seed", type=int, default=64, help="keys pre-written for GET workers")
+
+    def run(self, args) -> int:
+        from seaweedfs_tpu.telemetry.weedload import run_load
+
+        report = run_load(
+            args.master,
+            duration_s=args.duration,
+            writers=args.writers,
+            readers=args.readers,
+            payload_bytes=args.size,
+            rate=args.rate,
+            seed_n=args.seed,
+        )
+        print(json.dumps(report, indent=2))
+        errs = sum(report.get(m, {}).get("errors", 0) for m in ("put", "get"))
+        ops = sum(report.get(m, {}).get("ops", 0) for m in ("put", "get"))
+        # non-zero exit when the run was mostly failures: a load tool
+        # that exits 0 while every request 500s hides outages in CI
+        return 0 if ops > 0 and errs <= ops else 1

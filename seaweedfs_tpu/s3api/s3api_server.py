@@ -52,12 +52,20 @@ class S3ApiServer:
         port: int = 8333,
         buckets_path: str = "/buckets",
         iam: s3auth.IdentityAccessManagement | None = None,
+        masters: list[str] | None = None,
+        announce_interval: float = 10.0,
     ):
         self.filer = filer
         self.host = host
         self.port = port
         self.buckets_path = buckets_path.rstrip("/")
         self.iam = iam or s3auth.IdentityAccessManagement()
+        # telemetry plane: masters to announce this gateway to (the S3
+        # gateway only knows its filer; the operator passes -master so
+        # the cluster collector can scrape it)
+        self.masters = list(masters or [])
+        self.announce_interval = announce_interval
+        self._announce: threading.Thread | None = None
         self._http_server: WeedHTTPServer | None = None
         self._channel: grpc.Channel | None = None
         self._channel_lock = threading.Lock()
@@ -187,8 +195,18 @@ class S3ApiServer:
         threading.Thread(
             target=self._http_server.serve_forever, daemon=True, name="s3-http"
         ).start()
+        from seaweedfs_tpu.telemetry import profiler
+        from seaweedfs_tpu.telemetry.announce import start_announce_loop
+
+        profiler.ensure_started()
+        self._announce = start_announce_loop(
+            "s3", f"{self.host}:{self.port}", self.masters,
+            interval=self.announce_interval,
+        )
 
     def stop(self) -> None:
+        if self._announce is not None:
+            self._announce.stop_event.set()
         if self._http_server:
             self._http_server.shutdown()
             self._http_server.server_close()

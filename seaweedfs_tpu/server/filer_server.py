@@ -78,8 +78,11 @@ class FilerServer:
         replication: str = "",
         max_mb: int = 32,
         on_event=None,
+        announce_interval: float = 10.0,
     ):
         self.masters = masters
+        self.announce_interval = announce_interval
+        self._announce: threading.Thread | None = None
         self._master_idx = 0  # rotates on failure (HA master failover)
         self.host = host
         self.port = port
@@ -632,9 +635,28 @@ class FilerServer:
         # injects the header)
         self._http_server.trace_name = "filer"
         self._http_server.trace_node = f"{self.host}:{self.port}"
+        # /metrics exposition via the mini loop (like S3/WebDAV): the
+        # filer's UI always linked /metrics but its path router treated
+        # it as a namespace lookup (404 on a fresh store) — the cluster
+        # collector needs the real exposition. Tradeoff: a stored FILE
+        # literally named /metrics is shadowed on GET, same contract as
+        # the other gateways.
+        self._http_server.gateway_metrics = True
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
+        # telemetry plane: announce this gateway to the master so the
+        # leader's collector scrapes it, and start the sampling profiler
+        from seaweedfs_tpu.telemetry import profiler
+        from seaweedfs_tpu.telemetry.announce import start_announce_loop
+
+        profiler.ensure_started()
+        self._announce = start_announce_loop(
+            "filer", f"{self.host}:{self.port}", self.masters,
+            interval=self.announce_interval,
+        )
 
     def stop(self) -> None:
+        if self._announce is not None:
+            self._announce.stop_event.set()
         if self._http_server:
             self._http_server.shutdown()
             self._http_server.server_close()

@@ -103,6 +103,8 @@ class MasterServer:
         repair_interval: float = 0.0,
         repair_concurrency: int = 2,
         repair_grace: float = 30.0,
+        telemetry_interval: float = 0.0,
+        telemetry_kwargs: dict | None = None,
     ):
         self.host = host
         self.port = port
@@ -192,6 +194,42 @@ class MasterServer:
         self._clients_lock = threading.Lock()
         self._grpc_server: grpc.Server | None = None
         self._http_server: WeedHTTPServer | None = None
+        # telemetry plane (docs/TELEMETRY.md): leader-only /metrics
+        # scraper + ring TSDB + alert rules. telemetry_interval <= 0
+        # leaves the plane off — the `weed` CLI enables it by default;
+        # tests and embedders opt in (a background scraper hitting
+        # every node changes observable traffic).
+        self.telemetry = None
+        if telemetry_interval > 0:
+            from seaweedfs_tpu.telemetry import ClusterCollector
+
+            self.telemetry = ClusterCollector(
+                self, interval=telemetry_interval, **(telemetry_kwargs or {})
+            )
+        # gateway registration (/cluster/register): filer/S3/WebDAV
+        # announce themselves here so the collector can scrape them —
+        # they have no heartbeat stream to be discovered from
+        self._gateways: dict[str, dict] = {}
+        self._gateways_lock = threading.Lock()
+
+    # gateways silent for this long stop being offered to the collector
+    # (its own sticky-target window keeps their staleness alert alive
+    # long before this prune runs)
+    GATEWAY_TTL = 3600.0
+
+    def register_gateway(self, kind: str, addr: str) -> None:
+        with self._gateways_lock:
+            self._gateways[addr] = {"kind": kind, "last_seen": time.time()}
+
+    def gateway_registrations(self) -> dict[str, dict]:
+        now = time.time()
+        with self._gateways_lock:
+            for addr in [
+                a for a, row in self._gateways.items()
+                if now - row["last_seen"] > self.GATEWAY_TTL
+            ]:
+                del self._gateways[addr]
+            return {a: dict(r) for a, r in self._gateways.items()}
 
     @property
     def is_leader(self) -> bool:
@@ -737,6 +775,61 @@ class MasterServer:
                     return self._json({"Topology": server._topology_dump()})
                 if path == "/stats/health":
                     return self._json({"ok": True})
+                if path == "/cluster/register":
+                    # gateway announce (telemetry/announce.py): record
+                    # on the leader so the collector that scrapes is
+                    # the one that knows the gateway exists. addr must
+                    # LOOK like host:port — the collector will dial
+                    # http://<addr>/metrics every cycle, so a free-form
+                    # string would turn the leader into an arbitrary-
+                    # URL fetcher (and a permanent bogus-alert source)
+                    kind = q.get("kind", "")
+                    addr = q.get("addr", "")
+                    host, _, port_s = addr.rpartition(":")
+                    if (
+                        not kind
+                        or len(kind) > 32
+                        or not host
+                        or len(addr) > 256
+                        or not port_s.isdigit()
+                        or not int(port_s) < 65536
+                        or any(c in host for c in "/?#@ \t")
+                    ):
+                        return self._json(
+                            {"error": "kind and addr (host:port) required"},
+                            400,
+                        )
+                    if not server.is_leader:
+                        return self._proxy_http_to_leader()
+                    server.register_gateway(kind, addr)
+                    return self._json({"ok": True})
+                if path in ("/cluster/health", "/cluster/alerts", "/cluster/top"):
+                    if not server.is_leader:
+                        # followers hold no topology and run no
+                        # collector cycles (their local collector may
+                        # even be disabled); the leader's aggregates
+                        # are the cluster's — proxy BEFORE the
+                        # disabled check so a follower never answers
+                        # "Disabled" for a cluster whose leader is
+                        # collecting fine
+                        return self._proxy_http_to_leader()
+                    if server.telemetry is None:
+                        return self._json(
+                            {
+                                "Disabled": True,
+                                "error": "telemetry collector disabled "
+                                "on this master (-telemetryInterval 0)",
+                            }
+                        )
+                    if path == "/cluster/health":
+                        return self._json(server.telemetry.health_payload())
+                    if path == "/cluster/alerts":
+                        return self._json(server.telemetry.alerts.payload())
+                    try:
+                        n = int(q.get("n", "10"))
+                    except ValueError:
+                        n = 10
+                    return self._json(server.telemetry.top_payload(n))
                 if path == "/repair/queue":
                     # scrub plane operator surface (repair.queue shell
                     # command): scheduler config, tracked damage with
@@ -1166,9 +1259,18 @@ class MasterServer:
             threading.Thread(target=self._liveness_loop, daemon=True).start()
         if self.repair is not None:
             self.repair.start()
+        if self.telemetry is not None:
+            self.telemetry.start()
+        # continuous sampling profiler (telemetry/profiler.py): every
+        # daemon serves /debug/profile; WEED_PROF=0 opts the process out
+        from seaweedfs_tpu.telemetry import profiler
+
+        profiler.ensure_started()
 
     def stop(self) -> None:
         self._stop_event.set()
+        if self.telemetry is not None:
+            self.telemetry.stop()
         if self.repair is not None:
             self.repair.stop()
         if self._raft is not None:

@@ -1957,6 +1957,11 @@ class VolumeServer:
             self._hb_thread.start()
         if self.scrub is not None:
             self.scrub.start()
+        # telemetry plane: continuous sampling profiler behind
+        # /debug/profile (WEED_PROF=0 opts out)
+        from seaweedfs_tpu.telemetry import profiler
+
+        profiler.ensure_started()
 
     def stop(self) -> None:
         self._stop.set()

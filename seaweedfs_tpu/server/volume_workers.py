@@ -744,6 +744,11 @@ class VolumeReadWorker:
             t = threading.Thread(target=s.serve_forever, daemon=True)
             t.start()
             self._threads.append(t)
+        # telemetry plane: workers serve /debug/profile too — a GIL
+        # stall in one SO_REUSEPORT process is invisible from the lead
+        from seaweedfs_tpu.telemetry import profiler
+
+        profiler.ensure_started()
         wlog.info(
             "volume %s worker %d on %s:%d (lead %s)",
             "write" if self.shard_writes else "read",

@@ -1355,3 +1355,191 @@ class TraceDump(Command):
 
             for r in roots:
                 walk(r, 0)
+
+
+# ----------------------------------------------------------------------
+# cluster telemetry plane (docs/TELEMETRY.md)
+
+
+@register
+class ClusterHealth(Command):
+    name = "cluster.health"
+    help = (
+        "cluster.health [-json] — the leader collector's view: per-"
+        "target scrape health (staleness, last error), alert counts, "
+        "push-loop status"
+    )
+
+    def run(self, env, args, out):
+        import json as _json
+
+        snap = _http_json(f"http://{env.master}/cluster/health")
+        if _has_flag(args, "json"):
+            print(_json.dumps(snap), file=out)
+            return
+        if snap.get("Disabled"):
+            print(
+                "telemetry collector disabled on this master "
+                "(-telemetryInterval 0)",
+                file=out,
+            )
+            return
+        print(
+            f"collector: every {snap.get('IntervalSeconds')}s, "
+            f"{snap.get('Cycles', 0)} cycle(s), window "
+            f"{snap.get('WindowSeconds')}s, "
+            f"{snap.get('FiringAlerts', 0)} firing / "
+            f"{snap.get('PendingAlerts', 0)} pending alert(s)",
+            file=out,
+        )
+        for url, row in sorted((snap.get("Targets") or {}).items()):
+            state = "up" if row.get("Up") else "DOWN"
+            line = (
+                f"  {url} [{row.get('Kind')}]: {state}, "
+                f"stale {row.get('StalenessSeconds', 0):.1f}s, "
+                f"{row.get('Series', 0)} series, "
+                f"{row.get('Scrapes', 0)} scrape(s)"
+            )
+            if row.get("LastError"):
+                line += f" last error: {row['LastError']}"
+            print(line, file=out)
+        for job, push in sorted((snap.get("Push") or {}).items()):
+            line = f"  push@{job}: last success {push.get('last_success_unix', 0)}"
+            if push.get("last_error"):
+                line += f" last error: {push['last_error']}"
+            print(line, file=out)
+
+
+@register
+class ClusterAlerts(Command):
+    name = "cluster.alerts"
+    help = (
+        "cluster.alerts [-json] — firing/pending alerts and recent "
+        "resolved history from the master rule engine"
+    )
+
+    def run(self, env, args, out):
+        import json as _json
+
+        snap = _http_json(f"http://{env.master}/cluster/alerts")
+        if _has_flag(args, "json"):
+            print(_json.dumps(snap), file=out)
+            return
+        if snap.get("Disabled"):
+            print(
+                "telemetry collector disabled on this master "
+                "(-telemetryInterval 0)",
+                file=out,
+            )
+            return
+        firing = snap.get("Firing") or []
+        pending = snap.get("Pending") or []
+        if not firing and not pending:
+            print("no active alerts", file=out)
+        for a in firing:
+            print(
+                f"FIRING [{a['Severity']}] {a['Alert']} @ {a['Target']}: "
+                f"{a['Detail']}",
+                file=out,
+            )
+        for a in pending:
+            print(
+                f"pending [{a['Severity']}] {a['Alert']} @ {a['Target']}: "
+                f"{a['Detail']}",
+                file=out,
+            )
+        for a in (snap.get("History") or [])[-10:]:
+            print(
+                f"  resolved {a['Alert']} @ {a['Target']} "
+                f"(fired {a.get('FiredAtUnix', 0)}, "
+                f"resolved {a.get('ResolvedAtUnix', 0)})",
+                file=out,
+            )
+
+
+@register
+class ClusterTop(Command):
+    name = "cluster.top"
+    help = (
+        "cluster.top [-n 10] [-json] — busiest nodes by req/s (with "
+        "5xx rate and http p99) and biggest volumes by size"
+    )
+
+    def run(self, env, args, out):
+        import json as _json
+
+        n = int(_flag(args, "n", "10") or 10)
+        snap = _http_json(f"http://{env.master}/cluster/top?n={n}")
+        if _has_flag(args, "json"):
+            print(_json.dumps(snap), file=out)
+            return
+        if snap.get("Disabled"):
+            print(
+                "telemetry collector disabled on this master "
+                "(-telemetryInterval 0)",
+                file=out,
+            )
+            return
+        print("busiest nodes:", file=out)
+        for row in snap.get("Nodes") or []:
+            p99 = row.get("P99Ms")
+            print(
+                f"  {row['Url']} [{row['Kind']}]: "
+                f"{row['ReqPerSec']:.2f} req/s, "
+                f"{row['ErrPerSec']:.2f} err/s, "
+                f"p99 {'-' if p99 is None else f'{p99:.1f}ms'}",
+                file=out,
+            )
+        print("biggest volumes:", file=out)
+        for row in snap.get("Volumes") or []:
+            print(
+                f"  vid {row['VolumeId']} @ {row['Node']}: "
+                f"{row['SizeBytes'] >> 20} MiB, "
+                f"{row['FileCount']} file(s)"
+                + (
+                    f" [{row['Collection']}]" if row.get("Collection") else ""
+                ),
+                file=out,
+            )
+
+
+@register
+class ProfileCapture(Command):
+    name = "profile.capture"
+    help = (
+        "profile.capture [-node host:port] [-seconds 2] [-top 15] "
+        "[-folded] — capture folded stacks from a node's continuous "
+        "sampling profiler (default: every node, ranked)"
+    )
+
+    def run(self, env, args, out):
+        node = _flag(args, "node")
+        seconds = float(_flag(args, "seconds", "2") or 2)
+        top = int(_flag(args, "top", "15") or 15)
+        urls = [node] if node else _trace_nodes(env)
+        for url in urls:
+            try:
+                payload = _http_json(
+                    f"http://{url}/debug/profile?seconds={seconds}",
+                    timeout=seconds + 15.0,
+                )
+            except (OSError, ValueError) as e:
+                print(f"{url}: unreachable ({e})", file=out)
+                continue
+            stacks = payload.get("stacks") or {}
+            print(
+                f"{url}: {payload.get('samples', 0)} sample(s) over "
+                f"{payload.get('seconds')}s "
+                f"(interval {payload.get('interval_ms')}ms, "
+                f"{'running' if payload.get('running') else 'PAUSED'})",
+                file=out,
+            )
+            ranked = sorted(stacks.items(), key=lambda kv: -kv[1])
+            if _has_flag(args, "folded"):
+                for stack, count in ranked:
+                    print(f"{stack} {count}", file=out)
+                continue
+            for stack, count in ranked[:top]:
+                # print the innermost frames; full stacks via -folded
+                leaf = ";".join(stack.split(";")[-3:])
+                print(f"  {count:6d}  {leaf}", file=out)

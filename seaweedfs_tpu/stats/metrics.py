@@ -133,7 +133,15 @@ class Histogram:
     def render(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
-            items = sorted(self._counts.items())
+            # copy each counts LIST, not just the dict: observe()
+            # mutates the per-child list in place from serving threads,
+            # and a render iterating the live list can emit bucket
+            # cumulative counts that disagree with the _count line it
+            # writes a few lines later (non-monotone exposition that
+            # trips real scrapers)
+            items = sorted(
+                (key, list(counts)) for key, counts in self._counts.items()
+            )
             sums = dict(self._sums)
         for key, counts in items:
             labels = dict(zip(self.label_names, key))
@@ -275,6 +283,26 @@ PUSH_FAILURES = DEFAULT_REGISTRY.counter(
     ("job",),
 )
 
+# --- cluster telemetry plane (docs/TELEMETRY.md) ----------------------------
+# Set by the master's leader-only collector: per-target scrape health
+# and the alert rule engine's firing state, re-exported so any external
+# scraper of the master inherits cluster aggregation + alerting.
+SCRAPE_STALENESS = DEFAULT_REGISTRY.gauge(
+    "weed_scrape_staleness_seconds",
+    "seconds since the collector last scraped this target successfully",
+    ("target",),
+)
+SCRAPE_UP = DEFAULT_REGISTRY.gauge(
+    "weed_scrape_up",
+    "1 when the most recent scrape of this target succeeded, else 0",
+    ("target",),
+)
+ALERT_FIRING = DEFAULT_REGISTRY.gauge(
+    "weed_alert_firing",
+    "1 while this alert rule is firing for this target",
+    ("alert", "target"),
+)
+
 # --- scrub & self-healing plane (docs/SCRUB.md) -----------------------------
 SCRUB_SCANNED = DEFAULT_REGISTRY.counter(
     "weed_scrub_scanned_bytes_total",
@@ -314,6 +342,18 @@ TIME_TO_REPAIR = DEFAULT_REGISTRY.histogram(
 )
 
 
+# textual push-loop health (gauges can't carry the error STRING): job
+# -> {"last_success_unix", "last_error"}; /cluster/health surfaces it
+_push_status: dict[str, dict] = {}
+_push_status_lock = threading.Lock()
+
+
+def push_status() -> dict[str, dict]:
+    """Per-job push-loop health rows for operator surfaces."""
+    with _push_status_lock:
+        return {job: dict(row) for job, row in _push_status.items()}
+
+
 def start_push_loop(
     gateway_url: str,
     job: str,
@@ -325,6 +365,8 @@ def start_push_loop(
     (stats/metrics.go LoopPushingMetric; interval and address arrive in
     the master HeartbeatResponse in the reference)."""
     stop = stop_event or threading.Event()
+    with _push_status_lock:
+        _push_status[job] = {"last_success_unix": 0.0, "last_error": ""}
 
     def loop():
         while not stop.is_set():
@@ -339,13 +381,20 @@ def start_push_loop(
                 urllib.request.urlopen(req, timeout=5).read()
                 PUSH_LAST_SUCCESS.set(time.time(), job)
                 PUSH_UP.set(1.0, job)
-            except OSError:
+                with _push_status_lock:
+                    _push_status[job] = {
+                        "last_success_unix": round(time.time(), 3),
+                        "last_error": "",
+                    }
+            except OSError as e:
                 # push gateway being down must not hurt the server —
-                # but it must be VISIBLE: /metrics now carries the
-                # loop's own health instead of the config being the
-                # only evidence the loop exists
+                # but it must be VISIBLE: /metrics carries the loop's
+                # own health, and push_status() keeps the error string
+                # for /cluster/health instead of failing silently
                 PUSH_UP.set(0.0, job)
                 PUSH_FAILURES.labels(job).inc()
+                with _push_status_lock:
+                    _push_status[job]["last_error"] = str(e)[:300]
             stop.wait(interval_sec)
 
     t = threading.Thread(target=loop, daemon=True, name="metrics-push")

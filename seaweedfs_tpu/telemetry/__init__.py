@@ -1,0 +1,41 @@
+"""Cluster telemetry plane (docs/TELEMETRY.md).
+
+PR 5 gave every request a trace; this plane makes the CLUSTER visible:
+
+  parse.py     Prometheus text-format 0.0.4 parser (the wire format
+               every daemon's /metrics already speaks)
+  ring.py      fixed-retention in-process ring TSDB: per-series sample
+               rings with counter-reset-aware rate/increase and
+               histogram-bucket quantiles
+  collector.py leader-only master scraper: volume servers discovered
+               from heartbeats, gateways via /cluster/register, with
+               per-target staleness + last-error tracking
+  alerts.py    SLO alert rules with firing→resolved transitions,
+               re-exported as weed_alert_firing gauges
+  profiler.py  continuous sampling profiler on every daemon
+               (sys._current_frames() → folded stacks, /debug/profile)
+  announce.py  gateway → master registration heartbeats
+  weedload.py  multi-process closed-loop load harness with
+               coordinated-omission-safe log-bucketed histograms
+
+The aggregation-only design follows the reference's shape
+(weed/stats/metrics.go push loop + weed/shell cluster commands) and the
+Facebook warehouse study (arXiv:1309.0186): fleet-level interference —
+repair traffic stealing serving bandwidth, one slow node dragging the
+cluster p99.9 — is only visible in aggregated telemetry, never in any
+single daemon's counters.
+"""
+
+from seaweedfs_tpu.telemetry.alerts import AlertManager, AlertRule
+from seaweedfs_tpu.telemetry.collector import ClusterCollector
+from seaweedfs_tpu.telemetry.parse import parse_prometheus_text
+from seaweedfs_tpu.telemetry.ring import SeriesRing, TargetStore
+
+__all__ = [
+    "AlertManager",
+    "AlertRule",
+    "ClusterCollector",
+    "SeriesRing",
+    "TargetStore",
+    "parse_prometheus_text",
+]

@@ -1,0 +1,326 @@
+"""weedload: multi-PROCESS closed-loop load harness.
+
+The in-process http tracker (bench.py `http`, BENCH_r06 caveat) shares
+the GIL with the servers it measures — it cannot see cross-process
+tail latency, which is exactly where the ROADMAP tail-latency work
+lives. weedload runs every worker as its own OS process against a real
+cluster over real sockets and reports p50/p99/p99.9 from log-bucketed
+histograms, so it is the measurement substrate for hedging/admission
+experiments.
+
+Coordinated-omission safety: each worker is closed-loop (next request
+issues only after the previous completes) but paces against a fixed
+schedule when `rate` is set — latency is measured from the request's
+SCHEDULED start, not its actual send. A server stall therefore charges
+every request queued behind it with the stall time, instead of the
+classic closed-loop lie where a 1 s freeze records one slow request
+and silently omits the 999 that never got sent. `rate=0` degrades to
+plain closed-loop (latency = send→reply) for max-throughput probes.
+
+Workloads: `put` workers drive the full user write path (master
+/dir/assign + volume POST per op); `get` workers read a pre-seeded
+keyset (volume GET per op, round-robin). Histograms are log-bucketed
+(~19% bucket growth from 50 µs to ~100 s) and merged in the parent;
+quantiles come from the shared stats/quantile estimator so weedload,
+the telemetry rings, and bench agree about tails by construction.
+"""
+
+from __future__ import annotations
+
+import bisect
+import http.client
+import json
+import multiprocessing
+import time
+import urllib.request
+
+from seaweedfs_tpu.stats.quantile import histogram_quantile
+
+# ~4 buckets per octave: 50 us .. ~104 s in 89 bounds (+1 overflow)
+_BOUNDS = tuple(5e-5 * 2 ** (i / 4) for i in range(85))
+
+
+class LogHistogram:
+    """Fixed log-bucketed latency histogram; cheap to record, merge,
+    and ship over a multiprocessing queue as a plain list."""
+
+    __slots__ = ("counts", "total", "sum", "max")
+
+    def __init__(self, counts: list[int] | None = None):
+        self.counts = counts or [0] * (len(_BOUNDS) + 1)
+        self.total = sum(self.counts)
+        self.sum = 0.0
+        self.max = 0.0
+
+    def record(self, seconds: float) -> None:
+        self.counts[bisect.bisect_left(_BOUNDS, seconds)] += 1
+        self.total += 1
+        self.sum += seconds
+        if seconds > self.max:
+            self.max = seconds
+
+    def merge(self, other: "LogHistogram") -> None:
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.total += other.total
+        self.sum += other.sum
+        if other.max > self.max:
+            self.max = other.max
+
+    def quantile(self, q: float) -> float:
+        if self.total == 0:
+            return 0.0
+        est = histogram_quantile(list(_BOUNDS), self.counts, q)
+        # bucket interpolation can overshoot the true extreme by up to
+        # one bucket width; the recorded max is a hard ceiling
+        return min(est, self.max) if self.max > 0 else est
+
+    def to_row(self) -> dict:
+        return {
+            "counts": self.counts,
+            "sum": self.sum,
+            "max": self.max,
+        }
+
+    @classmethod
+    def from_row(cls, row: dict) -> "LogHistogram":
+        h = cls(list(row["counts"]))
+        h.sum = row["sum"]
+        h.max = row["max"]
+        return h
+
+
+# ----------------------------------------------------------------------
+# worker process
+
+
+def _http(conns: dict, netloc: str, method: str, path: str,
+          body: bytes | None = None, timeout: float = 30.0):
+    """One request over a cached keep-alive connection; one fresh-dial
+    retry on a torn connection (server restart, idle close)."""
+    for attempt in (0, 1):
+        conn = conns.get(netloc)
+        if conn is None:
+            conn = conns[netloc] = http.client.HTTPConnection(
+                netloc, timeout=timeout
+            )
+        try:
+            conn.request(method, path, body=body)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, data
+        except (OSError, http.client.HTTPException):
+            conn.close()
+            conns.pop(netloc, None)
+            if attempt:
+                raise
+    raise RuntimeError("unreachable")
+
+
+def _worker(spec: dict, out_q) -> None:
+    """One load worker (runs in its own process). `spec`:
+    mode, master, duration_s, payload, rate, keys, index."""
+    mode = spec["mode"]
+    master = spec["master"]
+    payload = spec["payload"]
+    rate = spec["rate"]
+    keys = spec.get("keys") or []
+    conns: dict[str, http.client.HTTPConnection] = {}
+    hist = LogHistogram()
+    ops = 0
+    errors = 0
+    err_samples: list[str] = []
+    nbytes = 0
+    interval = (1.0 / rate) if rate > 0 else 0.0
+    start = time.perf_counter()
+    deadline = start + spec["duration_s"]
+    scheduled = start
+    ki = spec.get("index", 0)  # stagger the round-robin start per worker
+    while True:
+        now = time.perf_counter()
+        if interval:
+            if scheduled > now:
+                time.sleep(scheduled - now)
+            t_ref = scheduled  # CO correction: charge from the schedule
+            scheduled += interval
+        else:
+            t_ref = now
+        if t_ref >= deadline or now >= deadline:
+            break
+        try:
+            if mode == "put":
+                status, data = _http(
+                    conns, master, "GET", "/dir/assign", timeout=30.0
+                )
+                if status != 200:
+                    raise RuntimeError(f"assign HTTP {status}")
+                a = json.loads(data)
+                if "error" in a:
+                    raise RuntimeError(f"assign: {a['error']}")
+                status, data = _http(
+                    conns, a["url"], "POST", f"/{a['fid']}", payload
+                )
+                if status not in (200, 201):
+                    raise RuntimeError(f"put HTTP {status}")
+                nbytes += len(payload)
+            else:
+                fid, url = keys[ki % len(keys)]
+                ki += 1
+                status, data = _http(conns, url, "GET", f"/{fid}")
+                if status != 200:
+                    raise RuntimeError(f"get {fid} HTTP {status}")
+                nbytes += len(data)
+        except Exception as e:  # noqa: BLE001 — counted, not fatal
+            errors += 1
+            if len(err_samples) < 5:
+                err_samples.append(repr(e)[:200])
+            hist.record(time.perf_counter() - t_ref)
+            continue
+        hist.record(time.perf_counter() - t_ref)
+        ops += 1
+    for c in conns.values():
+        c.close()
+    out_q.put({
+        "mode": mode,
+        "ops": ops,
+        "errors": errors,
+        "err_samples": err_samples,
+        "bytes": nbytes,
+        "hist": hist.to_row(),
+        "wall_s": time.perf_counter() - start,
+    })
+
+
+# ----------------------------------------------------------------------
+# parent
+
+
+def seed_keys(master: str, n: int, payload: bytes) -> list[tuple[str, str]]:
+    """Write n blobs for the GET workers to hammer; returns (fid, url)."""
+    keys: list[tuple[str, str]] = []
+    for _ in range(n):
+        with urllib.request.urlopen(
+            f"http://{master}/dir/assign", timeout=10
+        ) as r:
+            a = json.loads(r.read())
+        if "error" in a:
+            raise RuntimeError(f"seed assign: {a['error']}")
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://{a['url']}/{a['fid']}", data=payload, method="POST"
+            ),
+            timeout=10,
+        ).close()
+        keys.append((a["fid"], a["url"]))
+    return keys
+
+
+def _summarize(hist: LogHistogram, ops: int, errors: int, nbytes: int,
+               wall_s: float) -> dict:
+    return {
+        "ops": ops,
+        "errors": errors,
+        "req_per_sec": round(ops / wall_s, 2) if wall_s > 0 else 0.0,
+        "mb_per_sec": round(nbytes / wall_s / 1e6, 3) if wall_s > 0 else 0.0,
+        "p50_ms": round(hist.quantile(0.50) * 1e3, 3),
+        "p99_ms": round(hist.quantile(0.99) * 1e3, 3),
+        "p999_ms": round(hist.quantile(0.999) * 1e3, 3),
+        "max_ms": round(hist.max * 1e3, 3),
+        "mean_ms": round(hist.sum / hist.total * 1e3, 3) if hist.total else 0.0,
+    }
+
+
+def run_load(
+    master: str,
+    duration_s: float = 10.0,
+    writers: int = 2,
+    readers: int = 2,
+    payload_bytes: int = 1024,
+    rate: float = 0.0,
+    seed_n: int = 64,
+    mp_start: str = "spawn",
+) -> dict:
+    """Drive writers+readers worker PROCESSES against the cluster at
+    `master`; returns the merged report. `rate` is per-worker target
+    req/s (0 = unpaced closed loop). `mp_start` picks the
+    multiprocessing start method — spawn (default) never inherits the
+    parent's threads/locks, which matters when the caller embeds
+    in-process servers."""
+    if writers <= 0 and readers <= 0:
+        raise ValueError("need at least one worker")
+    # \x00\xff keeps the body ungzippable so the write path stays honest
+    payload = (b"weedload\x00\xff" * ((payload_bytes // 10) + 1))[:payload_bytes]
+    keys = seed_keys(master, seed_n, payload) if readers > 0 else []
+    ctx = multiprocessing.get_context(mp_start)
+    out_q = ctx.Queue()
+    procs = []
+    for i in range(writers + readers):
+        spec = {
+            "mode": "put" if i < writers else "get",
+            "master": master,
+            "duration_s": duration_s,
+            "payload": payload,
+            "rate": rate,
+            "keys": keys,
+            "index": i * 7,
+        }
+        p = ctx.Process(target=_worker, args=(spec, out_q), daemon=True)
+        p.start()
+        procs.append(p)
+    import queue as _queue
+
+    rows = []
+    join_deadline = time.time() + duration_s + 60.0
+    while len(rows) < len(procs) and time.time() < join_deadline:
+        try:
+            rows.append(out_q.get(timeout=1.0))
+        except _queue.Empty:
+            # a worker that died before posting (OOM kill, spawn
+            # bootstrap failure) must surface as a named error, not a
+            # 60s hang ending in a raw queue.Empty
+            dead = [
+                p for p in procs if not p.is_alive() and p.exitcode != 0
+            ]
+            if dead and len(rows) + sum(1 for p in procs if p.is_alive()) < len(procs):
+                break
+    for p in procs:
+        p.join(timeout=10)
+        if p.is_alive():
+            p.terminate()
+    if len(rows) < len(procs):
+        codes = [p.exitcode for p in procs]
+        raise RuntimeError(
+            f"weedload: only {len(rows)}/{len(procs)} workers reported "
+            f"(exit codes {codes}) — a worker died before posting results"
+        )
+    report: dict = {
+        "config": {
+            "master": master,
+            "duration_s": duration_s,
+            "writers": writers,
+            "readers": readers,
+            "payload_bytes": payload_bytes,
+            "rate_per_worker": rate,
+            "coordinated_omission_safe": rate > 0,
+            "processes": len(procs),
+        },
+    }
+    for mode in ("put", "get"):
+        mode_rows = [r for r in rows if r["mode"] == mode]
+        if not mode_rows:
+            continue
+        hist = LogHistogram()
+        ops = errors = nbytes = 0
+        wall = 0.0
+        samples: list[str] = []
+        for r in mode_rows:
+            hist.merge(LogHistogram.from_row(r["hist"]))
+            ops += r["ops"]
+            errors += r["errors"]
+            nbytes += r["bytes"]
+            wall = max(wall, r["wall_s"])
+            samples.extend(r["err_samples"])
+        report[mode] = _summarize(hist, ops, errors, nbytes, wall)
+        if samples:
+            report[mode]["err_samples"] = samples[:5]
+    return report

@@ -123,17 +123,22 @@ def start_cluster(
     volume_size_limit_mb: int = 64,
     heartbeat_interval: float = 0.2,
     ready_timeout: float = 45.0,
+    master_kwargs: dict | None = None,
     **vs_kwargs,
 ):
     """Boot 1 master + one VolumeServer per dir (rack{i%2} layout) and
     wait until every node has registered. Returns (master, servers);
     caller stops them. Shared by tests/test_migration.py's fixture and
-    bench.py's migration config so both measure the same cluster shape."""
+    bench.py's migration config so both measure the same cluster shape.
+    `master_kwargs` feeds MasterServer (e.g. telemetry_interval for the
+    cluster-telemetry tests)."""
     from seaweedfs_tpu.server.master_server import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
 
     master = MasterServer(
-        port=free_port(), volume_size_limit_mb=volume_size_limit_mb
+        port=free_port(),
+        volume_size_limit_mb=volume_size_limit_mb,
+        **(master_kwargs or {}),
     )
     master.start()
     servers = []

@@ -392,7 +392,7 @@ def serve_connection(sock, addr, server, handler_cls) -> None:
             if (
                 command == "GET"
                 and (
-                    bare in ("/debug/traces", "/debug/requests")
+                    bare in ("/debug/traces", "/debug/requests", "/debug/profile")
                     or (bare == "/metrics" and gateway_metrics)
                 )
                 # an auth-fronted gateway vetoes the interception
@@ -470,6 +470,29 @@ def _serve_debug(h, bare: str) -> None:
             DEFAULT_REGISTRY.render_text().encode(),
             {"Content-Type": "text/plain; version=0.0.4"},
         )
+    if bare == "/debug/profile":
+        # continuous sampling profiler (telemetry/profiler.py):
+        # ?seconds=S captures the NEXT S seconds (capped; parks only
+        # this operator connection's thread), ?fmt=folded emits
+        # flamegraph.pl input instead of JSON
+        from seaweedfs_tpu.telemetry import profiler
+
+        q = fast_query(h.path.partition("?")[2])
+        try:
+            seconds = float(q.get("seconds", "1"))
+        except ValueError:
+            seconds = 1.0
+        payload = profiler.capture(max(0.0, min(seconds, 30.0)))
+        payload["node"] = getattr(h.server, "trace_node", "") or payload.get(
+            "node", ""
+        )
+        if q.get("fmt") == "folded":
+            return h.fast_reply(
+                200,
+                profiler.render_folded(payload).encode(),
+                {"Content-Type": "text/plain; charset=utf-8"},
+            )
+        return h.fast_reply(200, _json.dumps(payload).encode(), JSON_HDR)
     if bare == "/debug/requests":
         payload = _trace.inflight_payload()
     else:

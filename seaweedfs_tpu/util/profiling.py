@@ -80,12 +80,26 @@ class CpuProfile:
         self._main.disable()
         threading.setprofile(self._prev_hook)
         stats = pstats.Stats(self._main)
+        skipped = 0
         with self._lock:
             for thread, prof in self._thread_profiles:
                 if thread.is_alive():
-                    continue  # cannot disable another thread's profiler
+                    # cannot disable another thread's profiler — its
+                    # samples never reach the dump
+                    skipped += 1
+                    continue
                 try:
                     stats.add(prof)
                 except Exception:  # noqa: BLE001 - partial stats are fine
                     pass
+        if skipped:
+            from seaweedfs_tpu.util import wlog
+
+            wlog.warning(
+                "cpuprofile %s: %d thread(s) still running at exit; "
+                "their samples were skipped (the continuous sampler at "
+                "/debug/profile covers long-lived threads)",
+                self.path,
+                skipped,
+            )
         stats.dump_stats(self.path)

@@ -36,11 +36,18 @@ class WebDavServer:
         host: str = "127.0.0.1",
         port: int = 7333,
         root: str = "/",
+        masters: list[str] | None = None,
+        announce_interval: float = 10.0,
     ):
         self.filer = filer
         self.host = host
         self.port = port
         self.root = root.rstrip("/")
+        # telemetry plane: masters to announce this gateway to so the
+        # cluster collector can scrape it (empty = no announce)
+        self.masters = list(masters or [])
+        self.announce_interval = announce_interval
+        self._announce: threading.Thread | None = None
         self._http_server: WeedHTTPServer | None = None
         self._channel: grpc.Channel | None = None
         self._lock = threading.Lock()
@@ -94,8 +101,18 @@ class WebDavServer:
         threading.Thread(
             target=self._http_server.serve_forever, daemon=True, name="webdav-http"
         ).start()
+        from seaweedfs_tpu.telemetry import profiler
+        from seaweedfs_tpu.telemetry.announce import start_announce_loop
+
+        profiler.ensure_started()
+        self._announce = start_announce_loop(
+            "webdav", f"{self.host}:{self.port}", self.masters,
+            interval=self.announce_interval,
+        )
 
     def stop(self) -> None:
+        if self._announce is not None:
+            self._announce.stop_event.set()
         if self._http_server:
             self._http_server.shutdown()
             self._http_server.server_close()

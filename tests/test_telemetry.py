@@ -1,0 +1,720 @@
+"""Cluster telemetry plane tests (docs/TELEMETRY.md).
+
+Units: quantile estimators, the Prometheus text parser against the
+repo's own renderer, ring TSDB rate/retention/reset math, weedload's
+log histograms, alert state transitions, the render-snapshot
+consistency regression (stats/metrics satellite), CpuProfile
+multi-thread aggregation + skipped-thread warning, and the continuous
+sampling profiler.
+
+E2E: the acceptance scenario — kill a volume server under a live
+cluster, watch scrape_staleness transition to firing in
+/cluster/health + cluster.alerts, restart, watch it resolve — plus
+gateway registration, /debug/profile over HTTP, the cluster.* shell
+commands, and a real multi-process weedload run.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.stats.quantile import histogram_quantile, percentile
+
+
+def _get_json(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def wait_until(pred, what: str, deadline_s: float = 30.0):
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        try:
+            out = pred()
+            if out:
+                return out
+        except Exception:  # noqa: BLE001 - not-ready counts as false
+            pass
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ----------------------------------------------------------------------
+# quantile helpers (the dedupe satellite)
+
+
+class TestQuantile:
+    def test_percentile_nearest_rank(self):
+        vals = list(range(1, 11))  # 1..10
+        assert percentile(vals, 0.5) == 5
+        assert percentile(vals, 0.0) == 1
+        assert percentile(vals, 1.0) == 10
+        assert percentile(vals, 0.99) == 10
+        assert percentile([7.0], 0.99) == 7.0
+
+    def test_percentile_unsorted_input(self):
+        assert percentile([9, 1, 5, 3, 7], 0.5) == 5
+
+    def test_percentile_empty_raises(self):
+        with pytest.raises(ValueError):
+            percentile([], 0.5)
+        with pytest.raises(ValueError):
+            percentile([1.0], 1.5)
+
+    def test_histogram_quantile_interpolates(self):
+        # 100 observations uniform in one bucket (0.1, 0.2]
+        bounds = [0.1, 0.2, 0.4]
+        counts = [0, 100, 0]
+        assert histogram_quantile(bounds, counts, 0.5) == pytest.approx(0.15)
+        assert histogram_quantile(bounds, counts, 1.0) == pytest.approx(0.2)
+
+    def test_histogram_quantile_overflow_bucket(self):
+        bounds = [0.1, 0.2]
+        counts = [0, 0, 5]  # all observations above the last bound
+        assert histogram_quantile(bounds, counts, 0.5) == pytest.approx(0.2)
+
+    def test_histogram_quantile_empty_and_validation(self):
+        assert histogram_quantile([0.1], [0], 0.99) == 0.0
+        with pytest.raises(ValueError):
+            histogram_quantile([0.1, 0.2], [1], 0.5)
+
+
+# ----------------------------------------------------------------------
+# Prometheus text parser
+
+
+class TestParse:
+    def test_roundtrip_with_registry(self):
+        from seaweedfs_tpu.stats.metrics import Registry
+        from seaweedfs_tpu.telemetry.parse import parse_prometheus_text
+
+        reg = Registry()
+        c = reg.counter("t_total", "help", ("server", "status"))
+        c.labels("vol a", "200").inc(3)
+        g = reg.gauge("t_gauge", "help")
+        g.set(2.5)
+        h = reg.histogram("t_hist", "help", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        samples = parse_prometheus_text(reg.render_text())
+        d = {(n, l): v for n, l, v in samples}
+        assert d[("t_total", (("server", "vol a"), ("status", "200")))] == 3.0
+        assert d[("t_gauge", ())] == 2.5
+        assert d[("t_hist_bucket", (("le", "0.1"),))] == 1.0
+        assert d[("t_hist_bucket", (("le", "+Inf"),))] == 2.0
+        assert d[("t_hist_count", ())] == 2.0
+
+    def test_escapes_and_malformed_lines(self):
+        from seaweedfs_tpu.telemetry.parse import parse_prometheus_text
+
+        text = (
+            '# HELP x help text\n'
+            '# TYPE x counter\n'
+            'x{path="a\\"b\\\\c\\nd"} 1\n'
+            'garbage line without value\n'
+            'noval \n'
+            'y 2.5e-3\n'
+            'z +Inf\n'
+        )
+        samples = parse_prometheus_text(text)
+        d = {(n, l): v for n, l, v in samples}
+        assert d[("x", (("path", 'a"b\\c\nd'),))] == 1.0
+        assert d[("y", ())] == pytest.approx(0.0025)
+        assert d[("z", ())] == float("inf")
+        assert len(samples) == 3
+
+
+# ----------------------------------------------------------------------
+# ring TSDB
+
+
+class TestSeriesRing:
+    def test_retention_cap(self):
+        from seaweedfs_tpu.telemetry.ring import SeriesRing
+
+        r = SeriesRing(cap=4)
+        for i in range(10):
+            r.append(float(i), float(i * 10))
+        assert r.count == 4
+        assert [v for _, v in r.items()] == [60.0, 70.0, 80.0, 90.0]
+        assert r.last() == (9.0, 90.0)
+
+    def test_increase_is_reset_aware(self):
+        from seaweedfs_tpu.telemetry.ring import SeriesRing
+
+        r = SeriesRing(cap=16)
+        now = 1000.0
+        # counter climbs to 50, daemon restarts (reset to 0), climbs to 7
+        for i, v in enumerate([10, 30, 50, 0, 3, 7]):
+            r.append(now + i, float(v))
+        # naive last-first would be -3; reset-aware = 40 + 7
+        assert r.increase(100.0, now=now + 6) == pytest.approx(47.0)
+        assert r.rate(100.0, now=now + 6) == pytest.approx(47.0 / 5.0)
+
+    def test_rate_needs_two_samples(self):
+        from seaweedfs_tpu.telemetry.ring import SeriesRing
+
+        r = SeriesRing(cap=4)
+        r.append(1.0, 5.0)
+        assert r.rate(100.0, now=2.0) == 0.0
+
+    def test_target_store_quantile_from_buckets(self):
+        from seaweedfs_tpu.telemetry.ring import TargetStore
+
+        ts = TargetStore("n1:80", "volume")
+        mk = lambda le, v: ("w_seconds_bucket", (("le", le), ("name", "x")), v)
+        ts.record_scrape(
+            [mk("0.1", 0), mk("1.0", 0), mk("+Inf", 0)], t=100.0
+        )
+        # 100 obs landed in (0.1, 1.0] since the first scrape
+        ts.record_scrape(
+            [mk("0.1", 0), mk("1.0", 100), mk("+Inf", 100)], t=110.0
+        )
+        q = ts.quantile("w_seconds", 0.5, window_s=60.0, now=111.0)
+        assert q == pytest.approx(0.55, rel=0.01)
+        # no new observations in a later, narrow window
+        assert ts.quantile("w_seconds", 0.5, window_s=0.5, now=200.0) is None
+
+    def test_target_store_staleness_and_health(self):
+        from seaweedfs_tpu.telemetry.ring import TargetStore
+
+        ts = TargetStore("n1:80", "volume")
+        ts.record_scrape([("up", (), 1.0)], t=100.0)
+        assert ts.staleness(now=130.0) == pytest.approx(30.0)
+        ts.record_failure("boom", t=140.0)
+        row = ts.health_row(now=140.0)
+        assert row["LastError"] == "boom"
+        assert not row["Up"]
+        assert row["Series"] == 1
+
+
+# ----------------------------------------------------------------------
+# weedload histograms
+
+
+class TestLogHistogram:
+    def test_record_merge_quantile(self):
+        from seaweedfs_tpu.telemetry.weedload import LogHistogram
+
+        a, b = LogHistogram(), LogHistogram()
+        for _ in range(99):
+            a.record(0.001)
+        b.record(1.0)
+        a.merge(LogHistogram.from_row(b.to_row()))
+        assert a.total == 100
+        assert a.quantile(0.5) == pytest.approx(0.001, rel=0.3)
+        assert a.quantile(0.999) == pytest.approx(1.0, rel=0.3)
+        assert a.max == pytest.approx(1.0)
+
+    def test_quantiles_monotone(self):
+        from seaweedfs_tpu.telemetry.weedload import LogHistogram
+
+        h = LogHistogram()
+        for i in range(1, 1000):
+            h.record(i * 1e-4)
+        qs = [h.quantile(q) for q in (0.5, 0.9, 0.99, 0.999)]
+        assert qs == sorted(qs)
+
+
+# ----------------------------------------------------------------------
+# alert state machine
+
+
+class TestAlertManager:
+    def test_pending_firing_resolved_cycle(self):
+        from seaweedfs_tpu.telemetry.alerts import AlertManager, AlertRule
+
+        rule = AlertRule("r", "critical", for_s=5.0)
+        mgr = AlertManager()
+        mgr.evaluate([(rule, "n1", True, 1.0, "d")], now=100.0)
+        assert not mgr.firing()  # pending, not yet firing
+        assert len(mgr.payload()["Pending"]) == 1
+        mgr.evaluate([(rule, "n1", True, 2.0, "d")], now=106.0)
+        firing = mgr.firing()
+        assert len(firing) == 1 and firing[0]["Alert"] == "r"
+        from seaweedfs_tpu.stats.metrics import ALERT_FIRING
+
+        assert ALERT_FIRING.value("r", "n1") == 1.0
+        mgr.evaluate([(rule, "n1", False, 0.0, "")], now=110.0)
+        assert not mgr.firing()
+        assert ALERT_FIRING.value("r", "n1") == 0.0
+        hist = mgr.payload()["History"]
+        assert len(hist) == 1 and hist[0]["State"] == "resolved"
+
+    def test_absent_pair_resolves(self):
+        from seaweedfs_tpu.telemetry.alerts import AlertManager, AlertRule
+
+        rule = AlertRule("gone", for_s=0.0)
+        mgr = AlertManager()
+        mgr.evaluate([(rule, "n2", True, 1.0, "d")], now=10.0)
+        assert mgr.firing()
+        mgr.evaluate([], now=20.0)  # target forgotten entirely
+        assert not mgr.firing()
+
+    def test_flap_does_not_reach_history(self):
+        from seaweedfs_tpu.telemetry.alerts import AlertManager, AlertRule
+
+        rule = AlertRule("flappy", for_s=60.0)
+        mgr = AlertManager()
+        mgr.evaluate([(rule, "n1", True, 1.0, "")], now=0.0)
+        mgr.evaluate([(rule, "n1", False, 0.0, "")], now=1.0)
+        assert mgr.payload()["History"] == []  # never fired → no entry
+
+
+# ----------------------------------------------------------------------
+# stats/metrics satellite: snapshot-consistent rendering
+
+
+class TestRenderSnapshotConsistency:
+    def test_concurrent_mutation_keeps_exposition_consistent(self):
+        from seaweedfs_tpu.stats.metrics import Registry
+        from seaweedfs_tpu.telemetry.parse import parse_prometheus_text
+
+        reg = Registry()
+        hist = reg.histogram("c_hist", "h", ("k",), buckets=(0.1, 0.5, 1.0))
+        ctr = reg.counter("c_total", "h", ("k",))
+        stop = threading.Event()
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                hist.observe((i % 13) / 10.0, "a")
+                hist.observe((i % 7) / 10.0, "b")
+                ctr.labels("a").inc()
+                i += 1
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(60):
+                samples = parse_prometheus_text(reg.render_text())
+                buckets: dict[str, list[tuple[float, float]]] = {}
+                counts: dict[str, float] = {}
+                for name, labels, value in samples:
+                    ld = dict(labels)
+                    if name == "c_hist_bucket":
+                        le = (
+                            float("inf")
+                            if ld["le"] == "+Inf"
+                            else float(ld["le"])
+                        )
+                        buckets.setdefault(ld["k"], []).append((le, value))
+                    elif name == "c_hist_count":
+                        counts[ld["k"]] = value
+                for k, rows in buckets.items():
+                    rows.sort()
+                    vals = [v for _, v in rows]
+                    # cumulative buckets must be monotone AND agree
+                    # with the _count line rendered moments later —
+                    # the exact property the pre-fix live-list render
+                    # violated under concurrent observe()
+                    assert vals == sorted(vals), (k, vals)
+                    assert vals[-1] == counts[k], (k, vals, counts[k])
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+
+
+# ----------------------------------------------------------------------
+# util/profiling satellite
+
+
+class TestCpuProfile:
+    def test_aggregates_finished_threads_and_warns_on_running(self, tmp_path):
+        import pstats
+
+        from seaweedfs_tpu.util.profiling import CpuProfile
+
+        records: list[logging.LogRecord] = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        handler = Capture()
+        logging.getLogger("seaweedfs_tpu").addHandler(handler)
+        release = threading.Event()
+        path = str(tmp_path / "prof.pstats")
+
+        def finished_work():
+            sum(i * i for i in range(20_000))
+
+        def running_work():
+            release.wait(30)
+
+        try:
+            with CpuProfile(path):
+                t1 = threading.Thread(target=finished_work)
+                t1.start()
+                t1.join()
+                t2 = threading.Thread(target=running_work)
+                t2.start()
+        finally:
+            release.set()
+            t2.join(timeout=30)
+            logging.getLogger("seaweedfs_tpu").removeHandler(handler)
+        # the finished thread's frames made it into the dump
+        stats = pstats.Stats(path)
+        funcs = {fn for _, _, fn in stats.stats}
+        assert "finished_work" in funcs
+        # the still-running thread was counted and warned about
+        warned = [r for r in records if "still running at exit" in r.getMessage()]
+        assert len(warned) == 1
+        assert "1 thread(s)" in warned[0].getMessage()
+
+
+# ----------------------------------------------------------------------
+# continuous sampling profiler
+
+
+class TestSamplingProfiler:
+    def test_capture_sees_busy_thread(self):
+        from seaweedfs_tpu.telemetry import profiler
+
+        assert profiler.ensure_started()
+        stop = threading.Event()
+
+        def distinctive_busy_loop_for_profiler_test():
+            while not stop.is_set():
+                sum(i for i in range(5_000))
+
+        t = threading.Thread(target=distinctive_busy_loop_for_profiler_test)
+        t.start()
+        try:
+            payload = profiler.capture(0.6)
+        finally:
+            stop.set()
+            t.join()
+        assert payload["samples"] > 0
+        stacks = payload["stacks"]
+        assert any(
+            "distinctive_busy_loop_for_profiler_test" in s for s in stacks
+        ), list(stacks)[:5]
+        folded = profiler.render_folded(payload)
+        line = folded.splitlines()[0]
+        stack, _, count = line.rpartition(" ")
+        assert int(count) >= 1 and stack
+
+    def test_pause_resume(self):
+        from seaweedfs_tpu.telemetry import profiler
+
+        profiler.ensure_started()
+        profiler.set_paused(True)
+        try:
+            s0, _ = profiler.snapshot()
+            time.sleep(0.15)
+            s1, _ = profiler.snapshot()
+            assert s1 == s0  # no samples while paused
+        finally:
+            profiler.set_paused(False)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if profiler.snapshot()[0] > s1:
+                break
+            time.sleep(0.02)
+        assert profiler.snapshot()[0] > s1  # sampling again
+
+
+# ----------------------------------------------------------------------
+# e2e: the acceptance scenario
+
+
+@pytest.fixture(scope="class")
+def telemetry_cluster(tmp_path_factory):
+    from seaweedfs_tpu.util.availability import start_cluster
+
+    dirs = [
+        str(tmp_path_factory.mktemp("tele-v0")),
+        str(tmp_path_factory.mktemp("tele-v1")),
+    ]
+    master, servers = start_cluster(
+        dirs,
+        master_kwargs={"telemetry_interval": 0.3},
+        scrub_interval=0.0,
+    )
+    yield master, servers, dirs
+    for vs in servers:
+        try:
+            vs.stop()
+        except Exception:  # noqa: BLE001 - some get stopped by tests
+            pass
+    master.stop()
+
+
+class TestClusterTelemetryE2E:
+    def _shell(self, master, line: str) -> str:
+        from seaweedfs_tpu.shell.command_env import CommandEnv
+        from seaweedfs_tpu.shell.commands import COMMANDS
+        import shlex
+
+        env = CommandEnv([f"127.0.0.1:{master.port}"])
+        out = io.StringIO()
+        parts = shlex.split(line)
+        COMMANDS[parts[0]].run(env, parts[1:], out)
+        return out.getvalue()
+
+    def test_kill_volume_server_fires_staleness_then_restart_resolves(
+        self, telemetry_cluster
+    ):
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+        from seaweedfs_tpu.stats.metrics import ALERT_FIRING
+
+        master, servers, dirs = telemetry_cluster
+        m = f"127.0.0.1:{master.port}"
+        victim = servers[1]
+        victim_url = f"127.0.0.1:{victim.port}"
+
+        # phase 0: all three targets (master + 2 volumes) healthy
+        def all_up():
+            h = _get_json(f"http://{m}/cluster/health")
+            rows = h.get("Targets", {})
+            return (
+                len(rows) >= 3
+                and all(r["Up"] for r in rows.values())
+                and h["Cycles"] >= 2
+            )
+
+        wait_until(all_up, "all targets scraped and up")
+        health = _get_json(f"http://{m}/cluster/health")
+        assert health["Targets"][victim_url]["Kind"] == "volume"
+        assert not _get_json(f"http://{m}/cluster/alerts")["Firing"]
+
+        # phase 1: kill the volume server → scrape_staleness FIRING
+        victim.stop()
+
+        def staleness_firing():
+            alerts = _get_json(f"http://{m}/cluster/alerts")["Firing"]
+            return any(
+                a["Alert"] == "scrape_staleness" and a["Target"] == victim_url
+                for a in alerts
+            )
+
+        wait_until(staleness_firing, "staleness alert firing", 30.0)
+        health = _get_json(f"http://{m}/cluster/health")
+        assert not health["Targets"][victim_url]["Up"]
+        assert health["FiringAlerts"] >= 1
+        # re-exported as a gauge on the master's own /metrics
+        assert ALERT_FIRING.value("scrape_staleness", victim_url) == 1.0
+        # and visible through the operator shell
+        text = self._shell(master, "cluster.alerts")
+        assert "FIRING" in text and "scrape_staleness" in text
+        assert victim_url in text
+        health_text = self._shell(master, "cluster.health")
+        assert "DOWN" in health_text
+
+        # phase 2: restart on the same port/dir → alert resolves
+        revived = VolumeServer(
+            [dirs[1]],
+            port=victim.port,
+            master=m,
+            rack="rack1",
+            heartbeat_interval=0.2,
+            max_volume_counts=[100],
+            scrub_interval=0.0,
+        )
+        servers[1] = revived
+        revived.start()
+
+        def resolved():
+            alerts = _get_json(f"http://{m}/cluster/alerts")
+            still = any(
+                a["Alert"] == "scrape_staleness" and a["Target"] == victim_url
+                for a in alerts["Firing"]
+            )
+            up = _get_json(f"http://{m}/cluster/health")["Targets"][
+                victim_url
+            ]["Up"]
+            return not still and up
+        wait_until(resolved, "staleness alert resolved after restart", 30.0)
+        assert ALERT_FIRING.value("scrape_staleness", victim_url) == 0.0
+        hist = _get_json(f"http://{m}/cluster/alerts")["History"]
+        assert any(
+            a["Alert"] == "scrape_staleness" and a["Target"] == victim_url
+            for a in hist
+        )
+
+    def test_cluster_top_ranks_traffic(self, telemetry_cluster):
+        master, servers, _dirs = telemetry_cluster
+        m = f"127.0.0.1:{master.port}"
+        # generate some traffic so rates are non-zero
+        for _ in range(30):
+            a = _get_json(f"http://{m}/dir/assign")
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://{a['url']}/{a['fid']}",
+                    data=b"telemetry-top-payload" * 40,
+                    method="POST",
+                ),
+                timeout=10,
+            ).close()
+
+        def has_rates():
+            top = _get_json(f"http://{m}/cluster/top?n=5")
+            ok = top.get("Nodes") and any(
+                r["ReqPerSec"] > 0 for r in top["Nodes"]
+            ) and top.get("Volumes")
+            return top if ok else None
+
+        top = wait_until(has_rates, "cluster.top sees traffic", 30.0)
+        assert top["Volumes"][0]["SizeBytes"] > 0
+        text = self._shell(master, "cluster.top -n 5")
+        assert "busiest nodes" in text and "req/s" in text
+
+    def test_gateway_registration_becomes_scrape_target(
+        self, telemetry_cluster
+    ):
+        from seaweedfs_tpu.server.filer_server import FilerServer
+        from seaweedfs_tpu.util.availability import free_port
+
+        master, _servers, _dirs = telemetry_cluster
+        m = f"127.0.0.1:{master.port}"
+        filer = FilerServer(
+            [m], port=free_port(), announce_interval=0.2
+        )
+        filer.start()
+        try:
+            filer_url = f"127.0.0.1:{filer.port}"
+
+            def filer_scraped():
+                h = _get_json(f"http://{m}/cluster/health")
+                row = h["Targets"].get(filer_url)
+                return row and row["Kind"] == "filer" and row["Up"]
+
+            wait_until(filer_scraped, "filer registered and scraped", 30.0)
+        finally:
+            filer.stop()
+
+    def test_debug_profile_over_http(self, telemetry_cluster):
+        master, servers, _dirs = telemetry_cluster
+        payload = _get_json(
+            f"http://127.0.0.1:{servers[0].port}/debug/profile?seconds=0.4",
+            timeout=15,
+        )
+        assert payload["samples"] > 0
+        assert any(";" in s for s in payload["stacks"])
+        # folded text format for flamegraph.pl
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{servers[0].port}"
+            "/debug/profile?seconds=0.2&fmt=folded",
+            timeout=15,
+        ) as r:
+            body = r.read().decode()
+            assert r.headers["Content-Type"].startswith("text/plain")
+        assert body.strip(), "folded output empty"
+        stack, _, count = body.splitlines()[0].rpartition(" ")
+        assert int(count) >= 1
+        # profile.capture shell command against the same node
+        text = self._shell(
+            master,
+            f"profile.capture -node 127.0.0.1:{servers[0].port} -seconds 0.3",
+        )
+        assert "sample(s)" in text
+
+    def test_register_endpoint_validates(self, telemetry_cluster):
+        master, _servers, _dirs = telemetry_cluster
+        m = f"127.0.0.1:{master.port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{m}/cluster/register?kind=s3", timeout=5
+            )
+        assert ei.value.code == 400
+
+
+class TestWeedloadE2E:
+    def test_multiprocess_load_reports_quantiles(self, tmp_path):
+        from seaweedfs_tpu.telemetry.weedload import run_load
+        from seaweedfs_tpu.util.availability import start_cluster
+
+        master, servers = start_cluster([str(tmp_path)], scrub_interval=0.0)
+        try:
+            report = run_load(
+                f"127.0.0.1:{master.port}",
+                duration_s=2.0,
+                writers=1,
+                readers=1,
+                payload_bytes=512,
+                rate=0.0,
+                seed_n=8,
+            )
+        finally:
+            for vs in servers:
+                vs.stop()
+            master.stop()
+        assert report["config"]["processes"] == 2
+        for mode in ("put", "get"):
+            row = report[mode]
+            assert row["ops"] > 0, report
+            assert row["errors"] == 0, report
+            assert 0 < row["p50_ms"] <= row["p99_ms"] <= row["p999_ms"]
+
+    def test_paced_mode_is_co_safe(self, tmp_path):
+        """With a rate schedule, a stalled server charges the latency of
+        every request queued behind the stall (measured from the
+        SCHEDULED start) — the pure closed-loop lie is off."""
+        from seaweedfs_tpu.telemetry.weedload import (
+            LogHistogram,
+            _worker,
+        )
+
+        # a fake one-shot "server": the first request stalls 0.5s, the
+        # rest are instant; at 50 req/s the stall spans ~25 schedules
+        class FakeQ:
+            def __init__(self):
+                self.rows = []
+
+            def put(self, row):
+                self.rows.append(row)
+
+        calls = {"n": 0}
+
+        import seaweedfs_tpu.telemetry.weedload as wl
+
+        real_http = wl._http
+
+        def stalling_http(conns, netloc, method, path, body=None, timeout=30.0):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                time.sleep(0.5)
+            if method == "GET" and path == "/dir/assign":
+                return 200, json.dumps(
+                    {"fid": "1,ff", "url": "fake"}
+                ).encode()
+            return 201, b"{}"
+
+        q = FakeQ()
+        wl._http = stalling_http
+        try:
+            _worker(
+                {
+                    "mode": "put",
+                    "master": "fake",
+                    "duration_s": 1.0,
+                    "payload": b"x",
+                    "rate": 50.0,
+                    "keys": [],
+                    "index": 0,
+                },
+                q,
+            )
+        finally:
+            wl._http = real_http
+        row = q.rows[0]
+        hist = LogHistogram.from_row(row["hist"])
+        # ~25 schedules piled up behind the 0.5s stall; CO correction
+        # charges each from its SCHEDULED start, so the upper quantiles
+        # carry the queue delay. Without the correction only ONE op
+        # records the stall and p90 collapses to the ~1ms service time
+        # — the classic coordinated-omission lie this test pins down.
+        assert row["ops"] >= 20
+        assert hist.quantile(0.9) > 0.05, hist.quantile(0.9)
+        assert hist.max > 0.4, hist.max
