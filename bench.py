@@ -1652,6 +1652,63 @@ def check_weedlint() -> int:
     return proc.returncode
 
 
+def check_contracts_smoke() -> int:
+    """`bench.py --check` contracts+lifecycle leg: both new weedlint
+    tiers must (a) run clean on the real tree (that is check_weedlint's
+    full-CLI job; here we assert the tiers themselves loaded) and
+    (b) still DETECT planted bugs — a checker that silently goes blind
+    is worse than none, so the gate proves the positive controls every
+    run, via a throwaway fixture tree."""
+    import tempfile
+    import textwrap
+
+    from seaweedfs_tpu.analysis import contracts, lifecycle
+
+    with tempfile.TemporaryDirectory() as td:
+        root = os.path.join(td, "fixturepkg")
+        os.makedirs(root)
+        with open(os.path.join(root, "__init__.py"), "w") as f:
+            f.write("")
+        with open(os.path.join(root, "srv.py"), "w") as f:
+            f.write(textwrap.dedent("""
+                import os
+                import urllib.request
+                from seaweedfs_tpu.util.httpd import FastHandler
+
+                class H(FastHandler):
+                    def do_GET(self):
+                        if self.path == "/served":
+                            return
+
+                def dial():
+                    urllib.request.urlopen(
+                        "http://127.0.0.1:9999/never-served", timeout=5
+                    )
+
+                def leak(p):
+                    fd = os.open(p, os.O_RDONLY)
+                    if os.fstat(fd).st_size == 0:
+                        return None
+                    os.close(fd)
+                    return True
+            """))
+        cf, _idx, _reg = contracts.check(root=root)
+        lf, _idx2 = lifecycle.check(root=root)
+    route_hit = any(
+        f.rule == "contract-route" and "/never-served" in f.message
+        for f in cf
+    )
+    leak_hit = any(f.rule == "lifecycle-fd-leak" for f in lf)
+    ok = route_hit and leak_hit
+    print(json.dumps({
+        "metric": "contracts_smoke",
+        "ok": ok,
+        "planted_route_detected": route_hit,
+        "planted_fd_leak_detected": leak_hit,
+    }))
+    return 0 if ok else 1
+
+
 def check_sanitizer_smoke() -> int:
     """Sanitizer gate: the ASan build of the whole shim tier must pass
     the native-post identity matrix and the fuzz-corpus sweep. Skips
@@ -1716,6 +1773,7 @@ def main() -> None:
         rc = rc or check_telemetry_smoke()
         if os.environ.get("WEED_BENCH_CHECK_INNER") != "1":
             rc = rc or check_weedlint()
+            rc = rc or check_contracts_smoke()
             rc = rc or check_sanitizer_smoke()
         raise SystemExit(rc)
     config = sys.argv[1] if len(sys.argv) > 1 else "all"

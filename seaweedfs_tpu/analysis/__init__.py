@@ -44,6 +44,7 @@ silence. docs/ANALYSIS.md is the checker catalog.
 
 from __future__ import annotations
 
+import ast
 import os
 import re
 from dataclasses import dataclass, field
@@ -51,10 +52,34 @@ from dataclasses import dataclass, field
 PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 REPO_ROOT = os.path.dirname(PACKAGE_ROOT)
 
-# `# weedlint: ignore[rule-a,rule-b] — why this is fine`
+# `# weedlint: ignore[rule-a,rule-b] — why this is fine`; markdown
+# files (contract findings anchor in docs) use the same grammar inside
+# an HTML comment: `<!-- weedlint: ignore[rule] — reason -->`
 _IGNORE_RE = re.compile(
-    r"#\s*weedlint:\s*ignore\[([a-z0-9_,\s-]+)\]\s*(?:[—:-]+\s*(.*))?"
+    r"(?:#|<!--)\s*weedlint:\s*ignore\[([a-z0-9_,\s-]+)\]\s*(?:[—:-]+\s*(.*))?"
 )
+
+
+def dotted_name(node: ast.expr) -> str:
+    """'urllib.request.urlopen'-style dotted name, '' when the chain
+    bottoms out in anything but a plain Name. Shared by every AST
+    checker (hotloop, contracts, lifecycle) — one definition, so a
+    future fix cannot silently diverge between tiers."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def const_str(node: ast.expr) -> "str | None":
+    """The literal string value of a Constant node, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
 
 
 @dataclass
@@ -76,6 +101,11 @@ class Suppressions:
     by_line: dict[int, set[str]] = field(default_factory=dict)
     # ignores missing the mandatory reason (line, rules)
     bare: list[tuple[int, str]] = field(default_factory=list)
+    # every well-formed ignore: (comment_line, target_line, rules) —
+    # the substrate of the --stale-suppressions audit
+    records: list[tuple[int, int, frozenset]] = field(
+        default_factory=list
+    )
 
 
 def scan_suppressions(source: str) -> Suppressions:
@@ -86,17 +116,21 @@ def scan_suppressions(source: str) -> Suppressions:
             continue
         rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
         reason = (m.group(2) or "").strip()
+        if reason.endswith("-->"):  # markdown comment closer
+            reason = reason[:-3].rstrip()
         if len(reason) < 3:
             sup.bare.append((i, ",".join(sorted(rules))))
             continue
-        if text.lstrip().startswith("#"):
+        if text.lstrip().startswith(("#", "<!--")):
             # a comment on its OWN line silences only the statement
             # below it — an inline ignore must never bleed onto the
             # next line, or an adjacent unannotated finding ships
             # under a neighbor's justification
-            sup.by_line.setdefault(i + 1, set()).update(rules)
+            target = i + 1
         else:
-            sup.by_line.setdefault(i, set()).update(rules)
+            target = i
+        sup.by_line.setdefault(target, set()).update(rules)
+        sup.records.append((i, target, frozenset(rules)))
     return sup
 
 
@@ -144,3 +178,48 @@ def apply_suppressions(
                 )
             )
     return kept, suppressed
+
+
+# rule tokens that mark a grammar EXAMPLE, not a live suppression —
+# the docs and these modules' docstrings spell the syntax with them
+_PLACEHOLDER_RULES = frozenset({"rule", "rule-a", "rule-b", "rule-name"})
+
+
+def find_stale_suppressions(
+    suppressed: list[Finding], sources: dict[str, str]
+) -> list[Finding]:
+    """`--stale-suppressions`: every well-formed ignore comment whose
+    rule no longer fires on its line is itself a finding — silence that
+    outlived its bug reads as an active hazard to the next maintainer
+    (and hides the NEXT real finding that lands on that line). An
+    ignore citing a rule NAME no checker emits is the worst case —
+    PR 5 shipped one (`hot-loop-lock`) that suppressed nothing for two
+    whole PRs."""
+    fired: set[tuple[str, int, str]] = {
+        (f.path, f.line, f.rule) for f in suppressed
+    }
+    fired_lines: set[tuple[str, int]] = {
+        (f.path, f.line) for f in suppressed
+    }
+    out: list[Finding] = []
+    for path, src in sources.items():
+        for comment_line, target, rules in scan_suppressions(src).records:
+            if rules <= _PLACEHOLDER_RULES:
+                continue  # syntax documentation, not a suppression
+            live = (
+                ("all" in rules and (path, target) in fired_lines)
+                or any((path, target, r) in fired for r in rules)
+            )
+            if not live:
+                out.append(
+                    Finding(
+                        "stale-suppression",
+                        path,
+                        comment_line,
+                        f"ignore[{','.join(sorted(rules))}] no longer "
+                        f"suppresses anything — the rule does not fire "
+                        f"here; delete the comment (it hides the next "
+                        f"real finding on this line)",
+                    )
+                )
+    return out

@@ -5,10 +5,11 @@ tree is clean (no unsuppressed findings — and no suppression missing
 its mandatory reason). This is the same gate `bench.py --check` and
 `make lint` drive; docs/ANALYSIS.md is the catalog.
 
-    python -m seaweedfs_tpu.analysis                # all checkers
-    python -m seaweedfs_tpu.analysis --rules lock-order,hot-loop
-    python -m seaweedfs_tpu.analysis --json         # machine-readable
-    python -m seaweedfs_tpu.analysis --fuzz 200     # + fuzz smoke
+    python -m seaweedfs_tpu.analysis                   # all checkers
+    python -m seaweedfs_tpu.analysis --rules contracts,lifecycle
+    python -m seaweedfs_tpu.analysis --json            # machine-readable
+    python -m seaweedfs_tpu.analysis --fuzz 200        # + fuzz smoke
+    python -m seaweedfs_tpu.analysis --stale-suppressions  # audit ignores
 """
 
 from __future__ import annotations
@@ -18,24 +19,60 @@ import json
 import sys
 import time
 
-from seaweedfs_tpu.analysis import Finding, apply_suppressions
+from seaweedfs_tpu.analysis import (
+    Finding,
+    apply_suppressions,
+    find_stale_suppressions,
+)
 
 # rule families, in the order they run; --rules filters by prefix,
 # e.g. `--rules lock-order`. lock-order and unguarded-write are
 # separate families that share one index walk — selecting either
 # runs the walk once and keeps only the selected family's findings
-_FAMILIES = ("lock-order", "unguarded-write", "hot-loop", "c")
+_FAMILIES = {
+    "lock-order": (
+        "static lock-acquisition graph: cycles are deadlock candidates"
+    ),
+    "unguarded-write": (
+        "writes to lock-guarded attributes reached without the guard"
+    ),
+    "hot-loop": (
+        "blocking calls (sleep/subprocess/deadline-less IO) reachable "
+        "from the FastHandler dispatch tree"
+    ),
+    "c": (
+        "C shim tier: -Wall -Wextra -Werror compile + structural "
+        "Py_BEGIN_ALLOW_THREADS checks"
+    ),
+    "contracts": (
+        "cross-component string contracts: served routes vs client "
+        "paths, registered vs referenced metrics, stamped vs parsed "
+        "headers, fast_reply statuses vs _REASON, WEED_* env vars and "
+        "CLI flags vs docs"
+    ),
+    "lifecycle": (
+        "fd/socket/thread acquire-release pairing: early-return leaks, "
+        "started-never-joined threads (interprocedural, owns[] aware)"
+    ),
+}
 
 
 def main(argv: list[str] | None = None) -> int:
-    ap = argparse.ArgumentParser(prog="python -m seaweedfs_tpu.analysis")
+    tier_help = "; ".join(f"{k}: {v}" for k, v in _FAMILIES.items())
+    ap = argparse.ArgumentParser(
+        prog="python -m seaweedfs_tpu.analysis",
+        description="weedlint — the repo-native static-analysis plane "
+        "(docs/ANALYSIS.md). Tiers: " + tier_help,
+    )
     ap.add_argument(
         "--rules",
         default="",
-        help="comma-separated rule prefixes to run (default: all)",
+        help="comma-separated tier prefixes to run (default: all of "
+        + ", ".join(_FAMILIES) + ")",
     )
     ap.add_argument(
-        "--json", action="store_true", help="machine-readable output"
+        "--json", action="store_true", help="machine-readable output "
+        "(includes the contract registries when the contracts tier runs)"
     )
     ap.add_argument(
         "--fuzz",
@@ -44,28 +81,46 @@ def main(argv: list[str] | None = None) -> int:
         metavar="N",
         help="also run N iterations of the C-vs-Python POST fuzzer",
     )
+    ap.add_argument(
+        "--stale-suppressions",
+        action="store_true",
+        help="audit mode: run every tier, then report each "
+        "`# weedlint: ignore[...]` whose rule no longer fires on its "
+        "line (silence that outlived its bug)",
+    )
     args = ap.parse_args(argv)
     wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
+    if args.stale_suppressions and wanted:
+        ap.error("--stale-suppressions audits ALL tiers; drop --rules")
+
+    def matches(w: str, family: str) -> bool:
+        # exact family, a full rule name within it (`hot-loop-no-timeout`
+        # → hot-loop, `contract-route` → contracts), or a shorthand
+        # prefix (`lock` → lock-order). A token that IS another family's
+        # exact name never prefix-matches across the boundary — `c` must
+        # select only the C tier, never `contracts` (and vice versa).
+        if w == family:
+            return True
+        if w.startswith(family + "-"):
+            return True
+        if family == "contracts" and w.startswith("contract-"):
+            return True
+        return w not in _FAMILIES and family.startswith(w)
+
     for w in wanted:
-        if not any(
-            w.startswith(f) or f.startswith(w) for f in _FAMILIES
-        ):
+        if not any(matches(w, f) for f in _FAMILIES):
             ap.error(
                 f"--rules {w!r} matches no checker family "
                 f"{list(_FAMILIES)}"
             )
 
     def active(family: str) -> bool:
-        # both directions: `--rules lock-order` selects the family,
-        # and `--rules hot-loop-no-timeout` (a full rule name) selects
-        # its `hot-loop` family rather than silently selecting nothing
-        return not wanted or any(
-            w.startswith(family) or family.startswith(w) for w in wanted
-        )
+        return not wanted or any(matches(w, family) for w in wanted)
 
     t0 = time.time()
     findings: list[Finding] = []
     index = None
+    registry = None
 
     if active("lock-order") or active("unguarded-write"):
         from seaweedfs_tpu.analysis import lockorder
@@ -77,8 +132,10 @@ def main(argv: list[str] | None = None) -> int:
             findings += [
                 f for f in lock_findings if f.rule == "unguarded-write"
             ]
-    elif active("hot-loop"):
-        # hot-loop alone only needs the package index, not the full
+    if index is None and (
+        active("hot-loop") or active("contracts") or active("lifecycle")
+    ):
+        # these tiers only need the package index, not the full
         # lock-graph/cycle/unguarded-write analyses
         from seaweedfs_tpu.analysis import lockorder
 
@@ -88,6 +145,16 @@ def main(argv: list[str] | None = None) -> int:
 
         hot_findings, index = hotloop.check(index=index)
         findings += hot_findings
+    if active("contracts"):
+        from seaweedfs_tpu.analysis import contracts
+
+        contract_findings, index, registry = contracts.check(index=index)
+        findings += contract_findings
+    if active("lifecycle"):
+        from seaweedfs_tpu.analysis import lifecycle
+
+        life_findings, index = lifecycle.check(index=index)
+        findings += life_findings
     if active("c"):
         from seaweedfs_tpu.analysis import ctier
 
@@ -101,6 +168,8 @@ def main(argv: list[str] | None = None) -> int:
 
         index = lockorder.build_index()
     kept, suppressed = apply_suppressions(findings, index.sources)
+    if args.stale_suppressions:
+        kept += find_stale_suppressions(suppressed, index.sources)
 
     fuzz_report = None
     if args.fuzz > 0:
@@ -125,6 +194,8 @@ def main(argv: list[str] | None = None) -> int:
             "elapsed_s": round(time.time() - t0, 2),
             "ok": not kept,
         }
+        if registry is not None:
+            out["contracts"] = registry.to_dict()
         if fuzz_report is not None:
             out["fuzz"] = fuzz_report.to_dict()
         print(json.dumps(out, indent=2))

@@ -66,6 +66,12 @@ def check_warnings() -> list[Finding]:
     cc = _compiler()
     if cc is None:
         return []  # no toolchain: pure-Python fallbacks serve, nothing to lint
+    # the sanitizer mode labels the finding: compile_cmd builds the
+    # same variant, so a rejection message must say WHICH build broke.
+    # (this line also fixes a latent NameError: the f-string below read
+    # `mode` that no path ever defined — reachable only on a failing
+    # compile, which is exactly when the diagnostics matter most)
+    mode = _build.san_mode()
     paths = sysconfig.get_paths()
     py_inc = tuple(
         dict.fromkeys((paths["include"], paths["platinclude"]))

@@ -428,9 +428,15 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m seaweedfs_tpu.analysis.fuzz_post"
     )
-    ap.add_argument("--n", type=int, default=200)
-    ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--corpus", default=DEFAULT_CORPUS)
+    ap.add_argument("--n", type=int, default=200, help="fuzz iterations to run")
+    ap.add_argument(
+        "--seed", type=int, default=0,
+        help="PRNG seed (same seed = same input stream)",
+    )
+    ap.add_argument(
+        "--corpus", default=DEFAULT_CORPUS,
+        help="corpus directory for crash/divergence persistence",
+    )
     ap.add_argument(
         "--seed-corpus",
         action="store_true",

@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import ast
 
-from seaweedfs_tpu.analysis import Finding
+from seaweedfs_tpu.analysis import Finding, dotted_name as _dotted
 from seaweedfs_tpu.analysis.lockorder import PackageIndex, build_index
 
 _HANDLER_BASES = {
@@ -143,18 +143,6 @@ def _reachable(index: PackageIndex, entries: set[str]) -> dict[str, str]:
                 if cb not in seen:
                     stack.append((cb, origin))
     return seen
-
-
-def _dotted(node: ast.expr) -> str:
-    """'urllib.request.urlopen'-style dotted name, '' when not a name."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
 
 
 def _has_kw(call: ast.Call, name: str) -> bool:

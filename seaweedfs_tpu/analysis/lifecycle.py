@@ -1,0 +1,694 @@
+"""Resource-lifecycle checker: fd/socket/thread acquire-release pairing.
+
+The upcoming epoll C serving core (ROADMAP) will hold thousands of
+fds per process; a Python-side path that leaks one fd per error
+return turns into fd exhaustion at connection scale, and a
+started-never-joined worker thread is a shutdown hang waiting for a
+signal. CPython's refcounting hides most of these in tests (the
+collector closes what you forgot) — which is exactly why they ship.
+
+Rules:
+
+  lifecycle-fd-leak      a locally acquired fd/file/socket can leave
+                         the function unclosed on some path: an early
+                         `return`/`raise` between acquisition and
+                         close, or plain fall-through. `with` blocks
+                         and try/finally-closed resources are clean.
+  lifecycle-thread-leak  a non-daemon threading.Thread start()ed but
+                         never join()ed, stored, or returned — a
+                         process that can never exit cleanly
+
+Analysis (precision over recall, like every weedlint pass):
+
+  * acquisitions: open()/os.open()/os.dup()/socket.socket()/
+    socket.create_connection()/sock.accept() and calls to in-package
+    ALLOCATOR functions (a function whose return value is a fresh
+    resource — computed to fixpoint over the call graph, so
+    `fd = self._open_shard()` carries the obligation to the caller);
+  * releases: .close()/os.close()/.join(), `with` context entry,
+    contextlib.closing;
+  * escapes (ownership transfer — the obligation moves, the local
+    check ends): returning/yielding the resource, storing it on self
+    or into any container, aliasing it, and passing it to a call —
+    EXCEPT known borrowing builtins (os.read/os.pread/os.fstat/
+    select.select... never take ownership) and in-package callees the
+    interprocedural pass proves only borrow their parameter. A callee
+    that closes or stores its parameter is a RELEASER/owner; passing
+    to it is a transfer. The explicit annotation
+        # weedlint: owns[param] — reason
+    on (or above) a `def` line forces ownership-transfer for that
+    parameter when the analysis cannot see it (C bindings, pools that
+    adopt fds);
+  * control flow: `with` bodies, try/finally (resources closed in the
+    finally are protected through the try), branches walked with
+    closed-in-any-arm leniency. Loops walk once.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from seaweedfs_tpu.analysis import Finding, dotted_name as _dotted
+from seaweedfs_tpu.analysis.lockorder import PackageIndex, build_index
+
+# dotted-name tails that mint a resource; kind is cosmetic (messages)
+_ACQUIRE_BUILTIN = {
+    "open": "file",  # both open() and os.open()
+    "dup": "fd",
+    "socket": "socket",
+    "create_connection": "socket",
+    "accept": "socket",
+    "fdopen": "file",
+    "TemporaryFile": "file",
+    "NamedTemporaryFile": "file",
+}
+
+# borrowing builtins: passing an fd/file here never transfers
+# ownership — the caller still owns the close
+_BORROW_TAILS = {
+    "read", "write", "pread", "pwrite", "pwritev", "preadv", "fstat",
+    "lseek", "ftruncate", "fsync", "fdatasync", "sendfile", "select",
+    "poll", "register", "len", "isinstance", "print", "repr", "str",
+    "fileno", "tell", "seek", "flush", "append_le", "pack", "unpack",
+    "min", "max", "abs", "int", "float", "bool", "hash", "id",
+}
+
+_CLOSE_TAILS = {"close", "join", "detach", "release_conn", "unlink"}
+
+_OWNS_RE = re.compile(r"#\s*weedlint:\s*owns\[([a-zA-Z0-9_,\s]+)\]\s*(?:[—:-]+\s*(\S.*))?")
+
+
+@dataclass
+class _Resource:
+    var: str
+    kind: str
+    line: int
+    daemon_thread: bool = False  # threads only
+    started: bool = False  # threads only
+
+
+@dataclass
+class FuncSummary:
+    """Interprocedural facts about one function."""
+
+    qualname: str
+    # returns a fresh resource of this kind (allocator)
+    allocates: str | None = None
+    # params (by name) the function takes ownership of: closes, stores,
+    # or passes onward to another owner — or annotated owns[param]
+    owns_params: set[str] = field(default_factory=set)
+    # params only ever borrowed (read/compared/passed to borrowers)
+    borrows_params: set[str] = field(default_factory=set)
+
+
+def _acquisition_kind(node: ast.expr, allocators: dict[str, str],
+                      resolve) -> str | None:
+    """kind string when `node` is a resource-minting call."""
+    if not isinstance(node, ast.Call):
+        return None
+    dotted = _dotted(node.func)
+    tail = dotted.rsplit(".", 1)[-1]
+    if tail == "open" and dotted in ("open", "os.open", "io.open"):
+        return "file" if dotted != "os.open" else "fd"
+    if tail in _ACQUIRE_BUILTIN and tail != "open":
+        head = dotted.split(".", 1)[0]
+        if tail == "socket" and head not in ("socket",):
+            return None  # some_obj.socket attribute, not the module
+        return _ACQUIRE_BUILTIN[tail]
+    if tail == "Thread" and dotted in ("threading.Thread", "Thread"):
+        return "thread"
+    ref = resolve(node.func)
+    if ref is not None and ref in allocators:
+        return allocators[ref]
+    return None
+
+
+def _thread_is_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            return not (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is False
+            )
+    return False
+
+
+# ---------------------------------------------------------------------------
+# per-function path walk
+
+
+class _LeakWalker:
+    """Walks one function body tracking locally owned resources.
+
+    State: var -> _Resource for resources this frame OWNS. A resource
+    leaves the state by being closed (release), escaping (transfer),
+    or being reported (leak)."""
+
+    def __init__(self, qual: str, rel_path: str,
+                 summaries: dict[str, FuncSummary],
+                 allocators: dict[str, str], resolve,
+                 funcs: dict | None = None):
+        self.qual = qual
+        self.rel_path = rel_path
+        self.summaries = summaries
+        self.allocators = allocators
+        self.resolve = resolve
+        self.funcs = funcs or {}
+        self.open: dict[str, _Resource] = {}
+        self.protected: set[str] = set()  # closed by enclosing finally
+        self.handler_depth = 0  # inside try-with-except: raises may be caught
+        self.findings: list[Finding] = []
+
+    # -- helpers -------------------------------------------------------
+    def _escape(self, var: str) -> None:
+        self.open.pop(var, None)
+
+    def _close(self, var: str) -> None:
+        self.open.pop(var, None)
+
+    def _escapes_in(self, node: ast.expr) -> None:
+        """Any tracked var appearing DIRECTLY inside `node` escapes
+        (returned, stored into a container, aliased). Names inside
+        nested Call nodes are skipped — _handle_call already classified
+        those as borrow/transfer."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Call):
+                continue
+            if isinstance(n, ast.Name) and n.id in self.open:
+                self._escape(n.id)
+            else:
+                stack.extend(ast.iter_child_nodes(n))
+
+    def _leak(self, res: _Resource, line: int, why: str) -> None:
+        if res.kind == "thread":
+            self.findings.append(Finding(
+                "lifecycle-thread-leak", self.rel_path, res.line,
+                f"{self.qual} starts a non-daemon Thread "
+                f"({res.var!r}) that is never join()ed, stored, or "
+                f"returned — the process cannot exit while it runs",
+            ))
+        else:
+            self.findings.append(Finding(
+                "lifecycle-fd-leak", self.rel_path, res.line,
+                f"{self.qual} acquires {res.var!r} ({res.kind}) here "
+                f"but {why} without closing it — under the event-loop "
+                f"serving core this is fd exhaustion, not a leak",
+            ))
+
+    def _exit_point(self, line: int, why: str) -> None:
+        for var, res in list(self.open.items()):
+            if var in self.protected:
+                continue
+            if res.kind == "thread" and not res.started:
+                continue  # constructed-never-started: inert object
+            self._leak(res, line, why)
+            self.open.pop(var, None)
+
+    # -- call classification -------------------------------------------
+    def _handle_call(self, call: ast.Call) -> None:
+        dotted = _dotted(call.func)
+        tail = dotted.rsplit(".", 1)[-1]
+        head = dotted.split(".", 1)[0]
+        # x.close() / t.join() / x.start()
+        if isinstance(call.func, ast.Attribute) and isinstance(
+            call.func.value, ast.Name
+        ):
+            var = call.func.value.id
+            if var in self.open:
+                if tail in _CLOSE_TAILS:
+                    self._close(var)
+                    return
+                if tail == "start" and self.open[var].kind == "thread":
+                    self.open[var].started = True
+                    return
+                if tail == "setDaemon" and self.open[var].kind == "thread":
+                    self.open.pop(var, None)
+                    return
+                # any other method on the resource is a borrow
+                for a in list(call.args) + [k.value for k in call.keywords]:
+                    self._escapes_in(a)
+                return
+        # os.close(fd) — positional release
+        if tail in ("close",) and head == "os" and call.args:
+            a = call.args[0]
+            if isinstance(a, ast.Name) and a.id in self.open:
+                self._close(a.id)
+                return
+        ref = self.resolve(call.func)
+        summary = self.summaries.get(ref) if ref else None
+        callee_rec = self.funcs.get(ref) if ref else None
+
+        def classify(arg: ast.expr, pname: str | None) -> None:
+            names = [
+                s.id for s in ast.walk(arg)
+                if isinstance(s, ast.Name) and s.id in self.open
+            ]
+            if not names:
+                return
+            if tail in _BORROW_TAILS:
+                return  # obligation stays here
+            if (
+                summary is not None
+                and pname is not None
+                and pname in summary.borrows_params
+                and pname not in summary.owns_params
+            ):
+                return  # proven borrow: caller still owns the close
+            for n in names:
+                self._escape(n)  # transfer (or unknown callee: lenient)
+
+        for i, a in enumerate(call.args):
+            pname = (
+                callee_rec.params[i]
+                if callee_rec is not None and i < len(callee_rec.params)
+                else None
+            )
+            classify(a, pname)
+        for kw in call.keywords:
+            classify(kw.value, kw.arg)
+
+    # -- statement walk ------------------------------------------------
+    def walk(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.With):
+            # resources entered via with are closed by the protocol
+            for item in stmt.items:
+                ctx = item.context_expr
+                inner = ctx
+                if (
+                    isinstance(ctx, ast.Call)
+                    and _dotted(ctx.func).rsplit(".", 1)[-1] == "closing"
+                    and ctx.args
+                ):
+                    inner = ctx.args[0]
+                if isinstance(inner, ast.Name) and inner.id in self.open:
+                    self._close(inner.id)
+                else:
+                    self._expr_calls(ctx)
+            self.walk(stmt.body)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # a closure that captures a tracked resource adopts it (the
+            # lsm iter_range idiom: the generator's `with f:` owns the
+            # close) — ownership leaves this frame
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Name) and sub.id in self.open:
+                    self._escape(sub.id)
+            return
+        if isinstance(stmt, ast.Try):
+            # close()s in the finally protect the try body's exits; a
+            # raise under an except handler may be caught locally, so
+            # raise-exits inside the body go lenient
+            finally_closed = self._closed_vars(stmt.finalbody)
+            added = finally_closed - self.protected
+            self.protected |= added
+            base = dict(self.open)  # pre-try state: what handlers see
+            if stmt.handlers:
+                self.handler_depth += 1
+            self.walk(stmt.body)
+            if stmt.handlers:
+                self.handler_depth -= 1
+            after_body = dict(self.open)
+            for handler in stmt.handlers:
+                # a handler runs when the try body failed PART WAY —
+                # resources the body acquired may not exist, so the
+                # handler is judged against the pre-try state only
+                self.open = dict(base)
+                self.walk(handler.body)
+            self.open = after_body
+            self.walk(stmt.orelse)
+            self.protected -= added
+            self.walk(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._expr_calls(stmt.value)
+                self._escapes_in(stmt.value)
+            self._exit_point(stmt.lineno,
+                             f"returns at line {stmt.lineno}")
+            return
+        if isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self._expr_calls(stmt.exc)
+                self._escapes_in(stmt.exc)
+            if self.handler_depth == 0:
+                self._exit_point(
+                    stmt.lineno, f"raises at line {stmt.lineno}"
+                )
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._assignment(stmt)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._expr_calls(stmt.value)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._expr_calls(stmt.test)
+            base = dict(self.open)
+            self.walk(stmt.body)
+            after_body = dict(self.open)
+            self.open = dict(base)
+            self.walk(stmt.orelse)
+            # closed-in-any-arm leniency: keep only resources still
+            # open after BOTH arms
+            self.open = {
+                k: v for k, v in after_body.items() if k in self.open
+            }
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr_calls(stmt.iter)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.Assert, ast.Delete)):
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    self._handle_call(sub)
+            return
+        # Pass/Break/Continue/Global/Import: nothing tracked
+
+    def _assignment(self, stmt: ast.stmt) -> None:
+        value = getattr(stmt, "value", None)
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        if value is None:
+            return
+        # `t.daemon = True` after construction lifts the join obligation
+        if len(targets) == 1 and isinstance(targets[0], ast.Attribute):
+            tgt = targets[0]
+            if (
+                tgt.attr == "daemon"
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id in self.open
+                and self.open[tgt.value.id].kind == "thread"
+                and not (
+                    isinstance(value, ast.Constant)
+                    and value.value is False
+                )
+            ):
+                self.open.pop(tgt.value.id, None)
+                return
+        kind = _acquisition_kind(value, self.allocators, self.resolve)
+        if kind is not None and len(targets) == 1:
+            tgt = targets[0]
+            if isinstance(tgt, ast.Name):
+                # re-assigning over a still-open resource loses it
+                prev = self.open.get(tgt.id)
+                if prev is not None and tgt.id not in self.protected:
+                    if not (prev.kind == "thread" and not prev.started):
+                        self._leak(
+                            prev, stmt.lineno,
+                            f"is overwritten at line {stmt.lineno}",
+                        )
+                    self.open.pop(tgt.id, None)
+                res = _Resource(tgt.id, kind, stmt.lineno)
+                # classify the acquisition call's own arguments FIRST:
+                # a tracked resource fed INTO the new one transfers
+                # ownership (`f = os.fdopen(fd)` — f.close() closes fd;
+                # `Thread(args=(sock,))` — the worker owns the socket,
+                # daemon or not)
+                if isinstance(value, ast.Call):
+                    self._handle_call(value)
+                if kind == "thread" and isinstance(value, ast.Call):
+                    res.daemon_thread = _thread_is_daemon(value)
+                    if res.daemon_thread:
+                        return  # daemon threads carry no join obligation
+                self.open[tgt.id] = res
+                return
+            if isinstance(tgt, ast.Tuple) and tgt.elts:
+                # conn, addr = sock.accept()
+                first = tgt.elts[0]
+                if isinstance(first, ast.Name):
+                    self.open[first.id] = _Resource(
+                        first.id, kind, stmt.lineno
+                    )
+                return
+            # acquired straight into self.attr / a container: escaped
+            # at birth — the owner is the object, not this frame
+            self._expr_calls(value)
+            return
+        # plain assignment: tracked vars on the RHS escape (alias,
+        # store, arithmetic into a struct...) — unless it is a pure
+        # self-alias we keep tracking under the new name? No: lenient.
+        self._expr_calls(value)
+        self._escapes_in(value)
+
+    def _expr_calls(self, node: ast.expr) -> None:
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._handle_call(sub)
+
+    def _closed_vars(self, body: list[ast.stmt]) -> set[str]:
+        out: set[str] = set()
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted = _dotted(sub.func)
+                tail = dotted.rsplit(".", 1)[-1]
+                if tail not in _CLOSE_TAILS:
+                    continue
+                if (
+                    dotted.startswith("os.")
+                    and sub.args
+                    and isinstance(sub.args[0], ast.Name)
+                ):
+                    out.add(sub.args[0].id)  # os.close(fd) closes FD
+                elif isinstance(sub.func, ast.Attribute) and isinstance(
+                    sub.func.value, ast.Name
+                ):
+                    out.add(sub.func.value.id)
+                elif sub.args and isinstance(sub.args[0], ast.Name):
+                    out.add(sub.args[0].id)
+        return out
+
+    def finish(self, fn: ast.FunctionDef) -> None:
+        end = getattr(fn, "end_lineno", fn.lineno)
+        self._exit_point(end, "falls off the end of the function")
+
+
+# ---------------------------------------------------------------------------
+# interprocedural summaries
+
+
+def _owns_annotations(source: str) -> dict[int, set[str]]:
+    """line -> param names force-marked as ownership-transfer. The
+    annotation sits on the `def` line or the line above it; a missing
+    reason is reported through the standard bare-ignore channel by
+    scan_suppressions-alike strictness here (no reason → ignored
+    annotation, which then surfaces as the finding it would have
+    silenced)."""
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _OWNS_RE.search(text)
+        if m is None or not (m.group(2) or "").strip():
+            continue
+        params = {p.strip() for p in m.group(1).split(",") if p.strip()}
+        target = i + 1 if text.lstrip().startswith("#") else i
+        out.setdefault(target, set()).update(params)
+    return out
+
+
+def _make_resolver(index: PackageIndex, rec):
+    """Callee-reference resolver mirroring lockorder's strategy:
+    self-methods, module functions, package-unique method names."""
+    from seaweedfs_tpu.analysis.lockorder import _BUILTIN_METHODS
+
+    cls = index.func_cls.get(rec.qualname)
+
+    def resolve(fn_expr: ast.expr) -> str | None:
+        if isinstance(fn_expr, ast.Name):
+            return index.module_funcs.get((rec.module, fn_expr.id))
+        if isinstance(fn_expr, ast.Attribute):
+            if (
+                isinstance(fn_expr.value, ast.Name)
+                and fn_expr.value.id == "self"
+                and cls is not None
+            ):
+                return cls.methods.get(fn_expr.attr)
+            cands = index.methods_by_name.get(fn_expr.attr, [])
+            if len(cands) == 1 and fn_expr.attr not in _BUILTIN_METHODS:
+                return cands[0]
+        return None
+
+    return resolve
+
+
+def _build_summaries(index: PackageIndex) -> tuple[
+    dict[str, FuncSummary], dict[str, str]
+]:
+    """(summaries by qualname, allocators qual->kind) to fixpoint."""
+    summaries: dict[str, FuncSummary] = {}
+    allocators: dict[str, str] = {}
+    owns_by_path: dict[str, dict[int, set[str]]] = {}
+    for rel, src in index.sources.items():
+        ann = _owns_annotations(src)
+        if ann:
+            owns_by_path[rel] = ann
+
+    for qual, fn in index.fn_nodes.items():
+        rec = index.funcs.get(qual)
+        if rec is None:
+            continue
+        s = FuncSummary(qual)
+        ann = owns_by_path.get(rec.path, {}).get(fn.lineno, set())
+        s.owns_params |= ann & set(rec.params)
+        summaries[qual] = s
+
+    # fixpoint: allocators (returns a fresh resource) and param
+    # ownership (closes/stores/forwards its param)
+    for _ in range(10):
+        changed = False
+        for qual, fn in index.fn_nodes.items():
+            rec = index.funcs.get(qual)
+            if rec is None:
+                continue
+            s = summaries[qual]
+            resolve = _make_resolver(index, rec)
+            params = set(rec.params)
+            # vars assigned from an acquisition call anywhere in the
+            # body: `fd = os.open(...)` ... `return fd` is an allocator
+            acquired_vars: dict[str, str] = {}
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                ):
+                    kind = _acquisition_kind(
+                        node.value, allocators, resolve
+                    )
+                    if kind:
+                        acquired_vars[node.targets[0].id] = kind
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    kind = _acquisition_kind(
+                        node.value, allocators, resolve
+                    )
+                    if kind is None and isinstance(
+                        node.value, ast.Name
+                    ):
+                        kind = acquired_vars.get(node.value.id)
+                    if kind and s.allocates is None:
+                        s.allocates = kind
+                        allocators[qual] = kind
+                        changed = True
+                    # `return fd` where fd is a param: caller keeps it
+                    # (builder idiom) — treat as borrow, not own
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = _dotted(node.func).rsplit(".", 1)[-1]
+                # param.close()/param.join() → owns
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in params
+                    and tail in _CLOSE_TAILS
+                    and node.func.value.id not in s.owns_params
+                ):
+                    s.owns_params.add(node.func.value.id)
+                    changed = True
+                # os.close(param) → owns
+                if (
+                    tail == "close"
+                    and _dotted(node.func).startswith("os.")
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in params
+                    and node.args[0].id not in s.owns_params
+                ):
+                    s.owns_params.add(node.args[0].id)
+                    changed = True
+                # param forwarded to a callee that owns it → owns
+                ref = resolve(node.func)
+                callee = summaries.get(ref) if ref else None
+                if callee is not None:
+                    callee_rec = index.funcs.get(ref)
+                    for i, a in enumerate(node.args):
+                        if (
+                            isinstance(a, ast.Name)
+                            and a.id in params
+                            and callee_rec is not None
+                            and i < len(callee_rec.params)
+                            and callee_rec.params[i] in callee.owns_params
+                            and a.id not in s.owns_params
+                        ):
+                            s.owns_params.add(a.id)
+                            changed = True
+            # param stored on self / into a container → owns
+            for node in ast.walk(fn):
+                tgt = None
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, (ast.Attribute, ast.Subscript)):
+                            tgt = node.value
+                elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ) and node.func.attr in ("append", "add", "put"):
+                    for a in node.args:
+                        if isinstance(a, ast.Name) and a.id in params:
+                            tgt = a
+                if isinstance(tgt, ast.Name) and tgt.id in params:
+                    if tgt.id not in s.owns_params:
+                        s.owns_params.add(tgt.id)
+                        changed = True
+        if not changed:
+            break
+
+    # borrows = params that are USED but never owned (used at all so a
+    # never-touched param doesn't read as a safe sink)
+    for qual, fn in index.fn_nodes.items():
+        rec = index.funcs.get(qual)
+        if rec is None:
+            continue
+        s = summaries[qual]
+        used = {
+            n.id
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Name) and n.id in set(rec.params)
+        }
+        s.borrows_params = used - s.owns_params
+    return summaries, allocators
+
+
+# ---------------------------------------------------------------------------
+# entry point
+
+
+def check(root: str | None = None, index: PackageIndex | None = None
+          ) -> tuple[list[Finding], PackageIndex]:
+    index = index or build_index(root)
+    summaries, allocators = _build_summaries(index)
+    findings: list[Finding] = []
+    for qual, fn in sorted(index.fn_nodes.items()):
+        rec = index.funcs.get(qual)
+        if rec is None:
+            continue
+        resolve = _make_resolver(index, rec)
+        walker = _LeakWalker(
+            qual, rec.path, summaries, allocators, resolve,
+            funcs=index.funcs,
+        )
+        walker.walk(fn.body)
+        walker.finish(fn)
+        findings.extend(walker.findings)
+    # dedupe (same resource can be reported from several exits)
+    seen: set[tuple[str, int, str]] = set()
+    out: list[Finding] = []
+    for f in findings:
+        key = (f.path, f.line, f.rule)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out, index

@@ -109,12 +109,27 @@ class BenchmarkCommand(Command):
     help = "load-test the cluster: concurrent writes then random reads"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-master", default="127.0.0.1:9333")
-        p.add_argument("-c", dest="concurrency", type=int, default=16)
-        p.add_argument("-n", dest="num", type=int, default=1024 * 1024)
-        p.add_argument("-size", type=int, default=1024)
-        p.add_argument("-collection", default="benchmark")
-        p.add_argument("-replication", default="000")
+        p.add_argument(
+            "-master", default="127.0.0.1:9333",
+            help="master address host:port",
+        )
+        p.add_argument(
+            "-c", dest="concurrency", type=int, default=16,
+            help="concurrent worker threads",
+        )
+        p.add_argument(
+            "-n", dest="num", type=int, default=1024 * 1024,
+            help="total files to write/read",
+        )
+        p.add_argument("-size", type=int, default=1024, help="payload bytes per file")
+        p.add_argument(
+            "-collection", default="benchmark",
+            help="collection to write into",
+        )
+        p.add_argument(
+            "-replication", default="000",
+            help="replication policy like 001",
+        )
         # the reference's -write=true/-read=false spelling: single-dash
         # flags get no --no- negative form from BooleanOptionalAction,
         # so write-only / read-only runs need the =bool style
@@ -122,12 +137,17 @@ class BenchmarkCommand(Command):
             return v.lower() not in ("false", "0", "no")
 
         p.add_argument(
-            "-write", type=_bool, nargs="?", const=True, default=True
+            "-write", type=_bool, nargs="?", const=True, default=True,
+            help="=false skips the write phase (read-only run)",
         )
         p.add_argument(
-            "-read", type=_bool, nargs="?", const=True, default=True
+            "-read", type=_bool, nargs="?", const=True, default=True,
+            help="=false skips the read phase (write-only run)",
         )
-        p.add_argument("-deletePercent", type=int, default=0)
+        p.add_argument(
+            "-deletePercent", type=int, default=0,
+            help="percentage of written files to delete during reads",
+        )
         p.add_argument(
             "-cpuprofile", default="", help="dump pstats profile here on exit"
         )

@@ -18,9 +18,9 @@ class FilerCopyCommand(Command):
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
         p.add_argument("sources", nargs="+", help="local files or directories")
         p.add_argument("dest", help="filer destination like http://filer:8888/path/")
-        p.add_argument("-collection", default="")
-        p.add_argument("-replication", default="")
-        p.add_argument("-ttl", default="")
+        p.add_argument("-collection", default="", help="collection for uploaded chunks")
+        p.add_argument("-replication", default="", help="replication policy like 001")
+        p.add_argument("-ttl", default="", help="time-to-live like 3m/4h/5d")
 
     def run(self, args) -> int:
         dest = args.dest

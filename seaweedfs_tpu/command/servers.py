@@ -115,11 +115,20 @@ class MasterCommand(Command):
     help = "start the cluster master (volume assignment, topology, lookup)"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-ip", default="127.0.0.1")
-        p.add_argument("-port", type=int, default=9333)
-        p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
-        p.add_argument("-defaultReplication", default="000")
-        p.add_argument("-garbageThreshold", type=float, default=0.3)
+        p.add_argument("-ip", default="127.0.0.1", help="bind address")
+        p.add_argument("-port", type=int, default=9333, help="listen port")
+        p.add_argument(
+            "-volumeSizeLimitMB", type=int, default=30 * 1024,
+            help="roll to a fresh volume past this size",
+        )
+        p.add_argument(
+            "-defaultReplication", default="000",
+            help="replication policy for new volumes like 001",
+        )
+        p.add_argument(
+            "-garbageThreshold", type=float, default=0.3,
+            help="deleted-bytes fraction that triggers vacuum",
+        )
         p.add_argument(
             "-peers",
             default="",
@@ -228,15 +237,24 @@ class VolumeCommand(Command):
     help = "start a volume server (blob data plane)"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-ip", default="127.0.0.1")
-        p.add_argument("-port", type=int, default=8080)
+        p.add_argument("-ip", default="127.0.0.1", help="bind address")
+        p.add_argument("-port", type=int, default=8080, help="listen port")
         p.add_argument("-dir", default=".", help="comma-separated data directories")
         p.add_argument("-max", default="7", help="comma-separated max volume counts")
-        p.add_argument("-mserver", default="127.0.0.1:9333")
-        p.add_argument("-dataCenter", default="")
-        p.add_argument("-rack", default="")
-        p.add_argument("-publicUrl", default="")
-        p.add_argument("-readRedirect", action="store_true")
+        p.add_argument(
+            "-mserver", default="127.0.0.1:9333",
+            help="comma-separated master address(es)",
+        )
+        p.add_argument("-dataCenter", default="", help="topology data center name")
+        p.add_argument("-rack", default="", help="topology rack name")
+        p.add_argument(
+            "-publicUrl", default="",
+            help="address advertised to clients (default ip:port)",
+        )
+        p.add_argument(
+            "-readRedirect", action="store_true",
+            help="302-redirect reads for volumes this server lacks",
+        )
         p.add_argument("-cpuprofile", default="", help="dump pstats profile here on exit")
         p.add_argument(
             "-index",
@@ -284,7 +302,10 @@ class VolumeCommand(Command):
             "foreground read p99; <=0 = unlimited)",
         )
         _add_trace_flags(p)
-        p.add_argument("-v", type=int, default=0)
+        p.add_argument(
+            "-v", type=int, default=0,
+            help="log verbosity (0=warning .. 3=trace)",
+        )
 
     def run(self, args) -> int:
         from seaweedfs_tpu.server.volume_server import VolumeServer
@@ -369,18 +390,42 @@ class VolumeWorkerCommand(Command):
     help = "internal: one SO_REUSEPORT read worker (spawned by volume -workers N)"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-ip", default="127.0.0.1")
-        p.add_argument("-port", type=int, required=True)
-        p.add_argument("-dir", required=True)
+        p.add_argument("-ip", default="127.0.0.1", help="bind address")
+        p.add_argument("-port", type=int, required=True, help="listen port")
+        p.add_argument(
+            "-dir", required=True,
+            help="data directory (shared with the lead)",
+        )
         p.add_argument("-lead", required=True, help="lead's internal host:port")
-        p.add_argument("-workerPort", type=int, default=0)
-        p.add_argument("-shardWrites", action="store_true")
-        p.add_argument("-writerIndex", type=int, default=0)
-        p.add_argument("-writers", type=int, default=1)
-        p.add_argument("-mserver", default="")
-        p.add_argument("-internalPort", type=int, default=0)
+        p.add_argument(
+            "-workerPort", type=int, default=0,
+            help="internal lead port for worker coordination",
+        )
+        p.add_argument(
+            "-shardWrites", action="store_true",
+            help="enable per-volume write sharding across workers",
+        )
+        p.add_argument(
+            "-writerIndex", type=int, default=0,
+            help="this worker's writer slot (0..writers-1)",
+        )
+        p.add_argument(
+            "-writers", type=int, default=1,
+            help="total writer slots in the shard-write group",
+        )
+        p.add_argument(
+            "-mserver", default="",
+            help="comma-separated master address(es)",
+        )
+        p.add_argument(
+            "-internalPort", type=int, default=0,
+            help="loopback listener port for trusted worker hops",
+        )
         _add_trace_flags(p)
-        p.add_argument("-v", type=int, default=0)
+        p.add_argument(
+            "-v", type=int, default=0,
+            help="log verbosity (0=warning .. 3=trace)",
+        )
 
     def run(self, args) -> int:
         from seaweedfs_tpu.server.volume_workers import VolumeReadWorker
@@ -415,18 +460,36 @@ class FilerCommand(Command):
     help = "start a filer (directory/file namespace over the blob store)"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-ip", default="127.0.0.1")
-        p.add_argument("-port", type=int, default=8888)
-        p.add_argument("-master", default="127.0.0.1:9333")
+        p.add_argument("-ip", default="127.0.0.1", help="bind address")
+        p.add_argument("-port", type=int, default=8888, help="listen port")
+        p.add_argument(
+            "-master", default="127.0.0.1:9333",
+            help="master address host:port",
+        )
         p.add_argument(
             "-store", default="memory", help="memory | sqlite | sql | sortedlog | lsm | redis | cassandra | etcd | tikv | mysql | postgres"
         )
-        p.add_argument("-storePath", default="")
-        p.add_argument("-collection", default="")
-        p.add_argument("-replication", default="")
-        p.add_argument("-maxMB", type=int, default=32)
+        p.add_argument(
+            "-storePath", default="",
+            help="store path/DSN (sqlite file, redis host, ...)",
+        )
+        p.add_argument(
+            "-collection", default="",
+            help="collection for filer-written chunks",
+        )
+        p.add_argument(
+            "-replication", default="",
+            help="replication policy for filer-written chunks",
+        )
+        p.add_argument(
+            "-maxMB", type=int, default=32,
+            help="split uploads into chunks of this many MB",
+        )
         _add_trace_flags(p)
-        p.add_argument("-v", type=int, default=0)
+        p.add_argument(
+            "-v", type=int, default=0,
+            help="log verbosity (0=warning .. 3=trace)",
+        )
 
     def run(self, args) -> int:
         from seaweedfs_tpu import notification
@@ -461,10 +524,16 @@ class S3Command(Command):
     help = "start the S3-compatible gateway over a filer"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-ip", default="127.0.0.1")
-        p.add_argument("-port", type=int, default=8333)
-        p.add_argument("-filer", default="127.0.0.1:8888")
-        p.add_argument("-bucketsPath", default="/buckets")
+        p.add_argument("-ip", default="127.0.0.1", help="bind address")
+        p.add_argument("-port", type=int, default=8333, help="listen port")
+        p.add_argument(
+            "-filer", default="127.0.0.1:8888",
+            help="filer address host:port backing the gateway",
+        )
+        p.add_argument(
+            "-bucketsPath", default="/buckets",
+            help="filer directory that holds the buckets",
+        )
         p.add_argument("-config", default="", help="identities toml with access/secret keys")
         p.add_argument(
             "-master",
@@ -473,7 +542,10 @@ class S3Command(Command):
             "(telemetry plane; empty = not scraped by the collector)",
         )
         _add_trace_flags(p)
-        p.add_argument("-v", type=int, default=0)
+        p.add_argument(
+            "-v", type=int, default=0,
+            help="log verbosity (0=warning .. 3=trace)",
+        )
 
     def run(self, args) -> int:
         _configure_tls("client")
@@ -520,9 +592,12 @@ class WebDavCommand(Command):
     help = "start the WebDAV gateway over a filer"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-ip", default="127.0.0.1")
-        p.add_argument("-port", type=int, default=7333)
-        p.add_argument("-filer", default="127.0.0.1:8888")
+        p.add_argument("-ip", default="127.0.0.1", help="bind address")
+        p.add_argument("-port", type=int, default=7333, help="listen port")
+        p.add_argument(
+            "-filer", default="127.0.0.1:8888",
+            help="filer address host:port backing the gateway",
+        )
         p.add_argument(
             "-master",
             default="",
@@ -530,7 +605,10 @@ class WebDavCommand(Command):
             "(telemetry plane; empty = not scraped by the collector)",
         )
         _add_trace_flags(p)
-        p.add_argument("-v", type=int, default=0)
+        p.add_argument(
+            "-v", type=int, default=0,
+            help="log verbosity (0=warning .. 3=trace)",
+        )
 
     def run(self, args) -> int:
         _configure_tls("client")
@@ -558,22 +636,58 @@ class ServerCommand(Command):
     help = "start master + volume server(s) [+ filer + s3] in one process"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-ip", default="127.0.0.1")
-        p.add_argument("-master.port", dest="master_port", type=int, default=9333)
-        p.add_argument("-volume.port", dest="volume_port", type=int, default=8080)
-        p.add_argument("-dir", default=".")
-        p.add_argument("-master.volumeSizeLimitMB", dest="vsl", type=int, default=30 * 1024)
-        p.add_argument("-master.defaultReplication", dest="repl", default="000")
-        p.add_argument("-volume.max", dest="vmax", default="7")
-        p.add_argument("-dataCenter", default="")
-        p.add_argument("-rack", default="")
-        p.add_argument("-filer", action="store_true")
-        p.add_argument("-filer.port", dest="filer_port", type=int, default=8888)
-        p.add_argument("-filer.store", dest="filer_store", default="memory")
-        p.add_argument("-s3", action="store_true")
-        p.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
-        p.add_argument("-webdav", action="store_true")
-        p.add_argument("-webdav.port", dest="webdav_port", type=int, default=7333)
+        p.add_argument(
+            "-ip", default="127.0.0.1",
+            help="bind address for every embedded daemon",
+        )
+        p.add_argument(
+            "-master.port", dest="master_port", type=int, default=9333,
+            help="master listen port",
+        )
+        p.add_argument(
+            "-volume.port", dest="volume_port", type=int, default=8080,
+            help="volume-server listen port",
+        )
+        p.add_argument(
+            "-dir", default=".",
+            help="data directory for volume + master meta",
+        )
+        p.add_argument(
+            "-master.volumeSizeLimitMB", dest="vsl", type=int, default=30 * 1024,
+            help="roll to a fresh volume past this size",
+        )
+        p.add_argument(
+            "-master.defaultReplication", dest="repl", default="000",
+            help="replication policy for new volumes like 001",
+        )
+        p.add_argument(
+            "-volume.max", dest="vmax", default="7",
+            help="comma-separated max volume counts",
+        )
+        p.add_argument("-dataCenter", default="", help="topology data center name")
+        p.add_argument("-rack", default="", help="topology rack name")
+        p.add_argument("-filer", action="store_true", help="also start a filer")
+        p.add_argument(
+            "-filer.port", dest="filer_port", type=int, default=8888,
+            help="filer listen port",
+        )
+        p.add_argument(
+            "-filer.store", dest="filer_store", default="memory",
+            help="filer metadata store kind",
+        )
+        p.add_argument("-s3", action="store_true", help="also start an S3 gateway")
+        p.add_argument(
+            "-s3.port", dest="s3_port", type=int, default=8333,
+            help="S3 gateway listen port",
+        )
+        p.add_argument(
+            "-webdav", action="store_true",
+            help="also start a WebDAV gateway",
+        )
+        p.add_argument(
+            "-webdav.port", dest="webdav_port", type=int, default=7333,
+            help="WebDAV gateway listen port",
+        )
         p.add_argument(
             "-ec.codec",
             dest="ec_codec",
@@ -583,14 +697,35 @@ class ServerCommand(Command):
         )
         # scrub/self-healing knobs, same semantics as the standalone
         # master/volume commands (0 disables either plane)
-        p.add_argument("-repairInterval", type=float, default=30.0)
-        p.add_argument("-repairConcurrency", type=int, default=2)
-        p.add_argument("-repairGrace", type=float, default=30.0)
-        p.add_argument("-scrubInterval", type=float, default=600.0)
-        p.add_argument("-scrubRate", type=float, default=64.0)
-        p.add_argument("-telemetryInterval", type=float, default=10.0)
+        p.add_argument(
+            "-repairInterval", type=float, default=30.0,
+            help="seconds between repair-scheduler scans (0 disables)",
+        )
+        p.add_argument(
+            "-repairConcurrency", type=int, default=2,
+            help="max repairs in flight",
+        )
+        p.add_argument(
+            "-repairGrace", type=float, default=30.0,
+            help="seconds of damage persistence before repairing",
+        )
+        p.add_argument(
+            "-scrubInterval", type=float, default=600.0,
+            help="seconds between scrub sweeps (0 disables)",
+        )
+        p.add_argument(
+            "-scrubRate", type=float, default=64.0,
+            help="scrub bandwidth cap in MB/s",
+        )
+        p.add_argument(
+            "-telemetryInterval", type=float, default=10.0,
+            help="seconds between collector scrape cycles (0 disables)",
+        )
         _add_trace_flags(p)
-        p.add_argument("-v", type=int, default=0)
+        p.add_argument(
+            "-v", type=int, default=0,
+            help="log verbosity (0=warning .. 3=trace)",
+        )
 
     def run(self, args) -> int:
         _configure_tls("master")
@@ -687,7 +822,10 @@ class ShellCommand(Command):
     help = "interactive admin shell (ec.*, volume.*, fs.* commands)"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-master", default="127.0.0.1:9333")
+        p.add_argument(
+            "-master", default="127.0.0.1:9333",
+            help="comma-separated master address(es)",
+        )
         p.add_argument("-c", dest="script", default="", help="run semicolon-separated commands and exit")
 
     def run(self, args) -> int:
@@ -714,9 +852,18 @@ class MountCommand(Command):
     help = "mount the filer as a FUSE filesystem (command/mount_std.go)"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-filer", default="127.0.0.1:8888")
-        p.add_argument("-dir", required=False, default="")
-        p.add_argument("-filer.path", dest="filer_path", default="/")
+        p.add_argument(
+            "-filer", default="127.0.0.1:8888",
+            help="filer address host:port to mount",
+        )
+        p.add_argument(
+            "-dir", required=False, default="",
+            help="local mountpoint directory",
+        )
+        p.add_argument(
+            "-filer.path", dest="filer_path", default="/",
+            help="filer subtree to mount as the root",
+        )
 
     def run(self, args) -> int:
         from seaweedfs_tpu.filesys.fuse_kernel import (

@@ -62,11 +62,17 @@ class UploadCommand(Command):
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
         p.add_argument("files", nargs="*")
-        p.add_argument("-master", default="127.0.0.1:9333")
-        p.add_argument("-collection", default="")
-        p.add_argument("-replication", default="")
-        p.add_argument("-ttl", default="")
-        p.add_argument("-maxMB", type=int, default=32)
+        p.add_argument(
+            "-master", default="127.0.0.1:9333",
+            help="master address host:port",
+        )
+        p.add_argument("-collection", default="", help="collection to upload into")
+        p.add_argument("-replication", default="", help="replication policy like 001")
+        p.add_argument("-ttl", default="", help="time-to-live like 3m/4h/5d")
+        p.add_argument(
+            "-maxMB", type=int, default=32,
+            help="split uploads into chunks of this many MB",
+        )
         p.add_argument(
             "-dir",
             default="",
@@ -129,7 +135,7 @@ class DownloadCommand(Command):
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
         p.add_argument("fids", nargs="+")
         p.add_argument("-server", default="127.0.0.1:9333", help="master")
-        p.add_argument("-dir", default=".")
+        p.add_argument("-dir", default=".", help="output directory for downloads")
 
     def run(self, args) -> int:
         from seaweedfs_tpu.client import operation as op
@@ -151,10 +157,16 @@ class BackupCommand(Command):
     help = "incrementally back up one volume from the cluster to local files"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-master", default="127.0.0.1:9333")
-        p.add_argument("-volumeId", type=int, required=True)
-        p.add_argument("-dir", default=".")
-        p.add_argument("-collection", default="")
+        p.add_argument(
+            "-master", default="127.0.0.1:9333",
+            help="master address host:port",
+        )
+        p.add_argument("-volumeId", type=int, required=True, help="volume to back up")
+        p.add_argument("-dir", default=".", help="local directory for the backup copy")
+        p.add_argument(
+            "-collection", default="",
+            help="collection the volume belongs to",
+        )
         p.add_argument(
             "-ttl",
             default="",
@@ -222,9 +234,12 @@ class CompactCommand(Command):
     help = "offline-compact a local volume (drop deleted needles)"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-dir", default=".")
-        p.add_argument("-volumeId", type=int, required=True)
-        p.add_argument("-collection", default="")
+        p.add_argument("-dir", default=".", help="directory holding the volume files")
+        p.add_argument("-volumeId", type=int, required=True, help="volume to compact")
+        p.add_argument(
+            "-collection", default="",
+            help="collection the volume belongs to",
+        )
 
     def run(self, args) -> int:
         from seaweedfs_tpu.storage.volume import Volume
@@ -245,9 +260,12 @@ class FixCommand(Command):
     help = "rebuild a volume's .idx by scanning its .dat"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-dir", default=".")
-        p.add_argument("-volumeId", type=int, required=True)
-        p.add_argument("-collection", default="")
+        p.add_argument("-dir", default=".", help="directory holding the volume files")
+        p.add_argument("-volumeId", type=int, required=True, help="volume to fix")
+        p.add_argument(
+            "-collection", default="",
+            help="collection the volume belongs to",
+        )
 
     def run(self, args) -> int:
         from seaweedfs_tpu.storage.volume import volume_base_name
@@ -293,9 +311,12 @@ class ExportCommand(Command):
     DEFAULT_NAME_FORMAT = "{{.Mime}}/{{.Id}}:{{.Name}}"
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-dir", default=".")
-        p.add_argument("-volumeId", type=int, required=True)
-        p.add_argument("-collection", default="")
+        p.add_argument("-dir", default=".", help="directory holding the volume files")
+        p.add_argument("-volumeId", type=int, required=True, help="volume to export")
+        p.add_argument(
+            "-collection", default="",
+            help="collection the volume belongs to",
+        )
         p.add_argument(
             "-o",
             dest="output",
@@ -425,7 +446,10 @@ class WeedloadCommand(Command):
     )
 
     def add_arguments(self, p: argparse.ArgumentParser) -> None:
-        p.add_argument("-master", default="127.0.0.1:9333")
+        p.add_argument(
+            "-master", default="127.0.0.1:9333",
+            help="master address host:port",
+        )
         p.add_argument("-duration", type=float, default=10.0, help="seconds")
         p.add_argument("-writers", type=int, default=2, help="PUT worker processes")
         p.add_argument("-readers", type=int, default=2, help="GET worker processes")

@@ -225,22 +225,14 @@ class Registry:
 
 DEFAULT_REGISTRY = Registry()
 
-# The metric families the reference registers (stats/metrics.go:20-60):
-REQUEST_COUNTER = DEFAULT_REGISTRY.counter(
-    "weed_request_total", "number of requests", ("server", "type")
-)
-REQUEST_HISTOGRAM = DEFAULT_REGISTRY.histogram(
-    "weed_request_seconds", "request latency", ("server", "type")
-)
-VOLUME_GAUGE = DEFAULT_REGISTRY.gauge(
-    "weed_volumes", "number of volumes", ("server", "collection", "type")
-)
-STORE_COUNTER = DEFAULT_REGISTRY.counter(
-    "weed_filer_store_total", "filer store ops", ("store", "type")
-)
-STORE_HISTOGRAM = DEFAULT_REGISTRY.histogram(
-    "weed_filer_store_seconds", "filer store latency", ("store", "type")
-)
+# NOTE: the seed port registered the reference's weed_request_total/
+# weed_request_seconds/weed_volumes/weed_filer_store_* families here
+# verbatim — but nothing in this tree ever wrote OR read them, so every
+# /metrics exposition rendered constant-zero rows that looked like live
+# instrumentation (and weed_request_* shadowed the real
+# weed_http_request_* families below). weedlint's contract tier flags
+# exactly this class (contract-metric-orphan); the dead families are
+# gone, OPERATIONS.md round 11 has the story.
 
 # --- request tracing & gateway instrumentation (docs/TRACING.md) ------------
 # One family for EVERY FastHandler server (volume/master/filer/s3/webdav/

@@ -313,7 +313,6 @@ def _slow_insert(sp: Span) -> None:
     """Admit a root span into the slowest-N table. Reached only when
     its duration beats the cached floor, so the lock is rare."""
     global _slow_floor
-    # weedlint: ignore[hot-loop-lock] — floor-gated rare path; see hotloop._EXEMPT_QUALS
     with _lock:
         if len(_slowest) < _SLOWEST_N:
             _slowest.append(sp)

@@ -370,18 +370,438 @@ class TestHotLoop:
 
 
 # ---------------------------------------------------------------------------
+# contracts tier (weedlint v2)
+
+
+class TestContracts:
+    def test_unserved_route_flagged_and_served_not(self, tmp_path):
+        from seaweedfs_tpu.analysis import contracts
+
+        root = _write_pkg(tmp_path, {"srv.py": """
+            import urllib.request
+            from seaweedfs_tpu.util.httpd import FastHandler
+
+            class H(FastHandler):
+                def do_GET(self):
+                    if self.path == "/served":
+                        return
+
+            def dial_ok():
+                urllib.request.urlopen(
+                    "http://127.0.0.1:1/served", timeout=5
+                )
+
+            def dial_drifted():
+                urllib.request.urlopen(
+                    "http://127.0.0.1:1/renamed-away", timeout=5
+                )
+        """})
+        findings, _, reg = contracts.check(root=root)
+        routes = [f for f in findings if f.rule == "contract-route"]
+        assert len(routes) == 1 and "/renamed-away" in routes[0].message
+        assert "/served" in reg.served.get("other", {})
+
+    def test_relative_ui_link_checked_per_module(self, tmp_path):
+        """The PR-6 filer bug class: a UI href must be served by the
+        SAME module's dispatch — another daemon's route must not mask
+        the 404."""
+        from seaweedfs_tpu.analysis import contracts
+
+        root = _write_pkg(tmp_path, {"srv.py": """
+            from seaweedfs_tpu.util.httpd import FastHandler
+
+            class H(FastHandler):
+                def do_GET(self):
+                    if self.path == "/":
+                        self.fast_reply(
+                            200, b'<a href="/missing-page">x</a>'
+                        )
+        """})
+        findings, _, _reg = contracts.check(root=root)
+        assert any(
+            f.rule == "contract-route" and "/missing-page" in f.message
+            for f in findings
+        )
+
+    def test_orphan_metric_flagged(self, tmp_path):
+        from seaweedfs_tpu.analysis import contracts
+
+        root = _write_pkg(tmp_path, {"metrics.py": """
+            class Registry:
+                def counter(self, name, help_):
+                    return object()
+
+            R = Registry()
+            USED = R.counter("weed_used_total", "written elsewhere")
+            DEAD = R.counter("weed_dead_total", "never touched")
+        """, "writer.py": """
+            from . import metrics
+
+            def bump():
+                metrics.USED.inc()
+        """})
+        findings, _, _reg = contracts.check(root=root)
+        orphans = [
+            f for f in findings if f.rule == "contract-metric-orphan"
+        ]
+        assert len(orphans) == 1 and "weed_dead_total" in orphans[0].message
+
+    def test_queried_unregistered_metric_flagged(self, tmp_path):
+        """The alert-wiring drift class: a ring query against a family
+        no Registry registers returns empty forever."""
+        from seaweedfs_tpu.analysis import contracts
+
+        root = _write_pkg(tmp_path, {"alerts.py": """
+            def evaluate(ts):
+                return ts.rate_sum("weed_ghost_total", 120.0)
+        """})
+        findings, _, _reg = contracts.check(root=root)
+        assert any(
+            f.rule == "contract-metric" and "weed_ghost_total" in f.message
+            for f in findings
+        )
+
+    def test_header_stamped_never_parsed(self, tmp_path):
+        from seaweedfs_tpu.analysis import contracts
+
+        root = _write_pkg(tmp_path, {"hop.py": """
+            def stamp(headers):
+                headers["x-weed-ghost"] = "1"
+
+            def stamp_and_parse(headers):
+                headers["x-weed-pair"] = "1"
+                return headers.get("x-weed-pair")
+        """})
+        findings, _, _reg = contracts.check(root=root)
+        hdr = [f for f in findings if f.rule == "contract-header"]
+        assert len(hdr) == 1 and "x-weed-ghost" in hdr[0].message
+
+    def test_status_without_reason_entry(self, tmp_path):
+        from seaweedfs_tpu.analysis import contracts
+
+        root = _write_pkg(tmp_path, {"handler.py": """
+            class H:
+                def reply(self):
+                    self.fast_reply(418, b"teapot")
+                    self.fast_reply(200, b"ok")
+        """})
+        (tmp_path / "fakepkg" / "util").mkdir()
+        (tmp_path / "fakepkg" / "util" / "__init__.py").write_text("")
+        (tmp_path / "fakepkg" / "util" / "httpd.py").write_text(
+            '_REASON = {200: b"OK"}\n'
+        )
+        findings, _, _reg = contracts.check(root=str(tmp_path / "fakepkg"))
+        hits = [
+            f for f in findings if f.rule == "contract-status-reason"
+        ]
+        assert len(hits) == 1 and "418" in hits[0].message
+
+    def test_env_var_contract_both_directions(self, tmp_path):
+        from seaweedfs_tpu.analysis import contracts
+
+        root = _write_pkg(tmp_path, {"knobs.py": """
+            import os
+
+            DOCUMENTED = os.environ.get("WEED_FIXTURE_DOCUMENTED")
+            SECRET = os.environ.get("WEED_FIXTURE_SECRET")
+        """})
+        docs = {"OPS.md": "set `WEED_FIXTURE_DOCUMENTED` and also "
+                          "`WEED_FIXTURE_GONE` (removed in v2)\n"}
+        findings, _, _reg = contracts.check(root=root, docs=docs)
+        envs = {f.message.split()[2]: f for f in findings
+                if f.rule == "contract-env"}
+        assert "WEED_FIXTURE_SECRET" in envs  # read, undocumented
+        assert "WEED_FIXTURE_GONE" in envs  # documented, never read
+        assert "WEED_FIXTURE_DOCUMENTED" not in envs
+
+    def test_real_tree_registries_extracted(self):
+        """The real tree's contract registries must keep seeing the
+        load-bearing edges (a checker whose extraction silently decays
+        to empty would pass every cross-check forever)."""
+        from seaweedfs_tpu.analysis import contracts
+
+        _findings, _idx, reg = contracts.check()
+        assert "/dir/assign" in reg.served.get("master", {})
+        assert "/cluster/register" in reg.served.get("master", {})
+        assert "/metrics" in reg.served.get("_funnel", {})
+        client_paths = {p for _k, p, _h, _s in reg.client_routes}
+        assert "/dir/assign" in client_paths
+        assert "/cluster/health" in client_paths  # shell command side
+        assert "x-weed-trace" in reg.header_stamped
+        assert "x-weed-trace" in reg.header_parsed
+        assert "weed_http_request_total" in reg.metric_registered
+        assert "weed_http_request_total" in reg.metric_queried
+        assert "WEED_NATIVE_POST" in reg.env_read
+        assert "WEED_NATIVE_POST" in reg.env_documented
+
+    def test_extra_source_findings_are_suppressible(self):
+        """Review regression: findings anchored in bench.py /
+        tests/conftest.py / docs must be reachable by the suppression
+        scan — check() merges those texts into index.sources so an
+        inline `# weedlint: ignore[...]` there actually works."""
+        from seaweedfs_tpu.analysis import contracts
+
+        _findings, idx, _reg = contracts.check()
+        assert "bench.py" in idx.sources
+        assert "OPERATIONS.md" in idx.sources
+
+    def test_dead_seed_metric_families_stay_gone(self):
+        """Round-12 contract fix: the five registered-but-never-touched
+        seed families must not come back to /metrics as constant-zero
+        rows that look like live instrumentation."""
+        from seaweedfs_tpu.stats.metrics import DEFAULT_REGISTRY
+
+        text = DEFAULT_REGISTRY.render_text()
+        for dead in (
+            "weed_request_total",
+            "weed_request_seconds",
+            "weed_volumes",
+            "weed_filer_store_total",
+            "weed_filer_store_seconds",
+        ):
+            assert dead not in text
+        assert "weed_http_request_total" in text  # the real family
+
+
+# ---------------------------------------------------------------------------
+# lifecycle tier (weedlint v2)
+
+
+class TestLifecycle:
+    def _check(self, tmp_path, src: str):
+        from seaweedfs_tpu.analysis import lifecycle
+
+        root = _write_pkg(tmp_path, {"mod.py": src})
+        findings, _ = lifecycle.check(root=root)
+        return findings
+
+    def test_fd_leaked_across_early_return(self, tmp_path):
+        findings = self._check(tmp_path, """
+            import os
+
+            def probe(p):
+                fd = os.open(p, os.O_RDONLY)
+                if os.fstat(fd).st_size == 0:
+                    return None
+                os.close(fd)
+                return True
+        """)
+        assert [f.rule for f in findings] == ["lifecycle-fd-leak"]
+        assert "returns at line" in findings[0].message
+
+    def test_with_and_try_finally_are_clean(self, tmp_path):
+        findings = self._check(tmp_path, """
+            import os
+
+            def with_form(p):
+                with open(p, "rb") as f:
+                    return f.read()
+
+            def finally_form(p):
+                fd = os.open(p, os.O_RDONLY)
+                try:
+                    if os.fstat(fd).st_size == 0:
+                        return None
+                    return os.read(fd, 10)
+                finally:
+                    os.close(fd)
+        """)
+        assert findings == []
+
+    def test_escapes_are_ownership_transfers(self, tmp_path):
+        findings = self._check(tmp_path, """
+            import os
+            import socket
+
+            class Pool:
+                def __init__(self, p):
+                    self.fd = os.open(p, os.O_RDONLY)  # stored: Pool owns
+
+                def adopt(self, p):
+                    fd = os.open(p, os.O_RDONLY)
+                    self.fd = fd  # escapes to self
+
+            def returned(p):
+                f = open(p, "rb")
+                return f  # caller owns now
+
+            def closure(p):
+                f = open(p, "rb")
+                def gen():
+                    with f:
+                        yield f.read()
+                return gen()
+        """)
+        assert findings == []
+
+    def test_thread_started_never_joined(self, tmp_path):
+        findings = self._check(tmp_path, """
+            import threading
+
+            def fire_and_forget(work):
+                t = threading.Thread(target=work)
+                t.start()
+
+            def daemon_ok(work):
+                t = threading.Thread(target=work, daemon=True)
+                t.start()
+
+            def joined_ok(work):
+                t = threading.Thread(target=work)
+                t.start()
+                t.join()
+        """)
+        assert [f.rule for f in findings] == ["lifecycle-thread-leak"]
+        assert "fire_and_forget" in findings[0].message
+
+    def test_interprocedural_allocator_carries_obligation(self, tmp_path):
+        findings = self._check(tmp_path, """
+            import os
+
+            def _open_shard(p):
+                fd = os.open(p, os.O_RDONLY)
+                return fd
+
+            def reader_leaks(p):
+                fd = _open_shard(p)
+                if os.fstat(fd).st_size == 0:
+                    return None
+                os.close(fd)
+                return fd
+
+            def closer(fd):
+                os.close(fd)
+
+            def reader_transfers(p):
+                fd = _open_shard(p)
+                closer(fd)
+        """)
+        assert [f.rule for f in findings] == ["lifecycle-fd-leak"]
+        assert "reader_leaks" in findings[0].message
+
+    def test_acquisition_args_transfer_ownership(self, tmp_path):
+        """Review regression: a tracked resource fed INTO another
+        acquisition call transfers ownership — os.fdopen(fd) owns fd
+        (f.close() closes it) and Thread(args=(conn,)) hands the
+        accepted socket to the worker."""
+        findings = self._check(tmp_path, """
+            import os
+            import threading
+
+            def fdopen_owns_the_fd(p):
+                fd = os.open(p, os.O_RDONLY)
+                f = os.fdopen(fd)
+                f.close()
+                return True
+
+            def worker_owns_the_conn(listener, handle):
+                conn, addr = listener.accept()
+                t = threading.Thread(
+                    target=handle, args=(conn,), daemon=True
+                )
+                t.start()
+        """)
+        assert findings == []
+
+    def test_owns_annotation_transfers_ownership(self, tmp_path):
+        findings = self._check(tmp_path, """
+            import os
+
+            # weedlint: owns[fd] — the C ring adopts the descriptor
+            def ring_register(fd):
+                _native_register(fd)
+
+            def no_leak(p):
+                fd = os.open(p, os.O_RDONLY)
+                ring_register(fd)
+        """)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# stale-suppression audit
+
+
+class TestStaleSuppressions:
+    def test_stale_and_unknown_rule_ignores_become_findings(self):
+        from seaweedfs_tpu.analysis import find_stale_suppressions
+
+        sources = {
+            "mod.py": (
+                "x = 1  # weedlint: ignore[hot-loop-sleep] — was real once\n"
+                "y = 2  # weedlint: ignore[hot-loop-lock] — rule never existed\n"
+                "z = 3  # weedlint: ignore[hot-loop-sleep] — still live\n"
+            )
+        }
+        live = [Finding("hot-loop-sleep", "mod.py", 3, "m")]
+        stale = find_stale_suppressions(live, sources)
+        assert sorted(f.line for f in stale) == [1, 2]
+        assert all(f.rule == "stale-suppression" for f in stale)
+
+    def test_placeholder_grammar_examples_are_skipped(self):
+        from seaweedfs_tpu.analysis import find_stale_suppressions
+
+        sources = {
+            "DOC.md": "syntax: `# weedlint: ignore[rule-name] — reason`\n"
+        }
+        assert find_stale_suppressions([], sources) == []
+
+
+# ---------------------------------------------------------------------------
 # the real tree + CLI
 
 
 class TestRealTree:
     def test_cli_exits_zero_on_tree(self):
+        # --stale-suppressions runs every tier AND the ignore audit in
+        # one subprocess: exit 0 proves the tree is finding-free and no
+        # suppression has outlived its bug
         proc = subprocess.run(
-            [sys.executable, "-m", "seaweedfs_tpu.analysis"],
+            [
+                sys.executable, "-m", "seaweedfs_tpu.analysis",
+                "--stale-suppressions",
+            ],
             capture_output=True,
             text=True,
             timeout=300,
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_contracts_and_lifecycle_rules_selectable(self):
+        """The acceptance-gate invocation: `--rules contracts,lifecycle`
+        must run exactly the new tiers and exit clean on this tree."""
+        from seaweedfs_tpu.analysis.__main__ import main
+
+        assert main(["--rules", "contracts,lifecycle"]) == 0
+
+    def test_c_and_contracts_families_do_not_cross_select(self, capsys):
+        """Review regression: `--rules c` must run ONLY the C tier —
+        "contracts".startswith("c") used to drag the whole contract
+        tier (and its package walk) into a C-only run, and vice
+        versa. The --json registry dump is the observable: present
+        exactly when the contracts tier ran."""
+        import json as _json
+
+        from seaweedfs_tpu.analysis.__main__ import main
+
+        assert main(["--rules", "c", "--json"]) == 0
+        assert "contracts" not in _json.loads(capsys.readouterr().out)
+        assert main(["--rules", "contracts", "--json"]) == 0
+        assert "contracts" in _json.loads(capsys.readouterr().out)
+
+    def test_ctier_failure_message_has_no_nameerror(self, monkeypatch):
+        """Regression: ctier's compile-failure message referenced an
+        undefined `mode` — reachable exactly when a shim FAILS to
+        compile, i.e. when the diagnostics matter. Force the failure
+        path and assert it formats."""
+        from seaweedfs_tpu.analysis import ctier
+
+        monkeypatch.setattr(
+            ctier, "_UNITS", (("does_not_exist.c", False),)
+        )
+        findings = ctier.check_warnings()
+        if findings:  # toolchain present: the path must format cleanly
+            assert findings[0].rule == "c-warnings"
 
     def test_full_rule_name_selects_its_family(self, capsys):
         """`--rules hot-loop-no-timeout` must run the hot-loop family
