@@ -1424,6 +1424,179 @@ def bench_load() -> None:
     )
 
 
+def bench_serve() -> None:
+    """Event-driven serving core A/B (docs/SERVING.md, BENCH_r08).
+
+    Two identical single-volume clusters run as CLI subprocesses, one
+    with the C epoll loop (default), one with WEED_NATIVE_SERVE=0 (the
+    threaded mini-loop fallback) — the kill switch IS the A/B lever.
+    weedload's GET fan drives 256 keep-alive connections (2 client
+    processes x 128 selector-driven conns, real sockets, spawn start)
+    through three mixes per arm:
+
+      serve_get_*     hot-cache 1 KiB GETs, unpaced closed loop — the
+                      max-throughput probe (req/s is the headline)
+      serve_range_*   same keyset, every 3rd request a Range read
+                      (suffix/interior/open-ended cycling; 200+206 mix)
+      serve_paced_*   coordinated-omission-safe arm: every connection
+                      paced at a fixed schedule chosen as ~60% of the
+                      epoll arm's measured hot throughput, latency
+                      charged from the SCHEDULED send — queueing delay
+                      at equal offered load is where thread-per-
+                      connection dies first
+
+    vs_baseline on each epoll line = epoll/threaded ratio (req/s for
+    the closed-loop mixes, threaded_p99/epoll_p99 for the paced arm).
+    Acceptance (ISSUE 8): >=2x req/s or >=2x p99 at >=256 connections,
+    0 errors."""
+    import subprocess
+    import tempfile
+    import urllib.request as _rq
+
+    from seaweedfs_tpu.telemetry.weedload import run_get_fan, seed_keys
+
+    def _free_port():
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def _spawn(env_extra, *args):
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu", WEED_EC_CODEC="cpu", **env_extra
+        )
+        return subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                "import jax; jax.config.update('jax_platforms', 'cpu');"
+                "from seaweedfs_tpu.__main__ import main; main()",
+                *args,
+            ],
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+
+    RANGES = ["bytes=0-127", "bytes=-100", "bytes=256-", "bytes=100-611"]
+
+    def _run_arm(
+        native: bool, paced_rate: float, mixes: tuple = ("hot", "range")
+    ) -> dict:
+        env_extra = {} if native else {"WEED_NATIVE_SERVE": "0"}
+        mport = _free_port()
+        m = f"127.0.0.1:{mport}"
+        procs = []
+        with tempfile.TemporaryDirectory() as d:
+            try:
+                procs.append(
+                    _spawn(env_extra, "master", "-port", str(mport),
+                           "-mdir", d)
+                )
+                vdir = os.path.join(d, "v0")
+                os.mkdir(vdir)
+                procs.append(
+                    _spawn(
+                        env_extra, "volume", "-port", str(_free_port()),
+                        "-dir", vdir, "-mserver", m, "-max", "20",
+                        "-scrubInterval", "0",
+                    )
+                )
+                deadline = time.time() + 60
+                while time.time() < deadline:
+                    try:
+                        with _rq.urlopen(
+                            f"http://{m}/dir/status", timeout=2
+                        ) as r:
+                            topo = json.load(r)["Topology"]
+                        if any(
+                            rk["DataNodes"]
+                            for dc in topo.get("DataCenters", [])
+                            for rk in dc.get("Racks", [])
+                        ):
+                            break
+                    except OSError:
+                        pass
+                    time.sleep(0.3)
+                else:
+                    raise RuntimeError("serve-bench cluster never came up")
+                payload = (b"weedload\x00\xff" * 103)[:1024]
+                keys = seed_keys(m, 48, payload)
+                common = dict(
+                    master=m, duration_s=8.0, processes=2,
+                    conns_per_proc=128, keys=keys,
+                )
+                out = {}
+                if "hot" in mixes:
+                    out["hot"] = run_get_fan(**common)
+                if "range" in mixes:
+                    out["range"] = run_get_fan(
+                        **common, range_every=3, ranges=RANGES
+                    )
+                if paced_rate > 0:
+                    out["paced"] = run_get_fan(**common, rate=paced_rate)
+                return out
+            finally:
+                for p in procs:
+                    p.kill()
+                for p in procs:
+                    try:
+                        p.wait(timeout=10)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    # throughput arms first; their hot req/s picks the paced schedule
+    # (the second epoll pass runs ONLY the paced mix — the closed-loop
+    # rows come from the first pass)
+    epoll = _run_arm(True, 0.0)
+    paced_rate = max(1.0, 0.6 * epoll["hot"]["req_per_sec"] / 256.0)
+    epoll["paced"] = _run_arm(True, paced_rate, mixes=())["paced"]
+    threaded = _run_arm(False, paced_rate)
+
+    for mix in ("hot", "range"):
+        e, t = epoll[mix], threaded[mix]
+        ratio = e["req_per_sec"] / t["req_per_sec"] if t["req_per_sec"] else 0.0
+        for arm_name, row, vs in (
+            (f"serve_{mix}_epoll", e, ratio),
+            (f"serve_{mix}_threaded", t, 1.0),
+        ):
+            _report(
+                arm_name,
+                row["req_per_sec"],
+                "req/s",
+                round(vs, 4),
+                p50_ms=row["p50_ms"],
+                p99_ms=row["p99_ms"],
+                p999_ms=row["p999_ms"],
+                ops=row["ops"],
+                errors=row["errors"],
+                connections=row["config"]["connections"],
+                co_safe=row["config"]["coordinated_omission_safe"],
+            )
+    e, t = epoll["paced"], threaded["paced"]
+    p99_ratio = t["p99_ms"] / e["p99_ms"] if e["p99_ms"] else 0.0
+    for arm_name, row, vs in (
+        ("serve_paced_epoll", e, round(p99_ratio, 4)),
+        ("serve_paced_threaded", t, 1.0),
+    ):
+        _report(
+            arm_name,
+            row["p99_ms"],
+            "ms",
+            vs,
+            p50_ms=row["p50_ms"],
+            p999_ms=row["p999_ms"],
+            req_per_sec=row["req_per_sec"],
+            offered_per_conn=round(paced_rate, 2),
+            ops=row["ops"],
+            errors=row["errors"],
+            connections=row["config"]["connections"],
+            co_safe=row["config"]["coordinated_omission_safe"],
+        )
+
+
 CONFIGS = {
     "encode": bench_encode,
     "rebuild": bench_rebuild,
@@ -1439,6 +1612,7 @@ CONFIGS = {
     "scrub": bench_scrub,
     "trace": bench_trace,
     "load": bench_load,
+    "serve": bench_serve,
 }
 
 
@@ -1512,6 +1686,64 @@ def check_native_post() -> int:
         return 0 if ok else 1
     finally:
         Volume._now_ns = orig
+
+
+def check_native_serve() -> int:
+    """`bench.py --check` serve leg: one GET (and one Range GET)
+    through the C epoll loop and through the threaded mini loop must
+    produce identical bytes, and the C arm must have served it from
+    the zero-copy fast path (not via handoff). The full matrix lives
+    in tests/test_native_serve.py; the fuzzer in
+    analysis/fuzz_serve.py."""
+    import tempfile
+
+    from seaweedfs_tpu.analysis import fuzz_serve
+    from seaweedfs_tpu.util import native_serve
+
+    if not native_serve.available():
+        print(json.dumps({
+            "check": "native_serve",
+            "skipped": "no C toolchain / non-Linux: threaded loop serves",
+        }))
+        return 0
+    with tempfile.TemporaryDirectory(prefix="weedserve_check") as d:
+        pair = fuzz_serve.ServePair(d)
+        try:
+            hits = []
+            orig = pair.servers[0].fast_resolver
+
+            def counting(path, rng, head_only):
+                plan = orig(path, rng, head_only)
+                hits.append(plan is not None)
+                return plan
+
+            pair.servers[0].fast_resolver = counting
+            for req in (
+                f"GET /{pair.fids['small']} HTTP/1.1\r\n\r\n",
+                f"GET /{pair.fids['big']} HTTP/1.1\r\nRange: bytes=-100\r\n\r\n",
+            ):
+                case = {"fragments": [req.encode()]}
+                c = fuzz_serve.drive(pair.c_port, case)
+                py = fuzz_serve.drive(pair.py_port, case)
+                if c != py:
+                    print(json.dumps({
+                        "check": "native_serve",
+                        "ok": False,
+                        "error": f"C/Python GET bytes diverge for {req!r}",
+                    }))
+                    return 1
+            if hits != [True, True]:
+                print(json.dumps({
+                    "check": "native_serve",
+                    "ok": False,
+                    "error": f"fast path declined eligible GETs: {hits}",
+                }))
+                return 1
+        finally:
+            pair.close()
+    print(json.dumps({"check": "native_serve", "ok": True,
+                      "fast_path_hits": 2}))
+    return 0
 
 
 def check_trace_smoke() -> int:
@@ -1769,6 +2001,7 @@ def main() -> None:
         # analysis (weedlint), and memory safety (ASan matrix+corpus);
         # the inner marker keeps subprocess layers from recursing
         rc = check_native_post()
+        rc = rc or check_native_serve()
         rc = rc or check_trace_smoke()
         rc = rc or check_telemetry_smoke()
         if os.environ.get("WEED_BENCH_CHECK_INNER") != "1":

@@ -42,6 +42,7 @@ _UNITS = (
     ("crc32c.c", False),
     ("gf256.c", False),
     ("needle_ext.c", True),
+    ("serve_ext.c", True),
 )
 
 
@@ -130,18 +131,30 @@ _GIL_SPANS = (
     ("py_encode", "needle_ext.c"),
     ("py_decode", "needle_ext.c"),
     ("py_post", "needle_ext.c"),
+    # the serving loop parks in epoll_wait for whole idle windows —
+    # holding the GIL there would freeze every handler thread in the
+    # process for the duration
+    ("py_loop", "serve_ext.c"),
 )
 
 
 def check_gil_release() -> list[Finding]:
     findings: list[Finding] = []
-    path = os.path.join(_NATIVE_DIR, "needle_ext.c")
-    try:
-        with open(path, "r", encoding="utf-8") as f:
-            source = f.read()
-    except OSError:
-        return findings
+    sources: dict[str, str] = {}
+    for _, src_name in _GIL_SPANS:
+        if src_name in sources:
+            continue
+        try:
+            with open(
+                os.path.join(_NATIVE_DIR, src_name), "r", encoding="utf-8"
+            ) as f:
+                sources[src_name] = f.read()
+        except OSError:
+            sources[src_name] = ""
     for fn, src_name in _GIL_SPANS:
+        source = sources[src_name]
+        if not source:
+            continue
         start = source.find(f"*{fn}(")
         if start < 0:
             findings.append(

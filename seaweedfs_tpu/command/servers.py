@@ -75,6 +75,48 @@ def _add_trace_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _add_serve_flags(p: argparse.ArgumentParser) -> None:
+    """Serving-core knobs shared by the data-plane daemons
+    (docs/SERVING.md), enforced identically by the C epoll loop and
+    the threaded mini loop."""
+    p.add_argument(
+        "-serveIdleMs",
+        type=int,
+        default=30000,
+        help="close keep-alive connections idle longer than this many "
+        "milliseconds (0 = never; bounds fd usage under millions of "
+        "mostly-idle clients)",
+    )
+    p.add_argument(
+        "-serveMaxReqs",
+        type=int,
+        default=0,
+        help="serve at most N requests per connection, then close with "
+        "Connection: close (0 = unlimited; rebalances long-lived "
+        "clients across SO_REUSEPORT accept processes)",
+    )
+
+
+def _spawn_serve_procs(n: int, argv_tail: list[str]) -> list:
+    """`-serveProcs N` (docs/SERVING.md): launch N-1 sibling gateway
+    processes re-running this subcommand with `-reusePort` so every
+    member binds the same port via SO_REUSEPORT and the kernel spreads
+    accepted connections across them. Returns Popen handles."""
+    import subprocess
+    import sys
+
+    procs = []
+    for _ in range(max(0, n - 1)):
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "seaweedfs_tpu"]
+                + argv_tail
+                + ["-serveProcs", "1", "-reusePort"]
+            )
+        )
+    return procs
+
+
 def _apply_trace_flags(args) -> None:
     from seaweedfs_tpu import trace
 
@@ -301,6 +343,7 @@ class VolumeCommand(Command):
             help="scrub bandwidth cap in MB/s (token bucket protecting "
             "foreground read p99; <=0 = unlimited)",
         )
+        _add_serve_flags(p)
         _add_trace_flags(p)
         p.add_argument(
             "-v", type=int, default=0,
@@ -352,6 +395,8 @@ class VolumeCommand(Command):
             n_writers=workers if shard_writes else 1,
             scrub_interval=args.scrubInterval,
             scrub_rate_mb_s=args.scrubRate,
+            serve_idle_ms=args.serveIdleMs,
+            serve_max_reqs=args.serveMaxReqs,
         )
         from seaweedfs_tpu.util.profiling import CpuProfile
 
@@ -541,6 +586,22 @@ class S3Command(Command):
             help="comma-separated master(s) to announce this gateway to "
             "(telemetry plane; empty = not scraped by the collector)",
         )
+        p.add_argument(
+            "-serveProcs",
+            type=int,
+            default=1,
+            help="accept processes sharing this port via SO_REUSEPORT "
+            "(N>1 spawns N-1 sibling gateways; the kernel spreads "
+            "connections across them — docs/SERVING.md)",
+        )
+        p.add_argument(
+            "-reusePort",
+            action="store_true",
+            help="bind with SO_REUSEPORT (set automatically on the "
+            "siblings -serveProcs spawns; set by hand to run your own "
+            "process group behind one port)",
+        )
+        _add_serve_flags(p)
         _add_trace_flags(p)
         p.add_argument(
             "-v", type=int, default=0,
@@ -570,6 +631,7 @@ class S3Command(Command):
                 for i in tree.get("identities", [])
             ]
             iam = IdentityAccessManagement(idents)
+        procs = args.serveProcs
         server = S3ApiServer(
             filer=args.filer,
             host=args.ip,
@@ -577,12 +639,23 @@ class S3Command(Command):
             buckets_path=args.bucketsPath,
             iam=iam,
             masters=[m for m in args.master.split(",") if m],
+            reuse_port=args.reusePort or procs > 1,
+            serve_idle_ms=args.serveIdleMs,
+            serve_max_reqs=args.serveMaxReqs,
         )
         server.start()
-        wlog.info("s3 gateway %s:%d -> filer %s", args.ip, args.port, args.filer)
+        import sys
+
+        children = _spawn_serve_procs(procs, sys.argv[1:])
+        wlog.info(
+            "s3 gateway %s:%d -> filer %s (%d proc(s))",
+            args.ip, args.port, args.filer, procs,
+        )
         try:
             return _wait_forever()
         finally:
+            for pr in children:
+                pr.terminate()
             server.stop()
 
 
@@ -604,6 +677,22 @@ class WebDavCommand(Command):
             help="comma-separated master(s) to announce this gateway to "
             "(telemetry plane; empty = not scraped by the collector)",
         )
+        p.add_argument(
+            "-serveProcs",
+            type=int,
+            default=1,
+            help="accept processes sharing this port via SO_REUSEPORT "
+            "(N>1 spawns N-1 sibling gateways; the kernel spreads "
+            "connections across them — docs/SERVING.md)",
+        )
+        p.add_argument(
+            "-reusePort",
+            action="store_true",
+            help="bind with SO_REUSEPORT (set automatically on the "
+            "siblings -serveProcs spawns; set by hand to run your own "
+            "process group behind one port)",
+        )
+        _add_serve_flags(p)
         _add_trace_flags(p)
         p.add_argument(
             "-v", type=int, default=0,
@@ -616,17 +705,29 @@ class WebDavCommand(Command):
 
         wlog.set_verbosity(args.v)
         _apply_trace_flags(args)
+        procs = args.serveProcs
         server = WebDavServer(
             filer=args.filer,
             host=args.ip,
             port=args.port,
             masters=[m for m in args.master.split(",") if m],
+            reuse_port=args.reusePort or procs > 1,
+            serve_idle_ms=args.serveIdleMs,
+            serve_max_reqs=args.serveMaxReqs,
         )
         server.start()
-        wlog.info("webdav %s:%d -> filer %s", args.ip, args.port, args.filer)
+        import sys
+
+        children = _spawn_serve_procs(procs, sys.argv[1:])
+        wlog.info(
+            "webdav %s:%d -> filer %s (%d proc(s))",
+            args.ip, args.port, args.filer, procs,
+        )
         try:
             return _wait_forever()
         finally:
+            for pr in children:
+                pr.terminate()
             server.stop()
 
 

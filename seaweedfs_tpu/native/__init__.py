@@ -50,3 +50,10 @@ if _lib is not None:
 # _build scans the #include graph, so staleness tracks needle.c,
 # crc32c.c, and post.c without a hand-maintained deps tuple)
 needle_ext = _build.load_ext("needle_ext.c", "_needle_ext")
+
+# event-driven serving core (native/serve.c behind serve_ext.c): the
+# epoll accept/read/dispatch loop with the zero-copy sendfile GET fast
+# path (docs/SERVING.md). Linux-only by design — on hosts where the
+# epoll/sendfile includes don't exist the build fails and every daemon
+# keeps the threaded mini request loop.
+serve_ext = _build.load_ext("serve_ext.c", "_serve_ext")

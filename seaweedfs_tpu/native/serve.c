@@ -1,0 +1,756 @@
+/* serve.c — the event-driven serving core: one epoll loop that owns
+ * accept/read/parse/respond for a listening socket.
+ *
+ * PR-5's stage traces put the serving residue in syscalls and loop
+ * machinery (pwrite ~358 us of a ~500 us write; the Python mini-loop
+ * and thread-per-connection dispatch are what's left around it), and
+ * thread-per-connection cannot survive past a few thousand concurrent
+ * connections.  This loop replaces that edge:
+ *
+ *   - non-blocking accept4 drain on every listen event (the kernel
+ *     backlog is deep; the loop must never leave it full),
+ *   - a per-connection read state machine: request heads are scanned
+ *     out of one growing buffer, keep-alive and HTTP pipelining are
+ *     native (the next pipelined head is parsed the moment the
+ *     previous response drains — no extra epoll round trip),
+ *   - a zero-copy GET fast path: the embedder's resolve() callback
+ *     maps a request to (fd, offset, count) and the loop sendfile()s
+ *     the bytes straight from the volume file to the socket, with
+ *     short-write resumption on EAGAIN,
+ *   - everything else HANDS THE CONNECTION OFF to the embedder
+ *     (handoff() transfers the fd plus any unconsumed buffered bytes),
+ *     so the one Python request parser keeps serving every slow path
+ *     and the two paths cannot drift: this loop never formats an error
+ *     response of its own.
+ *
+ * The loop knows no HTTP beyond what routing requires: request line,
+ * the handful of headers that gate the fast path, and Connection
+ * semantics.  Response bytes come from the embedder pre-formatted
+ * except the Connection/Content-Length tail, which the loop appends
+ * exactly like the Python fast_reply does — byte identity between the
+ * C and Python serving paths is a construction, not a test hope.
+ *
+ * Pure C, no Python.h: serve_ext.c binds it the way needle_ext.c
+ * binds post.c.  Callbacks are function pointers; the glue re-takes
+ * the GIL inside them.
+ */
+
+#ifndef WEED_SERVE_C
+#define WEED_SERVE_C
+
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/sendfile.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+/* matches util/httpd._BufReader.read_head's 431 limit: a head this
+ * large is handed off so the Python loop applies its own cap */
+#define WEED_SERVE_HEAD_LIMIT 131072
+#define WEED_SERVE_RBUF_INIT 4096
+#define WEED_SERVE_SENDFILE_CHUNK (1u << 20)
+#define WEED_SERVE_EVENTS 256
+
+typedef struct {
+    const char *method; size_t method_len;
+    const char *path;   size_t path_len;
+    const char *range;  size_t range_len;   /* NULL when absent */
+    const char *trace;  size_t trace_len;   /* x-weed-trace value    */
+    int head_only;                          /* method == HEAD        */
+} weed_req;
+
+typedef struct {
+    const uint8_t *prefix; size_t prefix_len; /* status line + headers,
+                                                 WITHOUT Connection /
+                                                 Content-Length tail  */
+    const uint8_t *body;   size_t body_len;   /* in-memory body (fd<0) */
+    int fd; int64_t off; size_t count;        /* sendfile body (fd>=0) */
+    int close_fd;                             /* loop closes fd after  */
+    int status;
+} weed_resp;
+
+typedef struct weed_serve_cbs {
+    void *ctx;
+    /* One parsed GET/HEAD request.  Return 1 = resp filled (serve it
+     * here), 0 = decline (hand the connection off), -1 = abort the
+     * connection.  `token` rides to the matching complete(). */
+    int (*resolve)(void *ctx, const weed_req *req, weed_resp *resp,
+                   void **token);
+    /* Ownership of `fd` (plus `len` unconsumed buffered bytes starting
+     * at the current request head) transfers to the embedder.
+     * `nreqs` = responses this loop already served on the connection,
+     * so the embedder's max-requests accounting continues instead of
+     * restarting. */
+    void (*handoff)(void *ctx, int fd, const uint8_t *pending, size_t len,
+                    const char *ip, int port, long nreqs);
+    /* The fast-path response finished (ok=1: fully written; ok=0: the
+     * connection died first).  Always called exactly once per
+     * successful resolve() — it releases `token`. */
+    void (*complete)(void *ctx, void *token, int status, size_t resp_bytes,
+                     double t_parse, double t_resolve, double t_send, int ok);
+} weed_serve_cbs;
+
+typedef struct weed_conn {
+    int fd;
+    char ip[48];
+    int port;
+    uint8_t *rbuf;
+    size_t rcap, rlen, rpos;  /* rpos = start of the current head */
+    size_t scan;              /* head-end scan resume point        */
+    uint8_t *wbuf;
+    size_t wcap, wlen, wpos;
+    int body_fd;
+    int64_t body_off;
+    size_t body_left;
+    int close_body_fd;
+    void *token;
+    int status;
+    size_t resp_bytes;
+    int writing;  /* a response is in flight (interest = EPOLLOUT) */
+    int closing;  /* close once the in-flight response drains      */
+    int eof;      /* peer sent FIN; drain buffered pipeline, then close
+                     (the Python loop serves buffered requests after
+                     EOF too — byte-identity includes shutdown order) */
+    long nreqs;
+    double t_parse, t_resolve, t_send0;
+    int64_t last_ms;
+    struct weed_conn *prev, *next;  /* idle LRU; most recent at tail */
+} weed_conn;
+
+typedef struct weed_loop {
+    int epfd, listen_fd, wake_fd;
+    long idle_ms, max_reqs;
+    weed_serve_cbs *cbs;
+    weed_conn lru;  /* sentinel */
+    int stop;
+    int64_t listen_paused_until_ms;  /* 0 = listen fd armed; else the
+                                        re-arm deadline after EMFILE
+                                        (a level-triggered listen event
+                                        that can never accept would
+                                        busy-spin the loop) */
+} weed_loop;
+
+static double weed_now_s(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
+static int64_t weed_now_ms(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (int64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+/* ---- idle LRU ------------------------------------------------------ */
+
+static void weed_lru_unlink(weed_conn *c) {
+    c->prev->next = c->next;
+    c->next->prev = c->prev;
+}
+
+static void weed_lru_touch(weed_loop *lp, weed_conn *c) {
+    weed_lru_unlink(c);
+    c->prev = lp->lru.prev;
+    c->next = &lp->lru;
+    lp->lru.prev->next = c;
+    lp->lru.prev = c;
+    c->last_ms = weed_now_ms();
+}
+
+/* ---- connection lifecycle ------------------------------------------ */
+
+static void weed_conn_release_resp(weed_loop *lp, weed_conn *c, int ok) {
+    if (c->close_body_fd && c->body_fd >= 0) close(c->body_fd);
+    c->body_fd = -1;
+    c->body_left = 0;
+    c->close_body_fd = 0;
+    if (c->token != NULL) {
+        double t_send = weed_now_s() - c->t_send0;
+        lp->cbs->complete(lp->cbs->ctx, c->token, c->status, c->resp_bytes,
+                          c->t_parse, c->t_resolve, t_send, ok);
+        c->token = NULL;
+    }
+}
+
+static void weed_conn_destroy(weed_loop *lp, weed_conn *c, int close_fd) {
+    weed_conn_release_resp(lp, c, 0);
+    weed_lru_unlink(c);
+    epoll_ctl(lp->epfd, EPOLL_CTL_DEL, c->fd, NULL);
+    if (close_fd) close(c->fd);
+    free(c->rbuf);
+    free(c->wbuf);
+    free(c);
+}
+
+/* the connection leaves this loop alive: the embedder now owns the fd
+ * and the unconsumed bytes (the current head onward) */
+static void weed_conn_handoff(weed_loop *lp, weed_conn *c) {
+    int fd = c->fd;
+    /* detach BEFORE the callback: the embedder may start reading from
+     * another thread immediately */
+    epoll_ctl(lp->epfd, EPOLL_CTL_DEL, fd, NULL);
+    lp->cbs->handoff(lp->cbs->ctx, fd, c->rbuf + c->rpos, c->rlen - c->rpos,
+                     c->ip, c->port, c->nreqs);
+    weed_lru_unlink(c);
+    free(c->rbuf);
+    free(c->wbuf);
+    free(c);
+}
+
+static int weed_conn_interest(weed_loop *lp, weed_conn *c, uint32_t events) {
+    struct epoll_event ev;
+    memset(&ev, 0, sizeof(ev));
+    /* RDHUP only while reading: a half-closed peer that is still
+     * draining its response would otherwise level-trigger RDHUP every
+     * epoll round while the send buffer is full (busy spin); in the
+     * writing state a dead peer surfaces as EPOLLERR/HUP or EPIPE */
+    ev.events = events | ((events & EPOLLIN) ? EPOLLRDHUP : 0);
+    ev.data.ptr = c;
+    return epoll_ctl(lp->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+/* ---- buffers ------------------------------------------------------- */
+
+static int weed_rbuf_reserve(weed_conn *c, size_t want) {
+    if (c->rcap - c->rlen >= want) return 0;
+    /* compact first: everything before rpos is consumed */
+    if (c->rpos > 0) {
+        memmove(c->rbuf, c->rbuf + c->rpos, c->rlen - c->rpos);
+        if (c->scan >= c->rpos) c->scan -= c->rpos; else c->scan = 0;
+        c->rlen -= c->rpos;
+        c->rpos = 0;
+        if (c->rcap - c->rlen >= want) return 0;
+    }
+    size_t cap = c->rcap ? c->rcap : WEED_SERVE_RBUF_INIT;
+    while (cap - c->rlen < want) cap *= 2;
+    uint8_t *nb = realloc(c->rbuf, cap);
+    if (nb == NULL) return -1;
+    c->rbuf = nb;
+    c->rcap = cap;
+    return 0;
+}
+
+static int weed_wbuf_append(weed_conn *c, const void *data, size_t n) {
+    if (c->wcap - c->wlen < n) {
+        size_t cap = c->wcap ? c->wcap : 1024;
+        while (cap - c->wlen < n) cap *= 2;
+        uint8_t *nb = realloc(c->wbuf, cap);
+        if (nb == NULL) return -1;
+        c->wbuf = nb;
+        c->wcap = cap;
+    }
+    memcpy(c->wbuf + c->wlen, data, n);
+    c->wlen += n;
+    return 0;
+}
+
+/* ---- parsing ------------------------------------------------------- */
+
+/* find "\r\n\r\n" in buf[from..len); returns offset of its first byte
+ * or -1.  memchr-based so no _GNU_SOURCE memmem dependency. */
+static ssize_t weed_find_head_end(const uint8_t *buf, size_t len, size_t from) {
+    while (from + 4 <= len) {
+        const uint8_t *p = memchr(buf + from, '\r', len - from - 3);
+        if (p == NULL) return -1;
+        if (p[1] == '\n' && p[2] == '\r' && p[3] == '\n')
+            return (ssize_t)(p - buf);
+        from = (size_t)(p - buf) + 1;
+    }
+    return -1;
+}
+
+static int weed_token_eq_ci(const char *p, size_t n, const char *lit) {
+    size_t i;
+    for (i = 0; i < n; i++) {
+        char a = p[i];
+        if (a >= 'A' && a <= 'Z') a += 32;
+        if (a != lit[i]) return 0;
+    }
+    return lit[n] == '\0';
+}
+
+static void weed_trim(const char **p, size_t *n) {
+    while (*n > 0 && ((*p)[0] == ' ' || (*p)[0] == '\t')) { (*p)++; (*n)--; }
+    while (*n > 0 && ((*p)[*n - 1] == ' ' || (*p)[*n - 1] == '\t')) (*n)--;
+}
+
+/* Parse one request head (head_len bytes including the blank line).
+ * Returns 1 = fast-path candidate (req filled, keep_alive set),
+ *         0 = hand off (anything this loop does not fully model).   */
+static int weed_parse_head(const uint8_t *head, size_t head_len,
+                           weed_req *req, int *keep_alive) {
+    const char *p = (const char *)head;
+    const char *end = p + head_len - 2;  /* final CRLF of blank line */
+    const char *eol = memchr(p, '\r', (size_t)(end - p));
+    if (eol == NULL || eol[1] != '\n') return 0;
+
+    /* request line: METHOD SP PATH SP HTTP/1.x */
+    const char *sp1 = memchr(p, ' ', (size_t)(eol - p));
+    if (sp1 == NULL) return 0;
+    const char *sp2 = memchr(sp1 + 1, ' ', (size_t)(eol - sp1 - 1));
+    if (sp2 == NULL) return 0;
+    size_t mlen = (size_t)(sp1 - p);
+    size_t vlen = (size_t)(eol - sp2 - 1);
+    if (memchr(sp1 + 1, ' ', (size_t)(sp2 - sp1 - 1)) != NULL) return 0;
+    int head_only;
+    if (mlen == 3 && memcmp(p, "GET", 3) == 0) head_only = 0;
+    else if (mlen == 4 && memcmp(p, "HEAD", 4) == 0) head_only = 1;
+    else return 0;
+    int http11;
+    if (vlen == 8 && memcmp(sp2 + 1, "HTTP/1.1", 8) == 0) http11 = 1;
+    else if (vlen == 8 && memcmp(sp2 + 1, "HTTP/1.0", 8) == 0) http11 = 0;
+    else return 0;  /* 0.9 / exotic versions: the Python parser decides */
+
+    memset(req, 0, sizeof(*req));
+    req->method = p;
+    req->method_len = mlen;
+    req->path = sp1 + 1;
+    req->path_len = (size_t)(sp2 - sp1 - 1);
+    req->head_only = head_only;
+    if (req->path_len == 0) return 0;
+
+    int ka = http11;
+    const char *line = eol + 2;
+    while (line < end) {
+        const char *le = memchr(line, '\r', (size_t)(end - line));
+        if (le == NULL) le = end;
+        const char *colon = memchr(line, ':', (size_t)(le - line));
+        if (colon != NULL) {
+            const char *k = line;
+            size_t kn = (size_t)(colon - line);
+            const char *v = colon + 1;
+            size_t vn = (size_t)(le - colon - 1);
+            weed_trim(&k, &kn);
+            weed_trim(&v, &vn);
+            if (weed_token_eq_ci(k, kn, "connection")) {
+                if (weed_token_eq_ci(v, vn, "close")) ka = 0;
+                else if (weed_token_eq_ci(v, vn, "keep-alive")) ka = 1;
+            } else if (weed_token_eq_ci(k, kn, "content-length")) {
+                /* a GET with a body: let Python frame and drain it */
+                if (!(vn == 1 && v[0] == '0')) return 0;
+            } else if (weed_token_eq_ci(k, kn, "transfer-encoding") ||
+                       weed_token_eq_ci(k, kn, "expect") ||
+                       weed_token_eq_ci(k, kn, "if-none-match") ||
+                       weed_token_eq_ci(k, kn, "if-modified-since") ||
+                       weed_token_eq_ci(k, kn, "etag-md5")) {
+                /* conditional / framing semantics live in Python */
+                return 0;
+            } else if (weed_token_eq_ci(k, kn, "range")) {
+                if (req->range != NULL) return 0;  /* duplicate Range */
+                req->range = v;
+                req->range_len = vn;
+            } else if (weed_token_eq_ci(k, kn, "x-weed-trace")) {
+                req->trace = v;
+                req->trace_len = vn;
+            }
+        }
+        line = le + 2;
+    }
+    *keep_alive = ka;
+    return 1;
+}
+
+/* ---- response writing ---------------------------------------------- */
+
+/* 1 = fully written, 0 = would block (EPOLLOUT pending), -1 = dead */
+static int weed_conn_flush(weed_conn *c) {
+    while (c->wpos < c->wlen) {
+        ssize_t n = send(c->fd, c->wbuf + c->wpos, c->wlen - c->wpos,
+                         MSG_NOSIGNAL);
+        if (n < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        c->wpos += (size_t)n;
+    }
+    while (c->body_left > 0) {
+        off_t off = (off_t)c->body_off;
+        size_t chunk = c->body_left < WEED_SERVE_SENDFILE_CHUNK
+                           ? c->body_left
+                           : WEED_SERVE_SENDFILE_CHUNK;
+        ssize_t n = sendfile(c->fd, c->body_fd, &off, chunk);
+        if (n < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        if (n == 0) return -1;  /* source truncated under us: the
+                                   promised Content-Length cannot be
+                                   met — kill the connection so the
+                                   client sees a short read, never
+                                   silent corruption */
+        c->body_off = (int64_t)off;
+        c->body_left -= (size_t)n;
+    }
+    return 1;
+}
+
+/* process buffered requests until blocked.  Returns 0 to keep the
+ * connection in the loop, -1 when it left (destroyed or handed off). */
+static int weed_conn_process(weed_loop *lp, weed_conn *c) {
+    while (!c->writing) {
+        size_t avail = c->rlen - c->rpos;
+        if (avail < 4) break;
+        if (c->scan < c->rpos) c->scan = c->rpos;
+        ssize_t he = weed_find_head_end(c->rbuf, c->rlen, c->scan);
+        if (he < 0) {
+            c->scan = c->rlen >= 3 ? c->rlen - 3 : 0;
+            if (avail > WEED_SERVE_HEAD_LIMIT) {
+                weed_conn_handoff(lp, c);  /* Python replies 431 */
+                return -1;
+            }
+            break;
+        }
+        size_t head_len = (size_t)he + 4 - c->rpos;
+
+        double tp0 = weed_now_s();
+        weed_req req;
+        int keep_alive = 1;
+        if (!weed_parse_head(c->rbuf + c->rpos, head_len, &req, &keep_alive) ||
+            lp->cbs->resolve == NULL) {
+            weed_conn_handoff(lp, c);
+            return -1;
+        }
+        c->t_parse = weed_now_s() - tp0;
+
+        weed_resp resp;
+        memset(&resp, 0, sizeof(resp));
+        resp.fd = -1;
+        void *token = NULL;
+        double tr0 = weed_now_s();
+        int rc = lp->cbs->resolve(lp->cbs->ctx, &req, &resp, &token);
+        c->t_resolve = weed_now_s() - tr0;
+        if (rc == 0) {
+            weed_conn_handoff(lp, c);
+            return -1;
+        }
+        if (rc < 0) {
+            weed_conn_destroy(lp, c, 1);
+            return -1;
+        }
+
+        c->rpos += head_len;
+        c->scan = c->rpos;
+        c->nreqs++;
+        int close_now =
+            !keep_alive || (lp->max_reqs > 0 && c->nreqs >= lp->max_reqs);
+        c->closing = close_now;
+
+        /* assemble head exactly as fast_reply does: resolver prefix
+         * (status line + headers), optional Connection: close, then
+         * Content-Length last */
+        size_t body_total = resp.fd >= 0 ? resp.count : resp.body_len;
+        char tail[64];
+        int tn = snprintf(tail, sizeof(tail), "Content-Length: %zu\r\n\r\n",
+                          body_total);
+        c->wlen = c->wpos = 0;
+        int oom = weed_wbuf_append(c, resp.prefix, resp.prefix_len);
+        if (!oom && close_now)
+            oom = weed_wbuf_append(c, "Connection: close\r\n", 19);
+        if (!oom) oom = weed_wbuf_append(c, tail, (size_t)tn);
+        if (!oom && !req.head_only && resp.fd < 0 && resp.body_len > 0)
+            oom = weed_wbuf_append(c, resp.body, resp.body_len);
+        c->token = token;
+        c->status = resp.status;
+        c->resp_bytes = c->wlen + (req.head_only ? 0 : (resp.fd >= 0 ? resp.count : 0));
+        if (oom) {
+            weed_conn_destroy(lp, c, 1);
+            return -1;
+        }
+        if (!req.head_only && resp.fd >= 0 && resp.count > 0) {
+            c->body_fd = resp.fd;
+            c->body_off = resp.off;
+            c->body_left = resp.count;
+            c->close_body_fd = resp.close_fd;
+        } else if (resp.fd >= 0 && resp.close_fd) {
+            close(resp.fd);  /* HEAD / empty body: nothing to send */
+        }
+        c->writing = 1;
+        c->t_send0 = weed_now_s();
+        int wr = weed_conn_flush(c);
+        if (wr < 0) {
+            weed_conn_destroy(lp, c, 1);
+            return -1;
+        }
+        if (wr == 0) {
+            if (weed_conn_interest(lp, c, EPOLLOUT) < 0) {
+                weed_conn_destroy(lp, c, 1);
+                return -1;
+            }
+            return 0;
+        }
+        weed_conn_release_resp(lp, c, 1);
+        c->writing = 0;
+        c->wlen = c->wpos = 0;
+        if (c->closing) {
+            weed_conn_destroy(lp, c, 1);
+            return -1;
+        }
+        if (c->rpos == c->rlen) {
+            c->rpos = c->rlen = c->scan = 0;  /* cheap full reset */
+        }
+    }
+    if (c->eof && !c->writing) {
+        /* pipeline drained (or never complete) after FIN: done */
+        weed_conn_destroy(lp, c, 1);
+        return -1;
+    }
+    return 0;
+}
+
+/* One write attempt on an in-flight response, shared by the EPOLLOUT
+ * handler and the idle-reaper's drain probe.  Returns -1 when the
+ * connection left the loop (destroyed or handed off), else 0; partial
+ * progress touches the idle LRU (a slow-but-draining client is active,
+ * not idle), completion finishes the response and resumes the
+ * pipeline. */
+static int weed_conn_flush_step(weed_loop *lp, weed_conn *c) {
+    size_t wpos0 = c->wpos, left0 = c->body_left;
+    int wr = weed_conn_flush(c);
+    if (wr < 0) {
+        weed_conn_destroy(lp, c, 1);
+        return -1;
+    }
+    if (wr == 0) {
+        if (c->wpos != wpos0 || c->body_left != left0)
+            weed_lru_touch(lp, c);
+        return 0;
+    }
+    weed_conn_release_resp(lp, c, 1);
+    c->writing = 0;
+    c->wlen = c->wpos = 0;
+    weed_lru_touch(lp, c);
+    if (c->closing) {
+        weed_conn_destroy(lp, c, 1);
+        return -1;
+    }
+    if (weed_conn_interest(lp, c, EPOLLIN) < 0) {
+        weed_conn_destroy(lp, c, 1);
+        return -1;
+    }
+    return weed_conn_process(lp, c);
+}
+
+static int weed_conn_read(weed_loop *lp, weed_conn *c) {
+    for (;;) {
+        if (weed_rbuf_reserve(c, 4096) < 0) {
+            weed_conn_destroy(lp, c, 1);
+            return -1;
+        }
+        ssize_t n = recv(c->fd, c->rbuf + c->rlen, c->rcap - c->rlen, 0);
+        if (n > 0) {
+            c->rlen += (size_t)n;
+            if (c->rlen < c->rcap) break;  /* short read: drained */
+            continue;
+        }
+        if (n == 0) {  /* FIN: serve what is buffered, then close */
+            c->eof = 1;
+            break;
+        }
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        weed_conn_destroy(lp, c, 1);
+        return -1;
+    }
+    weed_lru_touch(lp, c);
+    return weed_conn_process(lp, c);
+}
+
+static void weed_accept_drain(weed_loop *lp) {
+    for (;;) {
+        struct sockaddr_storage ss;
+        socklen_t slen = sizeof(ss);
+        int fd = accept4(lp->listen_fd, (struct sockaddr *)&ss, &slen,
+                         SOCK_NONBLOCK | SOCK_CLOEXEC);
+        if (fd < 0) {
+            if (errno == EINTR) continue;
+            if (errno == EMFILE || errno == ENFILE) {
+                /* fd exhaustion: the backlog stays non-empty, so the
+                 * level-triggered listen event would re-fire every
+                 * epoll round in a hot spin — park the listen fd and
+                 * re-arm after a beat */
+                epoll_ctl(lp->epfd, EPOLL_CTL_DEL, lp->listen_fd, NULL);
+                lp->listen_paused_until_ms = weed_now_ms() + 100;
+            }
+            return;  /* EAGAIN / ECONNABORTED: next listen event retries */
+        }
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        weed_conn *c = calloc(1, sizeof(weed_conn));
+        if (c == NULL) {
+            close(fd);
+            continue;
+        }
+        c->fd = fd;
+        c->body_fd = -1;
+        c->ip[0] = '\0';
+        if (ss.ss_family == AF_INET) {
+            const struct sockaddr_in *a = (const struct sockaddr_in *)&ss;
+            const uint8_t *b = (const uint8_t *)&a->sin_addr;
+            snprintf(c->ip, sizeof(c->ip), "%u.%u.%u.%u", b[0], b[1], b[2],
+                     b[3]);
+            c->port = (int)ntohs(a->sin_port);
+        } else if (ss.ss_family == AF_INET6) {
+            const struct sockaddr_in6 *a6 = (const struct sockaddr_in6 *)&ss;
+            const uint8_t *b = (const uint8_t *)&a6->sin6_addr;
+            /* enough fidelity for logs/ACL checks on the data plane */
+            snprintf(c->ip, sizeof(c->ip),
+                     "%x:%x:%x:%x:%x:%x:%x:%x",
+                     (b[0] << 8) | b[1], (b[2] << 8) | b[3],
+                     (b[4] << 8) | b[5], (b[6] << 8) | b[7],
+                     (b[8] << 8) | b[9], (b[10] << 8) | b[11],
+                     (b[12] << 8) | b[13], (b[14] << 8) | b[15]);
+            c->port = (int)ntohs(a6->sin6_port);
+        }
+        /* link into LRU tail */
+        c->prev = lp->lru.prev;
+        c->next = &lp->lru;
+        lp->lru.prev->next = c;
+        lp->lru.prev = c;
+        c->last_ms = weed_now_ms();
+        struct epoll_event ev;
+        memset(&ev, 0, sizeof(ev));
+        ev.events = EPOLLIN | EPOLLRDHUP;
+        ev.data.ptr = c;
+        if (epoll_ctl(lp->epfd, EPOLL_CTL_ADD, fd, &ev) < 0) {
+            weed_lru_unlink(c);
+            close(fd);
+            free(c);
+        }
+    }
+}
+
+static void weed_expire_idle(weed_loop *lp) {
+    if (lp->idle_ms <= 0) return;
+    int64_t cutoff = weed_now_ms() - lp->idle_ms;
+    while (lp->lru.next != &lp->lru && lp->lru.next->last_ms < cutoff) {
+        weed_conn *c = lp->lru.next;
+        if (c->writing) {
+            /* EPOLLOUT cadence cannot prove drain progress: TCP only
+             * reports writable once the send queue falls below HALF
+             * full, so a client sipping a multi-MB buffered body sees
+             * zero events for whole idle windows.  send()/sendfile()
+             * have no such threshold — they accept bytes whenever ANY
+             * space exists — so probe by flushing: moved bytes = a
+             * live, draining client (flush_step touches the LRU);
+             * zero bytes across a full idle window = a true stall.
+             * A stalled writer therefore dies within two idle
+             * windows, mirroring the threaded arm's stall-retry
+             * sendall. */
+            if (weed_conn_flush_step(lp, c) < 0)
+                continue;  /* left the loop (done+closing, or dead) */
+            if (c->last_ms >= cutoff)
+                continue;  /* progressed (or completed): re-read next */
+        }
+        weed_conn_destroy(lp, c, 1);
+    }
+}
+
+/* tags for the two non-connection epoll registrations */
+static int weed_tag_listen;
+static int weed_tag_wake;
+
+/* Run the loop until a byte arrives on wake_fd.  Returns 0 on clean
+ * shutdown, -errno when setup fails.  listen_fd and wake_fd are NOT
+ * closed (the embedder owns them); every connection fd is. */
+static int weed_serve_loop(int listen_fd, int wake_fd, weed_serve_cbs *cbs,
+                           long idle_ms, long max_reqs) {
+    weed_loop lp;
+    memset(&lp, 0, sizeof(lp));
+    lp.listen_fd = listen_fd;
+    lp.wake_fd = wake_fd;
+    lp.cbs = cbs;
+    lp.idle_ms = idle_ms;
+    lp.max_reqs = max_reqs;
+    lp.lru.next = lp.lru.prev = &lp.lru;
+    lp.epfd = epoll_create1(EPOLL_CLOEXEC);
+    if (lp.epfd < 0) return -errno;
+
+    int fl = fcntl(listen_fd, F_GETFL, 0);
+    if (fl >= 0) fcntl(listen_fd, F_SETFL, fl | O_NONBLOCK);
+
+    struct epoll_event ev;
+    memset(&ev, 0, sizeof(ev));
+    ev.events = EPOLLIN;
+    ev.data.ptr = &weed_tag_listen;
+    if (epoll_ctl(lp.epfd, EPOLL_CTL_ADD, listen_fd, &ev) < 0) {
+        int e = errno;
+        close(lp.epfd);
+        return -e;
+    }
+    memset(&ev, 0, sizeof(ev));
+    ev.events = EPOLLIN;
+    ev.data.ptr = &weed_tag_wake;
+    if (epoll_ctl(lp.epfd, EPOLL_CTL_ADD, wake_fd, &ev) < 0) {
+        int e = errno;
+        close(lp.epfd);
+        return -e;
+    }
+
+    struct epoll_event events[WEED_SERVE_EVENTS];
+    while (!lp.stop) {
+        int timeout = -1;
+        if (lp.idle_ms > 0 && lp.lru.next != &lp.lru) {
+            int64_t dl = lp.lru.next->last_ms + lp.idle_ms - weed_now_ms();
+            timeout = dl < 0 ? 0 : (dl > 1000 ? 1000 : (int)dl);
+        }
+        if (lp.listen_paused_until_ms) {
+            int64_t dl = lp.listen_paused_until_ms - weed_now_ms();
+            if (dl <= 0) {
+                memset(&ev, 0, sizeof(ev));
+                ev.events = EPOLLIN;
+                ev.data.ptr = &weed_tag_listen;
+                epoll_ctl(lp.epfd, EPOLL_CTL_ADD, listen_fd, &ev);
+                lp.listen_paused_until_ms = 0;
+            } else if (timeout < 0 || dl < timeout) {
+                timeout = (int)dl;
+            }
+        }
+        int n = epoll_wait(lp.epfd, events, WEED_SERVE_EVENTS, timeout);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            break;
+        }
+        for (int i = 0; i < n && !lp.stop; i++) {
+            void *tag = events[i].data.ptr;
+            if (tag == &weed_tag_wake) {
+                char drain[64];
+                while (read(wake_fd, drain, sizeof(drain)) > 0) {}
+                lp.stop = 1;
+                break;
+            }
+            if (tag == &weed_tag_listen) {
+                weed_accept_drain(&lp);
+                continue;
+            }
+            weed_conn *c = (weed_conn *)tag;
+            uint32_t evs = events[i].events;
+            if (evs & (EPOLLERR | EPOLLHUP)) {
+                weed_conn_destroy(&lp, c, 1);
+                continue;
+            }
+            if (c->writing) {
+                if (evs & EPOLLOUT) weed_conn_flush_step(&lp, c);
+                continue;
+            }
+            if (evs & (EPOLLIN | EPOLLRDHUP)) weed_conn_read(&lp, c);
+        }
+        weed_expire_idle(&lp);
+    }
+
+    while (lp.lru.next != &lp.lru) weed_conn_destroy(&lp, lp.lru.next, 1);
+    close(lp.epfd);
+    return 0;
+}
+
+#endif /* WEED_SERVE_C */

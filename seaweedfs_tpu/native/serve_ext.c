@@ -1,0 +1,180 @@
+/* CPython binding for the epoll serving core (serve.c), the way
+ * needle_ext.c binds post.c.
+ *
+ * loop(listen_fd, wake_fd, resolver, handoff, complete,
+ *      idle_ms, max_reqs) -> None
+ *
+ * Runs the event loop with the GIL RELEASED; each callback re-takes it
+ * via PyGILState_Ensure for exactly as long as the Python call lasts:
+ *
+ *   resolver(path, range, head_only, trace)
+ *       -> None                        decline: hand the connection off
+ *        | (status, prefix_bytes, body_bytes|None,
+ *           fd, offset, count, close_fd, ctx)
+ *                                      fast path: the loop writes
+ *                                      prefix + Connection/Content-
+ *                                      Length tail + body (bytes, or
+ *                                      sendfile of count@offset from
+ *                                      fd); ctx rides to complete()
+ *   handoff(fd, pending_bytes, ip, port)
+ *                                      ownership of fd transfers; the
+ *                                      embedder re-parses `pending`
+ *                                      (the current head onward) in
+ *                                      the Python mini loop
+ *   complete(ctx, status, resp_bytes, t_parse, t_resolve, t_send, ok)
+ *                                      response finished (ok=False:
+ *                                      the connection died mid-write)
+ *
+ * The resolver's returned tuple is held alive (one reference) until
+ * complete() runs, which is what keeps the prefix/body buffers valid
+ * while the loop drains them; complete() is guaranteed exactly once
+ * per fast-path response, including on connection teardown and loop
+ * exit.  A resolver/complete/handoff exception is reported via
+ * sys.unraisablehook and degrades to decline/continue — a Python bug
+ * must never wedge the accept path.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include "serve.c"
+
+typedef struct {
+    PyObject *resolver;
+    PyObject *handoff;
+    PyObject *complete;
+} weed_glue;
+
+static PyObject *glue_str_or_none(const char *p, size_t n) {
+    if (p == NULL) Py_RETURN_NONE;
+    return PyUnicode_DecodeLatin1(p, (Py_ssize_t)n, "replace");
+}
+
+static int glue_resolve(void *vctx, const weed_req *req, weed_resp *resp,
+                        void **token) {
+    weed_glue *g = (weed_glue *)vctx;
+    PyGILState_STATE st = PyGILState_Ensure();
+    int rc = 0;
+    PyObject *path = PyUnicode_DecodeLatin1(req->path, (Py_ssize_t)req->path_len,
+                                            "replace");
+    PyObject *range = glue_str_or_none(req->range, req->range_len);
+    PyObject *trace = glue_str_or_none(req->trace, req->trace_len);
+    PyObject *r = NULL;
+    if (path != NULL && range != NULL && trace != NULL) {
+        r = PyObject_CallFunctionObjArgs(
+            g->resolver, path, range, req->head_only ? Py_True : Py_False,
+            trace, NULL);
+    }
+    Py_XDECREF(path);
+    Py_XDECREF(range);
+    Py_XDECREF(trace);
+    if (r == NULL) {
+        PyErr_WriteUnraisable(g->resolver);
+    } else if (r == Py_None) {
+        Py_DECREF(r);
+    } else {
+        int status = 0, fd = -1, close_fd = 0;
+        long long off = 0;
+        Py_ssize_t count = 0;
+        PyObject *prefix = NULL, *body = NULL, *ctx = NULL;
+        if (PyTuple_Check(r) &&
+            PyArg_ParseTuple(r, "iSOiLnpO:resolver", &status, &prefix, &body,
+                             &fd, &off, &count, &close_fd, &ctx) &&
+            (body == Py_None || PyBytes_Check(body))) {
+            resp->status = status;
+            resp->prefix = (const uint8_t *)PyBytes_AS_STRING(prefix);
+            resp->prefix_len = (size_t)PyBytes_GET_SIZE(prefix);
+            if (body != Py_None) {
+                resp->body = (const uint8_t *)PyBytes_AS_STRING(body);
+                resp->body_len = (size_t)PyBytes_GET_SIZE(body);
+            }
+            resp->fd = fd;
+            resp->off = (int64_t)off;
+            resp->count = count < 0 ? 0 : (size_t)count;
+            resp->close_fd = close_fd;
+            *token = r;  /* keeps prefix/body alive until complete() */
+            rc = 1;
+        } else {
+            PyErr_WriteUnraisable(g->resolver);
+            if (fd >= 0 && close_fd) close(fd);
+            Py_DECREF(r);
+        }
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+static void glue_handoff(void *vctx, int fd, const uint8_t *pending,
+                         size_t len, const char *ip, int port, long nreqs) {
+    weed_glue *g = (weed_glue *)vctx;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *r = PyObject_CallFunction(g->handoff, "iy#sil", fd,
+                                        (const char *)pending,
+                                        (Py_ssize_t)len, ip, port, nreqs);
+    if (r == NULL) {
+        /* the embedder never took ownership: close here or leak */
+        PyErr_WriteUnraisable(g->handoff);
+        close(fd);
+    } else {
+        Py_DECREF(r);
+    }
+    PyGILState_Release(st);
+}
+
+static void glue_complete(void *vctx, void *token, int status,
+                          size_t resp_bytes, double t_parse, double t_resolve,
+                          double t_send, int ok) {
+    weed_glue *g = (weed_glue *)vctx;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *tup = (PyObject *)token;
+    PyObject *ctx = PyTuple_GET_ITEM(tup, 7); /* borrowed */
+    PyObject *r = PyObject_CallFunction(
+        g->complete, "OindddO", ctx, status, (Py_ssize_t)resp_bytes, t_parse,
+        t_resolve, t_send, ok ? Py_True : Py_False);
+    if (r == NULL) PyErr_WriteUnraisable(g->complete);
+    else Py_DECREF(r);
+    Py_DECREF(tup);
+    PyGILState_Release(st);
+}
+
+static PyObject *py_loop(PyObject *Py_UNUSED(self), PyObject *args) {
+    int listen_fd, wake_fd;
+    PyObject *resolver, *handoff, *complete;
+    long idle_ms = 0, max_reqs = 0;
+    if (!PyArg_ParseTuple(args, "iiOOO|ll:loop", &listen_fd, &wake_fd,
+                          &resolver, &handoff, &complete, &idle_ms,
+                          &max_reqs))
+        return NULL;
+    if (!PyCallable_Check(resolver) || !PyCallable_Check(handoff) ||
+        !PyCallable_Check(complete)) {
+        PyErr_SetString(PyExc_TypeError, "callbacks must be callable");
+        return NULL;
+    }
+    weed_glue g = {resolver, handoff, complete};
+    weed_serve_cbs cbs;
+    memset(&cbs, 0, sizeof(cbs));
+    cbs.ctx = &g;
+    cbs.resolve = glue_resolve;
+    cbs.handoff = glue_handoff;
+    cbs.complete = glue_complete;
+    int rc;
+    Py_BEGIN_ALLOW_THREADS
+    rc = weed_serve_loop(listen_fd, wake_fd, &cbs, idle_ms, max_reqs);
+    Py_END_ALLOW_THREADS
+    if (rc < 0) {
+        errno = -rc;
+        return PyErr_SetFromErrno(PyExc_OSError);
+    }
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef methods[] = {
+    {"loop", py_loop, METH_VARARGS,
+     "run the epoll serving loop until wake_fd is written"},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_serve_ext", NULL, -1, methods,
+    NULL, NULL, NULL, NULL};
+
+PyMODINIT_FUNC PyInit__serve_ext(void) { return PyModule_Create(&moduledef); }

@@ -54,6 +54,9 @@ class S3ApiServer:
         iam: s3auth.IdentityAccessManagement | None = None,
         masters: list[str] | None = None,
         announce_interval: float = 10.0,
+        reuse_port: bool = False,
+        serve_idle_ms: int = 0,
+        serve_max_reqs: int = 0,
     ):
         self.filer = filer
         self.host = host
@@ -65,6 +68,12 @@ class S3ApiServer:
         # the cluster collector can scrape it)
         self.masters = list(masters or [])
         self.announce_interval = announce_interval
+        # `s3 -serveProcs N`: every process of the group binds the port
+        # with SO_REUSEPORT so the kernel spreads accepted connections
+        # (docs/SERVING.md); the keep-alive knobs ride to the loop
+        self.reuse_port = reuse_port
+        self.serve_idle_ms = serve_idle_ms
+        self.serve_max_reqs = serve_max_reqs
         self._announce: threading.Thread | None = None
         self._http_server: WeedHTTPServer | None = None
         self._channel: grpc.Channel | None = None
@@ -174,7 +183,15 @@ class S3ApiServer:
     # lifecycle
     def start(self) -> None:
         handler = self._handler_class()
-        self._http_server = WeedHTTPServer((self.host, self.port), handler)
+        if self.reuse_port:
+            from seaweedfs_tpu.util.httpd import ReusePortWeedHTTPServer
+
+            server_cls = ReusePortWeedHTTPServer
+        else:
+            server_cls = WeedHTTPServer
+        self._http_server = server_cls((self.host, self.port), handler)
+        self._http_server.serve_idle_ms = self.serve_idle_ms
+        self._http_server.serve_max_reqs = self.serve_max_reqs
         # tracing + metrics plane: span per request in the mini loop,
         # request counters/histograms under the "s3" label, and the
         # /metrics exposition the gateway previously lacked (served by

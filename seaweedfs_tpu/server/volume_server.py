@@ -119,6 +119,8 @@ class VolumeServer:
         n_writers: int = 1,
         scrub_interval: float = 600.0,
         scrub_rate_mb_s: float = 64.0,
+        serve_idle_ms: int = 0,
+        serve_max_reqs: int = 0,
     ):
         # `ec.codec` config: "cpu" | "native" | "tpu" | "" (auto: tpu
         # with a JAX device, else the native SIMD shim, else numpy).
@@ -212,6 +214,10 @@ class VolumeServer:
                 on_event=self._hb_wake.set,
                 node_label=self.store.node_label,
             )
+        # keep-alive housekeeping knobs for both serving loops
+        # (`-serveIdleMs`/`-serveMaxReqs`, docs/SERVING.md); 0 = off
+        self.serve_idle_ms = serve_idle_ms
+        self.serve_max_reqs = serve_max_reqs
         self.shard_writes = shard_writes
         self.n_writers = max(1, n_writers)
         self._shard_taken: set[int] = set()
@@ -1736,6 +1742,167 @@ class VolumeServer:
 
         return Handler
 
+    # ------------------------------------------------------------------
+    # zero-copy GET fast path (docs/SERVING.md): the C epoll loop calls
+    # this resolver for plain GET/HEAD requests; it maps a bare
+    # /<vid>,<fid> path to a pre-formatted response the loop finishes
+    # without ever entering do_GET — small records from one pread (CRC
+    # verified), large ones zero-copy via sendfile from a dup'd fd.
+    # Anything with richer semantics (query params, filename/extension
+    # segments, EC volumes, redirects, gzip/name/mime/ttl/pairs/
+    # chunk-manifest needles, conditional headers — those never reach
+    # here, the C loop hands them off) returns None and the request
+    # takes the threaded Python path, whose responses are byte-
+    # identical for everything this path does serve (the shared
+    # reply_prefix/parse_range helpers make that true by construction).
+    def _make_fast_resolver(self):
+        import os as _os
+
+        from seaweedfs_tpu.storage import types as t
+        from seaweedfs_tpu.storage.needle import (
+            FLAG_HAS_LAST_MODIFIED_DATE as _F_LM,
+            get_actual_size as _actual_size,
+        )
+        from seaweedfs_tpu.util.crc import crc32c as _crc32c, masked_value as _masked
+        from seaweedfs_tpu.util.http_range import (
+            RangeNotSatisfiable,
+            parse_range,
+        )
+        from seaweedfs_tpu.util.httpd import reply_prefix
+
+        find_volume = self.store.find_volume
+        shard_refresh = self._shard_refresh
+        tomb = t.TOMBSTONE_FILE_SIZE
+        prefix_404 = reply_prefix(404)
+        not_found = (404, prefix_404, b"", -1, 0, 0)
+        pread = _os.pread
+        dup = _os.dup
+        # records at or under this take the one-pread in-memory path
+        # (CRC verified, no fd duplication); larger go sendfile
+        small = 65536
+        octet = "application/octet-stream"
+
+        def resolver(path, rng, head_only):
+            if "?" in path:
+                return None
+            vid_s, fid_s, filename, ext, vid_only = parse_url_path(path)
+            if vid_only or not fid_s or filename or ext:
+                return None
+            try:
+                fid = parse_path_fid(vid_s, fid_s)
+            except ValueError:
+                return None  # Python's invalid-file-id 400 JSON
+            v = find_volume(fid.volume_id)
+            if v is None:
+                return None  # EC / redirect lookup: Python path
+            if v.version not in (2, 3):
+                return None
+            shard_refresh(v)
+            with v._lock:
+                fd = v._fd
+                if fd is None:
+                    return None  # remote-tier volume
+                nv = v.nm.get(fid.key)
+                if nv is None or nv.offset == 0 or nv.size == tomb:
+                    return not_found
+                size = nv.size
+                if size < 5:
+                    return None  # v2/v3 body is at least data_size+flags
+                off0 = nv.actual_offset
+                rec_len = _actual_size(size, v.version)
+                body_fd = -1
+                if rec_len <= small:
+                    blob = pread(fd, rec_len, off0)
+                    if len(blob) < 20 + size + 4:
+                        return None  # torn record: Python raises loudly
+                else:
+                    blob = pread(fd, 20, off0)
+                    if len(blob) < 20:
+                        return None
+                    body_fd = fd  # dup'd below once the record checks out
+                if blob[12:16] != size.to_bytes(4, "big"):
+                    return None  # .idx/.dat disagree: Python path decides
+                if int.from_bytes(blob[0:4], "big") != fid.cookie:
+                    return not_found  # CookieMismatch serves 404
+                data_len = int.from_bytes(blob[16:20], "big")
+                meta_len = size - 4 - data_len
+                if meta_len < 1:
+                    return None
+                if body_fd < 0:
+                    tail = blob[20 + data_len : 16 + size + 4]
+                else:
+                    tail = pread(fd, meta_len + 4, off0 + 20 + data_len)
+                    if len(tail) < meta_len + 4:
+                        return None
+                flags = tail[0]
+                if flags & ~_F_LM:
+                    return None  # gzip/name/mime/ttl/pairs/manifest
+                if meta_len != (6 if flags & _F_LM else 1):
+                    return None
+                stored = int.from_bytes(tail[meta_len : meta_len + 4], "big")
+                if body_fd < 0:
+                    data = blob[20 : 20 + data_len]
+                    crc = _crc32c(data)
+                    if _masked(crc) != stored:
+                        return None  # corrupt: the Python read raises
+                else:
+                    data = None
+                    # ETag is the RAW crc; the trailer stores the
+                    # LevelDB-masked value — rotl17+const, so invert
+                    rot = (stored - 0xA282EAD8) & 0xFFFFFFFF
+                    crc = ((rot >> 17) | (rot << 15)) & 0xFFFFFFFF
+                    body_fd = dup(fd)
+                    # the dup keeps the CURRENT .dat alive for the
+                    # sendfile even if a vacuum commit swaps the
+                    # volume's fd before the response drains
+            headers = {"ETag": f'"{crc:08x}"', "Content-Type": octet}
+            if flags & _F_LM:
+                headers["Last-Modified"] = _http_date(
+                    int.from_bytes(tail[1:6], "big")
+                )
+            headers["Accept-Ranges"] = "bytes"
+            if rng:
+                try:
+                    span = parse_range(rng.strip(), data_len)
+                except RangeNotSatisfiable:
+                    if body_fd >= 0:
+                        _os.close(body_fd)
+                    return (
+                        416,
+                        reply_prefix(
+                            416, {"Content-Range": f"bytes */{data_len}"}
+                        ),
+                        b"",
+                        -1,
+                        0,
+                        0,
+                    )
+                if span is not None:
+                    start, end = span
+                    headers["Content-Range"] = f"bytes {start}-{end}/{data_len}"
+                    if data is not None:
+                        return (
+                            206,
+                            reply_prefix(206, headers),
+                            data[start : end + 1],
+                            -1,
+                            0,
+                            0,
+                        )
+                    return (
+                        206,
+                        reply_prefix(206, headers),
+                        None,
+                        body_fd,
+                        off0 + 20 + start,
+                        end - start + 1,
+                    )
+            if data is not None:
+                return (200, reply_prefix(200, headers), data, -1, 0, 0)
+            return (200, reply_prefix(200, headers), None, body_fd, off0 + 20, data_len)
+
+        return resolver
+
     def _redirect_target(self, vid: int) -> str | None:
         """Another server that can serve this vid: a replica holder, or
         any EC shard holder learned from the master."""
@@ -1942,6 +2109,13 @@ class VolumeServer:
         # request, labeled with this daemon's role and address
         self._http_server.trace_name = "volume"
         self._http_server.trace_node = f"{self.host}:{self.port}"
+        # event-driven serving core (docs/SERVING.md): the epoll loop
+        # answers plain needle GETs through this resolver without
+        # touching the handler; the knobs bound keep-alive lifetimes on
+        # both serving paths
+        self._http_server.fast_resolver = self._make_fast_resolver()
+        self._http_server.serve_idle_ms = self.serve_idle_ms
+        self._http_server.serve_max_reqs = self.serve_max_reqs
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
         if self.internal_port:
             self._internal_server = WeedHTTPServer(
@@ -1949,6 +2123,9 @@ class VolumeServer:
             )
             self._internal_server.trace_name = "volume"
             self._internal_server.trace_node = f"{self.host}:{self.port}"
+            # no idle/max-req knobs here: the -workers proxy pool keeps
+            # long-lived internal connections by design
+            self._internal_server.fast_resolver = self._http_server.fast_resolver
             threading.Thread(
                 target=self._internal_server.serve_forever, daemon=True
             ).start()

@@ -207,12 +207,275 @@ def seed_keys(master: str, n: int, payload: bytes) -> list[tuple[str, str]]:
             raise RuntimeError(f"seed assign: {a['error']}")
         urllib.request.urlopen(
             urllib.request.Request(
-                f"http://{a['url']}/{a['fid']}", data=payload, method="POST"
+                f"http://{a['url']}/{a['fid']}", data=payload, method="POST",
+                # explicit octet-stream: urllib's default
+                # x-www-form-urlencoded would store a mime flag on the
+                # needle, and flagged needles decline the zero-copy GET
+                # fast path the serve bench exists to measure
+                headers={"Content-Type": "application/octet-stream"},
             ),
             timeout=10,
         ).close()
         keys.append((a["fid"], a["url"]))
     return keys
+
+
+def _get_fan_worker(spec: dict, out_q) -> None:
+    """One GET *fan* worker: K nonblocking keep-alive connections
+    driven by a single selector loop in this process — the client-side
+    shape for connection-scale serving benches (256+ concurrent
+    connections across a few processes, where thread-per-connection
+    clients would measure their own scheduler instead of the server).
+
+    Each connection is closed-loop (next GET only after the previous
+    response drains). With `rate` set, each connection paces against
+    its own fixed schedule and latency is charged from the SCHEDULED
+    send — the same coordinated-omission discipline as `_worker`. A
+    `range_every` of N makes every Nth request on a connection carry a
+    Range header cycling through `ranges` (mixed 200/206 traffic).
+
+    spec: mode='get_fan', duration_s, keys, conns, rate, index,
+    range_every, ranges."""
+    import selectors
+    import socket as _socket
+
+    keys = spec["keys"]
+    duration = spec["duration_s"]
+    rate = spec["rate"]
+    nconns = spec["conns"]
+    range_every = spec.get("range_every", 0)
+    ranges = spec.get("ranges") or ["bytes=0-127"]
+    interval = (1.0 / rate) if rate > 0 else 0.0
+    hist = LogHistogram()
+    ops = errors = nbytes = 0
+    err_samples: list[str] = []
+    sel = selectors.DefaultSelector()
+    start = time.perf_counter()
+    deadline = start + duration
+
+    class _Conn:
+        __slots__ = ("sock", "buf", "need", "t_ref", "scheduled", "ki",
+                     "nreq", "netloc", "inflight")
+
+    def _dial(netloc: str):
+        host, _, port = netloc.partition(":")
+        s = _socket.create_connection((host, int(port)), timeout=30)
+        s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, True)
+        s.setblocking(False)
+        return s
+
+    def _send(c, now: float) -> None:
+        fid, url = keys[c.ki % len(keys)]
+        c.ki += nconns  # stride: fan the keyset across the conns
+        c.nreq += 1
+        hdr = b""
+        if range_every and c.nreq % range_every == 0:
+            hdr = b"Range: " + ranges[c.nreq % len(ranges)].encode() + b"\r\n"
+        req = b"GET /" + fid.encode() + b" HTTP/1.1\r\n" + hdr + b"\r\n"
+        c.t_ref = c.scheduled if interval else now
+        c.buf = b""
+        c.need = -1
+        c.inflight = True
+        try:
+            # a ~60B request always fits an empty send buffer, and the
+            # closed loop guarantees the buffer IS empty here
+            c.sock.sendall(req)
+        except OSError:
+            pass  # the read side sees the teardown and redials
+
+    def _complete(c, now: float) -> bool:
+        """True once the buffered bytes hold one whole response."""
+        if c.need < 0:
+            end = c.buf.find(b"\r\n\r\n")
+            if end < 0:
+                return False
+            cl = 0
+            for line in c.buf[:end].split(b"\r\n")[1:]:
+                k, _, v = line.partition(b":")
+                if k.strip().lower() == b"content-length":
+                    cl = int(v.strip())
+            c.need = end + 4 + cl
+        return len(c.buf) >= c.need
+
+    conns: list = []
+    try:
+        for i in range(nconns):
+            c = _Conn()
+            c.netloc = keys[(spec.get("index", 0) + i) % len(keys)][1]
+            c.sock = _dial(c.netloc)
+            c.ki = spec.get("index", 0) + i
+            c.nreq = i  # desync the Range cadence across conns
+            c.buf = b""
+            c.need = -1
+            c.inflight = False
+            # stagger schedules so paced conns don't phase-lock
+            c.scheduled = start + (interval * i / nconns if interval else 0.0)
+            sel.register(c.sock, selectors.EVENT_READ, c)
+            conns.append(c)
+        now = time.perf_counter()
+        for c in conns:
+            if not interval or c.scheduled <= now:
+                _send(c, now)
+        while True:
+            now = time.perf_counter()
+            if now >= deadline:
+                break
+            events = sel.select(timeout=0.05)
+            now = time.perf_counter()
+            for key, _mask in events:
+                c = key.data
+                try:
+                    chunk = c.sock.recv(1 << 18)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError as e:
+                    chunk = b""
+                    if len(err_samples) < 5:
+                        err_samples.append(repr(e)[:200])
+                if not chunk:
+                    # torn connection: count the in-flight op lost,
+                    # then redial so concurrency holds
+                    if c.inflight:
+                        errors += 1
+                        hist.record(now - c.t_ref)
+                    sel.unregister(c.sock)
+                    c.sock.close()
+                    try:
+                        c.sock = _dial(c.netloc)
+                    except OSError:
+                        continue  # server gone: this conn retires
+                    sel.register(c.sock, selectors.EVENT_READ, c)
+                    c.inflight = False
+                    c.buf = b""
+                    c.need = -1
+                    if not interval:
+                        _send(c, now)
+                    continue
+                c.buf += chunk
+                if c.inflight and _complete(c, now):
+                    status = c.buf[9:12]
+                    if status in (b"200", b"206"):
+                        ops += 1
+                        nbytes += c.need
+                    else:
+                        errors += 1
+                        if len(err_samples) < 5:
+                            err_samples.append(
+                                c.buf[:80].decode("latin-1", "replace")
+                            )
+                    hist.record(now - c.t_ref)
+                    c.buf = c.buf[c.need :]
+                    c.need = -1
+                    c.inflight = False
+                    if interval:
+                        c.scheduled += interval
+                        if c.scheduled <= now:
+                            _send(c, now)  # behind schedule: CO charge
+                    else:
+                        _send(c, now)
+            if interval:
+                for c in conns:
+                    if not c.inflight and c.scheduled <= now:
+                        _send(c, now)
+    finally:
+        for c in conns:
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+        sel.close()
+    out_q.put({
+        "mode": "get",
+        "ops": ops,
+        "errors": errors,
+        "err_samples": err_samples,
+        "bytes": nbytes,
+        "hist": hist.to_row(),
+        "wall_s": time.perf_counter() - start,
+    })
+
+
+def run_get_fan(
+    master: str,
+    duration_s: float = 10.0,
+    processes: int = 4,
+    conns_per_proc: int = 64,
+    payload_bytes: int = 1024,
+    rate: float = 0.0,
+    seed_n: int = 64,
+    range_every: int = 0,
+    ranges: list[str] | None = None,
+    keys: list[tuple[str, str]] | None = None,
+    mp_start: str = "spawn",
+) -> dict:
+    """GET-heavy connection-scale load: `processes` × `conns_per_proc`
+    keep-alive connections in closed loop against the cluster at
+    `master`. `rate` is per-CONNECTION req/s (0 = unpaced
+    max-throughput probe; >0 = coordinated-omission-safe pacing).
+    Returns the same report shape as run_load (mode 'get')."""
+    payload = (b"weedload\x00\xff" * ((payload_bytes // 10) + 1))[:payload_bytes]
+    if keys is None:
+        keys = seed_keys(master, seed_n, payload)
+    ctx = multiprocessing.get_context(mp_start)
+    out_q = ctx.Queue()
+    procs = []
+    for i in range(processes):
+        spec = {
+            "mode": "get_fan",
+            "duration_s": duration_s,
+            "keys": keys,
+            "conns": conns_per_proc,
+            "rate": rate,
+            "index": i * 13,
+            "range_every": range_every,
+            "ranges": ranges or [],
+        }
+        p = ctx.Process(target=_get_fan_worker, args=(spec, out_q), daemon=True)
+        p.start()
+        procs.append(p)
+    import queue as _queue
+
+    rows = []
+    join_deadline = time.time() + duration_s + 90.0
+    while len(rows) < len(procs) and time.time() < join_deadline:
+        try:
+            rows.append(out_q.get(timeout=1.0))
+        except _queue.Empty:
+            if any(not p.is_alive() and p.exitcode != 0 for p in procs):
+                break
+    for p in procs:
+        p.join(timeout=10)
+        if p.is_alive():
+            p.terminate()
+    if len(rows) < len(procs):
+        raise RuntimeError(
+            f"weedload get_fan: only {len(rows)}/{len(procs)} workers "
+            f"reported (exit codes {[p.exitcode for p in procs]})"
+        )
+    hist = LogHistogram()
+    ops = errors = nbytes = 0
+    samples: list[str] = []
+    for r in rows:
+        hist.merge(LogHistogram.from_row(r["hist"]))
+        ops += r["ops"]
+        errors += r["errors"]
+        nbytes += r["bytes"]
+        samples.extend(r["err_samples"])
+    wall = max(r["wall_s"] for r in rows)
+    report = _summarize(hist, ops, errors, nbytes, wall)
+    report["err_samples"] = samples[:5]
+    report["config"] = {
+        "master": master,
+        "duration_s": duration_s,
+        "processes": processes,
+        "conns_per_proc": conns_per_proc,
+        "connections": processes * conns_per_proc,
+        "payload_bytes": payload_bytes,
+        "rate_per_conn": rate,
+        "range_every": range_every,
+        "coordinated_omission_safe": rate > 0,
+    }
+    return report
 
 
 def _summarize(hist: LogHistogram, ops: int, errors: int, nbytes: int,

@@ -24,6 +24,7 @@ from seaweedfs_tpu.trace.tracer import (
     inflight_payload,
     inject,
     inject_request,
+    loop_tracer,
     parse_header,
     reset,
     sample_every,
@@ -51,6 +52,7 @@ __all__ = [
     "inflight_payload",
     "inject",
     "inject_request",
+    "loop_tracer",
     "parse_header",
     "reset",
     "sample_every",

@@ -518,6 +518,65 @@ def connection_tracer(node: str):
     return open_span, close_span, sample_hit
 
 
+def loop_tracer(node: str):
+    """Span mint/close pair for the EVENT-DRIVEN serving loop (the C
+    epoll core, docs/SERVING.md): like connection_tracer, but
+    nesting-free. The epoll loop interleaves many in-flight requests
+    on ONE thread — open A, open B, close A through the thread's
+    context cell would corrupt the restore stack — so each fast-path
+    span rides its own throwaway cell and never becomes ambient
+    context. Fast-path GETs are leaf hops that make no further calls,
+    so nothing downstream needs the ambient span anyway; cross-hop
+    parentage still comes from the request's X-Weed-Trace header.
+
+    Returns `(open_span, close_span, sample_hit)`; open_span(name,
+    header, nbytes, t0) -> Span | None (tracing off)."""
+    node = node or _node_label
+    span_cls = Span
+    next_sid = _span_counter.__next__
+    next_tid = _trace_counter.__next__
+    parse = parse_header
+    ring = _ring
+    mask = _RING_MASK
+    ring_next = _ring_next
+    pc = time.perf_counter
+    next_sample = _sample_counter.__next__
+
+    def sample_hit() -> bool:
+        return _sample_every == 1 or next_sample() % _sample_every == 0
+
+    def open_span(name: str, header, nbytes: int, t0: float):
+        if not _ENABLED:
+            return None
+        tup = parse(header) if header else None
+        if tup is not None:
+            tid, parent_id, pl = tup
+        else:
+            tid = next_tid()
+            parent_id = ""
+            pl = PLANE_SERVE
+        cell = [None]
+        sp = span_cls(name, tid, next_sid(), parent_id, pl, node, nbytes, cell, t0)
+        sp._prev = None
+        cell[0] = sp
+        return sp
+
+    def close_span(sp, status: int) -> None:
+        sp.duration = d = pc() - sp.t0
+        sp.status = status
+        sp._cellref[0] = None
+        ring[ring_next() & mask] = sp
+        if sp.parent_id == "":
+            if d > _slow_floor:
+                _slow_insert(sp)
+            if _slow_threshold_ms > 0 and d * 1000.0 >= _slow_threshold_ms:
+                _slow_log(sp)
+        if not _drainer_started:
+            _start_drainer()
+
+    return open_span, close_span, sample_hit
+
+
 class _NullSpan:
     """Disabled-tracer stand-in: every method a no-op, `if sp:` False."""
 

@@ -78,6 +78,35 @@ class FastHeaders(dict):
         )
 
 
+def encode_headers(headers: dict) -> bytearray:
+    """Encode a header dict as the b"Name: value\\r\\n"... block, with
+    request-derived CR/LF stripped so a hostile value can never split a
+    response. The ONE header formatter: fast_reply uses it, and the
+    zero-copy GET resolvers (server.fast_resolver, docs/SERVING.md)
+    build their pre-formatted response prefixes through it — which is
+    what makes C-path and Python-path responses byte-identical by
+    construction, not by parallel maintenance."""
+    buf = bytearray()
+    for k, v in headers.items():
+        line = f"{k}: {v}"
+        if "\r" in line or "\n" in line:
+            line = line.replace("\r", "").replace("\n", "")
+        buf += line.encode("latin-1", "replace") + b"\r\n"
+    return buf
+
+
+def reply_prefix(status: int, headers: dict | None = None) -> bytes:
+    """Status line + headers for a response the EVENT LOOP will finish:
+    the C serving core appends the same `Connection: close` /
+    `Content-Length` tail fast_reply writes, so a resolver that builds
+    its prefix here yields responses byte-identical to the threaded
+    path serving the same request."""
+    buf = bytearray(b"HTTP/1.1 %d %s\r\n" % (status, _REASON.get(status, b"OK")))
+    if headers:
+        buf += encode_headers(headers)
+    return bytes(buf)
+
+
 class FastRequestMixin:
     """Marks a handler as data-plane: WeedHTTPServer drives it through
     the mini request loop (serve_connection) instead of the stdlib
@@ -101,13 +130,7 @@ class FastRequestMixin:
             if isinstance(headers, (bytes, bytearray)):
                 buf += headers
             else:
-                for k, v in headers.items():
-                    line = f"{k}: {v}"
-                    if "\r" in line or "\n" in line:
-                        # request-derived values (URL filenames, stored
-                        # pairs) must never split the response
-                        line = line.replace("\r", "").replace("\n", "")
-                    buf += line.encode("latin-1", "replace") + b"\r\n"
+                buf += encode_headers(headers)
         if self.close_connection:
             buf += b"Connection: close\r\n"
         buf += b"Content-Length: %d\r\n\r\n" % len(body)
@@ -172,9 +195,12 @@ class _BufReader:
 
     __slots__ = ("_sock", "_buf", "_pos", "consumed")
 
-    def __init__(self, sock):
+    def __init__(self, sock, initial: bytes = b""):
+        # `initial`: bytes already read off the socket by whoever owned
+        # the connection before (the C epoll loop hands a connection
+        # off WITH the unconsumed tail of its read buffer)
         self._sock = sock
-        self._buf = b""
+        self._buf = initial
         self._pos = 0
         self.consumed = 0
 
@@ -244,16 +270,47 @@ class _BufReader:
 
 class _SockWriter:
     """wfile facade: sendall semantics (a raw SocketIO.write may short-
-    write large bodies), no buffering to flush."""
+    write large bodies), no buffering to flush.
+
+    With `-serveIdleMs` arming a socket timeout, a plain sendall would
+    turn the IDLE timeout into a total-transfer deadline (CPython
+    computes ONE deadline for the whole call) and truncate big
+    downloads to slow-but-draining clients — worse, TCP only reports
+    *writable* once the send queue falls below half full, so even
+    per-chunk sendalls time out while the client is sipping a multi-MB
+    kernel buffer. send() itself has no such threshold: it accepts
+    bytes whenever ANY space exists. So on a timeout we retry the
+    send once — moved bytes mean a live client (keep going with a
+    fresh window); a zero-progress retry after a full idle window of
+    waiting is a true stall and raises. Mirrors the C loop's
+    idle-reaper drain probe (serve.c weed_conn_flush_step)."""
 
     __slots__ = ("_sock",)
+
+    _CHUNK = 1 << 18
 
     def __init__(self, sock):
         self._sock = sock
 
     def write(self, data) -> int:
-        self._sock.sendall(data)
-        return len(data)
+        n = len(data)
+        view = memoryview(data)
+        pos = 0
+        stalled = False
+        while pos < n:
+            try:
+                sent = self._sock.send(view[pos : pos + self._CHUNK])
+            except TimeoutError:
+                # the client freed no space for a whole idle window;
+                # one more zero-progress window confirms the stall
+                if stalled:
+                    raise
+                stalled = True
+                continue
+            if sent > 0:
+                pos += sent
+                stalled = False
+        return n
 
     def flush(self) -> None:
         pass
@@ -274,7 +331,9 @@ def _dispatch_table(handler_cls: type) -> dict:
     return table
 
 
-def serve_connection(sock, addr, server, handler_cls) -> None:
+def serve_connection(
+    sock, addr, server, handler_cls, initial: bytes = b"", initial_reqs: int = 0
+) -> None:
     """The mini per-connection request loop: replaces the
     socketserver → handle → handle_one_request → parse_request stack
     on every serving path. One handler object per connection (no
@@ -284,16 +343,34 @@ def serve_connection(sock, addr, server, handler_cls) -> None:
     drives the same do_GET/do_POST/... methods with the same surface
     (path/command/headers/rfile/wfile/client_address/close_connection,
     fast_reply, and the inherited stdlib send_response/send_header/
-    end_headers/send_error for the slow paths)."""
+    end_headers/send_error for the slow paths).
+
+    `initial` seeds the read buffer with bytes a previous owner of the
+    connection already consumed off the wire — the C epoll loop
+    (docs/SERVING.md) hands non-fast-path connections off here with
+    the current request head onward."""
     h = handler_cls.__new__(handler_cls)  # skip the stdlib per-request __init__
     h.server = server
     h.client_address = addr
     h.connection = sock
-    reader = _BufReader(sock)
+    reader = _BufReader(sock, initial)
     h.rfile = reader
     h.wfile = _SockWriter(sock)
     table = _dispatch_table(handler_cls)
     proto11 = handler_cls.protocol_version >= "HTTP/1.1"
+    # keep-alive housekeeping knobs (`-serveIdleMs` / `-serveMaxReqs`),
+    # honored identically by this loop and the C epoll loop: a socket
+    # timeout bounds idle keep-alive connections (the except arm below
+    # already treats TimeoutError as end-of-connection), and max_reqs
+    # closes after N responses (Connection: close on the Nth)
+    idle_ms = getattr(server, "serve_idle_ms", 0)
+    if idle_ms and idle_ms > 0:
+        try:
+            sock.settimeout(idle_ms / 1000.0)
+        except OSError:
+            return
+    max_reqs = getattr(server, "serve_max_reqs", 0) or 0
+    nreqs = initial_reqs  # responses a prior owner (the C loop) served
     # tracing/metrics identity is per-server, not per-request: resolve
     # it once per connection, and hoist every module/attribute the
     # traced dispatch touches into locals — the per-request cost of
@@ -359,12 +436,11 @@ def serve_connection(sock, addr, server, handler_cls) -> None:
             elif conn == "keep-alive":
                 close = False
             h.close_connection = close
-            if (
-                proto11
-                and version >= "HTTP/1.1"
-                and headers.get("expect", "").lower() == "100-continue"
-            ):
-                sock.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
+            nreqs += 1
+            if max_reqs and nreqs >= max_reqs:
+                # the Nth response carries Connection: close; set it
+                # BEFORE dispatch so fast_reply writes the header
+                h.close_connection = True
 
             method = table.get(command)
             if method is None:
@@ -382,6 +458,20 @@ def serve_connection(sock, addr, server, handler_cls) -> None:
                 return
             chunked = "chunked" in headers.get("transfer-encoding", "").lower()
             body_end = reader.consumed + length
+
+            # 100 Continue goes out only AFTER the request validates:
+            # a bad Content-Length (400 above), an unknown method
+            # (405), or an oversized head (431, in read_head) must
+            # reject the request outright — an interim 100 first would
+            # invite the client to stream a body this connection is
+            # about to slam the door on (and on a reused keep-alive
+            # connection would desync the error reply that follows)
+            if (
+                proto11
+                and version >= "HTTP/1.1"
+                and headers.get("expect", "").lower() == "100-continue"
+            ):
+                sock.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
 
             # tracing plane (docs/TRACING.md): the mini loop is the ONE
             # place every serving daemon's dispatch funnels through, so
@@ -512,7 +602,22 @@ def _bad_request(h, msg: str) -> None:
 
 
 class WeedHTTPServer(ThreadingHTTPServer):
-    request_queue_size = 256
+    # deep accept backlog: under a connection burst (256+ concurrent
+    # weedload workers) a shallow backlog drops SYNs into 1s/3s
+    # retransmission steps; the epoll loop drains it every listen event
+    request_queue_size = 1024
+
+    # keep-alive housekeeping knobs (`-serveIdleMs`/`-serveMaxReqs`),
+    # enforced by BOTH serving paths (C epoll loop + threaded mini
+    # loop); 0 = disabled
+    serve_idle_ms = 0
+    serve_max_reqs = 0
+
+    # zero-copy GET fast path (docs/SERVING.md): the owning daemon may
+    # install `fast_resolver(path, range, head_only) -> plan | None`
+    # before serve_forever; None means every request takes the handoff
+    # path into the threaded mini loop
+    fast_resolver = None
 
     def get_request(self):
         # TCP_NODELAY: keep-alive responses are written headers-then-
@@ -522,6 +627,25 @@ class WeedHTTPServer(ThreadingHTTPServer):
         sock, addr = super().get_request()
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, True)
         return sock, addr
+
+    def serve_forever(self, poll_interval=0.5):
+        # event-driven serving core (docs/SERVING.md): when the native
+        # epoll loop is built and WEED_NATIVE_SERVE != 0, it owns the
+        # accept/read/dispatch edge — fast-path GETs never leave C,
+        # everything else hands off into serve_connection threads.
+        # The threaded socketserver path below is the byte-identical
+        # fallback (and the kill switch's landing spot).
+        from seaweedfs_tpu.util import native_serve
+
+        if native_serve.try_serve_forever(self):
+            return
+        super().serve_forever(poll_interval)
+
+    def shutdown(self):
+        from seaweedfs_tpu.util import native_serve
+
+        if not native_serve.shutdown(self):
+            super().shutdown()
 
     def finish_request(self, request, client_address):
         # every in-repo serving path carries FastRequestMixin and rides
@@ -536,8 +660,17 @@ class WeedHTTPServer(ThreadingHTTPServer):
 
 
 class ReusePortWeedHTTPServer(WeedHTTPServer):
-    """SO_REUSEPORT listener for per-core worker processes sharing one
-    host:port (`volume -workers N`); every binder of the port must set
-    the option, so lead and workers use this same class."""
+    """SO_REUSEPORT listener for processes sharing one host:port
+    (`volume -workers N`, gateway `-serveProcs N`); every binder of the
+    port must set the option, so lead and workers use this same class.
 
-    allow_reuse_port = True
+    server_bind sets the option explicitly: socketserver only learned
+    `allow_reuse_port` in Python 3.11, so relying on the class attr
+    silently binds WITHOUT it on 3.10 — the second process then dies
+    with EADDRINUSE instead of sharing the accept load."""
+
+    allow_reuse_port = True  # honored natively on 3.11+
+
+    def server_bind(self):
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        super().server_bind()

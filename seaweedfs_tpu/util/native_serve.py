@@ -1,0 +1,246 @@
+"""Driver for the event-driven serving core (native/serve.c).
+
+`WeedHTTPServer.serve_forever` lands here first: when the `_serve_ext`
+extension is built and `WEED_NATIVE_SERVE` != 0, the server's accept/
+read/dispatch edge runs as ONE C epoll loop instead of a thread per
+connection —
+
+  * fast-path GET/HEAD requests (the owning daemon installed a
+    `server.fast_resolver`) are answered without leaving the loop:
+    the resolver maps the request to a pre-formatted response prefix
+    plus either small in-memory bytes or a (fd, offset, count)
+    sendfile plan, and the loop writes it zero-copy;
+  * every other request HANDS THE CONNECTION OFF: the loop transfers
+    the fd and its unconsumed buffer here, and the connection finishes
+    its life in a `serve_connection` thread — the same threaded mini
+    loop the kill switch falls back to, driving the same do_* handler
+    methods, so slow paths have exactly one implementation;
+  * per-response completion callbacks keep the tracing plane and the
+    /metrics counters identical to the threaded path: a span per
+    traced request (named `<role>.get`/`<role>.head`), stage timings
+    parse/resolve/send attached the way the C POST span attaches
+    parse/assemble/crc/pwrite/reply, and the
+    weed_http_request_* counter/histogram labeled as ever.
+
+Kill switch: WEED_NATIVE_SERVE=0 (or an unbuilt extension, or a
+non-Linux host) restores the pure-Python threaded path wholesale.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+try:
+    from seaweedfs_tpu.native import serve_ext as _serve_ext
+except ImportError:  # pragma: no cover - no compiler on host
+    _serve_ext = None
+if _serve_ext is not None and not hasattr(_serve_ext, "loop"):
+    _serve_ext = None  # stale artifact without the loop entry
+
+NATIVE_SERVE_ENABLED = os.environ.get("WEED_NATIVE_SERVE", "1") != "0"
+
+# Stage names attached to a fast-path GET span — the serving-loop
+# counterpart of write_path.WRITE_STAGES (docs/TRACING.md): parse is
+# the C head parse, resolve the Python needle lookup, send the
+# header write + sendfile drain.
+SERVE_STAGES = ("parse", "resolve", "send")
+
+
+def available() -> bool:
+    """True when the epoll serving core can run in this process."""
+    return _serve_ext is not None and NATIVE_SERVE_ENABLED
+
+
+def try_serve_forever(server) -> bool:
+    """Run `server`'s accept loop on the C epoll core. False = caller
+    should use the threaded socketserver path (extension missing, kill
+    switch set, or the loop failed to start)."""
+    # per-server opt-out: embedders (and the serve fuzzer's threaded
+    # control arm) can pin one server to the threaded path while the
+    # process default stays native
+    if not available() or not getattr(server, "native_serve", True):
+        return False
+    try:
+        wake_r, wake_w = os.pipe()
+    except OSError:
+        return False
+    os.set_blocking(wake_r, False)
+    done = threading.Event()
+    # _serve_native stays True for the server's LIFETIME (not just
+    # while the loop runs): a second shutdown() — double stop()s are
+    # normal in teardown paths — must be a no-op here, never fall
+    # through to socketserver.shutdown(), which would wait forever on
+    # an __is_shut_down event the stdlib loop (which never ran) will
+    # never set
+    server._serve_native = True
+    server._serve_wake_w = wake_w
+    server._serve_done = done
+    resolve, handoff, complete = _callbacks(server)
+    try:
+        _serve_ext.loop(
+            server.socket.fileno(),
+            wake_r,
+            resolve,
+            handoff,
+            complete,
+            int(getattr(server, "serve_idle_ms", 0) or 0),
+            int(getattr(server, "serve_max_reqs", 0) or 0),
+        )
+    except (OSError, ValueError):
+        # loop setup failed (epoll exhausted, listen fd gone): fall
+        # back to the threaded path for the life of this server — and
+        # route future shutdown() calls back to socketserver's
+        server._serve_native = False
+        server._serve_wake_w = None
+        done.set()
+        try:
+            os.close(wake_w)
+        finally:
+            os.close(wake_r)
+        return False
+    done.set()
+    os.close(wake_r)
+    # wake_w stays open until shutdown() (a shutdown racing loop exit
+    # must still have a valid fd to write); server_close is too late
+    # only for the exotic never-shutdown case, which leaks one pipe fd
+    # per server object — the lifecycle tier's accounting below keeps
+    # the normal path clean.
+    return True
+
+
+def shutdown(server) -> bool:
+    """Stop a native serve loop. False = this server never ran the
+    native loop (caller should run the stdlib shutdown). Idempotent:
+    a repeated shutdown of a native server returns True and does
+    nothing."""
+    if not getattr(server, "_serve_native", False):
+        return False
+    wake_w = getattr(server, "_serve_wake_w", None)
+    if wake_w is None:
+        return True  # already shut down (or the loop already exited)
+    try:
+        os.write(wake_w, b"x")
+    except OSError:
+        pass  # loop already gone
+    server._serve_done.wait(5.0)
+    server._serve_wake_w = None
+    try:
+        os.close(wake_w)
+    except OSError:
+        pass
+    return True
+
+
+def _callbacks(server):
+    """Build the (resolve, handoff, complete) trio around `server`.
+    Everything the per-request path touches is hoisted into closure
+    locals — the loop thread should read its own warm frame, not
+    chase module attributes (the docs/TRACING.md cold-line rule)."""
+    from seaweedfs_tpu import trace as _trace
+    from seaweedfs_tpu.stats.metrics import (
+        HTTP_REQUEST_COUNTER,
+        HTTP_REQUEST_HISTOGRAM,
+    )
+    from seaweedfs_tpu.util.httpd import serve_connection
+
+    handler_cls = server.RequestHandlerClass
+    trace_label = getattr(server, "trace_name", "")
+    trace_node = getattr(server, "trace_node", "")
+    open_span, close_span, sample_hit = _trace.loop_tracer(trace_node)
+    trace_enabled = _trace.enabled
+    hist_observe = HTTP_REQUEST_HISTOGRAM.observe
+    counter_labels = HTTP_REQUEST_COUNTER.labels
+    get_name = f"{trace_label or 'http'}.get"
+    head_name = f"{trace_label or 'http'}.head"
+    import time as _time
+
+    clock = _time.perf_counter
+
+    def resolve(path, rng, head_only, trace_hdr):
+        # `fast_resolver` is re-read per request: the volume server
+        # installs it before serve_forever, but a daemon that never
+        # does simply declines everything (gateways)
+        fr = server.fast_resolver
+        if fr is None:
+            return None
+        plan = fr(path, rng, head_only)
+        if plan is None:
+            return None
+        status, prefix, body, fd, off, count = plan
+        sp = None
+        if trace_enabled() and (trace_hdr or sample_hit()):
+            sp = open_span(
+                head_name if head_only else get_name,
+                trace_hdr or None,
+                0,
+                clock(),
+            )
+        return (
+            status,
+            prefix,
+            body,
+            fd,
+            off,
+            count,
+            fd >= 0,  # the loop closes the per-request dup'd fd
+            (sp, "HEAD" if head_only else "GET"),
+        )
+
+    def handoff(fd, pending, ip, port, nreqs):
+        # once socket() succeeds the fd has an owner whose destructor
+        # closes it — from that point NOTHING may propagate to the C
+        # glue, whose error path close(fd) would double-close a number
+        # a concurrent thread may already have reused (a raise BEFORE
+        # ownership is fine: the glue's close is then the only one)
+        sock = socket.socket(fileno=fd)
+        try:
+            sock.setblocking(True)
+            threading.Thread(
+                target=_drive_handoff,
+                args=(sock, (ip, port), server, handler_cls, pending, nreqs),
+                daemon=True,
+                name="weed-serve-handoff",
+            ).start()
+        except Exception as e:  # thread exhaustion under extreme load
+            from seaweedfs_tpu.util import wlog
+
+            wlog.warning("serve handoff dropped %s:%s: %s", ip, port, e)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _drive_handoff(sock, addr, srv, cls, pending, nreqs):
+        try:
+            # initial_reqs: responses the C loop already served on this
+            # connection — -serveMaxReqs keeps counting, not restarts
+            serve_connection(
+                sock, addr, srv, cls, initial=pending, initial_reqs=nreqs
+            )
+        finally:
+            try:
+                sock.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+            sock.close()
+
+    def complete(ctx, status, nbytes, t_parse, t_resolve, t_send, ok):
+        sp, cmd = ctx
+        if sp is not None:
+            sp.add_stages(
+                {"parse": t_parse, "resolve": t_resolve, "send": t_send}
+            )
+            if not ok and not sp.error:
+                sp.error = "connection lost mid-response"
+            close_span(sp, status)
+        if trace_label:
+            hist_observe(
+                sp.duration if sp is not None else t_resolve + t_send,
+                trace_label,
+                cmd,
+            )
+            counter_labels(trace_label, cmd, str(status)).inc()
+
+    return resolve, handoff, complete

@@ -38,6 +38,9 @@ class WebDavServer:
         root: str = "/",
         masters: list[str] | None = None,
         announce_interval: float = 10.0,
+        reuse_port: bool = False,
+        serve_idle_ms: int = 0,
+        serve_max_reqs: int = 0,
     ):
         self.filer = filer
         self.host = host
@@ -47,6 +50,11 @@ class WebDavServer:
         # cluster collector can scrape it (empty = no announce)
         self.masters = list(masters or [])
         self.announce_interval = announce_interval
+        # `webdav -serveProcs N`: SO_REUSEPORT accept-process group +
+        # keep-alive knobs (docs/SERVING.md)
+        self.reuse_port = reuse_port
+        self.serve_idle_ms = serve_idle_ms
+        self.serve_max_reqs = serve_max_reqs
         self._announce: threading.Thread | None = None
         self._http_server: WeedHTTPServer | None = None
         self._channel: grpc.Channel | None = None
@@ -89,9 +97,17 @@ class WebDavServer:
             return []
 
     def start(self) -> None:
-        self._http_server = WeedHTTPServer(
+        if self.reuse_port:
+            from seaweedfs_tpu.util.httpd import ReusePortWeedHTTPServer
+
+            server_cls = ReusePortWeedHTTPServer
+        else:
+            server_cls = WeedHTTPServer
+        self._http_server = server_cls(
             (self.host, self.port), self._handler_class()
         )
+        self._http_server.serve_idle_ms = self.serve_idle_ms
+        self._http_server.serve_max_reqs = self.serve_max_reqs
         # tracing + metrics plane: span per request, request counters/
         # histograms under "webdav", and /metrics exposition (the
         # gateway exposed nothing before)

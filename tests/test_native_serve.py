@@ -1,0 +1,424 @@
+"""The event-driven serving core (native/serve.c + util/native_serve):
+C-loop-vs-threaded byte identity, the zero-copy GET fast path, Range
+semantics through both arms, the keep-alive housekeeping knobs, the
+kill switch, and the SO_REUSEPORT bind fix.
+
+Identity is tested the way the serve fuzzer tests it: one volume
+store, two live servers (one on the epoll loop, one pinned threaded),
+the same bytes down both sockets, every response byte diffed.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.analysis import fuzz_serve
+from seaweedfs_tpu.util import native_serve
+
+pytestmark = pytest.mark.skipif(
+    not native_serve.available(),
+    reason="no C toolchain / non-Linux: native serve loop unavailable",
+)
+
+
+@pytest.fixture(scope="module")
+def pair(tmp_path_factory):
+    p = fuzz_serve.ServePair(str(tmp_path_factory.mktemp("servepair")))
+    yield p
+    p.close()
+
+
+def _roundtrip(port: int, payload: bytes, deadline: float = 5.0) -> bytes:
+    return fuzz_serve.drive(port, {"fragments": [payload]}, deadline)
+
+
+def _both(pair, payload: bytes) -> tuple[bytes, bytes]:
+    return (
+        _roundtrip(pair.c_port, payload),
+        _roundtrip(pair.py_port, payload),
+    )
+
+
+class TestByteIdentity:
+    def test_plain_get_fast_path_hit(self, pair):
+        """The C arm must actually serve this from the resolver (not
+        via handoff): probe by swapping in a counting resolver."""
+        hits = []
+        srv = pair.servers[0]
+        orig = srv.fast_resolver
+
+        def counting(path, rng, head_only):
+            plan = orig(path, rng, head_only)
+            hits.append(plan is not None)
+            return plan
+
+        srv.fast_resolver = counting
+        try:
+            req = f"GET /{pair.fids['small']} HTTP/1.1\r\n\r\n".encode()
+            c, py = _both(pair, req)
+        finally:
+            srv.fast_resolver = orig
+        assert c == py
+        assert b"200 OK" in c and b"ETag" in c
+        assert hits == [True]
+
+    @pytest.mark.parametrize(
+        "shape", ["small", "tiny", "empty", "big", "edge64k", "named",
+                  "deleted", "missing", "badcookie"]
+    )
+    def test_get_identity_per_shape(self, pair, shape):
+        req = f"GET /{pair.fids[shape]} HTTP/1.1\r\n\r\n".encode()
+        c, py = _both(pair, req)
+        assert c == py
+
+    def test_head_identity(self, pair):
+        req = f"HEAD /{pair.fids['big']} HTTP/1.1\r\n\r\n".encode()
+        c, py = _both(pair, req)
+        assert c == py
+        assert b"Content-Length: 100000" in c and len(c) < 400
+
+    def test_pipelined_identity(self, pair):
+        req = (
+            f"GET /{pair.fids['small']} HTTP/1.1\r\n\r\n"
+            f"GET /{pair.fids['missing']} HTTP/1.1\r\n\r\n"
+            f"GET /{pair.fids['big']} HTTP/1.1\r\nRange: bytes=0-9\r\n\r\n"
+        ).encode()
+        c, py = _both(pair, req)
+        assert c == py
+        assert c.count(b"HTTP/1.1 ") == 3
+
+    def test_fragmented_head_identity(self, pair):
+        raw = f"GET /{pair.fids['small']} HTTP/1.1\r\nRange: bytes=1-5\r\n\r\n".encode()
+        frags = [raw[i : i + 7] for i in range(0, len(raw), 7)]
+        c = fuzz_serve.drive(pair.c_port, {"fragments": frags})
+        py = fuzz_serve.drive(pair.py_port, {"fragments": frags})
+        assert c == py and b"206" in c
+
+    def test_http10_connection_close_identity(self, pair):
+        req = f"GET /{pair.fids['tiny']} HTTP/1.0\r\n\r\n".encode()
+        c, py = _both(pair, req)
+        assert c == py
+        assert b"Connection: close" in c
+
+
+class TestRangeCorrectness:
+    """Satellite: util/http_range.parse_range semantics exercised
+    end-to-end through BOTH serving paths (suffix, out-of-bounds→416,
+    multi-byte offsets, open-ended), byte-identical."""
+
+    @pytest.mark.parametrize(
+        "rng",
+        [
+            "bytes=0-0",          # first byte
+            "bytes=100-199",      # interior run
+            "bytes=-100",         # suffix
+            "bytes=-1",           # one-byte suffix
+            "bytes=-999999",      # suffix larger than the body: whole body
+            "bytes=699-",         # open-ended to EOF
+            "bytes=650-100000",   # end clamped to EOF
+            "bytes=700-",         # start == total: 416
+            "bytes=999999-",      # far out of bounds: 416
+            "bytes=5-2",          # inverted: 416
+            "bytes=abc",          # malformed: 416
+            "bytes=",             # empty spec: 416
+            "bytes=0-99,200-299", # multi-range: first range only
+            "bits=0-1",           # non-bytes unit: full 200
+        ],
+    )
+    def test_range_identity(self, pair, rng):
+        req = (
+            f"GET /{pair.fids['small']} HTTP/1.1\r\nRange: {rng}\r\n\r\n"
+        ).encode()
+        c, py = _both(pair, req)
+        assert c == py
+
+    def test_suffix_range_bytes(self, pair):
+        req = f"GET /{pair.fids['small']} HTTP/1.1\r\nRange: bytes=-100\r\n\r\n".encode()
+        c, _ = _both(pair, req)
+        head, _, body = c.partition(b"\r\n\r\n")
+        assert b"206" in head.split(b"\r\n")[0]
+        assert b"Content-Range: bytes 600-699/700" in head
+        assert len(body) == 100
+
+    def test_out_of_bounds_416_contract(self, pair):
+        req = f"GET /{pair.fids['small']} HTTP/1.1\r\nRange: bytes=700-\r\n\r\n".encode()
+        c, py = _both(pair, req)
+        assert c == py
+        assert c.startswith(b"HTTP/1.1 416 ")
+        assert b"Content-Range: bytes */700" in c
+
+    def test_multi_byte_offset_slices_match_store(self, pair):
+        """The sendfile window must hit the exact data bytes: pull
+        three disjoint slices of the 100 KB needle and splice them
+        against the full body."""
+        full = _roundtrip(
+            pair.c_port, f"GET /{pair.fids['big']} HTTP/1.1\r\n\r\n".encode()
+        ).partition(b"\r\n\r\n")[2]
+        assert len(full) == 100_000
+        for start, end in [(0, 0), (65_535, 65_537), (99_998, 99_999)]:
+            req = (
+                f"GET /{pair.fids['big']} HTTP/1.1\r\n"
+                f"Range: bytes={start}-{end}\r\n\r\n"
+            ).encode()
+            c, py = _both(pair, req)
+            assert c == py
+            body = c.partition(b"\r\n\r\n")[2]
+            assert body == full[start : end + 1]
+
+
+class TestCorpusAndFuzz:
+    def test_serve_corpus_is_seeded(self):
+        assert len(_corpus_entries()) >= 12, (
+            "tests/corpus/serve/ lost entries; re-seed with "
+            "`python -m seaweedfs_tpu.analysis.fuzz_serve --seed-corpus`"
+        )
+
+    @pytest.mark.parametrize("name", sorted(
+        p for p in os.listdir(
+            os.path.join(os.path.dirname(__file__), "corpus", "serve")
+        ) if p.endswith(".json")
+    ) if os.path.isdir(
+        os.path.join(os.path.dirname(__file__), "corpus", "serve")
+    ) else [])
+    def test_corpus_entry_identity(self, pair, name):
+        path = os.path.join(
+            os.path.dirname(__file__), "corpus", "serve", name
+        )
+        with open(path, encoding="utf-8") as f:
+            case = fuzz_serve.case_from_json(f.read())
+        divergence = fuzz_serve.run_case(pair, case)
+        assert divergence is None, f"{name}: {divergence}"
+
+    def test_fresh_fuzz_round(self, tmp_path):
+        report = fuzz_serve.run(
+            iterations=20, seed=4321, corpus_dir=str(tmp_path / "corpus")
+        )
+        assert report.iterations == 20
+        assert not report.divergences, report.divergences
+
+
+def _corpus_entries() -> list[str]:
+    d = os.path.join(os.path.dirname(__file__), "corpus", "serve")
+    if not os.path.isdir(d):
+        return []
+    return [p for p in os.listdir(d) if p.endswith(".json")]
+
+
+class TestKnobs:
+    @pytest.mark.parametrize("arm", ["c", "py"])
+    def test_idle_timeout_closes_connection(self, tmp_path, arm):
+        p = fuzz_serve.ServePair(str(tmp_path / arm), serve_idle_ms=300)
+        try:
+            port = p.c_port if arm == "c" else p.py_port
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            req = f"GET /{p.fids['tiny']} HTTP/1.1\r\n\r\n".encode()
+            s.sendall(req)
+            time.sleep(0.1)
+            first = s.recv(65536)
+            assert b"200 OK" in first
+            # idle past the knob: the server must close, not hold the fd
+            s.settimeout(5)
+            t0 = time.monotonic()
+            assert s.recv(64) == b""
+            assert time.monotonic() - t0 < 4
+            s.close()
+        finally:
+            p.close()
+
+    @pytest.mark.parametrize("arm", ["c", "py"])
+    def test_max_reqs_closes_with_connection_close(self, tmp_path, arm):
+        p = fuzz_serve.ServePair(str(tmp_path / arm), serve_max_reqs=2)
+        try:
+            port = p.c_port if arm == "c" else p.py_port
+            req = f"GET /{p.fids['tiny']} HTTP/1.1\r\n\r\n".encode()
+            out = fuzz_serve.drive(port, {"fragments": [req * 3]})
+            # request 1 plain, request 2 carries Connection: close,
+            # request 3 is never served
+            assert out.count(b"HTTP/1.1 200 OK") == 2
+            assert out.count(b"Connection: close") == 1
+        finally:
+            p.close()
+
+    @pytest.mark.parametrize("arm", ["c", "py"])
+    def test_idle_timeout_spares_slow_draining_download(self, tmp_path, arm):
+        """Regression (review finding): -serveIdleMs is an IDLE bound,
+        not a total-transfer deadline — a client draining a large body
+        slower than body/idle_ms must still receive every byte. The
+        blob must be big enough to outsize the kernel's socket buffers
+        or the server never enters the partial-write state."""
+        import random
+
+        from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+        from seaweedfs_tpu.storage.needle import Needle
+
+        total = 8 << 20
+        p = fuzz_serve.ServePair(str(tmp_path / arm), serve_idle_ms=400)
+        try:
+            v = p.vs.store.find_volume(1)
+            n = Needle(
+                cookie=0x99999999, id=50,
+                data=random.Random(9).randbytes(total),
+            )
+            n.last_modified = 1_700_000_050
+            n.set_has_last_modified_date()
+            v.write_needle(n)
+            fid = f"1,{format_needle_id_cookie(50, 0x99999999)}"
+            port = p.c_port if arm == "c" else p.py_port
+            s = socket.socket()
+            # small windows BEFORE connect, so the server cannot park
+            # the whole body in kernel buffers and skip the slow drain
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 32768)
+            s.settimeout(10)
+            s.connect(("127.0.0.1", port))
+            s.sendall(f"GET /{fid} HTTP/1.1\r\n\r\n".encode())
+            got = 0
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                try:
+                    chunk = s.recv(65536)
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                got += len(chunk)
+                time.sleep(0.02)  # ~3 MB/s: idle knob expires 8x over
+            s.close()
+            # headers ride in the first chunk; bound the body total
+            assert got >= total, (
+                f"slow download truncated at {got}/{total}+head [{arm}]"
+            )
+        finally:
+            p.close()
+
+    def test_max_reqs_identical_bytes_both_arms(self, tmp_path):
+        p = fuzz_serve.ServePair(str(tmp_path), serve_max_reqs=2)
+        try:
+            req = f"GET /{p.fids['small']} HTTP/1.1\r\n\r\n".encode() * 4
+            c = fuzz_serve.drive(p.c_port, {"fragments": [req]})
+            py = fuzz_serve.drive(p.py_port, {"fragments": [req]})
+            assert c == py
+        finally:
+            p.close()
+
+
+class TestKillSwitch:
+    def test_native_serve_env_kill_switch(self, tmp_path, monkeypatch):
+        """WEED_NATIVE_SERVE=0 must land every server on the threaded
+        path (try_serve_forever declines)."""
+        monkeypatch.setattr(native_serve, "NATIVE_SERVE_ENABLED", False)
+        p = fuzz_serve.ServePair(str(tmp_path))
+        try:
+            assert getattr(p.servers[0], "_serve_wake_w", None) is None
+            req = f"GET /{p.fids['small']} HTTP/1.1\r\n\r\n".encode()
+            out = fuzz_serve.drive(p.c_port, {"fragments": [req]})
+            assert b"200 OK" in out and out.partition(b"\r\n\r\n")[2]
+        finally:
+            p.close()
+
+    def test_double_shutdown_is_idempotent(self, tmp_path):
+        """Regression: stop()ing a native server twice (normal in
+        teardown paths — a failover test stops the leader, then the
+        fixture stops every master) must not fall through to
+        socketserver.shutdown(), which waits forever on an
+        __is_shut_down event the stdlib loop (which never ran) will
+        never set."""
+        p = fuzz_serve.ServePair(str(tmp_path))
+        try:
+            srv = p.servers[0]
+            srv.shutdown()
+            done = threading.Event()
+
+            def second():
+                srv.shutdown()
+                done.set()
+
+            threading.Thread(target=second, daemon=True).start()
+            assert done.wait(5), "second shutdown() deadlocked"
+        finally:
+            p.close()
+
+    def test_native_arm_is_actually_native(self, pair):
+        """The positive control for the kill-switch test: the C arm
+        carries the loop's wake pipe, the threaded arm does not."""
+        assert getattr(pair.servers[0], "_serve_wake_w", None) is not None
+        assert getattr(pair.servers[1], "_serve_wake_w", None) is None
+
+
+class TestReusePort:
+    def test_two_listeners_share_one_port(self):
+        """Regression for the 3.10 allow_reuse_port no-op: two
+        ReusePortWeedHTTPServer binds on one port must BOTH come up
+        (socketserver only honors the class attr on 3.11+; server_bind
+        sets SO_REUSEPORT explicitly)."""
+        from seaweedfs_tpu.util.httpd import FastHandler, ReusePortWeedHTTPServer
+
+        class H(FastHandler):
+            def do_GET(self):
+                self.fast_reply(200, str(os.getpid()).encode())
+
+        a = ReusePortWeedHTTPServer(("127.0.0.1", 0), H)
+        port = a.server_address[1]
+        b = ReusePortWeedHTTPServer(("127.0.0.1", port), H)
+        for s in (a, b):
+            threading.Thread(target=s.serve_forever, daemon=True).start()
+        time.sleep(0.1)
+        out = _roundtrip(port, b"GET / HTTP/1.1\r\n\r\n")
+        assert b"200 OK" in out
+        for s in (a, b):
+            s.shutdown()
+            s.server_close()
+
+
+class TestExpectValidationOrder:
+    """Satellite regression: 100 Continue must not be sent before the
+    request validates (bad Content-Length, unknown method)."""
+
+    def _exchange(self, pair, payload: bytes) -> bytes:
+        return _roundtrip(pair.py_port, payload)
+
+    def test_bad_content_length_rejects_without_100(self, pair):
+        out = self._exchange(
+            pair,
+            b"POST /1,00000000 HTTP/1.1\r\nExpect: 100-continue\r\n"
+            b"Content-Length: abc\r\n\r\n",
+        )
+        assert b"100 Continue" not in out
+        assert b"400" in out.split(b"\r\n", 1)[0]
+
+    def test_unknown_method_rejects_without_100(self, pair):
+        out = self._exchange(
+            pair,
+            b"BREW /x HTTP/1.1\r\nExpect: 100-continue\r\n"
+            b"Content-Length: 4\r\n\r\n",
+        )
+        assert b"100 Continue" not in out
+        assert b"405" in out.split(b"\r\n", 1)[0]
+
+    def test_valid_expect_still_gets_100(self, pair):
+        s = socket.create_connection(("127.0.0.1", pair.py_port), timeout=5)
+        try:
+            s.sendall(
+                b"GET /status HTTP/1.1\r\nExpect: 100-continue\r\n"
+                b"Content-Length: 0\r\n\r\n"
+            )
+            buf = b""
+            end = time.monotonic() + 5
+            while b"\r\n\r\n" not in buf and time.monotonic() < end:
+                buf += s.recv(4096)
+            assert buf.startswith(b"HTTP/1.1 100 Continue\r\n\r\n")
+        finally:
+            s.close()
+
+    def test_both_arms_identical_on_expect_abuse(self, pair):
+        payload = (
+            b"POST /1,00000000 HTTP/1.1\r\nExpect: 100-continue\r\n"
+            b"Content-Length: oops\r\n\r\n"
+        )
+        c = _roundtrip(pair.c_port, payload)
+        py = _roundtrip(pair.py_port, payload)
+        assert c == py
