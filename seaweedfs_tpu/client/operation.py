@@ -369,9 +369,17 @@ def http_call(
     headers: dict | None = None,
     timeout: float = 30.0,
     max_redirects: int = 3,
+    shed_retries: int = 2,
 ) -> tuple[int, dict, bytes]:
     """Keep-alive request; returns (status, headers, body). Follows
-    redirects (volume read-redirect 302s). `url` may omit the scheme."""
+    redirects (volume read-redirect 302s). `url` may omit the scheme.
+
+    QoS plane (docs/QOS.md): a 503 carrying Retry-After is admission
+    control shedding load, NOT a dead server — the request was never
+    processed, so any method is safe to re-send. Up to `shed_retries`
+    retries honor the server's hint with jitter (so a shed thundering
+    herd doesn't re-arrive in phase); `WEED_QOS=0` (or shed_retries=0)
+    returns the 503 to the caller untouched."""
 
     if "://" in url:
         scheme, _, url = url.partition("://")
@@ -384,7 +392,8 @@ def http_call(
     from seaweedfs_tpu import trace as _trace
 
     _trace.inject(headers)
-    for _hop in range(max_redirects + 1):
+    hops = 0
+    while hops <= max_redirects:
         netloc, slash, rest = url.partition("/")
         path = slash + rest or "/"
         idempotent = method in ("GET", "HEAD", "PUT", "DELETE", "OPTIONS")
@@ -414,6 +423,25 @@ def http_call(
                 ):
                     continue  # next _pooled_conn dials fresh (sock is gone)
                 raise
+        if status == 503 and shed_retries > 0:
+            retry_after = rheaders.get("retry-after", "")
+            if retry_after:
+                from seaweedfs_tpu import qos as _qos
+
+                if _qos.enabled():
+                    import random as _random
+
+                    try:
+                        ra = float(retry_after)
+                    except ValueError:
+                        ra = 1.0
+                    if will_close:
+                        _drop_conn(netloc)
+                    shed_retries -= 1
+                    # jittered, bounded wait: 50–100% of the server's
+                    # hint so retries from many shed clients de-phase
+                    time.sleep(min(ra, 2.0) * (0.5 + _random.random() * 0.5))
+                    continue
         if status in (301, 302, 303, 307, 308):
             loc = rheaders.get("Location", "")
             if loc:
@@ -436,6 +464,7 @@ def http_call(
                     method, body = "GET", None
                     headers.pop("Content-Type", None)
                 url = t_rest
+                hops += 1
                 continue
         if will_close or status >= 400:
             # >=400: error handlers may reply before draining the

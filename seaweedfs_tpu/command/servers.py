@@ -97,11 +97,52 @@ def _add_serve_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
-def _spawn_serve_procs(n: int, argv_tail: list[str]) -> list:
+def _add_admission_flags(p: argparse.ArgumentParser) -> None:
+    """QoS admission-control knobs shared by the serving daemons
+    (docs/QOS.md): token bucket per client key + process-wide in-flight
+    cap, shedding with 503 + Retry-After instead of collapsing."""
+    p.add_argument(
+        "-admissionRate",
+        type=float,
+        default=0.0,
+        help="per-client admitted requests/second (token bucket keyed "
+        "by S3 access key or remote address; 0 = admission off). With "
+        "-serveProcs N each sibling enforces rate/N of the budget",
+    )
+    p.add_argument(
+        "-admissionBurst",
+        type=float,
+        default=0.0,
+        help="per-client token-bucket burst capacity (0 = 2x the rate)",
+    )
+    p.add_argument(
+        "-admissionInflight",
+        type=int,
+        default=0,
+        help="shed with 503 once this many requests are in flight in "
+        "this process, regardless of client (queue-length cap; 0 = "
+        "uncapped)",
+    )
+    p.add_argument(
+        "-admissionProcs",
+        type=int,
+        default=0,
+        help="process-group size the per-client admission budget is "
+        "divided by (0 = the -serveProcs value; set automatically on "
+        "spawned siblings, which re-run with -serveProcs 1 and would "
+        "otherwise each enforce the FULL budget)",
+    )
+
+
+def _spawn_serve_procs(
+    n: int, argv_tail: list[str], extra: list[str] | None = None
+) -> list:
     """`-serveProcs N` (docs/SERVING.md): launch N-1 sibling gateway
     processes re-running this subcommand with `-reusePort` so every
     member binds the same port via SO_REUSEPORT and the kernel spreads
-    accepted connections across them. Returns Popen handles."""
+    accepted connections across them. Returns Popen handles. `extra`
+    rides before the overrides (e.g. -admissionProcs N so siblings keep
+    dividing the admission budget by the ORIGINAL group size)."""
     import subprocess
     import sys
 
@@ -111,6 +152,7 @@ def _spawn_serve_procs(n: int, argv_tail: list[str]) -> list:
             subprocess.Popen(
                 [sys.executable, "-m", "seaweedfs_tpu"]
                 + argv_tail
+                + (extra or [])
                 + ["-serveProcs", "1", "-reusePort"]
             )
         )
@@ -214,6 +256,15 @@ class MasterCommand(Command):
             "(/metrics from every node into the ring TSDB feeding "
             "/cluster/health, /cluster/alerts, /cluster/top; 0 disables)",
         )
+        p.add_argument(
+            "-assignPolicy",
+            default="p2c",
+            choices=("p2c", "random"),
+            help="pick-for-write policy (docs/QOS.md): p2c = "
+            "power-of-two-choices weighted by the nodes' heartbeat-"
+            "reported in-flight/write-queue depth; random = the classic "
+            "pure-random pick (also what WEED_QOS=0 forces)",
+        )
         p.add_argument("-cpuprofile", default="", help="dump pstats profile here on exit")
         p.add_argument(
             "-sequencer.etcd",
@@ -254,6 +305,7 @@ class MasterCommand(Command):
             repair_concurrency=args.repairConcurrency,
             repair_grace=args.repairGrace,
             telemetry_interval=args.telemetryInterval,
+            assign_policy=args.assignPolicy,
         )
         from seaweedfs_tpu.util.profiling import CpuProfile
 
@@ -343,6 +395,36 @@ class VolumeCommand(Command):
             help="scrub bandwidth cap in MB/s (token bucket protecting "
             "foreground read p99; <=0 = unlimited)",
         )
+        p.add_argument(
+            "-commitWindowUs",
+            type=int,
+            default=0,
+            help="group-commit window in microseconds (docs/QOS.md): "
+            "concurrent POSTs against one volume coalesce into one "
+            "pwritev + one flush per window; 0 = off (write-per-POST)",
+        )
+        p.add_argument(
+            "-commitBytes",
+            type=int,
+            default=4 << 20,
+            help="group-commit byte cap: a window commits early once "
+            "its batched bodies reach this many bytes",
+        )
+        p.add_argument(
+            "-commitBatch",
+            type=int,
+            default=64,
+            help="group-commit batch cap: a window commits early once "
+            "this many writes have joined it",
+        )
+        p.add_argument(
+            "-commitFsync",
+            action="store_true",
+            help="fsync the .dat on every commit point (per POST "
+            "without -commitWindowUs, per window with it) — the "
+            "durability lever the fsyncs-per-POST bench ratio measures",
+        )
+        _add_admission_flags(p)
         _add_serve_flags(p)
         _add_trace_flags(p)
         p.add_argument(
@@ -397,6 +479,16 @@ class VolumeCommand(Command):
             scrub_rate_mb_s=args.scrubRate,
             serve_idle_ms=args.serveIdleMs,
             serve_max_reqs=args.serveMaxReqs,
+            commit_window_us=args.commitWindowUs,
+            commit_bytes=args.commitBytes,
+            commit_batch=args.commitBatch,
+            commit_fsync=args.commitFsync,
+            admission_rate=args.admissionRate,
+            admission_burst=args.admissionBurst,
+            admission_inflight=args.admissionInflight,
+            # the lead enforces the whole budget it sees; -workers read
+            # processes serve un-gated (docs/QOS.md limitation note)
+            admission_procs=1,
         )
         from seaweedfs_tpu.util.profiling import CpuProfile
 
@@ -601,6 +693,7 @@ class S3Command(Command):
             "siblings -serveProcs spawns; set by hand to run your own "
             "process group behind one port)",
         )
+        _add_admission_flags(p)
         _add_serve_flags(p)
         _add_trace_flags(p)
         p.add_argument(
@@ -642,11 +735,17 @@ class S3Command(Command):
             reuse_port=args.reusePort or procs > 1,
             serve_idle_ms=args.serveIdleMs,
             serve_max_reqs=args.serveMaxReqs,
+            admission_rate=args.admissionRate,
+            admission_burst=args.admissionBurst,
+            admission_inflight=args.admissionInflight,
+            admission_procs=args.admissionProcs or procs,
         )
         server.start()
         import sys
 
-        children = _spawn_serve_procs(procs, sys.argv[1:])
+        children = _spawn_serve_procs(
+            procs, sys.argv[1:], ["-admissionProcs", str(procs)]
+        )
         wlog.info(
             "s3 gateway %s:%d -> filer %s (%d proc(s))",
             args.ip, args.port, args.filer, procs,
@@ -692,6 +791,7 @@ class WebDavCommand(Command):
             "siblings -serveProcs spawns; set by hand to run your own "
             "process group behind one port)",
         )
+        _add_admission_flags(p)
         _add_serve_flags(p)
         _add_trace_flags(p)
         p.add_argument(
@@ -714,11 +814,17 @@ class WebDavCommand(Command):
             reuse_port=args.reusePort or procs > 1,
             serve_idle_ms=args.serveIdleMs,
             serve_max_reqs=args.serveMaxReqs,
+            admission_rate=args.admissionRate,
+            admission_burst=args.admissionBurst,
+            admission_inflight=args.admissionInflight,
+            admission_procs=args.admissionProcs or procs,
         )
         server.start()
         import sys
 
-        children = _spawn_serve_procs(procs, sys.argv[1:])
+        children = _spawn_serve_procs(
+            procs, sys.argv[1:], ["-admissionProcs", str(procs)]
+        )
         wlog.info(
             "webdav %s:%d -> filer %s (%d proc(s))",
             args.ip, args.port, args.filer, procs,

@@ -6,12 +6,49 @@ and fetched from a volume server; sub-chunk views slice the fetched
 needle. Reference parity: a sparse hole ends the stream — views stop at
 the first gap and nothing is zero-filled (filechunks.go semantics,
 pinned by the ported view tests).
+
+QoS plane (docs/QOS.md): when the volume has more than one replica,
+chunk fetches ride the hedged-read driver — a read that outlives the
+volume's adaptive latency quantile fires a second attempt at the next
+replica and cancels the loser. This is the path the filer's own GET
+handler, the S3 gateway, and the WebDAV gateway all read through, so
+one seam hedges every gateway at once. Replica order passes through
+the client circuit breaker (vid_map), so a recently-dead replica is
+tried last. `WEED_QOS=0`/`WEED_QOS_HEDGE=0` restores the plain
+single-attempt read wholesale.
 """
 
 from __future__ import annotations
 
 from seaweedfs_tpu.client import operation as op
+from seaweedfs_tpu.client import vid_map as _vm
 from seaweedfs_tpu.filer import filechunks
+
+
+def _replica_urls(master: str, fid: str) -> list[str]:
+    """All "host:port/fid" candidates for a chunk fid, healthiest
+    first (breaker-ordered); single-replica volumes return one."""
+    vid = fid.split(",")[0]
+    result = op.lookup(master, vid)
+    if result.error:
+        raise RuntimeError(result.error)
+    if not result.locations:
+        raise RuntimeError(f"volume {vid} has no locations")
+    return _vm.order_by_health(
+        [f"{loc['url']}/{fid}" for loc in result.locations]
+    )
+
+
+def fetch_chunk(master: str, fid: str) -> bytes:
+    """One chunk fid → bytes, hedged across replicas when possible."""
+    urls = _replica_urls(master, fid)
+    if len(urls) < 2:
+        data, _ = op.download(urls[0])
+        return data
+    from seaweedfs_tpu.qos import hedge
+
+    data, _ = hedge.download(urls, key=fid.split(",")[0])
+    return data
 
 
 def stream_content(master: str, chunks, offset: int = 0, size: int | None = None):
@@ -19,8 +56,7 @@ def stream_content(master: str, chunks, offset: int = 0, size: int | None = None
     if size is None:
         size = filechunks.total_size(chunks) - offset
     for view in filechunks.view_from_chunks(chunks, offset, size):
-        url = op.lookup_file_id(master, view.fid)
-        data, _ = op.download(url)
+        data = fetch_chunk(master, view.fid)
         yield data[view.offset : view.offset + view.size]
 
 

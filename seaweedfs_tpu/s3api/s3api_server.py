@@ -57,6 +57,10 @@ class S3ApiServer:
         reuse_port: bool = False,
         serve_idle_ms: int = 0,
         serve_max_reqs: int = 0,
+        admission_rate: float = 0.0,
+        admission_burst: float = 0.0,
+        admission_inflight: int = 0,
+        admission_procs: int = 1,
     ):
         self.filer = filer
         self.host = host
@@ -74,6 +78,21 @@ class S3ApiServer:
         self.reuse_port = reuse_port
         self.serve_idle_ms = serve_idle_ms
         self.serve_max_reqs = serve_max_reqs
+        # QoS plane (docs/QOS.md): per-client admission control, keyed
+        # by S3 access key when the request is signed (else remote
+        # addr). `admission_procs` = the -serveProcs group size, so each
+        # sibling process enforces its share of the global budget.
+        self.admission = None
+        if admission_rate > 0 or admission_inflight > 0:
+            from seaweedfs_tpu.qos.admission import AdmissionController
+
+            self.admission = AdmissionController(
+                rate=admission_rate,
+                burst=admission_burst,
+                max_inflight=admission_inflight,
+                procs=admission_procs,
+                label="s3",
+            )
         self._announce: threading.Thread | None = None
         self._http_server: WeedHTTPServer | None = None
         self._channel: grpc.Channel | None = None
@@ -209,6 +228,7 @@ class S3ApiServer:
             lambda h: not self.iam.is_enabled
             or h.client_address[0] in ("127.0.0.1", "::1")
         )
+        self._http_server.admission = self.admission
         threading.Thread(
             target=self._http_server.serve_forever, daemon=True, name="s3-http"
         ).start()

@@ -34,6 +34,7 @@ from concurrent import futures
 
 import grpc
 
+from seaweedfs_tpu import qos
 from seaweedfs_tpu.pb import master_pb2 as pb
 from seaweedfs_tpu.util.httpd import (
     JSON_HDR as _JSON_HDR,
@@ -105,7 +106,13 @@ class MasterServer:
         repair_grace: float = 30.0,
         telemetry_interval: float = 0.0,
         telemetry_kwargs: dict | None = None,
+        assign_policy: str = "p2c",
     ):
+        # QoS plane (docs/QOS.md): "p2c" = queue-depth-aware
+        # power-of-two-choices over writable volumes; "random" keeps
+        # the pre-QoS pure-random pick (-assignPolicy random; WEED_QOS=0
+        # forces it wholesale)
+        self.assign_policy = assign_policy
         self.host = host
         self.port = port
         self.grpc_port = port + 10000  # reference convention: http port + 10000
@@ -335,6 +342,10 @@ class MasterServer:
                             # resends the full inventory
                             need_full = True
                     dn.last_seen = time.time()
+                    # QoS plane: live load for queue-depth-aware
+                    # assignment (pick_for_write power-of-two-choices)
+                    dn.in_flight = req.in_flight_requests
+                    dn.write_queue_depth = req.write_queue_depth
                     self.sequencer.set_max(req.max_file_key)
                     if req.volumes or req.has_no_volumes:
                         new, deleted = self.topology.sync_volumes(
@@ -636,7 +647,9 @@ class MasterServer:
                 if not self.topology.has_writable_volume(collection, rp, ttl):
                     self.grow_volumes(collection, rp, ttl, data_center=data_center)
         vid, _, nodes = self.topology.pick_for_write(
-            collection, rp, ttl, count, data_center=data_center
+            collection, rp, ttl, count,
+            data_center=data_center,
+            policy=self.assign_policy if qos.enabled("assign") else "random",
         )
         file_key = self.sequencer.next_file_id(count)
         cookie = random.randrange(1 << 32)

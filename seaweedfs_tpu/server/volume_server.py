@@ -34,7 +34,7 @@ from urllib.parse import parse_qs
 
 import grpc
 
-from seaweedfs_tpu import trace
+from seaweedfs_tpu import qos, trace
 from seaweedfs_tpu.ec import ec_files
 from seaweedfs_tpu.ec.ec_volume import EcVolume, NotEnoughShards
 from seaweedfs_tpu.pb import master_pb2, rpc, volume_pb2 as pb
@@ -121,6 +121,14 @@ class VolumeServer:
         scrub_rate_mb_s: float = 64.0,
         serve_idle_ms: int = 0,
         serve_max_reqs: int = 0,
+        commit_window_us: int = 0,
+        commit_bytes: int = 4 << 20,
+        commit_batch: int = 64,
+        commit_fsync: bool = False,
+        admission_rate: float = 0.0,
+        admission_burst: float = 0.0,
+        admission_inflight: int = 0,
+        admission_procs: int = 1,
     ):
         # `ec.codec` config: "cpu" | "native" | "tpu" | "" (auto: tpu
         # with a JAX device, else the native SIMD shim, else numpy).
@@ -218,6 +226,37 @@ class VolumeServer:
         # (`-serveIdleMs`/`-serveMaxReqs`, docs/SERVING.md); 0 = off
         self.serve_idle_ms = serve_idle_ms
         self.serve_max_reqs = serve_max_reqs
+        # QoS plane (docs/QOS.md): group commit on the write path — a
+        # configured committer routes POSTs through commit windows (and
+        # per-POST fsync when -commitFsync rides alone); the C POST
+        # fast path declines to Python while one is installed so every
+        # write can join a window / get its durability flush
+        self.group_commit = None
+        if commit_window_us > 0 or commit_fsync:
+            from seaweedfs_tpu.qos.group_commit import GroupCommitter
+
+            self.group_commit = GroupCommitter(
+                window_us=commit_window_us,
+                max_bytes=commit_bytes,
+                max_batch=commit_batch,
+                fsync=commit_fsync,
+            )
+        # in-flight request tracking, shipped on heartbeats so the
+        # master's pick-for-write can weigh nodes by live load
+        self.load = qos.LoadTracker()
+        # per-client admission control (token bucket + in-flight cap);
+        # None = accept everything, today's behavior
+        self.admission = None
+        if admission_rate > 0 or admission_inflight > 0:
+            from seaweedfs_tpu.qos.admission import AdmissionController
+
+            self.admission = AdmissionController(
+                rate=admission_rate,
+                burst=admission_burst,
+                max_inflight=admission_inflight,
+                procs=admission_procs,
+                label="volume",
+            )
         self.shard_writes = shard_writes
         self.n_writers = max(1, n_writers)
         self._shard_taken: set[int] = set()
@@ -316,6 +355,14 @@ class VolumeServer:
                 data_center=self.data_center,
                 rack=self.rack,
                 has_no_ec_shards=not hb.ec_shards,
+                # QoS plane: live load for queue-depth-aware assignment
+                # (master pick_for_write power-of-two-choices)
+                in_flight_requests=self.load.inflight(),
+                write_queue_depth=(
+                    self.group_commit.depth()
+                    if self.group_commit is not None
+                    else 0
+                ),
             )
             # signature catches in-place changes (growth past the size
             # limit, read-only flips, delete counts) so they propagate
@@ -1255,6 +1302,19 @@ class VolumeServer:
 
     # ------------------------------------------------------------------
     # HTTP data path
+    def _commit_write(self, vid: int, n, stages: dict | None = None):
+        """The one write seam behind do_POST's Python path: (size,
+        unchanged) via the group committer when one is installed
+        (docs/QOS.md — batched pwritev + shared fsync window), else the
+        classic per-needle store write."""
+        if self.group_commit is None:
+            return self.store.write_needle(vid, n, stages=stages)
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise NeedleNotFound(f"volume {vid} not found")
+        _, size, unchanged = self.group_commit.write(v, n, stages=stages)
+        return size, unchanged
+
     def _http_handler_class(self):
         server = self
 
@@ -1416,6 +1476,18 @@ class VolumeServer:
                 fid, q, url_filename, url_ext = self._parse_fid()
                 if fid is None:
                     return self._json({"error": "invalid file id"}, 400)
+                if self.headers.get(qos.HEDGE_HEADER):
+                    # QoS plane: a tied (hedged) read — count it and tag
+                    # the span so trace.dump shows which arm this was;
+                    # if the client's other attempt wins, its socket
+                    # close is the cancel (the reply write fails and
+                    # this connection tears down quietly)
+                    from seaweedfs_tpu.stats.metrics import HEDGE_SERVED
+
+                    HEDGE_SERVED.labels("volume").inc()
+                    hedge_span = getattr(self, "_trace_span", None)
+                    if hedge_span is not None:
+                        hedge_span.annotate("hedge", 1)
                 try:
                     v = server.store.find_volume(fid.volume_id)
                     if v is not None:
@@ -1651,16 +1723,25 @@ class VolumeServer:
                 # path)
                 req_span = getattr(self, "_trace_span", None)
                 stages = {} if req_span is not None else None
-                reply = write_path.try_native_post(
-                    server.store.find_volume(fid.volume_id),
-                    fid,
-                    q,
-                    body,
-                    self.headers,
-                    url_filename,
-                    server.fix_jpg_orientation,
-                    stages=stages,
-                )
+                if server.group_commit is not None:
+                    # QoS group commit (docs/QOS.md): the C one-call
+                    # append can't join a commit window (and fsync-only
+                    # mode needs the post-write flush), so the fast
+                    # path declines wholesale while a committer is
+                    # installed — the Python path below routes through
+                    # it and stays byte-identical
+                    reply = None
+                else:
+                    reply = write_path.try_native_post(
+                        server.store.find_volume(fid.volume_id),
+                        fid,
+                        q,
+                        body,
+                        self.headers,
+                        url_filename,
+                        server.fix_jpg_orientation,
+                        stages=stages,
+                    )
                 if reply is None:
                     n, fname, err = write_path.build_upload_needle(
                         fid,
@@ -1674,7 +1755,7 @@ class VolumeServer:
                     if err is not None:
                         return self._json({"error": err}, 400)
                     try:
-                        size, unchanged = server.store.write_needle(
+                        size, unchanged = server._commit_write(
                             fid.volume_id, n, stages=stages
                         )
                     except NeedleNotFound:
@@ -1783,6 +1864,13 @@ class VolumeServer:
         octet = "application/octet-stream"
 
         def resolver(path, rng, head_only):
+            if self.admission is not None:
+                # admission control runs in the mini loop's dispatch
+                # funnel; declining here routes every request through it
+                # (the C loop can't run the token bucket) — only when an
+                # admission controller is actually configured, so the
+                # zero-copy fast path keeps its default speed
+                return None
             if "?" in path:
                 return None
             vid_s, fid_s, filename, ext, vid_only = parse_url_path(path)
@@ -2116,6 +2204,10 @@ class VolumeServer:
         self._http_server.fast_resolver = self._make_fast_resolver()
         self._http_server.serve_idle_ms = self.serve_idle_ms
         self._http_server.serve_max_reqs = self.serve_max_reqs
+        # QoS plane: the mini loop counts in-flight dispatches (heartbeat
+        # load signal) and runs per-client admission when configured
+        self._http_server.load_tracker = self.load
+        self._http_server.admission = self.admission
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
         if self.internal_port:
             self._internal_server = WeedHTTPServer(

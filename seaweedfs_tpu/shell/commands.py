@@ -1462,7 +1462,8 @@ class ClusterTop(Command):
     name = "cluster.top"
     help = (
         "cluster.top [-n 10] [-json] — busiest nodes by req/s (with "
-        "5xx rate and http p99) and biggest volumes by size"
+        "5xx rate, http p99, and heartbeat-reported in-flight/write-"
+        "queue depth) and biggest volumes by size"
     )
 
     def run(self, env, args, out):
@@ -1483,11 +1484,20 @@ class ClusterTop(Command):
         print("busiest nodes:", file=out)
         for row in snap.get("Nodes") or []:
             p99 = row.get("P99Ms")
+            load = ""
+            if row.get("InFlight") is not None:
+                # QoS columns (volume servers only): the heartbeat load
+                # signal queue-depth-aware assignment weighs
+                load = (
+                    f", inflight {row['InFlight']}, "
+                    f"wqueue {row['WriteQueueDepth']}"
+                )
             print(
                 f"  {row['Url']} [{row['Kind']}]: "
                 f"{row['ReqPerSec']:.2f} req/s, "
                 f"{row['ErrPerSec']:.2f} err/s, "
-                f"p99 {'-' if p99 is None else f'{p99:.1f}ms'}",
+                f"p99 {'-' if p99 is None else f'{p99:.1f}ms'}"
+                + load,
                 file=out,
             )
         print("biggest volumes:", file=out)

@@ -333,6 +333,45 @@ TIME_TO_REPAIR = DEFAULT_REGISTRY.histogram(
     buckets=(1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 3600.0, 14400.0),
 )
 
+# --- QoS / tail-latency plane (docs/QOS.md) ---------------------------------
+# Hedged reads (client side): fired = second attempt launched after the
+# adaptive delay; won = the hedge (not the primary) returned first;
+# cancelled = the losing attempt's connection was torn down mid-flight.
+HEDGE_FIRED = DEFAULT_REGISTRY.counter(
+    "weed_hedge_fired_total",
+    "hedged read second attempts launched after the adaptive delay",
+)
+HEDGE_WON = DEFAULT_REGISTRY.counter(
+    "weed_hedge_won_total",
+    "hedged reads where the second attempt beat the primary",
+)
+HEDGE_CANCELLED = DEFAULT_REGISTRY.counter(
+    "weed_hedge_cancelled_total",
+    "losing hedged-read attempts cancelled (connection torn down)",
+)
+HEDGE_SERVED = DEFAULT_REGISTRY.counter(
+    "weed_hedge_served_total",
+    "requests a server observed carrying the x-weed-hedge hop header",
+    ("server",),
+)
+ADMISSION_REJECTED = DEFAULT_REGISTRY.counter(
+    "weed_admission_rejected_total",
+    "requests shed with 503 + Retry-After by per-client admission control",
+    ("server",),
+)
+GROUP_COMMIT_BATCHES = DEFAULT_REGISTRY.counter(
+    "weed_group_commit_batches_total",
+    "group-commit windows committed (one pwritev + one flush each)",
+)
+GROUP_COMMIT_WRITES = DEFAULT_REGISTRY.counter(
+    "weed_group_commit_writes_total",
+    "needle writes that rode a group-commit window",
+)
+COMMIT_FLUSHES = DEFAULT_REGISTRY.counter(
+    "weed_commit_flush_total",
+    "durability flushes (fsync) issued by the volume write path",
+)
+
 
 # textual push-loop health (gauges can't carry the error STRING): job
 # -> {"last_success_unix", "last_error"}; /cluster/health surfaces it

@@ -455,6 +455,138 @@ class Volume:
                 self.nm.put(n.id, t.offset_to_units(offset), n.size)
             return offset, n.size, False
 
+    def commit(self) -> None:
+        """One durability flush of the .dat (fsync on the pread/pwrite
+        fast path). The QoS write path calls this per POST when
+        `-commitFsync` is set, or once per group-commit window — the
+        weed_commit_flush_total counter is what the fsyncs-per-POST
+        bench ratio reads (docs/QOS.md)."""
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        from seaweedfs_tpu.stats.metrics import COMMIT_FLUSHES
+
+        if self._fd is not None:
+            os.fsync(self._fd)
+        else:
+            self._dat.flush()
+        COMMIT_FLUSHES.inc()
+
+    def write_needles(
+        self,
+        entries: list[tuple[Needle, dict | None]],
+        durable: bool = False,
+    ) -> list:
+        """Group commit (docs/QOS.md): the batch counterpart of
+        write_needle with identical per-needle semantics — dedup,
+        cookie checks, TTL injection, monotonic append_at_ns — but all
+        encoded records land with ONE pwritev and at most ONE
+        durability flush. Returns one outcome per entry: an
+        (offset, size, unchanged) tuple, or the exception instance the
+        caller must raise for that needle (per-needle failures must not
+        fail batchmates). Byte-identical on disk to the same needles
+        written serially through write_needle, by construction: the
+        same encode runs at the same offsets in the same order.
+
+        A short pwritev raises for the whole batch BEFORE any needle
+        map update — same invariant as the serial path (a truncated
+        record must never be indexed as live)."""
+        results: list = [None] * len(entries)
+        with self._lock:
+            if self.read_only:
+                raise VolumeReadOnly(f"volume {self.id} is read-only")
+            if self._fd is None:
+                # buffered (remote-tier shim) volumes have no pwritev
+                # fast path; serial appends keep semantics identical
+                for i, (n, stages) in enumerate(entries):
+                    try:
+                        results[i] = self.write_needle(n, stages=stages)
+                    except (VolumeReadOnly, CookieMismatch) as e:
+                        results[i] = e
+                if durable:
+                    self._flush_locked()
+                return results
+            start = self._append_end
+            if start % t.NEEDLE_PADDING_SIZE:
+                pad = t.NEEDLE_PADDING_SIZE - start % t.NEEDLE_PADDING_SIZE
+                if os.pwrite(self._fd, bytes(pad), start) != pad:
+                    raise OSError(f"volume {self.id}: short pad write at {start}")
+                start += pad
+                self._append_end = start
+            blobs: list[bytes] = []
+            metas: list[tuple[int, Needle, int]] = []  # (entry idx, n, offset)
+            cursor = start
+            seen_ids: set[int] = set()
+            deferred: list[int] = []
+            for i, (n, stages) in enumerate(entries):
+                if n.id in seen_ids:
+                    # a batchmate already writes this id: the serial
+                    # path's dedup/cookie checks compare against THAT
+                    # record's map entry, which doesn't exist until the
+                    # batch commits — defer this entry to a serial
+                    # write after the pwritev so the checks see what
+                    # they would have seen serially
+                    deferred.append(i)
+                    continue
+                if self._is_file_unchanged(n):
+                    results[i] = (0, n.size, True)
+                    continue
+                if n.ttl is None and self.ttl.count != 0:
+                    n.set_has_ttl()
+                    n.ttl = self.ttl
+                existing = self.nm.get(n.id)
+                if existing is not None and existing.size != t.TOMBSTONE_FILE_SIZE:
+                    old = self._read_needle_at(existing)
+                    if old is not None and old.cookie != n.cookie:
+                        results[i] = CookieMismatch(
+                            f"mismatching cookie {n.cookie:08x} for needle {n.id}"
+                        )
+                        continue
+                n.append_at_ns = self._now_ns()
+                self.last_append_at_ns = n.append_at_ns
+                if stages is None:
+                    blob = n.encode_record(self.version)
+                else:
+                    t0 = time.perf_counter()
+                    blob = n.encode_record(self.version)
+                    stages["crc"] = time.perf_counter() - t0
+                blobs.append(blob)
+                metas.append((i, n, cursor))
+                seen_ids.add(n.id)
+                cursor += len(blob)
+            if blobs:
+                t0 = time.perf_counter()
+                written = os.pwritev(self._fd, blobs, start)
+                if written != cursor - start:
+                    raise OSError(
+                        f"volume {self.id}: short batch append at {start}: "
+                        f"{written}/{cursor - start} bytes"
+                    )
+                pwrite_s = time.perf_counter() - t0
+                self._append_end = cursor
+                for i, n, offset in metas:
+                    existing = self.nm.get(n.id)
+                    if existing is None or existing.actual_offset < offset:
+                        self.nm.put(n.id, t.offset_to_units(offset), n.size)
+                    stages = entries[i][1]
+                    if stages is not None:
+                        # the one syscall serviced the whole batch; each
+                        # rider reports the shared wall time
+                        stages["pwrite"] = pwrite_s
+                    results[i] = (offset, n.size, False)
+            for i in deferred:
+                # the RLock is already held; these run the exact serial
+                # path against the now-committed batch state
+                n, stages = entries[i]
+                try:
+                    results[i] = self.write_needle(n, stages=stages)
+                except (VolumeReadOnly, CookieMismatch) as e:
+                    results[i] = e
+            if durable and blobs:
+                self._flush_locked()
+        return results
+
     def _is_file_unchanged(self, n: Needle) -> bool:
         if str(self.ttl):
             return False

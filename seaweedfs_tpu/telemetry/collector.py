@@ -54,6 +54,12 @@ RULE_REPAIR_DEPTH = AlertRule(
     "repair_queue_depth", "warning", 0.0,
     "master repair scheduler tracking more damage than the bound",
 )
+RULE_ADMISSION = AlertRule(
+    "admission_reject_rate", "warning", 0.0,
+    "per-client admission control shedding requests (503 + Retry-After) "
+    "above the sustained-rate bound — a tenant is over budget or the "
+    "node is saturated (docs/QOS.md)",
+)
 
 
 class ClusterCollector:
@@ -69,6 +75,7 @@ class ClusterCollector:
         error_rate_threshold: float = 0.05,
         span_p99_threshold_s: float = 2.0,
         repair_depth_threshold: int = 8,
+        admission_reject_threshold: float = 1.0,
     ):
         self.master = master
         self.interval = interval
@@ -82,6 +89,7 @@ class ClusterCollector:
         self.error_rate_threshold = error_rate_threshold
         self.span_p99_threshold_s = span_p99_threshold_s
         self.repair_depth_threshold = repair_depth_threshold
+        self.admission_reject_threshold = admission_reject_threshold
         self.alerts = AlertManager()
         self.targets: dict[str, TargetStore] = {}
         self._targets_lock = threading.Lock()
@@ -223,6 +231,16 @@ class ClusterCollector:
                 RULE_SCRUB_CORRUPT, ts.url, corrupt > 0, corrupt,
                 f"{corrupt:.0f} new corruption(s) in {w:.0f}s",
             ))
+            # QoS plane: sustained shedding means a tenant is over
+            # budget (or the node is saturated) — surface it before the
+            # tenant's own dashboards do
+            shed = ts.rate_sum("weed_admission_rejected_total", w, now)
+            conds.append((
+                RULE_ADMISSION, ts.url,
+                shed > self.admission_reject_threshold, shed,
+                f"{shed:.2f}/s requests shed by admission control "
+                f"over {w:.0f}s",
+            ))
         # master-local: the repair scheduler's tracked-damage depth
         depth = 0
         if getattr(self.master, "repair", None) is not None:
@@ -270,6 +288,12 @@ class ClusterCollector:
         w = self.window_s
         with self._targets_lock:
             targets = list(self.targets.values())
+        # QoS plane: heartbeat-reported live load per volume server
+        # (the same numbers pick_for_write's power-of-two-choices uses)
+        load_by_url = {
+            dn.url: (dn.in_flight, dn.write_queue_depth)
+            for dn in self.master.topology.data_nodes()
+        }
         nodes = []
         for ts in targets:
             if not ts.last_success:
@@ -280,12 +304,15 @@ class ClusterCollector:
                 label_filter=lambda l: l.get("status", "").startswith("5"),
             )
             p99 = ts.quantile("weed_http_request_seconds", 0.99, w, now)
+            in_flight, queue_depth = load_by_url.get(ts.url, (None, None))
             nodes.append({
                 "Url": ts.url,
                 "Kind": ts.kind,
                 "ReqPerSec": round(total, 3),
                 "ErrPerSec": round(errs, 3),
                 "P99Ms": None if p99 is None else round(p99 * 1000.0, 3),
+                "InFlight": in_flight,
+                "WriteQueueDepth": queue_depth,
             })
         nodes.sort(key=lambda r: -r["ReqPerSec"])
         volumes = []

@@ -117,18 +117,44 @@ def _http(conns: dict, netloc: str, method: str, path: str,
     raise RuntimeError("unreachable")
 
 
-def _worker(spec: dict, out_q) -> None:
+class _Shed(Exception):
+    """A 503 from admission control: counted separately from errors —
+    the request was deliberately refused, not failed."""
+
+
+def _worker(spec: dict, out_q, barrier=None) -> None:
     """One load worker (runs in its own process). `spec`:
-    mode, master, duration_s, payload, rate, keys, index."""
+    mode ('put' | 'get' | 'mixed'), master, duration_s, payload, rate,
+    keys, index, hedge. `barrier` (shared with the parent and every
+    sibling) gates the measured loop until ALL workers finish their
+    process bootstrap — a sibling still importing heavyweight modules
+    pins the CPU and would charge multi-hundred-ms stalls to the
+    server under test.
+
+    `mixed` alternates one PUT then one GET per scheduled slot (the
+    multi-tenant contention shape: writers and readers fight for the
+    same disks). `hedge` (with keys entries carrying a replica url
+    LIST) routes GETs through the qos hedged-read driver and reports
+    fired/won/cancelled counts. A 503 reply counts as `shed`, not an
+    error, and its latency lands in a separate histogram so the
+    accepted-request quantiles stay honest under admission control."""
     mode = spec["mode"]
     master = spec["master"]
     payload = spec["payload"]
     rate = spec["rate"]
     keys = spec.get("keys") or []
+    use_hedge = bool(spec.get("hedge"))
+    hedge_stats: dict = {}
+    if use_hedge:
+        from seaweedfs_tpu.qos import hedge as _hedge
+    if barrier is not None:
+        barrier.wait(120)
     conns: dict[str, http.client.HTTPConnection] = {}
     hist = LogHistogram()
+    shed_hist = LogHistogram()
     ops = 0
     errors = 0
+    shed = 0
     err_samples: list[str] = []
     nbytes = 0
     interval = (1.0 / rate) if rate > 0 else 0.0
@@ -136,6 +162,47 @@ def _worker(spec: dict, out_q) -> None:
     deadline = start + spec["duration_s"]
     scheduled = start
     ki = spec.get("index", 0)  # stagger the round-robin start per worker
+
+    def one_put():
+        nonlocal nbytes
+        status, data = _http(conns, master, "GET", "/dir/assign", timeout=30.0)
+        if status != 200:
+            raise RuntimeError(f"assign HTTP {status}")
+        a = json.loads(data)
+        if "error" in a:
+            raise RuntimeError(f"assign: {a['error']}")
+        status, data = _http(conns, a["url"], "POST", f"/{a['fid']}", payload)
+        if status == 503:
+            raise _Shed()
+        if status not in (200, 201):
+            raise RuntimeError(f"put HTTP {status}")
+        nbytes += len(payload)
+
+    def one_get():
+        nonlocal nbytes, ki
+        fid, loc = keys[ki % len(keys)]
+        ki += 1
+        urls = [loc] if isinstance(loc, str) else list(loc)
+        if use_hedge and len(urls) > 1:
+            # rotate the primary across replicas so the hedged arm's
+            # first attempt hits the slow replica as often as the
+            # unhedged arm does — the A/B measures hedging, not luck
+            r = ki % len(urls)
+            cand = [f"{urls[(r + j) % len(urls)]}/{fid}" for j in range(len(urls))]
+            data, _ = _hedge.download(
+                cand, key=fid.partition(",")[0], stats=hedge_stats
+            )
+            nbytes += len(data)
+            return
+        url = urls[ki % len(urls)]
+        status, data = _http(conns, url, "GET", f"/{fid}")
+        if status == 503:
+            raise _Shed()
+        if status != 200:
+            raise RuntimeError(f"get {fid} HTTP {status}")
+        nbytes += len(data)
+
+    n_slot = 0
     while True:
         now = time.perf_counter()
         if interval:
@@ -147,29 +214,16 @@ def _worker(spec: dict, out_q) -> None:
             t_ref = now
         if t_ref >= deadline or now >= deadline:
             break
+        n_slot += 1
         try:
-            if mode == "put":
-                status, data = _http(
-                    conns, master, "GET", "/dir/assign", timeout=30.0
-                )
-                if status != 200:
-                    raise RuntimeError(f"assign HTTP {status}")
-                a = json.loads(data)
-                if "error" in a:
-                    raise RuntimeError(f"assign: {a['error']}")
-                status, data = _http(
-                    conns, a["url"], "POST", f"/{a['fid']}", payload
-                )
-                if status not in (200, 201):
-                    raise RuntimeError(f"put HTTP {status}")
-                nbytes += len(payload)
+            if mode == "put" or (mode == "mixed" and n_slot % 2):
+                one_put()
             else:
-                fid, url = keys[ki % len(keys)]
-                ki += 1
-                status, data = _http(conns, url, "GET", f"/{fid}")
-                if status != 200:
-                    raise RuntimeError(f"get {fid} HTTP {status}")
-                nbytes += len(data)
+                one_get()
+        except _Shed:
+            shed += 1
+            shed_hist.record(time.perf_counter() - t_ref)
+            continue
         except Exception as e:  # noqa: BLE001 — counted, not fatal
             errors += 1
             if len(err_samples) < 5:
@@ -184,9 +238,12 @@ def _worker(spec: dict, out_q) -> None:
         "mode": mode,
         "ops": ops,
         "errors": errors,
+        "shed": shed,
         "err_samples": err_samples,
         "bytes": nbytes,
         "hist": hist.to_row(),
+        "shed_hist": shed_hist.to_row(),
+        "hedge": hedge_stats,
         "wall_s": time.perf_counter() - start,
     })
 
@@ -220,7 +277,40 @@ def seed_keys(master: str, n: int, payload: bytes) -> list[tuple[str, str]]:
     return keys
 
 
-def _get_fan_worker(spec: dict, out_q) -> None:
+def seed_keys_replicated(
+    master: str, n: int, payload: bytes, replication: str = "010"
+) -> list[tuple[str, list[str]]]:
+    """Seed n blobs onto REPLICATED volumes and return every replica:
+    (fid, [url, ...]) rows — the keyset shape the hedged-GET workers
+    (and the slow-replica A/B) need. The POST fans out to the replicas
+    server-side; /dir/lookup reports where the copies live."""
+    keys: list[tuple[str, list[str]]] = []
+    for _ in range(n):
+        with urllib.request.urlopen(
+            f"http://{master}/dir/assign?replication={replication}",
+            timeout=10,
+        ) as r:
+            a = json.loads(r.read())
+        if "error" in a:
+            raise RuntimeError(f"seed assign: {a['error']}")
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://{a['url']}/{a['fid']}", data=payload, method="POST",
+                headers={"Content-Type": "application/octet-stream"},
+            ),
+            timeout=10,
+        ).close()
+        vid = a["fid"].partition(",")[0]
+        with urllib.request.urlopen(
+            f"http://{master}/dir/lookup?volumeId={vid}", timeout=10
+        ) as r:
+            lk = json.loads(r.read())
+        urls = [loc["url"] for loc in lk.get("locations", [])] or [a["url"]]
+        keys.append((a["fid"], urls))
+    return keys
+
+
+def _get_fan_worker(spec: dict, out_q, barrier=None) -> None:
     """One GET *fan* worker: K nonblocking keep-alive connections
     driven by a single selector loop in this process — the client-side
     shape for connection-scale serving benches (256+ concurrent
@@ -234,10 +324,19 @@ def _get_fan_worker(spec: dict, out_q) -> None:
     `range_every` of N makes every Nth request on a connection carry a
     Range header cycling through `ranges` (mixed 200/206 traffic).
 
+    A 503 (admission-control shed, docs/QOS.md) is counted as `shed`
+    and the connection HONORS the server's Retry-After before its next
+    attempt — the same contract op.http_call implements — so the
+    admission A/B measures the designed backpressure loop, not a
+    client that spams the server it was just refused by.
+
     spec: mode='get_fan', duration_s, keys, conns, rate, index,
     range_every, ranges."""
     import selectors
     import socket as _socket
+
+    if barrier is not None:
+        barrier.wait(120)
 
     keys = spec["keys"]
     duration = spec["duration_s"]
@@ -247,7 +346,8 @@ def _get_fan_worker(spec: dict, out_q) -> None:
     ranges = spec.get("ranges") or ["bytes=0-127"]
     interval = (1.0 / rate) if rate > 0 else 0.0
     hist = LogHistogram()
-    ops = errors = nbytes = 0
+    shed_hist = LogHistogram()
+    ops = errors = nbytes = shed = 0
     err_samples: list[str] = []
     sel = selectors.DefaultSelector()
     start = time.perf_counter()
@@ -255,7 +355,7 @@ def _get_fan_worker(spec: dict, out_q) -> None:
 
     class _Conn:
         __slots__ = ("sock", "buf", "need", "t_ref", "scheduled", "ki",
-                     "nreq", "netloc", "inflight")
+                     "nreq", "netloc", "inflight", "resume")
 
     def _dial(netloc: str):
         host, _, port = netloc.partition(":")
@@ -308,6 +408,7 @@ def _get_fan_worker(spec: dict, out_q) -> None:
             c.buf = b""
             c.need = -1
             c.inflight = False
+            c.resume = 0.0
             # stagger schedules so paced conns don't phase-lock
             c.scheduled = start + (interval * i / nconns if interval else 0.0)
             sel.register(c.sock, selectors.EVENT_READ, c)
@@ -357,25 +458,55 @@ def _get_fan_worker(spec: dict, out_q) -> None:
                     if status in (b"200", b"206"):
                         ops += 1
                         nbytes += c.need
+                        hist.record(now - c.t_ref)
+                    elif status == b"503":
+                        # admission-control shed (docs/QOS.md): refused
+                        # by design, histogrammed apart so accepted-
+                        # request quantiles stay honest; honor the
+                        # server's Retry-After before this connection's
+                        # next attempt
+                        shed += 1
+                        shed_hist.record(now - c.t_ref)
+                        head = c.buf[: c.need].lower()
+                        backoff = 0.5
+                        idx = head.find(b"retry-after:")
+                        if idx >= 0:
+                            tok = head[idx + 12 : idx + 28].split(b"\r", 1)[0]
+                            try:
+                                backoff = float(tok.strip())
+                            except ValueError:
+                                pass
+                        c.resume = now + min(max(backoff, 0.05), 1.0)
                     else:
                         errors += 1
                         if len(err_samples) < 5:
                             err_samples.append(
                                 c.buf[:80].decode("latin-1", "replace")
                             )
-                    hist.record(now - c.t_ref)
+                        hist.record(now - c.t_ref)
                     c.buf = c.buf[c.need :]
                     c.need = -1
                     c.inflight = False
                     if interval:
                         c.scheduled += interval
-                        if c.scheduled <= now:
+                        if c.scheduled <= now and c.resume <= now:
                             _send(c, now)  # behind schedule: CO charge
-                    else:
+                    elif c.resume <= now:
                         _send(c, now)
             if interval:
                 for c in conns:
-                    if not c.inflight and c.scheduled <= now:
+                    if (
+                        not c.inflight
+                        and c.scheduled <= now
+                        and c.resume <= now
+                    ):
+                        _send(c, now)
+            else:
+                # shed-backoff wakeups: a connection honoring a
+                # Retry-After re-enters the closed loop here
+                for c in conns:
+                    if not c.inflight and c.resume and c.resume <= now:
+                        c.resume = 0.0
                         _send(c, now)
     finally:
         for c in conns:
@@ -388,9 +519,11 @@ def _get_fan_worker(spec: dict, out_q) -> None:
         "mode": "get",
         "ops": ops,
         "errors": errors,
+        "shed": shed,
         "err_samples": err_samples,
         "bytes": nbytes,
         "hist": hist.to_row(),
+        "shed_hist": shed_hist.to_row(),
         "wall_s": time.perf_counter() - start,
     })
 
@@ -418,6 +551,7 @@ def run_get_fan(
         keys = seed_keys(master, seed_n, payload)
     ctx = multiprocessing.get_context(mp_start)
     out_q = ctx.Queue()
+    barrier = ctx.Barrier(processes)
     procs = []
     for i in range(processes):
         spec = {
@@ -430,7 +564,9 @@ def run_get_fan(
             "range_every": range_every,
             "ranges": ranges or [],
         }
-        p = ctx.Process(target=_get_fan_worker, args=(spec, out_q), daemon=True)
+        p = ctx.Process(
+            target=_get_fan_worker, args=(spec, out_q, barrier), daemon=True
+        )
         p.start()
         procs.append(p)
     import queue as _queue
@@ -453,16 +589,23 @@ def run_get_fan(
             f"reported (exit codes {[p.exitcode for p in procs]})"
         )
     hist = LogHistogram()
-    ops = errors = nbytes = 0
+    shed_hist = LogHistogram()
+    ops = errors = nbytes = shed = 0
     samples: list[str] = []
     for r in rows:
         hist.merge(LogHistogram.from_row(r["hist"]))
+        if r.get("shed_hist"):
+            shed_hist.merge(LogHistogram.from_row(r["shed_hist"]))
         ops += r["ops"]
         errors += r["errors"]
+        shed += r.get("shed", 0)
         nbytes += r["bytes"]
         samples.extend(r["err_samples"])
     wall = max(r["wall_s"] for r in rows)
     report = _summarize(hist, ops, errors, nbytes, wall)
+    report["shed"] = shed
+    if shed:
+        report["shed_p99_ms"] = round(shed_hist.quantile(0.99) * 1e3, 3)
     report["err_samples"] = samples[:5]
     report["config"] = {
         "master": master,
@@ -502,32 +645,57 @@ def run_load(
     rate: float = 0.0,
     seed_n: int = 64,
     mp_start: str = "spawn",
+    mixed: int = 0,
+    hedge: bool = False,
+    keys: list | None = None,
 ) -> dict:
-    """Drive writers+readers worker PROCESSES against the cluster at
-    `master`; returns the merged report. `rate` is per-worker target
-    req/s (0 = unpaced closed loop). `mp_start` picks the
-    multiprocessing start method — spawn (default) never inherits the
-    parent's threads/locks, which matters when the caller embeds
-    in-process servers."""
-    if writers <= 0 and readers <= 0:
+    """Drive writers+readers(+mixed) worker PROCESSES against the
+    cluster at `master`; returns the merged report. `rate` is
+    per-worker target req/s (0 = unpaced closed loop). `mp_start` picks
+    the multiprocessing start method — spawn (default) never inherits
+    the parent's threads/locks, which matters when the caller embeds
+    in-process servers.
+
+    QoS knobs (docs/QOS.md): `mixed` adds workers alternating PUT and
+    GET (cross-plane contention in one closed loop); `hedge` routes
+    GETs through the hedged-read driver — pass `keys` rows shaped
+    (fid, [replica_url, ...]) (seed_keys_replicated builds them; a
+    caller injecting a slow replica rewrites one url to its proxy).
+    The report carries hedge fired/won/cancelled counts and `shed`
+    (503-refused requests, histogrammed apart from accepted ones)."""
+    if writers <= 0 and readers <= 0 and mixed <= 0:
         raise ValueError("need at least one worker")
     # \x00\xff keeps the body ungzippable so the write path stays honest
     payload = (b"weedload\x00\xff" * ((payload_bytes // 10) + 1))[:payload_bytes]
-    keys = seed_keys(master, seed_n, payload) if readers > 0 else []
+    if keys is None:
+        keys = (
+            seed_keys(master, seed_n, payload)
+            if readers > 0 or mixed > 0
+            else []
+        )
     ctx = multiprocessing.get_context(mp_start)
     out_q = ctx.Queue()
+    n_workers = writers + readers + mixed
+    barrier = ctx.Barrier(n_workers)
     procs = []
-    for i in range(writers + readers):
+    for i in range(n_workers):
         spec = {
-            "mode": "put" if i < writers else "get",
+            "mode": (
+                "put" if i < writers
+                else "get" if i < writers + readers
+                else "mixed"
+            ),
             "master": master,
             "duration_s": duration_s,
             "payload": payload,
             "rate": rate,
             "keys": keys,
             "index": i * 7,
+            "hedge": hedge,
         }
-        p = ctx.Process(target=_worker, args=(spec, out_q), daemon=True)
+        p = ctx.Process(
+            target=_worker, args=(spec, out_q, barrier), daemon=True
+        )
         p.start()
         procs.append(p)
     import queue as _queue
@@ -562,28 +730,48 @@ def run_load(
             "duration_s": duration_s,
             "writers": writers,
             "readers": readers,
+            "mixed": mixed,
+            "hedge": hedge,
             "payload_bytes": payload_bytes,
             "rate_per_worker": rate,
             "coordinated_omission_safe": rate > 0,
             "processes": len(procs),
         },
     }
-    for mode in ("put", "get"):
+    for mode in ("put", "get", "mixed"):
         mode_rows = [r for r in rows if r["mode"] == mode]
         if not mode_rows:
             continue
         hist = LogHistogram()
-        ops = errors = nbytes = 0
+        shed_hist = LogHistogram()
+        ops = errors = nbytes = shed = 0
+        hedge_fired = hedge_won = hedge_cancelled = 0
         wall = 0.0
         samples: list[str] = []
         for r in mode_rows:
             hist.merge(LogHistogram.from_row(r["hist"]))
+            if r.get("shed_hist"):
+                shed_hist.merge(LogHistogram.from_row(r["shed_hist"]))
             ops += r["ops"]
             errors += r["errors"]
+            shed += r.get("shed", 0)
             nbytes += r["bytes"]
             wall = max(wall, r["wall_s"])
             samples.extend(r["err_samples"])
+            hstats = r.get("hedge") or {}
+            hedge_fired += hstats.get("fired", 0)
+            hedge_won += hstats.get("won", 0)
+            hedge_cancelled += hstats.get("cancelled", 0)
         report[mode] = _summarize(hist, ops, errors, nbytes, wall)
+        report[mode]["shed"] = shed
+        if shed:
+            report[mode]["shed_p99_ms"] = round(
+                shed_hist.quantile(0.99) * 1e3, 3
+            )
+        if hedge:
+            report[mode]["hedge_fired"] = hedge_fired
+            report[mode]["hedge_won"] = hedge_won
+            report[mode]["hedge_cancelled"] = hedge_cancelled
         if samples:
             report[mode]["err_samples"] = samples[:5]
     return report

@@ -91,11 +91,22 @@ class DataNode(Node):
         # node's heartbeats; the repair scheduler reads corruption and
         # quarantine signals from here
         self.scrub_stats: dict[tuple[int, bool], ScrubStatInfo] = {}
+        # QoS plane (docs/QOS.md): live load from the node's heartbeats
+        # — in-flight HTTP dispatches and group-commit queue depth;
+        # pick_for_write's power-of-two-choices weighs nodes by these
+        self.in_flight = 0
+        self.write_queue_depth = 0
         self.last_seen = 0.0
 
     @property
     def url(self) -> str:
         return f"{self.ip}:{self.port}" if self.ip else self.id
+
+    def queue_load(self) -> int:
+        """The node's reported live load — what queue-depth-aware
+        assignment compares (heartbeat-fresh, so at most one beat
+        stale; ties break random in the layout's picker)."""
+        return self.in_flight + self.write_queue_depth
 
     def max_volume_count(self) -> int:
         return self._max_volumes

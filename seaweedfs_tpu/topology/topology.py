@@ -261,10 +261,16 @@ class Topology(Node):
         return self.get_layout(collection, rp, ttl).active_volume_count() > 0
 
     def pick_for_write(
-        self, collection: str, rp: str, ttl: str, count: int = 1, data_center: str = ""
+        self,
+        collection: str,
+        rp: str,
+        ttl: str,
+        count: int = 1,
+        data_center: str = "",
+        policy: str = "p2c",
     ) -> tuple[int, int, list[DataNode]]:
         vid, nodes = self.get_layout(collection, rp, ttl).pick_for_write(
-            data_center=data_center
+            data_center=data_center, policy=policy
         )
         return vid, count, nodes
 

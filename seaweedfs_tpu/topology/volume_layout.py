@@ -98,21 +98,53 @@ class VolumeLayout:
     def active_volume_count(self) -> int:
         return len(self.writables)
 
+    @staticmethod
+    def _volume_load(nodes: list[DataNode]) -> int:
+        """Cost of writing one volume: a write lands on EVERY replica
+        (fan-out), so the slowest — most loaded — replica bounds it."""
+        return max((dn.queue_load() for dn in nodes), default=0)
+
     def pick_for_write(
         self,
         data_center: str = "",
         rack: str = "",
         data_node: str = "",
         rng: random.Random | None = None,
+        policy: str = "p2c",
     ) -> tuple[int, list[DataNode]]:
-        """Random writable vid, optionally affine to a DC/rack/node
+        """Writable vid pick, optionally affine to a DC/rack/node
         (volume_layout.go:165 PickForWrite — reservoir sampling over
-        matching replica locations when affinity is requested)."""
+        matching replica locations when affinity is requested).
+
+        `policy` (QoS plane, docs/QOS.md): "p2c" (default) runs
+        power-of-two-choices over the writable set, weighted by the
+        replica nodes' heartbeat-reported in-flight + write-queue
+        depth — near-random load balance at random-pick cost, without
+        the herd-to-the-idlest stampede a full argmin causes on stale
+        signals. "random" is the pre-QoS pure-random pick
+        (`-assignPolicy random`, and what WEED_QOS=0 restores).
+        Affinity-constrained picks keep the reservoir path (the
+        candidate set is already narrow)."""
         rng = rng or random
         with self._lock:
             if not self.writables:
                 raise ValueError("no writable volumes")
             if not data_center:
+                if policy == "p2c" and len(self.writables) > 1:
+                    a, b = rng.sample(self.writables, 2)
+                    la = self._volume_load(self.vid2location[a])
+                    lb = self._volume_load(self.vid2location[b])
+                    if la == lb:
+                        vid = a if rng.random() < 0.5 else b
+                    else:
+                        vid = a if la < lb else b
+                    # least-loaded replica leads: callers route the
+                    # first hop at locations[0]
+                    nodes = sorted(
+                        self.vid2location[vid],
+                        key=lambda dn: dn.queue_load(),
+                    )
+                    return vid, nodes
                 vid = rng.choice(self.writables)
                 return vid, list(self.vid2location[vid])
             counter = 0

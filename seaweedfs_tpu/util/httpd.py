@@ -381,6 +381,26 @@ def serve_connection(
     trace_node = getattr(server, "trace_node", "")
     gateway_metrics = getattr(server, "gateway_metrics", False)
     debug_gate = getattr(server, "debug_gate", None)
+    # QoS plane (docs/QOS.md): this dispatch funnel is the ONE place
+    # every daemon's requests pass through (including C-epoll-loop
+    # handoffs), so the in-flight load signal and per-client admission
+    # control live here. Both default to None — the common path pays
+    # one is-None check per request.
+    admission = getattr(server, "admission", None)
+    load_tracker = getattr(server, "load_tracker", None)
+    if admission is not None or load_tracker is not None:
+        def qos_dispatch(method, h, _adm=admission, _lt=load_tracker):
+            if _lt is not None:
+                _lt.enter()
+            try:
+                if _adm is not None:
+                    return _adm.gate(method, h)
+                return method(h)
+            finally:
+                if _lt is not None:
+                    _lt.exit()
+    else:
+        qos_dispatch = None
     trace_enabled = _trace.enabled
     span_open, span_close, sample_hit = _trace.connection_tracer(trace_node)
     trace_hdr_key = _trace.TRACE_HEADER
@@ -504,7 +524,10 @@ def serve_connection(
                 sp = span_open(name, hdr, length, t0)
                 h._trace_span = sp if sp else None
                 try:
-                    method(h)
+                    if qos_dispatch is None:
+                        method(h)
+                    else:
+                        qos_dispatch(method, h)
                 finally:
                     if sp:  # falsy when the tracer flipped off mid-open
                         span_close(sp, h._trace_status)
@@ -522,7 +545,10 @@ def serve_connection(
             else:
                 h._trace_span = None
                 t0 = clock()
-                method(h)
+                if qos_dispatch is None:
+                    method(h)
+                else:
+                    qos_dispatch(method, h)
                 if trace_label:
                     hist_observe(clock() - t0, trace_label, command)
                     counter_labels(
@@ -618,6 +644,13 @@ class WeedHTTPServer(ThreadingHTTPServer):
     # before serve_forever; None means every request takes the handoff
     # path into the threaded mini loop
     fast_resolver = None
+
+    # QoS plane (docs/QOS.md): the owning daemon may install a
+    # qos.admission.AdmissionController (per-client shed with 503 +
+    # Retry-After) and/or a qos.LoadTracker (in-flight count for the
+    # heartbeat load signal); None = today's behavior
+    admission = None
+    load_tracker = None
 
     def get_request(self):
         # TCP_NODELAY: keep-alive responses are written headers-then-

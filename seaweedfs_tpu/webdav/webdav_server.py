@@ -41,6 +41,10 @@ class WebDavServer:
         reuse_port: bool = False,
         serve_idle_ms: int = 0,
         serve_max_reqs: int = 0,
+        admission_rate: float = 0.0,
+        admission_burst: float = 0.0,
+        admission_inflight: int = 0,
+        admission_procs: int = 1,
     ):
         self.filer = filer
         self.host = host
@@ -55,6 +59,20 @@ class WebDavServer:
         self.reuse_port = reuse_port
         self.serve_idle_ms = serve_idle_ms
         self.serve_max_reqs = serve_max_reqs
+        # QoS plane (docs/QOS.md): per-client admission control keyed
+        # by remote address (WebDAV carries no access keys); budgets
+        # split across the -serveProcs group like the S3 gateway's
+        self.admission = None
+        if admission_rate > 0 or admission_inflight > 0:
+            from seaweedfs_tpu.qos.admission import AdmissionController
+
+            self.admission = AdmissionController(
+                rate=admission_rate,
+                burst=admission_burst,
+                max_inflight=admission_inflight,
+                procs=admission_procs,
+                label="webdav",
+            )
         self._announce: threading.Thread | None = None
         self._http_server: WeedHTTPServer | None = None
         self._channel: grpc.Channel | None = None
@@ -114,6 +132,7 @@ class WebDavServer:
         self._http_server.trace_name = "webdav"
         self._http_server.trace_node = f"{self.host}:{self.port}"
         self._http_server.gateway_metrics = True
+        self._http_server.admission = self.admission
         threading.Thread(
             target=self._http_server.serve_forever, daemon=True, name="webdav-http"
         ).start()
