@@ -2293,6 +2293,54 @@ def check_contracts_smoke() -> int:
     return 0 if ok else 1
 
 
+def check_crash_smoke() -> int:
+    """`bench.py --check` crash leg (docs/ANALYSIS.md v3): the
+    durability lint must DETECT a planted missing-fsync publish (a
+    checker that silently goes blind is worse than none), the dynamic
+    enumerator must DETECT the planted unsynced tmp+rename bug, and
+    one real enumerator pass over a tiny volume's group-commit trace
+    must come back with zero recovery-invariant violations."""
+    import tempfile
+    import textwrap
+
+    from seaweedfs_tpu.analysis import crash, crashlint
+
+    with tempfile.TemporaryDirectory() as td:
+        root = os.path.join(td, "fixturepkg")
+        os.makedirs(root)
+        with open(os.path.join(root, "__init__.py"), "w") as f:
+            f.write("")
+        with open(os.path.join(root, "pub.py"), "w") as f:
+            f.write(textwrap.dedent("""
+                import os
+
+                def publish(path):
+                    tmp = path + ".tmp"
+                    with open(tmp, "w") as f:
+                        f.write("x")
+                    os.replace(tmp, path)
+            """))
+        lint_findings, _idx = crashlint.check(root=root)
+    lint_hit = any(
+        f.rule == "crash-rename-unsynced-src" for f in lint_findings
+    ) and any(f.rule == "crash-rename-no-dirsync" for f in lint_findings)
+    dynamic_hit = bool(crash.run_broken_publish(budget=48).violations)
+    sweep_rep = crash.run_group_commit(budget=64)
+    sweep_ok = (
+        sweep_rep.violations == [] and sweep_rep.states_tested >= 24
+    )
+    ok = lint_hit and dynamic_hit and sweep_ok
+    print(json.dumps({
+        "metric": "crash_smoke",
+        "ok": ok,
+        "planted_lint_detected": lint_hit,
+        "planted_dynamic_detected": dynamic_hit,
+        "group_commit_states_tested": sweep_rep.states_tested,
+        "group_commit_violations": sweep_rep.violations[:3],
+    }))
+    return 0 if ok else 1
+
+
 def check_qos_smoke() -> int:
     """`bench.py --check` qos leg (docs/QOS.md): a hedged GET against a
     stalled replica must win via the hedge (correct bytes, fired+won
@@ -2465,6 +2513,7 @@ def main() -> None:
         if os.environ.get("WEED_BENCH_CHECK_INNER") != "1":
             rc = rc or check_weedlint()
             rc = rc or check_contracts_smoke()
+            rc = rc or check_crash_smoke()
             rc = rc or check_sanitizer_smoke()
         raise SystemExit(rc)
     config = sys.argv[1] if len(sys.argv) > 1 else "all"

@@ -29,6 +29,18 @@ rather than generic style lint:
   fuzz_post   structured fuzzer hammering the C multipart/POST parser
               against the byte-identical Python fallback; diverging
               or crashing inputs persist to tests/corpus/
+  crashlint   crash-consistency durability-order lint (v3): rename
+              without fsync of file+parent dir, fsync-after-close,
+              .idx published before its .dat write, unflushed rename
+              sources, recovery-critical state mutated outside the
+              tmp + durable.publish idiom
+  crash       the DYNAMIC crash plane: records a live workload's
+              effect trace (pwrite/pwritev/fsync/rename shim),
+              enumerates every legal post-crash disk state (prefix
+              writes, torn final write, renames landing before data),
+              and re-runs real recovery against each one asserting
+              no acked needle lost / no torn record valid / idx never
+              past .dat
 
 CLI: `python -m seaweedfs_tpu.analysis` (exit 0 = clean tree).
 

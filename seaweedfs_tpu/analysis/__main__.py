@@ -54,6 +54,12 @@ _FAMILIES = {
         "fd/socket/thread acquire-release pairing: early-return leaks, "
         "started-never-joined threads (interprocedural, owns[] aware)"
     ),
+    "crash": (
+        "crash-consistency durability ordering: write-then-rename "
+        "without fsync of file + parent dir, fsync-after-close, .idx "
+        "publish before its .dat write, unflushed os.replace sources, "
+        "recovery-critical state mutated outside atomic publish"
+    ),
 }
 
 
@@ -134,6 +140,7 @@ def main(argv: list[str] | None = None) -> int:
             ]
     if index is None and (
         active("hot-loop") or active("contracts") or active("lifecycle")
+        or active("crash")
     ):
         # these tiers only need the package index, not the full
         # lock-graph/cycle/unguarded-write analyses
@@ -155,6 +162,11 @@ def main(argv: list[str] | None = None) -> int:
 
         life_findings, index = lifecycle.check(index=index)
         findings += life_findings
+    if active("crash"):
+        from seaweedfs_tpu.analysis import crashlint
+
+        crash_findings, index = crashlint.check(index=index)
+        findings += crash_findings
     if active("c"):
         from seaweedfs_tpu.analysis import ctier
 

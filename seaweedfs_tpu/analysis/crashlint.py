@@ -1,0 +1,423 @@
+"""weedlint v3: the crash-consistency (durability-order) lint.
+
+PR 9's group commit and PR 2's quarantine/rebuild plane make hard
+durability claims ("idx entries only after the batch write", "rename so
+rebuild regenerates") that until now lived in comments. This tier turns
+the ordering rules themselves into machine-checked contracts, the way
+lockorder turned "take the volume lock" into one. The model is the
+ALICE observation (PAPERS.md, arXiv:1309.0186 context): a crash
+preserves an arbitrary prefix-consistent subset of un-fsynced work, so
+any publish that relies on ordering the kernel never promised is a
+latent data-loss bug that only fires in the field.
+
+Rules (all statically checked per function, line-order sensitive):
+
+  crash-rename-unsynced-src   os.replace/os.rename whose source file
+                              was written in the same function with no
+                              fsync of those bytes before the rename —
+                              a crash can publish an empty or partial
+                              file under the final name
+  crash-rename-no-dirsync     a rename with no parent-directory fsync
+                              after it — the rename itself may not
+                              survive the crash (durable.publish and
+                              durable.fsync_dir are the recognized
+                              idioms)
+  crash-fsync-after-close     fsync/flush of a handle after it was
+                              closed — the barrier silently became a
+                              no-op (or an EBADF) and everything
+                              ordered "after" it is unordered
+  crash-idx-before-dat        (storage/ only) a needle-map/.idx publish
+                              ordered before the .dat write it indexes
+                              — a crash between them surfaces an index
+                              entry for bytes that never landed
+  crash-replace-unflushed     os.replace of a file whose writing handle
+                              is still open with no flush/close — the
+                              rename publishes the OS-level bytes,
+                              which may be missing the Python buffer
+  crash-critical-write        recovery-critical state (scrub_state.json,
+                              .vif) opened for direct in-place write
+                              instead of the tmp + atomic-publish idiom
+
+Precision over recall, like every weedlint tier: path expressions are
+matched structurally (same unparsed expression, or a local variable
+holding it); anything the pass cannot resolve is not a finding.
+Suppressions use the standard `# weedlint: ignore[rule] — reason`
+grammar and the `--stale-suppressions` audit.
+
+The dynamic complement — recording a live workload's effect trace and
+re-running recovery against every legal post-crash state — lives in
+analysis/crash.py (docs/ANALYSIS.md v3).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from seaweedfs_tpu.analysis import Finding, dotted_name as _dotted
+from seaweedfs_tpu.analysis.lockorder import PackageIndex, build_index
+
+# Structural exemptions (module-path prefix -> mandatory reason), the
+# hotloop._EXEMPT_QUALS convention: the durable helpers ARE the
+# publish idiom the rules point at, and the crash-state enumerator
+# deliberately materializes arbitrary (including torn) disk states.
+_EXEMPT_PATHS: dict[str, str] = {
+    "seaweedfs_tpu/util/durable.py": (
+        "the fsync/rename/dirsync publish idiom itself — the helper "
+        "every rule resolves to (docs/ANALYSIS.md v3)"
+    ),
+    "seaweedfs_tpu/analysis/crash.py": (
+        "the crash-state enumerator: materializing legal POST-crash "
+        "states (including torn and unsynced ones) is its purpose"
+    ),
+}
+
+# basenames whose direct overwrite is a crash window for recovery
+# itself (the scrub cursor and the tier metadata are what restart
+# reads first); publishes must go through tmp + durable.publish
+_CRITICAL_NAMES = ("scrub_state.json", ".vif")
+
+_WRITE_MODES = ("w", "x", "a", "+")
+
+
+def _is_write_mode(mode: str) -> bool:
+    return any(m in mode for m in _WRITE_MODES) and "r" != mode
+
+
+def _expr_keys(node: ast.expr) -> set[str]:
+    """Structural identity keys for a path expression: its unparsed
+    text, plus the bare name when it is one (so `tmp = p + ".t"` /
+    `open(tmp)` / `os.replace(tmp, p)` all meet)."""
+    keys = set()
+    try:
+        keys.add(ast.unparse(node))
+    except Exception:  # pragma: no cover - unparse is total on stdlib ast
+        pass
+    if isinstance(node, ast.Name):
+        keys.add(node.id)
+    return keys
+
+
+def _const_parts(node: ast.expr) -> list[str]:
+    """Every literal string fragment inside a path expression
+    (concats, f-strings, os.path.join args)."""
+    out: list[str] = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.append(n.value)
+    return out
+
+
+class _Op:
+    __slots__ = ("kind", "line", "keys", "var", "extra")
+
+    def __init__(self, kind, line, keys=frozenset(), var=None, extra=None):
+        self.kind = kind
+        self.line = line
+        self.keys = set(keys)
+        self.var = var
+        self.extra = extra
+
+
+def _iter_stmts_excluding_defs(body: list[ast.stmt]):
+    """Walk statements without descending into nested function/class
+    definitions (those are scanned as their own units)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Lambda):
+                continue
+            stack.append(child)
+
+
+def _collect_ops(body: list[ast.stmt], var_exprs: dict[str, set[str]]
+                 ) -> list[_Op]:
+    """One linear pass over a function body: every durability-relevant
+    operation with its line and structural path keys."""
+    ops: list[_Op] = []
+    for node in _iter_stmts_excluding_defs(body):
+        # var = <expr> : remember what path expression a local holds
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+            if isinstance(node.value, ast.Call):
+                call = node.value
+                dotted = _dotted(call.func)
+                if dotted.rsplit(".", 1)[-1] == "open" and call.args:
+                    mode = ""
+                    if len(call.args) > 1:
+                        m = call.args[1]
+                        if isinstance(m, ast.Constant) and isinstance(
+                            m.value, str
+                        ):
+                            mode = m.value
+                    for kw in call.keywords:
+                        if kw.arg == "mode" and isinstance(
+                            kw.value, ast.Constant
+                        ):
+                            mode = str(kw.value.value)
+                    keys = _expr_keys(call.args[0])
+                    for k in list(keys):
+                        keys |= var_exprs.get(k, set())
+                    ops.append(_Op(
+                        "open", node.lineno, keys, var=target,
+                        extra={
+                            "mode": mode,
+                            "with": False,
+                            "consts": _const_parts(call.args[0]),
+                            "os_open": dotted.startswith("os."),
+                        },
+                    ))
+                    continue
+                # any other call result rebinds the name: a close mark
+                # on the old value must not follow the new one
+                ops.append(_Op("assign", node.lineno, var=target))
+                continue
+            else:
+                var_exprs[target] = _expr_keys(node.value)
+                ops.append(_Op("assign", node.lineno, var=target))
+                continue
+        if isinstance(node, ast.withitem) and isinstance(
+            node.context_expr, ast.Call
+        ):
+            call = node.context_expr
+            dotted = _dotted(call.func)
+            if dotted.rsplit(".", 1)[-1] == "open" and call.args:
+                mode = ""
+                if len(call.args) > 1 and isinstance(
+                    call.args[1], ast.Constant
+                ) and isinstance(call.args[1].value, str):
+                    mode = call.args[1].value
+                for kw in call.keywords:
+                    if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                        mode = str(kw.value.value)
+                var = (
+                    node.optional_vars.id
+                    if isinstance(node.optional_vars, ast.Name)
+                    else None
+                )
+                keys = _expr_keys(call.args[0])
+                for k in list(keys):
+                    keys |= var_exprs.get(k, set())
+                ops.append(_Op(
+                    "open", call.lineno, keys, var=var,
+                    extra={
+                        "mode": mode, "with": True,
+                        "consts": _const_parts(call.args[0]),
+                        "os_open": False,
+                    },
+                ))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        tail = dotted.rsplit(".", 1)[-1]
+        if dotted in ("os.replace", "os.rename") and len(node.args) >= 2:
+            src_keys = _expr_keys(node.args[0])
+            for k in list(src_keys):
+                src_keys |= var_exprs.get(k, set())
+            ops.append(_Op("rename", node.lineno, src_keys))
+        elif dotted == "os.fsync" and node.args:
+            arg = node.args[0]
+            # os.fsync(f.fileno()) -> barrier on f's file; os.fsync(fd)
+            # -> barrier on the fd variable
+            if (
+                isinstance(arg, ast.Call)
+                and isinstance(arg.func, ast.Attribute)
+                and arg.func.attr == "fileno"
+            ):
+                ops.append(_Op(
+                    "fsync", node.lineno, var=_dotted(arg.func.value) or None
+                ))
+            else:
+                ops.append(_Op(
+                    "fsync", node.lineno, _expr_keys(arg),
+                    var=_dotted(arg) or None,
+                ))
+        elif tail == "fsync_path" and node.args:
+            keys = _expr_keys(node.args[0])
+            for k in list(keys):
+                keys |= var_exprs.get(k, set())
+            ops.append(_Op("fsync", node.lineno, keys))
+        elif tail == "fsync_dir":
+            ops.append(_Op("dirsync", node.lineno))
+        elif tail == "publish" and (
+            dotted in ("publish", "durable.publish")
+            or dotted.endswith(".durable.publish")
+        ) and len(node.args) >= 2:
+            # durable.publish = fsync(src) + rename + dirsync in one
+            keys = _expr_keys(node.args[0])
+            for k in list(keys):
+                keys |= var_exprs.get(k, set())
+            ops.append(_Op("fsync", node.lineno, keys))
+            ops.append(_Op("dirsync", node.lineno))
+        elif dotted == "os.close" and node.args:
+            ops.append(_Op("close", node.lineno, var=_dotted(node.args[0]) or None))
+        elif tail == "close" and isinstance(node.func, ast.Attribute):
+            ops.append(_Op("close", node.lineno, var=_dotted(node.func.value) or None))
+        elif tail == "flush" and isinstance(node.func, ast.Attribute):
+            ops.append(_Op("flush", node.lineno, var=_dotted(node.func.value) or None))
+        elif dotted in ("os.pwrite", "os.pwritev") or tail == "_append_blob":
+            ops.append(_Op("dat-write", node.lineno))
+        elif tail in ("put", "delete", "_append_index") and isinstance(
+            node.func, ast.Attribute
+        ):
+            recv = _dotted(node.func.value)
+            if recv.endswith("nm") or tail == "_append_index":
+                ops.append(_Op("idx-publish", node.lineno))
+    ops.sort(key=lambda o: o.line)
+    return ops
+
+
+def _scan_unit(path: str, body: list[ast.stmt], in_storage: bool,
+               qual: str) -> list[Finding]:
+    var_exprs: dict[str, set[str]] = {}
+    ops = _collect_ops(body, var_exprs)
+    findings: list[Finding] = []
+    opens = [o for o in ops if o.kind == "open"]
+    renames = [o for o in ops if o.kind == "rename"]
+    fsyncs = [o for o in ops if o.kind == "fsync"]
+    dirsyncs = [o for o in ops if o.kind == "dirsync"]
+
+    def file_barriers(open_op: _Op) -> list[int]:
+        """Lines at which open_op's bytes were fsynced (by path key or
+        through its handle variable)."""
+        lines = []
+        for f in fsyncs:
+            if f.keys & open_op.keys:
+                lines.append(f.line)
+            elif f.var and open_op.var and f.var == open_op.var:
+                lines.append(f.line)
+        return lines
+
+    for rn in renames:
+        # --- crash-rename-unsynced-src --------------------------------
+        written = [
+            o for o in opens
+            if o.line < rn.line and o.keys & rn.keys
+            and _is_write_mode(o.extra["mode"])
+        ]
+        if written:
+            src_open = written[-1]
+            synced = any(
+                src_open.line <= line <= rn.line
+                for line in file_barriers(src_open)
+            )
+            if not synced:
+                findings.append(Finding(
+                    "crash-rename-unsynced-src", path, rn.line,
+                    f"{qual}: source file written at line {src_open.line} "
+                    f"is renamed with no fsync of its bytes first — a "
+                    f"crash can publish an empty or partial file under "
+                    f"the destination name (use util.durable.publish)",
+                ))
+            # --- crash-replace-unflushed ------------------------------
+            if not src_open.extra["with"] and src_open.var:
+                closed = any(
+                    o.kind in ("close", "flush")
+                    and o.var == src_open.var
+                    and src_open.line <= o.line <= rn.line
+                    for o in ops
+                ) or synced
+                if not closed:
+                    findings.append(Finding(
+                        "crash-replace-unflushed", path, rn.line,
+                        f"{qual}: renaming a file whose writing handle "
+                        f"(line {src_open.line}) was neither flushed nor "
+                        f"closed — the rename publishes OS-level bytes "
+                        f"that may be missing the Python buffer",
+                    ))
+        # --- crash-rename-no-dirsync ----------------------------------
+        if not any(d.line >= rn.line for d in dirsyncs):
+            findings.append(Finding(
+                "crash-rename-no-dirsync", path, rn.line,
+                f"{qual}: rename is never followed by a parent-directory "
+                f"fsync in this function — the rename itself may not "
+                f"survive a crash (durable.fsync_dir / durable.publish)",
+            ))
+
+    # --- crash-fsync-after-close --------------------------------------
+    closes: dict[str, int] = {}
+    for o in ops:
+        if o.kind == "close" and o.var:
+            closes[o.var] = o.line
+        elif o.kind in ("open", "assign") and o.var in closes:
+            del closes[o.var]  # rebound/reopened: the close mark is stale
+        elif o.kind in ("fsync", "flush") and o.var and o.var in closes:
+            findings.append(Finding(
+                "crash-fsync-after-close", path, o.line,
+                f"{qual}: {o.kind} of {o.var!r} after its close at line "
+                f"{closes[o.var]} — the durability barrier is a no-op "
+                f"and everything ordered after it is unordered",
+            ))
+
+    # --- crash-idx-before-dat (storage/ only) -------------------------
+    if in_storage:
+        dat_lines = [o.line for o in ops if o.kind == "dat-write"]
+        idx_lines = [o.line for o in ops if o.kind == "idx-publish"]
+        if dat_lines and idx_lines and min(idx_lines) < min(dat_lines):
+            findings.append(Finding(
+                "crash-idx-before-dat", path, min(idx_lines),
+                f"{qual}: needle-map/.idx publish at line "
+                f"{min(idx_lines)} precedes the first .dat write at "
+                f"line {min(dat_lines)} — a crash between them surfaces "
+                f"an index entry for bytes that never landed",
+            ))
+
+    # --- crash-critical-write -----------------------------------------
+    for o in opens:
+        if not _is_write_mode(o.extra["mode"]) or "a" in o.extra["mode"]:
+            continue
+        consts = o.extra["consts"]
+        if any(
+            crit in c for c in consts for crit in _CRITICAL_NAMES
+        ) and not any(".tmp" in c for c in consts):
+            findings.append(Finding(
+                "crash-critical-write", path, o.line,
+                f"{qual}: recovery-critical state opened for direct "
+                f"in-place write — a crash mid-write leaves a torn file "
+                f"where restart recovery reads first; write a .tmp and "
+                f"durable.publish it",
+            ))
+    return findings
+
+
+def check(root: str | None = None, index: PackageIndex | None = None
+          ) -> tuple[list[Finding], PackageIndex]:
+    index = index or build_index(root)
+    findings: list[Finding] = []
+    for path, source in sorted(index.sources.items()):
+        if path.replace("\\", "/") in _EXEMPT_PATHS:
+            continue
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:  # pragma: no cover - index already parsed it
+            continue
+        in_storage = "/storage/" in path.replace("\\", "/")
+        module_qual = path.replace("\\", "/")
+        # module level (rare but legal place for a publish)
+        findings += _scan_unit(
+            path,
+            [n for n in tree.body],
+            in_storage,
+            f"{module_qual} (module level)",
+        )
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings += _scan_unit(
+                    path, node.body, in_storage, node.name
+                )
+    # one site can surface through module-level AND nested walks
+    seen: set[tuple[str, int, str]] = set()
+    out: list[Finding] = []
+    for f in findings:
+        key = (f.path, f.line, f.rule)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out, index

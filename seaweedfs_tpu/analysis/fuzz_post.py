@@ -372,6 +372,7 @@ def run(
                 )
                 if persist_divergent:
                     name = _case_name(case, "div")
+                    # weedlint: ignore[crash-rename-no-dirsync,crash-rename-unsynced-src] — forensic corpus artifact; persistence is best-effort and the fuzzer reruns
                     os.replace(pending, os.path.join(corpus_dir, name))
                     report.corpus_written.append(name)
     finally:

@@ -35,6 +35,7 @@ import grpc
 
 from seaweedfs_tpu.pb import raft_pb2 as rpb
 from seaweedfs_tpu.pb import rpc
+from seaweedfs_tpu.util import durable
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -131,7 +132,10 @@ class RaftNode:
                 },
                 f,
             )
-        os.replace(tmp, path)
+        # durable publish: a vote or term bump that does not survive
+        # the crash lets this node vote twice in one term — the one
+        # thing Raft's safety argument forbids
+        durable.publish(tmp, path)
 
     # ------------------------------------------------------------------
     def start(self) -> None:

@@ -486,9 +486,11 @@ class VolumeCommand(Command):
             admission_rate=args.admissionRate,
             admission_burst=args.admissionBurst,
             admission_inflight=args.admissionInflight,
-            # the lead enforces the whole budget it sees; -workers read
-            # processes serve un-gated (docs/QOS.md limitation note)
-            admission_procs=1,
+            # the read workers enforce admission too (each SO_REUSEPORT
+            # member sees ~1/workers of the connections), so the whole
+            # group divides the configured per-client budget by its
+            # size — the same convention -serveProcs siblings use
+            admission_procs=args.admissionProcs or workers,
         )
         from seaweedfs_tpu.util.profiling import CpuProfile
 
@@ -508,6 +510,10 @@ class VolumeCommand(Command):
                     n_writers=workers,
                     master=args.mserver,
                     internal_base=internal_port,
+                    admission_rate=args.admissionRate,
+                    admission_burst=args.admissionBurst,
+                    admission_inflight=args.admissionInflight,
+                    admission_procs=args.admissionProcs or workers,
                 )
             wlog.info(
                 "volume server %s:%d -> master %s (%d worker(s))",
@@ -558,6 +564,7 @@ class VolumeWorkerCommand(Command):
             "-internalPort", type=int, default=0,
             help="loopback listener port for trusted worker hops",
         )
+        _add_admission_flags(p)
         _add_trace_flags(p)
         p.add_argument(
             "-v", type=int, default=0,
@@ -583,6 +590,12 @@ class VolumeWorkerCommand(Command):
             # same security.toml as the lead: sharded local writes
             # enforce the identical JWT/white-list gate
             guard=_load_guard(),
+            admission_rate=args.admissionRate,
+            admission_burst=args.admissionBurst,
+            admission_inflight=args.admissionInflight,
+            # spawn passes the group size explicitly; a bare-launched
+            # worker defaults to enforcing the full budget alone
+            admission_procs=args.admissionProcs or 1,
         )
         worker.start()
         try:

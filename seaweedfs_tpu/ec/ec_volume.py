@@ -37,6 +37,7 @@ from seaweedfs_tpu.storage.needle import Needle, get_actual_size
 from seaweedfs_tpu.storage.needle_map import SortedNeedleMap
 from seaweedfs_tpu.storage.volume import NeedleNotFound, volume_base_name
 from seaweedfs_tpu.util import wlog
+from seaweedfs_tpu.util import durable
 
 # fetch(shard_id, offset, size) -> bytes | None. Returning None means
 # the shard is unavailable everywhere (candidates exhausted).
@@ -262,6 +263,11 @@ class EcVolume:
             self.unmount_shard(shard_id)
             try:
                 os.replace(shard.path, shard.path + ".bad")
+                # dir fsync: the quarantine decision must survive a
+                # crash — a resurrected corrupt shard would be remounted
+                # at restart and silently skip regeneration (rebuild
+                # keys off the shard file being MISSING)
+                durable.fsync_dir(self.directory)
             except OSError:
                 pass  # vanished/unwritable dir: unmount still protects
             self.quarantined[shard_id] = reason
@@ -306,6 +312,8 @@ class EcVolume:
             self.unmount_shard(shard_id)
             try:
                 os.replace(shard.path, shard.path + ".bad")
+                # same dir-fsync contract as quarantine_shard above
+                durable.fsync_dir(self.directory)
             except OSError:
                 pass
             reason = f"truncated: {actual} bytes, nominal {nominal}"

@@ -40,6 +40,7 @@ import threading
 import zlib
 
 from seaweedfs_tpu.filer.entry import Entry, normalize_path, split_path
+from seaweedfs_tpu.util import durable
 from seaweedfs_tpu.filer.filerstore import EntryNotFound, FilerStore
 
 _PUT, _DEL = 1, 2
@@ -140,6 +141,7 @@ class _SSTable:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        durable.fsync_dir(os.path.dirname(path) or ".")
 
     def _scan_from(self, offset: int):
         """Yield (key, op, value) records starting at a record offset.
@@ -270,6 +272,7 @@ class LsmStore(FilerStore):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._manifest_path())
+        durable.fsync_dir(self._dir)
 
     def _replay_wal(self) -> None:
         if not os.path.exists(self._wal_path):

@@ -185,6 +185,7 @@ def _compile(src: str, so: str, deps: tuple[str, ...], includes: tuple[str, ...]
                                 RuntimeWarning,
                                 stacklevel=2,
                             )
+                        # weedlint: ignore[crash-rename-no-dirsync] — rebuildable .so cache artifact; a lost publish recompiles on next import
                         os.replace(tmp, so)
                         return so
                     if b"-Werror" not in proc.stderr:

@@ -412,6 +412,14 @@ static int weed_conn_process(weed_loop *lp, weed_conn *c) {
             break;
         }
         size_t head_len = (size_t)he + 4 - c->rpos;
+        if (head_len > WEED_SERVE_HEAD_LIMIT) {
+            /* a COMPLETE head past the cap: the incomplete-head check
+             * above never fires when the whole head coalesced into one
+             * buffered read — hand off so Python's read_head replies
+             * 431 instead of serving the oversized request as 200 */
+            weed_conn_handoff(lp, c);
+            return -1;
+        }
 
         double tp0 = weed_now_s();
         weed_req req;

@@ -22,6 +22,7 @@ import time
 from collections import deque
 
 from seaweedfs_tpu.pb import filer_pb2 as fpb
+from seaweedfs_tpu.util import durable
 from seaweedfs_tpu.util import wlog
 
 queue = None  # process-wide, set by configure() (notification.Queue role)
@@ -103,7 +104,9 @@ class DirQueue(NotificationQueue):
         final = os.path.join(self.dir, f"{seq:020d}.msg")
         with open(tmp, "wb") as f:
             f.write(len(payload).to_bytes(4, "big") + payload + blob)
-        os.replace(tmp, final)  # atomic publish
+        # atomic + durable publish: consumers treat presence of the
+        # .msg name as "event fired"; a crash must not un-fire it
+        durable.publish(tmp, final)
 
     def consume(self, after_seq: int = 0):
         """Yield (seq, key, message) for every message with seq >

@@ -36,6 +36,7 @@ import time
 import zlib
 
 from seaweedfs_tpu.pb import filer_pb2 as fpb
+from seaweedfs_tpu.util import durable
 from seaweedfs_tpu.util import wlog
 
 _REC = struct.Struct("<II")  # payload length, crc32
@@ -193,9 +194,10 @@ class _Partition:
         tmp = p + ".tmp"
         with open(tmp, "w") as f:
             f.write(str(offset))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, p)
+        # durable publish: a lost cursor re-delivers from the previous
+        # commit (at-least-once holds), but a TORN one parse-fails and
+        # restarts the group from zero
+        durable.publish(tmp, p)
 
     def groups(self) -> list[str]:
         return os.listdir(os.path.join(self.dir, "offsets"))
@@ -304,7 +306,10 @@ class PartitionedLogQueue:
             tmp = meta_path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump({"partitions": partitions}, f)
-            os.replace(tmp, meta_path)
+            # partition count is immutable once chosen; the meta file
+            # must survive the crash or a restart re-partitions and
+            # strands every queued message
+            durable.publish(tmp, meta_path)
         self.partitions = [
             _Partition(os.path.join(directory, f"p{i:03d}"), segment_bytes)
             for i in range(partitions)

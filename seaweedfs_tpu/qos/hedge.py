@@ -133,6 +133,65 @@ class _ConnPool:
 _POOL = _ConnPool()
 
 
+class _AttemptPool:
+    """Reusable attempt workers (ROADMAP tail-latency follow-on): every
+    hedged-capable chunk GET used to spawn 1–2 fresh threads — ~100 µs
+    each, noise at ms-scale network reads but pure overhead at high
+    fan-out. This is a cached pool: submit() hands the task to a parked
+    idle worker when one exists, else starts a new thread that runs the
+    task and then PARKS (up to `_MAX_IDLE`; beyond that it exits). A
+    hedge never queues behind a busy worker — the fresh-thread fallback
+    keeps the fire latency of the old code while the steady state
+    recycles the same few threads."""
+
+    _MAX_IDLE = 8
+    _IDLE_S = 30.0
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._idle: list[queue.SimpleQueue] = []
+        self.spawned = 0  # lifetime thread count (leak-baseline tests)
+
+    def submit(self, fn, *args) -> None:
+        with self._lock:
+            q = self._idle.pop() if self._idle else None
+        if q is not None:
+            q.put((fn, args))
+            return
+        q = queue.SimpleQueue()
+        q.put((fn, args))
+        with self._lock:
+            self.spawned += 1
+        threading.Thread(
+            target=self._worker, args=(q,), daemon=True,
+            name="weed-hedge-worker",
+        ).start()
+
+    def _worker(self, q: "queue.SimpleQueue") -> None:
+        while True:
+            try:
+                fn, args = q.get(timeout=self._IDLE_S)
+            except queue.Empty:
+                with self._lock:
+                    if q in self._idle:
+                        self._idle.remove(q)
+                        return
+                # a submitter claimed this queue between the timeout
+                # and the lock: its task is (or is about to be) queued
+                fn, args = q.get()
+            try:
+                fn(*args)
+            except BaseException:  # noqa: BLE001 — attempts report via out_q
+                pass
+            with self._lock:
+                if len(self._idle) >= self._MAX_IDLE:
+                    return
+                self._idle.append(q)
+
+
+_ATTEMPTS = _AttemptPool()
+
+
 class _Attempt:
     """One in-flight GET try. cancel() is safe against the completion
     race: the owning thread marks `finished` under the same lock before
@@ -256,10 +315,7 @@ def download(
         trace.inject(base_headers)
         primary = _Attempt(0, urls[0])
         attempts = [primary]
-        threading.Thread(
-            target=primary.run, args=(base_headers, timeout, out_q),
-            daemon=True,
-        ).start()
+        _ATTEMPTS.submit(primary.run, base_headers, timeout, out_q)
 
         def fire_hedge():
             # the second (tied) attempt: hop header stamped, counted as
@@ -273,9 +329,7 @@ def download(
             h2[qos.HEDGE_HEADER] = "1"
             second = _Attempt(1, urls[1])
             attempts.append(second)
-            threading.Thread(
-                target=second.run, args=(h2, timeout, out_q), daemon=True
-            ).start()
+            _ATTEMPTS.submit(second.run, h2, timeout, out_q)
 
         delay = TRACKER.delay_s(key)
         t0 = _time.perf_counter()

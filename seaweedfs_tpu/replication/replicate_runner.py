@@ -10,6 +10,7 @@ from seaweedfs_tpu.replication.replicator import Replicator
 from seaweedfs_tpu.replication.sink import FilerSink, LocalSink, S3Sink
 from seaweedfs_tpu.replication.source import FilerSource
 from seaweedfs_tpu.util import wlog
+from seaweedfs_tpu.util import durable
 from seaweedfs_tpu.util.config import load_config, Configuration
 
 
@@ -208,7 +209,9 @@ class _KafkaOffsetAdapter:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             f.write(str(next_offset))
-        os.replace(tmp, path)
+        # same contract as logqueue.commit: lost = re-replicate (safe,
+        # idempotent PUTs), torn = parse failure on restart
+        durable.publish(tmp, path)
 
     def trim(self) -> int:
         return 0  # retention is the broker's concern

@@ -15,6 +15,8 @@ import os
 import threading
 from dataclasses import asdict, dataclass, field
 
+from seaweedfs_tpu.util import durable
+
 
 @dataclass
 class VolumeScrubHealth:
@@ -104,7 +106,11 @@ class ScrubState:
         try:
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(payload, f)
-            os.replace(tmp, self.path)
+            # fsync + rename + dir fsync: the cursor file is the first
+            # thing restart recovery reads — a torn or lost publish
+            # would restart every in-flight sweep from zero (or worse,
+            # parse-fail and reset health history)
+            durable.publish(tmp, self.path)
         except OSError:
             # a disk too sick to persist scrub state is a disk the
             # sweep itself will report on; never crash the engine here

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import threading
 
+from seaweedfs_tpu.util import durable
+
 
 class MemorySequencer:
     def __init__(self, start: int = 1):
@@ -78,9 +80,10 @@ class FileSequencer:
         tmp = self._path + ".tmp"
         with open(tmp, "w") as f:
             f.write(str(self._reserved))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._path)
+        # fsync + rename + dir fsync: a reservation that does not
+        # survive the crash can re-issue file ids the old process
+        # already handed out
+        durable.publish(tmp, self._path)
 
     def next_file_id(self, count: int = 1) -> int:
         with self._lock:

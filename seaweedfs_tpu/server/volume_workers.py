@@ -262,11 +262,33 @@ class VolumeReadWorker:
         master: str = "",
         internal_port: int = 0,
         guard=None,
+        admission_rate: float = 0.0,
+        admission_burst: float = 0.0,
+        admission_inflight: int = 0,
+        admission_procs: int = 1,
     ):
         self.directories = directories
         self.host = host
         self.port = port
         self.lead = lead  # host:port of the lead's internal listener
+        # QoS admission control (docs/QOS.md): workers share the
+        # configured per-client budget the same way -admissionProcs
+        # splits it for SO_REUSEPORT gateway siblings — the kernel
+        # spreads accepted connections uniformly across the group, so
+        # each member enforces rate/N. Before this, only the lead
+        # gated and N-1 of every N connections bypassed admission
+        # entirely (ROADMAP tail-latency follow-on).
+        self.admission = None
+        if admission_rate > 0 or admission_inflight > 0:
+            from seaweedfs_tpu.qos.admission import AdmissionController
+
+            self.admission = AdmissionController(
+                rate=admission_rate,
+                burst=admission_burst,
+                max_inflight=admission_inflight,
+                procs=admission_procs,
+                label=f"volume-worker-{writer_index}",
+            )
         self.worker_port = worker_port  # optional private listener (tests)
         # -shardWrites: this worker OWNS writes for vids with
         # vid % n_writers == writer_index (lead is writer 0) — see
@@ -741,6 +763,11 @@ class VolumeReadWorker:
             # shard-hop write reads worker→lead→replica in one trace
             s.trace_name = "worker"
             s.trace_node = f"{self.host}:{self.port}#w{self.writer_index}"
+            # admission gates the PUBLIC surfaces only: the internal
+            # release/control listener is a trusted lead↔worker hop —
+            # shedding it could wedge an ownership handback mid-admin-op
+            if s is not self._internal_server:
+                s.admission = self.admission
             t = threading.Thread(target=s.serve_forever, daemon=True)
             t.start()
             self._threads.append(t)
@@ -804,6 +831,10 @@ def spawn_read_workers(
     n_writers: int = 1,
     master: str = "",
     internal_base: int = 0,
+    admission_rate: float = 0.0,
+    admission_burst: float = 0.0,
+    admission_inflight: int = 0,
+    admission_procs: int = 1,
 ) -> list:
     """Lead-side helper: launch n worker subprocesses sharing host:port
     (writer indices 1..n; the lead is writer 0). Returns the Popen
@@ -829,6 +860,15 @@ def spawn_read_workers(
         ]
         if worker_port_base:
             cmd += ["-workerPort", str(worker_port_base + k)]
+        if admission_rate > 0 or admission_inflight > 0:
+            # each group member (lead included) enforces 1/procs of the
+            # per-client budget — the SO_REUSEPORT sibling convention
+            cmd += [
+                "-admissionRate", str(admission_rate),
+                "-admissionBurst", str(admission_burst),
+                "-admissionInflight", str(admission_inflight),
+                "-admissionProcs", str(admission_procs),
+            ]
         if shard_writes:
             cmd += [
                 "-shardWrites",

@@ -66,12 +66,18 @@ class DiskLocation:
             if vid in self.volumes:
                 continue
             try:
+                # repair: server startup is the exclusive owner of
+                # these files — the one safe point to roll a crashed
+                # vacuum commit forward/back and heal torn tails
+                # (docs/ANALYSIS.md v3); follower/worker opens must
+                # never pass it
                 self.volumes[vid] = Volume(
                     self.directory,
                     vid,
                     collection,
                     create=False,
                     needle_map_kind=self.needle_map_kind,
+                    repair=True,
                 )
             except (OSError, ValueError):
                 continue  # unloadable volume; reference logs and skips
@@ -130,6 +136,9 @@ class DiskLocation:
                 continue
             collection = parsed[0]
             try:
+                # no repair here: a runtime remount can race a live
+                # -shardWrites worker appending to the same files —
+                # only the startup load (above) is provably exclusive
                 self.volumes[vid] = Volume(
                     self.directory,
                     vid,

@@ -38,6 +38,7 @@ from seaweedfs_tpu.storage.needle_map import CompactNeedleMap, NeedleValue
 from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
 from seaweedfs_tpu.storage.super_block import CURRENT_VERSION, SuperBlock
 from seaweedfs_tpu.storage.ttl import TTL
+from seaweedfs_tpu.util import durable, wlog
 
 
 class NeedleNotFound(KeyError):
@@ -114,6 +115,7 @@ class Volume:
         version: int = CURRENT_VERSION,
         create: bool = True,
         needle_map_kind: str = "memory",
+        repair: bool = False,
     ):
         self.id = vid
         self.collection = collection
@@ -125,6 +127,16 @@ class Volume:
         # "memory" (CompactNeedleMap) or "db" (persistent sqlite map —
         # the reference's -index=leveldb variant, needle_map_leveldb.go)
         self.needle_map_kind = needle_map_kind
+        # `repair` = crash recovery is allowed to REWRITE the files:
+        # roll a half-committed vacuum swap forward/back (.cpm marker)
+        # and heal the .idx/.dat tails (truncate torn entries/records,
+        # re-index durable .dat records whose idx entries were lost).
+        # ONLY the exclusive owner may pass it (DiskLocation at server
+        # startup): a -workers follower opening a LIVE volume would
+        # otherwise "heal away" the entry the writer is appending
+        # right now. docs/ANALYSIS.md v3 has the crash-state model.
+        if repair:
+            self._recover_compaction()
 
         dat_path = self.base_name + ".dat"
         # tier metadata: a .vif with remote files means the sealed .dat
@@ -160,6 +172,9 @@ class Volume:
         self._bind_fd()
         if exists:
             self.super_block = SuperBlock.read_from(self._dat)
+        if repair and exists and not self.read_only:
+            self._repair_tail()
+            self._bind_fd()  # the heal may have truncated the .dat
         self.nm = self._load_needle_map()
         # how much of the on-disk .idx this process's map reflects —
         # refresh_from_idx replays from here when ANOTHER process is
@@ -360,6 +375,205 @@ class Volume:
             self.last_append_at_ns = n.append_at_ns
         except CorruptNeedle:
             raise
+
+    # --- crash recovery (docs/ANALYSIS.md v3) ---
+    def _recover_compaction(self) -> None:
+        """Resolve a crash that interrupted commit_compact's two-rename
+        swap. The `.cpm` marker is the commit point: it is written (and
+        made durable, after the scratch bytes were) BEFORE either
+        rename, and removed after both — so at recovery,
+
+          marker present  →  the new generation is complete on disk
+                             under .cpd/.cpx or already partly swapped
+                             in: roll the swap FORWARD (both renames
+                             are idempotent re-runs);
+          marker absent   →  the commit point was never reached: the
+                             old generation is authoritative, stale
+                             scratch files are deleted (roll BACK).
+
+        Either way the recovered volume is wholly old or wholly new —
+        never the new .dat under the old .idx that a bare two-rename
+        sequence can leave behind."""
+        marker = self.base_name + ".cpm"
+        cpd = self.base_name + ".cpd"
+        cpx = self.base_name + ".cpx"
+        if os.path.exists(marker):
+            # the marker outliving the swap means the db needle map's
+            # sqlite table may still index the OLD idx (its clean
+            # checkpoint can coincidentally match the new idx size, so
+            # load would skip the rebuild): drop it in EVERY
+            # marker-present state, not just the cpx-pending one —
+            # commit_compact removes the sdb before the marker, so a
+            # crash between renames and that removal lands here with
+            # cpd/cpx already gone
+            sdb = self.base_name + ".idx.sdb"
+            if os.path.exists(sdb):
+                os.remove(sdb)
+            if os.path.exists(cpx):
+                wlog.warning(
+                    "volume %d: rolling interrupted vacuum commit "
+                    "forward from scratch files", self.id,
+                )
+                if os.path.exists(cpd):
+                    os.replace(cpd, self.base_name + ".dat")
+                os.replace(cpx, self.base_name + ".idx")
+            elif os.path.exists(cpd):
+                # cannot happen under the commit order (cpd is renamed
+                # first), but never leave a scratch .dat to trip the
+                # next compact
+                os.remove(cpd)
+            os.remove(marker)
+            durable.fsync_dir(self.dir)
+            return
+        removed = False
+        for p in (cpd, cpx):
+            if os.path.exists(p):
+                os.remove(p)
+                removed = True
+        if removed:
+            wlog.warning(
+                "volume %d: removed uncommitted compaction scratch "
+                "files (crash before the commit point)", self.id,
+            )
+            durable.fsync_dir(self.dir)
+
+    def _repair_tail(self) -> None:
+        """Heal the .idx/.dat tails after an unclean shutdown so the
+        recovery invariants hold (docs/ANALYSIS.md v3):
+
+          * the .idx never references bytes past (or torn inside) the
+            .dat: trailing entries that fail the bounds or CRC gate are
+            truncated away, torn (non-16-multiple) tails first;
+          * no acked needle is lost: the .dat is fsynced before a write
+            is acked but its .idx entries are not — records past the
+            idx-covered region are re-indexed from the .dat (the `weed
+            fix` role, run incrementally at load);
+          * no torn record surfaces as valid: the tail scan stops at
+            the first record that fails the CRC gate and truncates the
+            .dat there (those bytes were never acked: the ack's fsync
+            would have made them whole).
+
+        A .dat record with EMPTY data re-indexes as a tombstone — the
+        scan convention of scan_volume_file and the reference's `weed
+        fix`; a zero-byte PUT overwritten by this is the known
+        ambiguity (idx entries, which disambiguate, were lost)."""
+        idx_path = self.base_name + ".idx"
+        try:
+            idx_size = os.path.getsize(idx_path)
+        except OSError:
+            idx_size = 0
+        entry = t.NEEDLE_MAP_ENTRY_SIZE
+        dat_size = self.data_file_size()
+        from seaweedfs_tpu.storage import idx as idx_codec
+
+        usable = idx_size - idx_size % entry
+        covered_end = self.super_block.block_size()
+        if usable:
+            with open(idx_path, "rb") as f:
+                while usable >= entry:
+                    f.seek(usable - entry)
+                    key, offset, size = idx_codec.unpack_entry(
+                        f.read(entry)
+                    )
+                    if offset == 0:
+                        # record-less tombstone entry (compaction diff
+                        # shape): self-contained, nothing to validate —
+                        # but it also does not advance coverage
+                        usable_probe = usable - entry
+                        covered = self._entry_end_if_valid(
+                            f, usable_probe, entry
+                        )
+                        covered_end = max(covered_end, covered)
+                        break
+                    norm = 0 if size == t.TOMBSTONE_FILE_SIZE else size
+                    end = t.units_to_offset(offset) + get_actual_size(
+                        norm, self.version
+                    )
+                    if end <= dat_size:
+                        blob = self._read_at(
+                            t.units_to_offset(offset),
+                            get_actual_size(norm, self.version),
+                        )
+                        try:
+                            Needle.from_bytes(
+                                blob, self.version, size=norm
+                            )
+                            covered_end = max(covered_end, end)
+                            break
+                        except (CorruptNeedle, ValueError):
+                            pass
+                    usable -= entry
+        if usable < idx_size:
+            wlog.warning(
+                "volume %d: truncating .idx tail %d -> %d bytes "
+                "(entries referencing torn/missing .dat records)",
+                self.id, idx_size, usable,
+            )
+            os.truncate(idx_path, usable)
+            durable.fsync_path(idx_path)
+        # --- re-index durable .dat records the idx lost -------------
+        scan = covered_end
+        regen: list[bytes] = []
+        while scan + t.NEEDLE_HEADER_SIZE <= dat_size:
+            header = self._read_at(scan, t.NEEDLE_HEADER_SIZE)
+            cookie, nid, nsize = Needle.parse_header(header)
+            if cookie == 0 and nid == 0 and nsize == 0:
+                break  # zero fill: a write hole / preallocation, not data
+            rec_len = get_actual_size(nsize, self.version)
+            if scan + rec_len > dat_size:
+                break  # torn tail record
+            blob = self._read_at(scan, rec_len)
+            try:
+                n = Needle.from_bytes(blob, self.version, size=nsize)
+            except (CorruptNeedle, ValueError):
+                break  # CRC gate: torn write that landed partially
+            regen.append(
+                idx_codec.pack_entry(
+                    nid,
+                    t.offset_to_units(scan),
+                    t.TOMBSTONE_FILE_SIZE if not n.data else nsize,
+                )
+            )
+            self.last_append_at_ns = max(
+                self.last_append_at_ns, n.append_at_ns
+            )
+            scan += rec_len
+        if scan < dat_size:
+            wlog.warning(
+                "volume %d: truncating torn .dat tail %d -> %d bytes",
+                self.id, dat_size, scan,
+            )
+            os.truncate(self.base_name + ".dat", scan)
+            durable.fsync_path(self.base_name + ".dat")
+        if regen:
+            wlog.warning(
+                "volume %d: re-indexed %d .dat record(s) whose .idx "
+                "entries were lost in the crash", self.id, len(regen),
+            )
+            with open(idx_path, "ab") as f:
+                f.write(b"".join(regen))
+                f.flush()
+                os.fsync(f.fileno())
+
+    def _entry_end_if_valid(self, f, pos: int, entry: int) -> int:
+        """End offset of the record referenced by the last non-
+        tombstone entry at/below `pos` (walking back), 0 when none —
+        coverage probe for _repair_tail when the tail entry itself is
+        a record-less tombstone."""
+        from seaweedfs_tpu.storage import idx as idx_codec
+
+        dat_size = self.data_file_size()
+        while pos >= entry:
+            f.seek(pos - entry)
+            key, offset, size = idx_codec.unpack_entry(f.read(entry))
+            if offset != 0:
+                norm = 0 if size == t.TOMBSTONE_FILE_SIZE else size
+                end = t.units_to_offset(offset) + get_actual_size(
+                    norm, self.version
+                )
+                return min(end, dat_size)
+            pos -= entry
+        return 0
 
     def _bind_fd(self) -> None:
         """Arm the pread/pwrite fast path on the freshly (re)opened
@@ -757,26 +971,55 @@ class Volume:
 
     def commit_compact(self) -> None:
         """Replay the catch-up diff, then swap .cpd/.cpx in as the live
-        files (volume_vacuum.go:157 makeupDiff + commit)."""
+        files (volume_vacuum.go:157 makeupDiff + commit).
+
+        The swap is TWO renames, so it rides a durable commit-marker
+        protocol (the crash enumerator's known suspect — a crash
+        between the renames used to leave the new .dat under the old
+        .idx, unopenable): scratch bytes are fsynced, then the `.cpm`
+        marker is published (THE commit point), then both renames land,
+        then the marker is removed. _recover_compaction rolls a crash
+        anywhere in that window forward (marker present) or back
+        (marker absent); docs/ANALYSIS.md v3 has the state table."""
         with self._lock:
             cpd = self.base_name + ".cpd"
             cpx = self.base_name + ".cpx"
             if not (os.path.exists(cpd) and os.path.exists(cpx)):
                 raise FileNotFoundError("no compaction scratch files to commit")
             self._makeup_diff(cpd, cpx)
+            # rename-visible-before-data guard: the new generation's
+            # BYTES must be durable before any rename can publish them
+            durable.fsync_path(cpd)
+            durable.fsync_path(cpx)
+            marker = self.base_name + ".cpm"
+            with open(marker, "wb") as mf:
+                mf.write(b"commit\n")
+                mf.flush()
+                os.fsync(mf.fileno())
+            durable.fsync_dir(self.dir)  # commit point: marker durable
             self._dat.close()
             self.nm.close()
             os.replace(cpd, self.base_name + ".dat")
             os.replace(cpx, self.base_name + ".idx")
-            self._dat = open(self.base_name + ".dat", "r+b")
-            self._bind_fd()
-            self.super_block = SuperBlock.read_from(self._dat)
-            # rebuild the map from the fresh index; a db map's stale
-            # sqlite table must go too — the watermark can't detect a
-            # same-size .cpx whose offsets all moved
+            durable.fsync_dir(self.dir)  # both renames durable
+            # the db needle map's sqlite table indexes the OLD idx —
+            # and nm.close() above checkpointed it CLEAN with the old
+            # watermark, which can coincidentally equal the compacted
+            # idx size, so load would skip the rebuild and serve
+            # pre-compaction offsets against the swapped .dat. Remove
+            # it INSIDE the marker window: every crash state then
+            # either keeps the marker (recovery deletes the table) or
+            # has already lost the table here.
             sdb = self.base_name + ".idx.sdb"
             if os.path.exists(sdb):
                 os.remove(sdb)
+            os.remove(marker)
+            durable.fsync_dir(self.dir)
+            self._dat = open(self.base_name + ".dat", "r+b")
+            self._bind_fd()
+            self.super_block = SuperBlock.read_from(self._dat)
+            # rebuild the map from the fresh index (the stale sqlite
+            # table was removed inside the marker window above)
             self.nm = self._load_needle_map()
             self._followed = self.nm.index_file_size()
 
@@ -821,7 +1064,9 @@ class Volume:
         with self._lock:
             self._compact_snapshot_idx = None
             self._compact_snapshot_size = None
-            for ext in (".cpd", ".cpx"):
+            # .cpm too: an abort must never leave a commit marker that
+            # a later restart would "roll forward" over fresh data
+            for ext in (".cpd", ".cpx", ".cpm"):
                 path = self.base_name + ext
                 if os.path.exists(path):
                     os.remove(path)
@@ -835,7 +1080,7 @@ class Volume:
     def destroy(self) -> None:
         with self._lock:
             self.close()
-            for ext in (".dat", ".idx", ".cpd", ".cpx"):
+            for ext in (".dat", ".idx", ".cpd", ".cpx", ".cpm"):
                 path = self.base_name + ext
                 if os.path.exists(path):
                     os.remove(path)

@@ -11,6 +11,8 @@ import json
 import os
 from dataclasses import dataclass, field
 
+from seaweedfs_tpu.util import durable
+
 
 @dataclass
 class RemoteFile:
@@ -86,4 +88,7 @@ def save_volume_info(file_name: str, vi: VolumeInfo) -> None:
             f,
             indent=2,
         )
-    os.replace(tmp, file_name)
+    # durable publish: the .vif decides at load time whether the .dat
+    # is local or remote — a lost/torn one after tier_upload deleted
+    # the local .dat would leave the volume unloadable
+    durable.publish(tmp, file_name)

@@ -1,0 +1,60 @@
+"""Durable-publish helpers: the atomic write→fsync→rename→dirsync idiom.
+
+POSIX gives exactly one crash-atomic primitive — rename(2) — and it is
+only as durable as the fsyncs around it: the renamed file's BYTES must
+be fsynced before the rename (or a crash can publish an empty/partial
+file under the final name: the classic rename-visible-before-data
+bug), and the parent DIRECTORY must be fsynced after it (or the rename
+itself may not survive the crash). The crash-consistency plane
+(docs/ANALYSIS.md v3) statically enforces this ordering tree-wide
+(`crash-rename-*` rules in analysis/crashlint.py); these helpers are
+the recognized way to satisfy it.
+
+Every recovery-critical state file in the repo publishes through
+`publish()` (scrub_state.json, .vif, raft state, LSM manifests,
+notification queue cursors, the sequence reservation file). The vacuum
+commit in storage/volume.py needs a two-file swap and carries its own
+marker protocol on top of `fsync_path`/`fsync_dir`.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def fsync_path(path: str) -> None:
+    """fsync a file's bytes by path (open read-only + fsync: syncing an
+    inode needs any fd, not the writing one)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so entries created/renamed/removed in it
+    survive a crash. Best-effort on filesystems that reject directory
+    fsync (some overlay/virtio mounts): the rename is then only as
+    durable as the host makes it, which is still strictly better than
+    not asking."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def publish(tmp: str, dst: str) -> None:
+    """Atomically publish `tmp` (fully written, possibly unflushed at
+    the OS level) as `dst`: fsync the bytes, rename, fsync the parent
+    directory. After a crash, `dst` is either the complete old file or
+    the complete new one — never empty, torn, or missing."""
+    fsync_path(tmp)
+    os.replace(tmp, dst)
+    fsync_dir(os.path.dirname(dst))

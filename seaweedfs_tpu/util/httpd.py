@@ -222,6 +222,15 @@ class _BufReader:
             idx = self._buf.find(b"\r\n\r\n", self._pos)
             if idx >= 0:
                 head = self._buf[self._pos : idx + 4]
+                # the limit applies to COMPLETE heads too: when the
+                # whole oversized head coalesces into the buffer before
+                # the first parse attempt (one big recv, or a C-loop
+                # handoff's initial bytes), find() succeeds and the
+                # incomplete-head check below never runs — the request
+                # would serve as 200 instead of 431 (timing-dependent:
+                # caught by the oversized-head test flaking under load)
+                if len(head) > limit:
+                    raise ValueError("request head too large")
                 self._pos = idx + 4
                 self.consumed += len(head)
                 return head
@@ -677,8 +686,28 @@ class WeedHTTPServer(ThreadingHTTPServer):
     def shutdown(self):
         from seaweedfs_tpu.util import native_serve
 
-        if not native_serve.shutdown(self):
-            super().shutdown()
+        if native_serve.shutdown(self):
+            return
+        if native_serve.available() and getattr(self, "native_serve", True):
+            # start/stop race (caught by the -workers admission tests'
+            # fast teardown): the serve thread WILL choose the native
+            # loop — the predicate is deterministic — but may not have
+            # armed _serve_native yet. Falling through to
+            # socketserver.shutdown() here waits forever on an
+            # __is_shut_down event the stdlib loop (which never runs)
+            # will never set. Wait for the arming instead; a False
+            # marker means native setup failed and the thread fell
+            # back to the stdlib loop, which CAN be shut down.
+            deadline = _time.monotonic() + 5.0
+            while _time.monotonic() < deadline:
+                state = getattr(self, "_serve_native", None)
+                if state:
+                    if native_serve.shutdown(self):
+                        return
+                if state is False:
+                    break  # threaded fallback owns the socket
+                _time.sleep(0.001)
+        super().shutdown()
 
     def finish_request(self, request, client_address):
         # every in-repo serving path carries FastRequestMixin and rides

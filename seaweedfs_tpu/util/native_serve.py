@@ -65,6 +65,11 @@ def try_serve_forever(server) -> bool:
     try:
         wake_r, wake_w = os.pipe()
     except OSError:
+        # mark the fallback explicitly: shutdown()'s arming-wait loop
+        # distinguishes "thread will run the stdlib loop" (False) from
+        # "native loop not armed yet" (absent) — without this marker an
+        # EMFILE fallback would spin that loop for its full deadline
+        server._serve_native = False
         return False
     os.set_blocking(wake_r, False)
     done = threading.Event()

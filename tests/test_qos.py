@@ -190,6 +190,38 @@ class TestHedge:
             fast.stop()
             backup.stop()
 
+    def test_attempt_pool_reuses_threads(self):
+        """ROADMAP tail-latency follow-on: hedged-capable GETs ride a
+        reusable attempt-worker pool instead of spawning 1-2 fresh
+        threads each. After a warm-up, a burst of reads must not grow
+        the pool's lifetime thread count (reuse) nor the process's live
+        thread count beyond the parked-worker cap (no leak)."""
+        fast = _StubServer(body=b"P" * 32)
+        backup = _StubServer(body=b"P" * 32)
+        try:
+            urls = [f"{fast.addr}/9,00000009", f"{backup.addr}/9,00000009"]
+            for _ in range(4):  # warm the pool
+                hedge.download(urls, key="pool-warm")
+            spawned_before = hedge._ATTEMPTS.spawned
+            live_before = threading.active_count()
+            for _ in range(30):
+                data, _ = hedge.download(urls, key="pool-test")
+                assert data == b"P" * 32
+            assert hedge._ATTEMPTS.spawned - spawned_before <= 2, (
+                "attempt pool is not reusing workers: "
+                f"{hedge._ATTEMPTS.spawned - spawned_before} fresh "
+                "threads for 30 sequential reads"
+            )
+            # live threads: at most the parked-worker cap over baseline
+            # (stub servers spawn-and-exit per connection; give the
+            # tail a moment to drain)
+            time.sleep(0.2)
+            assert threading.active_count() <= live_before + \
+                hedge._AttemptPool._MAX_IDLE
+        finally:
+            fast.stop()
+            backup.stop()
+
     def test_primary_connect_failure_fails_over(self):
         """A dead primary shouldn't wait out the delay-then-timeout
         dance: the failure reroutes to the replica immediately and the

@@ -774,6 +774,23 @@ class TestRealTree:
 
         assert main(["--rules", "contracts,lifecycle"]) == 0
 
+    def test_crash_rules_selectable_and_clean(self, capsys):
+        """weedlint v3 acceptance gate: `--rules crash` runs the
+        durability-order tier alone and exits clean on this tree (the
+        true positives it found — the commit_compact swap, the scrub
+        state publish, the quarantine rename — are fixed, not
+        suppressed). `c` must still select only the C tier."""
+        import json as _json
+
+        from seaweedfs_tpu.analysis.__main__ import main
+
+        assert main(["--rules", "crash"]) == 0
+        out = capsys.readouterr().out
+        assert "suppressed" in out  # the fuzz/_build crash ignores ran
+        # family-matcher boundary: "c" and "crash" never cross-select
+        assert main(["--rules", "c", "--json"]) == 0
+        assert "contracts" not in _json.loads(capsys.readouterr().out)
+
     def test_c_and_contracts_families_do_not_cross_select(self, capsys):
         """Review regression: `--rules c` must run ONLY the C tier —
         "contracts".startswith("c") used to drag the whole contract

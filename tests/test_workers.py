@@ -594,3 +594,84 @@ class TestTornReadUnderVacuum:
             f"http://127.0.0.1:{mport}/dir/assign"
         ) as r:
             return json.load(r)
+
+
+class TestWorkerAdmission:
+    """`volume -workers N` read workers enforce admission control
+    (ROADMAP tail-latency follow-on: until now only the lead gated, so
+    N-1 of every N SO_REUSEPORT connections bypassed the budget)."""
+
+    def _worker_with_admission(self, tmp_path, rate=1.0, procs=1):
+        vol = Volume(str(tmp_path), 9)
+        n = Needle(cookie=0x42, id=1, data=b"gated" * 8)
+        vol.write_needle(n)
+        vol.close()
+        worker = VolumeReadWorker(
+            [str(tmp_path)],
+            host="127.0.0.1",
+            port=free_port(),
+            lead="127.0.0.1:1",  # never dialed: the blob is local
+            admission_rate=rate,
+            admission_burst=rate,
+            admission_procs=procs,
+        )
+        worker.start()
+        return worker
+
+    def test_worker_sheds_over_budget_with_retry_after(self, tmp_path):
+        worker = self._worker_with_admission(tmp_path, rate=1.0)
+        try:
+            from seaweedfs_tpu.storage.file_id import FileId
+
+            url = f"http://127.0.0.1:{worker.port}/{FileId(9, 1, 0x42)}"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                assert r.status == 200
+                assert r.read() == b"gated" * 8
+            # burst spent: the immediate second request must shed with
+            # 503 + Retry-After through the worker's own gate (the
+            # lead is unreachable, so a proxy fallback would 502)
+            try:
+                urllib.request.urlopen(url, timeout=10)
+                raise AssertionError("second request was not shed")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert float(e.headers["Retry-After"]) > 0
+            assert worker.admission.rejected == 1
+        finally:
+            worker.stop()
+
+    def test_budget_splits_across_group(self, tmp_path):
+        """Same convention as -serveProcs siblings: each member of a
+        -workers group enforces rate/procs of the per-client budget."""
+        worker = self._worker_with_admission(tmp_path, rate=8.0, procs=4)
+        try:
+            assert worker.admission.rate == pytest.approx(2.0)
+        finally:
+            worker.stop()
+
+    def test_internal_listener_not_gated(self, tmp_path):
+        """The lead↔worker release handshake must never be shed — a
+        503 mid-handback would wedge write ownership."""
+        vol = Volume(str(tmp_path), 9)
+        vol.close()
+        worker = VolumeReadWorker(
+            [str(tmp_path)],
+            host="127.0.0.1",
+            port=free_port(),
+            lead="127.0.0.1:1",
+            shard_writes=True,
+            writer_index=1,
+            n_writers=2,
+            internal_port=free_port(),
+            admission_rate=1.0,
+            admission_burst=1.0,
+        )
+        worker.start()
+        try:
+            assert worker._internal_server is not None
+            assert worker._internal_server.admission is None
+            for s in worker._servers:
+                if s is not worker._internal_server:
+                    assert s.admission is worker.admission
+        finally:
+            worker.stop()
