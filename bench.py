@@ -1948,6 +1948,294 @@ def bench_qos() -> None:
     )
 
 
+def bench_degraded() -> None:
+    """Degraded-read fast path + repair-bandwidth-frugal rebuild A/B
+    (docs/SCRUB.md degraded section, BENCH_r10).
+
+    degraded_native / degraded_threaded — a 3-node CLI cluster per
+    serving path (`WEED_NATIVE_SERVE=0` is the lever): seed one volume,
+    ec.encode it, measure a paced CO-safe healthy GET pass against the
+    shard-0 holder, kill shard 0 over the /ec/quarantine operator route
+    (tests/faults.DeadShard), then measure two degraded passes — the
+    first pays the k-shard gather + decode per tile (cold), the second
+    serves every interval from the reconstructed-tile cache. weedload's
+    degraded workers verify body LENGTH per GET, so errors:0 certifies
+    reconstruction. Acceptance: warm degraded p99 <= 3x healthy p99 on
+    BOTH paths, warm p50 <= 1.2x healthy p50, tile-cache hits observed
+    on /metrics, 0 errors.
+
+    degraded_rebuild — rebuild shard 0 ON the warm node (its cached
+    degraded tiles seed the repair session), then read bytes-moved-
+    per-rebuilt-byte off the weed_ec_repair_bytes_* counters.
+    Acceptance: total moved <= 8x rebuilt (naive k-gather is 10x),
+    donated bytes > 0 (piggyback engaged)."""
+    import io
+    import subprocess
+    import tempfile
+    import urllib.request as _rq
+
+    from seaweedfs_tpu.pb import rpc, master_pb2
+    from seaweedfs_tpu.telemetry.parse import parse_prometheus_text
+    from seaweedfs_tpu.telemetry.weedload import run_load
+    from tests.faults import DeadShard
+
+    def _free_port():
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def _spawn(env_extra, *args):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", WEED_EC_CODEC="cpu",
+                   **env_extra)
+        return subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                "import jax; jax.config.update('jax_platforms', 'cpu');"
+                "from seaweedfs_tpu.__main__ import main; main()",
+                *args,
+            ],
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+
+    def _wait_nodes(m, n, deadline_s=60):
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            try:
+                with _rq.urlopen(f"http://{m}/dir/status", timeout=2) as r:
+                    topo = json.load(r)["Topology"]
+                nodes = sum(
+                    len(rk["DataNodes"])
+                    for dc in topo.get("DataCenters", [])
+                    for rk in dc.get("Racks", [])
+                )
+                if nodes >= n:
+                    return
+            except OSError:
+                pass
+            time.sleep(0.3)
+        raise RuntimeError("degraded bench cluster never became ready")
+
+    def _kill(procs):
+        for p in procs:
+            p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _scrape(addr) -> dict:
+        with _rq.urlopen(f"http://{addr}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        out: dict = {}
+        for name, labels, value in parse_prometheus_text(text):
+            out[(name, labels)] = value
+        return out
+
+    def _counter(m, name, **labels):
+        key = tuple(sorted(labels.items()))
+        return m.get((name, key), 0.0)
+
+    payload = (b"degraded\x00\xff" * 205)[:2048]
+
+    def _arm(tag, env_extra):
+        with tempfile.TemporaryDirectory() as d:
+            mport = _free_port()
+            m = f"127.0.0.1:{mport}"
+            procs = [
+                _spawn(env_extra, "master", "-port", str(mport),
+                       "-mdir", d, "-telemetryInterval", "0")
+            ]
+            for i in range(3):
+                vdir = os.path.join(d, f"v{i}")
+                os.makedirs(vdir, exist_ok=True)
+                procs.append(
+                    _spawn(
+                        env_extra, "volume", "-port", str(_free_port()),
+                        "-dir", vdir, "-mserver", m, "-max", "50",
+                        "-rack", f"rack{i}", "-scrubInterval", "0",
+                    )
+                )
+            try:
+                _wait_nodes(m, 3)
+                # seed one keyset; assigns scatter across writable
+                # volumes, so keep the most-loaded vid (same shape as
+                # util.availability.write_keyset, minus its same-rack
+                # replication demand — this cluster is one node/rack)
+                by_vid: dict[int, dict] = {}
+                for _ in range(40):
+                    with _rq.urlopen(
+                        f"http://{m}/dir/assign?collection=deg{tag}",
+                        timeout=10,
+                    ) as r:
+                        a = json.load(r)
+                    _rq.urlopen(
+                        _rq.Request(
+                            f"http://{a['url']}/{a['fid']}", data=payload,
+                            method="POST",
+                            headers={
+                                "Content-Type": "application/octet-stream"
+                            },
+                        ),
+                        timeout=10,
+                    ).close()
+                    fid_vid = int(a["fid"].partition(",")[0])
+                    by_vid.setdefault(fid_vid, {})[a["fid"]] = payload
+                vid = max(by_vid, key=lambda v: len(by_vid[v]))
+                keys = by_vid[vid]
+                from seaweedfs_tpu.shell.command_env import CommandEnv
+                from seaweedfs_tpu.shell.commands import do_ec_encode
+
+                env = CommandEnv([m])
+                do_ec_encode(env, vid, f"deg{tag}", io.StringIO())
+                # the shard-0 holder: all data of a <1MB .dat stripes
+                # into block 0 = shard 0, so it serves healthy reads
+                # locally and degraded reads after the kill
+                with rpc.dial(f"127.0.0.1:{mport + 10000}") as ch:
+                    resp = rpc.master_stub(ch).LookupEcVolume(
+                        master_pb2.LookupEcVolumeRequest(volume_id=vid),
+                        timeout=10,
+                    )
+                holder0 = next(
+                    e.locations[0].url
+                    for e in resp.shard_id_locations
+                    if e.shard_id == 0 and e.locations
+                )
+                lkeys = [(fid, holder0) for fid in keys]
+
+                def pass_(duration, rate):
+                    return run_load(
+                        m, duration_s=duration, writers=0, readers=2,
+                        payload_bytes=len(payload), rate=rate, keys=lkeys,
+                        verify_bytes=len(payload),
+                    )["get"]
+
+                pass_(2.5, 10.0)  # warmup: spawn-time jax import storm
+                healthy = pass_(6.0, 20.0)
+                m0 = _scrape(holder0)
+                DeadShard(vid, sid=0, addr=holder0).kill()
+                cold = pass_(6.0, 20.0)
+                warm = pass_(6.0, 20.0)
+                m1 = _scrape(holder0)
+                hits = (
+                    _counter(m1, "weed_ec_tile_cache_total", result="hit")
+                    - _counter(m0, "weed_ec_tile_cache_total", result="hit")
+                )
+                misses = (
+                    _counter(m1, "weed_ec_tile_cache_total", result="miss")
+                    - _counter(m0, "weed_ec_tile_cache_total", result="miss")
+                )
+                degraded_total = (
+                    _counter(m1, "weed_ec_degraded_read_total")
+                    - _counter(m0, "weed_ec_degraded_read_total")
+                )
+                row = {
+                    "healthy": healthy, "cold": cold, "warm": warm,
+                    "tile_hits": hits, "tile_misses": misses,
+                    "degraded_reads": degraded_total,
+                }
+                if tag != "native":
+                    return row, None
+                # rebuild leg (native arm only — the repair plane does
+                # not touch the serving path): rebuild ON the warm
+                # holder so its cached tiles piggyback into the session
+                from seaweedfs_tpu.pb import volume_pb2
+
+                r0 = _scrape(holder0)
+                host, _, port = holder0.partition(":")
+                with rpc.dial(f"{host}:{int(port) + 10000}") as ch:
+                    rresp = rpc.volume_stub(ch).VolumeEcShardsRebuild(
+                        volume_pb2.VolumeEcShardsRebuildRequest(
+                            volume_id=vid, collection=f"deg{tag}"
+                        ),
+                        timeout=300,
+                    )
+                    rpc.volume_stub(ch).VolumeEcShardsMount(
+                        volume_pb2.VolumeEcShardsMountRequest(
+                            volume_id=vid, collection=f"deg{tag}",
+                            shard_ids=list(rresp.rebuilt_shard_ids),
+                        ),
+                        timeout=30,
+                    )
+                r1 = _scrape(holder0)
+                reb = {
+                    "rebuilt_shards": list(rresp.rebuilt_shard_ids),
+                    "read_local": _counter(
+                        r1, "weed_ec_repair_bytes_read_total", source="local"
+                    ) - _counter(
+                        r0, "weed_ec_repair_bytes_read_total", source="local"
+                    ),
+                    "read_remote": _counter(
+                        r1, "weed_ec_repair_bytes_read_total", source="remote"
+                    ) - _counter(
+                        r0, "weed_ec_repair_bytes_read_total", source="remote"
+                    ),
+                    "written": _counter(
+                        r1, "weed_ec_repair_bytes_written_total"
+                    ) - _counter(r0, "weed_ec_repair_bytes_written_total"),
+                    "donated": _counter(
+                        r1, "weed_ec_repair_donated_bytes_total"
+                    ) - _counter(r0, "weed_ec_repair_donated_bytes_total"),
+                }
+                # post-rebuild: reads must still verify byte lengths
+                reb["post_rebuild"] = pass_(3.0, 10.0)
+                return row, reb
+            finally:
+                _kill(procs)
+
+    for tag, env_extra in (
+        ("native", {}),
+        ("threaded", {"WEED_NATIVE_SERVE": "0"}),
+    ):
+        row, reb = _arm(tag, env_extra)
+        healthy, cold, warm = row["healthy"], row["cold"], row["warm"]
+        errors = healthy["errors"] + cold["errors"] + warm["errors"]
+        _report(
+            f"degraded_{tag}", warm["p99_ms"], "ms",
+            (healthy["p99_ms"] * 3.0 / warm["p99_ms"])
+            if warm["p99_ms"] > 0 else 0.0,  # >=1 == within the 3x bound
+            healthy_p50_ms=healthy["p50_ms"], healthy_p99_ms=healthy["p99_ms"],
+            cold_p99_ms=cold["p99_ms"], warm_p50_ms=warm["p50_ms"],
+            warm_p50_vs_healthy_p50=round(
+                warm["p50_ms"] / healthy["p50_ms"], 4
+            ) if healthy["p50_ms"] > 0 else None,
+            degraded_p99_vs_healthy_p99=round(
+                warm["p99_ms"] / healthy["p99_ms"], 4
+            ) if healthy["p99_ms"] > 0 else None,
+            tile_cache_hits=row["tile_hits"],
+            tile_cache_misses=row["tile_misses"],
+            degraded_reads=row["degraded_reads"],
+            ops=healthy["ops"] + cold["ops"] + warm["ops"],
+            errors=errors, co_safe=True,
+            serving_path=(
+                "threaded (WEED_NATIVE_SERVE=0)" if tag == "threaded"
+                else "native"
+            ),
+        )
+        if reb is not None:
+            moved = reb["read_local"] + reb["read_remote"]
+            ratio = moved / reb["written"] if reb["written"] else 0.0
+            _report(
+                "degraded_rebuild", ratio, "bytes-moved/rebuilt-byte",
+                (10.0 / ratio) if ratio > 0 else 0.0,  # vs naive k=10
+                read_local_bytes=reb["read_local"],
+                read_remote_bytes=reb["read_remote"],
+                network_moved_per_rebuilt=round(
+                    reb["read_remote"] / reb["written"], 4
+                ) if reb["written"] else None,
+                written_bytes=reb["written"],
+                donated_bytes=reb["donated"],
+                rebuilt_shards=reb["rebuilt_shards"],
+                post_rebuild_errors=reb["post_rebuild"]["errors"],
+            )
+
+
 CONFIGS = {
     "encode": bench_encode,
     "rebuild": bench_rebuild,
@@ -1965,6 +2253,7 @@ CONFIGS = {
     "load": bench_load,
     "serve": bench_serve,
     "qos": bench_qos,
+    "degraded": bench_degraded,
 }
 
 
@@ -2329,7 +2618,16 @@ def check_crash_smoke() -> int:
     sweep_ok = (
         sweep_rep.violations == [] and sweep_rep.states_tested >= 24
     )
-    ok = lint_hit and dynamic_hit and sweep_ok
+    # the EC shard writer-pool flush ordering (ISSUE 12): durable arm
+    # clean, and the PRE-FIX ordering must still be DETECTED — a sweep
+    # that can no longer see torn-shards-under-complete-.ecx states
+    # proves nothing
+    ec_rep = crash.run_ec_encode(budget=48)
+    ec_regress = bool(
+        crash.run_ec_encode(budget=48, durable=False).violations
+    )
+    ec_ok = ec_rep.violations == [] and ec_regress
+    ok = lint_hit and dynamic_hit and sweep_ok and ec_ok
     print(json.dumps({
         "metric": "crash_smoke",
         "ok": ok,
@@ -2337,6 +2635,8 @@ def check_crash_smoke() -> int:
         "planted_dynamic_detected": dynamic_hit,
         "group_commit_states_tested": sweep_rep.states_tested,
         "group_commit_violations": sweep_rep.violations[:3],
+        "ec_encode_violations": ec_rep.violations[:3],
+        "ec_encode_pre_fix_detected": ec_regress,
     }))
     return 0 if ok else 1
 
@@ -2446,6 +2746,72 @@ def check_qos_smoke() -> int:
     return 0 if ok else 1
 
 
+def check_degraded_smoke() -> int:
+    """`bench.py --check` degraded leg (docs/SCRUB.md): kill one shard
+    of a live EC volume — the GET must succeed byte-identical via
+    reconstruction, the SECOND read must be a tile-cache hit (no fresh
+    decode), and the planted-regression guard asserts the old serial
+    per-interval gather (per-call ThreadPoolExecutor) is gone from the
+    hot path."""
+    import inspect
+    import random
+    import tempfile
+
+    from seaweedfs_tpu.ec import ec_files, ec_volume
+    from seaweedfs_tpu.ec.codec import new_encoder
+    from seaweedfs_tpu.stats.metrics import EC_TILE_CACHE
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+    from seaweedfs_tpu.storage.volume import Volume
+
+    serial_gone = (
+        "ThreadPoolExecutor" not in inspect.getsource(ec_volume)
+        and "as_completed" not in inspect.getsource(ec_volume)
+    )
+    with tempfile.TemporaryDirectory() as d:
+        v = Volume(d, 9)
+        rng = random.Random(7)
+        payload = {}
+        for k in range(1, 17):
+            data = bytes(rng.randbytes(1500 + 31 * k))
+            payload[k] = data
+            v.write_needle(Needle(cookie=0xD00D, id=k, data=data))
+        v.close()
+        base = os.path.join(d, "9")
+        ec_files.write_ec_files(base, rs=new_encoder(backend="cpu"))
+        ec_files.write_sorted_file_from_idx(base)
+        os.remove(base + ".dat")
+        os.remove(base + ".idx")
+        store = Store([d], ec_backend="cpu")
+        ev = store.find_ec_volume(9)
+        killed = ev.quarantine_shard(0, "check: degraded smoke")
+        first_ok = all(
+            bytes(ev.read_needle(k).data) == data
+            for k, data in payload.items()
+        )
+        h0 = EC_TILE_CACHE.value("hit")
+        m0 = EC_TILE_CACHE.value("miss")
+        second_ok = all(
+            bytes(ev.read_needle(k).data) == data
+            for k, data in payload.items()
+        )
+        cache_hit = (
+            EC_TILE_CACHE.value("hit") > h0
+            and EC_TILE_CACHE.value("miss") == m0
+        )
+        store.close()
+    ok = serial_gone and killed and first_ok and second_ok and cache_hit
+    print(json.dumps({
+        "metric": "degraded_smoke",
+        "ok": ok,
+        "shard_killed": killed,
+        "degraded_read_byte_identical": first_ok and second_ok,
+        "second_read_tile_cache_hit": cache_hit,
+        "serial_fallback_gone": serial_gone,
+    }))
+    return 0 if ok else 1
+
+
 def check_sanitizer_smoke() -> int:
     """Sanitizer gate: the ASan build of the whole shim tier must pass
     the native-post identity matrix and the fuzz-corpus sweep. Skips
@@ -2510,6 +2876,7 @@ def main() -> None:
         rc = rc or check_trace_smoke()
         rc = rc or check_telemetry_smoke()
         rc = rc or check_qos_smoke()
+        rc = rc or check_degraded_smoke()
         if os.environ.get("WEED_BENCH_CHECK_INNER") != "1":
             rc = rc or check_weedlint()
             rc = rc or check_contracts_smoke()

@@ -182,6 +182,7 @@ class Recorder:
             "ftruncate": os.ftruncate,
             "remove": os.remove,
             "unlink": os.unlink,
+            "posix_fallocate": os.posix_fallocate,
         }
         o = self._orig
 
@@ -285,6 +286,23 @@ class Recorder:
                 rec._emit(kind="trunc", ino=ino, size=length)
             return r
 
+        def _posix_fallocate(fd, offset, length):
+            r = o["posix_fallocate"](fd, offset, length)
+            ino = rec._fd.get(fd)
+            if ino is not None:
+                # modeled as a size-extension whose durability is
+                # independent (a trunc event the enumerator may apply
+                # or drop) — the EC stream drivers preallocate with
+                # exactly this call before their pwritev streams. The
+                # recorded size is the REAL post-call st_size, not
+                # offset+length: fallocate never shrinks, so emitting
+                # the smaller value for an already-larger file would
+                # let the sweep materialize shrunken states no
+                # hardware can produce
+                rec._emit(kind="trunc", ino=ino,
+                          size=os.fstat(fd).st_size)
+            return r
+
         def _remove(path, **kw):
             r = o["remove"](path, **kw)
             rel = rec._rel(path)
@@ -307,6 +325,7 @@ class Recorder:
         os.ftruncate = _ftruncate
         os.remove = _remove
         os.unlink = _remove
+        os.posix_fallocate = _posix_fallocate
 
     def uninstall(self) -> None:
         if not self._installed:
@@ -326,6 +345,7 @@ class Recorder:
         os.ftruncate = o["ftruncate"]
         os.remove = o["remove"]
         os.unlink = o["unlink"]
+        os.posix_fallocate = o["posix_fallocate"]
         self._installed = False
 
     def __enter__(self) -> "Recorder":
@@ -913,10 +933,151 @@ def run_broken_publish(budget: int | None = None,
                      budget=budget, seed=seed)
 
 
+def run_ec_encode(budget: int | None = None, seed: int | None = None,
+                  durable: bool = True) -> CrashReport:
+    """EC shard writer-pool flush ordering (the PR-11 follow-on): sweep
+    stream_write_ec_files — the pooled preallocate+pwritev driver — plus
+    the .ecx publish that acks the encode. Invariant: whenever the .ecx
+    exists complete under its final name, every shard file byte-equals
+    a control encode (the generate verb's contract: a visible index
+    never fronts page-cache-only shard bytes).
+
+    durable=False replays the PRE-FIX ordering (no shard fsyncs, .ecx
+    written in place) — the regression control that must DETECT the
+    complete-index-over-torn-shards states."""
+    import shutil as _shutil
+
+    from seaweedfs_tpu.ec import ec_files, ec_stream
+    from seaweedfs_tpu.ec.codec import new_encoder
+    from seaweedfs_tpu.storage.volume import Volume
+
+    # tiny block geometry keeps shard files (and every materialized
+    # state) a few KB; .ecx content only depends on the .idx
+    blocks = {"large_block_size": 8192, "small_block_size": 4096}
+    with tempfile.TemporaryDirectory() as d:
+        v = Volume(d, 1)
+        for nid in range(1, 4):
+            v.write_needle(_mk_needle(nid, b"ec-%03d\xee" % nid * 30))
+        v.commit()
+        v.close()
+        base = os.path.join(d, "1")
+        rs = new_encoder(backend="cpu")
+        parity_fn, fetch_fn = ec_stream.local_encode_fns(rs)
+
+        def encode(target_base: str, durable_arm: bool) -> None:
+            ec_stream.stream_write_ec_files(
+                target_base, tile_bytes=4096, parity_fn=parity_fn,
+                fetch_fn=fetch_fn, writer_threads=2, reader_threads=1,
+                durable=durable_arm, **blocks,
+            )
+            ec_files.write_sorted_file_from_idx(
+                target_base, durable=durable_arm
+            )
+
+        # control: the byte-exact expected outputs, encoded outside the
+        # recorder from a copy of the same .dat/.idx
+        ctl = os.path.join(d, "ctl")
+        os.makedirs(ctl)
+        for ext in (".dat", ".idx"):
+            _shutil.copy(base + ext, os.path.join(ctl, "1" + ext))
+        encode(os.path.join(ctl, "1"), durable_arm=True)
+        expect = {}
+        for i in range(ec_files.TOTAL_SHARDS):
+            with open(os.path.join(ctl, "1" + ec_files.to_ext(i)), "rb") as f:
+                expect[ec_files.to_ext(i)[1:]] = f.read()
+        with open(os.path.join(ctl, "1.ecx"), "rb") as f:
+            expect["ecx"] = f.read()
+        _shutil.rmtree(ctl)
+
+        rec = Recorder(d)
+        with rec:
+            encode(base, durable_arm=durable)
+            rec.mark("encoded")
+
+        def recover(state_dir, _st, _acked):
+            ecx = os.path.join(state_dir, "1.ecx")
+            if not os.path.exists(ecx):
+                return  # encode never acked: nothing is promised
+            with open(ecx, "rb") as f:
+                got = f.read()
+            assert got == expect["ecx"], (
+                f".ecx visible but torn: {len(got)}B of "
+                f"{len(expect['ecx'])}B"
+            )
+            for i in range(ec_files.TOTAL_SHARDS):
+                ext = ec_files.to_ext(i)
+                p = os.path.join(state_dir, "1" + ext)
+                assert os.path.exists(p), f".ecx complete but {ext} missing"
+                with open(p, "rb") as f:
+                    shard = f.read()
+                assert shard == expect[ext[1:]], (
+                    f".ecx complete but {ext} bytes wrong "
+                    f"({len(shard)}B, want {len(expect[ext[1:]])}B)"
+                )
+
+        return sweep(rec.trace, recover, workload="ec-encode",
+                     budget=budget, seed=seed)
+
+
+def run_shard_handback(budget: int | None = None,
+                       seed: int | None = None) -> CrashReport:
+    """-shardWrites ownership handback (the PR-11 follow-on): a worker
+    OWNS a vid's writes (SharedReadVolume appends through the same
+    Volume write path as the lead), releases ownership, and the lead
+    appends more and commits. Sweeps the combined append stream.
+    Invariants: every needle acked at the final durability point
+    survives recovery (the lead's commit fsyncs the .dat; repair-mode
+    open re-indexes fsynced-but-unindexed records), the .idx never
+    references past the .dat, torn tails never surface as valid."""
+    from seaweedfs_tpu.server.volume_workers import SharedReadVolume
+    from seaweedfs_tpu.storage.volume import Volume
+
+    with tempfile.TemporaryDirectory() as d:
+        v = Volume(d, 1)
+        base = {i: b"lead-%03d\xaa" % i * 40 for i in range(1, 4)}
+        for nid, data in base.items():
+            v.write_needle(_mk_needle(nid, data))
+        v.commit()
+        v.close()
+        rec = Recorder(d)
+        rec.mark(dict(base))
+        with rec:
+            # worker-owned phase: appends ride the shared wrapper the
+            # -shardWrites read workers use for owned vids
+            w = SharedReadVolume(d, 1)
+            worker_writes = {i: b"wrk-%03d\x00\xfe" % i * 50
+                             for i in range(10, 14)}
+            for nid, data in worker_writes.items():
+                w.write_needle(_mk_needle(nid, data))
+            # handback: worker stops writing forever; the lead reopens,
+            # catches up from the on-disk .idx, appends, and COMMITS —
+            # the durability point the final ack rides
+            lead = Volume(d, 1, create=False)
+            lead_writes = {i: b"ld2-%03d\xbb" % i * 45
+                           for i in range(20, 23)}
+            for nid, data in lead_writes.items():
+                lead.write_needle(_mk_needle(nid, data))
+            lead.commit()
+            rec.mark({**worker_writes, **lead_writes})
+            lead.close()
+            w.close()
+
+        def recover(state_dir, _st, acked_payloads):
+            acked: dict[int, bytes] = {}
+            for p in acked_payloads:
+                acked.update(p)
+            verify_volume(state_dir, 1, acked)
+
+        return sweep(rec.trace, recover, workload="shard-handback",
+                     budget=budget, seed=seed)
+
+
 ALL_WORKLOADS = {
     "group-commit": run_group_commit,
     "vacuum": run_vacuum,
     "quarantine": run_quarantine,
+    "ec-encode": run_ec_encode,
+    "shard-handback": run_shard_handback,
 }
 
 

@@ -133,6 +133,12 @@ class ReedSolomon:
         # cache: survivor-row tuple -> decode matrix (invert is host-side
         # 14x14 work; reuse across blocks of a streaming rebuild)
         self._decode_cache: dict[tuple[int, ...], np.ndarray] = {}
+        # cache: (survivors, targets) -> decode ROWS — the per-target
+        # slice every caller of gf256.decode_rows wants; one home so
+        # the degraded read path and the stream rebuild driver don't
+        # each grow their own (GIL-atomic dict ops; a racing recompute
+        # is benign and identical)
+        self._decode_rows_cache: dict[tuple, np.ndarray] = {}
 
     @staticmethod
     def _resolve_backend(name: str) -> Callable[[np.ndarray, np.ndarray], np.ndarray]:
@@ -206,6 +212,21 @@ class ReedSolomon:
             m = gf256.mat_inv(sub)
             self._decode_cache[survivors] = m
         return m
+
+    def decode_rows(
+        self, survivors: tuple[int, ...], targets: tuple[int, ...]
+    ) -> np.ndarray:
+        """Cached [len(targets), k] matrix rebuilding `targets` (data or
+        parity) from `survivors` — apply it to the stacked survivor
+        tile with `self._apply`."""
+        key = (tuple(survivors), tuple(targets))
+        rows = self._decode_rows_cache.get(key)
+        if rows is None:
+            rows = gf256.decode_rows(self.matrix, key[0], key[1])
+            if len(self._decode_rows_cache) > 512:
+                self._decode_rows_cache.clear()  # bound, rarely hit
+            self._decode_rows_cache[key] = rows
+        return rows
 
     def reconstruct(
         self, shards: list[Optional[np.ndarray]], data_only: bool = False

@@ -156,9 +156,12 @@ def write_ec_files(
     large_block_size: int = LARGE_BLOCK_SIZE,
     small_block_size: int = SMALL_BLOCK_SIZE,
     stats: dict | None = None,
+    durable: bool = False,
 ) -> None:
     """Generate .ec00-.ec13 next to `base_file_name`.dat
-    (ec_encoder.go:53 WriteEcFiles).
+    (ec_encoder.go:53 WriteEcFiles). durable=True fsyncs the shard
+    files before returning (see stream_write_ec_files — the ordering
+    the generate verb's .ecx publish relies on after a crash).
 
     buffer_size=None lets each driver pick its default (4 MiB classic
     IO batches; 4 MiB pipelined tiles on TPU/native hosts). A `stats` dict
@@ -185,6 +188,7 @@ def write_ec_files(
             parity_fn=parity_fn,
             fetch_fn=fetch_fn,
             stats=stats,
+            durable=durable,
         )
         return
 
@@ -220,6 +224,12 @@ def write_ec_files(
                 read_s += t1 - t0
                 encode_s += t2 - t1
                 write_s += t3 - t2
+        if durable:
+            # success path only (inside the try): a failed durability
+            # fsync must fail the encode, never be swallowed by close
+            for f in outputs:
+                f.flush()
+                os.fsync(f.fileno())
     finally:
         tc0 = _time.perf_counter()
         try:
@@ -357,6 +367,7 @@ def rebuild_ec_files(
     base_file_name: str,
     rs: ReedSolomon | None = None,
     buffer_size: int | None = None,
+    durable: bool = False,
 ) -> list[int]:
     """Regenerate whichever .ec files are missing from the ones present
     (ec_encoder.go:83 generateMissingEcFiles). Returns rebuilt ids.
@@ -377,6 +388,7 @@ def rebuild_ec_files(
             tile_bytes=buffer_size,
             rebuild_fn=rebuild_fn,
             fetch_fn=fetch_fn,
+            durable=durable,
         )
     buffer_size = buffer_size or SMALL_BLOCK_SIZE
     present, missing = shard_presence(base_file_name)
@@ -387,6 +399,12 @@ def rebuild_ec_files(
             f"too few shard files to rebuild: {sum(present)} of {rs.data_shards}"
         )
 
+    from seaweedfs_tpu.stats.metrics import (
+        EC_REPAIR_BYTES_READ,
+        EC_REPAIR_BYTES_WRITTEN,
+    )
+
+    read_local = EC_REPAIR_BYTES_READ.labels("local")
     inputs = {
         i: open(base_file_name + to_ext(i), "rb")
         for i in range(TOTAL_SHARDS)
@@ -408,11 +426,30 @@ def rebuild_ec_files(
                     raise ValueError(
                         f"ec shard {i} truncated: expected {step} at {offset}"
                     )
+                read_local.inc(len(raw))
                 shards[i] = np.frombuffer(raw, dtype=np.uint8)
             rs.reconstruct(shards)
             for i in missing:
                 outputs[i].write(shards[i].tobytes())  # type: ignore[union-attr]
+                EC_REPAIR_BYTES_WRITTEN.inc(step)
             offset += step
+        if durable:
+            for f in outputs.values():
+                f.flush()
+                os.fsync(f.fileno())
+    except BaseException:
+        # partial (or written-but-unsynced, when the durable fsync
+        # failed) targets must not survive: shard_presence counts ANY
+        # existing .ecNN as a valid shard, so a retry would see "not
+        # missing", skip the rebuild AND the fsync, and a later crash
+        # could lose the shard bytes under a complete .ecx — the same
+        # contract the stream driver enforces on its failure paths
+        for i in missing:
+            try:
+                os.remove(base_file_name + to_ext(i))
+            except OSError:
+                pass
+        raise
     finally:
         for f in inputs.values():
             f.close()
@@ -457,12 +494,29 @@ def compact_idx_entries(idx_data: bytes) -> bytes:
     return idx_codec.arrays_to_entries(keys, offsets, sizes)
 
 
-def write_sorted_file_from_idx(base_file_name: str, ext: str = ".ecx") -> None:
-    """.idx → sorted .ecx (ec_encoder.go:26 WriteSortedFileFromIdx)."""
+def write_sorted_file_from_idx(
+    base_file_name: str, ext: str = ".ecx", durable: bool = False
+) -> None:
+    """.idx → sorted .ecx (ec_encoder.go:26 WriteSortedFileFromIdx).
+
+    durable=True routes through util/durable.publish (tmp + fsync +
+    rename + dirsync): the .ecx is the encode's commit record — if a
+    crash leaves it visible, the shard files it indexes must be whole,
+    so the generate verbs fsync shards first and publish this last
+    (weedcrash ec-encode workload, docs/ANALYSIS.md v3)."""
     with open(base_file_name + ".idx", "rb") as f:
         idx_data = f.read()
+    entries = compact_idx_entries(idx_data)
+    if durable:
+        from seaweedfs_tpu.util import durable as _durable
+
+        tmp = base_file_name + ext + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(entries)
+        _durable.publish(tmp, base_file_name + ext)
+        return
     with open(base_file_name + ext, "wb") as f:
-        f.write(compact_idx_entries(idx_data))
+        f.write(entries)
 
 
 def write_idx_file_from_ec_index(base_file_name: str) -> None:

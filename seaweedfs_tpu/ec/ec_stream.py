@@ -64,6 +64,10 @@ import numpy as np
 
 from seaweedfs_tpu import trace
 from seaweedfs_tpu.ec import locate
+from seaweedfs_tpu.stats.metrics import (
+    EC_REPAIR_BYTES_READ,
+    EC_REPAIR_BYTES_WRITTEN,
+)
 
 DATA_SHARDS = locate.DATA_SHARDS
 PARITY_SHARDS = locate.PARITY_SHARDS
@@ -237,27 +241,16 @@ def local_encode_fns(rs) -> tuple[Callable, Callable]:
 
 def local_rebuild_fns(rs) -> tuple[Callable, Callable]:
     """(rebuild_fn, fetch_fn) over a host ReedSolomon backend, with the
-    inverted-survivor decode rows cached per (survivors, targets) and
-    the decode itself deferred to the writer pool (see
+    inverted-survivor decode rows cached on the codec (rs.decode_rows)
+    and the decode itself deferred to the writer pool (see
     local_encode_fns)."""
-    rows_cache: dict = {}
-    cache_lock = threading.Lock()
 
     def rebuild_fn(survivors, targets, tile: np.ndarray):
         return (tuple(survivors), tuple(targets), tile)
 
     def fetch_fn(handle):
         survivors, targets, tile = handle
-        key = (survivors, targets)
-        with cache_lock:
-            rows = rows_cache.get(key)
-        if rows is None:
-            from seaweedfs_tpu.ec import gf256
-
-            rows = gf256.decode_rows(rs.matrix, survivors, targets)
-            with cache_lock:
-                rows_cache[key] = rows
-        return rs._apply(rows, tile)
+        return rs._apply(rs.decode_rows(survivors, targets), tile)
 
     return rebuild_fn, fetch_fn
 
@@ -275,8 +268,15 @@ def stream_write_ec_files(
     stats: dict | None = None,
     writer_threads: int | None = None,
     reader_threads: int | None = None,
+    durable: bool = False,
 ) -> None:
     """Pipelined .dat → .ec00…13, byte-identical to write_ec_files.
+
+    durable=True fsyncs every shard fd before returning — the ordering
+    the generate verbs need so the .ecx publish that follows can imply
+    "shard bytes are on disk" after a crash (weedcrash finding,
+    docs/ANALYSIS.md v3: the writer pool's pwritev stream is otherwise
+    entirely page-cache-resident when the .ecx lands).
 
     parity_fn([10, step] u8 host tile) must *dispatch* the parity
     computation and return an opaque handle immediately; fetch_fn turns
@@ -474,13 +474,25 @@ def stream_write_ec_files(
             pipe.finish(caller_error=not ok)  # may re-raise a stage error
         finally:
             tc0 = time.perf_counter()
+            fsync_err: OSError | None = None
             try:
                 for fd in out_fds:
                     try:
+                        if durable and ok and not pipe.errors:
+                            # a failed durability fsync must FAIL the
+                            # encode (swallowing it would ack bytes that
+                            # never reached disk — the exact state the
+                            # weedcrash ec-encode workload forbids), but
+                            # only after every fd is closed
+                            try:
+                                os.fsync(fd)  # see the docstring contract
+                            except OSError as e:
+                                if fsync_err is None:
+                                    fsync_err = e
                         os.close(fd)
                     except OSError:
                         pass
-                if not ok or pipe.errors:
+                if not ok or pipe.errors or fsync_err is not None:
                     # a partial shard set must not survive the abort:
                     # shard_presence treats ANY existing .ecNN as a
                     # valid shard, so full-size garbage files would
@@ -490,6 +502,8 @@ def stream_write_ec_files(
                             os.remove(base_file_name + to_ext(i))
                         except OSError:
                             pass
+                if fsync_err is not None:
+                    raise fsync_err
             finally:
                 # raw preallocated fds: nothing buffered remains, so
                 # this measures only the close syscalls (the previous
@@ -519,6 +533,8 @@ def stream_rebuild_ec_files(
     remote_readers: dict[int, Callable[[int, int], bytes]] | None = None,
     writer_threads: int | None = None,
     reader_threads: int | None = None,
+    session=None,
+    durable: bool = False,
 ) -> list[int]:
     """Pipelined shard rebuild, byte-identical to rebuild_ec_files.
 
@@ -532,7 +548,21 @@ def stream_rebuild_ec_files(
     tiles over the wire in parallel with local preadv and the decode,
     and shards readable remotely are treated as present (not rebuilt).
     At least one survivor must be local — its file size fixes the tile
-    walk."""
+    walk.
+
+    `session` (an ec.repair_session.RebuildSession) is the repair-
+    bandwidth-frugal hookup: tiles degraded serving already decoded are
+    consumed as donations, so the reader gathers survivors only for the
+    GAPS — range-aligned sub-shard reads instead of the naive whole-
+    range k-gather — and the reader yields to in-flight degraded
+    gathers between tiles (serving never starves behind repair). Every
+    survivor byte gathered is counted local-vs-remote on
+    weed_ec_repair_bytes_read_total, every rebuilt byte written on
+    weed_ec_repair_bytes_written_total.
+
+    `durable=True` fsyncs the rebuilt shard files before returning
+    (the weedcrash contract for the generate/rebuild verbs: an acked
+    shard set survives a crash — docs/ANALYSIS.md v3)."""
     if (rebuild_fn is None) != (fetch_fn is None):
         raise ValueError("rebuild_fn and fetch_fn must be injected together")
     if rebuild_fn is None:
@@ -592,6 +622,8 @@ def stream_rebuild_ec_files(
     idx_iter = iter(offsets)
 
     n_remote = sum(1 for i in survivors if not present[i])
+    read_local = EC_REPAIR_BYTES_READ.labels("local")
+    read_remote = EC_REPAIR_BYTES_READ.labels("remote")
 
     def reader():
         fds = {
@@ -607,42 +639,68 @@ def stream_rebuild_ec_files(
             if n_remote > 1
             else None
         )
+
+        def gather(g_off: int, g_len: int) -> np.ndarray:
+            """One [k, g_len] survivor read at g_off — the only place
+            rebuild bytes cross a disk or the network, so the repair
+            accounting lives here."""
+            tile = np.empty((DATA_SHARDS, g_len), dtype=np.uint8)
+            futures = {}
+            if fetch_pool is not None:
+                futures = {
+                    j: fetch_pool.submit(remote_readers[i], g_off, g_len)
+                    for j, i in enumerate(survivors)
+                    if i not in fds
+                }
+            for j, i in enumerate(survivors):
+                if i in fds:
+                    got = _pread_into(fds[i], tile[j], g_off)
+                    read_local.inc(got)
+                else:
+                    fut = futures.get(j)
+                    raw = (
+                        fut.result()
+                        if fut is not None
+                        else remote_readers[i](g_off, g_len)
+                    )
+                    got = len(raw)
+                    read_remote.inc(got)
+                    if got == g_len:
+                        tile[j] = np.frombuffer(raw, dtype=np.uint8)
+                if got != g_len:
+                    raise ValueError(
+                        f"ec shard {i} truncated: expected {g_len} at "
+                        f"{g_off}"
+                    )
+            return tile
+
         try:
             while True:
                 with idx_lock:
                     offset = next(idx_iter, None)
                 if offset is None:
                     return
+                if session is not None:
+                    # serve-first arbitration: degraded GET gathers in
+                    # flight own the disks/links; repair waits (bounded)
+                    session.yield_to_serving()
                 t0 = time.perf_counter()
                 step = min(tile_bytes, shard_size - offset)
-                tile = np.empty((DATA_SHARDS, step), dtype=np.uint8)
-                futures = {}
-                if fetch_pool is not None:
-                    futures = {
-                        j: fetch_pool.submit(remote_readers[i], offset, step)
-                        for j, i in enumerate(survivors)
-                        if i not in fds
-                    }
-                for j, i in enumerate(survivors):
-                    if i in fds:
-                        got = _pread_into(fds[i], tile[j], offset)
-                    else:
-                        fut = futures.get(j)
-                        raw = (
-                            fut.result()
-                            if fut is not None
-                            else remote_readers[i](offset, step)
-                        )
-                        got = len(raw)
-                        if got == step:
-                            tile[j] = np.frombuffer(raw, dtype=np.uint8)
-                    if got != step:
-                        raise ValueError(
-                            f"ec shard {i} truncated: expected {step} at "
-                            f"{offset}"
-                        )
+                if session is not None:
+                    covered, gaps = session.consume(offset, step)
+                else:
+                    covered, gaps = [], [(offset, step)]
+                # parts: ("don", off, {target: bytes}) ride through as
+                # bytes; ("raw", off, [k, n] tile) get decoded. Only the
+                # gaps pay survivor reads — donated ranges moved zero
+                # new bytes (arXiv:2205.11015's partial-repair shape)
+                parts: list = [
+                    ("don", d_off, per_t) for d_off, per_t in covered
+                ]
+                for g_off, g_len in gaps:
+                    parts.append(("raw", g_off, gather(g_off, g_len)))
                 _charge(busy, busy_lock, "read_s", time.perf_counter() - t0)
-                if not _q_put(read_q, (offset, tile), pipe.stop):
+                if not _q_put(read_q, (offset, parts), pipe.stop):
                     return
         finally:
             if fetch_pool is not None:
@@ -659,14 +717,23 @@ def stream_rebuild_ec_files(
             item = _q_get(write_q, pipe.stop)
             if item is _EOF or item is _STOPPED:
                 return
-            offset, handle = item
+            _offset, parts = item
             t0 = time.perf_counter()
-            rebuilt = fetch_fn(handle)
+            fetched = [
+                (kind, off, fetch_fn(payload) if kind == "h" else payload)
+                for kind, off, payload in parts
+            ]
             t1 = time.perf_counter()
-            for j, i in enumerate(targets):
-                _pwrite_full(
-                    out_fds[i], np.ascontiguousarray(rebuilt[j]), offset
-                )
+            for kind, off, payload in fetched:
+                if kind == "don":
+                    for i in targets:
+                        _pwrite_full(out_fds[i], payload[i], off)
+                        EC_REPAIR_BYTES_WRITTEN.inc(len(payload[i]))
+                else:
+                    for j, i in enumerate(targets):
+                        row = np.ascontiguousarray(payload[j])
+                        _pwrite_full(out_fds[i], row, off)
+                        EC_REPAIR_BYTES_WRITTEN.inc(len(row))
             t2 = time.perf_counter()
             _charge(busy, busy_lock, "fetch_s", t1 - t0)
             _charge(busy, busy_lock, "write_s", t2 - t1)
@@ -689,11 +756,18 @@ def stream_rebuild_ec_files(
             item = _q_get(read_q, pipe.stop)
             if item is _STOPPED:
                 break
-            offset, tile = item
+            offset, parts = item
             t0 = time.perf_counter()
-            handle = rebuild_fn(survivors, targets, tile)
+            parts = [
+                (
+                    ("h", off, rebuild_fn(survivors, targets, payload))
+                    if kind == "raw"
+                    else (kind, off, payload)
+                )
+                for kind, off, payload in parts
+            ]
             _charge(busy, busy_lock, "dispatch_s", time.perf_counter() - t0)
-            if not _q_put(write_q, (offset, handle), pipe.stop):
+            if not _q_put(write_q, (offset, parts), pipe.stop):
                 break
         for _ in range(writer_threads):
             if not _q_put(write_q, _EOF, pipe.stop):
@@ -704,13 +778,26 @@ def stream_rebuild_ec_files(
             pipe.finish(caller_error=not ok)  # may re-raise a stage error
         finally:
             tc0 = time.perf_counter()
+            fsync_err: OSError | None = None
             try:
                 for fd in out_fds.values():
                     try:
+                        if durable and ok and not pipe.errors:
+                            # crash contract (weedcrash, docs/ANALYSIS.md
+                            # v3): a rebuild acked to its caller must
+                            # survive power loss — pin the shard bytes
+                            # before the fds close and the ack leaves;
+                            # a FAILED fsync fails the rebuild (below)
+                            # rather than acking page-cache-only bytes
+                            try:
+                                os.fsync(fd)
+                            except OSError as e:
+                                if fsync_err is None:
+                                    fsync_err = e
                         os.close(fd)
                     except OSError:
                         pass
-                if not ok or pipe.errors:
+                if not ok or pipe.errors or fsync_err is not None:
                     # half-written targets must not survive: a later
                     # shard_presence would count the garbage files as
                     # valid shards and silently skip rebuilding them
@@ -720,6 +807,8 @@ def stream_rebuild_ec_files(
                             os.remove(base_file_name + to_ext(i))
                         except OSError:
                             pass
+                if fsync_err is not None:
+                    raise fsync_err
             finally:
                 # an ENOSPC surfacing mid-stream must not skip the
                 # stats nor leak any fd (the reader pool closes its own
@@ -729,7 +818,16 @@ def stream_rebuild_ec_files(
                     _finish_stats(
                         stats, busy, wall0, reader_threads, writer_threads
                     )
+                    if session is not None:
+                        stats["donated_bytes"] = session.donated_bytes
+                        stats["used_donated_bytes"] = (
+                            session.used_donated_bytes
+                        )
+                        stats["serve_yields"] = session.yields
                 _trace_stages(_sp, busy)
+                if session is not None and _sp:
+                    _sp.annotate("donated_bytes", session.used_donated_bytes)
+                    _sp.annotate("serve_yields", session.yields)
                 # a stage error re-raised by pipe.finish() is live in
                 # this finally; hand it to the span so a failed drive
                 # is distinguishable from a clean one in /debug/traces

@@ -1,0 +1,162 @@
+"""Per-volume LRU cache of reconstructed EC shard tiles.
+
+A degraded read — a GET whose interval lands on a lost/quarantined
+shard — must decode that interval from k surviving shards. The decode
+input is k× the output and the gather usually crosses the rack, so
+re-decoding the same hot range for every GET multiplies both CPU and
+network by the read rate. This cache remembers the *reconstructed
+bytes* at fixed tile granularity: the first degraded read of a tile
+pays the k-shard gather + decode once, every later read of any
+interval inside it is a memcpy.
+
+Correctness leans on two facts:
+
+  * RS reconstruction is deterministic — any k survivors produce the
+    same bytes — so a cached tile is byte-identical to a fresh decode
+    no matter which survivor set either used;
+  * shard bytes are immutable while mounted (deletes tombstone the
+    .ecx, never touch shard files), so the only events that can change
+    what a decode would return are shard remount (a rebuild landed a
+    regenerated file), quarantine, and rebuild itself — EcVolume
+    invalidates on each.
+
+The cache is per-EcVolume (dropped wholesale with the volume), bounded
+in bytes, and safe for concurrent readers. Knobs (docs/OPERATIONS.md
+env table): WEED_EC_TILE_CACHE=0 disables, WEED_EC_TILE_CACHE_MB
+bounds the per-volume footprint (default 64), WEED_EC_TILE_BYTES sets
+the tile granularity (default 256 KiB).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+from seaweedfs_tpu.stats.metrics import EC_TILE_CACHE
+
+DEFAULT_TILE_BYTES = 256 * 1024
+DEFAULT_CAPACITY_MB = 64
+
+
+def _int_or(raw: str, default: int) -> int:
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+class TileCache:
+    """LRU of (shard_id, tile_offset) -> reconstructed bytes."""
+
+    def __init__(
+        self,
+        capacity_bytes: int | None = None,
+        tile_bytes: int | None = None,
+    ):
+        # literal env reads so the weedlint contract tier can cross-
+        # check each knob against the OPERATIONS.md table
+        if capacity_bytes is None:
+            capacity_bytes = _int_or(
+                os.environ.get(
+                    "WEED_EC_TILE_CACHE_MB", str(DEFAULT_CAPACITY_MB)
+                ),
+                DEFAULT_CAPACITY_MB,
+            ) << 20
+        if tile_bytes is None:
+            tile_bytes = _int_or(
+                os.environ.get(
+                    "WEED_EC_TILE_BYTES", str(DEFAULT_TILE_BYTES)
+                ),
+                DEFAULT_TILE_BYTES,
+            )
+        self.capacity_bytes = max(0, capacity_bytes)
+        self.tile_bytes = max(4096, tile_bytes)
+        if os.environ.get("WEED_EC_TILE_CACHE", "1") == "0":
+            self.capacity_bytes = 0
+        self._lock = threading.Lock()
+        self._tiles: OrderedDict[tuple[int, int], bytes] = OrderedDict()
+        self._bytes = 0
+        self.invalidations = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity_bytes > 0
+
+    @property
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def get(self, shard_id: int, tile_off: int) -> bytes | None:
+        """Counted probe (hit/miss land on weed_ec_tile_cache_total)."""
+        with self._lock:
+            data = self._tiles.get((shard_id, tile_off))
+            if data is not None:
+                self._tiles.move_to_end((shard_id, tile_off))
+        EC_TILE_CACHE.labels("hit" if data is not None else "miss").inc()
+        return data
+
+    def covers(self, shard_id: int, offset: int, size: int) -> bool:
+        """Uncounted probe: True when every tile of [offset, offset+size)
+        is resident — lets the read path prefer memory over a remote
+        shard fetch without charging a miss for merely asking."""
+        if not self.enabled or size <= 0:
+            return False
+        tile = self.tile_bytes
+        t = (offset // tile) * tile
+        with self._lock:
+            while t < offset + size:
+                data = self._tiles.get((shard_id, t))
+                if data is None or t + len(data) < min(offset + size, t + tile):
+                    return False
+                t += tile
+        return True
+
+    def put(
+        self,
+        shard_id: int,
+        tile_off: int,
+        data: bytes,
+        gen: int | None = None,
+    ) -> bool:
+        """Insert a tile; returns True when it landed. `gen` is the
+        invalidation generation captured BEFORE the decode started
+        (self.invalidations): an invalidation that raced the decode —
+        e.g. a survivor quarantined mid-gather may have contributed
+        corrupt bytes — makes the stale insert a no-op instead of
+        poisoning the cache forever (checked under the same lock
+        invalidate() increments under)."""
+        if not self.enabled or not data:
+            return False
+        with self._lock:
+            if gen is not None and gen != self.invalidations:
+                return False
+            old = self._tiles.pop((shard_id, tile_off), None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._tiles[(shard_id, tile_off)] = data
+            self._bytes += len(data)
+            while self._bytes > self.capacity_bytes and self._tiles:
+                _, evicted = self._tiles.popitem(last=False)
+                self._bytes -= len(evicted)
+        return True
+
+    def snapshot(self, shard_id: int) -> list[tuple[int, bytes]]:
+        """Resident tiles of one shard, (tile_off, bytes) — the rebuild
+        piggyback drains these at session open so degraded traffic that
+        already ran still counts toward repair forward-progress."""
+        with self._lock:
+            return [
+                (off, data)
+                for (sid, off), data in self._tiles.items()
+                if sid == shard_id
+            ]
+
+    def invalidate(self) -> None:
+        """Drop everything (shard remount / quarantine / rebuild: the
+        decode inputs changed, cached outputs may no longer match)."""
+        with self._lock:
+            self._tiles.clear()
+            self._bytes = 0
+            self.invalidations += 1

@@ -192,6 +192,60 @@ class _AttemptPool:
 _ATTEMPTS = _AttemptPool()
 
 
+def submit_attempt(fn, *args) -> None:
+    """Run `fn(*args)` on the shared cached attempt-worker pool (the
+    same parked threads hedged GETs fire on). Fire-and-forget: results
+    travel through whatever channel `fn` closes over."""
+    _ATTEMPTS.submit(fn, *args)
+
+
+def gather_first_k(tasks: dict, k: int, timeout: float = 30.0) -> dict:
+    """Fan every task out on the shared attempt pool; return the first
+    `k` successes as {tag: result}. The generalized k-of-n gather the
+    EC degraded read path runs over shard survivors (ROADMAP QoS
+    follow-on "hedging for EC degraded reads"): all candidates race,
+    the k fastest win, the rest are abandoned.
+
+    `tasks` maps tag -> callable(done_event) -> result; returning None
+    (or raising) is a miss. `done_event` is set once k results are in —
+    a long task can consult it between retries to stop doing abandoned
+    work (attempt-level cancellation; the pool worker itself is
+    recycled either way)."""
+    if k <= 0 or not tasks:
+        return {}
+    done = threading.Event()
+    out_q: queue.Queue = queue.Queue()
+
+    def run(tag, fn):
+        result = None
+        try:
+            result = fn(done)
+        except Exception:  # noqa: BLE001 — a failed attempt is a miss
+            result = None
+        out_q.put((tag, result))
+
+    for tag, fn in tasks.items():
+        _ATTEMPTS.submit(run, tag, fn)
+    import time as _time
+
+    got: dict = {}
+    pending = len(tasks)
+    deadline = _time.monotonic() + timeout
+    while len(got) < k and pending > 0:
+        wait = deadline - _time.monotonic()
+        if wait <= 0:
+            break
+        try:
+            tag, result = out_q.get(timeout=wait)
+        except queue.Empty:
+            break
+        pending -= 1
+        if result is not None:
+            got[tag] = result
+    done.set()
+    return got
+
+
 class _Attempt:
     """One in-flight GET try. cancel() is safe against the completion
     race: the owning thread marks `finished` under the same lock before

@@ -807,8 +807,11 @@ class VolumeServer:
         if v is None:
             context.abort(grpc.StatusCode.NOT_FOUND, f"volume {req.volume_id} not found")
         base = v.base_name
-        ec_files.write_ec_files(base, rs=self._new_rs())
-        ec_files.write_sorted_file_from_idx(base)
+        # durable ordering (weedcrash ec-encode workload): shard bytes
+        # fsynced BEFORE the .ecx publish — a crash can then never leave
+        # a complete-looking index over page-cache-only shard files
+        ec_files.write_ec_files(base, rs=self._new_rs(), durable=True)
+        ec_files.write_sorted_file_from_idx(base, durable=True)
         return pb.VolumeEcShardsGenerateResponse()
 
     def VolumeEcShardsBatchGenerate(self, req, context):
@@ -836,9 +839,16 @@ class VolumeServer:
             codec = MeshCodec(
                 make_mesh(devices, stripe=len(devices) // vol_axis)
             )
+            from seaweedfs_tpu.util import durable
+
             ec_files.write_ec_files_batch(bases, codec=codec)
             for base in bases:
-                ec_files.write_sorted_file_from_idx(base)
+                # same durable ordering as the single-volume verb: the
+                # batch driver has no fsync arm, so pin every shard
+                # file here BEFORE the .ecx publish can imply it
+                for i in range(ec_files.TOTAL_SHARDS):
+                    durable.fsync_path(base + ec_files.to_ext(i))
+                ec_files.write_sorted_file_from_idx(base, durable=True)
         return pb.VolumeEcShardsBatchGenerateResponse()
 
     def VolumeEcShardsRebuild(self, req, context):
@@ -863,7 +873,9 @@ class VolumeServer:
         base = self._base_name(req.collection, req.volume_id)
         present, missing = ec_files.shard_presence(base)
         if not missing or not self.master:
-            rebuilt = ec_files.rebuild_ec_files(base, rs=self._new_rs())
+            rebuilt = ec_files.rebuild_ec_files(
+                base, rs=self._new_rs(), durable=True
+            )
             return pb.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
         # with a master, always learn which "missing" shards are in
         # fact mounted elsewhere: they serve as remote survivors and
@@ -875,23 +887,42 @@ class VolumeServer:
         )
         try:
             if not readers:
-                rebuilt = ec_files.rebuild_ec_files(base, rs=self._new_rs())
+                rebuilt = ec_files.rebuild_ec_files(
+                    base, rs=self._new_rs(), durable=True
+                )
             else:
-                from seaweedfs_tpu.ec import ec_stream
+                from seaweedfs_tpu.ec import ec_stream, repair_session
 
                 rs = self._new_rs()
                 rebuild_fn = fetch_fn = None
                 if not ec_files._use_stream_driver(rs):
                     rebuild_fn, fetch_fn = ec_stream.local_rebuild_fns(rs)
+                # repair piggyback (docs/SCRUB.md): degraded GETs of
+                # this volume donate the tiles they decode while the
+                # session is open, and tiles already decoded for past
+                # degraded reads seed it — the driver then gathers
+                # survivors only for the gaps
+                targets = [i for i in missing if i not in readers]
+                sess = repair_session.open_session(req.volume_id, targets)
                 try:
+                    # inside the try: a raise here must still unregister
+                    # the session, or every later degraded read donates
+                    # into a dead one (bounded by the cap, held forever)
+                    ev = self.store.find_ec_volume(req.volume_id)
+                    if ev is not None:
+                        ev.donate_cached_tiles(sess)
                     rebuilt = ec_stream.stream_rebuild_ec_files(
                         base,
                         rebuild_fn=rebuild_fn,
                         fetch_fn=fetch_fn,
                         remote_readers=readers,
+                        session=sess,
+                        durable=True,
                     )
                 except ValueError as e:
                     context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+                finally:
+                    repair_session.close_session(sess)
         finally:
             close_readers()
         return pb.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
@@ -1464,6 +1495,31 @@ class VolumeServer:
                         )
                     server.scrub.trigger(vid)
                     return self._json({"triggered": True, "volumeId": vid})
+                if url_path == "/ec/quarantine":
+                    # operator surface (and tests/faults.DeadShard): put
+                    # one mounted EC shard out of service NOW — the
+                    # degraded-read drill lever (docs/SCRUB.md); same
+                    # rename-to-.bad path the scrubber takes, so the
+                    # repair plane regenerates it like real damage
+                    q = fast_query(self.path.partition("?")[2])
+                    try:
+                        vid = int(q.get("volumeId", ""))
+                    except ValueError:
+                        return self._json({"error": "bad volumeId"}, 400)
+                    ev = server.store.find_ec_volume(vid)
+                    if ev is None:
+                        return self._json(
+                            {"error": f"ec volume {vid} not here"}, 404
+                        )
+                    sid_arg = q.get("shard", "")
+                    try:
+                        sid = int(sid_arg) if sid_arg else ev.shard_ids()[0]
+                    except (ValueError, IndexError):
+                        return self._json({"error": "bad shard"}, 400)
+                    ok = ev.quarantine_shard(sid, "operator: /ec/quarantine")
+                    return self._json(
+                        {"volumeId": vid, "shard": sid, "quarantined": ok}
+                    )
                 if url_path == "/metrics":
                     from seaweedfs_tpu.stats.metrics import DEFAULT_REGISTRY
 

@@ -354,6 +354,32 @@ HEDGE_SERVED = DEFAULT_REGISTRY.counter(
     "requests a server observed carrying the x-weed-hedge hop header",
     ("server",),
 )
+# --- EC degraded reads & repair-bandwidth accounting (docs/SCRUB.md) --------
+# Every degraded/repair byte moved is counted so bench can report
+# bytes-moved-per-rebuilt-byte and degraded-read p99 vs healthy p99.
+EC_DEGRADED_READS = DEFAULT_REGISTRY.counter(
+    "weed_ec_degraded_read_total",
+    "EC intervals served by reconstruction (a shard was lost/quarantined)",
+)
+EC_TILE_CACHE = DEFAULT_REGISTRY.counter(
+    "weed_ec_tile_cache_total",
+    "reconstructed-tile cache probes on the degraded read path",
+    ("result",),  # result: hit | miss
+)
+EC_REPAIR_BYTES_READ = DEFAULT_REGISTRY.counter(
+    "weed_ec_repair_bytes_read_total",
+    "survivor bytes gathered by EC rebuild, by where they came from",
+    ("source",),  # source: local | remote
+)
+EC_REPAIR_BYTES_WRITTEN = DEFAULT_REGISTRY.counter(
+    "weed_ec_repair_bytes_written_total",
+    "rebuilt shard bytes written by EC rebuild",
+)
+EC_REPAIR_DONATED_BYTES = DEFAULT_REGISTRY.counter(
+    "weed_ec_repair_donated_bytes_total",
+    "tile bytes degraded serving handed to an in-progress rebuild",
+)
+
 ADMISSION_REJECTED = DEFAULT_REGISTRY.counter(
     "weed_admission_rejected_total",
     "requests shed with 503 + Retry-After by per-client admission control",

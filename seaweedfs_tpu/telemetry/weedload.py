@@ -143,6 +143,12 @@ def _worker(spec: dict, out_q, barrier=None) -> None:
     payload = spec["payload"]
     rate = spec["rate"]
     keys = spec.get("keys") or []
+    # degraded-GET worker knob (docs/SCRUB.md): a degraded read that
+    # "succeeds" with truncated or zero-filled bytes is the worst
+    # failure mode a latency number can hide — verify_bytes makes a
+    # wrong-length body an ERROR, so the degraded A/B's `errors: 0`
+    # actually certifies reconstruction, not just status codes
+    verify_bytes = int(spec.get("verify_bytes") or 0)
     use_hedge = bool(spec.get("hedge"))
     hedge_stats: dict = {}
     if use_hedge:
@@ -200,6 +206,11 @@ def _worker(spec: dict, out_q, barrier=None) -> None:
             raise _Shed()
         if status != 200:
             raise RuntimeError(f"get {fid} HTTP {status}")
+        if verify_bytes and len(data) != verify_bytes:
+            raise RuntimeError(
+                f"get {fid}: {len(data)} bytes, expected {verify_bytes} "
+                f"(degraded reconstruction served wrong-length body)"
+            )
         nbytes += len(data)
 
     n_slot = 0
@@ -648,6 +659,7 @@ def run_load(
     mixed: int = 0,
     hedge: bool = False,
     keys: list | None = None,
+    verify_bytes: int = 0,
 ) -> dict:
     """Drive writers+readers(+mixed) worker PROCESSES against the
     cluster at `master`; returns the merged report. `rate` is
@@ -662,7 +674,12 @@ def run_load(
     (fid, [replica_url, ...]) (seed_keys_replicated builds them; a
     caller injecting a slow replica rewrites one url to its proxy).
     The report carries hedge fired/won/cancelled counts and `shed`
-    (503-refused requests, histogrammed apart from accepted ones)."""
+    (503-refused requests, histogrammed apart from accepted ones).
+
+    `verify_bytes` (the degraded-GET worker, docs/SCRUB.md): GET bodies
+    whose length differs are counted as errors — drives real degraded
+    traffic against an EC volume with a DeadShard and certifies the
+    reconstruction, not just the status code."""
     if writers <= 0 and readers <= 0 and mixed <= 0:
         raise ValueError("need at least one worker")
     # \x00\xff keeps the body ungzippable so the write path stays honest
@@ -692,6 +709,7 @@ def run_load(
             "keys": keys,
             "index": i * 7,
             "hedge": hedge,
+            "verify_bytes": verify_bytes,
         }
         p = ctx.Process(
             target=_worker, args=(spec, out_q, barrier), daemon=True

@@ -153,6 +153,13 @@ def _callbacks(server):
     handler_cls = server.RequestHandlerClass
     trace_label = getattr(server, "trace_name", "")
     trace_node = getattr(server, "trace_node", "")
+    # QoS plane (docs/QOS.md): fast-path GETs never enter the Python
+    # dispatch funnel, so without this the heartbeat in_flight signal
+    # under-reports a node saturated by zero-copy reads. resolve()
+    # enters, complete() exits — the C loop fires complete() exactly
+    # once per resolved response, including connection-lost teardowns
+    # (weed_conn_release_resp runs on every destroy path).
+    load_tracker = getattr(server, "load_tracker", None)
     open_span, close_span, sample_hit = _trace.loop_tracer(trace_node)
     trace_enabled = _trace.enabled
     hist_observe = HTTP_REQUEST_HISTOGRAM.observe
@@ -182,6 +189,9 @@ def _callbacks(server):
                 0,
                 clock(),
             )
+        if load_tracker is not None:
+            load_tracker.enter()  # exited in complete(); nothing can
+            # raise between here and the loop owning the token
         return (
             status,
             prefix,
@@ -232,6 +242,8 @@ def _callbacks(server):
             sock.close()
 
     def complete(ctx, status, nbytes, t_parse, t_resolve, t_send, ok):
+        if load_tracker is not None:
+            load_tracker.exit()
         sp, cmd = ctx
         if sp is not None:
             sp.add_stages(

@@ -108,6 +108,85 @@ class SlowReplicaProxy:
                 pass
 
 
+class DeadShard:
+    """Quarantine one mounted shard of a LIVE EC volume mid-load — the
+    degraded-read fault (docs/SCRUB.md): every later GET whose interval
+    lands on the shard must reconstruct from survivors, exactly like a
+    disk death under traffic. Uses the same rename-to-.bad quarantine
+    the scrubber does, so the repair plane treats it as real damage.
+
+    In-process servers: pass `volume_servers`; subprocess/CLI clusters:
+    pass `addr` ("host:port") and the fault rides the /ec/quarantine
+    operator route instead. `restore()` moves the .bad file back and
+    remounts (in-process only), so suites sharing a cluster fixture can
+    heal without a rebuild."""
+
+    def __init__(self, vid: int, sid: int | None = None,
+                 volume_servers=None, addr: str | None = None,
+                 collection: str = ""):
+        self.vid = vid
+        self.collection = collection
+        self.sid: int | None = sid
+        self.addr = addr
+        self._vs = None
+        self._path: str | None = None
+        if (volume_servers is None) == (addr is None):
+            raise ValueError("pass exactly one of volume_servers / addr")
+        if volume_servers is not None:
+            for vs in volume_servers:
+                ev = vs.store.find_ec_volume(vid)
+                if ev is None:
+                    continue
+                ids = ev.shard_ids()
+                if not ids:
+                    continue
+                if sid is None:
+                    self.sid = ids[0]
+                elif sid not in ids:
+                    continue
+                self._vs = vs
+                self._path = ev.shards[self.sid].path
+                break
+            if self._vs is None:
+                raise RuntimeError(
+                    f"no server has a mounted shard of vid {vid}"
+                    + (f" (wanted shard {sid})" if sid is not None else "")
+                )
+
+    def kill(self) -> int:
+        """Quarantine the shard; returns the shard id killed."""
+        if self._vs is not None:
+            ev = self._vs.store.find_ec_volume(self.vid)
+            assert ev is not None
+            if not ev.quarantine_shard(self.sid, "fault: DeadShard"):
+                raise RuntimeError(
+                    f"shard {self.sid} of vid {self.vid} not quarantined"
+                )
+            return self.sid
+        import json
+        import urllib.request
+
+        url = f"http://{self.addr}/ec/quarantine?volumeId={self.vid}"
+        if self.sid is not None:
+            url += f"&shard={self.sid}"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            reply = json.loads(r.read())
+        if not reply.get("quarantined"):
+            raise RuntimeError(f"DeadShard via {self.addr}: {reply}")
+        self.sid = reply["shard"]
+        return self.sid
+
+    def restore(self) -> None:
+        """Undo (in-process only): move the forensic .bad copy back and
+        remount, clearing the quarantine record."""
+        if self._vs is None or self._path is None:
+            raise RuntimeError("restore() needs in-process volume_servers")
+        if os.path.exists(self._path + ".bad"):
+            os.replace(self._path + ".bad", self._path)
+        store = self._vs.store
+        store.mount_ec_shards(self.vid, self.collection, [self.sid])
+
+
 def flip_byte(path: str, offset: int, xor: int = 0xFF) -> int:
     """XOR one byte in place; returns the ORIGINAL byte value."""
     with open(path, "r+b") as f:

@@ -488,6 +488,35 @@ class TestCrashMatrix:
         assert rep.states_tested >= 32
         assert rep.violations == []
 
+    def test_ec_encode_durable_ordering_clean(self):
+        """The EC shard writer-pool flush (ISSUE 12 / PR-11 follow-on):
+        with durable ordering — shard fds fsynced, .ecx via
+        durable.publish — no crash state shows a complete index over
+        missing/torn shard bytes."""
+        rep = crash.run_ec_encode(budget=96)
+        assert rep.states_tested >= 24
+        assert rep.violations == []
+
+    def test_ec_encode_pre_fix_ordering_detected(self):
+        """Regression proof the durable flag is load-bearing: replaying
+        the OLD ordering (no shard fsyncs, .ecx written in place) must
+        yield complete-looking-index-over-page-cache-only-shards
+        states — the exact finding the sweep fixed."""
+        rep = crash.run_ec_encode(budget=96, durable=False)
+        assert rep.violations, (
+            "the unsynced encode should be catchable — either the "
+            "enumerator went blind or posix_fallocate/pwritev streams "
+            "stopped being recorded"
+        )
+
+    def test_shard_handback_acked_writes_survive(self):
+        """-shardWrites ownership handback: worker-owned appends,
+        release, lead catch-up appends, commit — every needle acked at
+        the commit survives recovery, idx never outruns the .dat."""
+        rep = crash.run_shard_handback(budget=96)
+        assert rep.states_tested >= 32
+        assert rep.violations == []
+
     def test_legacy_unsynced_swap_is_caught(self):
         """Regression proof that the commit marker protocol is load-
         bearing: replaying the OLD commit_compact (bare double rename,

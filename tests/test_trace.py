@@ -287,7 +287,20 @@ class TestCrossHop:
         req.add_header("X-Weed-Trace", "feedfeedfeedfeed:0badc0de:serve")
         urllib.request.urlopen(req, timeout=60).close()
 
-        spans = _spans_for("feedfeedfeedfeed")
+        # the gateway's s3.put span is the OUTERMOST: it closes (and
+        # lands in the ring) only AFTER the response bytes went out, so
+        # the client can observe the reply a scheduling quantum before
+        # the handler thread runs span_close — poll briefly instead of
+        # racing it (under full-suite GIL load the single-shot query
+        # lost this race ~1 run in 2)
+        deadline = time.time() + 5.0
+        while True:
+            spans = _spans_for("feedfeedfeedfeed")
+            if any(s["name"] == "s3.put" for s in spans) or (
+                time.time() > deadline
+            ):
+                break
+            time.sleep(0.01)
         by_name: dict[str, list[dict]] = {}
         for s in spans:
             by_name.setdefault(s["name"], []).append(s)
