@@ -2236,6 +2236,215 @@ def bench_degraded() -> None:
             )
 
 
+def bench_chaos() -> None:
+    """weedchaos robustness config (docs/CHAOS.md, BENCH_r11).
+
+    Per serving path (`WEED_NATIVE_SERVE=0` is the lever): a master +
+    2 volume servers with one replica reachable only through a
+    ChaosProxy pair, replication=010 writers under the unified
+    RetryPolicy with per-write deadlines. Three phases:
+
+      baseline — healthy cluster, retries disabled: request volume +
+        write p99 to compare amplification and recovery against;
+      fault — the replica BLACKHOLED (full two-way partition): error
+        rate, p99 during the fault, and the retry-amplification
+        factor = total upstream requests / work attempted. Acceptance:
+        amplification <= 1.15x the no-retry baseline volume (the
+        process-wide retry budget's promise — a blackholed replica
+        degrades latency/errors, it must not multiply load);
+      heal — time-to-recover: seconds from heal() until a replicated
+        write round-trips cleanly again, plus the after-heal p99.
+
+    Emits one JSON line per path and writes BENCH_r11.json."""
+    import tempfile
+    import threading as _threading
+
+    from seaweedfs_tpu.analysis.chaos import ProxyPair
+    from seaweedfs_tpu.client import operation as op
+    from seaweedfs_tpu.client import retry as retry_mod
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.util import deadline as dl_mod
+    from seaweedfs_tpu.util.availability import free_port
+    from seaweedfs_tpu.stats.quantile import percentile
+
+    results = []
+
+    def one_path(native: str) -> dict:
+        os.environ["WEED_NATIVE_SERVE"] = native
+        label = "native" if native == "1" else "threaded"
+        with tempfile.TemporaryDirectory() as d:
+            master = MasterServer(
+                port=free_port(), volume_size_limit_mb=64, vacuum_interval=0
+            )
+            master.start()
+            maddr = f"127.0.0.1:{master.port}"
+            vs_a = VolumeServer(
+                [tempfile.mkdtemp(dir=d)], port=free_port(), master=maddr,
+                heartbeat_interval=0.2, max_volume_counts=[100], rack="r0",
+            )
+            vs_a.start()
+            b_port = free_port()
+            pair = ProxyPair(f"127.0.0.1:{b_port}")
+            # a different rack: replication=010 places the replica in
+            # another rack, which is what routes every write through
+            # the (blackholable) announced pair
+            vs_b = VolumeServer(
+                [tempfile.mkdtemp(dir=d)], port=b_port, master=maddr,
+                heartbeat_interval=0.2, max_volume_counts=[100], rack="r1",
+                announce=pair.addr,
+            )
+            vs_b.start()
+            try:
+                deadline_t = time.time() + 45
+                while (
+                    time.time() < deadline_t
+                    and len(master.topology.data_nodes()) < 2
+                ):
+                    time.sleep(0.05)
+
+                no_retry = retry_mod.RetryPolicy(attempts=1, budget=None)
+
+                def write_round(lat, budget_s=2.0):
+                    """One write op = 2 upstream requests (assign +
+                    upload), whole-op deadline per attempt."""
+                    t0 = time.perf_counter()
+                    try:
+                        with dl_mod.scope(dl_mod.Deadline.after(budget_s)):
+                            ar, _ = op.with_master_failover(
+                                [maddr],
+                                lambda m: op.assign(m, replication="010"),
+                                policy=no_retry,
+                            )
+                            ur = op.upload(
+                                f"{ar.url}/{ar.fid}", b"chaos bench " * 40,
+                                jwt=ar.auth,
+                            )
+                    finally:
+                        lat.append(time.perf_counter() - t0)
+                    if ur.error:
+                        raise RuntimeError(ur.error)
+
+                def fan(n_writers, n_writes, op_policy, budget_s=2.0):
+                    """Writer fan; each failed op is retried through
+                    `op_policy` (None = no retries). Returns request-
+                    volume accounting for the amplification audit."""
+                    lat: list[float] = []
+                    failed = [0]
+                    lock = _threading.Lock()
+                    spent0 = retry_mod.DEFAULT_BUDGET.spent
+
+                    def one_op():
+                        if op_policy is None:
+                            return write_round(lat, budget_s)
+                        return op_policy.run(
+                            lambda a: write_round(lat, budget_s)
+                        )
+
+                    def writer():
+                        for _ in range(n_writes):
+                            try:
+                                one_op()
+                            except Exception:
+                                with lock:
+                                    failed[0] += 1
+
+                    ts = [
+                        _threading.Thread(target=writer, daemon=True)
+                        for _ in range(n_writers)
+                    ]
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join(timeout=180)
+                    attempts = n_writers * n_writes
+                    retried_ops = retry_mod.DEFAULT_BUDGET.spent - spent0
+                    return {
+                        "attempts": attempts,
+                        "failed": failed[0],
+                        # 2 requests per op, retried ops re-issue both
+                        "requests": 2 * (attempts + retried_ops),
+                        "retried_ops": retried_ops,
+                        "p99_ms": round(
+                            percentile(lat, 0.99) * 1000, 1
+                        ) if lat else None,
+                    }
+
+                base = fan(8, 15, None)
+
+                # the unified policy + the process-wide budget: what a
+                # naive client-side retry loop becomes under weedchaos.
+                # Enough offered load that the dry-bucket probe trickle
+                # and the min_reserve are noise against the ratio term —
+                # the regime the ≤1.15x bound is stated for.
+                storm_policy = retry_mod.RetryPolicy(
+                    attempts=3, backoff_ms=50, backoff_max_ms=300,
+                    retry_on=(RuntimeError, OSError),
+                    label="bench-chaos-write",
+                    # one retried write op reissues assign+upload
+                    cost=2.0,
+                )
+                pair.partition()
+                fault = fan(8, 60, storm_policy, budget_s=0.3)
+                amp = fault["requests"] / (2 * max(1, fault["attempts"]))
+
+                pair.heal()
+                t_heal = time.perf_counter()
+                recovered = None
+                probe_lat: list[float] = []
+                while time.perf_counter() - t_heal < 60:
+                    try:
+                        write_round(probe_lat)
+                        recovered = time.perf_counter() - t_heal
+                        break
+                    except Exception:
+                        time.sleep(0.25)
+                after = fan(3, 10, None)
+                row = {
+                    "metric": "chaos",
+                    "serving_path": label,
+                    "baseline_p99_ms": base["p99_ms"],
+                    "baseline_requests": base["requests"],
+                    "baseline_errors": base["failed"],
+                    "fault_error_rate": round(
+                        fault["failed"] / max(1, fault["attempts"]), 3
+                    ),
+                    "fault_p99_ms": fault["p99_ms"],
+                    "retry_amplification": round(amp, 3),
+                    "amplification_bound": 1.15,
+                    "time_to_recover_s": (
+                        round(recovered, 2) if recovered is not None else None
+                    ),
+                    "after_heal_p99_ms": after["p99_ms"],
+                    "after_heal_errors": after["failed"],
+                    "pass": bool(
+                        base["failed"] == 0
+                        and amp <= 1.15
+                        and recovered is not None
+                        and after["failed"] == 0
+                    ),
+                }
+                print(json.dumps(row))
+                return row
+            finally:
+                pair.stop()
+                vs_b.stop()
+                vs_a.stop()
+                master.stop()
+
+    prior_native = os.environ.get("WEED_NATIVE_SERVE")
+    try:
+        for native in ("1", "0"):
+            results.append(one_path(native))
+    finally:
+        if prior_native is None:
+            os.environ.pop("WEED_NATIVE_SERVE", None)
+        else:
+            os.environ["WEED_NATIVE_SERVE"] = prior_native
+    with open(os.path.join(os.path.dirname(__file__), "BENCH_r11.json"), "w") as f:
+        json.dump({"chaos": results}, f, indent=2)
+
+
 CONFIGS = {
     "encode": bench_encode,
     "rebuild": bench_rebuild,
@@ -2254,6 +2463,7 @@ CONFIGS = {
     "serve": bench_serve,
     "qos": bench_qos,
     "degraded": bench_degraded,
+    "chaos": bench_chaos,
 }
 
 
@@ -2812,6 +3022,102 @@ def check_degraded_smoke() -> int:
     return 0 if ok else 1
 
 
+def check_chaos_smoke() -> int:
+    """`bench.py --check` weedchaos leg (docs/CHAOS.md): a planted
+    partition must be DETECTED (a deadlined call through it fails
+    fast, never parks) AND HEALED (the same call succeeds after
+    heal()), and a planted EIO on an EC shard must QUARANTINE the
+    shard — reads stay byte-identical, the server never crashes."""
+    import tempfile
+
+    from seaweedfs_tpu.analysis.chaos import ChaosProxy, DiskChaos, DiskFault
+    from seaweedfs_tpu.client import operation as _cop
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.util import deadline as _cdl
+    from seaweedfs_tpu.util.availability import free_port as _fp
+
+    # --- partition: detected fast (deadline), healed cleanly ------------
+    master = MasterServer(port=_fp(), volume_size_limit_mb=64,
+                          vacuum_interval=0)
+    master.start()
+    proxy = ChaosProxy(f"127.0.0.1:{master.port}")
+    detected = healed = False
+    try:
+        status, _, _ = _cop.http_call(
+            "GET", f"{proxy.addr}/dir/status", timeout=5
+        )
+        pre_ok = status == 200
+        proxy.partition()
+        t0 = time.perf_counter()
+        try:
+            _cop.http_call(
+                "GET", f"{proxy.addr}/dir/status", timeout=5,
+                deadline=_cdl.Deadline.after(0.5),
+            )
+        except (TimeoutError, OSError):
+            # the budget — not a parked socket — ended the call
+            detected = time.perf_counter() - t0 < 3.0
+        proxy.heal()
+        status, _, _ = _cop.http_call(
+            "GET", f"{proxy.addr}/dir/status", timeout=5
+        )
+        healed = pre_ok and status == 200
+    finally:
+        proxy.stop()
+        master.stop()
+
+    # --- EIO: quarantined, reads byte-identical, no crash ---------------
+    import random as _random
+
+    from seaweedfs_tpu.ec import ec_files as _ecf
+    from seaweedfs_tpu.ec.codec import new_encoder as _enc
+    from seaweedfs_tpu.storage.needle import Needle as _Needle
+    from seaweedfs_tpu.storage.store import Store as _Store
+    from seaweedfs_tpu.storage.volume import Volume as _Volume
+
+    eio_ok = quarantined = False
+    with tempfile.TemporaryDirectory() as d:
+        vid = 7
+        victim = os.path.join(d, f"{vid}.ec00")
+        with DiskChaos([DiskFault("eio", victim)]):
+            v = _Volume(d, vid)
+            rng = _random.Random(11)
+            payload = {}
+            for k in range(1, 31):
+                data = bytes(rng.randbytes(rng.randint(400, 3000)))
+                payload[k] = data
+                v.write_needle(_Needle(cookie=0x1234, id=k, data=data))
+            v.close()
+            base = os.path.join(d, str(vid))
+            _ecf.write_ec_files(base, rs=_enc(backend="cpu"))
+            _ecf.write_sorted_file_from_idx(base)
+            os.remove(base + ".dat")
+            os.remove(base + ".idx")
+            store = _Store([d], ec_backend="cpu")
+            try:
+                ev = store.find_ec_volume(vid)
+                ok_reads = 0
+                for _pass in range(2):
+                    for k, data in payload.items():
+                        nd = store.read_needle(vid, k)
+                        ok_reads += bytes(nd.data) == data
+                eio_ok = ok_reads == 2 * len(payload)
+                quarantined = 0 in ev.quarantined
+            finally:
+                store.close()
+
+    ok = detected and healed and eio_ok and quarantined
+    print(json.dumps({
+        "metric": "chaos_smoke",
+        "ok": ok,
+        "partition_detected_fast": detected,
+        "partition_healed": healed,
+        "eio_reads_byte_identical": eio_ok,
+        "eio_shard_quarantined": quarantined,
+    }))
+    return 0 if ok else 1
+
+
 def check_sanitizer_smoke() -> int:
     """Sanitizer gate: the ASan build of the whole shim tier must pass
     the native-post identity matrix and the fuzz-corpus sweep. Skips
@@ -2877,6 +3183,7 @@ def main() -> None:
         rc = rc or check_telemetry_smoke()
         rc = rc or check_qos_smoke()
         rc = rc or check_degraded_smoke()
+        rc = rc or check_chaos_smoke()
         if os.environ.get("WEED_BENCH_CHECK_INNER") != "1":
             rc = rc or check_weedlint()
             rc = rc or check_contracts_smoke()

@@ -1,0 +1,880 @@
+"""weedchaos: deterministic cluster fault injection (docs/CHAOS.md).
+
+The single-node robustness planes (weedcrash, scrub, QoS) never
+exercise CLUSTER failure: partitions, flaky links, dying disks, a
+leader SIGKILLed mid-write. This module is the fault library plus the
+declarative scenario runner that drives a LIVE cluster through those
+regimes while invariant checkers watch — the regime the warehouse-
+cluster failure study (arXiv:1309.0186) shows is exactly where
+recovery traffic and serving traffic collide.
+
+Three fault planes, all deterministic (seeded RNG, explicit trigger
+points), none needing root:
+
+  * `ChaosProxy` — a runtime-mutable TCP proxy generalizing
+    tests/faults.SlowReplicaProxy: per-direction latency/jitter,
+    bandwidth caps, probabilistic connection drop, mid-stream RST, and
+    full blackhole. Wire a node's advertised address through one and
+    the whole cluster reaches it through the fault; `partition()` /
+    `heal()` flip at runtime. `ProxyPair` covers a daemon's HTTP port
+    and its +10000 gRPC port with one shared fault state, so a
+    "partitioned node" is partitioned on both wires at once.
+
+  * `DiskChaos` — an os-level shim (installed like weedcrash's
+    Recorder) injecting EIO / ENOSPC / short reads / slow preads into
+    os.pread/read/pwrite/write for fds whose path matches a prefix.
+    `WEED_CHAOS_DISK` installs it at daemon startup, so subprocess CLI
+    clusters are injectable too (`mode:path_prefix[:ops]`, `;`-joined).
+
+  * `ProcChaos` — SIGKILL / SIGSTOP / SIGCONT / restart for daemon
+    processes (subprocess.Popen or pid), plus `stop()` for in-process
+    servers — the raft-leader-kill lever.
+
+Scenarios are data: a list of (at_s, action) faults applied on a
+timeline against a live cluster while a workload runs, then invariants
+evaluated over the workload's report. See docs/CHAOS.md for the
+catalog (leader-kill during a write fan, partition-during-rebuild,
+EIO-on-read, lossy EC gather) and how to reproduce a finding.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import os
+import random
+import signal
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+from seaweedfs_tpu.util import wlog
+
+
+def _seed_default() -> int:
+    try:
+        return int(os.environ.get("WEED_CHAOS_SEED", "0"))
+    except ValueError:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# ChaosProxy
+
+
+@dataclass
+class LinkFault:
+    """Mutable fault state for ONE direction of a proxied link.
+
+    All fields are live: tests retune them mid-connection and the pump
+    threads read them per chunk."""
+
+    latency_s: float = 0.0  # fixed delay per chunk
+    jitter_s: float = 0.0  # + uniform[0, jitter] per chunk
+    bandwidth_bps: float = 0.0  # 0 = unlimited; else pace chunks
+    # two loss granularities: drop_p is evaluated PER CHUNK (a long
+    # transfer compounds it — flaky-link modeling), drop_conn_p ONCE
+    # per connection ("30% of transfers die" — the scenario-catalog
+    # meaning of loss; a doomed connection RSTs at its first chunk)
+    drop_p: float = 0.0
+    drop_conn_p: float = 0.0
+    blackhole: bool = False  # swallow everything until healed
+    rst_after_bytes: int = -1  # >=0: RST the conn after N fwd bytes
+
+
+class ChaosProxy:
+    """TCP proxy with runtime-mutable faults on each direction.
+
+    Point clients (or a node's advertised url) at `proxy.addr` and
+    every byte each way traverses the fault state. `request` is the
+    client→upstream direction, `response` is upstream→client.
+    Connections arriving (or bytes flowing) while `blackhole` is set
+    PARK until healed — modeling a partition whose packets vanish
+    (peers see stalls and timeouts, never RSTs) — except when
+    `refuse` is set, where new connections are closed immediately
+    (modeling an unreachable-host reject instead)."""
+
+    _POLL_S = 0.05
+
+    def __init__(
+        self,
+        target: str,
+        seed: int | None = None,
+        request: LinkFault | None = None,
+        response: LinkFault | None = None,
+        listener: socket.socket | None = None,
+    ):
+        host, _, port = target.partition(":")
+        self.target = (host, int(port))
+        self.request = request or LinkFault()
+        self.response = response or LinkFault()
+        self.refuse = False
+        self._rng = random.Random(seed if seed is not None else _seed_default())
+        self._rng_lock = threading.Lock()
+        if listener is not None:
+            # pre-bound by the caller (ProxyPair needs two listeners
+            # whose ports differ by exactly the gRPC offset)
+            self._listener = listener
+        else:
+            self._listener = socket.socket()
+            self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._listener.bind(("127.0.0.1", 0))
+            self._listener.listen(128)
+        self._stop = threading.Event()
+        self._conns: list[socket.socket] = []
+        self._lock = threading.Lock()
+        # observability for scenario reports
+        self.conns_total = 0
+        self.conns_dropped = 0
+        self.conns_rst = 0
+        self.bytes_forwarded = 0
+        self.chunks_delayed = 0
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    # -- fault controls ----------------------------------------------------
+    @property
+    def addr(self) -> str:
+        return "127.0.0.1:%d" % self._listener.getsockname()[1]
+
+    @property
+    def port(self) -> int:
+        return self._listener.getsockname()[1]
+
+    def partition(self) -> None:
+        """Full two-way blackhole: in-flight bytes stall, new
+        connections park. The node is unreachable THROUGH this proxy
+        until heal()."""
+        self.request.blackhole = True
+        self.response.blackhole = True
+
+    def heal(self) -> None:
+        """Clear every fault on both directions."""
+        for lf in (self.request, self.response):
+            lf.latency_s = 0.0
+            lf.jitter_s = 0.0
+            lf.bandwidth_bps = 0.0
+            lf.drop_p = 0.0
+            lf.drop_conn_p = 0.0
+            lf.blackhole = False
+            lf.rst_after_bytes = -1
+        self.refuse = False
+
+    @property
+    def partitioned(self) -> bool:
+        return self.request.blackhole and self.response.blackhole
+
+    def _rand(self) -> float:
+        with self._rng_lock:
+            return self._rng.random()
+
+    # -- plumbing ----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            self.conns_total += 1
+            if self.refuse:
+                client.close()
+                self.conns_dropped += 1
+                continue
+            threading.Thread(
+                target=self._open_link, args=(client,), daemon=True
+            ).start()
+
+    def _open_link(self, client: socket.socket) -> None:
+        # a connection arriving during a partition parks here — the
+        # peer's SYN succeeded (the proxy IS reachable) but nothing
+        # flows, which is how a blackholed route feels to a client
+        while (self.request.blackhole or self.response.blackhole) and (
+            not self._stop.is_set()
+        ):
+            time.sleep(self._POLL_S)
+        if self._stop.is_set():
+            client.close()
+            return
+        try:
+            upstream = socket.create_connection(self.target, timeout=10)
+        except OSError:
+            client.close()
+            self.conns_dropped += 1
+            return
+        for s in (client, upstream):
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, True)
+        with self._lock:
+            self._conns += [client, upstream]
+        threading.Thread(
+            target=self._pump, args=(client, upstream, self.request), daemon=True
+        ).start()
+        threading.Thread(
+            target=self._pump, args=(upstream, client, self.response), daemon=True
+        ).start()
+
+    def _rst(self, sock: socket.socket) -> None:
+        """Abortive close: SO_LINGER(on, 0) turns close() into a RST —
+        the mid-stream connection-reset fault."""
+        try:
+            sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+            )
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+        self.conns_rst += 1
+
+    def _pump(self, src, dst, lf: LinkFault) -> None:
+        forwarded = 0
+        doomed = None  # drop_conn_p verdict, drawn at the first chunk
+        try:
+            while True:
+                data = src.recv(1 << 16)
+                if not data:
+                    break
+                # blackhole: park (never forward, never close) until
+                # healed — peers observe a stall, exactly like loss
+                while lf.blackhole and not self._stop.is_set():
+                    time.sleep(self._POLL_S)
+                if self._stop.is_set():
+                    break
+                if doomed is None:
+                    doomed = (
+                        lf.drop_conn_p > 0
+                        and self._rand() < lf.drop_conn_p
+                    )
+                if doomed or (lf.drop_p > 0 and self._rand() < lf.drop_p):
+                    # connection-granularity loss: TCP can't lose bytes
+                    # from the middle of a stream, so "30% loss" on a
+                    # link means 30% of transfers die mid-flight and
+                    # the retry/hedge planes must recover
+                    self.conns_dropped += 1
+                    self._rst(dst)
+                    self._rst(src)
+                    return
+                d = lf.latency_s
+                if lf.jitter_s > 0:
+                    d += self._rand() * lf.jitter_s
+                if d > 0:
+                    self.chunks_delayed += 1
+                    time.sleep(d)
+                if lf.bandwidth_bps > 0:
+                    time.sleep(len(data) / lf.bandwidth_bps)
+                if (
+                    lf.rst_after_bytes >= 0
+                    and forwarded + len(data) > lf.rst_after_bytes
+                ):
+                    keep = max(0, lf.rst_after_bytes - forwarded)
+                    if keep:
+                        try:
+                            dst.sendall(data[:keep])
+                        except OSError:
+                            pass
+                    self._rst(dst)
+                    self._rst(src)
+                    return
+                dst.sendall(data)
+                forwarded += len(data)
+                self.bytes_forwarded += len(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class ProxyPair:
+    """One logical node behind chaos: an HTTP-port proxy and a gRPC-
+    port proxy (+10000, the cluster convention) listening on a
+    matching port pair, faulted together.
+
+    The cluster reaches a daemon by ONE advertised "host:port" and
+    derives the gRPC port from it — so to interpose on everything,
+    `http.port` and `grpc` must differ by exactly 10000. The pair
+    binds a free base port for HTTP and base+10000 for gRPC (retrying
+    until both are free), so `addr` drops in anywhere a node address
+    does."""
+
+    GRPC_OFFSET = 10000
+
+    def __init__(self, target: str, seed: int | None = None, tries: int = 64):
+        host, _, port = target.partition(":")
+        p = int(port)
+        self.http: ChaosProxy | None = None
+        for _ in range(tries):
+            cand = self._bindable_pair()
+            if cand is None:
+                continue
+            http_l, grpc_l = cand
+            self.http = ChaosProxy(f"{host}:{p}", seed=seed, listener=http_l)
+            self.grpc = ChaosProxy(
+                f"{host}:{p + self.GRPC_OFFSET}", seed=seed, listener=grpc_l
+            )
+            break
+        if self.http is None:
+            raise OSError("could not find a free HTTP/+10000 port pair")
+
+    @staticmethod
+    def _bindable_pair():
+        l1 = socket.socket()
+        l1.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        l1.bind(("127.0.0.1", 0))
+        base = l1.getsockname()[1]
+        if base + ProxyPair.GRPC_OFFSET > 65535:
+            l1.close()
+            return None
+        l2 = socket.socket()
+        l2.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            l2.bind(("127.0.0.1", base + ProxyPair.GRPC_OFFSET))
+        except OSError:
+            l1.close()
+            l2.close()
+            return None
+        l1.listen(128)
+        l2.listen(128)
+        return l1, l2
+
+    @property
+    def addr(self) -> str:
+        return self.http.addr
+
+    def partition(self) -> None:
+        self.http.partition()
+        self.grpc.partition()
+
+    def heal(self) -> None:
+        self.http.heal()
+        self.grpc.heal()
+
+    def stop(self) -> None:
+        self.http.stop()
+        self.grpc.stop()
+
+
+# ---------------------------------------------------------------------------
+# DiskChaos
+
+
+@dataclass
+class DiskFault:
+    """One injection rule, matched on the fd's opened path."""
+
+    mode: str  # eio | enospc | short | slow
+    path_prefix: str
+    ops: tuple = ("read",)  # any of ("read", "write")
+    probability: float = 1.0
+    delay_s: float = 0.05  # slow mode: sleep before the real op
+    short_by: int = 1  # short mode: bytes withheld
+    max_hits: int = -1  # -1 = unlimited
+    hits: int = 0
+
+    def matches(self, path: str, op: str) -> bool:
+        if op not in self.ops:
+            return False
+        if not path.startswith(self.path_prefix):
+            return False
+        return self.max_hits < 0 or self.hits < self.max_hits
+
+
+class DiskChaos:
+    """os-level read/write fault shim, installed like weedcrash's
+    Recorder: wraps os.open/close to learn fd→path, and
+    os.pread/read/pwrite/write/pwritev to inject. Only fds OPENED
+    while installed are candidates (matching the Recorder's model);
+    pass-through costs one dict probe per call for everything else."""
+
+    def __init__(self, faults: list[DiskFault] | None = None, seed=None):
+        self.faults: list[DiskFault] = list(faults or [])
+        self._rng = random.Random(seed if seed is not None else _seed_default())
+        self._fd_paths: dict[int, str] = {}
+        self._lock = threading.Lock()
+        self._installed = False
+        self._real: dict[str, Callable] = {}
+
+    def add(self, fault: DiskFault) -> DiskFault:
+        self.faults.append(fault)
+        return fault
+
+    # ------------------------------------------------------------------
+    def _pick(self, fd: int, op: str) -> DiskFault | None:
+        path = self._fd_paths.get(fd)
+        if path is None:
+            return None
+        for f in self.faults:
+            if f.matches(path, op):
+                if f.probability >= 1.0 or self._rng.random() < f.probability:
+                    f.hits += 1
+                    return f
+        return None
+
+    def _strike(self, fault: DiskFault, op: str, nbytes: int):
+        """Returns ('short', n) to truncate, None to proceed; raises
+        for error modes."""
+        if fault.mode == "eio":
+            raise OSError(_errno.EIO, "chaos: injected EIO")
+        if fault.mode == "enospc":
+            if op == "write":
+                raise OSError(_errno.ENOSPC, "chaos: injected ENOSPC")
+            return None
+        if fault.mode == "slow":
+            time.sleep(fault.delay_s)
+            return None
+        if fault.mode == "short":
+            return ("short", max(0, nbytes - fault.short_by))
+        return None
+
+    # ------------------------------------------------------------------
+    def install(self) -> "DiskChaos":
+        if self._installed:
+            return self
+        import builtins
+
+        real = self._real
+        real["open"] = os.open
+        real["bopen"] = builtins.open
+        real["close"] = os.close
+        real["pread"] = os.pread
+        real["read"] = os.read
+        real["pwrite"] = os.pwrite
+        real["write"] = os.write
+        real["pwritev"] = os.pwritev
+        chaos = self
+
+        def c_open(path, flags, mode=0o777, *, dir_fd=None):
+            fd = real["open"](path, flags, mode, dir_fd=dir_fd)
+            with chaos._lock:
+                chaos._fd_paths[fd] = os.fspath(path)
+            return fd
+
+        def c_bopen(file, *args, **kwargs):
+            # buffered opens (EcVolumeShard, Volume) never touch
+            # os.open, but their preads DO ride os.pread on the
+            # underlying fd — track fileno→path so those match too
+            fobj = real["bopen"](file, *args, **kwargs)
+            if isinstance(file, (str, os.PathLike)):
+                try:
+                    fd = fobj.fileno()
+                except (OSError, AttributeError, ValueError):
+                    return fobj
+                with chaos._lock:
+                    chaos._fd_paths[fd] = os.fspath(file)
+            return fobj
+
+        def c_close(fd):
+            with chaos._lock:
+                chaos._fd_paths.pop(fd, None)
+            return real["close"](fd)
+
+        def c_pread(fd, n, offset):
+            f = chaos._pick(fd, "read")
+            if f is not None:
+                act = chaos._strike(f, "read", n)
+                if act is not None:
+                    n = act[1]
+            return real["pread"](fd, n, offset)
+
+        def c_read(fd, n):
+            f = chaos._pick(fd, "read")
+            if f is not None:
+                act = chaos._strike(f, "read", n)
+                if act is not None:
+                    n = act[1]
+            return real["read"](fd, n)
+
+        def c_pwrite(fd, data, offset):
+            f = chaos._pick(fd, "write")
+            if f is not None:
+                act = chaos._strike(f, "write", len(data))
+                if act is not None:
+                    return real["pwrite"](fd, data[: act[1]], offset)
+            return real["pwrite"](fd, data, offset)
+
+        def c_write(fd, data):
+            f = chaos._pick(fd, "write")
+            if f is not None:
+                act = chaos._strike(f, "write", len(data))
+                if act is not None:
+                    return real["write"](fd, data[: act[1]])
+            return real["write"](fd, data)
+
+        def c_pwritev(fd, buffers, offset, flags=0):
+            f = chaos._pick(fd, "write")
+            if f is not None:
+                total = sum(len(b) for b in buffers)
+                chaos._strike(f, "write", total)  # raises for eio/enospc
+            return real["pwritev"](fd, buffers, offset, flags)
+
+        os.open = c_open
+        builtins.open = c_bopen
+        os.close = c_close
+        os.pread = c_pread
+        os.read = c_read
+        os.pwrite = c_pwrite
+        os.write = c_write
+        os.pwritev = c_pwritev
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        import builtins
+
+        os.open = self._real["open"]
+        builtins.open = self._real["bopen"]
+        os.close = self._real["close"]
+        os.pread = self._real["pread"]
+        os.read = self._real["read"]
+        os.pwrite = self._real["pwrite"]
+        os.write = self._real["write"]
+        os.pwritev = self._real["pwritev"]
+        self._installed = False
+        with self._lock:
+            self._fd_paths.clear()
+
+    def __enter__(self) -> "DiskChaos":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+def parse_disk_spec(spec: str) -> list[DiskFault]:
+    """`mode:path_prefix[:ops]` rules, `;`-joined — the WEED_CHAOS_DISK
+    wire format (ops comma-joined, default read). Unparseable rules are
+    skipped with a warning: a typo in a chaos knob must degrade to
+    no-fault, never crash the daemon it targets."""
+    out: list[DiskFault] = []
+    for rule in spec.split(";"):
+        rule = rule.strip()
+        if not rule:
+            continue
+        parts = rule.split(":")
+        if (
+            len(parts) < 2
+            or not parts[1]  # empty prefix would match EVERY file
+            or parts[0] not in ("eio", "enospc", "short", "slow")
+        ):
+            wlog.warning("chaos: ignoring bad WEED_CHAOS_DISK rule %r", rule)
+            continue
+        ops = ("read",)
+        if len(parts) >= 3 and parts[2]:
+            ops = tuple(
+                o for o in parts[2].split(",") if o in ("read", "write")
+            ) or ("read",)
+        out.append(DiskFault(mode=parts[0], path_prefix=parts[1], ops=ops))
+    return out
+
+
+_ENV_DISK: DiskChaos | None = None
+
+
+def install_disk_chaos_from_env() -> DiskChaos | None:
+    """Daemon-startup hook (command/servers.py): when WEED_CHAOS_DISK
+    names rules, install a process-wide DiskChaos before any volume
+    opens — this is how scenarios reach a subprocess CLI cluster's
+    disks. Idempotent; returns the installed shim (or None)."""
+    global _ENV_DISK
+    spec = os.environ.get("WEED_CHAOS_DISK", "")
+    if not spec or _ENV_DISK is not None:
+        return _ENV_DISK
+    faults = parse_disk_spec(spec)
+    if not faults:
+        return None
+    wlog.warning("chaos: WEED_CHAOS_DISK active: %s", spec)
+    _ENV_DISK = DiskChaos(faults).install()
+    return _ENV_DISK
+
+
+# ---------------------------------------------------------------------------
+# ProcChaos
+
+
+class ProcChaos:
+    """Kill/pause/resume/restart one daemon.
+
+    Wraps either a subprocess.Popen (CLI clusters) or any in-process
+    server object with .stop() (the raft-leader-kill scenarios drive
+    in-process MasterServers). `spawn` lets restart() bring a killed
+    subprocess back with the same argv/env."""
+
+    def __init__(self, proc=None, spawn: Callable[[], object] | None = None):
+        self.proc = proc
+        self.spawn = spawn
+        self.killed = False
+        self.paused = False
+
+    def _pid(self) -> int | None:
+        return getattr(self.proc, "pid", None)
+
+    def kill(self) -> None:
+        """SIGKILL (subprocess) or .stop() (in-process): the daemon
+        vanishes without goodbye — no FIN on its sockets' peers' next
+        read, no heartbeat stream teardown."""
+        pid = self._pid()
+        if pid is not None:
+            try:
+                os.kill(pid, signal.SIGKILL)
+                self.proc.wait()
+            except (OSError, AttributeError):
+                pass
+        else:
+            self.proc.stop()
+        self.killed = True
+
+    def pause(self) -> None:
+        """SIGSTOP: the process freezes with every socket still open —
+        the 'gray failure' no liveness check built on TCP accept can
+        see (subprocess only)."""
+        pid = self._pid()
+        if pid is None:
+            raise RuntimeError("pause() needs a subprocess (SIGSTOP)")
+        os.kill(pid, signal.SIGSTOP)
+        self.paused = True
+
+    def resume(self) -> None:
+        pid = self._pid()
+        if pid is None:
+            raise RuntimeError("resume() needs a subprocess (SIGCONT)")
+        os.kill(pid, signal.SIGCONT)
+        self.paused = False
+
+    def restart(self):
+        """Respawn after kill() via the `spawn` callable; returns the
+        new proc handle."""
+        if self.spawn is None:
+            raise RuntimeError("restart() needs a spawn callable")
+        self.proc = self.spawn()
+        self.killed = False
+        return self.proc
+
+
+def kill_raft_leader(masters: list) -> object | None:
+    """SIGKILL-equivalent for the current raft leader among in-process
+    MasterServers (or any objects with .is_leader and .stop()).
+    Returns the killed server, or None when no leader exists yet."""
+    for m in masters:
+        if getattr(m, "is_leader", False):
+            ProcChaos(m).kill()
+            return m
+    return None
+
+
+# ---------------------------------------------------------------------------
+# scenario runner + invariants
+
+
+@dataclass
+class Fault:
+    """One timed action on the scenario timeline."""
+
+    at_s: float
+    action: Callable[[], None]
+    name: str = ""
+
+
+@dataclass
+class Scenario:
+    """A named fault timeline. `duration_s` bounds the whole run
+    (workload included); faults fire at their offsets from start."""
+
+    name: str
+    faults: list[Fault]
+    duration_s: float = 30.0
+
+
+@dataclass
+class InvariantResult:
+    name: str
+    ok: bool
+    detail: str = ""
+
+
+class InvariantFailed(AssertionError):
+    pass
+
+
+def run_scenario(
+    scenario: Scenario,
+    workload: Callable[[], dict],
+    invariants: list[Callable[[dict], InvariantResult]] | None = None,
+) -> dict:
+    """Drive one scenario: start `workload()` on a thread, fire the
+    fault timeline, join the workload (bounded by duration_s + grace),
+    then evaluate every invariant over the workload's report dict.
+
+    Returns the report with `events` (fault log), `invariants`
+    (results), and `ok`. Raises InvariantFailed when any invariant
+    fails — with every result in the message, so a CI failure names
+    the broken property, not just 'assert False'."""
+    events: list[tuple[float, str]] = []
+    report: dict = {}
+    workload_error: list[BaseException] = []
+
+    def run_workload():
+        try:
+            report.update(workload() or {})
+        except BaseException as e:  # noqa: BLE001 - reported, not lost
+            workload_error.append(e)
+
+    t0 = time.monotonic()
+    wt = threading.Thread(target=run_workload, daemon=True)
+    wt.start()
+    for fault in sorted(scenario.faults, key=lambda f: f.at_s):
+        wait = fault.at_s - (time.monotonic() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        name = fault.name or getattr(fault.action, "__name__", "fault")
+        events.append((round(time.monotonic() - t0, 3), name))
+        wlog.warning(
+            "chaos[%s] t=%.2fs: %s", scenario.name, events[-1][0], name
+        )
+        fault.action()
+    wt.join(timeout=max(0.0, scenario.duration_s - (time.monotonic() - t0)) + 30.0)
+    if wt.is_alive():
+        raise InvariantFailed(
+            f"chaos[{scenario.name}]: workload still running past "
+            f"duration {scenario.duration_s}s + 30s grace"
+        )
+    if workload_error:
+        raise workload_error[0]
+    report["scenario"] = scenario.name
+    report["events"] = events
+    report["wall_s"] = round(time.monotonic() - t0, 3)
+    results = [inv(report) for inv in (invariants or [])]
+    report["invariants"] = [
+        {"name": r.name, "ok": r.ok, "detail": r.detail} for r in results
+    ]
+    report["ok"] = all(r.ok for r in results)
+    if not report["ok"]:
+        raise InvariantFailed(
+            f"chaos[{scenario.name}] invariants failed: "
+            + "; ".join(f"{r.name}: {r.detail}" for r in results if not r.ok)
+        )
+    return report
+
+
+# -- the invariant library --------------------------------------------------
+# Each helper RETURNS an invariant callable, so scenarios compose them
+# declaratively: invariants=[no_acked_write_lost(read_fn), ...]
+
+
+def no_acked_write_lost(
+    read_fn: Callable[[str], bytes], acked_key: str = "acked"
+) -> Callable[[dict], InvariantResult]:
+    """Every write the workload reports as ACKED must read back byte-
+    identical after the fault window (report[acked_key] is
+    {fid: expected_bytes}). THE durability invariant: a fault may fail
+    a write loudly, it may never eat an acknowledged one."""
+
+    def check(report: dict) -> InvariantResult:
+        acked: dict = report.get(acked_key, {})
+        lost, corrupt = [], []
+        for fid, expect in acked.items():
+            try:
+                got = read_fn(fid)
+            except Exception as e:  # noqa: BLE001 - classified as lost
+                lost.append(f"{fid}: {e}")
+                continue
+            if got != expect:
+                corrupt.append(fid)
+        ok = not lost and not corrupt
+        return InvariantResult(
+            "no_acked_write_lost",
+            ok,
+            "" if ok else f"lost={lost[:3]} corrupt={corrupt[:3]} "
+            f"({len(lost)} lost / {len(corrupt)} corrupt of {len(acked)})",
+        )
+
+    return check
+
+
+def no_double_apply() -> Callable[[dict], InvariantResult]:
+    """Retries must not double-apply. The workload reports
+    `duplicates` — the count of acked fids it saw MORE THAN ONCE (a
+    replayed assign reusing a volume-id/needle pair) — and may also
+    report the raw `acked_fids` list for an independent uniqueness
+    check (the acked DICT's keys are unique by construction, so they
+    can never show a collision)."""
+
+    def check(report: dict) -> InvariantResult:
+        dupes = int(report.get("duplicates", 0))
+        fids = report.get("acked_fids")
+        if fids is not None:
+            dupes += len(fids) - len(set(fids))
+        return InvariantResult(
+            "no_double_apply",
+            dupes == 0,
+            "" if dupes == 0 else f"{dupes} duplicated applies",
+        )
+
+    return check
+
+
+def converges(
+    probe: Callable[[], bool], bound_s: float, name: str = "converges"
+) -> Callable[[dict], InvariantResult]:
+    """The cluster returns to steady state within `bound_s` of the
+    workload ending: poll `probe()` (heartbeats resumed, repair queue
+    drained, leader elected — caller's definition) until true."""
+
+    def check(report: dict) -> InvariantResult:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < bound_s:
+            try:
+                if probe():
+                    report[f"{name}_s"] = round(time.monotonic() - t0, 3)
+                    return InvariantResult(name, True)
+            except Exception:  # noqa: BLE001 - not converged yet
+                pass
+            time.sleep(0.1)
+        return InvariantResult(name, False, f"not within {bound_s}s")
+
+    return check
+
+
+def bounded_amplification(
+    requests_key: str = "requests_sent",
+    acked_key: str = "acked",
+    factor: float = 1.15,
+) -> Callable[[dict], InvariantResult]:
+    """Retry-storm guard: total upstream requests the workload emitted
+    may not exceed `factor` × the work acked (the retry budget's
+    promise — a blackholed replica degrades latency, it must not
+    multiply load)."""
+
+    def check(report: dict) -> InvariantResult:
+        sent = report.get(requests_key, 0)
+        base = max(1, len(report.get(acked_key, {})) + report.get("failed", 0))
+        amp = sent / base
+        report["amplification"] = round(amp, 3)
+        return InvariantResult(
+            "bounded_amplification",
+            amp <= factor,
+            "" if amp <= factor else f"amplification {amp:.2f} > {factor}",
+        )
+
+    return check

@@ -37,6 +37,14 @@ side of each contract into a registry and reports one-sided edges:
                         add_argument defines (doc rot), or a defined
                         flag with no help= text (the CLI's only
                         self-documentation)
+  no-deadline           a raw urlopen() on a data-plane module
+                        (server/client/filer/ec/qos/scrub/s3api/
+                        webdav): it can never inherit the request's
+                        X-Weed-Deadline budget (docs/CHAOS.md) the way
+                        op.http_call and the gRPC Stub do, so a
+                        multi-hop request outlives its caller's intent
+                        there — migrate to http_call or state why the
+                        bounded one-hop timeout suffices
 
 Suppression uses the standard `# weedlint: ignore[rule] — reason`
 mechanism; findings anchored in markdown use the same comment inside
@@ -174,6 +182,8 @@ class ContractRegistry:
     flag_defined: dict[str, list[Site]] = field(default_factory=dict)
     flag_no_help: list[tuple[str, Site]] = field(default_factory=list)
     flag_documented: dict[str, list[Site]] = field(default_factory=dict)
+    # raw urlopen() call sites on data-plane modules (no-deadline rule)
+    deadline_bypass: list[Site] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         def sites(lst):
@@ -200,6 +210,7 @@ class ContractRegistry:
             "env_documented": sorted(self.env_documented),
             "flags_defined": sorted(self.flag_defined),
             "flags_documented": sorted(self.flag_documented),
+            "deadline_bypass": sites(self.deadline_bypass),
         }
 
 
@@ -342,6 +353,18 @@ def _url_to_path(template: str) -> tuple[str, str] | None:
 
 
 _CLIENT_CALL_TAILS = {"http_call", "urlopen", "Request", "_pooled_request"}
+
+# deadline plane (docs/CHAOS.md): modules on these data-plane paths
+# must make internal hops through deadline-inheriting transports
+# (op.http_call, pb/rpc.Stub). A raw urlopen there is flagged
+# `no-deadline` unless suppressed with a reason.
+_DEADLINE_SCOPE = tuple(
+    os.path.join("seaweedfs_tpu", d) + os.sep
+    for d in (
+        "server", "client", "filer", "ec", "qos", "scrub", "s3api",
+        "webdav",
+    )
+)
 # words in a host placeholder's expression that mark it as a NETWORK
 # location (so `f"{master}/dir/assign"` counts but `f"{dirpath}/x.json"`
 # never does)
@@ -829,6 +852,45 @@ def _parse_all(sources: dict[str, str]) -> dict[str, ast.Module]:
     return trees
 
 
+def _extract_deadline_bypass(
+    trees: dict[str, ast.Module], reg: ContractRegistry
+) -> None:
+    """urlopen() calls on data-plane modules: the transports that
+    inherit the ambient X-Weed-Deadline (op.http_call, rpc.Stub) do so
+    by construction, so the only statically-detectable bypass is a raw
+    urlopen — which has no deadline seam at all."""
+    for rel_path, tree in trees.items():
+        if not rel_path.startswith(_DEADLINE_SCOPE):
+            continue
+        if any(
+            rel_path.startswith(pfx) or rel_path == pfx
+            for pfx in _EXTERNAL_CLIENT_MODULES
+        ):
+            continue  # external-service clients: not our deadline plane
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and _dotted(node.func).rsplit(".", 1)[-1] == "urlopen"
+            ):
+                reg.deadline_bypass.append(Site(rel_path, node.lineno))
+
+
+def _check_deadline(reg: ContractRegistry) -> list[Finding]:
+    return [
+        Finding(
+            "no-deadline",
+            s.path,
+            s.line,
+            "raw urlopen() on a data-plane module cannot inherit the "
+            "request's X-Weed-Deadline budget (docs/CHAOS.md) — a "
+            "multi-hop request outlives its caller's intent here; use "
+            "op.http_call / the gRPC Stub, or state why the bounded "
+            "one-hop timeout suffices",
+        )
+        for s in reg.deadline_bypass
+    ]
+
+
 def build_registry(
     index: PackageIndex,
     docs: dict[str, str] | None = None,
@@ -845,6 +907,7 @@ def build_registry(
     _extract_headers_and_statuses(trees, reg)
     _extract_env_reads(trees, reg)
     _extract_flags(trees, reg)
+    _extract_deadline_bypass(trees, reg)
     if extra_trees:
         _extract_env_reads(extra_trees, reg)
         _extract_flags(extra_trees, reg)
@@ -1131,6 +1194,7 @@ def check(
     findings += _check_statuses(reg)
     findings += _check_env(reg)
     findings += _check_flags(reg)
+    findings += _check_deadline(reg)
     # findings anchored outside the package (docs, bench.py,
     # tests/conftest.py) need those texts in the suppression scan, or
     # the documented `# weedlint: ignore[...]` escape hatch silently

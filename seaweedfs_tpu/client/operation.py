@@ -28,8 +28,11 @@ from dataclasses import dataclass, field
 
 import grpc
 
+from seaweedfs_tpu.client import retry as _retry
 from seaweedfs_tpu.pb import master_pb2, rpc, volume_pb2
 from seaweedfs_tpu.pb.rpc import grpc_address
+from seaweedfs_tpu.util import deadline as _deadline
+from seaweedfs_tpu.util.deadline import DeadlineExceeded
 
 
 # ----------------------------------------------------------------------
@@ -41,28 +44,67 @@ def _is_retryable_master_error(e: Exception) -> bool:
     master; in-band application errors (e.g. 'no free volumes') come
     from the leader itself — every master proxies to the same place,
     so retrying them elsewhere just multiplies the same failure."""
+    if isinstance(e, DeadlineExceeded):
+        return False  # the caller's budget is gone wherever we turn
     if isinstance(e, (OSError, grpc.RpcError)):
         return True
     return "no leader" in str(e)
 
 
-def with_master_failover(masters, fn, start_idx: int = 0):
+class AllMastersFailed(Exception):
+    """One full rotation through the seed list failed retryably."""
+
+    def __init__(self, last: Exception):
+        super().__init__(str(last))
+        self.last = last
+
+
+# Bounded, jittered rounds over the seed list: a leader SIGKILL lands
+# mid-election, so the first rotation often finds only "no leader yet"
+# followers — the backoff is sized to span one election timeout
+# (cluster/raft.py defaults 0.4-0.8 s) without hammering the survivors.
+_MASTER_POLICY = _retry.RetryPolicy(
+    backoff_ms=150,
+    backoff_max_ms=1500,
+    retry_on=(AllMastersFailed,),
+    label="master-failover",
+)
+
+
+def with_master_failover(masters, fn, start_idx: int = 0, policy=None):
     """Run fn(master) against the first master that answers, rotating
     through the seed list on connection/RPC failure (any live master
     serves: non-leaders proxy writes to the leader). Returns
     (result, index_of_master_used); raises the last error when every
-    master is down. The single home for try-each-master logic."""
-    last: Exception | None = None
+    master stays down. The single home for try-each-master logic.
+
+    Rotation is wrapped in the unified RetryPolicy (client/retry.py):
+    a whole-list failure — the signature of a leader kill with the new
+    election still in flight — retries with exponential backoff + full
+    jitter, charged to the process-wide retry budget and bounded by
+    the ambient request deadline, instead of surfacing the raw
+    connection error to the caller after one pass."""
+    policy = policy or _MASTER_POLICY
     n = len(masters)
-    for i in range(n):
-        idx = (start_idx + i) % n
-        try:
-            return fn(masters[idx]), idx
-        except (RuntimeError, OSError, grpc.RpcError) as e:
-            if not _is_retryable_master_error(e):
-                raise
-            last = e
-    raise last if last is not None else RuntimeError("no masters configured")
+
+    def one_round(attempt):
+        last: Exception | None = None
+        for i in range(n):
+            idx = (start_idx + i) % n
+            try:
+                return fn(masters[idx]), idx
+            except (RuntimeError, OSError, grpc.RpcError) as e:
+                if not _is_retryable_master_error(e):
+                    raise
+                last = e
+        if last is None:
+            raise RuntimeError("no masters configured")
+        raise AllMastersFailed(last)
+
+    try:
+        return policy.run(one_round, idempotent=True)
+    except AllMastersFailed as e:
+        raise e.last
 
 
 # ----------------------------------------------------------------------
@@ -362,6 +404,13 @@ def _drop_conn(netloc: str) -> None:
         c.close()
 
 
+# whole-request wall bound for calls with NO propagated deadline: the
+# per-socket-op `timeout` still governs each recv, but the request as
+# a whole may not outlive timeout × this factor — a server trickling
+# one byte per timeout window used to hold the caller indefinitely
+_WALL_FACTOR = 4.0
+
+
 def http_call(
     method: str,
     url: str,
@@ -370,16 +419,27 @@ def http_call(
     timeout: float = 30.0,
     max_redirects: int = 3,
     shed_retries: int = 2,
+    deadline=None,
 ) -> tuple[int, dict, bytes]:
     """Keep-alive request; returns (status, headers, body). Follows
     redirects (volume read-redirect 302s). `url` may omit the scheme.
+
+    Deadline plane (docs/CHAOS.md): `deadline` (else the ambient
+    request deadline a serving funnel installed) bounds the WHOLE call
+    — every socket operation's timeout is derived from the remaining
+    budget, the `X-Weed-Deadline` hop header is re-stamped per attempt
+    so downstream daemons share the clock, and an exhausted budget
+    raises DeadlineExceeded. Calls with no deadline anywhere still get
+    a whole-request wall bound of timeout × 4: `timeout` alone is
+    per-socket-op, so a trickling response used to reset it forever.
 
     QoS plane (docs/QOS.md): a 503 carrying Retry-After is admission
     control shedding load, NOT a dead server — the request was never
     processed, so any method is safe to re-send. Up to `shed_retries`
     retries honor the server's hint with jitter (so a shed thundering
-    herd doesn't re-arrive in phase); `WEED_QOS=0` (or shed_retries=0)
-    returns the 503 to the caller untouched."""
+    herd doesn't re-arrive in phase), each charged to the process-wide
+    retry budget (client/retry.py) so shed clients cannot storm;
+    `WEED_QOS=0` (or shed_retries=0) returns the 503 untouched."""
 
     if "://" in url:
         scheme, _, url = url.partition("://")
@@ -392,6 +452,22 @@ def http_call(
     from seaweedfs_tpu import trace as _trace
 
     _trace.inject(headers)
+    dl = _deadline.effective(deadline)
+    if dl is not None:
+        # span evidence for the deadline plane: how much budget this
+        # hop entered with (the 504-fast-reject test reads it back)
+        _trace.annotate("deadline_ms", round(dl.remaining() * 1000.0, 1))
+    # the wall clock bounds everything below — redirects, shed waits,
+    # every socket op; only a REAL deadline rides the hop header
+    wall = dl if dl is not None else _deadline.Deadline.after(
+        timeout * _WALL_FACTOR
+    )
+    # retry-budget deposit: FIRST-ATTEMPT calls only (a RetryPolicy
+    # retry runs under the in_retry marker) — retried requests
+    # crediting themselves would re-earn part of their own cost and
+    # drift the amplification cap from ~1+r toward 1/(1-k·r)
+    if not _retry.in_retry():
+        _retry.DEFAULT_BUDGET.note_request()
     hops = 0
     while hops <= max_redirects:
         netloc, slash, rest = url.partition("/")
@@ -401,9 +477,20 @@ def http_call(
             c, reused = _pooled_conn(netloc, timeout)
             sent = False
             try:
+                if dl is not None:
+                    # re-stamp per attempt: remaining shrinks
+                    headers[_deadline.DEADLINE_HEADER] = dl.header_value()
+                # arm the whole-request bound: sendall gets one
+                # deadline-capped window (CPython computes a single
+                # deadline for the full sendall), and every response
+                # recv re-arms through the reader
+                c.sock.settimeout(wall.cap(timeout))
+                c.rfile.deadline = wall
+                c.rfile.op_timeout = timeout
                 c.send_request(method, path, body, headers)
                 sent = True
                 status, rheaders, data, will_close = c.read_response(method)
+                c.rfile.deadline = None
                 break
             except (http.client.HTTPException, OSError) as e:
                 _drop_conn(netloc)
@@ -435,13 +522,24 @@ def http_call(
                         ra = float(retry_after)
                     except ValueError:
                         ra = 1.0
-                    if will_close:
-                        _drop_conn(netloc)
-                    shed_retries -= 1
                     # jittered, bounded wait: 50–100% of the server's
                     # hint so retries from many shed clients de-phase
-                    time.sleep(min(ra, 2.0) * (0.5 + _random.random() * 0.5))
-                    continue
+                    wait = min(ra, 2.0) * (0.5 + _random.random() * 0.5)
+                    # a retry the caller's budget can't pay for — or
+                    # one the process-wide retry budget refuses — hands
+                    # the 503 back instead of adding load
+                    if (
+                        wall.remaining() > wait
+                        and _retry.DEFAULT_BUDGET.try_spend()
+                    ):
+                        from seaweedfs_tpu.stats.metrics import RETRY_TOTAL
+
+                        RETRY_TOTAL.labels("http-shed").inc()
+                        if will_close:
+                            _drop_conn(netloc)
+                        shed_retries -= 1
+                        time.sleep(wait)
+                        continue
         if status in (301, 302, 303, 307, 308):
             loc = rheaders.get("Location", "")
             if loc:

@@ -1,0 +1,286 @@
+"""Unified retry policy + process-wide retry budget (docs/CHAOS.md).
+
+Before this module every retry loop in the tree was hand-rolled: the
+master-failover rotation made exactly one pass, `http_call` had its own
+shed-retry counter, and nothing bounded the AGGREGATE retry volume a
+process could emit. Under a partial failure (one replica blackholed,
+a leader election in flight) those ad-hoc loops turn degraded latency
+into multiplied load — the retry storm the Facebook warehouse study
+(arXiv:1309.0186) measures colliding with recovery traffic.
+
+Two pieces:
+
+  * `RetryPolicy` — attempt cap, exponential backoff with FULL jitter
+    (each wait is uniform in [0, base * 2^attempt], the AWS
+    architecture-blog result: full jitter de-phases a shed thundering
+    herd strictly better than equal jitter), idempotency awareness
+    (non-idempotent work is never replayed after it may have been
+    applied), and deadline awareness (never sleep past the request's
+    remaining budget — a retry the caller gave up on is pure load).
+
+  * `RetryBudget` — a process-wide token bucket CREDITED by
+    FIRST-ATTEMPT operations (each RetryPolicy.run — retried attempts
+    deliberately deposit nothing, or every granted retry would earn
+    back part of its own cost and the amplification cap would drift
+    from 1+r toward 1/(1-k·r)) and DEBITED by retries, capping retries
+    at ~10% of recent first-attempt volume (`WEED_RETRY_BUDGET_RATIO`).
+    When the cluster is healthy the budget is a no-op; when a
+    dependency blackholes, the budget empties after the first wave and
+    every later failure degrades to a plain error instead of
+    multiplying upstream load. This is the gRPC/Finagle "retry budget"
+    design, not a circuit breaker: a probe retry every couple of
+    seconds keeps flowing even when dry, so recovery is noticed.
+
+Knobs (OPERATIONS.md "Environment knobs"): `WEED_RETRY_ATTEMPTS`,
+`WEED_RETRY_BACKOFF_MS`, `WEED_RETRY_BACKOFF_MAX_MS`,
+`WEED_RETRY_BUDGET_RATIO`; `WEED_RETRY_BUDGET_RATIO=0` disables the
+budget gate (every policy-approved retry fires).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+from seaweedfs_tpu.stats.metrics import RETRY_BUDGET_EXHAUSTED, RETRY_TOTAL
+from seaweedfs_tpu.util import deadline as _deadline
+
+
+def _attempts_default() -> int:
+    try:
+        return max(1, int(os.environ.get("WEED_RETRY_ATTEMPTS", "4")))
+    except ValueError:
+        return 4
+
+
+def _backoff_ms_default() -> float:
+    try:
+        return float(os.environ.get("WEED_RETRY_BACKOFF_MS", "50"))
+    except ValueError:
+        return 50.0
+
+
+def _backoff_max_ms_default() -> float:
+    try:
+        return float(os.environ.get("WEED_RETRY_BACKOFF_MAX_MS", "2000"))
+    except ValueError:
+        return 2000.0
+
+
+def _budget_ratio_default() -> float:
+    try:
+        return float(os.environ.get("WEED_RETRY_BUDGET_RATIO", "0.1"))
+    except ValueError:
+        return 0.1
+
+
+# first-attempt vs retry marker: RetryPolicy.run sets this around
+# retried attempts so the TRANSPORT (http_call) can credit the shared
+# budget for first-attempt traffic only — retried requests crediting
+# themselves is exactly the feedback loop the budget exists to cut
+_tls = threading.local()
+
+
+def in_retry() -> bool:
+    return getattr(_tls, "in_retry", False)
+
+
+class RetryBudget:
+    """Process-wide retries-as-a-fraction-of-requests token bucket."""
+
+    def __init__(
+        self,
+        ratio: float | None = None,
+        min_reserve: float = 3.0,
+        # burst ceiling: tokens banked during healthy traffic that a
+        # fresh fault may spend at once. Kept SMALL on purpose — a
+        # large bank lets the first seconds of an outage retry-storm
+        # on saved credit and blows the ≤1.15× amplification bound the
+        # chaos bench enforces; refill is continuous (ratio × request
+        # rate), so sustained retry capacity is unaffected
+        max_tokens: float = 16.0,
+    ):
+        # ratio None = read the env knob PER SPEND, so tests and
+        # operators can retune a live process
+        self._ratio = ratio
+        self.min_reserve = min_reserve
+        self.max_tokens = max_tokens
+        self._lock = threading.Lock()
+        self._tokens = min_reserve
+        self._last_probe = 0.0
+        self.spent = 0  # lifetime retries granted (operator surface)
+        self.denied = 0  # lifetime retries refused
+
+    def ratio(self) -> float:
+        return self._ratio if self._ratio is not None else _budget_ratio_default()
+
+    def note_request(self, n: int = 1) -> None:
+        """Credit the budget for `n` first-attempt requests."""
+        r = self.ratio()
+        if r <= 0:
+            return
+        with self._lock:
+            self._tokens = min(self.max_tokens, self._tokens + r * n)
+
+    # dry-bucket probe cadence: frequent enough to notice a dependency
+    # recovering, rare enough that probes stay noise against any real
+    # request rate (the ≤1.15× amplification bound counts them too)
+    probe_interval_s: float = 2.0
+
+    def try_spend(self, now: float | None = None, cost: float = 1.0) -> bool:
+        """Take `cost` retry tokens (cost ≈ the number of upstream
+        requests this retry will reissue, so the ratio stays a bound on
+        retried REQUEST volume, not on coarse-grained operations). When
+        the bucket is dry, a probe retry is still granted once per
+        probe interval — the budget throttles storms, it must not blind
+        the process to the dependency recovering."""
+        r = self.ratio()
+        if r <= 0:
+            return True
+        now = now if now is not None else time.monotonic()
+        with self._lock:
+            if self._tokens >= cost:
+                self._tokens -= cost
+                self.spent += 1
+                return True
+            if now - self._last_probe >= self.probe_interval_s:
+                self._last_probe = now
+                self.spent += 1
+                return True
+            self.denied += 1
+        RETRY_BUDGET_EXHAUSTED.inc()
+        return False
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "Tokens": round(self._tokens, 3),
+                "Ratio": self.ratio(),
+                "Spent": self.spent,
+                "Denied": self.denied,
+            }
+
+
+# the process-wide budget every RetryPolicy shares by default: the
+# whole point is that ALL retry sites drain one pool, so a blackholed
+# replica can't multiply load just by being hit from many call sites
+DEFAULT_BUDGET = RetryBudget()
+
+
+class RetryPolicy:
+    """One retry discipline for every internal client hop.
+
+    `run(fn)` calls `fn(attempt)` up to `attempts` times. `fn` raises
+    to signal a retryable failure (any exception type in `retry_on`)
+    and returns normally on success. Between attempts the policy
+    sleeps full-jitter exponential backoff, charges the shared
+    RetryBudget, and checks the ambient/explicit deadline — whichever
+    gate fails first ends the loop with the last error."""
+
+    def __init__(
+        self,
+        attempts: int | None = None,
+        backoff_ms: float | None = None,
+        backoff_max_ms: float | None = None,
+        retry_on: tuple = (OSError,),
+        budget: RetryBudget | None = DEFAULT_BUDGET,
+        label: str = "generic",
+        rng: random.Random | None = None,
+        cost: float = 1.0,
+    ):
+        # `cost`: budget tokens one retry spends ≈ upstream requests it
+        # reissues (an assign+upload write op retried whole is cost 2)
+        self.attempts = attempts if attempts is not None else _attempts_default()
+        self.backoff_s = (
+            backoff_ms if backoff_ms is not None else _backoff_ms_default()
+        ) / 1000.0
+        self.backoff_max_s = (
+            backoff_max_ms
+            if backoff_max_ms is not None
+            else _backoff_max_ms_default()
+        ) / 1000.0
+        self.retry_on = retry_on
+        self.budget = budget
+        self.label = label
+        self.cost = cost
+        self._rng = rng or random
+
+    # ------------------------------------------------------------------
+    def backoff_for(self, attempt: int) -> float:
+        """Full-jitter wait before attempt `attempt` (1-based retries:
+        attempt 0 is the first try and never waits)."""
+        if attempt <= 0:
+            return 0.0
+        ceiling = min(self.backoff_max_s, self.backoff_s * (2 ** (attempt - 1)))
+        return self._rng.uniform(0.0, ceiling)
+
+    def _may_retry(
+        self,
+        attempt: int,
+        exc: Exception,
+        idempotent: bool,
+        applied: bool,
+        dl: _deadline.Deadline | None,
+    ) -> float | None:
+        """None = give up; else the jittered sleep before the retry."""
+        if attempt + 1 >= self.attempts:
+            return None
+        if not isinstance(exc, self.retry_on):
+            return None
+        # an exhausted budget is terminal however it surfaces — the
+        # caller's clock ran out, more attempts only add load
+        if isinstance(exc, _deadline.DeadlineExceeded):
+            return None
+        if applied and not idempotent:
+            # the request may have been processed (bytes fully sent,
+            # response lost): replaying a non-idempotent request there
+            # double-applies
+            return None
+        wait = self.backoff_for(attempt + 1)
+        if dl is not None and dl.remaining() <= wait + _deadline.MIN_OP_TIMEOUT_S:
+            return None  # the caller will be gone before the retry lands
+        if self.budget is not None and not self.budget.try_spend(
+            cost=self.cost
+        ):
+            return None
+        return wait
+
+    def run(
+        self,
+        fn,
+        idempotent: bool = True,
+        deadline: _deadline.Deadline | None = None,
+        applied=None,
+    ):
+        """Drive `fn(attempt)` under the policy. `applied` (optional
+        callable) reports whether the failed attempt may have reached
+        the server (e.g. the request bytes fully went out) — consulted
+        for non-idempotent work.
+
+        Budget crediting happens at the TRANSPORT (http_call deposits
+        for every non-retry call), not here: retried attempts run
+        under the `in_retry` marker so their own requests deposit
+        nothing, and an op whose attempts never touch the pooled
+        transport simply doesn't feed the pool."""
+        dl = _deadline.effective(deadline)
+        attempt = 0
+        while True:
+            try:
+                if attempt == 0:
+                    return fn(attempt)
+                _tls.in_retry = True
+                try:
+                    return fn(attempt)
+                finally:
+                    _tls.in_retry = False
+            except Exception as e:  # noqa: BLE001 - classified below
+                was_applied = bool(applied(e)) if applied is not None else False
+                wait = self._may_retry(attempt, e, idempotent, was_applied, dl)
+                if wait is None:
+                    raise
+                RETRY_TOTAL.labels(self.label).inc()
+                if wait > 0:
+                    time.sleep(wait)
+                attempt += 1

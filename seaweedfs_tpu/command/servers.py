@@ -167,6 +167,13 @@ def _apply_trace_flags(args) -> None:
         trace.set_slow_threshold_ms(slow_ms)
     if getattr(args, "traceSample", 0) > 0:
         trace.set_sample_every(args.traceSample)
+    # weedchaos (docs/CHAOS.md): every daemon command funnels through
+    # here before serving, so a WEED_CHAOS_DISK spec in the environment
+    # arms the disk-fault shim in subprocess CLI clusters — the chaos
+    # scenarios' lever into a real multi-process cluster's disks
+    from seaweedfs_tpu.analysis.chaos import install_disk_chaos_from_env
+
+    install_disk_chaos_from_env()
 
 
 def _load_guard():
@@ -346,6 +353,12 @@ class VolumeCommand(Command):
             help="address advertised to clients (default ip:port)",
         )
         p.add_argument(
+            "-announce", default="",
+            help="host:port advertised to the CLUSTER (heartbeat "
+            "ip/port peers and repair verbs dial) when this server is "
+            "reached through a proxy/NAT hop; default ip:port",
+        )
+        p.add_argument(
             "-readRedirect", action="store_true",
             help="302-redirect reads for volumes this server lacks",
         )
@@ -491,6 +504,7 @@ class VolumeCommand(Command):
             # group divides the configured per-client budget by its
             # size — the same convention -serveProcs siblings use
             admission_procs=args.admissionProcs or workers,
+            announce=args.announce,
         )
         from seaweedfs_tpu.util.profiling import CpuProfile
 

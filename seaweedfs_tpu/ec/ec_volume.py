@@ -137,6 +137,12 @@ class EcVolume:
         # shard id → reason for every shard quarantined on this node
         # (scrub-plane surface: rides heartbeats + /status JSON)
         self.quarantined: dict[int, str] = {}
+        # shard id → consecutive verified-full-size read failures: at 3
+        # the shard is a failing medium (EIO) and gets quarantined so
+        # repair regenerates it (chaos hardening, see _read_interval).
+        # Only double failures that size-verification cleared count, so
+        # transient close/remount races never accumulate here.
+        self._read_error_strikes: dict[int, int] = {}
         # wired by the Store to its quarantine registry so the event
         # reaches the heartbeat loop (forced delta beat) immediately
         self.on_quarantine: Callable[[int, int, str], None] | None = None
@@ -343,8 +349,9 @@ class EcVolume:
     ) -> bytes:
         shard = self.shards.get(shard_id)
         if shard is not None:
+            data = None
             try:
-                return shard.read_at(offset, size)
+                data = shard.read_at(offset, size)
             except ShardTruncated as e:
                 if not self._quarantine_if_truncated(shard_id):
                     # healthy full-size file: the failure was transient
@@ -353,13 +360,39 @@ class EcVolume:
                     cur = self.shards.get(shard_id)
                     if cur is not None:
                         try:
-                            return cur.read_at(offset, size)
+                            data = cur.read_at(offset, size)
                         except ShardTruncated:
                             # still verify before evicting: a second
                             # transient race must not permanently
                             # quarantine a healthy on-disk shard
-                            self._quarantine_if_truncated(shard_id)
-                wlog.warning("ec read: %s; falling back to recovery", e)
+                            if not self._quarantine_if_truncated(shard_id):
+                                # full-size file that still won't read:
+                                # a failing medium (EIO), not a race.
+                                # Three CONSECUTIVE strikes quarantine
+                                # it so the repair plane regenerates
+                                # the shard instead of every future
+                                # read paying retry+reconstruct forever
+                                # — the weedchaos EIO scenario's
+                                # required behavior (quarantine, don't
+                                # crash)
+                                strikes = self._read_error_strikes
+                                strikes[shard_id] = strikes.get(shard_id, 0) + 1
+                                if strikes[shard_id] >= 3:
+                                    strikes.pop(shard_id, None)
+                                    self.quarantine_shard(
+                                        shard_id,
+                                        f"persistent read errors: {e}",
+                                    )
+                if data is None:
+                    wlog.warning("ec read: %s; falling back to recovery", e)
+            if data is not None:
+                # a clean read clears the strike count: the counter
+                # tracks CONSECUTIVE failures, so rare transient races
+                # spread over weeks can never add up to a quarantine
+                # of a healthy shard
+                if self._read_error_strikes:
+                    self._read_error_strikes.pop(shard_id, None)
+                return data
         if self.tile_cache.covers(shard_id, offset, size):
             # a prior degraded read already decoded this range: memory
             # beats even a healthy remote shard fetch

@@ -341,8 +341,12 @@ static int weed_parse_head(const uint8_t *head, size_t head_len,
                        weed_token_eq_ci(k, kn, "expect") ||
                        weed_token_eq_ci(k, kn, "if-none-match") ||
                        weed_token_eq_ci(k, kn, "if-modified-since") ||
-                       weed_token_eq_ci(k, kn, "etag-md5")) {
-                /* conditional / framing semantics live in Python */
+                       weed_token_eq_ci(k, kn, "etag-md5") ||
+                       weed_token_eq_ci(k, kn, "x-weed-deadline")) {
+                /* conditional / framing / deadline semantics live in
+                 * Python (the mini loop parses the budget, 504-fast-
+                 * rejects expired ones, and scopes the ambient
+                 * deadline around dispatch — docs/CHAOS.md) */
                 return 0;
             } else if (weed_token_eq_ci(k, kn, "range")) {
                 if (req->range != NULL) return 0;  /* duplicate Range */

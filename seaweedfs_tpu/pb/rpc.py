@@ -17,6 +17,7 @@ from seaweedfs_tpu.pb import filer_pb2 as f
 from seaweedfs_tpu.pb import master_pb2 as m
 from seaweedfs_tpu.pb import raft_pb2 as r
 from seaweedfs_tpu.pb import volume_pb2 as v
+from seaweedfs_tpu.util import deadline as _deadline
 
 GRPC_PORT_OFFSET = 10000  # reference convention: grpc port = http port + 10000
 
@@ -228,6 +229,34 @@ FILER_METHODS = {
 }
 
 
+def _deadline_guard(fn, kind):
+    """Server-side deadline enforcement for every gRPC service bound
+    through servicer_handler (docs/CHAOS.md): an `x-weed-deadline`
+    metadata budget that arrived already expired aborts with
+    DEADLINE_EXCEEDED before the method runs, and unary-response
+    methods execute under the budget as the ambient deadline so their
+    own downstream hops inherit it. Streaming-response methods get the
+    fast-reject only — their generators run lazily on other threads,
+    where a scoped thread-local would not follow."""
+    unary_resp = kind in (UNARY_UNARY, STREAM_UNARY)
+
+    def call(request, context):
+        dl = _deadline.from_grpc_context(context) if _deadline.enabled() else None
+        if dl is None:
+            return fn(request, context)
+        if dl.expired:
+            context.abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                "x-weed-deadline expired before dispatch",
+            )
+        if not unary_resp:
+            return fn(request, context)
+        with _deadline.scope(dl):
+            return fn(request, context)
+
+    return call
+
+
 def servicer_handler(service_name: str, methods: dict, impl) -> grpc.GenericRpcHandler:
     """Bind `impl`'s methods (same names as the table) into a generic
     gRPC handler. Methods receive (request_or_iterator, context)."""
@@ -236,7 +265,7 @@ def servicer_handler(service_name: str, methods: dict, impl) -> grpc.GenericRpcH
         fn = getattr(impl, name)
         factory = getattr(grpc, f"{kind}_rpc_method_handler")
         handlers[name] = factory(
-            fn,
+            _deadline_guard(fn, kind),
             request_deserializer=req_cls.FromString,
             response_serializer=lambda msg: msg.SerializeToString(),
         )
@@ -249,13 +278,26 @@ def _traced_call(multicallable):
     `X-Weed-Trace` header across every internal gRPC hop — EC shard
     reads, copies, rebuild verbs, heartbeats — without touching call
     sites. Explicit metadata= wins (the EC readers capture context at
-    factory time because their calls run on pool threads)."""
+    factory time because their calls run on pool threads).
+
+    Deadline plane (docs/CHAOS.md): the same wrapper derives each
+    attempt's gRPC timeout from the ambient request deadline's
+    REMAINING budget (min with any explicit timeout) and forwards the
+    budget as `x-weed-deadline` metadata; an already-exhausted budget
+    raises DeadlineExceeded without dialing."""
 
     def call(request, timeout=None, metadata=None, **kwargs):
         if metadata is None:
             from seaweedfs_tpu.trace import grpc_metadata
 
             metadata = grpc_metadata()
+        dl = _deadline.effective(None)
+        if dl is not None:
+            timeout = dl.cap(timeout)  # DeadlineExceeded when spent
+            md = list(metadata) if metadata else []
+            if not any(k == _deadline.DEADLINE_HEADER for k, _ in md):
+                md.append((_deadline.DEADLINE_HEADER, dl.header_value()))
+            metadata = md
         return multicallable(
             request, timeout=timeout, metadata=metadata, **kwargs
         )

@@ -359,6 +359,17 @@ def download(
         return op.download(urls[0], timeout=timeout)
     import time as _time
 
+    # deadline plane (docs/CHAOS.md): the hedge race runs on the SAME
+    # clock as everything else — the driver's overall timeout shrinks
+    # to the request's remaining budget (raising DeadlineExceeded when
+    # none is left, before any attempt fires), and each attempt's
+    # request carries the hop header so a server can fast-reject work
+    # the caller already gave up on
+    from seaweedfs_tpu.util import deadline as _dl_mod
+
+    dl = _dl_mod.effective(None)
+    if dl is not None:
+        timeout = dl.cap(timeout)
     if key is None:
         # fid "vid,..." → vid buckets the latency history
         tail = urls[0].partition("/")[2]
@@ -367,6 +378,7 @@ def download(
     with trace.span("qos.hedge", plane="serve") as sp:
         base_headers: dict = {}
         trace.inject(base_headers)
+        _dl_mod.stamp(base_headers, dl)
         primary = _Attempt(0, urls[0])
         attempts = [primary]
         _ATTEMPTS.submit(primary.run, base_headers, timeout, out_q)

@@ -86,6 +86,11 @@ class RepairScheduler:
     # at the rate cap) — a 60 s cool-down would delete+recopy a healthy
     # volume every cycle until then
     replace_cooldown: float = 900.0
+    # whole-attempt budget (deadline plane, docs/CHAOS.md): one repair
+    # attempt — all its verbs and remote gathers together — may not
+    # outlive this; a partitioned peer then costs one bounded failed
+    # attempt + backoff, not a parked concurrency slot
+    repair_deadline_s: float = 900.0
     tasks: dict = field(default_factory=dict)  # (kind, vid) -> RepairTask
     history: deque = field(default_factory=lambda: deque(maxlen=50))
 
@@ -270,8 +275,21 @@ class RepairScheduler:
             # reads) inherits the tag via gRPC metadata, so rebuild
             # traffic competing with serving traffic is attributable
             from seaweedfs_tpu import trace
+            from seaweedfs_tpu.util import deadline as _deadline
 
-            with trace.span(f"repair.{task.kind}", plane="repair") as sp:
+            # deadline plane (docs/CHAOS.md): every repair attempt runs
+            # under one whole-repair budget. The ambient deadline rides
+            # the gRPC Stub auto-derivation onto every verb the repair
+            # drives (rebuild, copies, remote EC shard gathers on the
+            # target node's pool threads) — so a PARTITIONED survivor
+            # fails this attempt within the budget and the scheduler's
+            # exponential backoff takes over, instead of one parked
+            # gather pinning a concurrency slot for the full per-verb
+            # timeout stack.
+            with trace.span(f"repair.{task.kind}", plane="repair") as sp, \
+                    _deadline.scope(
+                        _deadline.Deadline.after(self.repair_deadline_s)
+                    ):
                 if sp:
                     sp.annotate("vid", task.volume_id)
                 if task.kind == "ec_rebuild":
@@ -471,6 +489,7 @@ class RepairScheduler:
         import urllib.request
 
         try:
+            # weedlint: ignore[no-deadline] — leader-side best-effort nudge with a 5 s cap; no request deadline exists on the scheduler thread
             urllib.request.urlopen(
                 f"http://{task.bad_node}/scrub/trigger"
                 f"?volumeId={task.volume_id}",

@@ -35,6 +35,7 @@ from urllib.parse import parse_qs
 import grpc
 
 from seaweedfs_tpu import qos, trace
+from seaweedfs_tpu.util import deadline as _op_deadline
 from seaweedfs_tpu.ec import ec_files
 from seaweedfs_tpu.ec.ec_volume import EcVolume, NotEnoughShards
 from seaweedfs_tpu.pb import master_pb2, rpc, volume_pb2 as pb
@@ -129,6 +130,7 @@ class VolumeServer:
         admission_burst: float = 0.0,
         admission_inflight: int = 0,
         admission_procs: int = 1,
+        announce: str = "",
     ):
         # `ec.codec` config: "cpu" | "native" | "tpu" | "" (auto: tpu
         # with a JAX device, else the native SIMD shim, else numpy).
@@ -161,6 +163,20 @@ class VolumeServer:
         self.master = self.seed_masters[0] if self.seed_masters else master
         self._master_rr = 0
         self.public_url = public_url or f"{host}:{port}"
+        # advertised INTERNAL address (heartbeat ip/port → the url every
+        # peer, repair verb, and replica fan-out dials): differs from
+        # the bind address when the cluster must reach this server
+        # through a proxy or NAT hop — including a weedchaos ChaosProxy
+        # pair (docs/CHAOS.md), which is how a live node gets
+        # partitioned without root. Self-identity checks go through
+        # _self_urls(), which matches BOTH the bind and the announced
+        # address — replica fan-out, delete cascades, and shard
+        # gathers must never dial this server through its own
+        # announced hop.
+        self.announce_host, self.announce_port = host, port
+        if announce:
+            a_host, _, a_port = announce.partition(":")
+            self.announce_host, self.announce_port = a_host, int(a_port)
         self.data_center = data_center
         self.rack = rack
         self.heartbeat_interval = heartbeat_interval
@@ -345,8 +361,8 @@ class VolumeServer:
                             v.refresh_from_idx()
             hb = self.store.collect_heartbeat()
             req = master_pb2.HeartbeatRequest(
-                ip=self.host,
-                port=self.port,
+                ip=self.announce_host,
+                port=self.announce_port,
                 public_url=self.public_url,
                 max_volume_count=sum(
                     loc.max_volume_count for loc in self.store.locations
@@ -943,10 +959,10 @@ class VolumeServer:
                 )
         except grpc.RpcError:
             return {}, (lambda: None)
-        me = f"{self.host}:{self.port}"
+        me = self._self_urls()
         locations: dict[int, list[str]] = {}
         for entry in resp.shard_id_locations:
-            urls = [l.url for l in entry.locations if l.url != me]
+            urls = [l.url for l in entry.locations if l.url not in me]
             if urls and entry.shard_id not in skip:
                 locations[entry.shard_id] = urls
         channels: dict[str, grpc.Channel] = {}
@@ -965,10 +981,18 @@ class VolumeServer:
         # not ambient — the captured metadata keeps remote-read spans
         # parented under the rebuild span that built the readers
         md = trace.grpc_metadata()
+        # ...and the ambient deadline the same way (docs/CHAOS.md): the
+        # rebuild verb runs under the caller's budget (the repair
+        # scheduler stamps one), and the pool threads' per-read
+        # timeouts shrink to what remains of it — a partitioned
+        # survivor then fails the gather within the budget instead of
+        # parking each read for the full per-op timeout
+        factory_dl = _op_deadline.current()
 
         def make_reader(sid: int, urls: list[str]):
             def read(offset: int, size: int) -> bytes:
                 last: Exception | None = None
+                t_o = 30 if factory_dl is None else factory_dl.cap(30)
                 for url in urls:
                     try:
                         data = b"".join(
@@ -980,7 +1004,7 @@ class VolumeServer:
                                     offset=offset,
                                     size=size,
                                 ),
-                                timeout=30,
+                                timeout=t_o,
                                 metadata=md,
                             )
                         )
@@ -1278,9 +1302,15 @@ class VolumeServer:
     def _forget_shard_id(ev, shard_id: int) -> None:
         """Drop a shard's cached locations after a failed read; the
         next unhealthy-tier refresh re-learns them (forgetShardId,
-        store_ec.go:211-216)."""
+        store_ec.go:211-216). The refresh clock is also zeroed so that
+        refresh happens on the NEXT fetch, not after the tier TTL —
+        found by the weedchaos lossy-gather scenario: one dropped
+        connection used to blind every reconstruction needing this
+        shard for up to 11 s (the unhealthy-tier TTL), turning 30%
+        connection loss into sustained read unavailability."""
         with ev.shard_locations_lock:
             ev.shard_locations.pop(shard_id, None)
+            ev.shard_locations_refresh_time = 0.0
 
     def _remote_shard_fetcher(self, ev):
         """fetch(shard_id, offset, size) against the EC volume's cached
@@ -1298,15 +1328,14 @@ class VolumeServer:
         # (and the scrub plane tag when the scrubber built this fetcher)
         md = trace.grpc_metadata()
 
-        def fetch(shard_id: int, offset: int, size: int):
-            with ev.shard_locations_lock:
-                urls = list(ev.shard_locations.get(shard_id, []))
-            attempted = False
-            for url in urls:
-                if url == f"{self.host}:{self.port}":
-                    continue
-                attempted = True
-                host, _, port = url.partition(":")
+        def read_from(url: str, shard_id: int, offset: int, size: int):
+            host, _, port = url.partition(":")
+            # two tries per holder: a flaky link (mid-stream RST, a
+            # dropped proxy hop) kills individual connections, and a
+            # fresh dial usually succeeds — distinguishing "this
+            # transfer died" from "this holder is gone" is what keeps
+            # lossy links from demoting healthy survivors
+            for attempt in range(2):
                 try:
                     with rpc.dial(f"{host}:{int(port) + 10000}") as ch:
                         chunks = [
@@ -1325,8 +1354,30 @@ class VolumeServer:
                     return b"".join(chunks)
                 except grpc.RpcError:
                     continue
-            if attempted:
-                self._forget_shard_id(ev, shard_id)
+            return None
+
+        def fetch(shard_id: int, offset: int, size: int):
+            me = self._self_urls()
+            for round_ in range(2):
+                with ev.shard_locations_lock:
+                    urls = list(ev.shard_locations.get(shard_id, []))
+                attempted = False
+                for url in urls:
+                    if url in me:
+                        continue
+                    attempted = True
+                    data = read_from(url, shard_id, offset, size)
+                    if data is not None:
+                        return data
+                if attempted:
+                    self._forget_shard_id(ev, shard_id)
+                if round_ == 0:
+                    # forgetting zeroed the refresh clock: re-learn the
+                    # holders from the master NOW and give the shard one
+                    # more chance inside this same request, instead of
+                    # failing every reconstruction until a later fetch
+                    # repopulates the cache
+                    self._cached_lookup_ec_locations(ev)
             return None
 
         return fetch
@@ -2047,12 +2098,24 @@ class VolumeServer:
 
         return resolver
 
+    def _self_urls(self) -> set[str]:
+        """Every address the master may report THIS server under: the
+        bind address and (with -announce) the advertised proxy/NAT
+        address. Self-exclusion checks must match BOTH — an announced
+        primary that only filtered its bind identity would replicate
+        every write to itself through the announced hop (found by the
+        weedchaos bench: the duplicate append also coupled write
+        success to the node's own proxy being up)."""
+        me = {f"{self.host}:{self.port}"}
+        me.add(f"{self.announce_host}:{self.announce_port}")
+        return me
+
     def _redirect_target(self, vid: int) -> str | None:
         """Another server that can serve this vid: a replica holder, or
         any EC shard holder learned from the master."""
-        me = f"{self.host}:{self.port}"
+        me = self._self_urls()
         for url in self._lookup_locations(vid) or []:
-            if url != me:
+            if url not in me:
                 return url
         if not self.master:
             return None
@@ -2092,6 +2155,7 @@ class VolumeServer:
         locations = self._lookup_locations(fid.volume_id) or []
         for url in locations:
             try:
+                # weedlint: ignore[no-deadline] — single bounded 10 s replica hop; TODO fold into http_call so replica reads inherit the request budget
                 with urllib.request.urlopen(f"http://{url}/{fid_str}", timeout=10) as r:
                     return r.read()
             except OSError:
@@ -2108,10 +2172,13 @@ class VolumeServer:
             fid = FileId.parse(fid_str)
         except ValueError:
             return
-        urls = self._lookup_locations(fid.volume_id) or []
-        me = f"{self.host}:{self.port}"
-        if self.store.find_volume(fid.volume_id) is not None and me not in urls:
-            urls = [me] + urls
+        mine = self._self_urls()
+        urls = [u for u in (self._lookup_locations(fid.volume_id) or [])
+                if u not in mine]
+        if self.store.find_volume(fid.volume_id) is not None:
+            # dial ourselves by the BIND address, never the announced
+            # hop (and never twice)
+            urls = [f"{self.host}:{self.port}"] + urls
         for url in urls:
             try:
                 req = urllib.request.Request(f"http://{url}/{fid_str}", method="DELETE")
@@ -2120,6 +2187,7 @@ class VolumeServer:
                     req.add_header(
                         "Authorization", f"BEARER {self.guard.sign_write(fid_str)}"
                     )
+                # weedlint: ignore[no-deadline] — single bounded 10 s replica-delete hop; the cascade itself is the retry surface
                 urllib.request.urlopen(req, timeout=10).read()
                 return
             except OSError:
@@ -2171,6 +2239,7 @@ class VolumeServer:
             import urllib.request
 
             try:
+                # weedlint: ignore[no-deadline] — localhost worker-to-worker control hop, 10 s cap; no request budget exists on this path
                 urllib.request.urlopen(
                     urllib.request.Request(
                         f"http://{self._writer_internal_addr(owner)}"
@@ -2235,7 +2304,8 @@ class VolumeServer:
         all_locations = self._lookup_locations(fid.volume_id)
         if all_locations is None:
             return "replication lookup failed"
-        locations = [u for u in all_locations if u != f"{self.host}:{self.port}"]
+        mine = self._self_urls()
+        locations = [u for u in all_locations if u not in mine]
         return write_path.replicate_to_peers(fid, q, method, body, headers, locations)
     def start(self) -> None:
         self._grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))

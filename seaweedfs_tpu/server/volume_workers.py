@@ -792,6 +792,7 @@ class VolumeReadWorker:
         import urllib.request
 
         try:
+            # weedlint: ignore[no-deadline] — boot-time localhost hop to the lead, 10 s cap; runs before any request deadline can exist
             with urllib.request.urlopen(
                 f"http://{self.lead}/__shard/taken", timeout=10
             ) as r:

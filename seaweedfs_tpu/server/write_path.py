@@ -289,6 +289,7 @@ def replicate_to_peers(
             auth = headers.get("Authorization") or headers.get("authorization")
             if auth:  # keep the write jwt valid on the replica hop
                 req.add_header("Authorization", auth)
+            # weedlint: ignore[no-deadline] — one bounded 10 s replica hop inside the already-deadlined POST dispatch; Request carries per-needle headers http_call lacks
             with urllib.request.urlopen(req, timeout=10) as r:
                 if r.status >= 300:
                     return f"replica {url} returned {r.status}"

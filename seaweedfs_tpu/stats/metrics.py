@@ -398,6 +398,25 @@ COMMIT_FLUSHES = DEFAULT_REGISTRY.counter(
     "durability flushes (fsync) issued by the volume write path",
 )
 
+# --- robustness plane: unified retries + deadlines (docs/CHAOS.md) ----------
+# The retry-amplification factor bench/chaos reports is
+# weed_retry_total vs request volume; the budget gate shows up as
+# weed_retry_budget_exhausted_total when a fault would have stormed.
+RETRY_TOTAL = DEFAULT_REGISTRY.counter(
+    "weed_retry_total",
+    "retries granted by the unified RetryPolicy, by call-site label",
+    ("site",),
+)
+RETRY_BUDGET_EXHAUSTED = DEFAULT_REGISTRY.counter(
+    "weed_retry_budget_exhausted_total",
+    "retries refused because the process-wide retry budget ran dry",
+)
+DEADLINE_REJECTED = DEFAULT_REGISTRY.counter(
+    "weed_deadline_rejected_total",
+    "requests 504-fast-rejected at dispatch: X-Weed-Deadline already expired",
+    ("server",),
+)
+
 
 # textual push-loop health (gauges can't carry the error STRING): job
 # -> {"last_success_unix", "last_error"}; /cluster/health surfaces it

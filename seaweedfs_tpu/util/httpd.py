@@ -20,9 +20,11 @@ from urllib.parse import unquote_plus
 
 from seaweedfs_tpu import trace as _trace
 from seaweedfs_tpu.stats.metrics import (
+    DEADLINE_REJECTED,
     HTTP_REQUEST_COUNTER,
     HTTP_REQUEST_HISTOGRAM,
 )
+from seaweedfs_tpu.util import deadline as _deadline
 
 
 # pre-encoded header block for fast_reply's bytes-headers contract —
@@ -171,6 +173,7 @@ _REASON = {
     501: b"Not Implemented",
     502: b"Bad Gateway",
     503: b"Service Unavailable",
+    504: b"Gateway Timeout",
 }
 
 
@@ -191,9 +194,16 @@ class _BufReader:
     one recv fills a buffer; the request head is scanned out of it in
     one pass, bodies and chunk lines drain it before hitting the
     socket again. Tracks total consumed bytes so the connection loop
-    can realign (or bail) when a handler leaves body bytes unread."""
+    can realign (or bail) when a handler leaves body bytes unread.
 
-    __slots__ = ("_sock", "_buf", "_pos", "consumed")
+    `deadline` (client-side pooled transport only): when set, every
+    refill re-arms the socket timeout to min(op_timeout, remaining
+    budget) and an exhausted budget raises DeadlineExceeded — this is
+    what turns the per-socket-op timeout into a true whole-request
+    bound (a server trickling one byte per timeout window used to
+    reset the clock on every recv)."""
+
+    __slots__ = ("_sock", "_buf", "_pos", "consumed", "deadline", "op_timeout")
 
     def __init__(self, sock, initial: bytes = b""):
         # `initial`: bytes already read off the socket by whoever owned
@@ -203,8 +213,15 @@ class _BufReader:
         self._buf = initial
         self._pos = 0
         self.consumed = 0
+        self.deadline = None
+        self.op_timeout = None
 
     def _fill(self) -> bool:
+        dl = self.deadline
+        if dl is not None:
+            # raises DeadlineExceeded once the whole-request budget is
+            # spent; otherwise shrinks this recv's window to what's left
+            self._sock.settimeout(dl.cap(self.op_timeout))
         chunk = self._sock.recv(65536)
         if not chunk:
             return False
@@ -325,6 +342,41 @@ class _SockWriter:
         pass
 
 
+def _deadline_scoped(method, dl):
+    """Dispatch wrapper installing `dl` as the ambient deadline for
+    exactly this request's handler, so internal hops (http_call, gRPC
+    stubs, hedged reads) inherit the remaining budget for free."""
+
+    def run(h, _m=method, _dl=dl):
+        _deadline.set_current(_dl)
+        try:
+            return _m(h)
+        finally:
+            _deadline.set_current(None)
+
+    return run
+
+
+def _expired_reject(h) -> None:
+    """Stand-in handler for a request whose X-Weed-Deadline arrived
+    already expired: 504 without touching disk or fanning out. Dispatch
+    runs it like any handler, so the span (annotated, no work stages)
+    and the 504-labelled request counter are the rejection's audit
+    trail."""
+    sp = getattr(h, "_trace_span", None)
+    if sp is not None:
+        sp.annotate("deadline", "expired-at-entry")
+    DEADLINE_REJECTED.labels(
+        getattr(h.server, "trace_name", "") or "server"
+    ).inc()
+    # an expired request's body may never arrive in full (the client
+    # has given up); never trust this connection for another request
+    h.close_connection = True
+    h.fast_reply(
+        504, b'{"error": "x-weed-deadline expired before dispatch"}', JSON_HDR
+    )
+
+
 _DISPATCH_CACHE: dict[type, dict] = {}
 
 
@@ -397,6 +449,17 @@ def serve_connection(
     # one is-None check per request.
     admission = getattr(server, "admission", None)
     load_tracker = getattr(server, "load_tracker", None)
+    # deadline plane (docs/CHAOS.md): this same funnel parses the
+    # X-Weed-Deadline hop header on every daemon, fast-rejects expired
+    # requests with 504 BEFORE dispatch, and installs the budget as
+    # the ambient deadline so every internal hop the handler makes
+    # inherits it. deadline_default_s set on the server wins; None
+    # falls back to the WEED_DEADLINE_DEFAULT_S gateway-entry default.
+    ddl_enabled = _deadline.enabled()
+    ddl_default = getattr(server, "deadline_default_s", None)
+    if ddl_default is None:
+        ddl_default = _deadline.default_budget_s()
+    ddl_hdr_key = _deadline.DEADLINE_HEADER
     if admission is not None or load_tracker is not None:
         def qos_dispatch(method, h, _adm=admission, _lt=load_tracker):
             if _lt is not None:
@@ -488,6 +551,28 @@ def serve_connection(
             chunked = "chunked" in headers.get("transfer-encoding", "").lower()
             body_end = reader.consumed + length
 
+            # deadline plane: an already-expired budget is rejected
+            # HERE — before the 100-continue invite, before admission
+            # spends a token, before the handler touches disk. The
+            # reject rides the normal dispatch seam so the span and
+            # status-labelled request counter record the 504 — but it
+            # BYPASSES the admission gate below (an expired request
+            # must never drain a client's token bucket, and a dry
+            # bucket's 503 + Retry-After would invite the client to
+            # retry work it already abandoned).
+            h._deadline = None
+            if ddl_enabled:
+                dhv = headers.get(ddl_hdr_key)
+                dl = _deadline.from_header(dhv) if dhv is not None else None
+                if dl is None and ddl_default > 0:
+                    dl = _deadline.Deadline.after(ddl_default)
+                if dl is not None:
+                    h._deadline = dl
+                    if dl.expired:
+                        method = _expired_reject
+                    else:
+                        method = _deadline_scoped(method, dl)
+
             # 100 Continue goes out only AFTER the request validates:
             # a bad Content-Length (400 above), an unknown method
             # (405), or an oversized head (431, in read_head) must
@@ -533,7 +618,7 @@ def serve_connection(
                 sp = span_open(name, hdr, length, t0)
                 h._trace_span = sp if sp else None
                 try:
-                    if qos_dispatch is None:
+                    if qos_dispatch is None or method is _expired_reject:
                         method(h)
                     else:
                         qos_dispatch(method, h)
@@ -554,7 +639,7 @@ def serve_connection(
             else:
                 h._trace_span = None
                 t0 = clock()
-                if qos_dispatch is None:
+                if qos_dispatch is None or method is _expired_reject:
                     method(h)
                 else:
                     qos_dispatch(method, h)
@@ -660,6 +745,11 @@ class WeedHTTPServer(ThreadingHTTPServer):
     # heartbeat load signal); None = today's behavior
     admission = None
     load_tracker = None
+
+    # deadline plane (docs/CHAOS.md): budget (seconds) minted at entry
+    # for requests arriving WITHOUT an X-Weed-Deadline header; None
+    # defers to the WEED_DEADLINE_DEFAULT_S env knob, 0 mints nothing
+    deadline_default_s = None
 
     def get_request(self):
         # TCP_NODELAY: keep-alive responses are written headers-then-

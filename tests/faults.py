@@ -11,14 +11,12 @@ the damage, because several suites share live cluster fixtures.
 from __future__ import annotations
 
 import os
-import socket
-import threading
-import time
 
+from seaweedfs_tpu.analysis.chaos import ChaosProxy
 from seaweedfs_tpu.storage import types as t
 
 
-class SlowReplicaProxy:
+class SlowReplicaProxy(ChaosProxy):
     """TCP proxy that delays one replica's RESPONSES by `delay_s`.
 
     Point a client's replica url at `proxy.addr` instead of the real
@@ -27,85 +25,27 @@ class SlowReplicaProxy:
     hedged-read A/B (bench.py qos, BENCH_r09) and the hedge tests
     drive. Requests pass through untouched, so the server does all its
     normal work; only the client-observed latency inflates. `delay_s`
-    is mutable mid-run (`proxy.delay_s = 0` = transparent)."""
+    is mutable mid-run (`proxy.delay_s = 0` = transparent).
+
+    Now a thin preset over the weedchaos fault library's ChaosProxy
+    (analysis/chaos.py, docs/CHAOS.md), which generalizes this proxy
+    to jitter/bandwidth/drop/blackhole/RST faults."""
 
     def __init__(self, target: str, delay_s: float = 0.25):
-        host, _, port = target.partition(":")
-        self.target = (host, int(port))
-        self.delay_s = delay_s
-        self._listener = socket.socket()
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind(("127.0.0.1", 0))
-        self._listener.listen(64)
-        self._stop = threading.Event()
-        self._conns: list[socket.socket] = []
-        self._lock = threading.Lock()
-        self.responses_delayed = 0
-        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
-        self._thread.start()
+        super().__init__(target)
+        self.response.latency_s = delay_s
 
     @property
-    def addr(self) -> str:
-        return "127.0.0.1:%d" % self._listener.getsockname()[1]
+    def delay_s(self) -> float:
+        return self.response.latency_s
 
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                client, _ = self._listener.accept()
-            except OSError:
-                return
-            try:
-                upstream = socket.create_connection(self.target, timeout=10)
-            except OSError:
-                client.close()
-                continue
-            for s in (client, upstream):
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, True)
-            with self._lock:
-                self._conns += [client, upstream]
-            threading.Thread(
-                target=self._pump, args=(client, upstream, 0.0), daemon=True
-            ).start()
-            threading.Thread(
-                target=self._pump, args=(upstream, client, None), daemon=True
-            ).start()
+    @delay_s.setter
+    def delay_s(self, value: float) -> None:
+        self.response.latency_s = value
 
-    def _pump(self, src, dst, fixed_delay) -> None:
-        # fixed_delay None = the response direction: read self.delay_s
-        # per chunk so tests can retune a live proxy
-        try:
-            while True:
-                data = src.recv(1 << 16)
-                if not data:
-                    break
-                d = self.delay_s if fixed_delay is None else fixed_delay
-                if d > 0:
-                    if fixed_delay is None:
-                        self.responses_delayed += 1
-                    time.sleep(d)
-                dst.sendall(data)
-        except OSError:
-            pass
-        finally:
-            for s in (src, dst):
-                try:
-                    s.shutdown(socket.SHUT_RDWR)
-                except OSError:
-                    pass
-
-    def stop(self) -> None:
-        self._stop.set()
-        try:
-            self._listener.close()
-        except OSError:
-            pass
-        with self._lock:
-            conns, self._conns = self._conns, []
-        for s in conns:
-            try:
-                s.close()
-            except OSError:
-                pass
+    @property
+    def responses_delayed(self) -> int:
+        return self.chunks_delayed
 
 
 class DeadShard:

@@ -563,6 +563,76 @@ class TestContracts:
         assert "weed_http_request_total" in text  # the real family
 
 
+class TestNoDeadline:
+    """The deadline-bypass rule (docs/CHAOS.md): raw urlopen() on a
+    data-plane module can never inherit the request's X-Weed-Deadline
+    budget — each site either migrates to http_call or states why the
+    bounded one-hop timeout suffices."""
+
+    def _scoped_pkg(self, tmp_path, rel: str, src: str) -> str:
+        import textwrap
+
+        root = tmp_path / "seaweedfs_tpu"
+        target = root / rel
+        target.parent.mkdir(parents=True, exist_ok=True)
+        (root / "__init__.py").write_text("")
+        init = target.parent / "__init__.py"
+        if not init.exists():
+            init.write_text("")
+        target.write_text(textwrap.dedent(src))
+        return str(root)
+
+    def test_planted_urlopen_on_data_plane_flagged(self, tmp_path):
+        from seaweedfs_tpu.analysis import contracts
+
+        root = self._scoped_pkg(tmp_path, "server/mod.py", """
+            import urllib.request
+
+            def hop(url):
+                return urllib.request.urlopen(url, timeout=10).read()
+        """)
+        findings, _, reg = contracts.check(root=root)
+        hits = [f for f in findings if f.rule == "no-deadline"]
+        assert len(hits) == 1 and hits[0].path.endswith("server/mod.py")
+        assert len(reg.deadline_bypass) == 1
+
+    def test_out_of_scope_module_not_flagged(self, tmp_path):
+        from seaweedfs_tpu.analysis import contracts
+
+        root = self._scoped_pkg(tmp_path, "telemetry/mod.py", """
+            import urllib.request
+
+            def scrape(url):
+                return urllib.request.urlopen(url, timeout=5).read()
+        """)
+        findings, _, _reg = contracts.check(root=root)
+        assert not [f for f in findings if f.rule == "no-deadline"]
+
+    def test_suppression_with_reason_silences(self, tmp_path):
+        from seaweedfs_tpu.analysis import apply_suppressions, contracts
+
+        root = self._scoped_pkg(tmp_path, "server/mod.py", """
+            import urllib.request
+
+            def hop(url):
+                # weedlint: ignore[no-deadline] — one bounded local hop
+                return urllib.request.urlopen(url, timeout=10).read()
+        """)
+        findings, idx, _reg = contracts.check(root=root)
+        kept, suppressed = apply_suppressions(findings, idx.sources)
+        assert not [f for f in kept if f.rule == "no-deadline"]
+        assert [f for f in suppressed if f.rule == "no-deadline"]
+
+    def test_real_tree_deadline_header_contract_whole(self):
+        """Satellite: x-weed-deadline joins the stamped-vs-parsed hop
+        header registry — both sides must exist in the real tree."""
+        from seaweedfs_tpu.analysis import contracts
+
+        _findings, _idx, reg = contracts.check()
+        assert "x-weed-deadline" in reg.header_stamped
+        assert "x-weed-deadline" in reg.header_parsed
+
+
 # ---------------------------------------------------------------------------
 # lifecycle tier (weedlint v2)
 
