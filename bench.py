@@ -2236,6 +2236,203 @@ def bench_degraded() -> None:
             )
 
 
+def bench_chaos_soak(minutes: float) -> None:
+    """`bench.py chaos --soak <minutes>`: long-running background chaos
+    (docs/CHAOS.md). One live cluster (master + healthy replica +
+    proxied replica, replication=010) runs a continuous writer fan
+    while the soak driver cycles fault regimes through the ChaosProxy
+    pair — blackhole partition, 250 ms latency, 1 MB/s bandwidth cap,
+    30% connection drop — healing between cycles and checking the
+    invariants EVERY cycle: a sampled read-back of everything acked so
+    far (no acked-write loss), retry amplification ≤ 1.15×, and a
+    bounded time-to-recover probe after each heal. One JSON line per
+    cycle; a cycle that breaks an invariant fails the run immediately
+    (a soak that only reports at the end hides which fault did it)."""
+    import tempfile
+    import threading as _threading
+
+    from seaweedfs_tpu.analysis.chaos import ProxyPair
+    from seaweedfs_tpu.client import operation as op
+    from seaweedfs_tpu.client import retry as retry_mod
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.util import deadline as dl_mod
+    from seaweedfs_tpu.util.availability import free_port
+
+    deadline_wall = time.time() + minutes * 60.0
+    with tempfile.TemporaryDirectory() as d:
+        master = MasterServer(
+            port=free_port(), volume_size_limit_mb=64, vacuum_interval=0
+        )
+        master.start()
+        maddr = f"127.0.0.1:{master.port}"
+        vs_a = VolumeServer(
+            [tempfile.mkdtemp(dir=d)], port=free_port(), master=maddr,
+            heartbeat_interval=0.2, max_volume_counts=[200], rack="r0",
+        )
+        vs_a.start()
+        b_port = free_port()
+        pair = ProxyPair(f"127.0.0.1:{b_port}")
+        vs_b = VolumeServer(
+            [tempfile.mkdtemp(dir=d)], port=b_port, master=maddr,
+            heartbeat_interval=0.2, max_volume_counts=[200], rack="r1",
+            announce=pair.addr,
+        )
+        vs_b.start()
+        stop = _threading.Event()
+        acked: dict[str, bytes] = {}
+        counters = {"ok": 0, "failed": 0}
+        lock = _threading.Lock()
+        policy = retry_mod.RetryPolicy(
+            attempts=3, backoff_ms=50, backoff_max_ms=400,
+            retry_on=(RuntimeError, OSError), label="bench-chaos-soak",
+            cost=2.0,
+        )
+
+        def writer(w: int) -> None:
+            i = 0
+            while not stop.is_set():
+                payload = (f"soak w{w} i{i} ".encode() * 30)[:512]
+                i += 1
+                try:
+                    def one(_attempt):
+                        with dl_mod.scope(dl_mod.Deadline.after(2.0)):
+                            ar, _ = op.with_master_failover(
+                                [maddr],
+                                lambda m: op.assign(m, replication="010"),
+                            )
+                            ur = op.upload(
+                                f"{ar.url}/{ar.fid}", payload, jwt=ar.auth
+                            )
+                        if ur.error:
+                            raise RuntimeError(ur.error)
+                        return ar.fid
+                    fid = policy.run(one)
+                except Exception:  # noqa: BLE001 — counted, audited
+                    with lock:
+                        counters["failed"] += 1
+                    continue
+                with lock:
+                    acked[fid] = payload
+                    counters["ok"] += 1
+                time.sleep(0.02)
+
+        try:
+            t0 = time.time()
+            while time.time() - t0 < 30 and len(master.topology.data_nodes()) < 2:
+                time.sleep(0.05)
+            writers = [
+                _threading.Thread(target=writer, args=(w,), daemon=True)
+                for w in range(3)
+            ]
+            for t in writers:
+                t.start()
+
+            def fault_partition():
+                pair.partition()
+
+            def fault_latency():
+                pair.http.response.latency_s = 0.25
+                pair.grpc.response.latency_s = 0.25
+
+            def fault_bandwidth():
+                pair.http.response.bandwidth_bps = 1 << 20
+                pair.grpc.response.bandwidth_bps = 1 << 20
+
+            def fault_drop():
+                pair.http.request.drop_conn_p = 0.30
+                pair.grpc.request.drop_conn_p = 0.30
+
+            regimes = [
+                ("partition", fault_partition),
+                ("latency_250ms", fault_latency),
+                ("bandwidth_1mbs", fault_bandwidth),
+                ("drop_conn_30pct", fault_drop),
+            ]
+            cycle = 0
+            while time.time() < deadline_wall:
+                name, arm = regimes[cycle % len(regimes)]
+                spent0 = retry_mod.DEFAULT_BUDGET.spent
+                with lock:
+                    ok0 = counters["ok"]
+                arm()
+                time.sleep(min(10.0, max(2.0, deadline_wall - time.time())))
+                pair.heal()
+                # time-to-recover: first clean replicated write after heal
+                t_heal = time.perf_counter()
+                recovered = None
+                while time.perf_counter() - t_heal < 30:
+                    try:
+                        with dl_mod.scope(dl_mod.Deadline.after(2.0)):
+                            ar, _ = op.with_master_failover(
+                                [maddr],
+                                lambda m: op.assign(m, replication="010"),
+                            )
+                            ur = op.upload(
+                                f"{ar.url}/{ar.fid}", b"soak probe",
+                                jwt=ar.auth,
+                            )
+                        if not ur.error:
+                            recovered = time.perf_counter() - t_heal
+                            break
+                    except Exception:  # noqa: BLE001 — not yet healed
+                        pass
+                    time.sleep(0.25)
+                # invariant: sampled read-back of the acked set
+                with lock:
+                    sample = list(acked.items())
+                sample = sample[:: max(1, len(sample) // 50)][:50]
+                lost = []
+                for fid, want in sample:
+                    try:
+                        url = op.lookup_file_id(maddr, fid)
+                        got, _ = op.download(url, timeout=10)
+                        if got != want:
+                            lost.append(fid)
+                    except Exception:  # noqa: BLE001 — classified lost
+                        lost.append(fid)
+                with lock:
+                    ok1, failed = counters["ok"], counters["failed"]
+                retried = retry_mod.DEFAULT_BUDGET.spent - spent0
+                done = max(1, ok1 - ok0)
+                amp = (done + retried) / done
+                cycle += 1
+                row = {
+                    "metric": "chaos_soak_cycle",
+                    "cycle": cycle,
+                    "regime": name,
+                    "acked_total": ok1,
+                    "failed_total": failed,
+                    "sampled": len(sample),
+                    "lost": len(lost),
+                    "amplification": round(amp, 3),
+                    "time_to_recover_s": (
+                        round(recovered, 2) if recovered is not None else None
+                    ),
+                    "pass": bool(
+                        not lost and amp <= 1.15 and recovered is not None
+                    ),
+                }
+                print(json.dumps(row), flush=True)
+                if not row["pass"]:
+                    raise SystemExit(
+                        f"chaos soak cycle {cycle} ({name}) failed: {row}"
+                    )
+            print(json.dumps({
+                "metric": "chaos_soak",
+                "minutes": minutes,
+                "cycles": cycle,
+                "acked_total": counters["ok"],
+                "pass": True,
+            }), flush=True)
+        finally:
+            stop.set()
+            pair.stop()
+            vs_b.stop()
+            vs_a.stop()
+            master.stop()
+
+
 def bench_chaos() -> None:
     """weedchaos robustness config (docs/CHAOS.md, BENCH_r11).
 
@@ -2255,7 +2452,18 @@ def bench_chaos() -> None:
       heal — time-to-recover: seconds from heal() until a replicated
         write round-trips cleanly again, plus the after-heal p99.
 
-    Emits one JSON line per path and writes BENCH_r11.json."""
+    Emits one JSON line per path and writes BENCH_r11.json.
+
+    `bench.py chaos --soak <minutes>` runs the long-background soak
+    mode instead (bench_chaos_soak): cycling fault regimes with
+    per-cycle invariant checks for hours, not minutes."""
+    if "--soak" in sys.argv[1:]:
+        idx = sys.argv.index("--soak")
+        try:
+            minutes = float(sys.argv[idx + 1])
+        except (IndexError, ValueError):
+            raise SystemExit("usage: bench.py chaos --soak <minutes>")
+        return bench_chaos_soak(minutes)
     import tempfile
     import threading as _threading
 

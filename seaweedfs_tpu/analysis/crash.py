@@ -904,6 +904,121 @@ def run_quarantine(budget: int | None = None,
                      budget=budget, seed=seed)
 
 
+def run_handoff_hint(budget: int | None = None, seed: int | None = None,
+                     durable: bool = True) -> CrashReport:
+    """Hinted-handoff spool (weedguard, docs/HEALTH.md): the primary
+    durably publishes a replica request as a hint BEFORE acking the
+    client (server/handoff.HintStore.write_hint → util/durable), and a
+    replay after crash must deliver the exact bytes. Invariants per
+    crash state: once the hint write is acked, EXACTLY one complete
+    hint exists and parses back byte-identical (acked-with-hint is a
+    durability promise — losing or tearing it loses an acked write);
+    before the ack, any *.hint under the final name must still be
+    complete (rename only ever publishes fsynced bytes). A delivered
+    hint (post-unlink + dirsync mark) must stay gone — a resurrected
+    hint is the double-apply shape.
+
+    `durable=False` replays the BUG ordering (plain write + rename, no
+    fsyncs) as the positive control: the enumerator must surface
+    rename-before-data states where the published hint is torn."""
+    import struct as _struct
+
+    body = bytes(range(256)) * 40 + b"\x00tail"
+    target = "127.0.0.1:18080"
+    path = "/3,0203fbfb?type=replicate"
+    headers = {"Content-Type": "application/octet-stream"}
+
+    with tempfile.TemporaryDirectory() as d:
+        rec = Recorder(d)
+        with rec:
+            if durable:
+                from seaweedfs_tpu.server.handoff import HintStore
+
+                hs = HintStore(os.path.join(d, "spool"))
+                assert hs.write_hint(target, "POST", path, body, headers)
+            else:
+                # the planted bug: same wire format, no fsync before
+                # the rename, no dirsync after
+                import json as _json
+
+                tdir = os.path.join(d, "spool", "127.0.0.1_18080")
+                os.makedirs(tdir, exist_ok=True)
+                head = _json.dumps(
+                    {"target": target, "method": "POST", "path": path,
+                     "headers": headers}
+                ).encode()
+                tmp = os.path.join(tdir, "0000000000001-000001.hint.tmp")
+                with open(tmp, "wb") as f:
+                    f.write(_struct.pack(">I", len(head)))
+                    f.write(head)
+                    f.write(body)
+                os.replace(tmp, tmp[: -len(".tmp")])
+            rec.mark({"hint": True})
+
+        def recover(state_dir, _st, acked_payloads):
+            from seaweedfs_tpu.server.handoff import HintStore
+
+            hs = HintStore(os.path.join(state_dir, "spool"))
+            hints = []
+            for _t, tdir in hs.targets():
+                for e in sorted(os.scandir(tdir), key=lambda e: e.name):
+                    if e.name.endswith(".hint"):
+                        hints.append(e.path)
+            if acked_payloads:
+                assert len(hints) == 1, (
+                    f"acked hint missing/duplicated: {len(hints)} found"
+                )
+            for hp in hints:
+                parsed = hs.read_hint(hp)
+                assert parsed is not None, f"torn hint published: {hp}"
+                head, got = parsed
+                assert got == body, (
+                    f"hint body corrupt: {len(got)}B != {len(body)}B"
+                )
+                assert head["target"] == target and head["path"] == path
+
+        return sweep(rec.trace, recover, workload="handoff-hint",
+                     budget=budget, seed=seed)
+
+
+def run_handoff_delivery(budget: int | None = None,
+                         seed: int | None = None) -> CrashReport:
+    """The other half of the hint lifecycle: after the agent delivers a
+    hint it unlinks the file and fsyncs the spool dir — a crash then
+    must never resurrect the hint (a revived hint replays a write the
+    replica already applied: the double-apply shape; harmless for
+    byte-identical needles but the contract is audited anyway)."""
+    body = b"delivered-hint" * 64
+    with tempfile.TemporaryDirectory() as d:
+        from seaweedfs_tpu.server.handoff import HintStore
+
+        hs = HintStore(os.path.join(d, "spool"))
+        assert hs.write_hint(
+            "127.0.0.1:18081", "POST", "/4,01aa?type=replicate", body, {}
+        )
+        (tgt, tdir), = hs.targets()
+        (name,) = [
+            e.name for e in os.scandir(tdir) if e.name.endswith(".hint")
+        ]
+        rec = Recorder(d)
+        with rec:
+            hs2 = HintStore(os.path.join(d, "spool"))
+            hs2.remove(os.path.join(tdir, name))
+            rec.mark({"delivered": True})
+
+        def recover(state_dir, _st, acked_payloads):
+            hp = os.path.join(
+                state_dir, "spool", "127.0.0.1_18081", name
+            )
+            if acked_payloads:
+                assert not os.path.exists(hp), (
+                    "delivered hint resurrected after crash"
+                )
+
+        return sweep(rec.trace, recover, workload="handoff-delivery",
+                     budget=budget, seed=seed)
+
+
 def run_broken_publish(budget: int | None = None,
                        seed: int | None = None) -> CrashReport:
     """Positive control (the planted bug bench --check must DETECT on
@@ -1078,6 +1193,8 @@ ALL_WORKLOADS = {
     "quarantine": run_quarantine,
     "ec-encode": run_ec_encode,
     "shard-handback": run_shard_handback,
+    "handoff-hint": run_handoff_hint,
+    "handoff-delivery": run_handoff_delivery,
 }
 
 

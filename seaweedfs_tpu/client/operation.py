@@ -678,14 +678,31 @@ def lookup(master: str, vid: str, collection: str = "") -> LookupResult:
         if e.vid == vid:
             result = LookupResult(
                 vid=vid,
+                # `suspect` (health plane, docs/HEALTH.md): the master
+                # marks replicas it currently suspects; the filer read
+                # path hedges eagerly when only suspects remain
                 locations=[
-                    {"url": l.url, "publicUrl": l.public_url} for l in e.locations
+                    {
+                        "url": l.url,
+                        "publicUrl": l.public_url,
+                        "suspect": l.suspect,
+                    }
+                    for l in e.locations
                 ],
                 error=e.error,
             )
     if not result.error:
+        # a result naming a SUSPECT replica is cached briefly: the
+        # health verdict changes on heartbeat timescales, and pinning
+        # it for the full 10 min would demote a healed node (or keep
+        # routing at a sick one) long after the master knows better
+        ttl = (
+            10.0
+            if any(loc.get("suspect") for loc in result.locations)
+            else LOOKUP_CACHE_TTL
+        )
         with _lookup_lock:
-            _lookup_cache[key] = _CacheEntry(result, LOOKUP_CACHE_TTL)
+            _lookup_cache[key] = _CacheEntry(result, ttl)
     return result
 
 

@@ -1,3 +1,5 @@
-"""HA master tier: compact raft consensus (reference raft_server.go)."""
+"""HA master tier: compact raft consensus (reference raft_server.go)
+plus the weedguard node-health plane (cluster/health.py,
+docs/HEALTH.md)."""
 
 from seaweedfs_tpu.cluster.raft import RaftNode  # noqa: F401

@@ -39,6 +39,28 @@ def _wait_forever() -> int:
     return 0
 
 
+def _wait_with_drain() -> tuple[int, bool]:
+    """Volume-daemon wait (docs/HEALTH.md drain runbook): SIGTERM asks
+    for a GRACEFUL drain — announce draining, shed new writes, finish
+    in-flight requests, deregister — while SIGINT keeps the abrupt
+    exit. Returns (rc, drain_requested)."""
+    stop = threading.Event()
+    drain = threading.Event()
+
+    def on_term(signum, frame):
+        drain.set()
+        stop.set()
+
+    def on_int(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_int)
+    signal.signal(signal.SIGTERM, on_term)
+    _tune_gc()
+    stop.wait()
+    return 0, drain.is_set()
+
+
 def _configure_tls(component: str) -> None:
     """security.toml [grpc]/[grpc.<component>] → process-wide gRPC TLS
     (security/tls.go LoadServerTLS/LoadClientTLS role)."""
@@ -359,6 +381,12 @@ class VolumeCommand(Command):
             "reached through a proxy/NAT hop; default ip:port",
         )
         p.add_argument(
+            "-heartbeat", type=float, default=2.0,
+            help="seconds between master heartbeats; the master's "
+            "phi-accrual gray-failure detector (docs/HEALTH.md) learns "
+            "this cadence, so lower = faster suspect detection",
+        )
+        p.add_argument(
             "-readRedirect", action="store_true",
             help="302-redirect reads for volumes this server lacks",
         )
@@ -479,6 +507,7 @@ class VolumeCommand(Command):
             data_center=args.dataCenter,
             rack=args.rack,
             max_volume_counts=maxes,
+            heartbeat_interval=args.heartbeat,
             read_redirect=args.readRedirect,
             guard=guard,
             ec_codec=args.ec_codec,
@@ -533,12 +562,26 @@ class VolumeCommand(Command):
                 "volume server %s:%d -> master %s (%d worker(s))",
                 args.ip, args.port, args.mserver, workers,
             )
+            drained = False
             try:
-                return _wait_forever()
+                rc, drained = _wait_with_drain()
+                return rc
             finally:
-                for pr in procs:
-                    pr.terminate()
-                server.stop()
+                if drained:
+                    # SIGTERM = graceful drain (docs/HEALTH.md): stop
+                    # taking assignments, finish in-flight, deregister.
+                    # Workers are terminated AFTER the drain window so
+                    # their in-flight reads finish while the master
+                    # learns of the drain — killing them first would
+                    # break the finish-in-flight contract for most of
+                    # the read traffic.
+                    server.drain()
+                    for pr in procs:
+                        pr.terminate()
+                else:
+                    for pr in procs:
+                        pr.terminate()
+                    server.stop()
 
 
 @register

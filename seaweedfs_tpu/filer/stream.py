@@ -25,29 +25,48 @@ from seaweedfs_tpu.client import vid_map as _vm
 from seaweedfs_tpu.filer import filechunks
 
 
-def _replica_urls(master: str, fid: str) -> list[str]:
-    """All "host:port/fid" candidates for a chunk fid, healthiest
-    first (breaker-ordered); single-replica volumes return one."""
+def _replica_urls(master: str, fid: str) -> tuple[list[str], set[str]]:
+    """("host:port/fid" candidates healthiest-first, suspect netlocs).
+
+    The master orders suspects last and flags them (health plane,
+    docs/HEALTH.md); the client breaker re-partitions on top for
+    failures only THIS process has seen. Single-replica volumes return
+    one url."""
     vid = fid.split(",")[0]
     result = op.lookup(master, vid)
     if result.error:
         raise RuntimeError(result.error)
     if not result.locations:
         raise RuntimeError(f"volume {vid} has no locations")
-    return _vm.order_by_health(
-        [f"{loc['url']}/{fid}" for loc in result.locations]
+    suspects = {
+        loc["url"] for loc in result.locations if loc.get("suspect")
+    }
+    return (
+        _vm.order_by_health(
+            [f"{loc['url']}/{fid}" for loc in result.locations]
+        ),
+        suspects,
     )
 
 
 def fetch_chunk(master: str, fid: str) -> bytes:
-    """One chunk fid → bytes, hedged across replicas when possible."""
-    urls = _replica_urls(master, fid)
+    """One chunk fid → bytes, hedged across replicas when possible.
+
+    When the best remaining candidate is a master-flagged SUSPECT (a
+    gray node: reachable, probably slow-or-dead), the hedge fires
+    EAGERLY — both replicas race from the start instead of waiting out
+    the adaptive delay against a node the cluster already distrusts."""
+    urls, suspects = _replica_urls(master, fid)
     if len(urls) < 2:
         data, _ = op.download(urls[0])
         return data
     from seaweedfs_tpu.qos import hedge
 
-    data, _ = hedge.download(urls, key=fid.split(",")[0])
+    data, _ = hedge.download(
+        urls,
+        key=fid.split(",")[0],
+        eager=urls[0].partition("/")[0] in suspects,
+    )
     return data
 
 

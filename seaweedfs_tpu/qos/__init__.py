@@ -60,12 +60,17 @@ class LoadTracker:
     around each dispatch when the server installs one of these; the
     volume server ships the current value to the master on every
     heartbeat (in_flight_requests) so pick-for-write can weigh nodes by
-    live load, not just volume counts."""
+    live load, not just volume counts.
 
-    __slots__ = ("_n", "_lock")
+    Also counts 5xx responses served (the funnel calls note_error);
+    the cumulative total rides heartbeats as `request_errors`, feeding
+    the master health plane's per-node error EWMA (docs/HEALTH.md)."""
+
+    __slots__ = ("_n", "_errors", "_lock")
 
     def __init__(self) -> None:
         self._n = 0
+        self._errors = 0
         self._lock = threading.Lock()
 
     def enter(self) -> None:
@@ -75,6 +80,14 @@ class LoadTracker:
     def exit(self) -> None:
         with self._lock:
             self._n -= 1
+
+    def note_error(self) -> None:
+        with self._lock:
+            self._errors += 1
+
+    def errors(self) -> int:
+        with self._lock:
+            return self._errors
 
     def inflight(self) -> int:
         with self._lock:

@@ -343,6 +343,7 @@ def download(
     key=None,
     timeout: float = 30.0,
     stats: dict | None = None,
+    eager: bool = False,
 ) -> tuple[bytes, dict]:
     """GET `urls[0]`, hedging to `urls[1]` after the adaptive delay.
 
@@ -350,9 +351,13 @@ def download(
     first — callers order them through the vid_map circuit breaker).
     `key` buckets the latency history (pass the volume id). `stats`, if
     given, collects {"fired","won","cancelled"} increments for callers
-    that report their own counts (weedload workers). Returns
-    (body, headers) like client.operation.download; raises HTTPError on
-    an error status and OSError when every replica fails."""
+    that report their own counts (weedload workers). `eager` fires the
+    hedge IMMEDIATELY instead of waiting the adaptive delay — the
+    health plane's lever (docs/HEALTH.md) when the primary candidate
+    is a master-flagged suspect: waiting a p95 against a gray node
+    just donates the delay to the tail. Returns (body, headers) like
+    client.operation.download; raises HTTPError on an error status and
+    OSError when every replica fails."""
     from seaweedfs_tpu.client import operation as op
 
     if len(urls) < 2 or not qos.enabled("hedge"):
@@ -397,7 +402,7 @@ def download(
             attempts.append(second)
             _ATTEMPTS.submit(second.run, h2, timeout, out_q)
 
-        delay = TRACKER.delay_s(key)
+        delay = 0.0 if eager else TRACKER.delay_s(key)
         t0 = _time.perf_counter()
         hedged = False
         deadline = t0 + timeout
@@ -486,5 +491,9 @@ def download(
         # completions at their true latency: the quantile then tracks
         # the volume's real service tail and the delay has a fixpoint.
         sample = _time.perf_counter() - t0
-        TRACKER.record(key, min(sample, delay) if hedged else sample)
+        if not eager:
+            # eager races (suspect primary) say nothing about the
+            # volume's normal service tail — recording their min(·, 0)
+            # would poison the ring with zeros
+            TRACKER.record(key, min(sample, delay) if hedged else sample)
         return body, rheaders

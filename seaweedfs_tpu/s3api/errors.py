@@ -72,6 +72,12 @@ ERRORS = {
     "InternalError": _err(
         "InternalError", 500, "We encountered an internal error, please try again."
     ),
+    "RequestTimeout": _err(
+        "RequestTimeout",
+        400,
+        "Your request's X-Weed-Deadline budget expired before it "
+        "could be completed.",
+    ),
     "AccessDenied": _err("AccessDenied", 403, "Access Denied."),
     "SignatureDoesNotMatch": _err(
         "SignatureDoesNotMatch",

@@ -33,6 +33,7 @@ from xml.sax.saxutils import escape
 import grpc
 
 from seaweedfs_tpu import trace
+from seaweedfs_tpu.util import deadline as _deadline
 from seaweedfs_tpu.pb import filer_pb2 as fpb
 from seaweedfs_tpu.util.httpd import FastHandler, WeedHTTPServer
 from seaweedfs_tpu.pb import rpc
@@ -170,6 +171,26 @@ class S3ApiServer:
         path = "/".join(urllib.parse.quote(s) for s in segments if s)
         return f"http://{self.filer}/{path}"
 
+    def _filer_hop_timeout(self, req) -> float:
+        """Deadline plane (docs/CHAOS.md): the gateway→filer hop runs
+        under the request's ambient budget — the X-Weed-Deadline header
+        rides along (the filer 504-fast-rejects expired work) and the
+        socket timeout shrinks to the remaining budget, so a
+        partitioned filer costs a bounded failure, not a 60 s park.
+        Deadline-less requests keep the fixed 60 s cap."""
+        dl = _deadline.effective(None)
+        if dl is None:
+            return 60.0
+        req.add_header(_deadline.DEADLINE_HEADER, dl.header_value())
+        try:
+            return dl.cap(60.0)
+        except _deadline.DeadlineExceeded:
+            # budget spent mid-request (body read + SigV4 check ate
+            # it): answer a proper S3 error — letting the TimeoutError
+            # propagate would be swallowed at the connection loop and
+            # close the socket with no response at all
+            raise s3_error("RequestTimeout") from None
+
     def _put_to_filer(self, path_segments: list[str], body: bytes, mime: str) -> None:
         """Store object bytes through the filer HTTP write path (which
         auto-chunks) — the putToFiler proxy in the reference."""
@@ -179,8 +200,10 @@ class S3ApiServer:
         if mime:
             req.add_header("Content-Type", mime)
         trace.inject_request(req)  # gateway→filer hop, same trace
-        # weedlint: ignore[no-deadline] — one bounded 60 s hop to the local filer; streaming Request bodies don't fit the pooled transport yet
-        with urllib.request.urlopen(req, timeout=60) as r:
+        # weedlint: ignore[no-deadline] — deadline-aware via _filer_hop_timeout; streaming Request bodies don't fit the pooled transport yet
+        with urllib.request.urlopen(
+            req, timeout=self._filer_hop_timeout(req)
+        ) as r:
             if r.status >= 300:
                 raise s3_error("InternalError")
 
@@ -188,8 +211,10 @@ class S3ApiServer:
         try:
             req = urllib.request.Request(self._filer_url(*path_segments))
             trace.inject_request(req)
-            # weedlint: ignore[no-deadline] — one bounded 60 s hop to the local filer; migrating GETs to http_call rides with the PUT path above
-            with urllib.request.urlopen(req, timeout=60) as r:
+            # weedlint: ignore[no-deadline] — deadline-aware via _filer_hop_timeout; migrating GETs to http_call rides with the PUT path above
+            with urllib.request.urlopen(
+                req, timeout=self._filer_hop_timeout(req)
+            ) as r:
                 return r.read(), r.headers.get("Content-Type", "")
         except urllib.error.HTTPError as e:
             if e.code == 404:

@@ -43,7 +43,7 @@ from seaweedfs_tpu.util import wlog
 
 @dataclass
 class RepairTask:
-    kind: str  # ec_rebuild | replicate | replace
+    kind: str  # ec_rebuild | replicate | replace | drain_move | drain_ec
     volume_id: int
     collection: str = ""
     detail: str = ""
@@ -216,6 +216,34 @@ class RepairScheduler:
                         f"{dn.url}; clean copy on {clean[0].url}"
                     ),
                 )
+        # weedguard drain (docs/HEALTH.md): nodes marked draining (the
+        # node.drain shell command, or a SIGTERM self-drain) get their
+        # data moved off before decommission — one task per volume /
+        # per EC vid held, executed under the same concurrency cap and
+        # backoff as damage repair
+        health = getattr(self.master, "health", None)
+        draining = health.draining_urls() if health is not None else set()
+        for dn in topo.data_nodes():
+            if dn.url not in draining:
+                continue
+            for vid, v in list(dn.volumes.items()):
+                if vid in topo.ec_shard_map:
+                    continue  # the EC registry owns this vid
+                found[("drain_move", vid)] = RepairTask(
+                    kind="drain_move",
+                    volume_id=vid,
+                    collection=v.collection,
+                    bad_node=dn.url,
+                    detail=f"drain {dn.url}",
+                )
+            for vid, s in list(dn.ec_shards.items()):
+                found[("drain_ec", vid)] = RepairTask(
+                    kind="drain_ec",
+                    volume_id=vid,
+                    collection=s.collection,
+                    bad_node=dn.url,
+                    detail=f"drain {dn.url}",
+                )
         return found
 
     # ------------------------------------------------------------------
@@ -236,7 +264,11 @@ class RepairScheduler:
                 task = self.tasks.get(key)
                 if task is None:
                     fresh.first_detected = now
-                    fresh.next_try = now + self.grace
+                    # drain tasks carry explicit operator intent: no
+                    # detection grace (the grace guards against
+                    # transient damage states, which a drain is not)
+                    grace = 0.0 if fresh.kind.startswith("drain") else self.grace
+                    fresh.next_try = now + grace
                     self.tasks[key] = task = fresh
                 else:
                     task.detail = fresh.detail
@@ -298,6 +330,10 @@ class RepairScheduler:
                     self._repair_replicate(task)
                 elif task.kind == "replace":
                     self._repair_replace(task)
+                elif task.kind == "drain_move":
+                    self._repair_drain_move(task)
+                elif task.kind == "drain_ec":
+                    self._repair_drain_ec(task)
                 else:
                     raise ValueError(f"unknown repair kind {task.kind}")
         except Exception as e:  # noqa: BLE001 - becomes backoff state
@@ -497,6 +533,132 @@ class RepairScheduler:
             ).close()
         except OSError:
             pass  # scrub disabled there: the row ages out on its own
+
+    # ------------------------------------------------------------------
+    # drain moves (weedguard, docs/HEALTH.md): empty a draining node
+    def _drain_targets(self, src_url: str, vid: int | None = None) -> list:
+        """Eligible destinations for data leaving a draining node:
+        registered, not draining, assignable per the health plane, with
+        free slots, and (for plain volumes) not already a holder of the
+        vid. Fullest-free first so drains spread wide."""
+        health = getattr(self.master, "health", None)
+        draining = health.draining_urls() if health is not None else set()
+        out = []
+        for dn in self.master.topology.data_nodes():
+            if dn.url == src_url or dn.url in draining:
+                continue
+            if health is not None and not health.assignable(dn.url):
+                continue
+            if vid is not None and vid in dn.volumes:
+                continue
+            if dn.free_space() <= 0:
+                continue
+            out.append(dn)
+        out.sort(key=lambda d: -d.free_space())
+        return out
+
+    def _repair_drain_move(self, task: RepairTask) -> None:
+        """Move one plain volume off the draining node: readonly guard
+        → copy → delete (the shell's volume.move driver, so operator
+        and automatic moves share one code path)."""
+        from seaweedfs_tpu.shell.commands import _move_volume
+
+        topo = self.master.topology
+        src = next(
+            (d for d in topo.data_nodes() if d.url == task.bad_node), None
+        )
+        if src is None or task.volume_id not in src.volumes:
+            return  # already gone — that's success
+        targets = self._drain_targets(task.bad_node, vid=task.volume_id)
+        if not targets:
+            # surplus replica: when enough OTHER holders already
+            # satisfy the placement, dropping the draining copy IS the
+            # complete move (no fresh node required). Below placement,
+            # the drain is genuinely blocked on capacity — error into
+            # backoff so the repair queue (and node.drain's timeout
+            # report) names it.
+            from seaweedfs_tpu.storage.replica_placement import (
+                ReplicaPlacement,
+            )
+
+            v = src.volumes[task.volume_id]
+            others = [
+                d
+                for d in topo.data_nodes()
+                if d is not src and task.volume_id in d.volumes
+            ]
+            want = ReplicaPlacement.from_byte(
+                v.replica_placement
+            ).copy_count
+            if len(others) < want:
+                raise RuntimeError(
+                    f"drain {task.bad_node}: no eligible target for "
+                    f"vid {task.volume_id} and only {len(others)}/{want} "
+                    f"other replica(s) — add capacity to proceed"
+                )
+            with rpc.dial(
+                f"{src.ip}:{src.port + 10000}"
+            ) as ch:
+                rpc.volume_stub(ch).VolumeDelete(
+                    volume_pb2.VolumeDeleteRequest(
+                        volume_id=task.volume_id
+                    ),
+                    timeout=60,
+                )
+        else:
+            _move_volume(
+                self._env(), task.volume_id, task.collection,
+                task.bad_node, targets[0].url,
+            )
+        # unregister immediately — node AND layout (the target's forced
+        # delta beat re-registers the moved copy). Popping only
+        # dn.volumes would erase the evidence the source's next FULL
+        # beat needs to report the delete, leaving a stale layout entry
+        # routing reads at the drained node forever (full-suite race).
+        v = src.volumes.pop(task.volume_id, None)
+        if v is not None:
+            self.master.topology._layout_for(v).unregister_volume(
+                v.id, src
+            )
+
+    def _repair_drain_ec(self, task: RepairTask) -> None:
+        """Move every EC shard of one vid off the draining node:
+        copy+mount on a target, then unmount+delete on the source (the
+        shell ec_common verbs, shard by shard so a failure mid-vid
+        leaves each shard wholly on exactly one node)."""
+        from seaweedfs_tpu.shell import ec_common
+
+        env = self._env()
+        topo = self.master.topology
+        src = next(
+            (d for d in topo.data_nodes() if d.url == task.bad_node), None
+        )
+        if src is None:
+            return
+        info = src.ec_shards.get(task.volume_id)
+        if info is None:
+            return  # already gone
+        sids = ec_common.shard_bits_to_ids(info.ec_index_bits)
+        targets = self._drain_targets(task.bad_node)
+        if not targets:
+            raise RuntimeError(
+                f"drain {task.bad_node}: no eligible target for ec "
+                f"vid {task.volume_id}"
+            )
+        from types import SimpleNamespace
+
+        for i, sid in enumerate(sids):
+            # ec_common helpers address targets by .url only
+            dst = SimpleNamespace(url=targets[i % len(targets)].url)
+            ec_common.copy_and_mount_shards(
+                env, dst, task.volume_id, task.collection, [sid],
+                task.bad_node,
+            )
+            ec_common.unmount_and_delete_shards(
+                env, task.bad_node, task.volume_id, task.collection, [sid]
+            )
+        src.ec_shards.pop(task.volume_id, None)
+        topo.unregister_ec_shards(task.volume_id, src)
 
     # ------------------------------------------------------------------
     def queue_snapshot(self) -> dict:

@@ -1,0 +1,355 @@
+"""Durable hinted handoff for replica writes (docs/HEALTH.md).
+
+Before weedguard, a replicated write was all-or-error: one down/suspect
+replica failed the whole POST even though the primary had durably
+applied it. That couples write availability to the worst replica —
+exactly what the health plane exists to decouple.
+
+Now, when a replica hop fails (and the health plane is on), the primary
+persists the complete replica request as a **hint** — method, target
+path+query (already carrying `type=replicate` so the peer stores
+without re-fanning), the replicated header subset, and the raw body —
+in a per-target spool under its data directory, acks the client, and a
+background handoff agent replays the spool in order once the replica
+answers again.
+
+Durability contract (audited by the weedcrash enumerator sweep,
+tests/test_health.py): the hint is published with `util/durable`
+(write tmp → fsync → rename → dirsync) BEFORE the client is acked, so
+"acked with a hint" survives a primary crash; replay after the crash
+delivers the same bytes, and replaying twice is idempotent on the
+replica (the needle write path dedups identical records — see
+Volume._is_file_unchanged). Hints are deleted only after a 2xx from
+the replica, with the spool directory fsynced so the deletion sticks.
+
+`WEED_HANDOFF=0` disables hinting alone (replica failures fail the
+write, pre-health behavior); `WEED_HEALTH=0` implies it.
+`WEED_HANDOFF_MAX_MB` caps each target's spool — a full spool refuses
+the hint and the write fails loudly, never silently dropping data the
+client was about to be promised.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+
+from seaweedfs_tpu.util import durable, wlog
+
+_HDR = struct.Struct(">I")  # header-JSON length prefix
+
+# replica-relevant request headers, the same set replicate_to_peers
+# forwards (per-needle semantics must survive the detour byte-for-byte);
+# seaweed-* prefixed pairs ride too — see keep_headers()
+KEEP_HEADERS = ("content-type", "content-encoding", "authorization")
+
+
+def keep_headers(headers) -> dict[str, str]:
+    """The header subset a hint must preserve — the ONE home for the
+    rule (the volume server's fan-out seam routes here)."""
+    out: dict[str, str] = {}
+    for hk, hv in headers.items():
+        lk = hk.lower()
+        if lk in KEEP_HEADERS or lk.startswith("seaweed-"):
+            out[hk] = hv
+    return out
+
+
+def handoff_enabled() -> bool:
+    """Hinting on? Requires the health plane; WEED_HANDOFF=0 turns the
+    handoff leg off by itself for A/B runs."""
+    from seaweedfs_tpu.cluster import health as _health
+
+    if not _health.enabled():
+        return False
+    return os.environ.get("WEED_HANDOFF", "1") != "0"
+
+
+def spool_cap_bytes() -> int:
+    """Per-target spool bound (WEED_HANDOFF_MAX_MB, default 256)."""
+    try:
+        mb = int(os.environ.get("WEED_HANDOFF_MAX_MB", "256"))
+    except ValueError:
+        mb = 256
+    return mb << 20
+
+
+def _target_dir(root: str, target: str) -> str:
+    # "host:port" → filesystem-safe component
+    return os.path.join(root, target.replace(":", "_").replace("/", "_"))
+
+
+def _target_of_dir(name: str) -> str:
+    host, _, port = name.rpartition("_")
+    return f"{host}:{port}" if port.isdigit() else name
+
+
+class HintStore:
+    """The on-disk spool: one directory per unreachable target, one
+    file per hinted request, ordered by filename (timestamp + seq) so
+    replay preserves the primary's apply order per target."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def _next_name(self) -> str:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        return "%013d-%06d.hint" % (int(time.time() * 1000), seq)
+
+    def _dir_size(self, tdir: str) -> int:
+        try:
+            return sum(
+                e.stat().st_size
+                for e in os.scandir(tdir)
+                if e.name.endswith(".hint")
+            )
+        except OSError:
+            return 0
+
+    def write_hint(
+        self,
+        target: str,
+        method: str,
+        path: str,
+        body: bytes,
+        headers: dict[str, str],
+    ) -> bool:
+        """Durably spool one replica request; False = refused (spool
+        over cap or unwritable) — the caller must then fail the write
+        like the pre-handoff code did."""
+        tdir = _target_dir(self.root, target)
+        try:
+            os.makedirs(tdir, exist_ok=True)
+            if self._dir_size(tdir) + len(body) > spool_cap_bytes():
+                from seaweedfs_tpu.stats.metrics import HANDOFF_HINTS
+
+                HANDOFF_HINTS.labels("dropped").inc()
+                wlog.error(
+                    "handoff: spool for %s over cap; refusing hint", target
+                )
+                return False
+            head = json.dumps(
+                {"target": target, "method": method, "path": path,
+                 "headers": headers}
+            ).encode()
+            name = self._next_name()
+            final = os.path.join(tdir, name)
+            tmp = final + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(_HDR.pack(len(head)))
+                f.write(head)
+                f.write(body)
+            # the durable publish IS the ack gate: fsync bytes, rename
+            # to *.hint, fsync the spool dir — a crash on the primary
+            # leaves either no hint (write not yet acked) or a complete
+            # one (acked; the agent replays it after restart)
+            durable.publish(tmp, final)
+        except OSError as e:
+            wlog.error("handoff: could not spool hint for %s: %s", target, e)
+            return False
+        from seaweedfs_tpu.stats.metrics import HANDOFF_HINTS
+
+        HANDOFF_HINTS.labels("written").inc()
+        return True
+
+    def read_hint(self, path: str) -> tuple[dict, bytes] | None:
+        """(header, body), or None for a torn/alien file (skipped and
+        removed by the agent — the durable publish makes torn hints a
+        can't-happen, but a spool must never wedge on one)."""
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+            (hlen,) = _HDR.unpack_from(raw, 0)
+            head = json.loads(raw[4 : 4 + hlen])
+            return head, raw[4 + hlen :]
+        except (OSError, ValueError, struct.error):
+            return None
+
+    def pending(self) -> dict[str, int]:
+        """target → queued hint count (the /status + test surface)."""
+        out: dict[str, int] = {}
+        try:
+            entries = os.scandir(self.root)
+        except OSError:
+            return out
+        for e in entries:
+            if not e.is_dir():
+                continue
+            try:
+                n = sum(
+                    1 for h in os.scandir(e.path) if h.name.endswith(".hint")
+                )
+            except OSError:
+                n = 0
+            if n:
+                out[_target_of_dir(e.name)] = n
+        return out
+
+    def targets(self) -> list[tuple[str, str]]:
+        """[(target, dir)] for every spool directory with hints."""
+        out = []
+        try:
+            entries = sorted(os.scandir(self.root), key=lambda e: e.name)
+        except OSError:
+            return out
+        for e in entries:
+            if e.is_dir():
+                out.append((_target_of_dir(e.name), e.path))
+        return out
+
+    def remove(self, path: str) -> None:
+        try:
+            os.remove(path)
+            durable.fsync_dir(os.path.dirname(path))
+        except OSError:
+            pass
+
+
+class HandoffAgent:
+    """Background replayer: wakes every `interval`, and for each target
+    with spooled hints replays them in filename (arrival) order through
+    the pooled HTTP plane. A transport failure or 5xx stops that
+    target's run for this round (the replica is still sick); 2xx — and
+    404 for DELETEs, the idempotent no-op — deliver the hint."""
+
+    def __init__(self, store: HintStore, interval: float = 1.0, sign=None):
+        self.store = store
+        self.interval = interval
+        # `sign(fid) -> Authorization value` re-signs replays on signed
+        # clusters: the CLIENT's write JWT spooled in the hint expires
+        # on token timescales while the outage can last longer — a
+        # stale token would 401 every replay and wedge the spool (the
+        # replica silently diverging from the acked primary). The
+        # server signs its own token, exactly like the delete cascade.
+        self.sign = sign
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.replayed = 0  # lifetime, for tests/status
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="weed-handoff"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def trigger(self) -> None:
+        self._wake.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.interval)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001 — the agent must survive
+                import traceback
+
+                wlog.warning(
+                    "handoff: replay cycle crashed: %s",
+                    traceback.format_exc(),
+                )
+
+    def run_once(self) -> int:
+        """One replay pass over every target; returns hints delivered.
+        Also the synchronous seam tests and drain paths drive."""
+        delivered = 0
+        for target, tdir in self.store.targets():
+            try:
+                names = sorted(
+                    e.name
+                    for e in os.scandir(tdir)
+                    if e.name.endswith(".hint")
+                )
+            except OSError:
+                continue
+            for name in names:
+                if self._stop.is_set():
+                    return delivered
+                path = os.path.join(tdir, name)
+                parsed = self.store.read_hint(path)
+                if parsed is None:
+                    from seaweedfs_tpu.stats.metrics import HANDOFF_HINTS
+
+                    HANDOFF_HINTS.labels("dropped").inc()
+                    self.store.remove(path)
+                    continue
+                head, body = parsed
+                verdict = self._replay(head, body)
+                if verdict == "sick":
+                    break  # target still sick: keep order, retry later
+                if verdict == "reject":
+                    # the target is UP and says no (4xx: volume moved
+                    # off it, auth revoked): retrying cannot change the
+                    # verdict, and blocking the queue behind it would
+                    # wedge every deliverable hint for this target —
+                    # drop it loudly; the repair/replication planes own
+                    # replica convergence from here
+                    from seaweedfs_tpu.stats.metrics import HANDOFF_HINTS
+
+                    HANDOFF_HINTS.labels("dropped").inc()
+                    self.store.remove(path)
+                    continue
+                self.store.remove(path)
+                delivered += 1
+                self.replayed += 1
+                from seaweedfs_tpu.stats.metrics import HANDOFF_HINTS
+
+                HANDOFF_HINTS.labels("replayed").inc()
+        return delivered
+
+    def _replay(self, head: dict, body: bytes) -> str:
+        """One delivery attempt: "done" (delivered / nothing left to
+        deliver), "sick" (transport failure or 5xx — the target is
+        still down, retry later), or "reject" (a live target refused
+        with a 4xx — permanent for this hint)."""
+        from seaweedfs_tpu.client.operation import http_call
+
+        method = head.get("method", "POST")
+        path = head["path"]
+        url = f"{head['target']}{path}"
+        headers = dict(head.get("headers") or {})
+        if self.sign is not None:
+            fid = path.lstrip("/").partition("?")[0]
+            headers["Authorization"] = self.sign(fid)
+        try:
+            status, _, _ = http_call(
+                method,
+                url,
+                body=body if method == "POST" else None,
+                headers=headers,
+                timeout=10,
+            )
+        except Exception as e:  # noqa: BLE001 — unreachable target, retried
+            wlog.info("handoff: %s still unreachable: %s", head["target"], e)
+            return "sick"
+        if status < 300 or (method == "DELETE" and status == 404):
+            return "done"
+        if status == 409:
+            # CookieMismatch on replay: the record already landed with
+            # these exact bytes in an earlier, half-acked delivery (or
+            # was legitimately overwritten since). Retrying forever
+            # cannot change the verdict — count it delivered.
+            return "done"
+        wlog.warning(
+            "handoff: %s answered %d for a hint (%s %s)",
+            head["target"], status, method, path,
+        )
+        return "sick" if status >= 500 else "reject"

@@ -35,6 +35,7 @@ from concurrent import futures
 import grpc
 
 from seaweedfs_tpu import qos
+from seaweedfs_tpu.cluster import health as health_mod
 from seaweedfs_tpu.pb import master_pb2 as pb
 from seaweedfs_tpu.util.httpd import (
     JSON_HDR as _JSON_HDR,
@@ -218,6 +219,11 @@ class MasterServer:
         # they have no heartbeat stream to be discovered from
         self._gateways: dict[str, dict] = {}
         self._gateways_lock = threading.Lock()
+        # weedguard health plane (docs/HEALTH.md): per-node phi-accrual
+        # suspicion + error EWMAs + lame-duck/drain flags, scored from
+        # heartbeats. Always on (cheap); WEED_HEALTH=0 makes every
+        # verdict read healthy, restoring pre-health behavior wholesale.
+        self.health = health_mod.HealthPlane()
 
     # gateways silent for this long stop being offered to the collector
     # (its own sticky-target window keeps their staleness alert alive
@@ -346,6 +352,11 @@ class MasterServer:
                     # assignment (pick_for_write power-of-two-choices)
                     dn.in_flight = req.in_flight_requests
                     dn.write_queue_depth = req.write_queue_depth
+                    # health plane (docs/HEALTH.md): beat arrival time
+                    # feeds the phi-accrual detector, the counters feed
+                    # the error EWMA, and the node's own lame-duck /
+                    # draining flags land here
+                    self.health.observe_heartbeat(dn.url, req)
                     self.sequencer.set_max(req.max_file_key)
                     if req.volumes or req.has_no_volumes:
                         new, deleted = self.topology.sync_volumes(
@@ -418,6 +429,9 @@ class MasterServer:
                         ],
                     )
                     new_sig = _damage_sig(dn.scrub_stats)
+                    # disk-health signal for the health plane: this
+                    # node's scrub rows currently report damage
+                    self.health.observe_scrub(dn.url, bool(new_sig))
                     if (
                         self.repair is not None
                         and new_sig
@@ -443,6 +457,7 @@ class MasterServer:
                 ):
                     vids = list(dn.volumes)
                     self.topology.unregister_data_node(dn)
+                    self.health.note_dead(dn.url)
                     if vids:
                         self._broadcast(dn.url, dn.public_url, [], vids)
 
@@ -556,8 +571,16 @@ class MasterServer:
             if not nodes:
                 entry.error = f"volume id {vid} not found"
                 continue
-            for dn in nodes:
-                entry.locations.add(url=dn.url, public_url=dn.public_url)
+            # health plane (docs/HEALTH.md): suspects ordered last AND
+            # marked, so every client demotes them cluster-wide (the
+            # per-process circuit breaker only learns from its own
+            # timeouts) and the hedge driver fires eagerly
+            for dn in self.health.order_nodes(nodes):
+                entry.locations.add(
+                    url=dn.url,
+                    public_url=dn.public_url,
+                    suspect=self.health.suspect(dn.url),
+                )
         return out
 
     def LookupEcVolume(self, req: pb.LookupEcVolumeRequest, context) -> pb.LookupEcVolumeResponse:
@@ -650,6 +673,11 @@ class MasterServer:
             collection, rp, ttl, count,
             data_center=data_center,
             policy=self.assign_policy if qos.enabled("assign") else "random",
+            # health plane (docs/HEALTH.md): prefer volumes whose
+            # replicas are all assignable — suspects/lame-ducks/
+            # draining nodes stop receiving writes as soon as the
+            # master suspects them, not when requests start timing out
+            health=self.health,
         )
         file_key = self.sequencer.next_file_id(count)
         cookie = random.randrange(1 << 32)
@@ -816,6 +844,46 @@ class MasterServer:
                         return self._proxy_http_to_leader()
                     server.register_gateway(kind, addr)
                     return self._json({"ok": True})
+                if path == "/node/drain":
+                    # weedguard (docs/HEALTH.md): operator drain intent
+                    # for one volume server — excluded from assignment
+                    # immediately, and the RepairScheduler moves its
+                    # volumes/EC shards off (the node.drain shell
+                    # command drives + polls this). ?stop=1 cancels;
+                    # ?status=1 is the READ-ONLY poll form (no
+                    # re-marking, no scheduler wake — the shell's
+                    # -wait loop would otherwise re-fire the mutation
+                    # twice a second).
+                    node = q.get("node", "")
+                    if not node or ":" not in node:
+                        return self._json(
+                            {"error": "node=host:port required"}, 400
+                        )
+                    if not server.is_leader:
+                        return self._proxy_http_to_leader()
+                    stop = q.get("stop", "") in ("1", "true")
+                    if q.get("status", "") not in ("1", "true"):
+                        server.health.request_drain(node, stop=stop)
+                        if server.repair is not None and not stop:
+                            server.repair.trigger()
+                    dn = next(
+                        (
+                            d
+                            for d in server.topology.data_nodes()
+                            if d.url == node
+                        ),
+                        None,
+                    )
+                    return self._json(
+                        {
+                            "node": node,
+                            "draining": not stop,
+                            "registered": dn is not None,
+                            "volumes": len(dn.volumes) if dn else 0,
+                            "ecShards": dn.ec_shard_count() if dn else 0,
+                            "repairScheduler": server.repair is not None,
+                        }
+                    )
                 if path in ("/cluster/health", "/cluster/alerts", "/cluster/top"):
                     if not server.is_leader:
                         # followers hold no topology and run no
@@ -826,6 +894,23 @@ class MasterServer:
                         # "Disabled" for a cluster whose leader is
                         # collecting fine
                         return self._proxy_http_to_leader()
+                    if path == "/cluster/health":
+                        # weedguard (docs/HEALTH.md): per-node health
+                        # scores/states ride this surface even with the
+                        # telemetry collector off — the health plane
+                        # lives on heartbeats alone
+                        payload = {"NodeHealth": server.health.payload()}
+                        if server.telemetry is None:
+                            payload["Disabled"] = True
+                            payload["error"] = (
+                                "telemetry collector disabled "
+                                "on this master (-telemetryInterval 0)"
+                            )
+                        else:
+                            payload.update(
+                                server.telemetry.health_payload()
+                            )
+                        return self._json(payload)
                     if server.telemetry is None:
                         return self._json(
                             {
@@ -834,8 +919,6 @@ class MasterServer:
                                 "on this master (-telemetryInterval 0)",
                             }
                         )
-                    if path == "/cluster/health":
-                        return self._json(server.telemetry.health_payload())
                     if path == "/cluster/alerts":
                         return self._json(server.telemetry.alerts.payload())
                     try:
@@ -919,7 +1002,12 @@ class MasterServer:
                         {"error": f"volume id {vid_str} not found"}, 404
                     )
                     return True
-                dn = random.choice(nodes)
+                # redirect readers at a non-suspect replica when one
+                # exists (health plane, docs/HEALTH.md)
+                healthy = [
+                    dn for dn in nodes if not server.health.suspect(dn.url)
+                ]
+                dn = random.choice(healthy or nodes)
                 target = f"http://{dn.public_url}{self.path}"
                 self.fast_reply(301, b"", {"Location": target})
                 return True
@@ -1061,11 +1149,17 @@ class MasterServer:
                     return self._json(
                         {"volumeId": vid_str, "error": "volume id not found"}, 404
                     )
+                # suspects last + marked (health plane, docs/HEALTH.md)
                 self._json(
                     {
                         "volumeId": vid_str,
                         "locations": [
-                            {"url": dn.url, "publicUrl": dn.public_url} for dn in nodes
+                            {
+                                "url": dn.url,
+                                "publicUrl": dn.public_url,
+                                "suspect": server.health.suspect(dn.url),
+                            }
+                            for dn in server.health.order_nodes(nodes)
                         ],
                     }
                 )
@@ -1238,6 +1332,7 @@ class MasterServer:
                     )
                     vids = list(dn.volumes)
                     self.topology.unregister_data_node(dn)
+                    self.health.note_dead(dn.url)
                     if vids:
                         self._broadcast(dn.url, dn.public_url, [], vids)
 

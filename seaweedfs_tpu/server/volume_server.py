@@ -36,6 +36,7 @@ import grpc
 
 from seaweedfs_tpu import qos, trace
 from seaweedfs_tpu.util import deadline as _op_deadline
+from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.ec import ec_files
 from seaweedfs_tpu.ec.ec_volume import EcVolume, NotEnoughShards
 from seaweedfs_tpu.pb import master_pb2, rpc, volume_pb2 as pb
@@ -260,6 +261,27 @@ class VolumeServer:
         # in-flight request tracking, shipped on heartbeats so the
         # master's pick-for-write can weigh nodes by live load
         self.load = qos.LoadTracker()
+        # weedguard (docs/HEALTH.md): the local disk watchdog flips the
+        # node into read-only lame-duck mode on repeated EIO/ENOSPC
+        # (announced on the next forced beat; new writes shed with
+        # 503), SIGTERM sets `draining` (graceful drain — see drain()),
+        # and the hinted-handoff spool + agent keep replicated writes
+        # available while one replica is down: a failed replica hop
+        # durably spools the request here and replays it on heal.
+        from seaweedfs_tpu.cluster.health import DiskWatchdog
+        from seaweedfs_tpu.server.handoff import HandoffAgent, HintStore
+
+        self.watchdog = DiskWatchdog()
+        self.watchdog.on_trip = self._hb_wake.set
+        self.draining = False
+        self.hints = HintStore(os.path.join(directories[0], ".weed_handoff"))
+        # replays re-sign with OUR key on signed clusters: the client
+        # JWT spooled in a hint expires on token timescales while an
+        # outage can last longer
+        sign = None
+        if guard is not None and guard.signing_key:
+            sign = lambda fid: f"BEARER {guard.sign_write(fid)}"  # noqa: E731
+        self.handoff = HandoffAgent(self.hints, sign=sign)
         # per-client admission control (token bucket + in-flight cap);
         # None = accept everything, today's behavior
         self.admission = None
@@ -379,6 +401,13 @@ class VolumeServer:
                     if self.group_commit is not None
                     else 0
                 ),
+                # health plane (docs/HEALTH.md): graceful-degradation
+                # flags + cumulative error counters for the master's
+                # per-node EWMAs
+                lame_duck=self.watchdog.lame_duck,
+                draining=self.draining,
+                io_errors=self.watchdog.io_errors,
+                request_errors=self.load.errors(),
             )
             # signature catches in-place changes (growth past the size
             # limit, read-only flips, delete counts) so they propagate
@@ -993,6 +1022,16 @@ class VolumeServer:
             def read(offset: int, size: int) -> bytes:
                 last: Exception | None = None
                 t_o = 30 if factory_dl is None else factory_dl.cap(30)
+                # the hop HEADER rides too (re-stamped per read, the
+                # remaining budget only shrinks): the shard holder can
+                # then 504-fast-reject work this gather already gave up
+                # on instead of serving bytes nobody will read
+                call_md = md
+                if factory_dl is not None:
+                    call_md = tuple(md or ()) + (
+                        (_op_deadline.DEADLINE_HEADER,
+                         factory_dl.header_value()),
+                    )
                 for url in urls:
                     try:
                         data = b"".join(
@@ -1005,7 +1044,7 @@ class VolumeServer:
                                     size=size,
                                 ),
                                 timeout=t_o,
-                                metadata=md,
+                                metadata=call_md,
                             )
                         )
                     except grpc.RpcError as e:
@@ -1327,9 +1366,26 @@ class VolumeServer:
         # have no ambient span, so the wire metadata carries the parent
         # (and the scrub plane tag when the scrubber built this fetcher)
         md = trace.grpc_metadata()
+        # ...and the ambient deadline (docs/CHAOS.md): the degraded-read
+        # fan-out runs on pool threads where the request's budget is not
+        # ambient — capture it here so each remote read derives its
+        # timeout from the REMAINING budget and stamps the hop header
+        # (the shard holder 504-fast-rejects expired gathers instead of
+        # decoding bytes the caller abandoned)
+        factory_dl = _op_deadline.current()
 
         def read_from(url: str, shard_id: int, offset: int, size: int):
             host, _, port = url.partition(":")
+            try:
+                t_o = 10 if factory_dl is None else factory_dl.cap(10)
+            except _op_deadline.DeadlineExceeded:
+                return None  # budget spent: the gather fails, fast
+            call_md = md
+            if factory_dl is not None:
+                call_md = tuple(md or ()) + (
+                    (_op_deadline.DEADLINE_HEADER,
+                     factory_dl.header_value()),
+                )
             # two tries per holder: a flaky link (mid-stream RST, a
             # dropped proxy hop) kills individual connections, and a
             # fresh dial usually succeeds — distinguishing "this
@@ -1347,8 +1403,8 @@ class VolumeServer:
                                     offset=offset,
                                     size=size,
                                 ),
-                                timeout=10,
-                                metadata=md,
+                                timeout=t_o,
+                                metadata=call_md,
                             )
                         ]
                     return b"".join(chunks)
@@ -1520,6 +1576,12 @@ class VolumeServer:
                                 if server.scrub is not None
                                 else {"Disabled": True}
                             ),
+                            # health plane (docs/HEALTH.md): local
+                            # degradation state + the handoff spool
+                            "LameDuck": server.watchdog.lame_duck,
+                            "Draining": server.draining,
+                            "IoErrors": server.watchdog.io_errors,
+                            "HandoffPending": server.hints.pending(),
                             "Resizing": (
                                 "enabled"
                                 if images.resizing_enabled()
@@ -1624,6 +1686,13 @@ class VolumeServer:
                     return self._reply(404)
                 except NotEnoughShards as e:
                     return self._json({"error": str(e)}, 500)
+                except OSError as e:
+                    # disk watchdog (docs/HEALTH.md): EIO on the read
+                    # path strikes toward lame-duck mode; a 500 beats a
+                    # silently torn connection either way
+                    if not server.watchdog.note_io_error(e):
+                        raise
+                    return self._json({"error": f"read failed: {e}"}, 500)
                 if n.is_chunked_manifest():
                     return self._serve_chunked_manifest(n)
                 # conditional gets: If-Modified-Since (RFC 1123, like
@@ -1804,11 +1873,37 @@ class VolumeServer:
 
             do_HEAD = do_GET
 
+            def _shed_unwritable(self) -> bool:
+                """weedguard graceful degradation (docs/HEALTH.md):
+                a lame-duck (disk watchdog tripped) or draining node
+                sheds NEW writes with 503 + Retry-After — reads keep
+                flowing, the master has already stopped assigning
+                here, and a healthy primary's replica fan-out turns
+                the 503 into a handoff hint instead of a failed
+                write."""
+                if not (server.watchdog.lame_duck or server.draining):
+                    return False
+                why = (
+                    "lame-duck (disk errors)"
+                    if server.watchdog.lame_duck
+                    else "draining"
+                )
+                self._reply(
+                    503,
+                    json.dumps(
+                        {"error": f"node is read-only: {why}"}
+                    ).encode(),
+                    _JSON_HDR + b"Retry-After: 1\r\n",
+                )
+                return True
+
             def do_POST(self):
                 fid, q, url_filename, _url_ext = self._parse_fid()
                 if fid is None:
                     return self._json({"error": "invalid file id"}, 400)
                 if not self._check_write_auth():
+                    return
+                if self._shed_unwritable():
                     return
                 length = int(self.headers.get("content-length", "0"))
                 body = self.rfile.read(length)
@@ -1830,25 +1925,34 @@ class VolumeServer:
                 # path)
                 req_span = getattr(self, "_trace_span", None)
                 stages = {} if req_span is not None else None
-                if server.group_commit is not None:
-                    # QoS group commit (docs/QOS.md): the C one-call
-                    # append can't join a commit window (and fsync-only
-                    # mode needs the post-write flush), so the fast
-                    # path declines wholesale while a committer is
-                    # installed — the Python path below routes through
-                    # it and stays byte-identical
-                    reply = None
-                else:
-                    reply = write_path.try_native_post(
-                        server.store.find_volume(fid.volume_id),
-                        fid,
-                        q,
-                        body,
-                        self.headers,
-                        url_filename,
-                        server.fix_jpg_orientation,
-                        stages=stages,
-                    )
+                try:
+                    if server.group_commit is not None:
+                        # QoS group commit (docs/QOS.md): the C one-call
+                        # append can't join a commit window (and fsync-only
+                        # mode needs the post-write flush), so the fast
+                        # path declines wholesale while a committer is
+                        # installed — the Python path below routes through
+                        # it and stays byte-identical
+                        reply = None
+                    else:
+                        reply = write_path.try_native_post(
+                            server.store.find_volume(fid.volume_id),
+                            fid,
+                            q,
+                            body,
+                            self.headers,
+                            url_filename,
+                            server.fix_jpg_orientation,
+                            stages=stages,
+                        )
+                except OSError as e:
+                    # disk watchdog (docs/HEALTH.md): an EIO/ENOSPC on
+                    # the append path strikes toward lame-duck mode and
+                    # fails THIS write loudly; anything else (deadline,
+                    # connection) keeps its existing handling
+                    if not server.watchdog.note_io_error(e):
+                        raise
+                    return self._json({"error": f"write failed: {e}"}, 500)
                 if reply is None:
                     n, fname, err = write_path.build_upload_needle(
                         fid,
@@ -1869,6 +1973,12 @@ class VolumeServer:
                         return self._json({"error": "volume not found"}, 404)
                     except (VolumeReadOnly, CookieMismatch) as e:
                         return self._json({"error": str(e)}, 409)
+                    except OSError as e:
+                        if not server.watchdog.note_io_error(e):
+                            raise
+                        return self._json(
+                            {"error": f"write failed: {e}"}, 500
+                        )
                     t_reply = time.perf_counter() if stages is not None else 0.0
                     reply = (
                         b'{"name": %s, "size": %d, "eTag": "%s"}'
@@ -1889,6 +1999,8 @@ class VolumeServer:
                 if fid is None:
                     return self._json({"error": "invalid file id"}, 400)
                 if not self._check_write_auth():
+                    return
+                if self._shed_unwritable():
                     return
                 if server.shard_writes and self._route_shard_write(fid, b""):
                     return
@@ -2295,7 +2407,15 @@ class VolumeServer:
             return None
 
     def _replicate(self, fid: FileId, q: dict, method: str, body: bytes, headers: dict) -> str | None:
-        """Fan the write to replica peers (store_replicate.go:44-80)."""
+        """Fan the write to replica peers (store_replicate.go:44-80).
+
+        weedguard (docs/HEALTH.md): a peer that fails at the transport
+        level or with a 5xx gets the request durably spooled as a
+        handoff hint instead of failing the whole write — the hint is
+        published via util/durable BEFORE this returns (i.e. before the
+        client is acked), and the handoff agent replays it once the
+        peer heals. WEED_HEALTH=0 / WEED_HANDOFF=0 restore the
+        all-or-error contract wholesale."""
         v = self.store.find_volume(fid.volume_id)
         if v is None or v.super_block.replica_placement.copy_count <= 1:
             return None
@@ -2306,7 +2426,28 @@ class VolumeServer:
             return "replication lookup failed"
         mine = self._self_urls()
         locations = [u for u in all_locations if u not in mine]
-        return write_path.replicate_to_peers(fid, q, method, body, headers, locations)
+        from seaweedfs_tpu.server import handoff as handoff_mod
+
+        on_fail = None
+        if handoff_mod.handoff_enabled():
+            def on_fail(url, path_q, err, status):
+                ok = self.hints.write_hint(
+                    url,
+                    method,
+                    path_q,
+                    body if method == "POST" else b"",
+                    handoff_mod.keep_headers(headers),
+                )
+                if ok:
+                    wlog.warning(
+                        "handoff: replica %s failed (%s); write hinted "
+                        "for replay on heal", url, err,
+                    )
+                return ok
+
+        return write_path.replicate_to_peers(
+            fid, q, method, body, headers, locations, on_fail=on_fail
+        )
     def start(self) -> None:
         self._grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         self._grpc_server.add_generic_rpc_handlers(
@@ -2350,6 +2491,10 @@ class VolumeServer:
         if self.master:
             self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
             self._hb_thread.start()
+        # handoff agent (docs/HEALTH.md): replays spooled replica
+        # writes once their target heals; idles cheaply when the spool
+        # is empty (and drains hints left by a previous process life)
+        self.handoff.start()
         if self.scrub is not None:
             self.scrub.start()
         # telemetry plane: continuous sampling profiler behind
@@ -2358,9 +2503,36 @@ class VolumeServer:
 
         profiler.ensure_started()
 
+    def drain(self, timeout: float = 30.0) -> None:
+        """SIGTERM graceful drain (docs/HEALTH.md runbook): announce
+        `draining` on an immediate beat — the master excludes this node
+        from write assignment and the RepairScheduler starts moving
+        data off — shed new writes with 503, let in-flight requests
+        finish (bounded by `timeout`), then stop(): the heartbeat
+        stream teardown deregisters the node cleanly."""
+        self.draining = True
+        self._hb_wake.set()  # the flag rides the NEXT beat, now
+        wlog.warning(
+            "volume %s:%d draining: writes shed, waiting for %d "
+            "in-flight request(s)", self.host, self.port,
+            self.load.inflight(),
+        )
+        # one beat RTT so the master sees the flag before we exit
+        deadline = time.time() + timeout
+        time.sleep(min(2 * self.heartbeat_interval, 2.0))
+        while time.time() < deadline and self.load.inflight() > 0:
+            time.sleep(0.05)
+        # last chance to deliver spooled hints while we are still up
+        try:
+            self.handoff.run_once()
+        except Exception:  # noqa: BLE001 — drain must complete anyway
+            pass
+        self.stop()
+
     def stop(self) -> None:
         self._stop.set()
         self._hb_wake.set()  # unblock the heartbeat generator's wait
+        self.handoff.stop()
         if self.scrub is not None:
             self.scrub.stop()
         if self._metrics_push is not None:

@@ -248,12 +248,23 @@ def replicate_to_peers(
     body: bytes,
     headers,
     locations: list[str],
+    on_fail=None,
 ) -> str | None:
     """Fan the original write to the replica `locations` (already
     excluding the sender) with type=replicate so peers store without
     re-fanning (store_replicate.go:44-80). Returns an error message or
     None; all-or-error like the reference (a failed replica fails the
-    write)."""
+    write).
+
+    `on_fail(url, path_with_query, error, status)` is the weedguard
+    hinted-handoff seam (docs/HEALTH.md): called for a peer whose hop
+    failed at the TRANSPORT level or with a 5xx (`status` is None for
+    transport failures) — returning True absorbs that peer's failure
+    (the caller durably spooled the request for replay on heal) so one
+    sick replica no longer fails the whole write. Semantic rejections
+    (4xx: bad auth, cookie mismatch) never reach it — a reachable peer
+    refusing the write is a real error, not an outage."""
+    import urllib.error
     import urllib.request
     from urllib.parse import urlencode
 
@@ -264,10 +275,11 @@ def replicate_to_peers(
     # replica fan-out is an internal hop: the peer's span must parent
     # under THIS server's span, not the client's original header
     trace_hdr = trace.header_value()
+    path_q = f"/{fid}?{urlencode(params)}"
     for url in locations:
         try:
             req = urllib.request.Request(
-                f"http://{url}/{fid}?{urlencode(params)}",
+                f"http://{url}{path_q}",
                 data=body if method == "POST" else None,
                 method=method,
             )
@@ -293,7 +305,15 @@ def replicate_to_peers(
             with urllib.request.urlopen(req, timeout=10) as r:
                 if r.status >= 300:
                     return f"replica {url} returned {r.status}"
+        except urllib.error.HTTPError as e:
+            if e.code >= 500 and on_fail is not None and on_fail(
+                url, path_q, f"HTTP {e.code}", e.code
+            ):
+                continue
+            return f"replica {url} returned {e.code}"
         except OSError as e:
+            if on_fail is not None and on_fail(url, path_q, str(e), None):
+                continue
             return f"replica {url} failed: {e}"
     return None
 

@@ -1235,6 +1235,107 @@ class RepairQueue(Command):
             )
 
 
+@register
+class NodeDrain(Command):
+    name = "node.drain"
+    help = (
+        "node.drain -node host:port [-wait seconds] [-stop] [-json] — "
+        "weedguard decommission (docs/HEALTH.md): mark the node "
+        "draining (excluded from write assignment at once) and have "
+        "the master RepairScheduler move its volumes and EC shards "
+        "off; -wait polls until the node is empty, printing repair-"
+        "queue evidence. -stop cancels a drain."
+    )
+
+    def run(self, env, args, out):
+        import json as _json
+
+        node = _flag(args, "node")
+        if not node:
+            raise ValueError("node.drain needs -node host:port")
+        stop = _has_flag(args, "stop")
+        try:
+            wait_s = float(_flag(args, "wait", "0") or "0")
+        except ValueError:
+            wait_s = 0.0
+        url = f"http://{env.master}/node/drain?node={node}"
+        if stop:
+            url += "&stop=1"
+        snap = _http_json(url)
+        if _has_flag(args, "json"):
+            print(_json.dumps(snap), file=out)
+            return
+        if snap.get("error"):
+            raise ValueError(snap["error"])
+        if stop:
+            print(f"drain of {node} cancelled", file=out)
+            return
+        if not snap.get("registered"):
+            # an unregistered address drains vacuously — most likely a
+            # typo; claiming "empty, safe to stop" here would invite
+            # SIGTERMing the wrong (undrained) process
+            print(
+                f"WARNING: {node} is not registered with this master — "
+                "check the address (the drain mark was recorded; "
+                "-stop clears it)",
+                file=out,
+            )
+            return
+        if not snap.get("repairScheduler"):
+            print(
+                "WARNING: repair scheduler disabled on this master "
+                "(-repairInterval 0) — the drain mark excludes the "
+                "node from assignment but nothing will move its data",
+                file=out,
+            )
+        print(
+            f"draining {node}: {snap.get('volumes', 0)} volume(s), "
+            f"{snap.get('ecShards', 0)} ec shard(s) to move",
+            file=out,
+        )
+        deadline = time.time() + wait_s
+        moved_evidence: list[str] = []
+        while wait_s > 0:
+            snap = _http_json(url + "&status=1")  # read-only poll form
+            if snap.get("volumes", 0) == 0 and snap.get("ecShards", 0) == 0:
+                break
+            if time.time() >= deadline:
+                print(
+                    f"  still holding {snap.get('volumes', 0)} volume(s) "
+                    f"/ {snap.get('ecShards', 0)} shard(s) after "
+                    f"{wait_s:.0f}s — drain continues in the background",
+                    file=out,
+                )
+                # name WHY it is stuck (a blocked drain usually means
+                # no eligible target: add capacity)
+                rq = _http_json(f"http://{env.master}/repair/queue")
+                for t in rq.get("Tasks", []):
+                    if t["Kind"].startswith("drain") and t.get("LastError"):
+                        print(
+                            f"  blocked: {t['Kind']} vid {t['VolumeId']}: "
+                            f"{t['LastError']}",
+                            file=out,
+                        )
+                return
+            time.sleep(0.5)
+        # repair-queue evidence: the drain tasks that moved the data
+        rq = _http_json(f"http://{env.master}/repair/queue")
+        for h in rq.get("History", []):
+            if h["Kind"].startswith("drain"):
+                moved_evidence.append(
+                    f"  moved: {h['Kind']} vid {h['VolumeId']} "
+                    f"in {h['RepairSeconds']}s"
+                )
+        for line in moved_evidence[-20:]:
+            print(line, file=out)
+        if wait_s > 0:
+            print(
+                f"{node} is empty — safe to stop the process "
+                "(SIGTERM finishes in-flight work and deregisters)",
+                file=out,
+            )
+
+
 # ----------------------------------------------------------------------
 # tracing plane (docs/TRACING.md)
 
@@ -1365,9 +1466,10 @@ class TraceDump(Command):
 class ClusterHealth(Command):
     name = "cluster.health"
     help = (
-        "cluster.health [-json] — the leader collector's view: per-"
-        "target scrape health (staleness, last error), alert counts, "
-        "push-loop status"
+        "cluster.health [-json] — per-node weedguard health scores/"
+        "states (docs/HEALTH.md) plus the leader collector's view: "
+        "per-target scrape health (staleness, last error), alert "
+        "counts, push-loop status"
     )
 
     def run(self, env, args, out):
@@ -1377,6 +1479,30 @@ class ClusterHealth(Command):
         if _has_flag(args, "json"):
             print(_json.dumps(snap), file=out)
             return
+        nh = snap.get("NodeHealth") or {}
+        if nh:
+            if not nh.get("Enabled", True):
+                print("health plane disabled (WEED_HEALTH=0)", file=out)
+            for url, row in sorted((nh.get("Nodes") or {}).items()):
+                flags = [
+                    f
+                    for f, on in (
+                        ("lame-duck", row.get("LameDuck")),
+                        ("draining", row.get("Draining")),
+                        ("scrub-flagged", row.get("ScrubFlagged")),
+                    )
+                    if on
+                ]
+                line = (
+                    f"  {url}: {row.get('State')} "
+                    f"(score {row.get('Score')}, phi {row.get('Phi')}, "
+                    f"err_ewma {row.get('ErrEwma')})"
+                )
+                if flags:
+                    line += " [" + ", ".join(flags) + "]"
+                if row.get("Reasons"):
+                    line += " — " + ", ".join(row["Reasons"])
+                print(line, file=out)
         if snap.get("Disabled"):
             print(
                 "telemetry collector disabled on this master "

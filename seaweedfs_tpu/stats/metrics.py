@@ -417,6 +417,22 @@ DEADLINE_REJECTED = DEFAULT_REGISTRY.counter(
     ("server",),
 )
 
+# --- weedguard health plane (docs/HEALTH.md) --------------------------------
+# Master-side node state transitions (healthy/suspect/dead) and the
+# volume-server hinted-handoff spool (written = a replica write was
+# diverted into a durable hint; replayed = the handoff agent delivered
+# it after heal; dropped = spool cap or unparseable hint).
+HEALTH_TRANSITIONS = DEFAULT_REGISTRY.counter(
+    "weed_health_transitions_total",
+    "node health-state transitions observed by the master, by new state",
+    ("state",),
+)
+HANDOFF_HINTS = DEFAULT_REGISTRY.counter(
+    "weed_handoff_hints_total",
+    "hinted-handoff events on the volume write path",
+    ("event",),  # written | replayed | dropped
+)
+
 
 # textual push-loop health (gauges can't carry the error STRING): job
 # -> {"last_success_unix", "last_error"}; /cluster/health surfaces it

@@ -120,11 +120,21 @@ class Topology(Node):
         return dn
 
     def sync_volumes(self, dn: DataNode, infos: list[VolumeInfo]) -> tuple[list[VolumeInfo], list[VolumeInfo]]:
-        """Full-state volume sync from one heartbeat."""
-        new, deleted = dn.update_volumes(infos)
+        """Full-state volume sync from one heartbeat.
+
+        Layouts register BEFORE dn.volumes is replaced: an assign
+        racing this sync reads free_space() from dn.volumes and
+        writability from the layouts, and the old order (node map
+        first) had a window where a full node counted against
+        free_space while its volumes were not yet writable — a
+        fresh-leader re-registration could answer "no free volumes
+        left" for a perfectly healthy cluster. Registering layouts
+        first errs the other way (at worst an unnecessary grow
+        attempt, which is guarded), never a spurious hard failure."""
         for v in infos:
             self.id_gen.adjust_if_larger(v.id)
             self._layout_for(v).register_volume(v, dn)
+        new, deleted = dn.update_volumes(infos)
         for v in deleted:
             self._layout_for(v).unregister_volume(v.id, dn)
         return new, deleted
@@ -268,9 +278,10 @@ class Topology(Node):
         count: int = 1,
         data_center: str = "",
         policy: str = "p2c",
+        health=None,
     ) -> tuple[int, int, list[DataNode]]:
         vid, nodes = self.get_layout(collection, rp, ttl).pick_for_write(
-            data_center=data_center, policy=policy
+            data_center=data_center, policy=policy, health=health
         )
         return vid, count, nodes
 

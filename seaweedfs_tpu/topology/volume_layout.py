@@ -104,6 +104,37 @@ class VolumeLayout:
         (fan-out), so the slowest — most loaded — replica bounds it."""
         return max((dn.queue_load() for dn in nodes), default=0)
 
+    def _health_filtered(self, health) -> list[int]:
+        """Writable vids whose replicas are ALL assignable per the
+        health plane (docs/HEALTH.md). Empty (or health None/disabled)
+        → the caller falls back to the full writable set: availability
+        beats precision when every volume touches a suspect node (the
+        write may still succeed — hinted handoff covers the sick
+        replica).
+
+        The verdict is memoized per NODE for this pick: volumes number
+        in the thousands while nodes number in the dozens, and each
+        assignable() call walks a phi ring + env knobs — evaluating it
+        per replica per vid under the layout lock would make assign
+        latency scale with the volume count."""
+        if health is None:
+            return self.writables
+        memo: dict[str, bool] = {}
+        assignable = health.assignable
+
+        def ok(dn) -> bool:
+            v = memo.get(dn.url)
+            if v is None:
+                v = memo[dn.url] = assignable(dn.url)
+            return v
+
+        clean = [
+            vid
+            for vid in self.writables
+            if all(ok(dn) for dn in self.vid2location.get(vid, ()))
+        ]
+        return clean or self.writables
+
     def pick_for_write(
         self,
         data_center: str = "",
@@ -111,6 +142,7 @@ class VolumeLayout:
         data_node: str = "",
         rng: random.Random | None = None,
         policy: str = "p2c",
+        health=None,
     ) -> tuple[int, list[DataNode]]:
         """Writable vid pick, optionally affine to a DC/rack/node
         (volume_layout.go:165 PickForWrite — reservoir sampling over
@@ -124,14 +156,20 @@ class VolumeLayout:
         signals. "random" is the pre-QoS pure-random pick
         (`-assignPolicy random`, and what WEED_QOS=0 restores).
         Affinity-constrained picks keep the reservoir path (the
-        candidate set is already narrow)."""
+        candidate set is already narrow).
+
+        `health` (docs/HEALTH.md): the master's HealthPlane — volumes
+        with a suspect/lame-duck/draining replica are excluded while a
+        clean alternative exists, under BOTH policies (WEED_HEALTH=0
+        makes every verdict healthy, restoring the old pool)."""
         rng = rng or random
         with self._lock:
             if not self.writables:
                 raise ValueError("no writable volumes")
+            candidates = self._health_filtered(health)
             if not data_center:
-                if policy == "p2c" and len(self.writables) > 1:
-                    a, b = rng.sample(self.writables, 2)
+                if policy == "p2c" and len(candidates) > 1:
+                    a, b = rng.sample(candidates, 2)
                     la = self._volume_load(self.vid2location[a])
                     lb = self._volume_load(self.vid2location[b])
                     if la == lb:
@@ -145,21 +183,29 @@ class VolumeLayout:
                         key=lambda dn: dn.queue_load(),
                     )
                     return vid, nodes
-                vid = rng.choice(self.writables)
+                vid = rng.choice(candidates)
                 return vid, list(self.vid2location[vid])
-            counter = 0
             chosen: Optional[tuple[int, DataNode]] = None
-            for vid in self.writables:
-                for dn in self.vid2location.get(vid, []):
-                    if dn.get_data_center().id != data_center:
+            # two passes at most: the health-filtered pool first, the
+            # full writable set if the filter emptied THIS affinity
+            # slice (availability beats precision, as above)
+            for pool in (set(candidates), set(self.writables)):
+                counter = 0
+                for vid in self.writables:
+                    if vid not in pool:
                         continue
-                    if rack and dn.get_rack().id != rack:
-                        continue
-                    if data_node and dn.id != data_node:
-                        continue
-                    counter += 1
-                    if rng.randrange(counter) < 1:
-                        chosen = (vid, dn)
+                    for dn in self.vid2location.get(vid, []):
+                        if dn.get_data_center().id != data_center:
+                            continue
+                        if rack and dn.get_rack().id != rack:
+                            continue
+                        if data_node and dn.id != data_node:
+                            continue
+                        counter += 1
+                        if rng.randrange(counter) < 1:
+                            chosen = (vid, dn)
+                if chosen is not None:
+                    break
             if chosen is None:
                 raise ValueError(
                     f"no writable volumes in dc={data_center} rack={rack}"

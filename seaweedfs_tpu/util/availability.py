@@ -75,9 +75,56 @@ def run_with_readers(readers, transition, settle: float = 0.5) -> None:
             r.join(timeout=30)
 
 
+_port_state = {"next": None}
+_port_lock = threading.Lock()
+
+
 def free_port() -> int:
+    """A listen port for a test/bench server.
+
+    NOT a bare port-0 probe: that hands back a port inside the
+    kernel's ephemeral range (`ip_local_port_range`, 32768+ here), and
+    any outbound connection the process — or a sibling daemon — makes
+    before the server binds can be assigned that exact port as its
+    LOCAL port, turning the later bind into EADDRINUSE. Under a full
+    tier-1 run (hundreds of servers, thousands of client dials) that
+    race killed whole module fixtures ~1 run in 3.
+
+    Instead: walk a range strictly BELOW the ephemeral floor
+    (20000–22699 — chosen so the +10000 gRPC sibling convention stays
+    below it too), per-process offset against concurrent suites, and
+    verify BOTH the port and its +10000 sibling are bindable before
+    handing it out (servers bind both; the old probe never checked
+    the sibling)."""
+    import os
     import socket
 
+    with _port_lock:
+        if _port_state["next"] is None:
+            _port_state["next"] = 20000 + (os.getpid() % 27) * 100
+        for _ in range(2700):
+            p = _port_state["next"]
+            _port_state["next"] = p + 1 if p + 1 < 22700 else 20000
+            try:
+                s1 = socket.socket()
+                s1.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s1.bind(("127.0.0.1", p))
+                try:
+                    s2 = socket.socket()
+                    s2.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+                    )
+                    try:
+                        s2.bind(("127.0.0.1", p + 10000))
+                    finally:
+                        s2.close()
+                finally:
+                    s1.close()
+                return p
+            except OSError:
+                continue
+    # range exhausted (never expected): the old ephemeral probe is
+    # still better than failing outright
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]

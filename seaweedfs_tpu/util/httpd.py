@@ -649,6 +649,21 @@ def serve_connection(
                         trace_label, command, str(h._trace_status)
                     ).inc()
 
+            # health plane (docs/HEALTH.md): 5xx responses feed the
+            # heartbeat request_errors counter the master's per-node
+            # error EWMA scores — a reachable-but-failing node goes
+            # suspect without anyone staring at logs. 503 (admission /
+            # lame-duck shed) and 504 (expired client deadline) are
+            # CLIENT-attributable by design and excluded: one client
+            # over its token bucket or stamping stale budgets must not
+            # be able to drive a healthy node suspect cluster-wide.
+            if (
+                load_tracker is not None
+                and h._trace_status >= 500
+                and h._trace_status not in (503, 504)
+            ):
+                load_tracker.note_error()
+
             if chunked:
                 # can't know from here whether the terminal chunk was
                 # consumed; never reuse the connection

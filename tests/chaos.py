@@ -10,7 +10,10 @@ below the syscall/wire level.
 
 from __future__ import annotations
 
+import os
 import socket
+import subprocess
+import sys
 import threading
 import time
 
@@ -19,10 +22,7 @@ from seaweedfs_tpu.client import operation as op
 from seaweedfs_tpu.client import retry as retry_mod
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from seaweedfs_tpu.util.availability import free_port  # noqa: E402 — collision-hardened allocator
 
 
 def wait_for(cond, timeout=45.0, interval=0.05) -> bool:
@@ -32,6 +32,45 @@ def wait_for(cond, timeout=45.0, interval=0.05) -> bool:
             return True
         time.sleep(interval)
     return False
+
+
+def spawn_cli(*args, env_extra: dict | None = None):
+    """A real `python -m seaweedfs_tpu ...` subprocess (cpu-forced
+    jax) — the SIGSTOP/SIGKILL scenarios need a separate PROCESS, and
+    `env_extra` selects the serving path (WEED_NATIVE_SERVE) per arm."""
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", WEED_EC_CODEC="cpu",
+        **(env_extra or {}),
+    )
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            "import jax; jax.config.update('jax_platforms', 'cpu');"
+            "from seaweedfs_tpu.__main__ import main; main()",
+            *args,
+        ],
+        env=env,
+        cwd="/root/repo",
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT,
+    )
+
+
+def reap_procs(procs) -> None:
+    """SIGCONT (for SIGSTOP scenarios) then kill+wait each process."""
+    import signal
+
+    for p in procs:
+        try:
+            p.send_signal(signal.SIGCONT)
+        except OSError:
+            pass
+        try:
+            p.kill()
+            p.wait(timeout=10)
+        except OSError:
+            pass
 
 
 def start_ha_masters(tmp_factory, n: int = 3, **kw):

@@ -15,10 +15,7 @@ from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.volume import Volume
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from seaweedfs_tpu.util.availability import free_port  # noqa: E402 — collision-hardened allocator
 
 
 def spawn_cli(*args):
@@ -313,10 +310,7 @@ class TestServerDaemon:
         import time
         import urllib.request
 
-        def free_port():
-            with socket.socket() as s:
-                s.bind(("127.0.0.1", 0))
-                return s.getsockname()[1]
+        from seaweedfs_tpu.util.availability import free_port
 
         mport, vport, fport = free_port(), free_port(), free_port()
         env = dict(os.environ)

@@ -19,10 +19,7 @@ from seaweedfs_tpu.server.master_server import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from seaweedfs_tpu.util.availability import free_port  # noqa: E402 — collision-hardened allocator
 
 
 def http_get(url: str):

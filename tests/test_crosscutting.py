@@ -223,10 +223,7 @@ class TestSecuredCluster:
         from seaweedfs_tpu.server.master_server import MasterServer
         from seaweedfs_tpu.server.volume_server import VolumeServer
 
-        def free_port():
-            with socket.socket() as s:
-                s.bind(("127.0.0.1", 0))
-                return s.getsockname()[1]
+        from seaweedfs_tpu.util.availability import free_port
 
         guard = Guard(signing_key="cluster-secret", expires_after_sec=30)
         mport = free_port()
@@ -341,10 +338,7 @@ class TestMetricsPushPlumbing:
         from seaweedfs_tpu.server.master_server import MasterServer
         from seaweedfs_tpu.server.volume_server import VolumeServer
 
-        def free_port():
-            with socket.socket() as s:
-                s.bind(("127.0.0.1", 0))
-                return s.getsockname()[1]
+        from seaweedfs_tpu.util.availability import free_port
 
         received = []
 

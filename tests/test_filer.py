@@ -842,10 +842,7 @@ def test_empty_file_get_does_not_crash(tmp_path_factory):
     from seaweedfs_tpu.server.master_server import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
 
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
+    from seaweedfs_tpu.util.availability import free_port
 
     master = MasterServer(port=free_port(), volume_size_limit_mb=64)
     master.start()

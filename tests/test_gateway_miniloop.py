@@ -24,10 +24,7 @@ from seaweedfs_tpu.s3api.s3api_server import S3ApiServer
 from seaweedfs_tpu.webdav.webdav_server import WebDavServer
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from seaweedfs_tpu.util.availability import free_port  # noqa: E402 — collision-hardened allocator
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,994 @@
+"""weedguard: gray-failure detection, health-scored placement, hinted
+handoff, lame-duck degradation, and drain (docs/HEALTH.md).
+
+Units for the phi-accrual detector, the node state machine (with
+hysteresis and the WEED_HEALTH=0 kill switch), the disk watchdog, the
+health-filtered pick_for_write, and the hint spool; weedcrash
+enumerator sweeps of the hint publish (write→ack→crash→replay must
+never lose an acked write, and the pre-durable ordering must be
+DETECTED); and live-cluster acceptance: a write succeeds during a
+single-replica outage via hinted handoff and replays byte-identical
+after heal, node.drain empties a server with repair-queue evidence,
+and WEED_HEALTH=0 restores the pre-health all-or-error write contract.
+"""
+
+from __future__ import annotations
+
+import errno
+import io
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.cluster import health as health_mod
+from seaweedfs_tpu.cluster.health import (
+    DiskWatchdog,
+    HealthPlane,
+    NodeHealth,
+    PhiAccrual,
+)
+from seaweedfs_tpu.server.handoff import HintStore
+from tests import chaos as wiring
+from tests.chaos import free_port, wait_for
+
+
+# ---------------------------------------------------------------------------
+# phi-accrual detector
+
+
+class TestPhiAccrual:
+    def _warm(self, interval=0.2, n=20, start=100.0):
+        p = PhiAccrual()
+        t = start
+        for _ in range(n):
+            p.observe(t)
+            t += interval
+        return p, t
+
+    def test_no_history_is_zero(self):
+        p = PhiAccrual()
+        assert p.phi(100.0) == 0.0
+        p.observe(100.0)
+        assert p.phi(105.0) == 0.0  # < MIN_SAMPLES intervals
+
+    def test_on_cadence_is_low(self):
+        p, t = self._warm()
+        assert p.phi(t + 0.05) < 2.0
+
+    def test_silence_grows_suspicion(self):
+        p, t = self._warm(interval=0.2)
+        # within ~2.5 missed beats the phi crosses the default
+        # threshold (the ≤3-heartbeat-interval detection bound)
+        assert p.phi(t + 3 * 0.2) > health_mod.phi_threshold()
+        # and keeps growing without bound (the erfc-underflow branch)
+        assert p.phi(t + 10 * 0.2) > p.phi(t + 3 * 0.2) > 0
+
+    def test_outage_resume_interval_not_recorded(self):
+        """The beat ENDING a flagged silence (SIGCONT, rejoin after a
+        crash) must not enter the cadence ring: recording the outage
+        length would raise the 2×-worst-gap gate to outage scale and
+        blind the NEXT gray failure for a whole ring."""
+        p, t = self._warm(interval=0.2)
+        gate_before = max(p._intervals)
+        # a 30 s outage ends with one beat
+        p.observe(t + 30.0)
+        assert max(p._intervals) == gate_before  # outage not cadence
+        # detection sensitivity survives: silence right after the
+        # resume still reads suspicious on the learned 0.2 s cadence
+        assert p.phi(t + 30.0 + 1.0) > health_mod.phi_threshold()
+
+    def test_persistent_cadence_change_relearned(self):
+        """A legitimately slower cadence (operator restarted with a
+        bigger -heartbeat) must not read suspect forever: after a few
+        skipped intervals the ring re-learns."""
+        p, t = self._warm(interval=0.2)
+        tt = t
+        for _ in range(30):  # new cadence: 2 s beats
+            tt += 2.0
+            p.observe(tt)
+        # the ring absorbed the new cadence; on-cadence silence is calm
+        assert p.phi(tt + 1.0) < health_mod.phi_threshold()
+
+    def test_jittery_cadence_needs_more_silence(self):
+        # irregular beats widen the learned std: the same absolute
+        # silence reads less suspicious than under a metronome
+        steady, t1 = self._warm(interval=0.2)
+        jittery = PhiAccrual()
+        t = 100.0
+        import random
+
+        rng = random.Random(7)
+        for _ in range(20):
+            jittery.observe(t)
+            t += 0.1 + rng.random() * 0.2
+        assert jittery.phi(t + 0.6) < steady.phi(t1 + 0.6)
+
+
+# ---------------------------------------------------------------------------
+# node state machine
+
+
+class TestNodeState:
+    def _beaten(self, interval=0.2, n=20, now=1000.0):
+        rec = NodeHealth("n1")
+        t = now - n * interval
+        for _ in range(n):
+            rec.observe(t)
+            t += interval
+        return rec, t
+
+    def test_healthy_on_cadence(self):
+        rec, t = self._beaten()
+        assert rec.state(t + 0.05) == health_mod.HEALTHY
+        assert rec.assignable(t + 0.05)
+        assert not rec.read_demoted(t + 0.05)
+
+    def test_silence_goes_suspect_and_holds(self):
+        rec, t = self._beaten()
+        assert rec.state(t + 1.0) == health_mod.SUSPECT
+        assert not rec.assignable(t + 1.0)
+        assert rec.read_demoted(t + 1.0)
+        # hysteresis: one clean beat right after does NOT flip back —
+        # the suspicion holds for recover_s
+        rec.observe(t + 1.0)
+        assert rec.state(t + 1.05) == health_mod.SUSPECT
+        # ...but after the hold-down with clean signals it recovers
+        tt = t + 1.0
+        for _ in range(40):
+            tt += 0.2
+            rec.observe(tt)
+        assert rec.state(tt + 0.05) == health_mod.HEALTHY
+
+    def test_error_ewma_goes_suspect(self):
+        rec, t = self._beaten()
+        # a burst of IO errors between two beats spikes the EWMA
+        rec.observe(t + 0.2, io_errors=50, request_errors=0)
+        assert rec.err_ewma > health_mod.err_ewma_threshold()
+        assert rec.state(t + 0.25) == health_mod.SUSPECT
+        assert "err_ewma" in ";".join(rec.suspicion_reasons(t + 0.25))
+
+    def test_counter_reset_not_an_error_burst(self):
+        rec, t = self._beaten()
+        rec.observe(t + 0.2, io_errors=50)
+        ewma = rec.err_ewma
+        # the node restarted: counters reset to 0 — must not read as
+        # another burst (or as negative)
+        rec.observe(t + 0.4, io_errors=0)
+        assert rec.err_ewma < ewma
+
+    def test_lame_duck_and_draining_unassignable_but_not_demoted(self):
+        rec, t = self._beaten()
+        rec.observe(t + 0.2, lame_duck=True)
+        assert not rec.assignable(t + 0.25)
+        # reads keep flowing to a lame duck — only suspicion demotes
+        assert not rec.read_demoted(t + 0.25)
+        rec.observe(t + 0.4, lame_duck=False, draining=True)
+        assert not rec.assignable(t + 0.45)
+
+    def test_kill_switch_restores_pre_health(self, monkeypatch):
+        rec, t = self._beaten()
+        assert rec.state(t + 5.0) == health_mod.SUSPECT
+        rec.lame_duck = True
+        monkeypatch.setenv("WEED_HEALTH", "0")
+        assert rec.state(t + 5.0) == health_mod.HEALTHY
+        assert rec.assignable(t + 5.0)
+        assert not rec.read_demoted(t + 5.0)
+
+    def test_dead_beats_everything(self):
+        rec, t = self._beaten()
+        rec.dead = True
+        assert rec.state(t) == health_mod.DEAD
+        assert not rec.assignable(t)
+
+
+class TestHealthPlane:
+    def test_order_nodes_demotes_suspects(self):
+        hp = HealthPlane()
+
+        class DN:
+            def __init__(self, url):
+                self.url = url
+
+        now = time.monotonic()
+        for url in ("a:1", "b:2"):
+            rec = hp._get(url)
+            t = now - 8.0
+            for _ in range(20):
+                rec.observe(t)
+                t += 0.2
+        # b stays silent ~4s past its 0.2s cadence; a beats up to now
+        hp._get("a:1").observe(now)
+        nodes = [DN("b:2"), DN("a:1")]
+        ordered = hp.order_nodes(nodes)
+        assert [d.url for d in ordered] == ["a:1", "b:2"]
+        assert hp.suspect("b:2") and not hp.suspect("a:1")
+
+    def test_unknown_nodes_are_healthy(self):
+        hp = HealthPlane()
+        assert hp.state("never:seen") == health_mod.HEALTHY
+        assert hp.assignable("never:seen")
+
+    def test_drain_registry(self):
+        hp = HealthPlane()
+        hp.request_drain("x:1")
+        assert hp.draining_urls() == {"x:1"}
+        hp.request_drain("x:1", stop=True)
+        assert hp.draining_urls() == set()
+
+
+# ---------------------------------------------------------------------------
+# disk watchdog
+
+
+class TestDiskWatchdog:
+    def test_disk_class_strikes_trip_lame_duck(self):
+        wd = DiskWatchdog(strikes=3, window_s=60)
+        tripped = []
+        wd.on_trip = lambda: tripped.append(1)
+        assert wd.note_io_error(OSError(errno.EIO, "eio"))
+        assert not wd.lame_duck
+        assert wd.note_io_error(OSError(errno.ENOSPC, "enospc"))
+        assert wd.note_io_error(OSError(errno.EIO, "eio"))
+        assert wd.lame_duck and tripped == [1]
+        assert wd.io_errors == 3
+
+    def test_non_disk_errors_ignored(self):
+        from seaweedfs_tpu.util.deadline import DeadlineExceeded
+
+        wd = DiskWatchdog(strikes=1)
+        assert not wd.note_io_error(ConnectionResetError("peer"))
+        assert not wd.note_io_error(DeadlineExceeded("budget"))
+        assert not wd.note_io_error(OSError(errno.ENOENT, "missing"))
+        assert not wd.lame_duck and wd.io_errors == 0
+
+    def test_window_decay(self):
+        wd = DiskWatchdog(strikes=3, window_s=0.05)
+        wd.note_io_error(OSError(errno.EIO, "x"))
+        wd.note_io_error(OSError(errno.EIO, "x"))
+        time.sleep(0.08)  # the first two strikes age out
+        wd.note_io_error(OSError(errno.EIO, "x"))
+        assert not wd.lame_duck
+
+
+# ---------------------------------------------------------------------------
+# health-filtered pick_for_write
+
+
+class TestHealthPick:
+    def _layout(self):
+        from seaweedfs_tpu.storage.store import VolumeInfo
+        from seaweedfs_tpu.topology.node import DataNode
+        from seaweedfs_tpu.topology.volume_layout import VolumeLayout
+
+        lay = VolumeLayout("000", "", 1 << 30)
+        nodes = {}
+        for vid, url in ((1, "a:1"), (2, "b:2"), (3, "c:3")):
+            dn = nodes[url] = DataNode(url)
+            dn.ip, dn.port = url.split(":")[0], int(url.split(":")[1])
+            lay.register_volume(
+                VolumeInfo(
+                    id=vid, size=0, collection="", file_count=0,
+                    delete_count=0, deleted_byte_count=0, read_only=False,
+                    replica_placement=0, version=3, ttl=0,
+                ),
+                dn,
+            )
+        return lay, nodes
+
+    class _FakeHealth:
+        def __init__(self, bad):
+            self.bad = set(bad)
+
+        def assignable(self, url):
+            return url not in self.bad
+
+    def test_suspect_replica_volumes_excluded(self):
+        lay, nodes = self._layout()
+        fake = self._FakeHealth({"b:2"})
+        picked = {
+            lay.pick_for_write(policy="random", health=fake)[0]
+            for _ in range(50)
+        }
+        assert picked == {1, 3}
+        picked_p2c = {
+            lay.pick_for_write(policy="p2c", health=fake)[0]
+            for _ in range(50)
+        }
+        assert picked_p2c == {1, 3}
+
+    def test_all_tainted_falls_back_to_full_pool(self):
+        lay, _ = self._layout()
+        fake = self._FakeHealth({"a:1", "b:2", "c:3"})
+        # availability beats precision: every volume touches a suspect,
+        # so the full writable set comes back rather than an error
+        picked = {
+            lay.pick_for_write(policy="random", health=fake)[0]
+            for _ in range(60)
+        }
+        assert picked == {1, 2, 3}
+
+    def test_health_none_is_pre_health(self):
+        lay, _ = self._layout()
+        picked = {
+            lay.pick_for_write(policy="random", health=None)[0]
+            for _ in range(60)
+        }
+        assert picked == {1, 2, 3}
+
+
+# ---------------------------------------------------------------------------
+# hint spool units
+
+
+class TestHintStore:
+    def test_roundtrip_and_pending(self, tmp_path):
+        hs = HintStore(str(tmp_path / "spool"))
+        body = b"\x00binary body\xff" * 100
+        assert hs.write_hint(
+            "10.0.0.9:8080", "POST", "/3,aabb?type=replicate", body,
+            {"Content-Type": "image/png", "Seaweed-k": "v"},
+        )
+        assert hs.pending() == {"10.0.0.9:8080": 1}
+        (target, tdir), = hs.targets()
+        assert target == "10.0.0.9:8080"
+        (name,) = [
+            e.name for e in os.scandir(tdir) if e.name.endswith(".hint")
+        ]
+        head, got = hs.read_hint(os.path.join(tdir, name))
+        assert got == body
+        assert head["method"] == "POST"
+        assert head["path"] == "/3,aabb?type=replicate"
+        assert head["headers"]["Content-Type"] == "image/png"
+        hs.remove(os.path.join(tdir, name))
+        assert hs.pending() == {}
+
+    def test_replay_order_is_arrival_order(self, tmp_path):
+        hs = HintStore(str(tmp_path / "spool"))
+        for i in range(5):
+            assert hs.write_hint(
+                "t:1", "POST", f"/1,{i:04x}?type=replicate",
+                b"x%d" % i, {},
+            )
+        (_, tdir), = hs.targets()
+        names = sorted(
+            e.name for e in os.scandir(tdir) if e.name.endswith(".hint")
+        )
+        paths = [hs.read_hint(os.path.join(tdir, n))[0]["path"] for n in names]
+        assert paths == [f"/1,{i:04x}?type=replicate" for i in range(5)]
+
+    def test_spool_cap_refuses(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("WEED_HANDOFF_MAX_MB", "0")
+        hs = HintStore(str(tmp_path / "spool"))
+        assert not hs.write_hint("t:1", "POST", "/1,aa", b"x" * 10, {})
+        assert hs.pending() == {}
+
+    def test_torn_hint_reads_none(self, tmp_path):
+        hs = HintStore(str(tmp_path / "spool"))
+        tdir = tmp_path / "spool" / "t_1"
+        tdir.mkdir(parents=True)
+        (tdir / "000-000001.hint").write_bytes(b"\x00\x00\x01")
+        assert hs.read_hint(str(tdir / "000-000001.hint")) is None
+
+    def test_replay_resigns_on_signed_clusters(self, tmp_path):
+        """A hint's spooled CLIENT JWT outlives its validity during a
+        long outage; the agent replaces it with a server-signed token
+        at replay time (the delete-cascade convention) so the spool
+        can't wedge on 401s."""
+        import socket
+        import threading
+
+        from seaweedfs_tpu.server.handoff import HandoffAgent
+
+        seen = {}
+        lst = socket.socket()
+        lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(4)
+
+        def serve():
+            c, _ = lst.accept()
+            data = b""
+            while b"\r\n\r\n" not in data:
+                data += c.recv(65536)
+            head = data.split(b"\r\n\r\n", 1)[0].decode("latin-1")
+            for line in head.split("\r\n")[1:]:
+                k, _, v = line.partition(":")
+                seen[k.strip().lower()] = v.strip()
+            n = int(seen.get("content-length", "0"))
+            body = data.split(b"\r\n\r\n", 1)[1]
+            while len(body) < n:
+                body += c.recv(65536)
+            c.sendall(b"HTTP/1.1 201 Created\r\nContent-Length: 0\r\n\r\n")
+            c.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        target = "127.0.0.1:%d" % lst.getsockname()[1]
+        hs = HintStore(str(tmp_path / "spool"))
+        assert hs.write_hint(
+            target, "POST", "/5,00ff?type=replicate", b"signed body",
+            {"Authorization": "BEARER stale-client-token"},
+        )
+        agent = HandoffAgent(
+            hs, sign=lambda fid: f"BEARER fresh-for-{fid}"
+        )
+        assert agent.run_once() == 1
+        t.join(timeout=5)
+        assert seen.get("authorization") == "BEARER fresh-for-5,00ff"
+        assert hs.pending() == {}
+        lst.close()
+
+    def test_live_target_4xx_drops_instead_of_wedging(self, tmp_path):
+        """A target that is UP but refuses a hint with a 4xx (the
+        volume moved off it, auth revoked) must not block the queue:
+        the rejected hint is dropped loudly and later hints for the
+        same target still deliver."""
+        import socket
+        import threading
+
+        from seaweedfs_tpu.server.handoff import HandoffAgent
+
+        statuses = [b"404 Not Found", b"201 Created"]
+        lst = socket.socket()
+        lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(4)
+
+        def serve():
+            for st in statuses:
+                c, _ = lst.accept()
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    data += c.recv(65536)
+                head = data.split(b"\r\n\r\n", 1)[0].decode("latin-1")
+                n = 0
+                for line in head.split("\r\n")[1:]:
+                    k, _, v = line.partition(":")
+                    if k.strip().lower() == "content-length":
+                        n = int(v.strip())
+                body = data.split(b"\r\n\r\n", 1)[1]
+                while len(body) < n:
+                    body += c.recv(65536)
+                c.sendall(
+                    b"HTTP/1.1 " + st + b"\r\nContent-Length: 0\r\n\r\n"
+                )
+                c.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        target = "127.0.0.1:%d" % lst.getsockname()[1]
+        hs = HintStore(str(tmp_path / "spool"))
+        assert hs.write_hint(target, "POST", "/9,dead?type=replicate",
+                             b"moved away", {})
+        assert hs.write_hint(target, "POST", "/9,beef?type=replicate",
+                             b"still deliverable", {})
+        agent = HandoffAgent(hs)
+        # one pass: hint 1 rejected (dropped), hint 2 delivered
+        assert agent.run_once() == 1
+        t.join(timeout=5)
+        assert hs.pending() == {}
+        lst.close()
+
+    def test_handoff_disabled_by_kill_switches(self, monkeypatch):
+        from seaweedfs_tpu.server import handoff
+
+        assert handoff.handoff_enabled()
+        monkeypatch.setenv("WEED_HANDOFF", "0")
+        assert not handoff.handoff_enabled()
+        monkeypatch.delenv("WEED_HANDOFF")
+        monkeypatch.setenv("WEED_HEALTH", "0")
+        assert not handoff.handoff_enabled()
+
+
+# ---------------------------------------------------------------------------
+# weedcrash enumerator sweeps of the hint lifecycle
+
+
+class TestHintCrashSweeps:
+    def test_durable_hint_publish_clean(self):
+        from seaweedfs_tpu.analysis import crash
+
+        rep = crash.run_handoff_hint(budget=96)
+        assert rep.states_tested >= 12
+        assert rep.violations == []
+
+    def test_unsynced_hint_publish_detected(self):
+        """Regression proof the durable.publish is load-bearing: the
+        same hint written with a bare write+rename must yield
+        rename-before-data states with a torn hint."""
+        from seaweedfs_tpu.analysis import crash
+
+        rep = crash.run_handoff_hint(budget=96, durable=False)
+        assert rep.violations, (
+            "the unsynced hint publish should be catchable — either "
+            "the enumerator went blind or HintStore stopped writing "
+            "through the recorded os layer"
+        )
+
+    def test_delivery_unlink_sticks(self):
+        from seaweedfs_tpu.analysis import crash
+
+        rep = crash.run_handoff_delivery(budget=64)
+        assert rep.violations == []
+
+
+# ---------------------------------------------------------------------------
+# live-cluster acceptance
+
+
+def _http(url, data=None, method="GET", timeout=10, headers=None):
+    req = urllib.request.Request(url, data=data, method=method)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+class TestHintedHandoffE2E:
+    def test_write_survives_replica_outage_and_replays(
+        self, tmp_path_factory
+    ):
+        """Acceptance: with one replica refusing connections, a
+        replicated write still succeeds (hint spooled durably on the
+        primary); after heal the handoff agent replays it and the
+        replica serves the exact bytes."""
+        from seaweedfs_tpu.server.master_server import MasterServer
+
+        master = MasterServer(
+            port=free_port(), volume_size_limit_mb=64, vacuum_interval=0
+        )
+        master.start()
+        maddr = f"127.0.0.1:{master.port}"
+        vs_a = wiring.start_volume_server(
+            tmp_path_factory, maddr, "ha", rack="r0"
+        )
+        vs_b, pair = wiring.proxied_volume_server(
+            tmp_path_factory, maddr, "hb", rack="r1"
+        )
+        try:
+            assert wait_for(lambda: len(master.topology.data_nodes()) == 2)
+            # a healthy replicated write first, so the volume exists
+            # with both replicas registered
+            a = json.loads(
+                _http(f"http://{maddr}/dir/assign?replication=010")[1]
+            )
+            assert not a.get("error"), a
+            payload0 = b"healthy replicated write " * 20
+            _http(f"http://{a['url']}/{a['fid']}", data=payload0,
+                  method="POST")
+            vid = a["fid"].split(",")[0]
+
+            # now the replica "host" goes down: connections refused
+            pair.http.refuse = True
+            pair.grpc.refuse = True
+
+            a2 = json.loads(
+                _http(f"http://{maddr}/dir/assign?replication=010")[1]
+            )
+            assert not a2.get("error"), a2
+            payload = b"write during outage \x00\xfe" * 64
+            # drive the PRIMARY side explicitly (vs_a) so the fan-out
+            # toward the dead replica is what the hint absorbs
+            t0 = time.time()
+            status, body = _http(
+                f"http://127.0.0.1:{vs_a.port}/{a2['fid']}",
+                data=payload, method="POST", timeout=30,
+            )
+            assert status == 201, body
+            # the ack required a durable hint, not a replica round-trip
+            assert vs_a.hints.pending(), "no hint spooled for the outage"
+            assert time.time() - t0 < 15
+
+            # read-back from the healthy primary: the acked write lives
+            status, got = _http(f"http://127.0.0.1:{vs_a.port}/{a2['fid']}")
+            assert status == 200 and got == payload
+
+            # heal → the agent replays → the REPLICA serves the bytes
+            pair.http.refuse = False
+            pair.grpc.refuse = False
+            assert wait_for(lambda: not vs_a.hints.pending(), 20), (
+                "hint never replayed after heal"
+            )
+
+            def replica_has_it():
+                try:
+                    s, g = _http(
+                        f"http://127.0.0.1:{vs_b.port}/{a2['fid']}",
+                        timeout=5,
+                    )
+                    return s == 200 and g == payload
+                except (OSError, urllib.error.HTTPError):
+                    return False
+
+            assert wait_for(replica_has_it, 20), (
+                "replica not byte-identical after handoff replay"
+            )
+            assert vs_a.handoff.replayed >= 1
+            assert int(vid) >= 1  # vid parsed (the first write landed)
+        finally:
+            pair.stop()
+            vs_b.stop()
+            vs_a.stop()
+            master.stop()
+
+    def test_health_off_restores_all_or_error(
+        self, tmp_path_factory, monkeypatch
+    ):
+        """WEED_HEALTH=0 regression: the same outage fails the write
+        like pre-health code did (no hint, 500 to the client)."""
+        monkeypatch.setenv("WEED_HEALTH", "0")
+        from seaweedfs_tpu.server.master_server import MasterServer
+
+        master = MasterServer(
+            port=free_port(), volume_size_limit_mb=64, vacuum_interval=0
+        )
+        master.start()
+        maddr = f"127.0.0.1:{master.port}"
+        vs_a = wiring.start_volume_server(
+            tmp_path_factory, maddr, "hka", rack="r0"
+        )
+        vs_b, pair = wiring.proxied_volume_server(
+            tmp_path_factory, maddr, "hkb", rack="r1"
+        )
+        try:
+            assert wait_for(lambda: len(master.topology.data_nodes()) == 2)
+            a = json.loads(
+                _http(f"http://{maddr}/dir/assign?replication=010")[1]
+            )
+            assert not a.get("error"), a
+            _http(f"http://{a['url']}/{a['fid']}", data=b"seed",
+                  method="POST")
+            pair.http.refuse = True
+            pair.grpc.refuse = True
+            a2 = json.loads(
+                _http(f"http://{maddr}/dir/assign?replication=010")[1]
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _http(
+                    f"http://127.0.0.1:{vs_a.port}/{a2['fid']}",
+                    data=b"must fail", method="POST", timeout=30,
+                )
+            assert ei.value.code == 500
+            assert not vs_a.hints.pending()
+        finally:
+            pair.stop()
+            vs_b.stop()
+            vs_a.stop()
+            master.stop()
+
+
+class TestLameDuckE2E:
+    def test_lame_duck_sheds_writes_serves_reads(self, tmp_path_factory):
+        from seaweedfs_tpu.server.master_server import MasterServer
+
+        master = MasterServer(
+            port=free_port(), volume_size_limit_mb=64, vacuum_interval=0
+        )
+        master.start()
+        maddr = f"127.0.0.1:{master.port}"
+        vs = wiring.start_volume_server(tmp_path_factory, maddr, "ld")
+        try:
+            assert wait_for(lambda: len(master.topology.data_nodes()) == 1)
+            a = json.loads(_http(f"http://{maddr}/dir/assign")[1])
+            _http(f"http://{a['url']}/{a['fid']}", data=b"pre-duck",
+                  method="POST")
+            # three EIO strikes flip the watchdog
+            for _ in range(3):
+                vs.watchdog.note_io_error(OSError(errno.EIO, "dying disk"))
+            assert vs.watchdog.lame_duck
+            # writes shed with 503 + Retry-After...
+            a2 = json.loads(_http(f"http://{maddr}/dir/assign")[1])
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _http(f"http://{vs.host}:{vs.port}/{a2['fid']}",
+                      data=b"x", method="POST")
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After")
+            # ...reads keep flowing
+            status, got = _http(f"http://{a['url']}/{a['fid']}")
+            assert status == 200 and got == b"pre-duck"
+            # the flag rides the heartbeat; the master stops assigning
+            assert wait_for(
+                lambda: not master.health.assignable(f"{vs.host}:{vs.port}"),
+                10,
+            )
+            payload = json.loads(_http(f"http://{maddr}/cluster/health")[1])
+            row = payload["NodeHealth"]["Nodes"][f"{vs.host}:{vs.port}"]
+            assert row["LameDuck"] is True
+            # /status surfaces it locally too
+            st = json.loads(_http(f"http://{vs.host}:{vs.port}/status")[1])
+            assert st["LameDuck"] is True and st["IoErrors"] >= 3
+        finally:
+            vs.stop()
+            master.stop()
+
+
+class TestLookupDemotionE2E:
+    def test_suspect_marked_and_ordered_last(self, tmp_path_factory):
+        """The master's lookup responses (HTTP + gRPC) order suspect
+        replicas last and carry the `suspect` mark — the cluster-wide
+        demotion clients and the eager-hedge lever read."""
+        from seaweedfs_tpu.client import operation as op
+        from seaweedfs_tpu.server.master_server import MasterServer
+
+        master = MasterServer(
+            port=free_port(), volume_size_limit_mb=64, vacuum_interval=0
+        )
+        master.start()
+        maddr = f"127.0.0.1:{master.port}"
+        vs_a = wiring.start_volume_server(
+            tmp_path_factory, maddr, "lka", rack="r0"
+        )
+        vs_b = wiring.start_volume_server(
+            tmp_path_factory, maddr, "lkb", rack="r1"
+        )
+        try:
+            assert wait_for(lambda: len(master.topology.data_nodes()) == 2)
+            a = json.loads(
+                _http(f"http://{maddr}/dir/assign?replication=010")[1]
+            )
+            assert not a.get("error"), a
+            _http(f"http://{a['url']}/{a['fid']}", data=b"x", method="POST")
+            vid = a["fid"].split(",")[0]
+            b_url = f"{vs_b.host}:{vs_b.port}"
+            # force suspicion on B (the hysteresis hold is the lever
+            # the state machine itself exposes)
+            master.health._get(b_url)._suspect_until = (
+                time.monotonic() + 60
+            )
+            lk = json.loads(
+                _http(f"http://{maddr}/dir/lookup?volumeId={vid}")[1]
+            )
+            assert [l["suspect"] for l in lk["locations"]] == [False, True]
+            assert lk["locations"][-1]["url"] == b_url
+            # gRPC carries the same verdict (what filer/stream reads)
+            op._lookup_cache.clear()
+            res = op.lookup(maddr, vid)
+            assert [l["suspect"] for l in res.locations] == [False, True]
+            # ...and the suspect-bearing result is cached SHORT, so the
+            # verdict refreshes on heartbeat timescales
+            key = (maddr, vid)
+            entry = op._lookup_cache[key]
+            assert entry.expires - time.time() < 30
+        finally:
+            vs_b.stop()
+            vs_a.stop()
+            master.stop()
+
+
+class TestDrainE2E:
+    def test_node_drain_empties_server_with_evidence(
+        self, tmp_path_factory
+    ):
+        """Acceptance: node.drain marks the node, the RepairScheduler
+        moves its volumes off, the shell prints repair-queue evidence,
+        and every blob stays readable."""
+        from seaweedfs_tpu.server.master_server import MasterServer
+        from seaweedfs_tpu.shell.command_env import CommandEnv
+        from seaweedfs_tpu.shell.commands import run_command
+
+        master = MasterServer(
+            port=free_port(), volume_size_limit_mb=64, vacuum_interval=0,
+            repair_interval=0.3, repair_grace=0.1,
+        )
+        master.repair.cooldown = 1.0
+        master.start()
+        maddr = f"127.0.0.1:{master.port}"
+        vs_a = wiring.start_volume_server(tmp_path_factory, maddr, "da")
+        try:
+            assert wait_for(lambda: len(master.topology.data_nodes()) == 1)
+            blobs = {}
+            for i in range(6):
+                a = json.loads(_http(f"http://{maddr}/dir/assign")[1])
+                assert not a.get("error"), a
+                payload = f"drain-me-{i:03d} ".encode() * 30
+                _http(f"http://{a['url']}/{a['fid']}", data=payload,
+                      method="POST")
+                blobs[a["fid"]] = payload
+            a_url = f"{vs_a.host}:{vs_a.port}"
+            dn_a = next(
+                d for d in master.topology.data_nodes() if d.url == a_url
+            )
+            assert dn_a.volumes, "no volumes landed on A"
+
+            # B joins as the drain target
+            vs_b = wiring.start_volume_server(tmp_path_factory, maddr, "db")
+            try:
+                assert wait_for(
+                    lambda: len(master.topology.data_nodes()) == 2
+                )
+                env = CommandEnv([maddr])
+                out = io.StringIO()
+                run_command(env, f"node.drain -node {a_url} -wait 60", out)
+                text = out.getvalue()
+                assert "draining" in text
+                assert "moved: drain_move" in text, text
+                assert "is empty" in text, text
+                # the node really is empty (master view)
+                assert not dn_a.volumes
+                # assignment no longer targets A
+                for _ in range(5):
+                    a = json.loads(_http(f"http://{maddr}/dir/assign")[1])
+                    assert a["url"] != a_url
+                # every blob survived the move, byte-identical (the
+                # layout learns the moved locations from the nodes'
+                # next beats — poll briefly)
+                def urls_of(vid):
+                    lk = json.loads(
+                        _http(
+                            f"http://{maddr}/dir/lookup?volumeId={vid}"
+                        )[1]
+                    )
+                    return [l["url"] for l in lk["locations"]]
+
+                for fid, want in blobs.items():
+                    vid = fid.split(",")[0]
+                    assert wait_for(
+                        lambda: a_url not in urls_of(vid), 15
+                    ), (fid, urls_of(vid))
+                    status, got = _http(f"http://{urls_of(vid)[0]}/{fid}")
+                    assert status == 200 and got == want, fid
+                # repair-queue evidence exists on the master surface too
+                rq = json.loads(_http(f"http://{maddr}/repair/queue")[1])
+                assert any(
+                    h["Kind"] == "drain_move" for h in rq.get("History", [])
+                )
+            finally:
+                vs_b.stop()
+        finally:
+            vs_a.stop()
+            master.stop()
+
+
+class TestDrainReplicatedE2E:
+    def test_surplus_replica_dropped_blocked_without_capacity(
+        self, tmp_path_factory
+    ):
+        """Replicated volumes under drain: a copy whose placement is
+        already satisfied by OTHER holders is dropped (that IS the
+        move); one still needed blocks loudly instead of breaking
+        placement."""
+        from seaweedfs_tpu.server.master_server import MasterServer
+        from seaweedfs_tpu.shell.command_env import CommandEnv
+        from seaweedfs_tpu.shell.commands import run_command
+
+        master = MasterServer(
+            port=free_port(), volume_size_limit_mb=64, vacuum_interval=0,
+            repair_interval=0.3, repair_grace=0.1,
+        )
+        master.repair.cooldown = 1.0
+        master.repair.backoff_base = 0.5
+        master.start()
+        maddr = f"127.0.0.1:{master.port}"
+        vs_a = wiring.start_volume_server(tmp_path_factory, maddr, "ra")
+        vs_b = wiring.start_volume_server(tmp_path_factory, maddr, "rb")
+        try:
+            assert wait_for(lambda: len(master.topology.data_nodes()) == 2)
+            a = json.loads(_http(f"http://{maddr}/dir/assign")[1])
+            assert not a.get("error"), a
+            payload = b"surplus copy " * 30
+            _http(f"http://{a['url']}/{a['fid']}", data=payload,
+                  method="POST")
+            vid = int(a["fid"].split(",")[0])
+            src_url = a["url"]
+            other = next(
+                d.url
+                for d in master.topology.data_nodes()
+                if d.url != src_url
+            )
+            env = CommandEnv([maddr])
+            # duplicate the volume onto the other node: placement wants
+            # 1 copy, so the original becomes surplus
+            out = io.StringIO()
+            run_command(env, f"volume.copy -volumeId {vid} "
+                             f"-from {src_url} -to {other}", out)
+            assert wait_for(
+                lambda: len(master.topology.lookup("", vid)) == 2, 15
+            )
+            out = io.StringIO()
+            run_command(env, f"node.drain -node {src_url} -wait 60", out)
+            # the surplus copy was DROPPED (no spare node exists to
+            # move it to), the drain completed, bytes survive on the
+            # other holder
+            assert "is empty" in out.getvalue(), out.getvalue()
+            status, got = _http(f"http://{other}/{a['fid']}")
+            assert status == 200 and got == payload
+        finally:
+            vs_b.stop()
+            vs_a.stop()
+            master.stop()
+
+
+class TestDrainEcE2E:
+    def test_drain_moves_ec_shards_off(self, tmp_path_factory):
+        """drain_ec: every EC shard the draining node holds moves to a
+        target (copy+mount then unmount+delete), and degraded reads of
+        the keyset stay byte-identical afterwards."""
+        from seaweedfs_tpu.server.master_server import MasterServer
+
+        master = MasterServer(
+            port=free_port(), volume_size_limit_mb=64, vacuum_interval=0,
+            repair_interval=0.3, repair_grace=0.1,
+        )
+        master.repair.cooldown = 1.0
+        master.start()
+        maddr = f"127.0.0.1:{master.port}"
+        vs_a = wiring.start_volume_server(tmp_path_factory, maddr, "ea")
+        vs_b = wiring.start_volume_server(tmp_path_factory, maddr, "eb")
+        try:
+            assert wait_for(lambda: len(master.topology.data_nodes()) == 2)
+            vid, keys = wiring.seed_ec_volume(master, "drainec")
+            assert wait_for(
+                lambda: wiring.registered_shards(master, vid) == 14, 30
+            )
+            a_url = f"{vs_a.host}:{vs_a.port}"
+            dn_a = next(
+                d for d in master.topology.data_nodes() if d.url == a_url
+            )
+            assert dn_a.ec_shards, "A holds no ec shards"
+            _http(f"http://{maddr}/node/drain?node={a_url}")
+            assert wait_for(lambda: not dn_a.ec_shards, 60), (
+                master.repair.queue_snapshot()
+            )
+            # every shard is mounted somewhere (B) and the data reads
+            # back byte-identical through the degraded/normal path
+            assert wait_for(
+                lambda: wiring.registered_shards(master, vid) == 14, 30
+            )
+            for fid, want in keys.items():
+                got = wiring.read_blob([maddr], fid, collection="drainec")
+                assert got == want, fid
+            rq = json.loads(_http(f"http://{maddr}/repair/queue")[1])
+            assert any(
+                h["Kind"] == "drain_ec" for h in rq.get("History", [])
+            )
+        finally:
+            vs_b.stop()
+            vs_a.stop()
+            master.stop()
+
+
+class TestVolumeDrainMethod:
+    def test_drain_announces_sheds_and_exits(self, tmp_path_factory):
+        """VolumeServer.drain(): the draining flag rides a forced beat
+        (master excludes the node), new writes shed 503, and the server
+        stops cleanly."""
+        from seaweedfs_tpu.server.master_server import MasterServer
+
+        master = MasterServer(
+            port=free_port(), volume_size_limit_mb=64, vacuum_interval=0
+        )
+        master.start()
+        maddr = f"127.0.0.1:{master.port}"
+        vs = wiring.start_volume_server(tmp_path_factory, maddr, "dm")
+        try:
+            assert wait_for(lambda: len(master.topology.data_nodes()) == 1)
+            a = json.loads(_http(f"http://{maddr}/dir/assign")[1])
+            _http(f"http://{a['url']}/{a['fid']}", data=b"pre-drain",
+                  method="POST")
+            url = f"{vs.host}:{vs.port}"
+            import threading
+
+            t = threading.Thread(target=lambda: vs.drain(timeout=10))
+            t.start()
+            assert wait_for(
+                lambda: not master.health.assignable(url), 10
+            ), "master never saw the draining flag"
+            t.join(timeout=20)
+            assert not t.is_alive()
+            # deregistered: the node left the topology
+            assert wait_for(
+                lambda: all(
+                    d.url != url for d in master.topology.data_nodes()
+                ),
+                10,
+            )
+        finally:
+            try:
+                vs.stop()
+            except Exception:  # noqa: BLE001 — already stopped by drain
+                pass
+            master.stop()

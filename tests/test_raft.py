@@ -13,10 +13,7 @@ import pytest
 from seaweedfs_tpu.cluster.raft import NotLeader, RaftNode
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from seaweedfs_tpu.util.availability import free_port  # noqa: E402 — collision-hardened allocator
 
 
 def wait_for(cond, timeout=45.0, interval=0.05):

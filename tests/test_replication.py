@@ -20,10 +20,7 @@ from seaweedfs_tpu.server.volume_server import VolumeServer
 from seaweedfs_tpu.util.config import Configuration
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from seaweedfs_tpu.util.availability import free_port  # noqa: E402 — collision-hardened allocator
 
 
 def _event(key_old=None, key_new=None, chunks=()):
@@ -215,10 +212,7 @@ class TestS3Sink:
         from seaweedfs_tpu.server.master_server import MasterServer
         from seaweedfs_tpu.server.volume_server import VolumeServer
 
-        def free_port():
-            with socket.socket() as s:
-                s.bind(("127.0.0.1", 0))
-                return s.getsockname()[1]
+        from seaweedfs_tpu.util.availability import free_port
 
         servers = []
 
@@ -368,10 +362,7 @@ def test_s3_sink_directory_delete_sweeps_prefix(tmp_path_factory):
     from seaweedfs_tpu.server.master_server import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
 
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
+    from seaweedfs_tpu.util.availability import free_port
 
     servers = []
 

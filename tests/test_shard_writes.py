@@ -30,10 +30,7 @@ from seaweedfs_tpu.server.volume_server import VolumeServer
 from seaweedfs_tpu.server.volume_workers import VolumeReadWorker
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from seaweedfs_tpu.util.availability import free_port  # noqa: E402 — collision-hardened allocator
 
 
 def _get(url):

@@ -369,10 +369,7 @@ class TestFsCommands:
         from seaweedfs_tpu.server.volume_server import VolumeServer
         from seaweedfs_tpu.shell import CommandEnv
 
-        def free_port():
-            with socket.socket() as s:
-                s.bind(("127.0.0.1", 0))
-                return s.getsockname()[1]
+        from seaweedfs_tpu.util.availability import free_port
 
         master = MasterServer(port=free_port(), volume_size_limit_mb=64)
         master.start()

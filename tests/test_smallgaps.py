@@ -14,10 +14,7 @@ import time
 import pytest
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from seaweedfs_tpu.util.availability import free_port  # noqa: E402 — collision-hardened allocator
 
 
 # ---------------------------------------------------------------------------

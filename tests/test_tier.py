@@ -16,10 +16,7 @@ import pytest
 ACCESS, SECRET = "tier_access", "tier_secret"
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from seaweedfs_tpu.util.availability import free_port  # noqa: E402 — collision-hardened allocator
 
 
 @pytest.fixture(scope="module")

@@ -287,18 +287,24 @@ class TestCrossHop:
         req.add_header("X-Weed-Trace", "feedfeedfeedfeed:0badc0de:serve")
         urllib.request.urlopen(req, timeout=60).close()
 
-        # the gateway's s3.put span is the OUTERMOST: it closes (and
-        # lands in the ring) only AFTER the response bytes went out, so
-        # the client can observe the reply a scheduling quantum before
-        # the handler thread runs span_close — poll briefly instead of
-        # racing it (under full-suite GIL load the single-shot query
-        # lost this race ~1 run in 2)
+        # EVERY hop's span closes (and lands in its node's ring) only
+        # AFTER that hop's response bytes went out, so the client can
+        # observe the final reply a scheduling quantum before ANY of
+        # the handler threads runs span_close — the filer/volume
+        # threads included, not just the outermost gateway (under
+        # full-suite GIL load the filer.post close lost this race even
+        # with the s3.put-only poll). Poll until the complete expected
+        # span set is present, then assert its shape.
         deadline = time.time() + 5.0
         while True:
             spans = _spans_for("feedfeedfeedfeed")
-            if any(s["name"] == "s3.put" for s in spans) or (
-                time.time() > deadline
-            ):
+            names = [s["name"] for s in spans]
+            complete = (
+                "s3.put" in names
+                and "filer.post" in names
+                and names.count("volume.post") >= 2
+            )
+            if complete or time.time() > deadline:
                 break
             time.sleep(0.01)
         by_name: dict[str, list[dict]] = {}

@@ -22,12 +22,7 @@ from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.volume import NeedleNotFound, Volume
 
 
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from seaweedfs_tpu.util.availability import free_port  # noqa: E402 — collision-hardened allocator
 
 
 class TestSharedReadVolume:
