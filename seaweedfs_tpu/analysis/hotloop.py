@@ -89,6 +89,19 @@ _EXEMPT_QUALS: dict[str, str] = {
         "leader-side background scrape loop; /cluster/* handlers only "
         "read ring snapshots under short locks (docs/TELEMETRY.md)"
     ),
+    # The EC streaming pipeline's staging-ring and queue waits
+    # (_q_get/_q_put/_StagingRing.acquire, docs/CODEC.md) are bounded
+    # 200 ms-tick polls that exist precisely so an aborted pipeline can
+    # never park a pool thread forever; they run on the pipeline's OWN
+    # reader/writer pool threads inside the maintenance verbs
+    # (generate/rebuild), never inside a serving dispatch, and the
+    # blocking IS the backpressure design — flagging the waits would
+    # train people to suppress the checker on real handler stalls.
+    "seaweedfs_tpu.ec.ec_stream.": (
+        "staging-ring/queue backpressure waits on pipeline pool "
+        "threads, stop-aware 200 ms ticks by design; maintenance "
+        "verbs only, not serving dispatch (docs/CODEC.md)"
+    ),
 }
 
 

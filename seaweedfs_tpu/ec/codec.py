@@ -195,6 +195,23 @@ class ReedSolomon:
             shards[k + i] = parity[i]
         return shards  # type: ignore[return-value]
 
+    def parity_with_crc(
+        self, stacked: np.ndarray
+    ) -> tuple[np.ndarray, list[int]]:
+        """([p, N] parity, [k+p] CRC-32C per shard row) for one [k, N]
+        data tile — the HOST side of the fused-CRC stage contract the
+        streaming pipeline's device kernels implement on-chip
+        (ec/crc_kernel.py): every stage pair hands the writer pool
+        (shard bytes, crc) pairs so nothing downstream re-reads the
+        bytes to checksum them. Byte- and CRC-identical to the device
+        pairs (enforced by tests and bench --check)."""
+        from seaweedfs_tpu.util.crc import crc32c
+
+        parity = self._apply(self.parity_rows, stacked)
+        crcs = [crc32c(stacked[i].tobytes()) for i in range(self.data_shards)]
+        crcs += [crc32c(parity[i].tobytes()) for i in range(self.parity_shards)]
+        return parity, crcs
+
     def verify(self, shards: Sequence[np.ndarray]) -> bool:
         self._check_shards(shards, allow_missing=False)
         k = self.data_shards

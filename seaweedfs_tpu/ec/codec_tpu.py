@@ -367,6 +367,15 @@ def apply_matrix_bits_u32_batch(
     return jax.lax.bitcast_convert_type(out.reshape(b, r, n32, 4), jnp.uint32)
 
 
+def apply_matrix_bits_u32(
+    a_bits: jnp.ndarray, inputs_u32: jnp.ndarray
+) -> jnp.ndarray:
+    """Single-tile variant of apply_matrix_bits_u32_batch: [k, n32]
+    uint32 → [R, n32] uint32 (the non-TPU arm of the fused stream
+    stage, where the SWAR Pallas kernel cannot lower)."""
+    return apply_matrix_bits_u32_batch(a_bits, inputs_u32[None])[0]
+
+
 def _swar_tn(n32: int) -> int:
     """Largest supported tile dividing n32 (n32 is a power of two ≥ 256
     on all SWAR call sites, so this always succeeds)."""
@@ -496,6 +505,53 @@ class TpuCodecKernels:
     def encode_batch(self, data: jnp.ndarray) -> jnp.ndarray:
         """data [B, k, N] → parity [B, p, N]."""
         return apply_matrix_bits_batch(self.encode_bits, data)
+
+    def encode_u32_crc(
+        self, data_u32: jnp.ndarray, interpret: bool = False
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Fused encode + Castagnoli pass: [k, n32] uint32 → (parity
+        [p, n32], crcs [k+p] uint32 — standard CRC-32C of every shard
+        row's bytes, data rows included). One jitted program: the CRC
+        accumulation (ec/crc_kernel.py bit-matmuls) runs over the tile
+        while it is still device-resident, so the host consumes
+        (shard bytes, crc) pairs without a second pass over parity
+        bytes. SWAR kernel on TPU (or under interpret), bit-matmul
+        elsewhere — CRCs are bit-identical to util/crc.crc32c either
+        way."""
+        from seaweedfs_tpu.ec import crc_kernel
+
+        if interpret or _on_tpu():
+            parity = swar_apply_matrix_u32(
+                self.matrix[self.data_shards :], data_u32, interpret
+            )
+        else:
+            parity = apply_matrix_bits_u32(self.encode_bits, data_u32)
+        crcs = crc_kernel.crc32c_rows(
+            jnp.concatenate([data_u32, parity], axis=0)
+        )
+        return parity, crcs
+
+    def reconstruct_u32_crc(
+        self,
+        survivors: tuple[int, ...],
+        targets: tuple[int, ...],
+        shard_data_u32: jnp.ndarray,
+        interpret: bool = False,
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Fused rebuild + Castagnoli pass: survivor tile [k, n32]
+        uint32 → (rebuilt [len(targets), n32], crcs [len(targets)]
+        uint32) in one program (see encode_u32_crc)."""
+        from seaweedfs_tpu.ec import crc_kernel
+
+        rows = self.decode_rows_for(survivors, targets)
+        if interpret or _on_tpu():
+            rebuilt = swar_apply_matrix_u32(rows, shard_data_u32, interpret)
+        else:
+            rebuilt = apply_matrix_bits_u32(
+                jnp.asarray(self.decode_bits_for(survivors, targets)),
+                shard_data_u32,
+            )
+        return rebuilt, crc_kernel.crc32c_rows(rebuilt)
 
     def decode_rows_for(
         self, survivors: tuple[int, ...], targets: tuple[int, ...]

@@ -1,0 +1,136 @@
+"""Device-side CRC32-C over u32-lane shard streams.
+
+The streaming encode pipeline produces parity tiles ON DEVICE; the
+host used to fetch the bytes and run the table CRC over them again
+before the writer pool could stamp checksums — a second full pass over
+every parity byte. This module folds the Castagnoli accumulation into
+the same jitted program as the codec kernel, so a dispatch returns
+(parity, per-row CRC) and the host never re-touches the bytes.
+
+The trick is the same GF(2)-linearity the bitsliced codec kernels
+lean on: with the init/final-xor constants stripped, a CRC register is
+a linear function of the message bits, so
+
+  * the raw CRC of each uint32 LANE (4 stream bytes) is one
+    [N,32]x[32,32] bit-matmul against a constant lane matrix;
+  * adjacent chunks combine with `crc(A||B) = Z_|B|(crc(A)) ^ crc(B)`
+    where Z_k (the k-zero-byte register transit, util/crc) is another
+    [32,32] bit-matrix — log2(lanes) halving rounds reduce a whole row
+    to one register;
+  * the init/final-xor constants re-enter as a single per-length XOR.
+
+Everything is ordinary XLA (int8 matmul + bit packing, the
+apply_matrix_bits idiom) — no Pallas, so it lowers on CPU and TPU with
+bit-identical results to util/crc.crc32c, which the tests and the
+bench --check pipeline-identity smoke enforce.
+
+Shape contract: lane counts must be a power of two (every stream tile
+the drivers dispatch is; odd tails fall back to the host table CRC in
+the driver).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from seaweedfs_tpu.util import crc as _crc
+
+
+def crc_supported(nbytes: int) -> bool:
+    """True when the device kernel serves a row of `nbytes` stream
+    bytes: whole u32 lanes, power-of-two lane count."""
+    if nbytes <= 0 or nbytes % 4:
+        return False
+    n32 = nbytes // 4
+    return n32 & (n32 - 1) == 0
+
+
+def _raw_transit(data: bytes, reg: int) -> int:
+    """CRC register after processing `data` starting from `reg` (the
+    init/final-xor constants of crc32c stripped off)."""
+    return _crc.crc32c(data, reg ^ 0xFFFFFFFF) ^ 0xFFFFFFFF
+
+
+@functools.lru_cache(maxsize=1)
+def _lane_cols() -> tuple[int, ...]:
+    """Columns of the lane operator: raw CRC of the 4-byte
+    little-endian message holding lane bit b (the numpy
+    ``.view(np.uint32)`` packing the SWAR kernels use)."""
+    return tuple(
+        _raw_transit((1 << b).to_bytes(4, "little"), 0) for b in range(32)
+    )
+
+
+def _bitmat(cols) -> np.ndarray:
+    """32-column operator -> [32(in), 32(out)] int8 bit-matrix for the
+    device-side matmul apply."""
+    m = np.zeros((32, 32), dtype=np.int8)
+    for b, c in enumerate(cols):
+        for j in range(32):
+            m[b, j] = (c >> j) & 1
+    return m
+
+
+@functools.lru_cache(maxsize=128)
+def _shift_bitmat(nbytes: int) -> np.ndarray:
+    """Bit-matrix of Z_nbytes (advance a raw CRC past nbytes zero
+    bytes), host-built by operator squaring."""
+    return _bitmat(_crc._zero_shift_cols(nbytes))
+
+
+@functools.lru_cache(maxsize=128)
+def _final_const(nbytes: int) -> int:
+    """crc32c(M) = crc_raw0(M) ^ _final_const(len(M)): the init state
+    pushed through the message length, plus the final xor."""
+    return _crc._gf2_apply(
+        _crc._zero_shift_cols(nbytes), 0xFFFFFFFF
+    ) ^ 0xFFFFFFFF if nbytes else 0
+
+
+_BIT_IDX = np.arange(32, dtype=np.uint32)
+
+
+def _apply_bits(x: jnp.ndarray, m_bits: jnp.ndarray) -> jnp.ndarray:
+    """Apply a [32,32] bit-matrix operator to every uint32 in x
+    (elementwise over leading dims): unpack, int8 matmul, repack."""
+    shifts = jnp.asarray(_BIT_IDX)
+    bits = ((x[..., None] >> shifts) & jnp.uint32(1)).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        bits,
+        m_bits,
+        (((bits.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return jnp.sum((acc & 1).astype(jnp.uint32) << shifts, axis=-1)
+
+
+def crc_lin_rows(x_u32: jnp.ndarray) -> jnp.ndarray:
+    """[..., n32] uint32 lanes -> [...] uint32 RAW (zero-init, no final
+    xor) CRC of each row's 4*n32 bytes. The linear form — what crosses
+    mesh devices, because raw CRCs of stream segments compose with the
+    Z shift alone (mesh_codec's stripe-axis fold)."""
+    n32 = x_u32.shape[-1]
+    if n32 & (n32 - 1):
+        raise ValueError(f"lane count {n32} is not a power of two")
+    c = _apply_bits(x_u32, jnp.asarray(_bitmat(_lane_cols())))
+    span = 4  # bytes covered by each element of c
+    while c.shape[-1] > 1:
+        m = jnp.asarray(_shift_bitmat(span))
+        c = _apply_bits(c[..., 0::2], m) ^ c[..., 1::2]
+        span *= 2
+    return c[..., 0]
+
+
+def finalize_rows(lin: jnp.ndarray, nbytes: int) -> jnp.ndarray:
+    """Raw row CRCs -> standard crc32c values for rows of `nbytes`."""
+    return lin ^ jnp.uint32(_final_const(nbytes))
+
+
+def crc32c_rows(x_u32: jnp.ndarray) -> jnp.ndarray:
+    """[..., n32] uint32 lanes -> [...] uint32 standard CRC-32C of each
+    row's bytes — bit-identical to util/crc.crc32c on the same bytes."""
+    return finalize_rows(crc_lin_rows(x_u32), x_u32.shape[-1] * 4)

@@ -88,12 +88,14 @@ def _use_stream_driver(rs: ReedSolomon) -> bool:
     """Route to the pipelined ec_stream driver when the codec would run
     on an attached TPU anyway — output bytes are identical; the stream
     driver overlaps disk IO, H2D, kernel, and D2H instead of
-    round-tripping synchronously per batch."""
+    round-tripping synchronously per batch. WEED_EC_PIPELINE=0 (the
+    pipeline kill switch) forces the serial classic loop wholesale."""
     if rs._backend_name != "tpu":
         return False
+    from seaweedfs_tpu.ec import ec_stream
     from seaweedfs_tpu.ec.codec_tpu import _on_tpu
 
-    return _on_tpu()
+    return ec_stream.pipeline_enabled() and _on_tpu()
 
 
 def _stream_host_codec(rs: ReedSolomon) -> bool:
@@ -102,8 +104,13 @@ def _stream_host_codec(rs: ReedSolomon) -> bool:
     and pwritev writer pools overlap disk IO with the C encode, and the
     flush-free raw-fd writes drop the serial close tail the classic
     loop pays. The numpy "cpu" backend stays on the classic loop — it
-    is the bit-exact reference the others are judged against."""
-    return rs._backend_name == "native"
+    is the bit-exact reference the others are judged against. The
+    WEED_EC_PIPELINE=0 kill switch overrides here too."""
+    if rs._backend_name != "native":
+        return False
+    from seaweedfs_tpu.ec import ec_stream
+
+    return ec_stream.pipeline_enabled()
 
 
 def iter_ec_tiles(dat_size: int, tile: int, large: int, small: int):
@@ -157,6 +164,7 @@ def write_ec_files(
     small_block_size: int = SMALL_BLOCK_SIZE,
     stats: dict | None = None,
     durable: bool = False,
+    want_crcs: bool = False,
 ) -> None:
     """Generate .ec00-.ec13 next to `base_file_name`.dat
     (ec_encoder.go:53 WriteEcFiles). durable=True fsyncs the shard
@@ -168,8 +176,14 @@ def write_ec_files(
     collects per-phase busy seconds so e2e throughput numbers stay
     attributable (bench.py stream): the classic loop reports
     read_s/encode_s/write_s; the pipelined stream driver reports
-    read_s/dispatch_s/fetch_s/write_s (its encode splits into a
-    dispatch and a blocking fetch on either side of the queue)."""
+    read_s/stage_s/device_s/writeback_s/compute_s/write_s plus its
+    pipeline depth (overlapped stages — each pool's busy seconds).
+
+    want_crcs=True lands `shard_crcs` (14 whole-file CRC-32C values)
+    in `stats` on every driver: fused into the device pass on the
+    pipelined paths, a running table CRC on the classic loop — the
+    value contract is identical, so the WEED_EC_PIPELINE=0 kill switch
+    changes nothing callers can observe but speed."""
     rs = rs or new_encoder()
     if rs.data_shards != DATA_SHARDS or rs.parity_shards != PARITY_SHARDS:
         raise ValueError("shard-file layout is fixed at RS(10,4)")
@@ -179,7 +193,9 @@ def write_ec_files(
 
         parity_fn = fetch_fn = None
         if not _use_stream_driver(rs):
-            parity_fn, fetch_fn = ec_stream.local_encode_fns(rs)
+            parity_fn, fetch_fn = ec_stream.local_encode_fns(
+                rs, want_crcs=want_crcs
+            )
         ec_stream.stream_write_ec_files(
             base_file_name,
             tile_bytes=buffer_size,
@@ -189,6 +205,7 @@ def write_ec_files(
             fetch_fn=fetch_fn,
             stats=stats,
             durable=durable,
+            want_crcs=want_crcs,
         )
         return
 
@@ -201,9 +218,12 @@ def write_ec_files(
 
     wall0 = _time.perf_counter()
     read_s = encode_s = write_s = 0.0
+    crcs = [0] * TOTAL_SHARDS  # running per-shard-file CRC (want_crcs)
     dat_size = os.path.getsize(base_file_name + ".dat")
     outputs = [open(base_file_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
     try:
+        from seaweedfs_tpu.util.crc import crc32c
+
         with open(base_file_name + ".dat", "rb") as dat:
             for row_off, block, batch_off, step in iter_ec_tiles(
                 dat_size, buffer_size, large_block_size, small_block_size
@@ -220,6 +240,11 @@ def write_ec_files(
                     # numpy arrays expose the buffer protocol: write the
                     # row directly instead of paying a tobytes() copy
                     outputs[i].write(shards[i])  # type: ignore[arg-type]
+                    if want_crcs:
+                        # the serial loop writes in stream order, so
+                        # the table CRC simply continues — same value
+                        # contract as the pipelined drivers' fused fold
+                        crcs[i] = crc32c(shards[i].tobytes(), crcs[i])
                 t3 = _time.perf_counter()
                 read_s += t1 - t0
                 encode_s += t2 - t1
@@ -262,6 +287,8 @@ def write_ec_files(
                     wall - read_s - encode_s - write_s - flush_s, 4
                 ),
             )
+            if want_crcs:
+                stats["shard_crcs"] = crcs
 
 
 def write_ec_files_batch(
@@ -270,6 +297,9 @@ def write_ec_files_batch(
     tile_bytes: int | None = None,
     large_block_size: int = LARGE_BLOCK_SIZE,
     small_block_size: int = SMALL_BLOCK_SIZE,
+    stats: dict | None = None,
+    durable: bool = False,
+    want_crcs: bool = False,
 ) -> None:
     """Encode N sealed volumes' .dat files through ONE mesh program per
     tile round — the §2.6.2 volume-parallelism story end-to-end: each
@@ -280,14 +310,38 @@ def write_ec_files_batch(
     goroutine-per-volume encode fan-out (command_ec_encode.go:153),
     lifted to SPMD.
 
+    The production arm is the PIPELINED driver
+    (ec_stream.stream_write_ec_files_batch): staging-ring overlap of
+    reads, H2D, the mesh program, D2H and shard writes, with fused
+    per-shard CRCs when want_crcs. WEED_EC_PIPELINE=0 restores this
+    serial per-round loop wholesale — byte-identical, no overlap, and
+    the same durable contract (durable=True fsyncs every shard file
+    before returning on BOTH arms, so the BatchGenerate verb's .ecx
+    publish ordering holds regardless of the kill switch).
+
     Shapes stay static across rounds (finished volumes contribute zero
-    tiles that are discarded) so the whole run compiles once."""
-    from seaweedfs_tpu.parallel import MeshCodec, make_mesh
+    tiles that are discarded) so each driver compiles its program
+    once."""
+    from seaweedfs_tpu.ec import ec_stream
 
     if not base_file_names:
         return
+    if ec_stream.pipeline_enabled():
+        ec_stream.stream_write_ec_files_batch(
+            base_file_names,
+            codec=codec,
+            tile_bytes=tile_bytes,
+            large_block_size=large_block_size,
+            small_block_size=small_block_size,
+            stats=stats,
+            durable=durable,
+            want_crcs=want_crcs,
+        )
+        return
     if codec is None:
-        codec = MeshCodec(make_mesh())
+        # same self-provisioning recipe as the pipelined arm: the vol
+        # axis sized to gcd(batch, devices) so any batch shards cleanly
+        codec = ec_stream._default_mesh_codec(len(base_file_names))
     tile_bytes = tile_bytes or DEFAULT_BUFFER_SIZE
     for block in (large_block_size, small_block_size):
         if block % tile_bytes != 0 and tile_bytes % block != 0:
@@ -320,7 +374,16 @@ def write_ec_files_batch(
                 )
             )
         if not any(tiles):
-            return  # all .dat files empty: 14 empty shards each, done
+            # all .dat files empty: 14 empty shards each, done —
+            # durably, when asked: the verb's .ecx publish must never
+            # outlive shard files a crash can drop
+            if durable:
+                for fs in outs:
+                    for f in fs:
+                        os.fsync(f.fileno())
+            if stats is not None and want_crcs:
+                stats["shard_crcs"] = [[0] * TOTAL_SHARDS for _ in range(b)]
+            return
         # one static tile width for every round: the max step, rounded
         # so the u32 lane count splits over the stripe axis in whole
         # SWAR-friendly chunks (1024 lanes per device minimum)
@@ -329,6 +392,9 @@ def write_ec_files_batch(
         width = -(-max_step // gran) * gran
         rounds = max(len(ts) for ts in tiles)
         batch = np.zeros((b, DATA_SHARDS, width), dtype=np.uint8)
+        crcs = [[0] * TOTAL_SHARDS for _ in range(b)]
+        from seaweedfs_tpu.util.crc import crc32c
+
         for r in range(rounds):
             batch[:] = 0
             steps = [0] * b
@@ -350,17 +416,56 @@ def write_ec_files_batch(
                 if not step:
                     continue
                 for i in range(DATA_SHARDS):
-                    outs[v][i].write(batch[v, i, :step].tobytes())
+                    chunk = batch[v, i, :step].tobytes()
+                    outs[v][i].write(chunk)
+                    if want_crcs:
+                        crcs[v][i] = crc32c(chunk, crcs[v][i])
                 for i in range(PARITY_SHARDS):
-                    outs[v][DATA_SHARDS + i].write(
-                        parity[v, i, :step].tobytes()
-                    )
+                    chunk = parity[v, i, :step].tobytes()
+                    outs[v][DATA_SHARDS + i].write(chunk)
+                    if want_crcs:
+                        crcs[v][DATA_SHARDS + i] = crc32c(
+                            chunk, crcs[v][DATA_SHARDS + i]
+                        )
+        if stats is not None and want_crcs:
+            stats["shard_crcs"] = crcs
+        if durable:
+            # same contract as the pipelined arm: a durable batch
+            # encode must not return until the shard bytes are on disk
+            # (success path only — a failed fsync fails the encode)
+            for fs in outs:
+                for f in fs:
+                    f.flush()
+                    os.fsync(f.fileno())
+    except BaseException:
+        # abort contract, matching the pipelined arm: no partial (or
+        # written-but-unsynced, when the durable fsync failed) shard
+        # set may survive for ANY volume — shard_presence counts any
+        # existing .ecNN as a valid shard, so leftovers would read as
+        # complete volumes to a later rebuild/scrub
+        for fs in outs:
+            for f in fs:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+        for base in base_file_names:
+            for i in range(TOTAL_SHARDS):
+                try:
+                    os.remove(base + to_ext(i))
+                except OSError:
+                    pass
+        raise
     finally:
         for f in dats:
             f.close()
         for fs in outs:
             for f in fs:
-                f.close()
+                if not f.closed:
+                    try:
+                        f.close()
+                    except OSError:
+                        pass
 
 
 def rebuild_ec_files(
@@ -368,12 +473,16 @@ def rebuild_ec_files(
     rs: ReedSolomon | None = None,
     buffer_size: int | None = None,
     durable: bool = False,
+    stats: dict | None = None,
+    want_crcs: bool = False,
 ) -> list[int]:
     """Regenerate whichever .ec files are missing from the ones present
     (ec_encoder.go:83 generateMissingEcFiles). Returns rebuilt ids.
 
     buffer_size=None lets each driver pick its default (1 MiB classic
-    batches; 8 MiB pipelined tiles on TPU/native hosts)."""
+    batches; 8 MiB pipelined tiles on TPU/native hosts). want_crcs
+    lands {rebuilt shard id: whole-file CRC-32C} in `stats` on every
+    driver (see write_ec_files)."""
     rs = rs or new_encoder()
     if rs.data_shards != DATA_SHARDS or rs.parity_shards != PARITY_SHARDS:
         raise ValueError("shard-file layout is fixed at RS(10,4)")
@@ -382,13 +491,17 @@ def rebuild_ec_files(
 
         rebuild_fn = fetch_fn = None
         if not _use_stream_driver(rs):
-            rebuild_fn, fetch_fn = ec_stream.local_rebuild_fns(rs)
+            rebuild_fn, fetch_fn = ec_stream.local_rebuild_fns(
+                rs, want_crcs=want_crcs
+            )
         return ec_stream.stream_rebuild_ec_files(
             base_file_name,
             tile_bytes=buffer_size,
             rebuild_fn=rebuild_fn,
             fetch_fn=fetch_fn,
             durable=durable,
+            stats=stats,
+            want_crcs=want_crcs,
         )
     buffer_size = buffer_size or SMALL_BLOCK_SIZE
     present, missing = shard_presence(base_file_name)
@@ -411,7 +524,10 @@ def rebuild_ec_files(
         if present[i]
     }
     outputs = {i: open(base_file_name + to_ext(i), "wb") for i in missing}
+    crcs = {i: 0 for i in missing}  # running rebuilt-file CRCs (want_crcs)
     try:
+        from seaweedfs_tpu.util.crc import crc32c
+
         shard_size = os.path.getsize(
             base_file_name + to_ext(next(iter(inputs)))
         )
@@ -430,9 +546,14 @@ def rebuild_ec_files(
                 shards[i] = np.frombuffer(raw, dtype=np.uint8)
             rs.reconstruct(shards)
             for i in missing:
-                outputs[i].write(shards[i].tobytes())  # type: ignore[union-attr]
+                chunk = shards[i].tobytes()  # type: ignore[union-attr]
+                outputs[i].write(chunk)
+                if want_crcs:
+                    crcs[i] = crc32c(chunk, crcs[i])
                 EC_REPAIR_BYTES_WRITTEN.inc(step)
             offset += step
+        if stats is not None and want_crcs:
+            stats["shard_crcs"] = crcs
         if durable:
             for f in outputs.values():
                 f.flush()

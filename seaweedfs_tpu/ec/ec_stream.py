@@ -75,25 +75,94 @@ TOTAL_SHARDS = locate.TOTAL_SHARDS
 LARGE_BLOCK_SIZE = locate.LARGE_BLOCK_SIZE
 SMALL_BLOCK_SIZE = locate.SMALL_BLOCK_SIZE
 
-# Per-shard bytes per pipelined tile. 4 MiB x 10 shards = 40 MiB of
-# host buffer per in-flight stage (on the encode path, up to 4
-# small-tier rows fold into one super-tile — see stream_write's
-# reader). Swept on the 2-core rig: bigger tiles amortize syscalls but
-# starve the pipeline of overlap on small volumes; 4 MiB won on the
-# disk-backed scratch, 1-2 MiB on tmpfs, 8 MiB lost on both.
-DEFAULT_TILE_BYTES = 4 * 1024 * 1024
+# Per-shard bytes per pipelined tile. 1 MiB x 10 shards = 10 MiB of
+# host buffer per ring slot (on the encode path, small-tier rows fold
+# into one super-tile — see stream_write's reader). Re-swept for the
+# staging-ring driver on this rig (BENCH_r12): finer tiles give the
+# reader pool more in-flight preads to overlap against compute, and
+# 1 MiB beat 4 MiB 1.27 vs 0.81 GB/s on the disk-backed scratch while
+# matching it on tmpfs (512 KiB was within noise of 1 MiB on both;
+# 8 MiB lost everywhere). TPU dispatch amortization keeps the floor at
+# 1 MiB — a [10, 1 MiB] tile is still 16x the SWAR minimum stream.
+DEFAULT_TILE_BYTES = 1024 * 1024
 # Dispatched-but-unfetched tiles queued toward the writer pool. Live
 # host-tile bound: _INFLIGHT queued + one per writer thread (being
 # fetched/written) + reader_threads + 2 (read queue + the
 # dispatcher's hands) — 10 tiles at the defaults.
 _INFLIGHT = 3
+
+
+def pipeline_enabled() -> bool:
+    """Kill switch for the whole device-resident pipeline plane:
+    WEED_EC_PIPELINE=0 routes every encode/rebuild back through the
+    serial classic drivers in ec_files.py wholesale (byte-identical;
+    regression-tested) — the operator lever when a pipeline bug is
+    suspected in production."""
+    return os.environ.get("WEED_EC_PIPELINE", "1") != "0"
+
+
+def pipeline_depth() -> int:
+    """Dispatched-but-unfetched window (staging-ring dispatch depth):
+    WEED_EC_PIPELINE_DEPTH, minimum 2 (double buffering — one tile on
+    the device while the next stages), default 3."""
+    try:
+        d = int(os.environ.get("WEED_EC_PIPELINE_DEPTH", "0"))
+    except ValueError:
+        d = 0
+    return max(2, d) if d > 0 else _INFLIGHT
+
+
+def pipeline_batch_limit() -> int:
+    """Max volumes per mesh dispatch round on the batched encode path
+    (WEED_EC_PIPELINE_BATCH, 0 = whole batch in one program). Caps
+    staging-ring memory: one ring slot is batch x 10 x tile bytes."""
+    try:
+        return max(0, int(os.environ.get("WEED_EC_PIPELINE_BATCH", "0")))
+    except ValueError:
+        return 0
+
+
+class _StagingRing:
+    """N preallocated host staging buffers cycled reader → dispatcher →
+    writer → free. Replaces a fresh np.empty per tile: the pipeline's
+    host memory is bounded at slots x slot_bytes for the whole run and
+    the allocator drops out of the hot loop (page-faulting a new 40 MiB
+    arena per tile showed up as unattributed wall in the loop_s
+    residue). Slot count = dispatch depth + one in-hand buffer per pool
+    thread, so no stage ever stalls waiting for memory another stage
+    is legitimately using."""
+
+    def __init__(self, slots: int, slot_bytes: int):
+        self.slots = max(2, slots)
+        self._bufs = [
+            np.empty(slot_bytes, dtype=np.uint8) for _ in range(self.slots)
+        ]
+        self._free: queue.Queue = queue.Queue()
+        for i in range(self.slots):
+            self._free.put(i)
+
+    def acquire(self, stop: threading.Event):
+        """(slot id, flat uint8 buffer) or None when the pipeline
+        aborted while waiting for a free slot."""
+        i = _q_get(self._free, stop)
+        if i is _STOPPED:
+            return None
+        return i, self._bufs[i]
+
+    def release(self, slot_id: int) -> None:
+        self._free.put(slot_id)
+
+
 # Pool widths: the threads spend their time in GIL-released syscalls
 # (preadv/pwritev), GIL-released C codec calls, or blocking device
 # fetches, so a few of them keep the disks busy even on small hosts —
-# but every extra thread costs GIL churn, and a 2-core-host sweep
-# measured w=3/r=2 beating both w=2 and w=8 (BENCH r06 notes).
+# but every extra thread costs GIL churn. Re-swept with the staging
+# ring (BENCH_r12): the reader pool is the disk's IO queue, and a
+# floor of 3 readers beat the old 2 even on a 1-CPU-quota host
+# (1.24 vs 1.17 GB/s at the 1 MiB tile) because blocked preads cost
+# no CPU; w=3 still beat w=2 and w=8.
 DEFAULT_WRITER_THREADS = min(8, max(3, (os.cpu_count() or 2) + 1))
-DEFAULT_READER_THREADS = min(4, max(2, (os.cpu_count() or 2) // 2))
+DEFAULT_READER_THREADS = min(6, max(3, (os.cpu_count() or 2) // 2))
 
 _EOF = object()  # end-of-stream marker flowing through the queues
 _STOPPED = object()  # returned by _q_get when the pipeline aborted
@@ -222,7 +291,7 @@ def _charge(busy: dict, lock: threading.Lock, key: str, dt: float) -> None:
 # --- codec stage factories --------------------------------------------------
 
 
-def local_encode_fns(rs) -> tuple[Callable, Callable]:
+def local_encode_fns(rs, want_crcs: bool = False) -> tuple[Callable, Callable]:
     """(parity_fn, fetch_fn) for a host ReedSolomon backend.
 
     Unlike the TPU pair — where parity_fn dispatches async device work
@@ -231,27 +300,57 @@ def local_encode_fns(rs) -> tuple[Callable, Callable]:
     WRITER POOL. The native SIMD shim releases the GIL inside its C
     call, so W writer threads encode W tiles concurrently instead of
     serializing the codec on the dispatcher thread (measured: the
-    single-thread native encode rate was the whole pipeline's cap)."""
+    single-thread native encode rate was the whole pipeline's cap).
 
-    def fetch_fn(tile: np.ndarray):
-        return rs._apply(rs.parity_rows, tile)
+    fetch_fn.charges = "compute_s": the matrix apply is HOST codec
+    work, not a device drain — without the tag the stage breakdown
+    would book the whole encode as writer-pool writeback time.
 
+    want_crcs=True makes fetch_fn return (parity, [k+p] CRC-32C) pairs
+    (codec.parity_with_crc) — the same fused-CRC stage contract the
+    device pairs serve on-chip."""
+
+    if want_crcs:
+
+        def fetch_fn(tile: np.ndarray):
+            return rs.parity_with_crc(tile)
+
+    else:
+
+        def fetch_fn(tile: np.ndarray):
+            return rs._apply(rs.parity_rows, tile)
+
+    fetch_fn.charges = "compute_s"
     return (lambda tile: tile), fetch_fn
 
 
-def local_rebuild_fns(rs) -> tuple[Callable, Callable]:
+def local_rebuild_fns(rs, want_crcs: bool = False) -> tuple[Callable, Callable]:
     """(rebuild_fn, fetch_fn) over a host ReedSolomon backend, with the
     inverted-survivor decode rows cached on the codec (rs.decode_rows)
     and the decode itself deferred to the writer pool (see
-    local_encode_fns)."""
+    local_encode_fns — including the compute_s charge tag and the
+    want_crcs (rebuilt, crcs) contract)."""
 
     def rebuild_fn(survivors, targets, tile: np.ndarray):
         return (tuple(survivors), tuple(targets), tile)
 
-    def fetch_fn(handle):
-        survivors, targets, tile = handle
-        return rs._apply(rs.decode_rows(survivors, targets), tile)
+    if want_crcs:
+        from seaweedfs_tpu.util.crc import crc32c
 
+        def fetch_fn(handle):
+            survivors, targets, tile = handle
+            rebuilt = rs._apply(rs.decode_rows(survivors, targets), tile)
+            return rebuilt, [
+                crc32c(np.ascontiguousarray(row).tobytes()) for row in rebuilt
+            ]
+
+    else:
+
+        def fetch_fn(handle):
+            survivors, targets, tile = handle
+            return rs._apply(rs.decode_rows(survivors, targets), tile)
+
+    fetch_fn.charges = "compute_s"
     return rebuild_fn, fetch_fn
 
 
@@ -269,6 +368,7 @@ def stream_write_ec_files(
     writer_threads: int | None = None,
     reader_threads: int | None = None,
     durable: bool = False,
+    want_crcs: bool = False,
 ) -> None:
     """Pipelined .dat → .ec00…13, byte-identical to write_ec_files.
 
@@ -280,19 +380,34 @@ def stream_write_ec_files(
 
     parity_fn([10, step] u8 host tile) must *dispatch* the parity
     computation and return an opaque handle immediately; fetch_fn turns
-    the handle into a [4, step] u8 numpy array (blocking; called
-    concurrently from the writer pool, so both must be thread-safe).
-    The defaults run the SWAR kernel on the attached TPU. The
-    indirection keeps the pipeline logic testable on CPU hosts (tests
-    inject a numpy parity_fn and still exercise tiling/offsets/write
-    paths)."""
+    the handle into a [4, step] u8 numpy array — or a
+    ([4, step] u8, [14] CRC-32C) pair when the stage computed fused
+    shard CRCs (blocking; called concurrently from the writer pool, so
+    both must be thread-safe). The defaults run the SWAR kernel on the
+    attached TPU. The indirection keeps the pipeline logic testable on
+    CPU hosts (tests inject a numpy parity_fn and still exercise
+    tiling/offsets/write paths).
+
+    want_crcs=True lands a 14-entry `shard_crcs` list in `stats`: the
+    standard CRC-32C of every finished shard FILE, folded from the
+    per-tile CRCs the stage pair returns (util/crc.crc32c_combine).
+    Tiles whose stage pair declined the fused CRC (injected test fns,
+    non-power-of-two tails) are checksummed host-side in the writer
+    pool and charged to compute_s — the contract holds either way.
+
+    Host staging buffers live in a _StagingRing of
+    pipeline_depth() + writer_threads + 1 slots (WEED_EC_PIPELINE_DEPTH
+    bounds the dispatched-but-unfetched window; the extras are the
+    buffers pool threads legitimately hold while working), so pipeline
+    memory is bounded and allocator churn stays out of the hot loop."""
     if (parity_fn is None) != (fetch_fn is None):
         raise ValueError("parity_fn and fetch_fn must be injected together")
     if parity_fn is None:
-        parity_fn, fetch_fn = _tpu_encode_fns()
+        parity_fn, fetch_fn = _tpu_encode_fns(want_crcs=want_crcs)
     tile_bytes = tile_bytes or DEFAULT_TILE_BYTES
     writer_threads = writer_threads or DEFAULT_WRITER_THREADS
     reader_threads = reader_threads or DEFAULT_READER_THREADS
+    depth = pipeline_depth()
 
     dat_path = base_file_name + ".dat"
     dat_size = os.path.getsize(dat_path)
@@ -329,12 +444,30 @@ def stream_write_ec_files(
     out_fds: list[int] = []  # opened inside the try: no leak on ENOSPC
     pipe = _Pipeline()
     read_q: queue.Queue = queue.Queue(maxsize=max(2, reader_threads))
-    write_q: queue.Queue = queue.Queue(maxsize=_INFLIGHT)
+    write_q: queue.Queue = queue.Queue(maxsize=depth)
+    # the staging ring: every in-flight tile lives in one of these
+    # preallocated slots (flat [rows*10*step] prefixes of slot buffers)
+    ring = _StagingRing(
+        depth + writer_threads + 1, DATA_SHARDS * tile_bytes
+    )
     # per-stage busy thread-seconds (queue waits excluded): read |
-    # dispatch | fetch (codec drain) | write — how e2e numbers stay
-    # attributable
-    busy = {"read_s": 0.0, "dispatch_s": 0.0, "fetch_s": 0.0, "write_s": 0.0}
+    # stage (host staging prep) | device (async dispatch) | writeback
+    # (device drain / D2H) or compute (host codec) | write — how e2e
+    # numbers stay attributable and reader/device/writer overlap is
+    # provable per run
+    busy = {
+        "read_s": 0.0,
+        "stage_s": 0.0,
+        "device_s": 0.0,
+        "writeback_s": 0.0,
+        "compute_s": 0.0,
+        "write_s": 0.0,
+    }
     busy_lock = threading.Lock()
+    fetch_bucket = getattr(fetch_fn, "charges", "writeback_s")
+    # per-tile shard CRCs, filled by the writer pool (index writes are
+    # GIL-atomic), folded into whole-file CRCs after the join
+    tile_crcs: list = [None] * len(tiles)
     wall0 = time.perf_counter()
     # tracing plane: the encode is one span whose stages are the pool
     # busy totals; entered manually because the body below already owns
@@ -354,18 +487,22 @@ def stream_write_ec_files(
                 if k is None:
                     return
                 row_off, block, batch_off, step, rows = tiles[k]
+                got_slot = ring.acquire(pipe.stop)
+                if got_slot is None:
+                    return
+                slot_id, buf = got_slot
                 t0 = time.perf_counter()
-                # one flat [rows, 10, step] buffer per tile, preadv
-                # straight into it (no bytes objects, no shared seek
-                # position across the pool), zero-padded past EOF like
-                # read_dat_tile — and only spans the .dat does not
+                # one flat [rows, 10, step] ring-slot prefix per tile,
+                # preadv straight into it (no bytes objects, no shared
+                # seek position across the pool), zero-padded past EOF
+                # like read_dat_tile — and only spans the .dat does not
                 # cover pay the memset. NO reshuffling into shard
                 # order: the codec consumes contiguous per-row [10,
                 # step] views and the writer gather-writes each shard's
                 # run of blocks with one iovec pwritev, so the bytes
                 # are copied exactly once between disk reads and
                 # writes.
-                flat = np.empty(rows * DATA_SHARDS * step, dtype=np.uint8)
+                flat = buf[: rows * DATA_SHARDS * step]
                 if batch_off == 0 and step == block:
                     # full rows are CONTIGUOUS in the .dat: one read
                     # covers the whole super-tile
@@ -390,7 +527,8 @@ def stream_write_ec_files(
                             if got < n:
                                 row[got:n] = 0
                 _charge(busy, busy_lock, "read_s", time.perf_counter() - t0)
-                if not _q_put(read_q, (k, flat), pipe.stop):
+                if not _q_put(read_q, (k, slot_id, flat), pipe.stop):
+                    ring.release(slot_id)
                     return
         finally:
             os.close(fd)
@@ -400,12 +538,41 @@ def stream_write_ec_files(
             item = _q_get(write_q, pipe.stop)
             if item is _EOF or item is _STOPPED:
                 return
-            k, flat, handles = item
+            k, slot_id, flat, handles = item
             _, _, _, step, rows = tiles[k]
             off = out_offs[k]
             t0 = time.perf_counter()
-            parities = [fetch_fn(h) for h in handles]
+            parities, crc_rows = [], []
+            for h in handles:
+                got = fetch_fn(h)
+                if isinstance(got, tuple):
+                    parities.append(got[0])
+                    crc_rows.append(got[1])
+                else:
+                    parities.append(got)
+                    crc_rows.append(None)
             t1 = time.perf_counter()
+            if want_crcs and any(c is None for c in crc_rows):
+                # the stage declined the fused CRC for this tile
+                # (injected pair / unsupported shape): table-CRC the
+                # written bytes here, charged as host compute
+                from seaweedfs_tpu.util.crc import crc32c
+
+                for r, c in enumerate(crc_rows):
+                    if c is not None:
+                        continue
+                    row0 = r * DATA_SHARDS * step
+                    crc_rows[r] = [
+                        crc32c(
+                            flat[row0 + i * step : row0 + (i + 1) * step]
+                            .tobytes()
+                        )
+                        for i in range(DATA_SHARDS)
+                    ] + [
+                        crc32c(np.ascontiguousarray(parities[r][p]).tobytes())
+                        for p in range(PARITY_SHARDS)
+                    ]
+            t2 = time.perf_counter()
             for i in range(DATA_SHARDS):
                 _pwritev_full(
                     out_fds[i],
@@ -424,9 +591,13 @@ def stream_write_ec_files(
                     [np.ascontiguousarray(parities[r][p]) for r in range(rows)],
                     off,
                 )
-            t2 = time.perf_counter()
-            _charge(busy, busy_lock, "fetch_s", t1 - t0)
-            _charge(busy, busy_lock, "write_s", t2 - t1)
+            t3 = time.perf_counter()
+            if want_crcs:
+                tile_crcs[k] = crc_rows
+            ring.release(slot_id)
+            _charge(busy, busy_lock, fetch_bucket, t1 - t0)
+            _charge(busy, busy_lock, "compute_s", t2 - t1)
+            _charge(busy, busy_lock, "write_s", t3 - t2)
 
     ok = False
     try:
@@ -448,22 +619,25 @@ def stream_write_ec_files(
             item = _q_get(read_q, pipe.stop)
             if item is _STOPPED:
                 break
-            k, flat = item
+            k, slot_id, flat = item
             _, _, _, step, rows = tiles[k]
             t0 = time.perf_counter()
-            # one parity dispatch per row: each [10, step] view is
-            # contiguous in the flat buffer, so the injected stage
-            # contract (and the TPU H2D) sees an ordinary tile
-            handles = [
-                parity_fn(
-                    flat[
-                        r * DATA_SHARDS * step : (r + 1) * DATA_SHARDS * step
-                    ].reshape(DATA_SHARDS, step)
-                )
+            # staging: each [10, step] view is contiguous in the ring
+            # slot, so the injected stage contract (and the TPU H2D)
+            # sees an ordinary tile
+            views = [
+                flat[
+                    r * DATA_SHARDS * step : (r + 1) * DATA_SHARDS * step
+                ].reshape(DATA_SHARDS, step)
                 for r in range(rows)
             ]
-            _charge(busy, busy_lock, "dispatch_s", time.perf_counter() - t0)
-            if not _q_put(write_q, (k, flat, handles), pipe.stop):
+            t1 = time.perf_counter()
+            # one async parity dispatch per row
+            handles = [parity_fn(v) for v in views]
+            t2 = time.perf_counter()
+            _charge(busy, busy_lock, "stage_s", t1 - t0)
+            _charge(busy, busy_lock, "device_s", t2 - t1)
+            if not _q_put(write_q, (k, slot_id, flat, handles), pipe.stop):
                 break
         for _ in range(writer_threads):
             if not _q_put(write_q, _EOF, pipe.stop):
@@ -513,11 +687,39 @@ def stream_write_ec_files(
                     _finish_stats(
                         stats, busy, wall0, reader_threads, writer_threads
                     )
+                    stats["pipeline_depth"] = depth
+                    stats["ring_slots"] = ring.slots
+                    if (
+                        want_crcs
+                        and ok
+                        and not pipe.errors
+                        and fsync_err is None
+                    ):
+                        stats["shard_crcs"] = _fold_encode_crcs(
+                            tiles, tile_crcs
+                        )
                 _trace_stages(_sp, busy)
                 # a stage error re-raised by pipe.finish() is live in
                 # this finally; hand it to the span so a failed drive
                 # is distinguishable from a clean one in /debug/traces
                 _sp.__exit__(*sys.exc_info())
+
+
+def _fold_encode_crcs(tiles: list, tile_crcs: list) -> list[int]:
+    """Whole-shard-file CRC-32C per shard from the per-tile row CRCs:
+    fold in tile/row generation order with crc32c_combine (tiles land
+    on disk in ANY order — positioned writes — but the fold is over
+    the recorded CRCs, so completion order is irrelevant here too)."""
+    from seaweedfs_tpu.util.crc import crc32c_combine
+
+    crcs = [0] * TOTAL_SHARDS
+    for k, (_, _, _, step, rows) in enumerate(tiles):
+        per_rows = tile_crcs[k]
+        for r in range(rows):
+            row = per_rows[r]
+            for i in range(TOTAL_SHARDS):
+                crcs[i] = crc32c_combine(crcs[i], int(row[i]), step)
+    return crcs
 
 
 # --- rebuild driver ---------------------------------------------------------
@@ -535,13 +737,21 @@ def stream_rebuild_ec_files(
     reader_threads: int | None = None,
     session=None,
     durable: bool = False,
+    want_crcs: bool = False,
 ) -> list[int]:
     """Pipelined shard rebuild, byte-identical to rebuild_ec_files.
 
     rebuild_fn(survivors, targets, [10, step] u8) dispatches
     reconstruction of `targets` from the survivor tile and returns a
-    handle; fetch_fn blocks it into [len(targets), step] u8 (called
-    from the writer pool — both must be thread-safe).
+    handle; fetch_fn blocks it into [len(targets), step] u8 — or a
+    ([len(targets), step] u8, [len(targets)] CRC-32C) pair when the
+    stage fused the Castagnoli pass (called from the writer pool —
+    both must be thread-safe).
+
+    want_crcs=True lands `shard_crcs` in `stats`: a {shard id: CRC-32C
+    of the whole rebuilt file} dict folded from per-range CRCs
+    (device-fused where the stage supports the shape, host table CRC
+    for donated ranges and odd tails, charged to compute_s).
 
     remote_readers maps shard id → fetch(offset, size) -> bytes for
     survivors that live on OTHER nodes: the reader pool pulls their
@@ -566,12 +776,20 @@ def stream_rebuild_ec_files(
     if (rebuild_fn is None) != (fetch_fn is None):
         raise ValueError("rebuild_fn and fetch_fn must be injected together")
     if rebuild_fn is None:
-        rebuild_fn, fetch_fn = _tpu_rebuild_fns()
-    # rebuild tiles read one span from each of 10 FILES (no contiguous
-    # row to coalesce, unlike encode), so bigger tiles amortize better
-    tile_bytes = tile_bytes or 2 * DEFAULT_TILE_BYTES
+        rebuild_fn, fetch_fn = _tpu_rebuild_fns(want_crcs=want_crcs)
+    # rebuild tiles read one span from each of 10 FILES. Re-swept with
+    # the staging ring (BENCH_r12): LOCAL rebuilds want fine tiles —
+    # 512 KiB ran 3.7 GB/s vs 1.9 at the old 2 MiB (more in-flight
+    # preads for the pool to overlap, page-cache-friendly spans) and
+    # 1.33x the serial classic driver. REMOTE rack-gathers keep a big
+    # tile: each tile costs one RPC per remote survivor, and 8x fewer
+    # round-trips beats overlap granularity across a network hop.
+    tile_bytes = tile_bytes or (
+        4 * DEFAULT_TILE_BYTES if remote_readers else DEFAULT_TILE_BYTES // 2
+    )
     writer_threads = writer_threads or DEFAULT_WRITER_THREADS
     reader_threads = reader_threads or DEFAULT_READER_THREADS
+    depth = pipeline_depth()
     remote_readers = dict(remote_readers or {})
 
     from seaweedfs_tpu.ec.ec_files import shard_presence, to_ext
@@ -605,9 +823,26 @@ def stream_rebuild_ec_files(
     out_fds: dict[int, int] = {}  # opened inside the try: no leak on ENOSPC
     pipe = _Pipeline()
     read_q: queue.Queue = queue.Queue(maxsize=max(2, reader_threads))
-    write_q: queue.Queue = queue.Queue(maxsize=_INFLIGHT)
-    busy = {"read_s": 0.0, "dispatch_s": 0.0, "fetch_s": 0.0, "write_s": 0.0}
+    write_q: queue.Queue = queue.Queue(maxsize=depth)
+    # staging ring for survivor-gather tiles: gap gathers sub-allocate
+    # contiguous [k, g_len] views out of one flat slot per tile
+    ring = _StagingRing(
+        depth + writer_threads + 1, DATA_SHARDS * tile_bytes
+    )
+    busy = {
+        "read_s": 0.0,
+        "stage_s": 0.0,
+        "device_s": 0.0,
+        "writeback_s": 0.0,
+        "compute_s": 0.0,
+        "write_s": 0.0,
+    }
     busy_lock = threading.Lock()
+    fetch_bucket = getattr(fetch_fn, "charges", "writeback_s")
+    # (range offset, range length, [crc per target]) from the writer
+    # pool, folded into whole-file CRCs after the join (append is
+    # GIL-atomic; order restored by sorting on offset)
+    crc_ranges: list[tuple[int, int, list[int]]] = []
     wall0 = time.perf_counter()
     # tracing plane: rebuild span (inherits the scrub/repair plane tag
     # when the caller's context carries one — cross-plane interference
@@ -640,11 +875,11 @@ def stream_rebuild_ec_files(
             else None
         )
 
-        def gather(g_off: int, g_len: int) -> np.ndarray:
-            """One [k, g_len] survivor read at g_off — the only place
-            rebuild bytes cross a disk or the network, so the repair
-            accounting lives here."""
-            tile = np.empty((DATA_SHARDS, g_len), dtype=np.uint8)
+        def gather(g_off: int, g_len: int, dest: np.ndarray) -> np.ndarray:
+            """One [k, g_len] survivor read at g_off into a staging-
+            ring view — the only place rebuild bytes cross a disk or
+            the network, so the repair accounting lives here."""
+            tile = dest.reshape(DATA_SHARDS, g_len)
             futures = {}
             if fetch_pool is not None:
                 futures = {
@@ -684,23 +919,36 @@ def stream_rebuild_ec_files(
                     # serve-first arbitration: degraded GET gathers in
                     # flight own the disks/links; repair waits (bounded)
                     session.yield_to_serving()
-                t0 = time.perf_counter()
                 step = min(tile_bytes, shard_size - offset)
                 if session is not None:
                     covered, gaps = session.consume(offset, step)
                 else:
                     covered, gaps = [], [(offset, step)]
+                slot_id = -1
+                if gaps:
+                    got_slot = ring.acquire(pipe.stop)
+                    if got_slot is None:
+                        return
+                    slot_id, buf = got_slot
+                t0 = time.perf_counter()
                 # parts: ("don", off, {target: bytes}) ride through as
                 # bytes; ("raw", off, [k, n] tile) get decoded. Only the
                 # gaps pay survivor reads — donated ranges moved zero
-                # new bytes (arXiv:2205.11015's partial-repair shape)
+                # new bytes (arXiv:2205.11015's partial-repair shape).
+                # Gap tiles sub-allocate contiguous views out of the
+                # tile's ring slot (Σ gap bytes ≤ step, so they fit).
                 parts: list = [
                     ("don", d_off, per_t) for d_off, per_t in covered
                 ]
+                cur = 0
                 for g_off, g_len in gaps:
-                    parts.append(("raw", g_off, gather(g_off, g_len)))
+                    dest = buf[cur : cur + DATA_SHARDS * g_len]
+                    cur += DATA_SHARDS * g_len
+                    parts.append(("raw", g_off, gather(g_off, g_len, dest)))
                 _charge(busy, busy_lock, "read_s", time.perf_counter() - t0)
-                if not _q_put(read_q, (offset, parts), pipe.stop):
+                if not _q_put(read_q, (offset, slot_id, parts), pipe.stop):
+                    if slot_id >= 0:
+                        ring.release(slot_id)
                     return
         finally:
             if fetch_pool is not None:
@@ -717,26 +965,56 @@ def stream_rebuild_ec_files(
             item = _q_get(write_q, pipe.stop)
             if item is _EOF or item is _STOPPED:
                 return
-            _offset, parts = item
+            _offset, slot_id, parts = item
             t0 = time.perf_counter()
-            fetched = [
-                (kind, off, fetch_fn(payload) if kind == "h" else payload)
-                for kind, off, payload in parts
-            ]
+            fetched = []
+            for kind, off, payload in parts:
+                crcs = None
+                if kind == "h":
+                    payload = fetch_fn(payload)
+                    if isinstance(payload, tuple):
+                        payload, crcs = payload
+                fetched.append((kind, off, payload, crcs))
             t1 = time.perf_counter()
-            for kind, off, payload in fetched:
+            if want_crcs:
+                # donated ranges and declined-fused tiles: table-CRC
+                # the bytes being written, charged as host compute
+                from seaweedfs_tpu.util.crc import crc32c
+
+                filled = []
+                for kind, off, payload, crcs in fetched:
+                    if crcs is None:
+                        if kind == "don":
+                            crcs = [crc32c(payload[i]) for i in targets]
+                        else:
+                            crcs = [
+                                crc32c(np.ascontiguousarray(payload[j]).tobytes())
+                                for j in range(len(targets))
+                            ]
+                    filled.append((kind, off, payload, crcs))
+                fetched = filled
+            t2 = time.perf_counter()
+            for kind, off, payload, crcs in fetched:
                 if kind == "don":
                     for i in targets:
                         _pwrite_full(out_fds[i], payload[i], off)
                         EC_REPAIR_BYTES_WRITTEN.inc(len(payload[i]))
+                    length = len(payload[targets[0]]) if targets else 0
                 else:
+                    length = 0
                     for j, i in enumerate(targets):
                         row = np.ascontiguousarray(payload[j])
                         _pwrite_full(out_fds[i], row, off)
                         EC_REPAIR_BYTES_WRITTEN.inc(len(row))
-            t2 = time.perf_counter()
-            _charge(busy, busy_lock, "fetch_s", t1 - t0)
-            _charge(busy, busy_lock, "write_s", t2 - t1)
+                        length = len(row)
+                if want_crcs and crcs is not None:
+                    crc_ranges.append((off, length, [int(c) for c in crcs]))
+            t3 = time.perf_counter()
+            if slot_id >= 0:
+                ring.release(slot_id)
+            _charge(busy, busy_lock, fetch_bucket, t1 - t0)
+            _charge(busy, busy_lock, "compute_s", t2 - t1)
+            _charge(busy, busy_lock, "write_s", t3 - t2)
 
     ok = False
     try:
@@ -756,7 +1034,7 @@ def stream_rebuild_ec_files(
             item = _q_get(read_q, pipe.stop)
             if item is _STOPPED:
                 break
-            offset, parts = item
+            offset, slot_id, parts = item
             t0 = time.perf_counter()
             parts = [
                 (
@@ -766,8 +1044,8 @@ def stream_rebuild_ec_files(
                 )
                 for kind, off, payload in parts
             ]
-            _charge(busy, busy_lock, "dispatch_s", time.perf_counter() - t0)
-            if not _q_put(write_q, (offset, parts), pipe.stop):
+            _charge(busy, busy_lock, "device_s", time.perf_counter() - t0)
+            if not _q_put(write_q, (offset, slot_id, parts), pipe.stop):
                 break
         for _ in range(writer_threads):
             if not _q_put(write_q, _EOF, pipe.stop):
@@ -818,6 +1096,17 @@ def stream_rebuild_ec_files(
                     _finish_stats(
                         stats, busy, wall0, reader_threads, writer_threads
                     )
+                    stats["pipeline_depth"] = depth
+                    stats["ring_slots"] = ring.slots
+                    if (
+                        want_crcs
+                        and ok
+                        and not pipe.errors
+                        and fsync_err is None
+                    ):
+                        stats["shard_crcs"] = _fold_rebuild_crcs(
+                            targets, crc_ranges
+                        )
                     if session is not None:
                         stats["donated_bytes"] = session.donated_bytes
                         stats["used_donated_bytes"] = (
@@ -835,15 +1124,35 @@ def stream_rebuild_ec_files(
     return list(targets)
 
 
+def _fold_rebuild_crcs(
+    targets: tuple[int, ...], crc_ranges: list[tuple[int, int, list[int]]]
+) -> dict[int, int]:
+    """{target shard id: whole-file CRC-32C} from the writer pool's
+    per-range CRCs: ranges land in any order (positioned writes), so
+    sort by offset and fold with crc32c_combine."""
+    from seaweedfs_tpu.util.crc import crc32c_combine
+
+    acc = {i: 0 for i in targets}
+    for _off, length, crcs in sorted(crc_ranges, key=lambda r: r[0]):
+        for j, i in enumerate(targets):
+            acc[i] = crc32c_combine(acc[i], crcs[j], length)
+    return acc
+
+
 def _trace_stages(sp, busy: dict) -> None:
     """Fold the driver's per-stage busy thread-seconds onto its span as
     the three pipeline stages an operator reasons about: reader-pool
-    (disk/remote reads), compute (codec dispatch + drain), writer-pool
-    (shard pwritev)."""
+    (disk/remote reads), compute (staging + device dispatch/drain +
+    host codec), writer-pool (shard pwritev)."""
     sp.add_stages(
         {
             "reader-pool": busy.get("read_s", 0.0),
-            "compute": busy.get("dispatch_s", 0.0) + busy.get("fetch_s", 0.0),
+            "compute": (
+                busy.get("stage_s", 0.0)
+                + busy.get("device_s", 0.0)
+                + busy.get("writeback_s", 0.0)
+                + busy.get("compute_s", 0.0)
+            ),
             "writer-pool": busy.get("write_s", 0.0),
         }
     )
@@ -870,7 +1179,8 @@ def _finish_stats(
     flush = busy.get("flush_s", 0.0)
     widths = {
         "read_s": reader_threads,
-        "fetch_s": writer_threads,
+        "writeback_s": writer_threads,
+        "compute_s": writer_threads,
         "write_s": writer_threads,
     }
     pipeline_max = max(
@@ -884,6 +1194,18 @@ def _finish_stats(
     stats.update({k: round(v, 4) for k, v in busy.items()})
     stats["wall_s"] = round(wall, 4)
     stats["loop_s"] = round(max(0.0, wall - flush - pipeline_max), 4)
+    # busy thread-seconds in excess of wall = stage time that ran
+    # CONCURRENTLY with another stage: the mechanical proof that
+    # reader / device / writer work actually overlapped this run
+    # (0 would mean the pipeline degenerated to a serial chain)
+    stats["overlap_s"] = round(
+        max(
+            0.0,
+            sum(v for k, v in busy.items() if k != "flush_s")
+            - (wall - flush),
+        ),
+        4,
+    )
     stats["reader_threads"] = reader_threads
     stats["writer_threads"] = writer_threads
 
@@ -898,15 +1220,27 @@ def _swar_ok(step: int) -> bool:
 
 
 def _fetch(handle) -> np.ndarray:
-    """Block a dispatched kernel handle into a host uint8 array."""
+    """Block a dispatched kernel handle into a host uint8 array — or
+    (uint8 array, crc uint32 array) when the dispatch fused the CRC
+    pass (the driver splits on the tuple)."""
     import jax
 
-    out, swar = handle
+    out, swar, fused_crc = handle
+    if fused_crc:
+        dev, crcs = out
+        host = np.asarray(jax.device_get(dev))
+        return host.view(np.uint8), np.asarray(jax.device_get(crcs))
     host = np.asarray(jax.device_get(out))
     return host.view(np.uint8) if swar else host
 
 
-def _tpu_encode_fns():
+def _crc_ok(step: int, want_crcs: bool) -> bool:
+    from seaweedfs_tpu.ec import crc_kernel
+
+    return want_crcs and crc_kernel.crc_supported(step)
+
+
+def _tpu_encode_fns(want_crcs: bool = False):
     import jax
     import jax.numpy as jnp
 
@@ -920,20 +1254,32 @@ def _tpu_encode_fns():
     encode_u32_don = jax.jit(
         lambda u32: kern.encode_u32(u32), donate_argnums=0
     )
+    # fused encode+CRC program (ec/crc_kernel.py rides the same
+    # dispatch): parity AND all 14 per-row CRCs come back from one
+    # device pass, so the host never re-reads parity bytes to
+    # checksum them
+    encode_u32_crc_don = jax.jit(
+        lambda u32: kern.encode_u32_crc(u32), donate_argnums=0
+    )
 
     def parity_fn(tile: np.ndarray):
         swar = _swar_ok(tile.shape[1])
-        if swar:
+        fused_crc = _crc_ok(tile.shape[1], want_crcs)
+        if swar and fused_crc:
+            u32 = jnp.asarray(tile.view(np.uint32))  # async H2D
+            out = encode_u32_crc_don(u32)
+        elif swar:
             u32 = jnp.asarray(tile.view(np.uint32))  # async H2D
             out = encode_u32_don(u32)  # async dispatch
         else:
             out = kern.encode(jnp.asarray(tile))
-        return out, swar
+            fused_crc = False
+        return out, swar, fused_crc
 
     return parity_fn, _fetch
 
 
-def _tpu_rebuild_fns():
+def _tpu_rebuild_fns(want_crcs: bool = False):
     import jax
     import jax.numpy as jnp
 
@@ -945,14 +1291,482 @@ def _tpu_rebuild_fns():
         static_argnums=(0, 1),
         donate_argnums=2,
     )
+    recon_crc_don = jax.jit(
+        lambda s, t, u32: kern.reconstruct_u32_crc(s, t, u32),
+        static_argnums=(0, 1),
+        donate_argnums=2,
+    )
 
     def rebuild_fn(survivors, targets, tile: np.ndarray):
         swar = _swar_ok(tile.shape[1])
-        if swar:
+        fused_crc = _crc_ok(tile.shape[1], want_crcs)
+        if swar and fused_crc:
+            u32 = jnp.asarray(tile.view(np.uint32))
+            out = recon_crc_don(tuple(survivors), tuple(targets), u32)
+        elif swar:
             u32 = jnp.asarray(tile.view(np.uint32))
             out = recon_don(tuple(survivors), tuple(targets), u32)
         else:
             out = kern.reconstruct(survivors, targets, jnp.asarray(tile))
-        return out, swar
+            fused_crc = False
+        return out, swar, fused_crc
 
     return rebuild_fn, _fetch
+
+
+# --- mesh-batched encode driver ---------------------------------------------
+
+
+def _read_tile_into(
+    fd: int, dat_size: int, row_off: int, block: int, batch_off: int,
+    step: int, dest: np.ndarray,
+) -> None:
+    """Fill dest [10, step] (ring-slot views) with one volume's tile of
+    the .dat, zero-padded past EOF — the single home of the batch
+    reader's striping math (same layout the single-volume reader
+    inlines). Per-row reads even for full rows: dest rows are strided
+    views of the batch slot, so there is no contiguous span to
+    coalesce into one pread here."""
+    for i in range(DATA_SHARDS):
+        row = dest[i]
+        off = row_off + i * block + batch_off
+        n = max(0, min(step, dat_size - off))
+        if n < step:
+            row[n:] = 0
+        if n:
+            got = _pread_into(fd, row[:n], off)
+            if got < n:
+                row[got:n] = 0
+
+
+def stream_write_ec_files_batch(
+    base_file_names: list[str],
+    codec=None,
+    tile_bytes: int | None = None,
+    large_block_size: int = LARGE_BLOCK_SIZE,
+    small_block_size: int = SMALL_BLOCK_SIZE,
+    stats: dict | None = None,
+    durable: bool = False,
+    want_crcs: bool = False,
+    reader_threads: int | None = None,
+    writer_threads: int | None = None,
+) -> None:
+    """Pipelined batch-of-volumes encode through the mesh codec: N
+    sealed .dat files → N shard sets, byte-identical per volume to
+    write_ec_files, with disk reads, host staging, the sharded device
+    program (parallel/mesh_codec.encode_batch_u32[_crc] under
+    shard_map), device drain, and shard pwritevs all overlapped by the
+    same staging-ring pipeline the single-volume driver runs. This is
+    how a batch of SMALL volumes saturates one chip (the per-volume
+    dispatch was latency-bound) and a mesh of chips splits the stream
+    axis of large ones.
+
+    codec=None self-provisions a MeshCodec whose 'vol' axis is the gcd
+    of batch size and device count (any batch shards cleanly); when
+    jax itself is unavailable the whole batch falls back to the
+    single-volume host-codec driver per volume — byte-identical, just
+    unbatched. WEED_EC_PIPELINE_BATCH caps volumes per dispatch round
+    (ring memory = slots x batch x 10 x tile bytes).
+
+    want_crcs=True lands `shard_crcs` in stats: one 14-entry CRC-32C
+    list per volume (fused on-mesh for full-width rounds — including
+    the stripe-axis CRC composition collective — host table CRC for
+    the short tail round)."""
+    if not base_file_names:
+        return
+    limit = pipeline_batch_limit()
+    if limit and len(base_file_names) > limit:
+        all_crcs: list = []
+        for i in range(0, len(base_file_names), limit):
+            chunk_stats: dict = {}
+            stream_write_ec_files_batch(
+                base_file_names[i : i + limit],
+                # each chunk self-provisions a mesh that fits ITS size
+                # (gcd sizing): a caller codec built for the WHOLE
+                # batch need not divide a chunk — passing it through
+                # would brick the verb the moment the memory-cap knob
+                # splits the batch unevenly
+                codec=None,
+                tile_bytes=tile_bytes,
+                large_block_size=large_block_size,
+                small_block_size=small_block_size,
+                stats=chunk_stats,
+                durable=durable,
+                want_crcs=want_crcs,
+                reader_threads=reader_threads,
+                writer_threads=writer_threads,
+            )
+            if want_crcs:
+                all_crcs.extend(chunk_stats.get("shard_crcs", []))
+            if stats is not None:
+                for k, v in chunk_stats.items():
+                    if isinstance(v, float):
+                        # stage seconds accumulate across chunks
+                        stats[k] = round(stats.get(k, 0.0) + v, 4)
+                    elif k != "shard_crcs":
+                        # structural fields (pipeline_depth, mesh,
+                        # ring_slots, thread counts): last chunk's
+                        # values — dropping them would break every
+                        # consumer the docs promise them to
+                        stats[k] = v
+        if stats is not None:
+            stats["batch_volumes"] = len(base_file_names)
+            if want_crcs:
+                stats["shard_crcs"] = all_crcs
+        return
+    if codec is None:
+        try:
+            codec = _default_mesh_codec(len(base_file_names))
+        except ImportError:
+            # no jax at all: the host-codec single-volume pipeline is
+            # the byte-identical fallback seam
+            from seaweedfs_tpu.ec.codec import new_encoder
+
+            rs = new_encoder()
+            all_crcs = []
+            for base in base_file_names:
+                s: dict = {}
+                parity_fn, fetch_fn = local_encode_fns(rs, want_crcs=want_crcs)
+                stream_write_ec_files(
+                    base,
+                    tile_bytes=tile_bytes,
+                    large_block_size=large_block_size,
+                    small_block_size=small_block_size,
+                    parity_fn=parity_fn,
+                    fetch_fn=fetch_fn,
+                    stats=s,
+                    durable=durable,
+                    want_crcs=want_crcs,
+                )
+                if want_crcs:
+                    all_crcs.append(s.get("shard_crcs"))
+            if stats is not None:
+                stats["fallback"] = "host"
+                if want_crcs:
+                    stats["shard_crcs"] = all_crcs
+            return
+    _stream_batch_chunk(
+        base_file_names, codec, tile_bytes, large_block_size,
+        small_block_size, stats, durable, want_crcs, reader_threads,
+        writer_threads,
+    )
+
+
+def _default_mesh_codec(batch: int):
+    """MeshCodec over all devices with the 'vol' axis sized to
+    gcd(batch, devices) so any batch shards cleanly (the
+    BatchGenerate verb's mesh recipe, now owned by the driver)."""
+    import math
+
+    import jax
+
+    from seaweedfs_tpu.parallel import MeshCodec, make_mesh
+
+    devices = jax.devices()
+    vol_axis = math.gcd(batch, len(devices))
+    return MeshCodec(make_mesh(devices, stripe=len(devices) // vol_axis))
+
+
+def _stream_batch_chunk(
+    bases: list[str], codec, tile_bytes, large_block_size, small_block_size,
+    stats, durable, want_crcs, reader_threads, writer_threads,
+) -> None:
+    from seaweedfs_tpu.ec.ec_files import (
+        iter_ec_tiles, shard_file_size, to_ext,
+    )
+
+    tile_bytes = tile_bytes or DEFAULT_TILE_BYTES
+    writer_threads = writer_threads or DEFAULT_WRITER_THREADS
+    reader_threads = reader_threads or DEFAULT_READER_THREADS
+    depth = pipeline_depth()
+    b = len(bases)
+    vol_axis = codec.mesh.devices.shape[0]
+    stripe = codec.mesh.devices.shape[1]
+    if b % vol_axis:
+        raise ValueError(
+            f"batch of {b} volumes does not shard over the mesh's "
+            f"{vol_axis}-way 'vol' axis"
+        )
+
+    sizes = [os.path.getsize(base + ".dat") for base in bases]
+    tiles = [
+        list(
+            iter_ec_tiles(size, tile_bytes, large_block_size, small_block_size)
+        )
+        for size in sizes
+    ]
+    rounds = max((len(ts) for ts in tiles), default=0)
+    if not rounds:
+        # all .dat files empty: 14 empty shard files each — fsynced
+        # when durable, so the caller's .ecx publish can never outlive
+        # shard files a crash could drop
+        from seaweedfs_tpu.util import durable as _durable
+
+        for base in bases:
+            for i in range(TOTAL_SHARDS):
+                open(base + to_ext(i), "wb").close()
+                if durable:
+                    _durable.fsync_path(base + to_ext(i))
+        if stats is not None and want_crcs:
+            stats["shard_crcs"] = [[0] * TOTAL_SHARDS for _ in bases]
+        return
+    # one static tile width for every round (finished volumes ride as
+    # zero-step entries whose output is discarded), rounded so the u32
+    # lane count splits over the stripe axis in whole SWAR-friendly
+    # chunks — shapes stay static, the mesh program compiles once
+    max_step = max(step for ts in tiles for _, _, _, step in ts)
+    gran = 4 * 1024 * stripe
+    width = -(-max_step // gran) * gran
+    # fused CRC needs power-of-two lanes per device (crc_kernel); the
+    # tail rounds (step < width) are host-checksummed regardless
+    fused_crc = want_crcs and codec.crc_supported(width)
+    step_of = [
+        [(ts[r][3] if r < len(ts) else 0) for ts in tiles]
+        for r in range(rounds)
+    ]
+    out_offs = []  # [rounds][volume] output offset
+    acc = [0] * b
+    for r in range(rounds):
+        out_offs.append(list(acc))
+        for v in range(b):
+            acc[v] += step_of[r][v]
+
+    pipe = _Pipeline()
+    read_q: queue.Queue = queue.Queue(maxsize=max(2, reader_threads))
+    write_q: queue.Queue = queue.Queue(maxsize=depth)
+    ring = _StagingRing(
+        depth + writer_threads + 1, b * DATA_SHARDS * width
+    )
+    busy = {
+        "read_s": 0.0,
+        "stage_s": 0.0,
+        "device_s": 0.0,
+        "writeback_s": 0.0,
+        "compute_s": 0.0,
+        "write_s": 0.0,
+    }
+    busy_lock = threading.Lock()
+    round_crcs: list = [None] * rounds
+    wall0 = time.perf_counter()
+    _sp = trace.span("ec_stream.encode_batch", nbytes=sum(sizes))
+    _sp.__enter__()
+
+    idx_lock = threading.Lock()
+    idx_iter = iter(range(rounds))
+    out_fds: list[list[int]] = []
+
+    def reader():
+        fds = [os.open(base + ".dat", os.O_RDONLY) for base in bases]
+        try:
+            while True:
+                with idx_lock:
+                    r = next(idx_iter, None)
+                if r is None:
+                    return
+                got_slot = ring.acquire(pipe.stop)
+                if got_slot is None:
+                    return
+                slot_id, buf = got_slot
+                t0 = time.perf_counter()
+                buf3 = buf[: b * DATA_SHARDS * width].reshape(
+                    b, DATA_SHARDS, width
+                )
+                for v in range(b):
+                    if r >= len(tiles[v]):
+                        continue  # volume done: zero-step, output discarded
+                    row_off, block, batch_off, step = tiles[v][r]
+                    _read_tile_into(
+                        fds[v], sizes[v], row_off, block, batch_off, step,
+                        buf3[v, :, :step],
+                    )
+                _charge(busy, busy_lock, "read_s", time.perf_counter() - t0)
+                if not _q_put(read_q, (r, slot_id, buf3), pipe.stop):
+                    ring.release(slot_id)
+                    return
+        finally:
+            for fd in fds:
+                os.close(fd)
+
+    def writer():
+        import jax
+
+        while True:
+            item = _q_get(write_q, pipe.stop)
+            if item is _EOF or item is _STOPPED:
+                return
+            r, slot_id, buf3, handle = item
+            t0 = time.perf_counter()
+            if fused_crc:
+                parity_dev, crcs_dev = handle
+                crcs = np.asarray(jax.device_get(crcs_dev))
+            else:
+                parity_dev, crcs = handle, None
+            parity = (
+                np.asarray(jax.device_get(parity_dev))
+                .view(np.uint8)
+                .reshape(b, PARITY_SHARDS, width)
+            )
+            t1 = time.perf_counter()
+            vol_crcs: list = [None] * b
+            if want_crcs:
+                from seaweedfs_tpu.util.crc import crc32c
+
+                for v in range(b):
+                    step = step_of[r][v]
+                    if not step:
+                        continue
+                    if crcs is not None and step == width:
+                        vol_crcs[v] = [int(c) for c in crcs[v]]
+                    else:
+                        # tail round: the fused CRC would cover the
+                        # padded width; table-CRC the written bytes
+                        vol_crcs[v] = [
+                            crc32c(buf3[v, i, :step].tobytes())
+                            for i in range(DATA_SHARDS)
+                        ] + [
+                            crc32c(
+                                np.ascontiguousarray(
+                                    parity[v, p, :step]
+                                ).tobytes()
+                            )
+                            for p in range(PARITY_SHARDS)
+                        ]
+            t2 = time.perf_counter()
+            for v in range(b):
+                step = step_of[r][v]
+                if not step:
+                    continue
+                off = out_offs[r][v]
+                for i in range(DATA_SHARDS):
+                    _pwrite_full(out_fds[v][i], buf3[v, i, :step], off)
+                for p in range(PARITY_SHARDS):
+                    _pwrite_full(
+                        out_fds[v][DATA_SHARDS + p],
+                        np.ascontiguousarray(parity[v, p, :step]),
+                        off,
+                    )
+            t3 = time.perf_counter()
+            if want_crcs:
+                round_crcs[r] = vol_crcs
+            ring.release(slot_id)
+            _charge(busy, busy_lock, "writeback_s", t1 - t0)
+            _charge(busy, busy_lock, "compute_s", t2 - t1)
+            _charge(busy, busy_lock, "write_s", t3 - t2)
+
+    ok = False
+    try:
+        for v, base in enumerate(bases):
+            fds = []
+            out_fds.append(fds)
+            for i in range(TOTAL_SHARDS):
+                fds.append(
+                    os.open(
+                        base + to_ext(i),
+                        os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                        0o644,
+                    )
+                )
+            size = shard_file_size(
+                sizes[v], large_block_size, small_block_size
+            )
+            for fd in fds:
+                _preallocate(fd, size)
+        for _ in range(min(reader_threads, rounds)):
+            pipe.spawn(reader)
+        for _ in range(writer_threads):
+            pipe.spawn(writer)
+        for _ in range(rounds):
+            item = _q_get(read_q, pipe.stop)
+            if item is _STOPPED:
+                break
+            r, slot_id, buf3 = item
+            t0 = time.perf_counter()
+            # staging: the u32 lane view is free host-side; device_put
+            # lays the batch out P('vol', None, 'stripe') over the mesh
+            vols = codec.shard_volumes(buf3.view(np.uint32))
+            t1 = time.perf_counter()
+            handle = (
+                codec.encode_batch_u32_crc(vols)
+                if fused_crc
+                else codec.encode_batch_u32(vols)
+            )
+            t2 = time.perf_counter()
+            _charge(busy, busy_lock, "stage_s", t1 - t0)
+            _charge(busy, busy_lock, "device_s", t2 - t1)
+            if not _q_put(write_q, (r, slot_id, buf3, handle), pipe.stop):
+                break
+        for _ in range(writer_threads):
+            if not _q_put(write_q, _EOF, pipe.stop):
+                break
+        ok = True
+    finally:
+        try:
+            pipe.finish(caller_error=not ok)
+        finally:
+            tc0 = time.perf_counter()
+            fsync_err: OSError | None = None
+            try:
+                for fds in out_fds:
+                    for fd in fds:
+                        try:
+                            if durable and ok and not pipe.errors:
+                                try:
+                                    os.fsync(fd)
+                                except OSError as e:
+                                    if fsync_err is None:
+                                        fsync_err = e
+                            os.close(fd)
+                        except OSError:
+                            pass
+                if not ok or pipe.errors or fsync_err is not None:
+                    # same abort contract as the single-volume driver:
+                    # no partial shard set may survive for ANY volume
+                    for base in bases:
+                        for i in range(TOTAL_SHARDS):
+                            try:
+                                os.remove(base + to_ext(i))
+                            except OSError:
+                                pass
+                if fsync_err is not None:
+                    raise fsync_err
+            finally:
+                busy["flush_s"] = time.perf_counter() - tc0
+                if stats is not None:
+                    _finish_stats(
+                        stats, busy, wall0, reader_threads, writer_threads
+                    )
+                    stats["pipeline_depth"] = depth
+                    stats["ring_slots"] = ring.slots
+                    stats["batch_volumes"] = b
+                    stats["mesh"] = {"vol": vol_axis, "stripe": stripe}
+                    if (
+                        want_crcs
+                        and ok
+                        and not pipe.errors
+                        and fsync_err is None
+                    ):
+                        stats["shard_crcs"] = _fold_batch_crcs(
+                            b, step_of, round_crcs
+                        )
+                _trace_stages(_sp, busy)
+                _sp.__exit__(*sys.exc_info())
+
+
+def _fold_batch_crcs(
+    b: int, step_of: list[list[int]], round_crcs: list
+) -> list[list[int]]:
+    """Per-volume 14-entry whole-file CRCs from the per-round writer
+    records, folded in round order."""
+    from seaweedfs_tpu.util.crc import crc32c_combine
+
+    out = []
+    for v in range(b):
+        acc = [0] * TOTAL_SHARDS
+        for r, vol_crcs in enumerate(round_crcs):
+            step = step_of[r][v]
+            if not step or vol_crcs is None or vol_crcs[v] is None:
+                continue
+            for i in range(TOTAL_SHARDS):
+                acc[i] = crc32c_combine(acc[i], vol_crcs[v][i], step)
+        out.append(acc)
+    return out

@@ -20,11 +20,22 @@ Correctness leans on two facts:
     regenerated file), quarantine, and rebuild itself — EcVolume
     invalidates on each.
 
+Scan resistance (segmented admission): a sequential scan through a
+dead shard touches every tile exactly once; in a plain LRU those
+one-touch tiles march straight through and evict the hot set. Tiles
+therefore land in a small PROBATION segment first (bounded at
+capacity/8, min one tile) and are only promoted to the protected
+segment on a second touch — a get() hit while still probationary.
+Scans churn probation only; eviction under global pressure drains
+probation before it ever considers a protected tile.
+WEED_EC_TILE_SCAN=0 restores the plain single-segment LRU wholesale.
+
 The cache is per-EcVolume (dropped wholesale with the volume), bounded
 in bytes, and safe for concurrent readers. Knobs (docs/OPERATIONS.md
 env table): WEED_EC_TILE_CACHE=0 disables, WEED_EC_TILE_CACHE_MB
 bounds the per-volume footprint (default 64), WEED_EC_TILE_BYTES sets
-the tile granularity (default 256 KiB).
+the tile granularity (default 256 KiB), WEED_EC_TILE_SCAN=0 disables
+the probationary segment.
 """
 
 from __future__ import annotations
@@ -47,7 +58,8 @@ def _int_or(raw: str, default: int) -> int:
 
 
 class TileCache:
-    """LRU of (shard_id, tile_offset) -> reconstructed bytes."""
+    """Segmented LRU of (shard_id, tile_offset) -> reconstructed bytes:
+    probation (one-touch, scan-churned) + protected (second-touch)."""
 
     def __init__(
         self,
@@ -74,9 +86,19 @@ class TileCache:
         self.tile_bytes = max(4096, tile_bytes)
         if os.environ.get("WEED_EC_TILE_CACHE", "1") == "0":
             self.capacity_bytes = 0
+        self.scan_resistant = (
+            os.environ.get("WEED_EC_TILE_SCAN", "1") != "0"
+        )
+        # probation stays SMALL: a scan can only ever churn this much
+        self.probation_bytes_cap = min(
+            self.capacity_bytes,
+            max(self.tile_bytes, self.capacity_bytes // 8),
+        )
         self._lock = threading.Lock()
-        self._tiles: OrderedDict[tuple[int, int], bytes] = OrderedDict()
-        self._bytes = 0
+        self._probation: OrderedDict[tuple[int, int], bytes] = OrderedDict()
+        self._protected: OrderedDict[tuple[int, int], bytes] = OrderedDict()
+        self._prob_bytes = 0
+        self._prot_bytes = 0
         self.invalidations = 0
 
     @property
@@ -86,14 +108,43 @@ class TileCache:
     @property
     def total_bytes(self) -> int:
         with self._lock:
-            return self._bytes
+            return self._prob_bytes + self._prot_bytes
+
+    def _evict_over_bounds(self) -> None:
+        """Lock held. Probation to its own cap, then the global bound —
+        probation drains first, protected only under residual
+        pressure (how a scan never touches the hot set)."""
+        while self._prob_bytes > self.probation_bytes_cap and self._probation:
+            _, v = self._probation.popitem(last=False)
+            self._prob_bytes -= len(v)
+        while (
+            self._prob_bytes + self._prot_bytes > self.capacity_bytes
+        ) and (self._probation or self._protected):
+            if self._probation:
+                _, v = self._probation.popitem(last=False)
+                self._prob_bytes -= len(v)
+            else:
+                _, v = self._protected.popitem(last=False)
+                self._prot_bytes -= len(v)
 
     def get(self, shard_id: int, tile_off: int) -> bytes | None:
-        """Counted probe (hit/miss land on weed_ec_tile_cache_total)."""
+        """Counted probe (hit/miss land on weed_ec_tile_cache_total).
+        A probationary hit is the second touch: the tile promotes to
+        the protected segment."""
+        key = (shard_id, tile_off)
         with self._lock:
-            data = self._tiles.get((shard_id, tile_off))
+            data = self._protected.get(key)
             if data is not None:
-                self._tiles.move_to_end((shard_id, tile_off))
+                self._protected.move_to_end(key)
+            else:
+                data = self._probation.get(key)
+                if data is not None:
+                    # second touch: promote
+                    del self._probation[key]
+                    self._prob_bytes -= len(data)
+                    self._protected[key] = data
+                    self._prot_bytes += len(data)
+                    self._evict_over_bounds()
         EC_TILE_CACHE.labels("hit" if data is not None else "miss").inc()
         return data
 
@@ -107,7 +158,9 @@ class TileCache:
         t = (offset // tile) * tile
         with self._lock:
             while t < offset + size:
-                data = self._tiles.get((shard_id, t))
+                data = self._protected.get((shard_id, t))
+                if data is None:
+                    data = self._probation.get((shard_id, t))
                 if data is None or t + len(data) < min(offset + size, t + tile):
                     return False
                 t += tile
@@ -126,37 +179,61 @@ class TileCache:
         e.g. a survivor quarantined mid-gather may have contributed
         corrupt bytes — makes the stale insert a no-op instead of
         poisoning the cache forever (checked under the same lock
-        invalidate() increments under)."""
+        invalidate() increments under).
+
+        New tiles are admitted to PROBATION (or straight to the single
+        segment with WEED_EC_TILE_SCAN=0); a re-put of an already
+        protected tile updates it in place."""
         if not self.enabled or not data:
             return False
+        key = (shard_id, tile_off)
         with self._lock:
             if gen is not None and gen != self.invalidations:
                 return False
-            old = self._tiles.pop((shard_id, tile_off), None)
+            old = self._protected.pop(key, None)
             if old is not None:
-                self._bytes -= len(old)
-            self._tiles[(shard_id, tile_off)] = data
-            self._bytes += len(data)
-            while self._bytes > self.capacity_bytes and self._tiles:
-                _, evicted = self._tiles.popitem(last=False)
-                self._bytes -= len(evicted)
+                # already earned protection: refresh in place
+                self._prot_bytes -= len(old)
+                self._protected[key] = data
+                self._prot_bytes += len(data)
+                self._evict_over_bounds()
+                return True
+            old = self._probation.pop(key, None)
+            if old is not None:
+                self._prob_bytes -= len(old)
+            if self.scan_resistant:
+                self._probation[key] = data
+                self._prob_bytes += len(data)
+            else:
+                self._protected[key] = data
+                self._prot_bytes += len(data)
+            self._evict_over_bounds()
         return True
 
     def snapshot(self, shard_id: int) -> list[tuple[int, bytes]]:
         """Resident tiles of one shard, (tile_off, bytes) — the rebuild
         piggyback drains these at session open so degraded traffic that
-        already ran still counts toward repair forward-progress."""
+        already ran still counts toward repair forward-progress.
+        Probationary tiles count too: their bytes are just as decoded."""
         with self._lock:
-            return [
+            out = [
                 (off, data)
-                for (sid, off), data in self._tiles.items()
+                for (sid, off), data in self._protected.items()
                 if sid == shard_id
             ]
+            out += [
+                (off, data)
+                for (sid, off), data in self._probation.items()
+                if sid == shard_id
+            ]
+            return out
 
     def invalidate(self) -> None:
         """Drop everything (shard remount / quarantine / rebuild: the
         decode inputs changed, cached outputs may no longer match)."""
         with self._lock:
-            self._tiles.clear()
-            self._bytes = 0
+            self._probation.clear()
+            self._protected.clear()
+            self._prob_bytes = 0
+            self._prot_bytes = 0
             self.invalidations += 1

@@ -307,12 +307,19 @@ class HandoffAgent:
                     HANDOFF_HINTS.labels("dropped").inc()
                     self.store.remove(path)
                     continue
-                self.store.remove(path)
+                # count BEFORE removing the spool file: pending()
+                # draining to empty is the barrier observers (the
+                # /status surface, tests) synchronize on, so the
+                # counters must already reflect a delivery by the time
+                # the last file disappears — the old order let a
+                # descheduled agent thread show "spool empty,
+                # 0 replayed" to a racing reader
                 delivered += 1
                 self.replayed += 1
                 from seaweedfs_tpu.stats.metrics import HANDOFF_HINTS
 
                 HANDOFF_HINTS.labels("replayed").inc()
+                self.store.remove(path)
         return delivered
 
     def _replay(self, head: dict, body: bytes) -> str:

@@ -854,22 +854,35 @@ class VolumeServer:
         base = v.base_name
         # durable ordering (weedcrash ec-encode workload): shard bytes
         # fsynced BEFORE the .ecx publish — a crash can then never leave
-        # a complete-looking index over page-cache-only shard files
-        ec_files.write_ec_files(base, rs=self._new_rs(), durable=True)
+        # a complete-looking index over page-cache-only shard files.
+        # want_crcs: the pipelined drivers fold per-shard CRC-32C out of
+        # the codec pass for free — logged so an operator can cross-check
+        # a suspect shard file against the encode-time checksum without
+        # re-reading the survivors
+        st: dict = {}
+        ec_files.write_ec_files(
+            base, rs=self._new_rs(), durable=True, stats=st, want_crcs=True
+        )
+        crcs = st.get("shard_crcs")
+        if crcs:
+            wlog.info(
+                "ec.generate vid=%s shard_crc32c=%s",
+                req.volume_id,
+                ",".join(f"{c:08x}" for c in crcs),
+            )
         ec_files.write_sorted_file_from_idx(base, durable=True)
         return pb.VolumeEcShardsGenerateResponse()
 
     def VolumeEcShardsBatchGenerate(self, req, context):
         """N local sealed volumes → shard files through ONE mesh
         program per tile round (ec_files.write_ec_files_batch over
-        parallel/mesh_codec.py). The mesh's 'vol' axis is sized to the
-        gcd of batch and device count so any batch shards cleanly."""
-        import math
-
-        import jax
-
-        from seaweedfs_tpu.parallel import MeshCodec, make_mesh
-
+        parallel/mesh_codec.py). The driver self-provisions the mesh
+        ('vol' axis = gcd of batch and device count, so any batch —
+        and any WEED_EC_PIPELINE_BATCH chunk of it — shards cleanly)
+        and, with durable=True, fsyncs every shard file before
+        returning on both arms, so the .ecx publish below can imply
+        shard bytes are on disk (the single-volume verb's weedcrash
+        ordering)."""
         bases = []
         for vid in req.volume_ids:
             v = self.store.find_volume(vid)
@@ -879,20 +892,17 @@ class VolumeServer:
                 )
             bases.append(v.base_name)
         if bases:
-            devices = jax.devices()
-            vol_axis = math.gcd(len(bases), len(devices))
-            codec = MeshCodec(
-                make_mesh(devices, stripe=len(devices) // vol_axis)
+            st: dict = {}
+            ec_files.write_ec_files_batch(
+                bases, durable=True, stats=st, want_crcs=True
             )
-            from seaweedfs_tpu.util import durable
-
-            ec_files.write_ec_files_batch(bases, codec=codec)
+            for vid, crcs in zip(req.volume_ids, st.get("shard_crcs") or []):
+                wlog.info(
+                    "ec.batch_generate vid=%s shard_crc32c=%s",
+                    vid,
+                    ",".join(f"{c:08x}" for c in crcs),
+                )
             for base in bases:
-                # same durable ordering as the single-volume verb: the
-                # batch driver has no fsync arm, so pin every shard
-                # file here BEFORE the .ecx publish can imply it
-                for i in range(ec_files.TOTAL_SHARDS):
-                    durable.fsync_path(base + ec_files.to_ext(i))
                 ec_files.write_sorted_file_from_idx(base, durable=True)
         return pb.VolumeEcShardsBatchGenerateResponse()
 
@@ -918,9 +928,12 @@ class VolumeServer:
         base = self._base_name(req.collection, req.volume_id)
         present, missing = ec_files.shard_presence(base)
         if not missing or not self.master:
+            st: dict = {}
             rebuilt = ec_files.rebuild_ec_files(
-                base, rs=self._new_rs(), durable=True
+                base, rs=self._new_rs(), durable=True, stats=st,
+                want_crcs=True,
             )
+            self._log_rebuild_crcs(req.volume_id, st)
             return pb.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
         # with a master, always learn which "missing" shards are in
         # fact mounted elsewhere: they serve as remote survivors and
@@ -932,16 +945,21 @@ class VolumeServer:
         )
         try:
             if not readers:
+                st = {}
                 rebuilt = ec_files.rebuild_ec_files(
-                    base, rs=self._new_rs(), durable=True
+                    base, rs=self._new_rs(), durable=True, stats=st,
+                    want_crcs=True,
                 )
+                self._log_rebuild_crcs(req.volume_id, st)
             else:
                 from seaweedfs_tpu.ec import ec_stream, repair_session
 
                 rs = self._new_rs()
                 rebuild_fn = fetch_fn = None
                 if not ec_files._use_stream_driver(rs):
-                    rebuild_fn, fetch_fn = ec_stream.local_rebuild_fns(rs)
+                    rebuild_fn, fetch_fn = ec_stream.local_rebuild_fns(
+                        rs, want_crcs=True
+                    )
                 # repair piggyback (docs/SCRUB.md): degraded GETs of
                 # this volume donate the tiles they decode while the
                 # session is open, and tiles already decoded for past
@@ -956,6 +974,7 @@ class VolumeServer:
                     ev = self.store.find_ec_volume(req.volume_id)
                     if ev is not None:
                         ev.donate_cached_tiles(sess)
+                    st = {}
                     rebuilt = ec_stream.stream_rebuild_ec_files(
                         base,
                         rebuild_fn=rebuild_fn,
@@ -963,7 +982,10 @@ class VolumeServer:
                         remote_readers=readers,
                         session=sess,
                         durable=True,
+                        stats=st,
+                        want_crcs=True,
                     )
+                    self._log_rebuild_crcs(req.volume_id, st)
                 except ValueError as e:
                     context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
                 finally:
@@ -971,6 +993,20 @@ class VolumeServer:
         finally:
             close_readers()
         return pb.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
+
+    @staticmethod
+    def _log_rebuild_crcs(vid: int, st: dict) -> None:
+        """Operator breadcrumb: encode-pass CRC-32C of every rebuilt
+        shard file (fused out of the codec pass — see the generate
+        verb), keyed so a later scrub mismatch can be triaged against
+        what the rebuild actually produced."""
+        crcs = st.get("shard_crcs")
+        if crcs:
+            wlog.info(
+                "ec.rebuild vid=%s rebuilt_crc32c=%s",
+                vid,
+                ",".join(f"{i}:{c:08x}" for i, c in sorted(crcs.items())),
+            )
 
     def _remote_rebuild_readers(self, vid: int, skip: set[int]):
         """(readers, closer): shard id → fetch(offset, size) callables

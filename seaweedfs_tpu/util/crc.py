@@ -14,6 +14,8 @@ Python table fallback keeps the package dependency-free.
 
 from __future__ import annotations
 
+import threading
+
 _POLY_REFLECTED = 0x82F63B78
 
 
@@ -74,6 +76,85 @@ def crc32c(data: bytes, crc: int = 0) -> int:
     if _native_crc32c is not None:
         return _native_crc32c(data, crc)
     return _crc32c_py(data, crc)
+
+
+# --- GF(2) operator algebra for CRC composition ----------------------------
+#
+# The CRC register transit over k zero bytes is a linear operator on
+# GF(2)^32. Representing it as 32 columns (column b = the operator
+# applied to 1<<b) makes "advance a CRC past k bytes" a 32-term XOR
+# and lets operators compose by squaring — O(log k) instead of O(k).
+# This is what zlib's crc32_combine does for CRC-32; here for
+# Castagnoli, shared by crc32c_combine below and the device-side CRC
+# kernel (ec/crc_kernel.py), which lifts the same columns into a
+# bit-matrix matmul so shard CRCs fold into the encode pass.
+
+def _gf2_apply(cols: list[int], x: int) -> int:
+    """Apply a 32-column GF(2) operator to a 32-bit value."""
+    r = 0
+    b = 0
+    while x:
+        if x & 1:
+            r ^= cols[b]
+        x >>= 1
+        b += 1
+    return r
+
+
+def _gf2_compose(outer: list[int], inner: list[int]) -> list[int]:
+    """Column representation of outer∘inner."""
+    return [_gf2_apply(outer, c) for c in inner]
+
+
+# Z_1: the register transit of ONE zero byte, r' = T[r & 0xFF] ^ (r >> 8)
+_Z1_COLS = [_TABLE[(1 << b) & 0xFF] ^ ((1 << b) >> 8) for b in range(32)]
+_ZPOW = [_Z1_COLS]  # _ZPOW[k] = transit of 2^k zero bytes
+# growth must be serialized: _gf2_compose is long pure-Python, so two
+# threads (concurrent generate/rebuild verbs folding CRCs) racing the
+# append could land a stale square at the wrong index — and the table
+# would then yield wrong combines for the life of the process
+_ZPOW_LOCK = threading.Lock()
+
+
+def _zero_shift_cols(nbytes: int) -> list[int]:
+    """Columns of the k-zero-byte transit operator Z_k (k = nbytes ≥ 1),
+    built from squared powers in O(log k) 32x32 GF(2) composes."""
+    cols = None
+    k = 0
+    n = nbytes
+    while n:
+        if k >= len(_ZPOW):
+            with _ZPOW_LOCK:
+                while k >= len(_ZPOW):
+                    _ZPOW.append(_gf2_compose(_ZPOW[-1], _ZPOW[-1]))
+        if n & 1:
+            p = _ZPOW[k]
+            cols = p if cols is None else _gf2_compose(p, cols)
+        n >>= 1
+        k += 1
+    return cols if cols is not None else [1 << b for b in range(32)]
+
+
+_COMBINE_CACHE: dict[int, list[int]] = {}
+
+
+def crc32c_combine(crc1: int, crc2: int, len2: int) -> int:
+    """CRC-32C of A||B from crc32c(A), crc32c(B) and len(B).
+
+    Same contract as zlib's crc32_combine: both inputs are ordinary
+    (init/final-xor applied) CRC values, and so is the result. The
+    init/xorout constants cancel, leaving Z_len2(crc1) ^ crc2 — the
+    identity the EC streaming drivers use to fold per-tile device CRCs
+    into whole-shard-file CRCs without re-reading a byte."""
+    if len2 <= 0:
+        return crc1 & 0xFFFFFFFF
+    cols = _COMBINE_CACHE.get(len2)
+    if cols is None:
+        cols = _zero_shift_cols(len2)
+        if len(_COMBINE_CACHE) > 256:
+            _COMBINE_CACHE.clear()  # bound; tile lengths are few
+        _COMBINE_CACHE[len2] = cols
+    return _gf2_apply(cols, crc1 & 0xFFFFFFFF) ^ (crc2 & 0xFFFFFFFF)
 
 
 def masked_value(crc: int) -> int:

@@ -1,0 +1,458 @@
+"""Device-resident EC streaming pipeline (ISSUE 15, docs/CODEC.md):
+staging ring, fused CRC32-C, mesh batch arm, kill switch, stage
+accounting, and tile-cache scan resistance.
+
+Everything runs on the CPU backend (tier-1 is JAX_PLATFORMS=cpu), so
+byte- and CRC-identity assertions here are exactly what the bench
+--check pipeline_identity smoke enforces in production."""
+
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ec import ec_files, ec_stream
+from seaweedfs_tpu.ec.codec import new_encoder
+from seaweedfs_tpu.ec.tile_cache import TileCache
+from seaweedfs_tpu.util.crc import crc32c, crc32c_combine
+
+# small two-tier geometry: fast, still exercises large-tier striding,
+# super-tile coalescing, and the zero-padded tail
+LARGE = 64 * 1024
+SMALL = 16 * 1024
+
+
+def _make_dat(path: str, nbytes: int, seed: int = 0) -> bytes:
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, nbytes, dtype=np.uint8).tobytes()
+    with open(path + ".dat", "wb") as f:
+        f.write(data)
+    return data
+
+
+def _shards(base: str) -> list[bytes]:
+    return [
+        open(base + ec_files.to_ext(i), "rb").read()
+        for i in range(ec_files.TOTAL_SHARDS)
+    ]
+
+
+def _write_classic(base: str, rs, want_crcs=False, stats=None):
+    """The serial reference driver, forced via the kill switch."""
+    os.environ["WEED_EC_PIPELINE"] = "0"
+    try:
+        ec_files.write_ec_files(
+            base, rs=rs, large_block_size=LARGE, small_block_size=SMALL,
+            stats=stats, want_crcs=want_crcs,
+        )
+    finally:
+        os.environ.pop("WEED_EC_PIPELINE", None)
+
+
+# ---------------------------------------------------------------------------
+class TestCrcKernel:
+    def test_rows_match_host_crc32c(self):
+        from seaweedfs_tpu.ec import crc_kernel
+
+        rng = np.random.default_rng(0)
+        for n32 in (1, 4, 64, 1024):
+            x = rng.integers(0, 2**32, (3, n32), dtype=np.uint32)
+            got = np.asarray(crc_kernel.crc32c_rows(x))
+            for r in range(3):
+                assert int(got[r]) == crc32c(x[r].tobytes())
+
+    def test_leading_batch_dims(self):
+        from seaweedfs_tpu.ec import crc_kernel
+
+        rng = np.random.default_rng(1)
+        x = rng.integers(0, 2**32, (2, 5, 64), dtype=np.uint32)
+        got = np.asarray(crc_kernel.crc32c_rows(x))
+        for i in range(2):
+            for j in range(5):
+                assert int(got[i, j]) == crc32c(x[i, j].tobytes())
+
+    def test_non_power_of_two_rejected(self):
+        from seaweedfs_tpu.ec import crc_kernel
+
+        assert not crc_kernel.crc_supported(12)  # 3 lanes
+        assert not crc_kernel.crc_supported(6)  # partial lane
+        assert crc_kernel.crc_supported(4096)
+        with pytest.raises(ValueError):
+            crc_kernel.crc_lin_rows(np.zeros((1, 3), dtype=np.uint32))
+
+    def test_combine_matches_concatenation(self):
+        rng = np.random.default_rng(2)
+        for la, lb in ((0, 5), (7, 0), (13, 40), (4096, 100)):
+            a = rng.integers(0, 256, la, dtype=np.uint8).tobytes()
+            b = rng.integers(0, 256, lb, dtype=np.uint8).tobytes()
+            assert crc32c_combine(crc32c(a), crc32c(b), lb) == crc32c(a + b)
+
+    def test_fused_encode_crc_matches_host(self):
+        from seaweedfs_tpu.ec.codec_tpu import TpuCodecKernels
+
+        kern = TpuCodecKernels(10, 4)
+        rng = np.random.default_rng(3)
+        data = rng.integers(0, 256, (10, 4096), dtype=np.uint8)
+        parity, crcs = kern.encode_u32_crc(data.view(np.uint32))
+        parity_h = np.asarray(parity).view(np.uint8)
+        rs = new_encoder(backend="cpu")
+        want = rs.encode([data[i].copy() for i in range(10)] + [None] * 4)
+        crcs_h = np.asarray(crcs)
+        for i in range(4):
+            assert np.array_equal(parity_h[i], want[10 + i])
+        full = np.concatenate([data, parity_h], axis=0)
+        for i in range(14):
+            assert int(crcs_h[i]) == crc32c(full[i].tobytes())
+
+    def test_fused_reconstruct_crc_matches_host(self):
+        from seaweedfs_tpu.ec.codec_tpu import TpuCodecKernels
+
+        kern = TpuCodecKernels(10, 4)
+        rng = np.random.default_rng(4)
+        data = rng.integers(0, 256, (10, 4096), dtype=np.uint8)
+        parity = np.asarray(
+            kern.encode_u32_crc(data.view(np.uint32))[0]
+        ).view(np.uint8)
+        all_shards = np.concatenate([data, parity], axis=0)
+        survivors = tuple(range(2, 12))
+        targets = (0, 1)
+        tile = all_shards[list(survivors)]
+        rebuilt, crcs = kern.reconstruct_u32_crc(
+            survivors, targets, tile.view(np.uint32)
+        )
+        rebuilt_h = np.asarray(rebuilt).view(np.uint8)
+        for j, t in enumerate(targets):
+            assert np.array_equal(rebuilt_h[j], all_shards[t])
+            assert int(np.asarray(crcs)[j]) == crc32c(all_shards[t].tobytes())
+
+
+# ---------------------------------------------------------------------------
+class TestPipelinedEncode:
+    @pytest.mark.parametrize("nbytes", [10 * SMALL * 3 + 777, 10 * LARGE + 5])
+    def test_bytes_and_crcs_match_serial(self, tmp_path, nbytes):
+        rs = new_encoder(backend="cpu")
+        piped = str(tmp_path / "p")
+        serial = str(tmp_path / "s")
+        data = _make_dat(piped, nbytes)
+        with open(serial + ".dat", "wb") as f:
+            f.write(data)
+        _write_classic(serial, rs, want_crcs=True, stats=(sstats := {}))
+        parity_fn, fetch_fn = ec_stream.local_encode_fns(rs, want_crcs=True)
+        pstats: dict = {}
+        ec_stream.stream_write_ec_files(
+            piped, large_block_size=LARGE, small_block_size=SMALL,
+            parity_fn=parity_fn, fetch_fn=fetch_fn, stats=pstats,
+            want_crcs=True,
+        )
+        for i, (pb, sb) in enumerate(zip(_shards(piped), _shards(serial))):
+            assert pb == sb, f"shard {i}"
+            assert pstats["shard_crcs"][i] == crc32c(pb) == sstats["shard_crcs"][i]
+
+    def test_stage_buckets_and_compute_charge(self, tmp_path):
+        """Satellite fix: host-codec time lands in compute_s, not in
+        the writer pool's writeback bucket."""
+        rs = new_encoder(backend="cpu")
+        base = str(tmp_path / "v")
+        _make_dat(base, 10 * SMALL * 4)
+        parity_fn, fetch_fn = ec_stream.local_encode_fns(rs)
+        assert fetch_fn.charges == "compute_s"
+        stats: dict = {}
+        ec_stream.stream_write_ec_files(
+            base, large_block_size=LARGE, small_block_size=SMALL,
+            parity_fn=parity_fn, fetch_fn=fetch_fn, stats=stats,
+        )
+        for key in ("read_s", "stage_s", "device_s", "writeback_s",
+                    "compute_s", "write_s", "pipeline_depth", "ring_slots"):
+            assert key in stats, key
+        assert stats["compute_s"] > 0  # the numpy encode ran somewhere
+        assert stats["writeback_s"] == 0  # and NOT booked as D2H drain
+
+    def test_injected_plain_fns_still_get_crcs(self, tmp_path):
+        """A stage pair that never heard of CRCs (the test-injection
+        contract) still yields shard_crcs — host fallback in the
+        writer pool."""
+        rs = new_encoder(backend="cpu")
+        base = str(tmp_path / "v")
+        _make_dat(base, 10 * SMALL * 2 + 99)
+
+        def fetch(tile):
+            return rs._apply(rs.parity_rows, tile)
+
+        stats: dict = {}
+        ec_stream.stream_write_ec_files(
+            base, large_block_size=LARGE, small_block_size=SMALL,
+            parity_fn=lambda t: t, fetch_fn=fetch, stats=stats,
+            want_crcs=True,
+        )
+        for i, sb in enumerate(_shards(base)):
+            assert stats["shard_crcs"][i] == crc32c(sb)
+
+    def test_depth_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("WEED_EC_PIPELINE_DEPTH", "2")
+        assert ec_stream.pipeline_depth() == 2
+        monkeypatch.setenv("WEED_EC_PIPELINE_DEPTH", "1")
+        assert ec_stream.pipeline_depth() == 2  # min 2: double buffering
+        monkeypatch.setenv("WEED_EC_PIPELINE_DEPTH", "junk")
+        assert ec_stream.pipeline_depth() == 3
+        monkeypatch.setenv("WEED_EC_PIPELINE_DEPTH", "4")
+        rs = new_encoder(backend="cpu")
+        base = str(tmp_path / "v")
+        _make_dat(base, 10 * SMALL * 2)
+        parity_fn, fetch_fn = ec_stream.local_encode_fns(rs)
+        stats: dict = {}
+        ec_stream.stream_write_ec_files(
+            base, large_block_size=LARGE, small_block_size=SMALL,
+            parity_fn=parity_fn, fetch_fn=fetch_fn, stats=stats,
+        )
+        assert stats["pipeline_depth"] == 4
+
+    def test_kill_switch_routes_serial(self, tmp_path, monkeypatch):
+        """WEED_EC_PIPELINE=0 restores the classic loop wholesale:
+        routing predicates decline, the classic stats shape comes
+        back, and bytes + CRCs are unchanged."""
+        rs = new_encoder(backend="cpu")
+        rs._backend_name = "native"  # pretend: routing looks at the name
+        monkeypatch.setenv("WEED_EC_PIPELINE", "0")
+        assert not ec_files._stream_host_codec(rs)
+        assert not ec_files._use_stream_driver(rs)
+        base = str(tmp_path / "v")
+        _make_dat(base, 10 * SMALL * 2 + 123)
+        stats: dict = {}
+        ec_files.write_ec_files(
+            base, rs=rs, large_block_size=LARGE, small_block_size=SMALL,
+            stats=stats, want_crcs=True,
+        )
+        assert "encode_s" in stats  # the classic driver's bucket
+        assert "device_s" not in stats
+        for i, sb in enumerate(_shards(base)):
+            assert stats["shard_crcs"][i] == crc32c(sb)
+        monkeypatch.delenv("WEED_EC_PIPELINE")
+        assert ec_files._stream_host_codec(rs)
+
+
+# ---------------------------------------------------------------------------
+class TestPipelinedRebuild:
+    def test_rebuild_crcs_match_files(self, tmp_path):
+        rs = new_encoder(backend="cpu")
+        base = str(tmp_path / "v")
+        _make_dat(base, 10 * SMALL * 3 + 4321)
+        parity_fn, fetch_fn = ec_stream.local_encode_fns(rs)
+        ec_stream.stream_write_ec_files(
+            base, large_block_size=LARGE, small_block_size=SMALL,
+            parity_fn=parity_fn, fetch_fn=fetch_fn,
+        )
+        want0 = open(base + ec_files.to_ext(0), "rb").read()
+        os.remove(base + ec_files.to_ext(0))
+        os.remove(base + ec_files.to_ext(12))
+        rebuild_fn, rfetch = ec_stream.local_rebuild_fns(rs, want_crcs=True)
+        stats: dict = {}
+        rebuilt = ec_stream.stream_rebuild_ec_files(
+            base, rebuild_fn=rebuild_fn, fetch_fn=rfetch, stats=stats,
+            want_crcs=True,
+        )
+        assert sorted(rebuilt) == [0, 12]
+        assert open(base + ec_files.to_ext(0), "rb").read() == want0
+        for i in (0, 12):
+            got = open(base + ec_files.to_ext(i), "rb").read()
+            assert stats["shard_crcs"][i] == crc32c(got)
+
+    def test_classic_rebuild_crcs(self, tmp_path, monkeypatch):
+        rs = new_encoder(backend="cpu")
+        base = str(tmp_path / "v")
+        _make_dat(base, 10 * SMALL * 2)
+        _write_classic(base, rs)
+        os.remove(base + ec_files.to_ext(3))
+        stats: dict = {}
+        rebuilt = ec_files.rebuild_ec_files(
+            base, rs=rs, stats=stats, want_crcs=True
+        )
+        assert rebuilt == [3]
+        got = open(base + ec_files.to_ext(3), "rb").read()
+        assert stats["shard_crcs"][3] == crc32c(got)
+
+
+# ---------------------------------------------------------------------------
+class TestMeshBatchPipeline:
+    def test_batch_matches_serial_per_volume(self, tmp_path):
+        """The mesh batch arm (CPU mesh = the byte-identical fallback
+        tier) against the serial classic driver, odd sizes included;
+        fused CRCs against the files on disk."""
+        rs = new_encoder(backend="cpu")
+        bases, refs = [], []
+        for v in range(3):
+            base = str(tmp_path / f"v{v}")
+            ref = str(tmp_path / f"r{v}")
+            data = _make_dat(base, 10 * SMALL * (v + 1) + 101 * v, seed=v)
+            with open(ref + ".dat", "wb") as f:
+                f.write(data)
+            _write_classic(ref, rs)
+            bases.append(base)
+            refs.append(ref)
+        stats: dict = {}
+        ec_stream.stream_write_ec_files_batch(
+            bases, large_block_size=LARGE, small_block_size=SMALL,
+            stats=stats, want_crcs=True,
+        )
+        assert stats["batch_volumes"] == 3
+        for v in range(3):
+            for i, (gb, wb) in enumerate(zip(_shards(bases[v]), _shards(refs[v]))):
+                assert gb == wb, f"v{v} shard {i}"
+                assert stats["shard_crcs"][v][i] == crc32c(gb)
+
+    def test_batch_limit_knob_chunks(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("WEED_EC_PIPELINE_BATCH", "2")
+        assert ec_stream.pipeline_batch_limit() == 2
+        rs = new_encoder(backend="cpu")
+        bases, refs = [], []
+        for v in range(3):
+            base = str(tmp_path / f"v{v}")
+            ref = str(tmp_path / f"r{v}")
+            data = _make_dat(base, 10 * SMALL + 7 * v, seed=10 + v)
+            with open(ref + ".dat", "wb") as f:
+                f.write(data)
+            _write_classic(ref, rs)
+            bases.append(base)
+            refs.append(ref)
+        stats: dict = {}
+        ec_stream.stream_write_ec_files_batch(
+            bases, large_block_size=LARGE, small_block_size=SMALL,
+            stats=stats, want_crcs=True,
+        )
+        assert len(stats["shard_crcs"]) == 3
+        # structural fields survive the chunk merge (the dryrun and
+        # bench consumers read them on every run)
+        assert stats["batch_volumes"] == 3
+        assert "pipeline_depth" in stats and "mesh" in stats
+        for v in range(3):
+            for gb, wb in zip(_shards(bases[v]), _shards(refs[v])):
+                assert gb == wb
+
+    def test_empty_volumes(self, tmp_path):
+        bases = []
+        for v in range(2):
+            base = str(tmp_path / f"e{v}")
+            open(base + ".dat", "wb").close()
+            bases.append(base)
+        stats: dict = {}
+        ec_stream.stream_write_ec_files_batch(
+            bases, stats=stats, want_crcs=True
+        )
+        for base in bases:
+            for i in range(14):
+                assert os.path.getsize(base + ec_files.to_ext(i)) == 0
+        assert stats["shard_crcs"] == [[0] * 14, [0] * 14]
+
+    def test_routing_via_write_ec_files_batch(self, tmp_path, monkeypatch):
+        """ec_files.write_ec_files_batch routes to the pipelined arm by
+        default and the classic per-round loop under the kill switch —
+        same bytes either way."""
+        rs = new_encoder(backend="cpu")
+        piped = str(tmp_path / "p")
+        killed = str(tmp_path / "k")
+        data = _make_dat(piped, 10 * SMALL * 2 + 55)
+        with open(killed + ".dat", "wb") as f:
+            f.write(data)
+        st_p: dict = {}
+        ec_files.write_ec_files_batch(
+            [piped], large_block_size=LARGE, small_block_size=SMALL,
+            stats=st_p, want_crcs=True,
+        )
+        assert "pipeline_depth" in st_p  # pipelined arm ran
+        monkeypatch.setenv("WEED_EC_PIPELINE", "0")
+        st_k: dict = {}
+        ec_files.write_ec_files_batch(
+            [killed], large_block_size=LARGE, small_block_size=SMALL,
+            stats=st_k, want_crcs=True,
+        )
+        assert "pipeline_depth" not in st_k  # classic arm ran
+        for gb, wb in zip(_shards(piped), _shards(killed)):
+            assert gb == wb
+        assert st_p["shard_crcs"] == st_k["shard_crcs"]
+
+    def test_mesh_fused_crc_with_stripe_collective(self):
+        """encode_batch_u32_crc on a vol×stripe mesh: the stripe-axis
+        CRC composition (all_gather + Z-shift fold) must equal the
+        host CRC of the full concatenated stream."""
+        jax = pytest.importorskip("jax")
+        from seaweedfs_tpu.parallel import MeshCodec, make_mesh
+
+        devs = jax.devices()
+        if len(devs) < 8:
+            pytest.skip("needs 8 virtual devices")
+        codec = MeshCodec(make_mesh(devs[:8]))  # 4 x 2
+        assert codec.crc_supported(32 * 1024)
+        assert not codec.crc_supported(32 * 1024 + 8)
+        rng = np.random.default_rng(7)
+        vols = rng.integers(0, 256, (4, 10, 32 * 1024), dtype=np.uint8)
+        u32 = codec.shard_volumes(vols.view(np.uint32))
+        parity, crcs = codec.encode_batch_u32_crc(u32)
+        parity_h = np.asarray(parity).view(np.uint8)
+        crcs_h = np.asarray(crcs)
+        full = np.concatenate([vols, parity_h], axis=1)
+        for v in range(4):
+            for i in range(14):
+                assert int(crcs_h[v, i]) == crc32c(full[v, i].tobytes())
+        layout = codec.batch_layout(4, 32 * 1024)
+        assert layout == {
+            "vol": 4, "stripe": 2, "devices": 8,
+            "per_device_volumes": 1, "per_device_bytes": 16 * 1024,
+        }
+
+
+# ---------------------------------------------------------------------------
+class TestTileCacheScanResistance:
+    def test_scan_does_not_churn_protected(self):
+        """ROADMAP satellite: a sequential scan (one-touch puts) must
+        not evict the promoted hot set."""
+        c = TileCache(capacity_bytes=8 * 100, tile_bytes=4096)
+        assert c.scan_resistant
+        # hot set: put + second-touch get -> protected
+        for off in (0, 4096):
+            c.put(0, off, b"h" * 100)
+            assert c.get(0, off) is not None
+        # scan: 50 one-touch tiles, never touched again
+        for i in range(50):
+            c.put(1, i * 4096, b"s" * 100)
+        assert c.get(0, 0) is not None, "scan churned the hot set"
+        assert c.get(0, 4096) is not None
+        assert c.total_bytes <= 8 * 100
+
+    def test_plain_lru_churns_under_knob(self, monkeypatch):
+        """WEED_EC_TILE_SCAN=0: the pre-PR behavior, where the same
+        scan evicts everything — the regression control."""
+        monkeypatch.setenv("WEED_EC_TILE_SCAN", "0")
+        c = TileCache(capacity_bytes=8 * 100, tile_bytes=4096)
+        assert not c.scan_resistant
+        for off in (0, 4096):
+            c.put(0, off, b"h" * 100)
+            assert c.get(0, off) is not None
+        for i in range(50):
+            c.put(1, i * 4096, b"s" * 100)
+        assert c.get(0, 0) is None  # plain LRU: scanned straight through
+        assert c.get(0, 4096) is None
+
+    def test_probation_bounded_small(self):
+        c = TileCache(capacity_bytes=64 << 20, tile_bytes=256 * 1024)
+        assert c.probation_bytes_cap == (64 << 20) // 8
+
+    def test_second_touch_promotes(self):
+        c = TileCache(capacity_bytes=4 * 100, tile_bytes=4096)
+        c.put(0, 0, b"x" * 100)
+        assert c.get(0, 0) is not None  # promotes
+        assert (0, 0) in c._protected
+        assert (0, 0) not in c._probation
+
+    def test_covers_and_snapshot_span_probation(self):
+        c = TileCache(capacity_bytes=1 << 20, tile_bytes=4096)
+        c.put(3, 0, b"x" * 4096)  # probationary only
+        assert c.covers(3, 100, 200)
+        snap = c.snapshot(3)
+        assert snap == [(0, b"x" * 4096)]
+
+    def test_protected_reput_updates_in_place(self):
+        c = TileCache(capacity_bytes=1 << 20, tile_bytes=4096)
+        c.put(0, 0, b"a" * 100)
+        c.get(0, 0)  # promote
+        c.put(0, 0, b"b" * 200)
+        assert c.get(0, 0) == b"b" * 200
+        assert c.total_bytes == 200
