@@ -1134,6 +1134,71 @@ def run_ec_encode(budget: int | None = None, seed: int | None = None,
                      budget=budget, seed=seed)
 
 
+def run_ecc_publish(budget: int | None = None, seed: int | None = None,
+                    durable: bool = True) -> CrashReport:
+    """`.ecc` scrub-sidecar publish ordering (ec/ecc_sidecar.py): the
+    sidecar ATTESTS shard bytes, so it must never reach its final name
+    before those bytes are durable. Sweep: write 14 shard files, fsync
+    them, publish the sidecar through util/durable.publish. Invariant:
+    whenever a parseable sidecar exists under its final name, every
+    shard it attests exists with exactly the attested size and
+    CRC-32C — a crash can leave NO sidecar (scrub takes the parity
+    path, fine) or a torn one (load fails, parity path, fine), but
+    never a confident sidecar over lost shard bytes.
+
+    durable=False replays the planted ordering bug — shard fsyncs
+    skipped, sidecar still published durably — which the sweep must
+    DETECT: the durable-only reorder state has the fsynced sidecar
+    complete and visible over empty shard files."""
+    from seaweedfs_tpu.ec import ec_files, ecc_sidecar
+    from seaweedfs_tpu.util import durable as _durable
+    from seaweedfs_tpu.util.crc import crc32c
+
+    with tempfile.TemporaryDirectory() as d:
+        base = os.path.join(d, "1")
+        rec = Recorder(d)
+        with rec:
+            crcs = []
+            for sid in range(ec_files.TOTAL_SHARDS):
+                data = bytes([0x40 + sid]) * (512 + 64 * sid)
+                with open(base + ec_files.to_ext(sid), "wb") as f:
+                    f.write(data)
+                crcs.append(crc32c(data))
+            if durable:
+                # the ordering under test: shard bytes durable BEFORE
+                # the sidecar that vouches for them becomes visible
+                for sid in range(ec_files.TOTAL_SHARDS):
+                    _durable.fsync_path(base + ec_files.to_ext(sid))
+            ecc_sidecar.write_sidecar(
+                base, crcs, total_shards=ec_files.TOTAL_SHARDS
+            )
+            rec.mark("published")
+
+        def recover(state_dir, _st, _acked):
+            b = os.path.join(state_dir, "1")
+            doc = ecc_sidecar.load_sidecar(b)
+            if doc is None:
+                return  # absent/torn sidecar: the parity path covers it
+            for sid_s, ent in doc["shards"].items():
+                p = b + ec_files.to_ext(int(sid_s))
+                assert os.path.exists(p), (
+                    f"sidecar attests shard {sid_s} that does not exist"
+                )
+                with open(p, "rb") as f:
+                    got = f.read()
+                assert len(got) == ent["size"], (
+                    f"sidecar attests shard {sid_s} at {ent['size']}B "
+                    f"but {len(got)}B are on disk"
+                )
+                assert crc32c(got) == ent["crc"], (
+                    f"sidecar CRC mismatch on shard {sid_s}: the "
+                    f"sidecar outlived the bytes it attests"
+                )
+
+        return sweep(rec.trace, recover, workload="ecc-publish",
+                     budget=budget, seed=seed)
+
+
 def run_shard_handback(budget: int | None = None,
                        seed: int | None = None) -> CrashReport:
     """-shardWrites ownership handback (the PR-11 follow-on): a worker
@@ -1192,6 +1257,7 @@ ALL_WORKLOADS = {
     "vacuum": run_vacuum,
     "quarantine": run_quarantine,
     "ec-encode": run_ec_encode,
+    "ecc-publish": run_ecc_publish,
     "shard-handback": run_shard_handback,
     "handoff-hint": run_handoff_hint,
     "handoff-delivery": run_handoff_delivery,

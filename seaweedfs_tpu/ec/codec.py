@@ -130,6 +130,20 @@ class ReedSolomon:
         self.parity_rows = self.matrix[data_shards:].copy()
         self._backend_name = backend
         self._apply = self._resolve_backend(backend)
+        # schedule optimization (ec/schedule.py): the numpy backend's
+        # naive per-entry LUT chain is replaced by a precompiled
+        # coefficient-grouped + pair-CSE'd XOR/mul program, compiled
+        # here per (k,m) and reused by encode, rebuild and degraded
+        # decode (they all route through self._apply). Byte-identical;
+        # WEED_EC_SCHEDULE=0 is the kill switch restoring the naive
+        # chain. The native/tpu backends keep their own realizations
+        # (the SWAR kernel builder runs the same CSE pass device-side).
+        from seaweedfs_tpu.ec import schedule as _schedule
+
+        self.scheduled = backend == "cpu" and _schedule.schedule_enabled()
+        if self.scheduled:
+            self._apply = _schedule.scheduled_apply_matrix
+            _schedule.compile_schedule(self.parity_rows)
         # cache: survivor-row tuple -> decode matrix (invert is host-side
         # 14x14 work; reuse across blocks of a streaming rebuild)
         self._decode_cache: dict[tuple[int, ...], np.ndarray] = {}

@@ -111,17 +111,35 @@ _SWAR_TN = 32768
 _SWAR_MIN_BYTES = 64 * 1024
 
 
-def _swar_schedule(rows_tuple: tuple[int, ...], r_out: int, k: int):
+def _swar_schedule(
+    rows_tuple: tuple[int, ...], r_out: int, k: int, sched: bool = False
+):
     """XOR schedules for one GF coefficient matrix: for output row p
     and bit j, sel[p][j] = the input columns whose coefficient has bit
-    j set; maxj[p] = the highest set bit (Horner start)."""
+    j set; maxj[p] = the highest set bit (Horner start).
+
+    sched=True runs the Paar-style pair-CSE (ec/schedule.py) over the
+    (p, j) sets: column pairs shared across sets are hoisted into
+    temps, returned as `temps[t] = (a, b)` defining slot k+t as
+    slot[a] ^ slot[b] (computed once per tile, shared by every output
+    row instead of re-XORed per Horner term). Pure XOR reassociation —
+    byte-identical output; WEED_EC_SCHEDULE=0 at the call sites
+    restores the naive per-row sets."""
     rows = np.array(rows_tuple, dtype=np.uint8).reshape(r_out, k)
     sel = [
         [[c for c in range(k) if (rows[p, c] >> j) & 1] for j in range(8)]
         for p in range(r_out)
     ]
     maxj = [max((j for j in range(8) if sel[p][j]), default=0) for p in range(r_out)]
-    return sel, maxj
+    temps: list[tuple[int, int]] = []
+    if sched:
+        from seaweedfs_tpu.ec.schedule import cse_pairs
+
+        flat = [sel[p][j] for p in range(r_out) for j in range(8)]
+        temps, new_flat = cse_pairs(flat, k)
+        it = iter(new_flat)
+        sel = [[list(next(it)) for _ in range(8)] for _ in range(r_out)]
+    return sel, maxj, temps
 
 
 def _swar_row(xs, sel_p, maxj_p):
@@ -149,31 +167,39 @@ def _swar_row(xs, sel_p, maxj_p):
 
 
 def _make_swar_kernel(
-    rows_tuple: tuple[int, ...], r_out: int, k: int, batched: bool = False
+    rows_tuple: tuple[int, ...],
+    r_out: int,
+    k: int,
+    batched: bool = False,
+    sched: bool = False,
 ):
     """Build the Pallas kernel body for one GF coefficient matrix.
 
     The matrix is baked into the kernel as XOR schedules (see
     _swar_schedule); each output row is one _swar_row Horner chain.
+    sched=True shares pair-CSE temps across all rows' Horner terms.
 
     batched=True builds the body for refs with a leading batch-block
     dim of 1 (the grid walks volumes × stream tiles), so one
     pallas_call serves a whole [B, k, n32] volume batch without a
     host-side transpose into the flat [k, B*n32] layout.
     """
-    sel, maxj = _swar_schedule(rows_tuple, r_out, k)
+    sel, maxj, temps = _swar_schedule(rows_tuple, r_out, k, sched)
     lead = (0,) if batched else ()  # ref index prefix for the batch dim
 
     def kernel(x_ref, o_ref):
-        xs = [x_ref[lead + (c, slice(None))] for c in range(k)]
+        slots = [x_ref[lead + (c, slice(None))] for c in range(k)]
+        for a, b in temps:
+            slots.append(slots[a] ^ slots[b])
         for p in range(r_out):
-            o_ref[lead + (p, slice(None))] = _swar_row(xs, sel[p], maxj[p])
+            o_ref[lead + (p, slice(None))] = _swar_row(slots, sel[p], maxj[p])
 
     return kernel
 
 
 @functools.partial(
-    jax.jit, static_argnames=("tn", "r_out", "k", "rows_tuple", "interpret")
+    jax.jit,
+    static_argnames=("tn", "r_out", "k", "rows_tuple", "interpret", "sched"),
 )
 def swar_apply_u32(
     data_u32: jnp.ndarray,
@@ -182,14 +208,17 @@ def swar_apply_u32(
     k: int,
     rows_tuple: tuple[int, ...],
     interpret: bool = False,
+    sched: bool = False,
 ) -> jnp.ndarray:
     """data [k, n32] uint32 (4 stream bytes per lane) → [r_out, n32].
 
     n32 must be a multiple of tn. interpret=True runs the Pallas
-    interpreter (for correctness tests on CPU hosts)."""
+    interpreter (for correctness tests on CPU hosts). sched toggles
+    the CSE'd XOR schedule (static, so the kill switch recompiles
+    rather than silently reusing the other arm's program)."""
     n = data_u32.shape[1]
     return pl.pallas_call(
-        _make_swar_kernel(rows_tuple, r_out, k),
+        _make_swar_kernel(rows_tuple, r_out, k, sched=sched),
         grid=(n // tn,),
         in_specs=[
             pl.BlockSpec((k, tn), lambda i: (0, i), memory_space=pltpu.VMEM)
@@ -201,7 +230,8 @@ def swar_apply_u32(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("tn", "r_out", "k", "rows_tuple", "interpret")
+    jax.jit,
+    static_argnames=("tn", "r_out", "k", "rows_tuple", "interpret", "sched"),
 )
 def swar_apply_u32_batch(
     data_u32: jnp.ndarray,
@@ -210,12 +240,13 @@ def swar_apply_u32_batch(
     k: int,
     rows_tuple: tuple[int, ...],
     interpret: bool = False,
+    sched: bool = False,
 ) -> jnp.ndarray:
     """data [B, k, n32] uint32 → [B, r_out, n32] uint32 (one kernel,
     grid = volumes × stream tiles). n32 must be a multiple of tn."""
     b, _, n = data_u32.shape
     return pl.pallas_call(
-        _make_swar_kernel(rows_tuple, r_out, k, batched=True),
+        _make_swar_kernel(rows_tuple, r_out, k, batched=True, sched=sched),
         grid=(b, n // tn),
         in_specs=[
             pl.BlockSpec(
@@ -230,7 +261,9 @@ def swar_apply_u32_batch(
     )(data_u32)
 
 
-def _make_swar_verify_kernel(rows_tuple: tuple[int, ...], r_out: int, k: int):
+def _make_swar_verify_kernel(
+    rows_tuple: tuple[int, ...], r_out: int, k: int, sched: bool = False
+):
     """Fused verify body: recompute each parity row's tile in VMEM
     (same _swar_row Horner chain as encode), compare against the given
     parity tile IN REGISTER, and accumulate the mismatched-lane count
@@ -242,13 +275,15 @@ def _make_swar_verify_kernel(rows_tuple: tuple[int, ...], r_out: int, k: int):
     Grid is (volumes, stream tiles); the scalar output block is
     revisited across the tile dim (TPU grids run sequentially), so
     tile 0 initialises and later tiles accumulate."""
-    sel, maxj = _swar_schedule(rows_tuple, r_out, k)
+    sel, maxj, temps = _swar_schedule(rows_tuple, r_out, k, sched)
 
     def kernel(x_ref, p_ref, o_ref, acc_ref):
-        xs = [x_ref[0, c, :] for c in range(k)]
+        slots = [x_ref[0, c, :] for c in range(k)]
+        for a, b in temps:
+            slots.append(slots[a] ^ slots[b])
         mism = None  # (tn,) int32: per-LANE mismatch count this tile
         for p in range(r_out):
-            y = _swar_row(xs, sel[p], maxj[p])
+            y = _swar_row(slots, sel[p], maxj[p])
             d = (y != p_ref[0, p, :]).astype(jnp.int32)
             mism = d if mism is None else mism + d
 
@@ -280,7 +315,8 @@ def _make_swar_verify_kernel(rows_tuple: tuple[int, ...], r_out: int, k: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("tn", "r_out", "k", "rows_tuple", "interpret")
+    jax.jit,
+    static_argnames=("tn", "r_out", "k", "rows_tuple", "interpret", "sched"),
 )
 def swar_verify_u32_batch(
     data_u32: jnp.ndarray,
@@ -290,13 +326,14 @@ def swar_verify_u32_batch(
     k: int,
     rows_tuple: tuple[int, ...],
     interpret: bool = False,
+    sched: bool = False,
 ) -> jnp.ndarray:
     """data [B, k, n32] + parity [B, r_out, n32] uint32 → [B] int32
     mismatched-lane counts (0 = verified), without materialising the
     recomputed parity. n32 must be a multiple of tn."""
     b, _, n = data_u32.shape
     counts = pl.pallas_call(
-        _make_swar_verify_kernel(rows_tuple, r_out, k),
+        _make_swar_verify_kernel(rows_tuple, r_out, k, sched=sched),
         grid=(b, n // tn),
         in_specs=[
             pl.BlockSpec(
@@ -324,6 +361,8 @@ def swar_verify_matrix_u32_batch(
 ) -> jnp.ndarray:
     """Fused batched verify against one GF coefficient matrix (the
     parity rows): [B] int32 mismatched-lane counts."""
+    from seaweedfs_tpu.ec.schedule import schedule_enabled
+
     rows_tuple = tuple(int(v) for v in np.asarray(matrix, dtype=np.uint8).reshape(-1))
     r_out, k = matrix.shape
     return swar_verify_u32_batch(
@@ -334,6 +373,7 @@ def swar_verify_matrix_u32_batch(
         k,
         rows_tuple,
         interpret,
+        sched=schedule_enabled(),
     )
 
 
@@ -342,6 +382,8 @@ def swar_apply_matrix_u32_batch(
 ) -> jnp.ndarray:
     """Batched device-resident SWAR: [B, k, n32] uint32 → [B, R, n32].
     Same packing contract as swar_apply_matrix_u32."""
+    from seaweedfs_tpu.ec.schedule import schedule_enabled
+
     rows_tuple = tuple(int(v) for v in np.asarray(matrix, dtype=np.uint8).reshape(-1))
     r_out, k = matrix.shape
     return swar_apply_u32_batch(
@@ -351,6 +393,7 @@ def swar_apply_matrix_u32_batch(
         k,
         rows_tuple,
         interpret,
+        sched=schedule_enabled(),
     )
 
 
@@ -408,10 +451,18 @@ def swar_apply_matrix_u32(
     in the same packing. The coefficient matrix is baked into the
     kernel (compiled once per distinct matrix — parity rows plus one
     decode matrix per survivor set, all tiny counts in practice)."""
+    from seaweedfs_tpu.ec.schedule import schedule_enabled
+
     rows_tuple = tuple(int(v) for v in np.asarray(matrix, dtype=np.uint8).reshape(-1))
     r_out, k = matrix.shape
     return swar_apply_u32(
-        inputs_u32, _swar_tn(inputs_u32.shape[1]), r_out, k, rows_tuple, interpret
+        inputs_u32,
+        _swar_tn(inputs_u32.shape[1]),
+        r_out,
+        k,
+        rows_tuple,
+        interpret,
+        sched=schedule_enabled(),
     )
 
 

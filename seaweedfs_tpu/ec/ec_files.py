@@ -579,6 +579,63 @@ def rebuild_ec_files(
     return missing
 
 
+def rebuild_ec_files_batch(
+    base_file_names: list[str],
+    codec=None,
+    tile_bytes: int | None = None,
+    stats: dict | None = None,
+    durable: bool = False,
+    want_crcs: bool = False,
+) -> list[list[int]]:
+    """Regenerate missing .ec files for N volumes, batched: volumes
+    sharing a (survivors, targets) signature ride ONE sharded mesh
+    decode program per tile round
+    (ec_stream.stream_rebuild_ec_files_batch over
+    parallel/mesh_codec.reconstruct_batch_u32) — the BatchRebuild
+    verb's driver, so the RepairScheduler amortizes dispatch latency
+    over concurrent small-volume rebuilds instead of paying it per
+    volume. Every survivor must be local (the remote rack-gather path
+    stays per-volume).
+
+    WEED_EC_PIPELINE=0 restores a serial per-volume rebuild_ec_files
+    loop wholesale — byte-identical output, same durable contract.
+    Returns the rebuilt id lists in input order; want_crcs lands
+    `shard_crcs` in stats as one {rebuilt id: whole-file CRC-32C} dict
+    per volume on both arms."""
+    from seaweedfs_tpu.ec import ec_stream
+
+    if not base_file_names:
+        return []
+    if ec_stream.pipeline_enabled():
+        return ec_stream.stream_rebuild_ec_files_batch(
+            base_file_names,
+            codec=codec,
+            tile_bytes=tile_bytes,
+            stats=stats,
+            durable=durable,
+            want_crcs=want_crcs,
+        )
+    results = []
+    all_crcs = []
+    for base in base_file_names:
+        s: dict = {}
+        results.append(
+            rebuild_ec_files(
+                base,
+                buffer_size=tile_bytes,
+                durable=durable,
+                stats=s,
+                want_crcs=want_crcs,
+            )
+        )
+        all_crcs.append(s.get("shard_crcs") or {})
+    if stats is not None:
+        stats["batch_volumes"] = len(base_file_names)
+        if want_crcs:
+            stats["shard_crcs"] = all_crcs
+    return results
+
+
 # --- .ecx sorted index ------------------------------------------------------
 
 def compact_idx_entries(idx_data: bytes) -> bytes:

@@ -1467,6 +1467,27 @@ def _default_mesh_codec(batch: int):
     return MeshCodec(make_mesh(devices, stripe=len(devices) // vol_axis))
 
 
+class _HostBatchCodec:
+    """Marker codec routing the batch REBUILD driver to its host arm
+    (_rebuild_batch_chunk_host) on hosts whose only jax devices are
+    CPU: the SWAR Pallas kernels run interpreted there, orders of
+    magnitude under the host RS backends, so the batch win must come
+    from the host side instead — ONE shared pipeline (one thread-pool
+    spin-up, one staging ring, per-(volume, tile) work items) for the
+    whole group, where the serial path pays the driver's fixed cost
+    once per volume. Byte-identical to the per-volume path: same
+    cached decode rows, same survivor order, bytewise GF math."""
+
+    def __init__(self, rs):
+        import types
+
+        self.rs = rs
+        # group-chunking code only reads devices.shape: (1, 1)
+        self.mesh = types.SimpleNamespace(
+            devices=np.empty((1, 1), dtype=object)
+        )
+
+
 def _stream_batch_chunk(
     bases: list[str], codec, tile_bytes, large_block_size, small_block_size,
     stats, durable, want_crcs, reader_threads, writer_threads,
@@ -1768,5 +1789,856 @@ def _fold_batch_crcs(
                 continue
             for i in range(TOTAL_SHARDS):
                 acc[i] = crc32c_combine(acc[i], vol_crcs[v][i], step)
+        out.append(acc)
+    return out
+
+
+def stream_rebuild_ec_files_batch(
+    base_file_names: list[str],
+    codec=None,
+    tile_bytes: int | None = None,
+    stats: dict | None = None,
+    durable: bool = False,
+    want_crcs: bool = False,
+    reader_threads: int | None = None,
+    writer_threads: int | None = None,
+) -> list[list[int]]:
+    """Rebuild N volumes' missing shard files through ONE sharded mesh
+    program per tile round — the rebuild-side sibling of
+    stream_write_ec_files_batch. The RepairScheduler's common case is a
+    node loss surfacing many small EC volumes missing the SAME shard
+    ids at once; rebuilding them one dispatch per volume is
+    latency-bound exactly like the small-volume encode was. Here each
+    tile round stacks one [k, W] survivor tile per volume into a
+    [B, k, W/4]-lane batch laid out P('vol', None, 'stripe') and runs
+    parallel/mesh_codec.reconstruct_batch_u32 once.
+
+    Volumes are grouped by their (survivors, targets) signature — each
+    group compiles one decode program; mixed-damage batches run one
+    group after another, still batched within each. Every survivor must
+    be LOCAL: the rack-gather/remote-reader and repair-session features
+    stay with the single-volume driver (callers with remote survivors
+    route there). Output bytes per volume are identical to
+    rebuild_ec_files (RS determinism over the same ascending survivor
+    choice).
+
+    want_crcs=True lands `shard_crcs` in stats: one {rebuilt shard id:
+    whole-file CRC-32C} dict per volume, in base_file_names order
+    (host table CRCs per round — reconstruct has no fused CRC tier —
+    folded with crc32c_combine). Returns the per-volume rebuilt id
+    lists in base_file_names order; volumes with nothing missing
+    return [].
+
+    `durable=True` fsyncs every rebuilt shard before returning; a
+    failed chunk removes ALL its volumes' target files (the abort
+    contract scrub relies on: no partial rebuilt shard survives)."""
+    from seaweedfs_tpu.ec.ec_files import shard_presence, to_ext
+
+    results: list[list[int]] = [[] for _ in base_file_names]
+    if not base_file_names:
+        return results
+    groups: dict[tuple, list[int]] = {}
+    sigs: list[tuple | None] = []
+    for i, base in enumerate(base_file_names):
+        present, missing = shard_presence(base)
+        targets = tuple(missing)
+        if not targets:
+            sigs.append(None)
+            continue
+        local_ids = [s for s, p in enumerate(present) if p]
+        if len(local_ids) < DATA_SHARDS:
+            raise ValueError(
+                f"too few local shard files to batch-rebuild {base}: "
+                f"{len(local_ids)} of {DATA_SHARDS}"
+            )
+        # same ascending first-k survivor choice as the single-volume
+        # driver with no remote holders: byte-identical output
+        survivors = tuple(sorted(local_ids)[:DATA_SHARDS])
+        sig = (survivors, targets)
+        sigs.append(sig)
+        groups.setdefault(sig, []).append(i)
+
+    if not groups:
+        if stats is not None:
+            stats["batch_volumes"] = len(base_file_names)
+            stats["batch_groups"] = 0
+            if want_crcs:
+                stats["shard_crcs"] = [{} for _ in base_file_names]
+        return results
+
+    if codec is None:
+        try:
+            import jax
+
+            if all(d.platform == "cpu" for d in jax.devices()):
+                # no accelerator: the interpreted Pallas kernels lose
+                # to the host backends by orders of magnitude, so run
+                # the batch through the host arm (same grouping and
+                # staging, one concatenated matrix apply per round)
+                from seaweedfs_tpu.ec.codec import new_encoder
+
+                try:
+                    codec = _HostBatchCodec(new_encoder(backend="native"))
+                except (ImportError, ValueError):
+                    codec = _HostBatchCodec(new_encoder(backend="cpu"))
+            else:
+                codec = _default_mesh_codec(
+                    max(len(idxs) for idxs in groups.values())
+                )
+        except ImportError:
+            # no jax: the single-volume pipeline per volume is the
+            # byte-identical fallback seam (it self-selects the host
+            # codec the same way rebuild_ec_files does)
+            from seaweedfs_tpu.ec import ec_files as _ec_files
+
+            all_crcs: list = []
+            for i, base in enumerate(base_file_names):
+                if sigs[i] is None:
+                    all_crcs.append({})
+                    continue
+                s: dict = {}
+                results[i] = _ec_files.rebuild_ec_files(
+                    base, durable=durable, stats=s, want_crcs=want_crcs
+                )
+                all_crcs.append(s.get("shard_crcs") or {})
+            if stats is not None:
+                stats["fallback"] = "host"
+                stats["batch_volumes"] = len(base_file_names)
+                stats["batch_groups"] = len(groups)
+                if want_crcs:
+                    stats["shard_crcs"] = all_crcs
+            return results
+
+    limit = pipeline_batch_limit()
+    crcs_by_vol: dict[int, dict] = {}
+    float_acc: dict[str, float] = {}
+    last_struct: dict = {}
+    for (survivors, targets), idxs in groups.items():
+        chunks = (
+            [idxs[i : i + limit] for i in range(0, len(idxs), limit)]
+            if limit
+            else [idxs]
+        )
+        for chunk in chunks:
+            chunk_stats: dict = {}
+            # each chunk self-provisions a mesh that fits ITS size when
+            # the caller passed none originally — but a caller codec is
+            # honored only if the chunk shards over its vol axis
+            chunk_codec = codec
+            if len(chunk) % codec.mesh.devices.shape[0]:
+                chunk_codec = _default_mesh_codec(len(chunk))
+            _rebuild_batch_chunk(
+                [base_file_names[i] for i in chunk],
+                chunk_codec, survivors, targets, tile_bytes, chunk_stats,
+                durable, want_crcs, reader_threads, writer_threads,
+            )
+            for i in chunk:
+                results[i] = list(targets)
+            if want_crcs:
+                for i, crcs in zip(
+                    chunk, chunk_stats.get("shard_crcs") or []
+                ):
+                    crcs_by_vol[i] = crcs
+            for k, v in chunk_stats.items():
+                if isinstance(v, float):
+                    float_acc[k] = round(float_acc.get(k, 0.0) + v, 4)
+                elif k != "shard_crcs":
+                    last_struct[k] = v
+    if stats is not None:
+        stats.update(float_acc)
+        stats.update(last_struct)
+        stats["batch_volumes"] = len(base_file_names)
+        stats["batch_groups"] = len(groups)
+        if want_crcs:
+            stats["shard_crcs"] = [
+                crcs_by_vol.get(i, {}) for i in range(len(base_file_names))
+            ]
+    return results
+
+
+def _rebuild_batch_chunk(
+    bases: list[str], codec, survivors: tuple[int, ...],
+    targets: tuple[int, ...], tile_bytes, stats, durable, want_crcs,
+    reader_threads, writer_threads,
+) -> None:
+    """One (survivors, targets)-homogeneous chunk through the mesh:
+    the rebuild-side mirror of _stream_batch_chunk. Reads [k, step]
+    survivor tiles per volume into a [B, k, W] staging slot, runs
+    reconstruct_batch_u32 once per round, pwrites the rebuilt target
+    rows. Same abort contract: any failure removes every volume's
+    target files."""
+    if isinstance(codec, _HostBatchCodec):
+        return _rebuild_batch_chunk_host(
+            bases, codec.rs, survivors, targets, tile_bytes, stats,
+            durable, want_crcs, reader_threads, writer_threads,
+        )
+    from seaweedfs_tpu.ec.ec_files import to_ext
+
+    # local rebuilds want the fine tile (BENCH_r12: more in-flight
+    # preads to overlap, page-cache-friendly spans) — and the batch arm
+    # is local-survivor-only by contract
+    tile_bytes = tile_bytes or DEFAULT_TILE_BYTES // 2
+    writer_threads = writer_threads or DEFAULT_WRITER_THREADS
+    reader_threads = reader_threads or DEFAULT_READER_THREADS
+    depth = pipeline_depth()
+    b = len(bases)
+    vol_axis = codec.mesh.devices.shape[0]
+    stripe = codec.mesh.devices.shape[1]
+    if b % vol_axis:
+        raise ValueError(
+            f"batch of {b} volumes does not shard over the mesh's "
+            f"{vol_axis}-way 'vol' axis"
+        )
+
+    sizes = [
+        os.path.getsize(base + to_ext(survivors[0])) for base in bases
+    ]
+    rounds = max(-(-size // tile_bytes) for size in sizes)
+    if not rounds:
+        # all-empty shard sets: rebuilt targets are empty files too
+        from seaweedfs_tpu.util import durable as _durable
+
+        for base in bases:
+            for t in targets:
+                open(base + to_ext(t), "wb").close()
+                if durable:
+                    _durable.fsync_path(base + to_ext(t))
+        if stats is not None:
+            stats["batch_volumes"] = b
+            if want_crcs:
+                stats["shard_crcs"] = [
+                    {t: 0 for t in targets} for _ in bases
+                ]
+        return
+    step_of = [
+        [
+            max(0, min(tile_bytes, sizes[v] - r * tile_bytes))
+            for v in range(b)
+        ]
+        for r in range(rounds)
+    ]
+    # one static tile width for every round, rounded so the u32 lane
+    # count splits over the stripe axis in whole SWAR-friendly chunks
+    max_step = max(step for row in step_of for step in row)
+    gran = 4 * 1024 * stripe
+    width = -(-max_step // gran) * gran
+
+    pipe = _Pipeline()
+    read_q: queue.Queue = queue.Queue(maxsize=max(2, reader_threads))
+    write_q: queue.Queue = queue.Queue(maxsize=depth)
+    ring = _StagingRing(
+        depth + writer_threads + 1, b * DATA_SHARDS * width
+    )
+    busy = {
+        "read_s": 0.0,
+        "stage_s": 0.0,
+        "device_s": 0.0,
+        "writeback_s": 0.0,
+        "compute_s": 0.0,
+        "write_s": 0.0,
+    }
+    busy_lock = threading.Lock()
+    round_crcs: list = [None] * rounds
+    wall0 = time.perf_counter()
+    _sp = trace.span(
+        "ec_stream.rebuild_batch",
+        nbytes=sum(sizes) * max(1, len(targets)),
+    )
+    _sp.__enter__()
+
+    idx_lock = threading.Lock()
+    idx_iter = iter(range(rounds))
+    out_fds: list[dict[int, int]] = []
+    read_local = EC_REPAIR_BYTES_READ.labels("local")
+
+    def reader():
+        fds = [
+            [
+                os.open(base + to_ext(s), os.O_RDONLY)
+                for s in survivors
+            ]
+            for base in bases
+        ]
+        try:
+            while True:
+                with idx_lock:
+                    r = next(idx_iter, None)
+                if r is None:
+                    return
+                got_slot = ring.acquire(pipe.stop)
+                if got_slot is None:
+                    return
+                slot_id, buf = got_slot
+                t0 = time.perf_counter()
+                buf3 = buf[: b * DATA_SHARDS * width].reshape(
+                    b, DATA_SHARDS, width
+                )
+                off = r * tile_bytes
+                for v in range(b):
+                    step = step_of[r][v]
+                    if not step:
+                        continue  # volume done: output discarded
+                    tile = buf3[v, :, :step]
+                    for j in range(DATA_SHARDS):
+                        got = _pread_into(fds[v][j], tile[j], off)
+                        read_local.inc(got)
+                        if got != step:
+                            raise ValueError(
+                                f"ec shard {survivors[j]} truncated: "
+                                f"expected {step} at {off} "
+                                f"({bases[v] + to_ext(survivors[j])})"
+                            )
+                _charge(busy, busy_lock, "read_s", time.perf_counter() - t0)
+                if not _q_put(read_q, (r, slot_id, buf3), pipe.stop):
+                    ring.release(slot_id)
+                    return
+        finally:
+            for vol_fds in fds:
+                for fd in vol_fds:
+                    os.close(fd)
+
+    def writer():
+        import jax
+
+        while True:
+            item = _q_get(write_q, pipe.stop)
+            if item is _EOF or item is _STOPPED:
+                return
+            r, slot_id, buf3, handle = item
+            t0 = time.perf_counter()
+            rebuilt = (
+                np.asarray(jax.device_get(handle))
+                .view(np.uint8)
+                .reshape(b, len(targets), width)
+            )
+            t1 = time.perf_counter()
+            vol_crcs: list = [None] * b
+            if want_crcs:
+                from seaweedfs_tpu.util.crc import crc32c
+
+                for v in range(b):
+                    step = step_of[r][v]
+                    if not step:
+                        continue
+                    # no fused CRC tier for reconstruct: host table
+                    # CRC the rebuilt rows (charged to compute_s)
+                    vol_crcs[v] = [
+                        crc32c(
+                            np.ascontiguousarray(
+                                rebuilt[v][t, :step]
+                            ).tobytes()
+                        )
+                        for t in range(len(targets))
+                    ]
+            t2 = time.perf_counter()
+            off = r * tile_bytes
+            for v in range(b):
+                step = step_of[r][v]
+                if not step:
+                    continue
+                for t, tid in enumerate(targets):
+                    _pwrite_full(
+                        out_fds[v][tid],
+                        np.ascontiguousarray(rebuilt[v][t, :step]),
+                        off,
+                    )
+                    EC_REPAIR_BYTES_WRITTEN.inc(step)
+            t3 = time.perf_counter()
+            if want_crcs:
+                round_crcs[r] = vol_crcs
+            ring.release(slot_id)
+            _charge(busy, busy_lock, "writeback_s", t1 - t0)
+            _charge(busy, busy_lock, "compute_s", t2 - t1)
+            _charge(busy, busy_lock, "write_s", t3 - t2)
+
+    ok = False
+    try:
+        for v, base in enumerate(bases):
+            fds: dict[int, int] = {}
+            out_fds.append(fds)
+            for tid in targets:
+                fds[tid] = os.open(
+                    base + to_ext(tid),
+                    os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                    0o644,
+                )
+            for fd in fds.values():
+                _preallocate(fd, sizes[v])
+        for _ in range(min(reader_threads, rounds)):
+            pipe.spawn(reader)
+        for _ in range(writer_threads):
+            pipe.spawn(writer)
+        for _ in range(rounds):
+            item = _q_get(read_q, pipe.stop)
+            if item is _STOPPED:
+                break
+            r, slot_id, buf3 = item
+            t0 = time.perf_counter()
+            vols = codec.shard_volumes(buf3.view(np.uint32))
+            t1 = time.perf_counter()
+            handle = codec.reconstruct_batch_u32(survivors, targets, vols)
+            t2 = time.perf_counter()
+            _charge(busy, busy_lock, "stage_s", t1 - t0)
+            _charge(busy, busy_lock, "device_s", t2 - t1)
+            if not _q_put(write_q, (r, slot_id, buf3, handle), pipe.stop):
+                break
+        for _ in range(writer_threads):
+            if not _q_put(write_q, _EOF, pipe.stop):
+                break
+        ok = True
+    finally:
+        try:
+            pipe.finish(caller_error=not ok)
+        finally:
+            tc0 = time.perf_counter()
+            fsync_err: OSError | None = None
+            try:
+                for fds in out_fds:
+                    for fd in fds.values():
+                        try:
+                            if durable and ok and not pipe.errors:
+                                try:
+                                    os.fsync(fd)
+                                except OSError as e:
+                                    if fsync_err is None:
+                                        fsync_err = e
+                            os.close(fd)
+                        except OSError:
+                            pass
+                if not ok or pipe.errors or fsync_err is not None:
+                    # abort contract: no partial rebuilt shard may
+                    # survive for ANY volume in the chunk
+                    for base in bases:
+                        for tid in targets:
+                            try:
+                                os.remove(base + to_ext(tid))
+                            except OSError:
+                                pass
+                if fsync_err is not None:
+                    raise fsync_err
+            finally:
+                busy["flush_s"] = time.perf_counter() - tc0
+                if stats is not None:
+                    _finish_stats(
+                        stats, busy, wall0, reader_threads, writer_threads
+                    )
+                    stats["pipeline_depth"] = depth
+                    stats["ring_slots"] = ring.slots
+                    stats["batch_volumes"] = b
+                    stats["mesh"] = {"vol": vol_axis, "stripe": stripe}
+                    if (
+                        want_crcs
+                        and ok
+                        and not pipe.errors
+                        and fsync_err is None
+                    ):
+                        stats["shard_crcs"] = _fold_rebuild_batch_crcs(
+                            b, targets, step_of, round_crcs
+                        )
+                _trace_stages(_sp, busy)
+                _sp.__exit__(*sys.exc_info())
+
+
+# At or below this many (volume, tile) work items the host arm skips
+# the thread pipeline entirely: on small batches every queue handoff
+# and Thread.start costs a scheduler wakeup (milliseconds on a busy
+# single-CPU host) that dwarfs the native-codec work it brokers.
+_HOST_INLINE_TILES = 16
+
+
+def _rebuild_batch_chunk_host_inline(
+    bases: list[str], rs, rows, survivors: tuple[int, ...],
+    targets: tuple[int, ...], sizes: list[int],
+    items: list[tuple[int, int]], tile_bytes: int, stats, durable,
+    want_crcs,
+) -> None:
+    """Zero-thread host arm for small batches: one staging buffer, one
+    pass over the flat (volume, tile) work list, decode via the group's
+    cached decode-rows matrix. Many-small-volumes repair is latency-
+    bound on fixed costs, so the win here is paying ONE set of them for
+    the whole batch and none of the pipeline's per-handoff scheduler
+    wakeups. Same durability/abort contract as the threaded arms."""
+    from seaweedfs_tpu.ec.ec_files import to_ext
+
+    b = len(bases)
+    busy = {"read_s": 0.0, "compute_s": 0.0, "write_s": 0.0}
+    crc_parts: list[tuple[int, int, int, list[int]]] = []
+    wall0 = time.perf_counter()
+    buf = np.empty((DATA_SHARDS, tile_bytes), dtype=np.uint8)
+    in_fds: list[list[int] | None] = [None] * b
+    out_fds: list[dict[int, int]] = []
+    read_local = EC_REPAIR_BYTES_READ.labels("local")
+    ok = False
+    with trace.span(
+        "ec_stream.rebuild_batch",
+        nbytes=sum(sizes) * max(1, len(targets)),
+    ) as _sp:
+        try:
+            for v, base in enumerate(bases):
+                fds: dict[int, int] = {}
+                out_fds.append(fds)
+                for tid in targets:
+                    fds[tid] = os.open(
+                        base + to_ext(tid),
+                        os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                        0o644,
+                    )
+                    _preallocate(fds[tid], sizes[v])
+            for v, off in items:
+                vol_fds = in_fds[v]
+                if vol_fds is None:
+                    vol_fds = in_fds[v] = [
+                        os.open(bases[v] + to_ext(s), os.O_RDONLY)
+                        for s in survivors
+                    ]
+                step = min(tile_bytes, sizes[v] - off)
+                tile = buf[:, :step]
+                t0 = time.perf_counter()
+                for j in range(DATA_SHARDS):
+                    got = _pread_into(vol_fds[j], tile[j], off)
+                    read_local.inc(got)
+                    if got != step:
+                        raise ValueError(
+                            f"ec shard {survivors[j]} truncated: "
+                            f"expected {step} at {off} "
+                            f"({bases[v] + to_ext(survivors[j])})"
+                        )
+                t1 = time.perf_counter()
+                rebuilt = rs._apply(rows, tile)
+                if want_crcs:
+                    from seaweedfs_tpu.util.crc import crc32c
+
+                    crc_parts.append((v, off, step, [
+                        crc32c(
+                            np.ascontiguousarray(rebuilt[t]).tobytes()
+                        )
+                        for t in range(len(targets))
+                    ]))
+                t2 = time.perf_counter()
+                for t, tid in enumerate(targets):
+                    _pwrite_full(
+                        out_fds[v][tid],
+                        np.ascontiguousarray(rebuilt[t]),
+                        off,
+                    )
+                    EC_REPAIR_BYTES_WRITTEN.inc(step)
+                t3 = time.perf_counter()
+                busy["read_s"] += t1 - t0
+                busy["compute_s"] += t2 - t1
+                busy["write_s"] += t3 - t2
+            ok = True
+        finally:
+            for vol_fds in in_fds:
+                for ifd in vol_fds or ():
+                    try:
+                        os.close(ifd)
+                    except OSError:
+                        pass
+            tc0 = time.perf_counter()
+            fsync_err: OSError | None = None
+            try:
+                for fds in out_fds:
+                    for fd in fds.values():
+                        try:
+                            if durable and ok:
+                                try:
+                                    os.fsync(fd)
+                                except OSError as e:
+                                    if fsync_err is None:
+                                        fsync_err = e
+                            os.close(fd)
+                        except OSError:
+                            pass
+                if not ok or fsync_err is not None:
+                    for base in bases:
+                        for tid in targets:
+                            try:
+                                os.remove(base + to_ext(tid))
+                            except OSError:
+                                pass
+                if fsync_err is not None:
+                    raise fsync_err
+            finally:
+                busy["flush_s"] = time.perf_counter() - tc0
+                if stats is not None:
+                    _finish_stats(stats, busy, wall0, 1, 1)
+                    stats["batch_volumes"] = b
+                    stats["codec_arm"] = "host"
+                    stats["host_inline"] = True
+                    if want_crcs and ok and fsync_err is None:
+                        stats["shard_crcs"] = _fold_host_batch_crcs(
+                            b, targets, crc_parts
+                        )
+                _trace_stages(_sp, busy)
+
+
+def _rebuild_batch_chunk_host(
+    bases: list[str], rs, survivors: tuple[int, ...],
+    targets: tuple[int, ...], tile_bytes, stats, durable, want_crcs,
+    reader_threads, writer_threads,
+) -> None:
+    """Host arm of the batch rebuild: one shared pipeline whose work
+    items are per-(volume, tile) survivor gathers, decoded in the
+    writer pool with the group's single cached decode-rows matrix.
+    Slots stay at the single-volume driver's [k, tile] size (cache-
+    resident on small hosts — an all-volumes-per-round slot measurably
+    loses CPU to memory traffic), and the stream crosses volume
+    boundaries without the per-volume spawn/drain the serial path
+    pays. Same abort contract as the mesh arm."""
+    from seaweedfs_tpu.ec.ec_files import to_ext
+
+    tile_bytes = tile_bytes or DEFAULT_TILE_BYTES // 2
+    writer_threads = writer_threads or DEFAULT_WRITER_THREADS
+    reader_threads = reader_threads or DEFAULT_READER_THREADS
+    depth = pipeline_depth()
+    b = len(bases)
+    sizes = [
+        os.path.getsize(base + to_ext(survivors[0])) for base in bases
+    ]
+    # flat (volume, offset) work list: the pipeline streams straight
+    # through volume boundaries, no drain between them
+    items = [
+        (v, off)
+        for v in range(b)
+        for off in range(0, sizes[v], tile_bytes)
+    ]
+    if not items:
+        from seaweedfs_tpu.util import durable as _durable
+
+        for base in bases:
+            for t in targets:
+                open(base + to_ext(t), "wb").close()
+                if durable:
+                    _durable.fsync_path(base + to_ext(t))
+        if stats is not None:
+            stats["batch_volumes"] = b
+            stats["codec_arm"] = "host"
+            if want_crcs:
+                stats["shard_crcs"] = [
+                    {t: 0 for t in targets} for _ in bases
+                ]
+        return
+
+    rows = rs.decode_rows(tuple(survivors), tuple(targets))
+    if len(items) <= _HOST_INLINE_TILES:
+        return _rebuild_batch_chunk_host_inline(
+            bases, rs, rows, survivors, targets, sizes, items,
+            tile_bytes, stats, durable, want_crcs,
+        )
+    pipe = _Pipeline()
+    read_q: queue.Queue = queue.Queue(maxsize=max(2, reader_threads))
+    write_q: queue.Queue = queue.Queue(maxsize=depth)
+    ring = _StagingRing(
+        depth + writer_threads + 1, DATA_SHARDS * tile_bytes
+    )
+    busy = {
+        "read_s": 0.0,
+        "stage_s": 0.0,
+        "device_s": 0.0,
+        "writeback_s": 0.0,
+        "compute_s": 0.0,
+        "write_s": 0.0,
+    }
+    busy_lock = threading.Lock()
+    # (volume, offset, step, [crc per target]); append is GIL-atomic,
+    # order restored by sorting on offset at fold time
+    crc_parts: list[tuple[int, int, int, list[int]]] = []
+    wall0 = time.perf_counter()
+    _sp = trace.span(
+        "ec_stream.rebuild_batch",
+        nbytes=sum(sizes) * max(1, len(targets)),
+    )
+    _sp.__enter__()
+
+    idx_lock = threading.Lock()
+    idx_iter = iter(items)
+    out_fds: list[dict[int, int]] = []
+    read_local = EC_REPAIR_BYTES_READ.labels("local")
+
+    def reader():
+        fds: dict[int, list[int]] = {}  # volume -> survivor fds, lazy
+        try:
+            while True:
+                with idx_lock:
+                    it = next(idx_iter, None)
+                if it is None:
+                    return
+                v, off = it
+                vol_fds = fds.get(v)
+                if vol_fds is None:
+                    vol_fds = fds[v] = [
+                        os.open(bases[v] + to_ext(s), os.O_RDONLY)
+                        for s in survivors
+                    ]
+                got_slot = ring.acquire(pipe.stop)
+                if got_slot is None:
+                    return
+                slot_id, buf = got_slot
+                step = min(tile_bytes, sizes[v] - off)
+                t0 = time.perf_counter()
+                tile = buf[: DATA_SHARDS * step].reshape(
+                    DATA_SHARDS, step
+                )
+                for j in range(DATA_SHARDS):
+                    got = _pread_into(vol_fds[j], tile[j], off)
+                    read_local.inc(got)
+                    if got != step:
+                        raise ValueError(
+                            f"ec shard {survivors[j]} truncated: "
+                            f"expected {step} at {off} "
+                            f"({bases[v] + to_ext(survivors[j])})"
+                        )
+                _charge(busy, busy_lock, "read_s", time.perf_counter() - t0)
+                if not _q_put(
+                    read_q, (v, off, step, slot_id, tile), pipe.stop
+                ):
+                    ring.release(slot_id)
+                    return
+        finally:
+            for vol_fds in fds.values():
+                for fd in vol_fds:
+                    os.close(fd)
+
+    def writer():
+        while True:
+            item = _q_get(write_q, pipe.stop)
+            if item is _EOF or item is _STOPPED:
+                return
+            v, off, step, slot_id, tile = item
+            t0 = time.perf_counter()
+            rebuilt = rs._apply(rows, tile)
+            t1 = time.perf_counter()
+            if want_crcs:
+                from seaweedfs_tpu.util.crc import crc32c
+
+                crc_parts.append((v, off, step, [
+                    crc32c(np.ascontiguousarray(rebuilt[t]).tobytes())
+                    for t in range(len(targets))
+                ]))
+            t2 = time.perf_counter()
+            for t, tid in enumerate(targets):
+                _pwrite_full(
+                    out_fds[v][tid],
+                    np.ascontiguousarray(rebuilt[t]),
+                    off,
+                )
+                EC_REPAIR_BYTES_WRITTEN.inc(step)
+            t3 = time.perf_counter()
+            ring.release(slot_id)
+            _charge(busy, busy_lock, "compute_s", t2 - t0)
+            _charge(busy, busy_lock, "write_s", t3 - t2)
+
+    ok = False
+    try:
+        for v, base in enumerate(bases):
+            fds: dict[int, int] = {}
+            out_fds.append(fds)
+            for tid in targets:
+                fds[tid] = os.open(
+                    base + to_ext(tid),
+                    os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                    0o644,
+                )
+            for fd in fds.values():
+                _preallocate(fd, sizes[v])
+        for _ in range(min(reader_threads, len(items))):
+            pipe.spawn(reader)
+        for _ in range(writer_threads):
+            pipe.spawn(writer)
+        for _ in range(len(items)):
+            item = _q_get(read_q, pipe.stop)
+            if item is _STOPPED:
+                break
+            if not _q_put(write_q, item, pipe.stop):
+                break
+        for _ in range(writer_threads):
+            if not _q_put(write_q, _EOF, pipe.stop):
+                break
+        ok = True
+    finally:
+        try:
+            pipe.finish(caller_error=not ok)
+        finally:
+            tc0 = time.perf_counter()
+            fsync_err: OSError | None = None
+            try:
+                for fds in out_fds:
+                    for fd in fds.values():
+                        try:
+                            if durable and ok and not pipe.errors:
+                                try:
+                                    os.fsync(fd)
+                                except OSError as e:
+                                    if fsync_err is None:
+                                        fsync_err = e
+                            os.close(fd)
+                        except OSError:
+                            pass
+                if not ok or pipe.errors or fsync_err is not None:
+                    for base in bases:
+                        for tid in targets:
+                            try:
+                                os.remove(base + to_ext(tid))
+                            except OSError:
+                                pass
+                if fsync_err is not None:
+                    raise fsync_err
+            finally:
+                busy["flush_s"] = time.perf_counter() - tc0
+                if stats is not None:
+                    _finish_stats(
+                        stats, busy, wall0, reader_threads, writer_threads
+                    )
+                    stats["pipeline_depth"] = depth
+                    stats["ring_slots"] = ring.slots
+                    stats["batch_volumes"] = b
+                    stats["codec_arm"] = "host"
+                    if (
+                        want_crcs
+                        and ok
+                        and not pipe.errors
+                        and fsync_err is None
+                    ):
+                        stats["shard_crcs"] = _fold_host_batch_crcs(
+                            b, targets, crc_parts
+                        )
+                _trace_stages(_sp, busy)
+                _sp.__exit__(*sys.exc_info())
+
+
+def _fold_host_batch_crcs(
+    b: int, targets: tuple[int, ...],
+    crc_parts: list[tuple[int, int, int, list[int]]],
+) -> list[dict[int, int]]:
+    """Per-volume {rebuilt shard id: whole-file CRC} folded from the
+    writer pool's per-tile records in offset order."""
+    from seaweedfs_tpu.util.crc import crc32c_combine
+
+    out = [dict.fromkeys(targets, 0) for _ in range(b)]
+    for v, off, step, crcs in sorted(crc_parts):
+        for t, tid in enumerate(targets):
+            out[v][tid] = crc32c_combine(out[v][tid], crcs[t], step)
+    return out
+
+
+def _fold_rebuild_batch_crcs(
+    b: int,
+    targets: tuple[int, ...],
+    step_of: list[list[int]],
+    round_crcs: list,
+) -> list[dict[int, int]]:
+    """Per-volume {rebuilt shard id: whole-file CRC} from the writer
+    pool's per-round records, folded in round order."""
+    from seaweedfs_tpu.util.crc import crc32c_combine
+
+    out = []
+    for v in range(b):
+        acc = {tid: 0 for tid in targets}
+        for r, vol_crcs in enumerate(round_crcs):
+            step = step_of[r][v]
+            if not step or vol_crcs is None or vol_crcs[v] is None:
+                continue
+            for t, tid in enumerate(targets):
+                acc[tid] = crc32c_combine(acc[tid], vol_crcs[v][t], step)
         out.append(acc)
     return out

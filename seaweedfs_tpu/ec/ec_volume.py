@@ -44,6 +44,13 @@ from seaweedfs_tpu.util import durable
 # the shard is unavailable everywhere (candidates exhausted).
 ShardFetcher = Callable[[int, int, int], Optional[bytes]]
 
+# staging cap for a tile-batched degraded decode: one leader decodes at
+# most this many contiguous cold tiles in one gather + dispatch (32 x
+# the 256 KiB default tile = 8 MiB of survivor staging per run — big
+# enough that a whole-object degraded GET is one dispatch, small enough
+# that k x run of survivor bytes stays cache-friendly)
+_DECODE_RUN_TILES = 32
+
 
 class NotEnoughShards(RuntimeError):
     pass
@@ -414,7 +421,13 @@ class EcVolume:
         self, target_shard: int, offset: int, size: int, fetch: ShardFetcher | None
     ) -> bytes:
         """Serve a degraded interval, decoding whole cache tiles so the
-        k-shard gather runs once per tile instead of once per GET.
+        k-shard gather runs once per tile instead of once per GET —
+        and decoding contiguous RUNS of uncached tiles in ONE
+        gather + decode dispatch: a GET spanning M cold tiles used to
+        round-trip the survivor gather and the codec M times; now the
+        leader stages the whole run's survivor span once, decodes it
+        in one dispatch (bytewise RS: a span decode IS the per-tile
+        decodes concatenated), and feeds the tile cache in bulk.
         Freshly decoded tiles are donated to an in-progress rebuild of
         the same shard (repair piggyback, docs/SCRUB.md)."""
         from seaweedfs_tpu.stats.metrics import EC_DEGRADED_READS
@@ -439,7 +452,7 @@ class EcVolume:
         while pos < end:
             t_off = (pos // tile) * tile
             data = cache.get(target_shard, t_off)
-            registered = False
+            owned: list[tuple[int, threading.Event]] = []
             if data is None:
                 # singleflight: exactly one thread decodes a given tile;
                 # the rest wait on its event and re-probe the cache —
@@ -449,17 +462,52 @@ class EcVolume:
                 with self._decode_inflight_lock:
                     leader_ev = self._decode_inflight.get(key)
                     if leader_ev is None:
-                        self._decode_inflight[key] = threading.Event()
-                        registered = True
-                if not registered:
+                        ev = threading.Event()
+                        self._decode_inflight[key] = ev
+                        owned.append((t_off, ev))
+                if not owned:
                     leader_ev.wait(timeout=30.0)
                     data = cache.get(target_shard, t_off)
                     # a miss here means the leader failed (or the cache
                     # evicted/invalidated): decode for ourselves below,
                     # WITHOUT re-registering — correctness never depends
                     # on the singleflight, only the stampede width does
-            if data is None:
+            if data is None and not owned:
                 t_len = min(tile, shard_len - t_off)
+                gen = cache.invalidations
+                data = self._reconstruct_range(
+                    target_shard, t_off, t_len, fetch
+                )
+                if cache.put(target_shard, t_off, data, gen=gen) and (
+                    sess is not None
+                ):
+                    sess.donate(target_shard, t_off, data)
+            elif data is None:
+                # this thread leads tile t_off: extend leadership over
+                # the following uncached tiles this interval still
+                # needs (stopping at a cache hit, another leader, the
+                # shard tail, or the staging cap) — the whole run then
+                # costs ONE survivor gather and ONE decode dispatch
+                run_lim = min(shard_len, -(-end // tile) * tile)
+                nxt = t_off + tile
+                while nxt < run_lim and len(owned) < _DECODE_RUN_TILES:
+                    if cache.get(target_shard, nxt) is not None:
+                        break
+                    key = (target_shard, nxt)
+                    with self._decode_inflight_lock:
+                        if key in self._decode_inflight:
+                            break
+                        ev = threading.Event()
+                        self._decode_inflight[key] = ev
+                    owned.append((nxt, ev))
+                    nxt += tile
+                run_len = min(nxt, shard_len) - t_off
+                if run_len <= 0:
+                    self._release_decode_leases(target_shard, owned)
+                    raise NotEnoughShards(
+                        f"vid {self.volume_id}: shard {target_shard} "
+                        f"interval [{offset}, {end}) past shard length"
+                    )
                 # capture the invalidation generation BEFORE the gather:
                 # a quarantine landing mid-decode may mean a survivor we
                 # already read was corrupt — the stale result must not
@@ -467,28 +515,38 @@ class EcVolume:
                 # invalidate() increments under)
                 gen = cache.invalidations
                 try:
-                    data = self._reconstruct_range(
-                        target_shard, t_off, t_len, fetch
+                    run = self._reconstruct_range(
+                        target_shard, t_off, run_len, fetch
                     )
                 finally:
-                    if registered:  # only the registrant owns the event
-                        with self._decode_inflight_lock:
-                            done = self._decode_inflight.pop(
-                                (target_shard, t_off), None
-                            )
-                        if done is not None:
-                            done.set()  # wake waiters, win or lose
-                if cache.put(target_shard, t_off, data, gen=gen) and (
-                    sess is not None
-                ):
-                    # piggyback: this tile is exactly what the rebuild
-                    # writer needs at this offset — serving traffic
-                    # makes repair forward-progress instead of
-                    # duplicating its reads. Gated on the same gen check
-                    # as the insert; the residual window between put and
-                    # donate is backstopped by the scrub plane's parity
-                    # sweep of the rebuilt shard.
-                    sess.donate(target_shard, t_off, data)
+                    # wake waiters of every owned tile, win or lose
+                    self._release_decode_leases(target_shard, owned)
+                for j, (o_off, _) in enumerate(owned):
+                    chunk = run[j * tile : min((j + 1) * tile, run_len)]
+                    if not chunk:
+                        break
+                    if cache.put(target_shard, o_off, chunk, gen=gen) and (
+                        sess is not None
+                    ):
+                        # piggyback: this tile is exactly what the
+                        # rebuild writer needs at this offset — serving
+                        # traffic makes repair forward-progress instead
+                        # of duplicating its reads. Gated on the same
+                        # gen check as the insert; the residual window
+                        # between put and donate is backstopped by the
+                        # scrub plane's parity sweep of the rebuilt
+                        # shard.
+                        sess.donate(target_shard, o_off, chunk)
+                take = min(end, t_off + run_len) - pos
+                if take <= 0:
+                    raise NotEnoughShards(
+                        f"vid {self.volume_id}: shard {target_shard} "
+                        f"interval [{offset}, {end}) past reconstructed "
+                        f"length"
+                    )
+                out += run[pos - t_off : pos - t_off + take]
+                pos += take
+                continue
             take = min(end, t_off + len(data)) - pos
             if take <= 0:  # cached tail tile shorter than the request
                 raise NotEnoughShards(
@@ -498,6 +556,19 @@ class EcVolume:
             out += data[pos - t_off : pos - t_off + take]
             pos += take
         return bytes(out)
+
+    def _release_decode_leases(
+        self, target_shard: int, owned: list[tuple[int, "threading.Event"]]
+    ) -> None:
+        """Unregister this thread's singleflight leases and wake their
+        waiters (who re-probe the cache and self-serve on a miss)."""
+        if not owned:
+            return
+        with self._decode_inflight_lock:
+            for o_off, _ in owned:
+                self._decode_inflight.pop((target_shard, o_off), None)
+        for _, ev in owned:
+            ev.set()
 
     def donate_cached_tiles(self, sess) -> int:
         """Seed a just-opened rebuild session with every resident tile

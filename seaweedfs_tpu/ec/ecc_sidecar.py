@@ -1,0 +1,159 @@
+"""`.ecc` sidecar: persisted encode-time shard CRCs for cheap scrub.
+
+The fused encode/rebuild pipelines (ec/ec_stream.py + ec/crc_kernel.py)
+already hand back a whole-file CRC-32C per shard for free — the device
+computes them while the tile is VMEM-resident. Until now those CRCs
+were only logged. Persisting them as a per-volume ``{base}.ecc`` JSON
+sidecar turns the scrubber's 14-shard parity re-verify (read all
+shards, recompute 4 GF parity rows per tile, compare) into a plain
+read+CRC pass per shard — no GF math, no cross-shard staging — freeing
+scrub CPU and memory bandwidth for serving.
+
+Crash ordering: the sidecar ATTESTS shard bytes, so it must never
+reach its final name before the bytes it attests are durable (a crash
+could then materialize a sidecar vouching for shards that lost their
+tails — scrub would "verify" garbage against a confident CRC and
+report clean). Emitters call write_sidecar only after the shard files
+are fsynced (the durable=True arm of write_ec_files/rebuild), and the
+sidecar itself goes through util/durable.publish (fsync bytes → rename
+→ fsync dir). analysis/crash.py's `ecc_publish` workload sweeps this
+ordering and proves the unsynced variant is DETECTED.
+
+Staleness: a rebuild rewrites shard files. Rebuilt shards are
+byte-identical to the originals (RS determinism), so existing entries
+stay CORRECT — but the sidecar's mtime now predates the shards', which
+is indistinguishable from "sidecar predates an overwrite that changed
+bytes". The rebuild verbs therefore merge the rebuilt shards' fresh
+CRCs and republish (making the sidecar newest again); any sidecar
+older than a shard it attests, or disagreeing with a shard's on-disk
+size, is reported stale and the scrubber falls back to the full parity
+re-verify LOUDLY (wlog + weed_scrub_ecc_fallback_total) — never a
+silent skip.
+
+``WEED_EC_ECC=0`` disables both emit and verify.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from seaweedfs_tpu.util import durable
+
+ECC_EXT = ".ecc"
+_VERSION = 1
+
+
+def ecc_enabled() -> bool:
+    """`WEED_EC_ECC` env knob: any value but "0" keeps the sidecar
+    emit + scrub verify on."""
+    return os.environ.get("WEED_EC_ECC", "1") != "0"
+
+
+def sidecar_path(base: str) -> str:
+    return base + ECC_EXT
+
+
+def load_sidecar(base: str) -> dict | None:
+    """Parsed sidecar doc, or None when absent/unreadable/garbled (a
+    torn sidecar must degrade to the parity path, not crash a sweep)."""
+    try:
+        with open(sidecar_path(base), "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or not isinstance(doc.get("shards"), dict):
+        return None
+    return doc
+
+
+def write_sidecar(
+    base: str,
+    crcs,
+    *,
+    total_shards: int = 14,
+    durable_publish: bool = True,
+) -> str | None:
+    """Publish ``{base}.ecc`` attesting per-shard whole-file CRC + size.
+
+    `crcs` is either a full [total_shards] list (the generate verbs) or
+    a partial {sid: crc} dict (the rebuild verbs), merged over any
+    existing sidecar. A partial update with no prior sidecar cannot
+    attest the untouched shards and is skipped (returns None) — the
+    scrubber then takes the parity path for this volume until the next
+    full generate.
+
+    PRECONDITION: the shard files' bytes are already durable (the
+    callers' durable=True fsync) — analysis/crash.py's `ecc-publish`
+    workload sweeps this ordering and its planted arm (shard fsyncs
+    skipped) proves violations are DETECTED. durable_publish=False
+    exists ONLY for tests proving a torn under-final-name sidecar
+    degrades to the parity path rather than a false-clean."""
+    if isinstance(crcs, dict):
+        entries = {int(k): int(v) for k, v in crcs.items()}
+        existing = load_sidecar(base)
+        if existing is not None:
+            for k, v in existing["shards"].items():
+                entries.setdefault(int(k), int(v["crc"]))
+        if len(entries) < total_shards:
+            return None
+    else:
+        if len(crcs) != total_shards:
+            raise ValueError(
+                f"expected {total_shards} shard CRCs, got {len(crcs)}"
+            )
+        entries = {sid: int(c) for sid, c in enumerate(crcs)}
+
+    from seaweedfs_tpu.ec import ec_files
+
+    shards = {}
+    for sid in range(total_shards):
+        path = base + ec_files.to_ext(sid)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return None  # shard vanished under us: attest nothing
+        shards[str(sid)] = {"crc": entries[sid] & 0xFFFFFFFF, "size": size}
+
+    dst = sidecar_path(base)
+    tmp = dst + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"version": _VERSION, "shards": shards}, f)
+    if durable_publish:
+        durable.publish(tmp, dst)
+    else:
+        # weedlint: ignore[crash-rename-no-dirsync] — deliberate planted-bug arm (tests + analysis/crash.py ecc-publish): proves a torn/unordered sidecar publish degrades to the parity path
+        os.replace(tmp, dst)
+    return dst
+
+
+def sidecar_status(
+    base: str, shard_paths: dict[int, str], total_shards: int = 14
+) -> tuple[str, dict | None]:
+    """("ok", doc) when the sidecar attests every shard in
+    `shard_paths` and is no older than any of them; ("missing", None) /
+    ("stale", doc-or-None) otherwise. Size disagreement and
+    shard-newer-than-sidecar both count as stale (the attested CRCs may
+    describe bytes that are no longer on disk)."""
+    doc = load_sidecar(base)
+    if doc is None:
+        return "missing", None
+    try:
+        ecc_mtime = os.stat(sidecar_path(base)).st_mtime_ns
+    except OSError:
+        return "missing", None
+    for sid, path in shard_paths.items():
+        ent = doc["shards"].get(str(sid))
+        if ent is None:
+            return "stale", doc
+        try:
+            st = os.stat(path)
+        except OSError:
+            return "stale", doc
+        if st.st_size != ent.get("size"):
+            return "stale", doc
+        if st.st_mtime_ns > ecc_mtime:
+            return "stale", doc
+    if len(doc["shards"]) < total_shards:
+        return "stale", doc
+    return "ok", doc

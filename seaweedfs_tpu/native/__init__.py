@@ -38,10 +38,25 @@ if _lib is not None:
 
     def crc32c(data, crc: int = 0) -> int:
         """Hardware-accelerated CRC-32C (SSE4.2 when the CPU has it).
-        Accepts any bytes-like object, matching the Python fallback."""
-        if not isinstance(data, bytes):
-            data = bytes(data)
-        return _lib.weed_crc32c(crc & 0xFFFFFFFF, data, len(data))
+        Accepts any bytes-like object, matching the Python fallback.
+        Writable buffers (bytearray, memoryview of one) are addressed
+        zero-copy — at native CRC speed a bytes() round-trip of the
+        input is a measurable fraction of the whole call."""
+        if isinstance(data, bytes):
+            return _lib.weed_crc32c(crc & 0xFFFFFFFF, data, len(data))
+        mv = memoryview(data)
+        if not mv.contiguous:
+            b = bytes(mv)
+            return _lib.weed_crc32c(crc & 0xFFFFFFFF, b, len(b))
+        n = mv.nbytes
+        if mv.readonly:
+            b = bytes(mv)
+            return _lib.weed_crc32c(crc & 0xFFFFFFFF, b, n)
+        arr = (ctypes.c_char * n).from_buffer(mv)
+        try:
+            return _lib.weed_crc32c(crc & 0xFFFFFFFF, arr, n)
+        finally:
+            del arr  # release the buffer export before mv goes away
 
 
 # needle record serializer + one-pass POST hot loop: a CPython

@@ -5,7 +5,11 @@
  * same component as a small C library loaded via ctypes.
  *
  * Two paths, chosen once at load time:
- *   - hardware: SSE4.2 crc32 instruction, 8 bytes per step
+ *   - hardware: SSE4.2 crc32 instruction, three independent 1 KiB
+ *     lanes in flight per loop (crc32di has ~3-cycle latency but
+ *     1/cycle throughput, so a single chain runs at a third of the
+ *     machine's rate; lane CRCs recombine through precomputed
+ *     zero-extension tables, the klauspost/crc32 structure)
  *   - portable: slicing-by-8 tables
  * Both compute the standard reflected CRC-32C (poly 0x1EDC6F41).
  */
@@ -18,8 +22,26 @@
 #define HAVE_X86 1
 #endif
 
+#define LANE 1024 /* bytes per lane in the 3-way hardware loop */
+
 static uint32_t table8[8][256];
+/* zero-extension operators: shiftNk(c) = CRC register after appending
+ * N KiB of zero bytes to a stream whose register is c. Extension is
+ * linear over GF(2), so each is four byte-indexed tables — the lane
+ * recombination of the 3-way loop. */
+static uint32_t shift1k[4][256];
+static uint32_t shift2k[4][256];
 static int use_hw = 0;
+
+static uint32_t zext_bytewise(uint32_t c, size_t n) {
+    while (n--) c = table8[0][c & 0xFF] ^ (c >> 8);
+    return c;
+}
+
+static uint32_t shift_apply(const uint32_t t[4][256], uint32_t c) {
+    return t[3][c >> 24] ^ t[2][(c >> 16) & 0xFF] ^
+           t[1][(c >> 8) & 0xFF] ^ t[0][c & 0xFF];
+}
 
 /* constructor: runs once at dlopen, before any caller thread exists —
  * lazy init under ctypes would race (the GIL is released during calls) */
@@ -35,6 +57,14 @@ __attribute__((constructor)) static void init_tables(void) {
         for (int i = 0; i < 256; i++)
             table8[t][i] =
                 table8[0][table8[t - 1][i] & 0xFF] ^ (table8[t - 1][i] >> 8);
+    for (int t = 0; t < 4; t++)
+        for (int i = 0; i < 256; i++)
+            shift1k[t][i] = zext_bytewise((uint32_t)i << (8 * t), LANE);
+    /* by linearity: shift2k = shift1k applied twice (shift1k must be
+     * complete first — shift_apply reads all four of its rows) */
+    for (int t = 0; t < 4; t++)
+        for (int i = 0; i < 256; i++)
+            shift2k[t][i] = shift_apply(shift1k, shift1k[t][i]);
 #ifdef HAVE_X86
     {
         unsigned int eax, ebx, ecx, edx;
@@ -49,6 +79,25 @@ __attribute__((target("sse4.2"))) static uint32_t crc_hw(uint32_t crc,
                                                          const uint8_t *p,
                                                          size_t n) {
     uint64_t c = crc;
+    /* 3 independent crc32di chains hide the instruction's latency;
+     * reg(A||B||D, c) = zext(reg(A,c), 2K) ^ zext(reg(B,0), 1K)
+     *                   ^ reg(D,0) recombines the lanes */
+    while (n >= 3 * LANE) {
+        uint64_t a = c, b = 0, d = 0;
+        for (int i = 0; i < LANE; i += 8) {
+            uint64_t va, vb, vd;
+            __builtin_memcpy(&va, p + i, 8);
+            __builtin_memcpy(&vb, p + LANE + i, 8);
+            __builtin_memcpy(&vd, p + 2 * LANE + i, 8);
+            a = __builtin_ia32_crc32di(a, va);
+            b = __builtin_ia32_crc32di(b, vb);
+            d = __builtin_ia32_crc32di(d, vd);
+        }
+        c = shift_apply(shift2k, (uint32_t)a) ^
+            shift_apply(shift1k, (uint32_t)b) ^ (uint32_t)d;
+        p += 3 * LANE;
+        n -= 3 * LANE;
+    }
     while (n >= 8) {
         uint64_t v;
         __builtin_memcpy(&v, p, 8);

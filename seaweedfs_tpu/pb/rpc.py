@@ -158,6 +158,15 @@ VOLUME_METHODS = {
         v.VolumeEcShardsRebuildResponse,
         UNARY_UNARY,
     ),
+    # batch rebuild rides the BatchGenerate message pair (ids in,
+    # empty response): the method table IS the service definition
+    # here, so a new verb needs no proto regeneration as long as an
+    # existing message shape fits
+    "VolumeEcShardsBatchRebuild": (
+        v.VolumeEcShardsBatchGenerateRequest,
+        v.VolumeEcShardsBatchGenerateResponse,
+        UNARY_UNARY,
+    ),
     "VolumeEcShardsCopy": (v.VolumeEcShardsCopyRequest, v.VolumeEcShardsCopyResponse, UNARY_UNARY),
     "VolumeEcShardsDelete": (
         v.VolumeEcShardsDeleteRequest,

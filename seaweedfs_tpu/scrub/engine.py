@@ -338,6 +338,14 @@ class ScrubEngine:
         )
 
         h = state.get(ev.volume_id, is_ec=True)
+        # `.ecc` fast path: a fresh sidecar turns the 14-shard parity
+        # re-verify into a read+CRC pass (scrub/verify.verify_ecc_stream).
+        # Eligibility is checked every volume visit; missing/stale
+        # sidecars fall through LOUDLY (wlog + fallback counter) — the
+        # parity sweep below still verifies everything.
+        ecc = self._scrub_ec_ecc(ev, state, h)
+        if ecc is not None:
+            return ecc
         found = quarantined = scanned = 0
         if h.cursor == 0:
             h.pass_corruptions = 0
@@ -441,6 +449,102 @@ class ScrubEngine:
                     # live): local quarantine markers are now history,
                     # not current damage — clearing stops the master
                     # re-flagging a repaired volume forever
+                    for sid in list(ev.quarantined):
+                        ev.quarantined.pop(sid, None)
+                        self.store.clear_quarantine(ev.volume_id, sid)
+                state.save()
+                break
+        return found, quarantined, scanned
+
+    # ------------------------------------------------------------------
+    def _scrub_ec_ecc(self, ev, state: ScrubState, h) -> tuple[int, int, int] | None:
+        """The `.ecc` sidecar arm of the EC sweep; None = not eligible
+        (knob off, shards not all local, sidecar missing/stale) — the
+        caller then runs the full parity re-verify.
+
+        Eligibility requires every shard LOCAL: the sidecar lives next
+        to the shards it attests, and a CRC pass over remote shards
+        would just move the same bytes over the network that the parity
+        path moves (each holder scrubs its own copy instead)."""
+        from seaweedfs_tpu.ec import ecc_sidecar
+        from seaweedfs_tpu.stats.metrics import (
+            SCRUB_CORRUPTIONS,
+            SCRUB_ECC_FALLBACK,
+            SCRUB_SCANNED,
+        )
+
+        if not ecc_sidecar.ecc_enabled():
+            return None
+        local = {sid: s.path for sid, s in ev.shards.items()}
+        if len(local) != ev.rs.total_shards:
+            return None  # remote shards: parity path, no fallback noise
+        status, doc = ecc_sidecar.sidecar_status(
+            ev.base_name, local, ev.rs.total_shards
+        )
+        if status != "ok":
+            wlog.warning(
+                "scrub: vid %d .ecc sidecar %s; falling back to full "
+                "parity re-verify",
+                ev.volume_id, status,
+            )
+            SCRUB_ECC_FALLBACK.labels(self.node_label, status).inc()
+            return None
+        found = quarantined = scanned = 0
+        if h.ecc_shard == 0 and h.ecc_offset == 0:
+            h.pass_corruptions = 0
+        while not self._stop.is_set():
+            res = _verify.verify_ecc_stream(
+                local,
+                doc,
+                start_shard=h.ecc_shard,
+                start_offset=h.ecc_offset,
+                run_crc=h.ecc_crc,
+                tile_bytes=self.tile_bytes,
+                limiter=self.limiter,
+                stop=self._stop,
+                max_bytes=SEGMENT_BYTES,
+            )
+            h.ecc_shard = res.shard_idx
+            h.ecc_offset = res.offset
+            h.ecc_crc = res.run_crc
+            h.scanned_bytes += res.bytes_scanned
+            scanned += res.bytes_scanned
+            SCRUB_SCANNED.labels(self.node_label, "ec").inc(res.bytes_scanned)
+            if res.corrupt:
+                found += len(res.bad_shards)
+                h.corruptions_found += len(res.bad_shards)
+                h.pass_corruptions += len(res.bad_shards)
+                h.sweep_corruptions = max(
+                    h.sweep_corruptions, h.pass_corruptions
+                )
+                SCRUB_CORRUPTIONS.labels(self.node_label, "ec").inc(
+                    len(res.bad_shards)
+                )
+                worst = sorted(res.bad_shards)[-1]
+                h.last_error = (
+                    f".ecc mismatch shard {worst}: {res.bad_shards[worst]}"
+                )
+                # the sidecar pins the culprit directly (a CRC names
+                # its shard) — no localization pass needed
+                for sid, why in sorted(res.bad_shards.items()):
+                    if sid in ev.shards:
+                        wlog.warning(
+                            "scrub: quarantining shard %d of vid %d "
+                            "(.ecc: %s)", sid, ev.volume_id, why,
+                        )
+                        if ev.quarantine_shard(sid, f"scrub .ecc: {why}"):
+                            quarantined += 1
+                self.on_event()
+            state.save()
+            if res.aborted:
+                break
+            if res.complete:
+                h.ecc_shard = h.ecc_offset = h.ecc_crc = 0
+                h.sweeps += 1
+                h.last_sweep_unix = time.time()
+                h.sweep_corruptions = h.pass_corruptions
+                if h.sweep_corruptions == 0:
+                    h.last_error = ""
                     for sid in list(ev.quarantined):
                         ev.quarantined.pop(sid, None)
                         self.store.clear_quarantine(ev.volume_id, sid)

@@ -282,6 +282,20 @@ class RepairScheduler:
                 task.in_flight = True
                 launch.append(task)
             self._active += len(launch)
+        # concurrent ec_rebuild tasks ride ONE batched repair: a node
+        # loss surfaces many small EC volumes with identical damage in
+        # the same scan, and the batch verb amortizes one mesh decode
+        # program across them (ec_files.rebuild_ec_files_batch) instead
+        # of paying per-volume dispatch latency N times
+        ec_batch = [t for t in launch if t.kind == "ec_rebuild"]
+        if len(ec_batch) >= 2:
+            launch = [t for t in launch if t.kind != "ec_rebuild"]
+            threading.Thread(
+                target=self._run_ec_batch,
+                args=(ec_batch,),
+                daemon=True,
+                name=f"repair-ec_rebuild-batch-{len(ec_batch)}",
+            ).start()
         for task in launch:
             threading.Thread(
                 target=self._run_task,
@@ -383,6 +397,94 @@ class RepairScheduler:
         wlog.warning(
             "repair: %s vid %d done in %.1fs (time-to-repair %.1fs)",
             task.kind, task.volume_id, took, ttr,
+        )
+
+    # ------------------------------------------------------------------
+    def _run_ec_batch(self, tasks: list["RepairTask"]) -> None:
+        """One batched ec_rebuild repair for N concurrent tasks — the
+        shell's do_ec_rebuild_batch groups same-node local-survivor
+        volumes through the BatchRebuild verb and falls back to the
+        single-volume flow for the rest, so per-task semantics (and
+        the scheduler's backoff on failure) are unchanged; only the
+        dispatch is amortized. Whole-batch failure backs off every
+        task: the next scan retries them (batched again if still
+        concurrent)."""
+        from seaweedfs_tpu.stats.metrics import (
+            REPAIR_FAILED,
+            REPAIR_STARTED,
+            REPAIR_SUCCEEDED,
+            TIME_TO_REPAIR,
+        )
+
+        for task in tasks:
+            REPAIR_STARTED.labels(task.kind).inc()
+        t0 = time.time()
+        try:
+            from seaweedfs_tpu import trace
+            from seaweedfs_tpu.shell.commands import do_ec_rebuild_batch
+            from seaweedfs_tpu.util import deadline as _deadline
+
+            # one whole-batch budget sized like the serial sum: N
+            # volumes under N x the per-repair deadline (the batch is
+            # strictly faster than serial, so this only loosens)
+            with trace.span("repair.ec_rebuild_batch", plane="repair") as sp, \
+                    _deadline.scope(
+                        _deadline.Deadline.after(
+                            self.repair_deadline_s * len(tasks)
+                        )
+                    ):
+                if sp:
+                    sp.annotate("vids", [t.volume_id for t in tasks])
+                do_ec_rebuild_batch(
+                    self._env(),
+                    [t.volume_id for t in tasks],
+                    io.StringIO(),
+                    apply=True,
+                )
+        except Exception as e:  # noqa: BLE001 - becomes backoff state
+            now = time.time()
+            with self._lock:
+                for task in tasks:
+                    REPAIR_FAILED.labels(task.kind).inc()
+                    task.in_flight = False
+                    task.attempts += 1
+                    task.last_error = str(e)[:300]
+                    task.next_try = now + min(
+                        self.backoff_base * (2 ** (task.attempts - 1)),
+                        self.backoff_max,
+                    )
+                    self._active -= 1
+            wlog.warning(
+                "repair: batched ec_rebuild of vids %s failed: %s",
+                [t.volume_id for t in tasks], e,
+            )
+            return
+        took = time.time() - t0
+        now = time.time()
+        with self._lock:
+            for task in tasks:
+                ttr = now - task.first_detected
+                REPAIR_SUCCEEDED.labels(task.kind).inc()
+                TIME_TO_REPAIR.observe(ttr, task.kind)
+                task.in_flight = False
+                task.last_error = ""
+                task.cooling_until = now + self.cooldown
+                task.next_try = task.cooling_until
+                self._active -= 1
+                self.history.append(
+                    {
+                        "Kind": task.kind,
+                        "VolumeId": task.volume_id,
+                        "Detail": task.detail + " (batched)",
+                        "FinishedUnix": now,
+                        "RepairSeconds": round(took, 3),
+                        "TimeToRepairSeconds": round(ttr, 3),
+                        "Attempts": task.attempts + 1,
+                    }
+                )
+        wlog.warning(
+            "repair: batched ec_rebuild of %d volume(s) %s done in %.1fs",
+            len(tasks), [t.volume_id for t in tasks], took,
         )
 
     # ------------------------------------------------------------------

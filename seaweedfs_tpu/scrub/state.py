@@ -45,6 +45,15 @@ class VolumeScrubHealth:
     pass_corruptions: int = 0
     sweeps: int = 0
     last_error: str = ""
+    # .ecc sidecar sweep cursor triple (scrub/verify.verify_ecc_stream):
+    # shard being read, byte offset within it, and the RUNNING CRC-32C
+    # at that offset — persisting the running CRC lets a restart resume
+    # mid-shard instead of reverifying from byte 0. Independent of
+    # `cursor` (the parity-path offset): a volume can flip between the
+    # two paths mid-life when its sidecar appears/goes stale.
+    ecc_shard: int = 0
+    ecc_offset: int = 0
+    ecc_crc: int = 0
 
     def to_dict(self) -> dict:
         return asdict(self)

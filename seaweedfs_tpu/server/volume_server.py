@@ -870,6 +870,7 @@ class VolumeServer:
                 req.volume_id,
                 ",".join(f"{c:08x}" for c in crcs),
             )
+            self._publish_ecc(base, crcs)
         ec_files.write_sorted_file_from_idx(base, durable=True)
         return pb.VolumeEcShardsGenerateResponse()
 
@@ -896,12 +897,15 @@ class VolumeServer:
             ec_files.write_ec_files_batch(
                 bases, durable=True, stats=st, want_crcs=True
             )
-            for vid, crcs in zip(req.volume_ids, st.get("shard_crcs") or []):
+            for vid, base, crcs in zip(
+                req.volume_ids, bases, st.get("shard_crcs") or []
+            ):
                 wlog.info(
                     "ec.batch_generate vid=%s shard_crc32c=%s",
                     vid,
                     ",".join(f"{c:08x}" for c in crcs),
                 )
+                self._publish_ecc(base, crcs)
             for base in bases:
                 ec_files.write_sorted_file_from_idx(base, durable=True)
         return pb.VolumeEcShardsBatchGenerateResponse()
@@ -933,7 +937,7 @@ class VolumeServer:
                 base, rs=self._new_rs(), durable=True, stats=st,
                 want_crcs=True,
             )
-            self._log_rebuild_crcs(req.volume_id, st)
+            self._log_rebuild_crcs(req.volume_id, base, st)
             return pb.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
         # with a master, always learn which "missing" shards are in
         # fact mounted elsewhere: they serve as remote survivors and
@@ -950,7 +954,7 @@ class VolumeServer:
                     base, rs=self._new_rs(), durable=True, stats=st,
                     want_crcs=True,
                 )
-                self._log_rebuild_crcs(req.volume_id, st)
+                self._log_rebuild_crcs(req.volume_id, base, st)
             else:
                 from seaweedfs_tpu.ec import ec_stream, repair_session
 
@@ -985,7 +989,7 @@ class VolumeServer:
                         stats=st,
                         want_crcs=True,
                     )
-                    self._log_rebuild_crcs(req.volume_id, st)
+                    self._log_rebuild_crcs(req.volume_id, base, st)
                 except ValueError as e:
                     context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
                 finally:
@@ -994,12 +998,119 @@ class VolumeServer:
             close_readers()
         return pb.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
 
+    def VolumeEcShardsBatchRebuild(self, req, context):
+        """Rebuild N volumes' missing shards, batched: volumes whose
+        survivors are ALL local and whose missing shards are missing
+        cluster-wide ride one sharded mesh decode program per tile
+        round (ec_files.rebuild_ec_files_batch, grouped there by
+        damage signature) — the RepairScheduler's answer to a node
+        loss surfacing many small volumes with identical damage at
+        once. Volumes that DON'T fit that shape (a "missing" shard is
+        mounted elsewhere — regenerating it here would double-mount —
+        or survivors must be rack-gathered) fall through to the
+        single-volume rebuild path per volume, so the verb is safe to
+        aim at any mix. Reuses the BatchGenerate message pair: ids in,
+        empty response (rebuilt ids are logged; callers recompute
+        presence, as ec.rebuild already does)."""
+        with trace.span(
+            "volume.ec_rebuild_batch",
+            header=trace.header_from_grpc_context(context),
+            node=f"{self.host}:{self.port}",
+        ) as sp:
+            if sp:
+                sp.annotate("vids", list(req.volume_ids))
+            batch: list[tuple[int, str]] = []
+            for vid in req.volume_ids:
+                ev = self.store.find_ec_volume(vid)
+                base = (
+                    ev.base_name
+                    if ev is not None
+                    else self._base_name("", vid)
+                )
+                present, missing = ec_files.shard_presence(base)
+                if not missing:
+                    continue
+                remote = self._cluster_present_shards(vid)
+                if (
+                    sum(present) >= ec_files.DATA_SHARDS
+                    and not (set(missing) & remote)
+                ):
+                    batch.append((vid, base))
+                else:
+                    self._ec_shards_rebuild(
+                        pb.VolumeEcShardsRebuildRequest(volume_id=vid),
+                        context,
+                    )
+            if batch:
+                st: dict = {}
+                try:
+                    ec_files.rebuild_ec_files_batch(
+                        [base for _, base in batch],
+                        durable=True,
+                        stats=st,
+                        want_crcs=True,
+                    )
+                except ValueError as e:
+                    context.abort(
+                        grpc.StatusCode.FAILED_PRECONDITION, str(e)
+                    )
+                for (vid, base), crcs in zip(
+                    batch, st.get("shard_crcs") or []
+                ):
+                    self._log_rebuild_crcs(vid, base, {"shard_crcs": crcs})
+        return pb.VolumeEcShardsBatchGenerateResponse()
+
+    def _cluster_present_shards(self, vid: int) -> set[int]:
+        """Shard ids of `vid` mounted on OTHER nodes per the master —
+        shards the batch-rebuild arm must not regenerate locally (the
+        single verb's _remote_rebuild_readers exclusion, presence-only).
+        Empty on no master / lookup failure — then every locally
+        missing shard is a target, exactly what the single verb does on
+        the same no-master / failed-lookup arms."""
+        if not self.master:
+            return set()
+        try:
+            with rpc.dial(self._master_grpc()) as ch:
+                resp = rpc.master_stub(ch).LookupEcVolume(
+                    master_pb2.LookupEcVolumeRequest(volume_id=vid),
+                    timeout=5,
+                )
+        except grpc.RpcError:
+            return set()
+        me = self._self_urls()
+        return {
+            e.shard_id
+            for e in resp.shard_id_locations
+            if any(l.url not in me for l in e.locations)
+        }
+
     @staticmethod
-    def _log_rebuild_crcs(vid: int, st: dict) -> None:
+    def _publish_ecc(base: str, crcs) -> None:
+        """Publish/refresh the `.ecc` scrub sidecar (ec/ecc_sidecar.py)
+        from encode/rebuild-pass CRCs. Callers reach here only on the
+        durable=True arms, so the shard bytes the sidecar attests are
+        already fsynced — the ordering the weedcrash ecc_publish
+        workload enforces. Best-effort: a sidecar we fail to write
+        just means the scrubber takes the (loud) parity path."""
+        from seaweedfs_tpu.ec import ecc_sidecar
+
+        if not ecc_sidecar.ecc_enabled():
+            return
+        try:
+            ecc_sidecar.write_sidecar(
+                base, crcs, total_shards=ec_files.TOTAL_SHARDS
+            )
+        except OSError as e:
+            wlog.warning("ec: .ecc sidecar publish failed for %s: %r", base, e)
+
+    def _log_rebuild_crcs(self, vid: int, base: str, st: dict) -> None:
         """Operator breadcrumb: encode-pass CRC-32C of every rebuilt
         shard file (fused out of the codec pass — see the generate
         verb), keyed so a later scrub mismatch can be triaged against
-        what the rebuild actually produced."""
+        what the rebuild actually produced. Also merges the fresh CRCs
+        into the volume's `.ecc` sidecar: rebuilt shards are
+        byte-identical to the originals, so the merge re-attests them
+        and un-stales the sidecar's mtime in one publish."""
         crcs = st.get("shard_crcs")
         if crcs:
             wlog.info(
@@ -1007,6 +1118,7 @@ class VolumeServer:
                 vid,
                 ",".join(f"{i}:{c:08x}" for i, c in sorted(crcs.items())),
             )
+            self._publish_ecc(base, dict(crcs))
 
     def _remote_rebuild_readers(self, vid: int, skip: set[int]):
         """(readers, closer): shard id → fetch(offset, size) callables

@@ -739,6 +739,121 @@ def do_ec_rebuild(env: CommandEnv, vid: int, out, apply: bool = True) -> list[in
     return rebuilt
 
 
+def do_ec_rebuild_batch(
+    env: CommandEnv, vids: list[int], out, apply: bool = True
+) -> dict[int, list[int]]:
+    """Rebuild missing shards for many volumes, batching volumes that
+    can rebuild from purely local survivors on the same node through
+    ONE VolumeEcShardsBatchRebuild verb (the mesh-batched decode —
+    the RepairScheduler's node-loss fan-in). Volumes that need a
+    rack-gather, have no >=10-local-shard holder, or whose batch verb
+    fails take the single-volume do_ec_rebuild path, so the result is
+    never worse than calling it in a loop. Returns {vid: rebuilt ids}."""
+    import grpc as _grpc
+
+    nodes = ec_common.collect_ec_nodes(env)
+    results: dict[int, list[int]] = {}
+    by_server: dict[str, list[tuple[int, str, list[int]]]] = {}
+    leftovers: list[int] = []
+    for vid in sorted({int(v) for v in vids}):
+        missing = find_missing_shards(nodes, vid)
+        if not missing:
+            results[vid] = []
+            continue
+        if not apply:
+            results[vid] = missing
+            continue
+        # the batch arm needs one node already holding >= 10 shards of
+        # the volume (all survivors local, no seed copy)
+        cands = [
+            n
+            for n in nodes
+            if vid in n.ec_shards
+            and len(n.local_shard_ids(vid)) >= ec_common.DATA_SHARDS
+        ]
+        if not cands:
+            leftovers.append(vid)
+            continue
+        rebuilder = max(cands, key=lambda n: n.free_ec_slot)
+        collection = rebuilder.ec_shards[vid][0]
+        by_server.setdefault(rebuilder.url, []).append(
+            (vid, collection, missing)
+        )
+    if not apply:
+        return results
+
+    for url, entries in sorted(by_server.items()):
+        if len(entries) < 2:
+            # nothing to amortize: the single-volume verb's remote-
+            # survivor handling and fallbacks are strictly richer
+            leftovers.extend(vid for vid, _, _ in entries)
+            continue
+        server_vids = [vid for vid, _, _ in entries]
+        try:
+            with env.volume_channel(url) as ch:
+                rpc.volume_stub(ch).VolumeEcShardsBatchRebuild(
+                    volume_pb2.VolumeEcShardsBatchGenerateRequest(
+                        volume_ids=server_vids
+                    ),
+                    timeout=600,
+                )
+        except _grpc.RpcError as e:
+            print(
+                f"batch rebuild of volumes {server_vids} on {url} "
+                f"failed ({e.code()}); falling back per volume",
+                file=out,
+            )
+            leftovers.extend(server_vids)
+            continue
+        print(
+            f"batch-rebuilt ec shards for volumes {server_vids} on "
+            f"{url} (one mesh program per damage signature)",
+            file=out,
+        )
+        for vid, collection, missing in entries:
+            with env.volume_channel(url) as ch:
+                rpc.volume_stub(ch).VolumeEcShardsMount(
+                    volume_pb2.VolumeEcShardsMountRequest(
+                        volume_id=vid,
+                        collection=collection,
+                        shard_ids=missing,
+                    )
+                )
+            results[vid] = missing
+    for vid in leftovers:
+        results[vid] = do_ec_rebuild(env, vid, out, apply)
+    return results
+
+
+@register
+class EcRebuildBatch(Command):
+    name = "ec.rebuild.batch"
+    help = (
+        "ec.rebuild.batch [-volumeIds 1,2,3] [-force] — rebuild many "
+        "EC volumes, batching same-node local-survivor rebuilds "
+        "through one mesh decode program per damage signature"
+    )
+
+    def run(self, env, args, out):
+        vid_flag = _flag(args, "volumeIds")
+        apply = _has_flag(args, "force")
+        nodes = ec_common.collect_ec_nodes(env)
+        vids = (
+            [int(x) for x in vid_flag.split(",") if x]
+            if vid_flag
+            else sorted({vid for n in nodes for vid in n.ec_shards})
+        )
+        results = do_ec_rebuild_batch(env, vids, out, apply)
+        if not apply:
+            for vid, missing in sorted(results.items()):
+                if missing:
+                    print(
+                        f"volume {vid}: missing shards {missing} "
+                        f"(dry run; -force to rebuild)",
+                        file=out,
+                    )
+
+
 @register
 class EcRebuild(Command):
     name = "ec.rebuild"

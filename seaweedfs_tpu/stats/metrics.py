@@ -306,6 +306,12 @@ SCRUB_CORRUPTIONS = DEFAULT_REGISTRY.counter(
     "corruption events found by the scrubber",
     ("server", "kind"),
 )
+SCRUB_ECC_FALLBACK = DEFAULT_REGISTRY.counter(
+    "weed_scrub_ecc_fallback_total",
+    "scrub sweeps that expected a .ecc sidecar but fell back to the "
+    "full parity re-verify (sidecar missing or stale)",
+    ("server", "reason"),  # reason: missing | stale
+)
 SCRUB_QUARANTINED = DEFAULT_REGISTRY.gauge(
     "scrub_quarantined_shards",
     "EC shards currently quarantined on this server",

@@ -509,6 +509,29 @@ class TestCrashMatrix:
             "stopped being recorded"
         )
 
+    def test_ecc_publish_durable_ordering_clean(self):
+        """The `.ecc` sidecar attests shard bytes, so it must never
+        reach its final name before those bytes are durable: with the
+        durable ordering (shard fsyncs, then durable.publish for the
+        sidecar) no crash state shows a complete sidecar vouching for
+        missing/torn shard tails."""
+        rep = crash.run_ecc_publish(budget=1200)
+        assert rep.states_tested >= 256
+        assert rep.violations == []
+
+    def test_ecc_publish_unsynced_ordering_detected(self):
+        """Regression proof the ordering is load-bearing: skipping the
+        shard fsyncs and publishing the sidecar with a bare rename must
+        yield confident-sidecar-over-page-cache-only-shards states.
+        budget=1200: the planted states live deep in the enumeration
+        (durable-data frontier + all-namespace syncs)."""
+        rep = crash.run_ecc_publish(budget=1200, durable=False)
+        assert rep.violations, (
+            "the unsynced sidecar publish should be catchable — either "
+            "the enumerator went blind or the sidecar rename/fsync "
+            "stream stopped being recorded"
+        )
+
     def test_shard_handback_acked_writes_survive(self):
         """-shardWrites ownership handback: worker-owned appends,
         release, lead catch-up appends, commit — every needle acked at

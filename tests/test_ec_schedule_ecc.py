@@ -1,0 +1,430 @@
+"""ISSUE 16 surfaces: compiled GF schedules (ec/schedule.py), the
+`.ecc` scrub sidecar (ec/ecc_sidecar.py + scrub/verify.verify_ecc_stream
++ the ScrubEngine fast pass), the batched rebuild arms
+(ec_stream.stream_rebuild_ec_files_batch: host pipeline + zero-thread
+inline), and the 3-way host CRC-32C kernel (native/crc32c.c).
+"""
+
+import os
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ec import ec_files, ec_stream, ecc_sidecar
+from seaweedfs_tpu.ec import schedule as sched
+from seaweedfs_tpu.ec.codec import cpu_apply_matrix, new_encoder
+from seaweedfs_tpu.scrub.verify import verify_ecc_stream
+from seaweedfs_tpu.util.crc import _crc32c_py, crc32c, crc32c_combine
+
+
+def _rs():
+    try:
+        return new_encoder(backend="native")
+    except (ImportError, ValueError):
+        return new_encoder(backend="cpu")
+
+
+def _make_volume(d, name, size, rs, seed=5):
+    base = os.path.join(str(d), name)
+    rng = np.random.default_rng(seed)
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+    ec_files.write_ec_files(base, rs=rs)
+    return base
+
+
+def _shard_bytes(base):
+    out = {}
+    for sid in range(14):
+        p = base + ec_files.to_ext(sid)
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                out[sid] = f.read()
+    return out
+
+
+def _publish_sidecar(base, total=14):
+    crcs = []
+    for sid in range(total):
+        with open(base + ec_files.to_ext(sid), "rb") as f:
+            crcs.append(crc32c(f.read()))
+    assert ecc_sidecar.write_sidecar(base, crcs, total_shards=total)
+    return crcs
+
+
+# ---------------------------------------------------------------------------
+class TestSchedule:
+    def test_matches_naive_apply(self):
+        rs = _rs()
+        rng = np.random.default_rng(3)
+        inp = rng.integers(0, 256, (10, 8192), dtype=np.uint8)
+        parity = np.asarray(rs.parity_rows, dtype=np.uint8)
+        assert np.array_equal(
+            sched.scheduled_apply_matrix(parity, inp),
+            cpu_apply_matrix(parity, inp),
+        )
+        # an arbitrary (non-parity) matrix goes through the same CSE
+        mat = rng.integers(0, 256, (4, 10), dtype=np.uint8)
+        assert np.array_equal(
+            sched.scheduled_apply_matrix(mat, inp),
+            cpu_apply_matrix(mat, inp),
+        )
+
+    def test_parity_term_reduction(self):
+        rs = _rs()
+        cs = sched.compile_schedule(
+            np.asarray(rs.parity_rows, dtype=np.uint8)
+        )
+        # the RS(10,4) parity matrix: 46 scheduled terms vs 156 naive
+        assert cs.n_terms < cs.n_terms_naive
+        assert cs.n_terms_naive == 156
+        assert cs.n_terms <= 60
+
+    def test_schedule_cache(self):
+        rs = _rs()
+        mat = np.asarray(rs.parity_rows, dtype=np.uint8)
+        assert sched.compile_schedule(mat) is sched.compile_schedule(
+            np.array(mat)  # equal bytes, different object
+        )
+
+    def test_kill_switch_byte_identical(self, tmp_path, monkeypatch):
+        size = 64 * 1024 + 17
+        rs_on = new_encoder(backend="cpu")
+        base_on = _make_volume(tmp_path, "on", size, rs_on)
+        monkeypatch.setenv("WEED_EC_SCHEDULE", "0")
+        assert not sched.schedule_enabled()
+        rs_off = new_encoder(backend="cpu")  # env read at construction
+        base_off = _make_volume(tmp_path, "off", size, rs_off)
+        on, off = _shard_bytes(base_on), _shard_bytes(base_off)
+        assert set(on) == set(range(14))
+        for sid in range(14):
+            assert on[sid] == off[sid], f"shard {sid} diverged"
+
+
+# ---------------------------------------------------------------------------
+class TestCrc32c:
+    def test_three_way_lane_boundaries(self):
+        # the hw kernel switches to 3x1 KiB lanes at n >= 3072: cover
+        # both sides of the boundary and multi-block + tail shapes
+        rnd = random.Random(7)
+        for sz in (0, 1, 8, 1023, 1024, 3071, 3072, 3073, 6144, 6145,
+                   10000, 65537):
+            data = rnd.randbytes(sz)
+            assert crc32c(data) == _crc32c_py(data), sz
+
+    def test_continuation_across_any_split(self):
+        rnd = random.Random(11)
+        data = rnd.randbytes(20000)
+        want = _crc32c_py(data)
+        for k in (0, 1, 3072, 9999, 20000):
+            assert crc32c(data[k:], crc32c(data[:k])) == want, k
+
+    def test_buffer_protocol_inputs(self):
+        rnd = random.Random(13)
+        data = rnd.randbytes(8192)
+        want = crc32c(data)
+        assert crc32c(bytearray(data)) == want
+        assert crc32c(memoryview(bytearray(data))) == want
+        assert crc32c(np.frombuffer(data, dtype=np.uint8)) == want
+        # non-contiguous views still hash their logical bytes
+        mv = memoryview(bytearray(data))[::2]
+        assert crc32c(mv) == crc32c(data[::2])
+
+    def test_combine_edges(self):
+        a, b = b"hello ", b"world"
+        ca, cb = crc32c(a), crc32c(b)
+        assert crc32c_combine(ca, cb, len(b)) == crc32c(a + b)
+        # zero-length second segment is the identity
+        assert crc32c_combine(ca, crc32c(b""), 0) == ca & 0xFFFFFFFF
+        # chained tile folds == one-shot
+        data = random.Random(17).randbytes(30000)
+        acc, off = 0, 0
+        for step in (7, 4096, 10000, 15897):
+            chunk = data[off:off + step]
+            acc = crc32c_combine(acc, crc32c(chunk), len(chunk))
+            off += step
+        assert off == len(data) and acc == crc32c(data)
+
+    def test_combine_zpow_thread_race(self):
+        # the zero-byte transit operator memoizes powers per length:
+        # racing first-use of a fresh length must not corrupt results
+        data = random.Random(19).randbytes(2 * 77777)
+        a, b = data[:77777], data[77777:]
+        want = crc32c(data)
+        ca, cb = crc32c(a), crc32c(b)
+        results, errs = [], []
+
+        def worker():
+            try:
+                results.append(crc32c_combine(ca, cb, len(b)))
+            except Exception as e:  # pragma: no cover - diagnostic
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs and results == [want] * 8
+
+
+# ---------------------------------------------------------------------------
+class TestEccSidecar:
+    def test_write_load_status_ok(self, tmp_path):
+        rs = _rs()
+        base = _make_volume(tmp_path, "v", 32 * 1024 + 3, rs)
+        crcs = _publish_sidecar(base)
+        doc = ecc_sidecar.load_sidecar(base)
+        assert doc is not None and len(doc["shards"]) == 14
+        for sid in range(14):
+            ent = doc["shards"][str(sid)]
+            assert ent["crc"] == crcs[sid]
+            assert ent["size"] == os.path.getsize(
+                base + ec_files.to_ext(sid)
+            )
+        paths = {s: base + ec_files.to_ext(s) for s in range(14)}
+        status, _ = ecc_sidecar.sidecar_status(base, paths)
+        assert status == "ok"
+
+    def test_full_list_length_enforced(self, tmp_path):
+        base = _make_volume(tmp_path, "v", 8 * 1024, _rs())
+        with pytest.raises(ValueError):
+            ecc_sidecar.write_sidecar(base, [1, 2, 3])
+
+    def test_partial_merge_and_no_prior(self, tmp_path):
+        base = _make_volume(tmp_path, "v", 16 * 1024 + 1, _rs())
+        # partial update with no prior sidecar attests nothing
+        assert ecc_sidecar.write_sidecar(base, {0: 123}) is None
+        assert ecc_sidecar.load_sidecar(base) is None
+        crcs = _publish_sidecar(base)
+        # rebuild-verb shape: merge fresh CRCs for two shards over the
+        # existing doc (byte-identical rebuild -> same values)
+        assert ecc_sidecar.write_sidecar(
+            base, {0: crcs[0], 13: crcs[13]}
+        )
+        doc = ecc_sidecar.load_sidecar(base)
+        assert [doc["shards"][str(s)]["crc"] for s in range(14)] == crcs
+
+    def test_status_stale_and_missing(self, tmp_path):
+        base = _make_volume(tmp_path, "v", 16 * 1024, _rs())
+        paths = {s: base + ec_files.to_ext(s) for s in range(14)}
+        assert ecc_sidecar.sidecar_status(base, paths)[0] == "missing"
+        _publish_sidecar(base)
+        # a shard newer than the sidecar is indistinguishable from an
+        # overwrite -> stale
+        ecc_mtime = os.stat(ecc_sidecar.sidecar_path(base)).st_mtime_ns
+        os.utime(paths[4], ns=(ecc_mtime + 10_000_000,) * 2)
+        assert ecc_sidecar.sidecar_status(base, paths)[0] == "stale"
+        os.utime(paths[4], ns=(ecc_mtime - 10_000_000,) * 2)
+        assert ecc_sidecar.sidecar_status(base, paths)[0] == "ok"
+        # size disagreement -> stale (attested bytes are gone)
+        with open(paths[4], "ab") as f:
+            f.write(b"x")
+        os.utime(paths[4], ns=(ecc_mtime - 10_000_000,) * 2)
+        assert ecc_sidecar.sidecar_status(base, paths)[0] == "stale"
+
+    def test_torn_sidecar_degrades_not_crashes(self, tmp_path):
+        base = _make_volume(tmp_path, "v", 16 * 1024, _rs())
+        crcs = []
+        for sid in range(14):
+            with open(base + ec_files.to_ext(sid), "rb") as f:
+                crcs.append(crc32c(f.read()))
+        ecc_sidecar.write_sidecar(base, crcs, durable_publish=False)
+        p = ecc_sidecar.sidecar_path(base)
+        # tear the file mid-json (the crash shape durable_publish=False
+        # exists to model): load must return None, never raise
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+        assert ecc_sidecar.load_sidecar(base) is None
+        paths = {s: base + ec_files.to_ext(s) for s in range(14)}
+        assert ecc_sidecar.sidecar_status(base, paths)[0] == "missing"
+
+    def test_knob(self, monkeypatch):
+        assert ecc_sidecar.ecc_enabled()
+        monkeypatch.setenv("WEED_EC_ECC", "0")
+        assert not ecc_sidecar.ecc_enabled()
+
+
+# ---------------------------------------------------------------------------
+class TestVerifyEccStream:
+    def _setup(self, tmp_path, size=96 * 1024 + 11):
+        base = _make_volume(tmp_path, "v", size, _rs())
+        _publish_sidecar(base)
+        doc = ecc_sidecar.load_sidecar(base)
+        paths = {s: base + ec_files.to_ext(s) for s in range(14)}
+        return base, doc, paths
+
+    def test_clean_complete(self, tmp_path):
+        _, doc, paths = self._setup(tmp_path)
+        res = verify_ecc_stream(paths, doc, tile_bytes=4096)
+        assert res.complete and not res.corrupt
+        assert res.bytes_scanned == sum(
+            os.path.getsize(p) for p in paths.values()
+        )
+
+    def test_corruption_names_its_shard(self, tmp_path):
+        _, doc, paths = self._setup(tmp_path)
+        with open(paths[7], "r+b") as f:
+            f.seek(100)
+            b = f.read(1)
+            f.seek(100)
+            f.write(bytes([b[0] ^ 0x40]))
+        res = verify_ecc_stream(paths, doc, tile_bytes=4096)
+        assert res.complete and res.corrupt
+        assert list(res.bad_shards) == [7]
+        assert "crc" in res.bad_shards[7]
+
+    def test_size_mismatch_detected(self, tmp_path):
+        _, doc, paths = self._setup(tmp_path)
+        with open(paths[2], "ab") as f:
+            f.write(b"\x00")
+        res = verify_ecc_stream(paths, doc, tile_bytes=4096)
+        assert res.complete and list(res.bad_shards) == [2]
+        assert "size" in res.bad_shards[2]
+
+    def test_resume_cursor_triple(self, tmp_path):
+        """A budgeted sweep resumes mid-shard from (shard, offset,
+        run_crc) without re-reading the prefix and still converges to
+        the same clean verdict."""
+        _, doc, paths = self._setup(tmp_path)
+        total = sum(os.path.getsize(p) for p in paths.values())
+        shard, offset, run = 0, 0, 0
+        scanned = segments = 0
+        while True:
+            res = verify_ecc_stream(
+                paths, doc, start_shard=shard, start_offset=offset,
+                run_crc=run, tile_bytes=4096, max_bytes=10_000,
+            )
+            scanned += res.bytes_scanned
+            segments += 1
+            if res.complete:
+                assert not res.corrupt
+                break
+            shard, offset, run = res.shard_idx, res.offset, res.run_crc
+            assert segments < 10_000
+        assert scanned == total and segments > 3
+
+
+# ---------------------------------------------------------------------------
+class TestBatchRebuild:
+    def _volumes(self, tmp_path, n, size, missing):
+        rs = _rs()
+        bases, golden = [], {}
+        for i in range(n):
+            base = _make_volume(
+                tmp_path, f"v{i}", size + i * 7, rs, seed=i
+            )
+            bases.append(base)
+            golden[base] = _shard_bytes(base)
+            for sid in missing[i] if isinstance(missing, list) else missing:
+                os.remove(base + ec_files.to_ext(sid))
+        return bases, golden
+
+    def test_inline_identity_and_crcs(self, tmp_path):
+        bases, golden = self._volumes(tmp_path, 3, 40 * 1024 + 3, (0, 13))
+        stats = {}
+        rebuilt = ec_stream.stream_rebuild_ec_files_batch(
+            bases, stats=stats, want_crcs=True
+        )
+        assert rebuilt == [[0, 13]] * 3
+        assert stats.get("codec_arm") in ("host", None)
+        if stats.get("codec_arm") == "host":
+            assert stats.get("host_inline") is True
+        for vi, base in enumerate(bases):
+            for sid in (0, 13):
+                with open(base + ec_files.to_ext(sid), "rb") as f:
+                    got = f.read()
+                assert got == golden[base][sid], (base, sid)
+                if "shard_crcs" in stats:
+                    assert stats["shard_crcs"][vi][sid] == crc32c(got)
+
+    def test_threaded_host_arm_identity(self, tmp_path):
+        # tiny tile -> >16 work items -> the shared-pipeline host arm
+        bases, golden = self._volumes(tmp_path, 2, 100 * 1024 + 9, (1,))
+        stats = {}
+        ec_stream.stream_rebuild_ec_files_batch(
+            bases, tile_bytes=1024, stats=stats, want_crcs=True
+        )
+        if stats.get("codec_arm") == "host":
+            assert not stats.get("host_inline")
+        for vi, base in enumerate(bases):
+            with open(base + ec_files.to_ext(1), "rb") as f:
+                got = f.read()
+            assert got == golden[base][1]
+            if "shard_crcs" in stats:
+                assert stats["shard_crcs"][vi][1] == crc32c(got)
+
+    def test_mixed_missing_sets_grouped(self, tmp_path):
+        bases, golden = self._volumes(
+            tmp_path, 2, 24 * 1024 + 1, [[0, 13], [3]]
+        )
+        stats = {}
+        rebuilt = ec_stream.stream_rebuild_ec_files_batch(
+            bases, stats=stats
+        )
+        assert rebuilt == [[0, 13], [3]]
+        assert stats.get("batch_groups", 2) == 2
+        for base, missing in zip(bases, [[0, 13], [3]]):
+            for sid in missing:
+                with open(base + ec_files.to_ext(sid), "rb") as f:
+                    assert f.read() == golden[base][sid]
+
+    def test_nothing_missing_is_a_noop(self, tmp_path):
+        bases, _ = self._volumes(tmp_path, 2, 8 * 1024, ())
+        assert ec_stream.stream_rebuild_ec_files_batch(bases) == [[], []]
+
+
+# ---------------------------------------------------------------------------
+class TestEngineEccFastPass:
+    def _store(self, tmp_path):
+        from tests.test_scrub import _local_ec_store
+
+        return _local_ec_store(tmp_path)
+
+    def test_fast_pass_clean_and_quarantines_by_crc(self, tmp_path):
+        from seaweedfs_tpu.scrub.engine import ScrubEngine
+
+        store, _ = self._store(tmp_path)
+        base = os.path.join(str(tmp_path), "9")
+        _publish_sidecar(base)
+        eng = ScrubEngine(store, interval=3600, rate_mb_s=0)
+        summary = eng.sweep_once()
+        assert summary["corruptions"] == 0
+        # rot a byte WITHOUT touching mtime (bit-rot doesn't utime) so
+        # the sidecar stays fresh and the .ecc arm makes the call
+        p = os.path.join(str(tmp_path), "9.ec06")
+        st = os.stat(p)
+        with open(p, "r+b") as f:
+            f.seek(42)
+            b = f.read(1)
+            f.seek(42)
+            f.write(bytes([b[0] ^ 0x01]))
+        os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns))
+        summary = eng.sweep_once()
+        assert summary["corruptions"] >= 1
+        assert store.quarantined[9][6].startswith("scrub .ecc:")
+        store.close()
+
+    def test_stale_sidecar_falls_back_loudly(self, tmp_path):
+        from seaweedfs_tpu.scrub.engine import ScrubEngine
+        from seaweedfs_tpu.stats.metrics import SCRUB_ECC_FALLBACK
+
+        store, _ = self._store(tmp_path)
+        base = os.path.join(str(tmp_path), "9")
+        _publish_sidecar(base)
+        # a shard mtime past the sidecar's (an overwrite) is stale, and
+        # the sweep must take the parity path (which still verifies).
+        # Explicit ns: a plain os.utime(p) can land in the SAME coarse
+        # filesystem clock tick as the publish just above.
+        p = os.path.join(str(tmp_path), "9.ec06")
+        ecc_mtime = os.stat(ecc_sidecar.sidecar_path(base)).st_mtime_ns
+        os.utime(p, ns=(ecc_mtime + 1_000_000, ecc_mtime + 1_000_000))
+        eng = ScrubEngine(store, interval=3600, rate_mb_s=0)
+        before = SCRUB_ECC_FALLBACK.value(eng.node_label, "stale")
+        summary = eng.sweep_once()
+        after = SCRUB_ECC_FALLBACK.value(eng.node_label, "stale")
+        assert after == before + 1
+        assert summary["corruptions"] == 0  # bytes are still fine
+        store.close()
