@@ -2910,6 +2910,180 @@ def bench_chaos() -> None:
         json.dump({"chaos": results}, f, indent=2)
 
 
+def bench_tier() -> None:
+    """Lifecycle-tiering round (docs/TIERING.md, BENCH_r14), three legs:
+
+    - tier_out_e2e / tier_in_e2e: GB/s moving a sealed EC volume's 14
+      shard files to/from the local-dir backend, judged against the
+      measured disk ceiling (both directions are one full sequential
+      copy; the recall also pays the .ecc CRC verify).
+    - replication_lag: per-event latency through the partitioned
+      logqueue + the runner's poll/commit loop, producer and consumer
+      concurrent; p99 is the SLO number RULE_REPL_LAG guards.
+    - arbiter_ab: rebuild time-to-repair alone vs sharing the
+      bandwidth arbiter with a flat-out handoff replay. The weighted
+      shares (rebuild .45 / handoff .20) bound the contended TTR at
+      <= 1.5x uncontended — the acceptance ratio.
+
+    Writes BENCH_r14.json.
+    """
+    import random
+    import tempfile
+    import threading
+
+    from seaweedfs_tpu.ec import ec_files
+    from seaweedfs_tpu.ec.codec import new_encoder
+    from seaweedfs_tpu.ec.ecc_sidecar import write_sidecar
+    from seaweedfs_tpu.notification.logqueue import PartitionedLogQueue
+    from seaweedfs_tpu.pb import filer_pb2 as fpb
+    from seaweedfs_tpu.replication.replicate_runner import _consume_logqueue
+    from seaweedfs_tpu.scrub.arbiter import BandwidthArbiter
+    from seaweedfs_tpu.storage import backend as bk
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+    from seaweedfs_tpu.storage.volume import Volume
+    from seaweedfs_tpu.tier.ec_tier import tier_in_ec, tier_out_ec
+    from seaweedfs_tpu.util.crc import crc32c
+
+    rows = []
+
+    # -- leg 1: tier-out / tier-in GB/s vs the disk ceiling ------------
+    with tempfile.TemporaryDirectory() as d:
+        ceiling = _disk_ceiling(d)
+        vol_dir = os.path.join(d, "vols")
+        os.makedirs(vol_dir)
+        v = Volume(vol_dir, 5)
+        rng = random.Random(5)
+        chunk = rng.randbytes(1024 * 1024)
+        for k in range(1, 65):  # 64 MiB of needle data
+            v.write_needle(Needle(cookie=0xBEEF, id=k, data=chunk))
+        v.close()
+        base = os.path.join(vol_dir, "5")
+        ec_files.write_ec_files(base, rs=new_encoder(backend="cpu"))
+        ec_files.write_sorted_file_from_idx(base)
+        os.remove(base + ".dat")
+        os.remove(base + ".idx")
+        crcs = {}
+        for sid in range(14):
+            with open(base + ec_files.to_ext(sid), "rb") as f:
+                crcs[sid] = crc32c(f.read())
+        write_sidecar(base, crcs)
+        bdir = os.path.join(d, "backend")
+        os.makedirs(bdir)
+        bk.ensure_builtin_factories()
+        inst = f"bench{os.getpid()}"
+        bk.load_backend_config(
+            {"dir": {inst: {"enabled": True, "dir": bdir}}}
+        )
+        store = Store([vol_dir], ec_backend="cpu")
+        t0 = time.perf_counter()
+        res = tier_out_ec(store, 5, f"dir.{inst}")
+        out_s = time.perf_counter() - t0
+        moved = res["Bytes"]
+        t0 = time.perf_counter()
+        res_in = tier_in_ec(store, 5)
+        in_s = time.perf_counter() - t0
+        store.close()
+        for name, gb_s, secs in (
+            ("tier_out_e2e", moved / out_s / 1e9, out_s),
+            ("tier_in_e2e", res_in["Bytes"] / in_s / 1e9, in_s),
+        ):
+            row = {
+                "metric": name,
+                "value": round(gb_s, 3),
+                "unit": "GB/s",
+                "bytes": moved,
+                "seconds": round(secs, 3),
+                **ceiling,
+            }
+            rows.append(row)
+            print(json.dumps(row))
+
+    # -- leg 2: replication lag p99 through logqueue + runner ----------
+    with tempfile.TemporaryDirectory() as d:
+        lq = PartitionedLogQueue(d, partitions=4)
+        lags_ms: list = []
+        n_events = 2000
+
+        class _LagSink:
+            @staticmethod
+            def replicate(key, msg):
+                lags_ms.append(
+                    (time.perf_counter() - float(msg.new_entry.name)) * 1e3
+                )
+
+        def produce():
+            for i in range(n_events):
+                ev = fpb.EventNotification()
+                ev.new_entry.name = repr(time.perf_counter())
+                lq.send_message(f"/bench/k{i % 16}", ev)
+
+        tp = threading.Thread(target=produce)
+        tp.start()
+        rc = _consume_logqueue(
+            lq, _LagSink, poll_interval=0.01, stop_after_idle=1.0
+        )
+        tp.join()
+        lq.close()
+        lags_ms.sort()
+        row = {
+            "metric": "replication_lag",
+            "value": round(lags_ms[int(0.99 * (len(lags_ms) - 1))], 3),
+            "unit": "p99_ms",
+            "p50_ms": round(lags_ms[len(lags_ms) // 2], 3),
+            "events": len(lags_ms),
+            "drain_rc": rc,
+            "pass": rc == 0 and len(lags_ms) >= n_events,
+        }
+        rows.append(row)
+        print(json.dumps(row))
+
+    # -- leg 3: arbiter A/B rebuild TTR --------------------------------
+    rebuild_bytes = 48_000_000
+    take_chunk = 64_000
+
+    def rebuild_ttr(contended: bool) -> float:
+        arb = BandwidthArbiter(total_bytes_s=32_000_000.0, yield_window_s=0.0)
+        stop = threading.Event()
+
+        def replay_storm():
+            while not stop.is_set():
+                arb.take("handoff", take_chunk, stop=stop)
+
+        storm = threading.Thread(target=replay_storm)
+        if contended:
+            storm.start()
+            time.sleep(0.05)  # the replay registers as active first
+        t0 = time.perf_counter()
+        done = 0
+        while done < rebuild_bytes:
+            arb.take("rebuild", take_chunk)
+            done += take_chunk
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        if contended:
+            storm.join()
+        return elapsed
+
+    alone = rebuild_ttr(False)
+    shared = rebuild_ttr(True)
+    ratio = shared / alone
+    row = {
+        "metric": "arbiter_ab",
+        "value": round(ratio, 3),
+        "unit": "ttr_ratio",
+        "ttr_uncontended_s": round(alone, 3),
+        "ttr_contended_s": round(shared, 3),
+        "bound": 1.5,
+        "pass": ratio <= 1.5,
+    }
+    rows.append(row)
+    print(json.dumps(row))
+
+    with open(os.path.join(os.path.dirname(__file__), "BENCH_r14.json"), "w") as f:
+        json.dump({"tier": rows}, f, indent=2)
+
+
 CONFIGS = {
     "encode": bench_encode,
     "rebuild": bench_rebuild,
@@ -2930,6 +3104,7 @@ CONFIGS = {
     "qos": bench_qos,
     "degraded": bench_degraded,
     "chaos": bench_chaos,
+    "tier": bench_tier,
 }
 
 
@@ -3502,6 +3677,82 @@ def check_degraded_smoke() -> int:
     return 0 if ok else 1
 
 
+def check_tier_smoke() -> int:
+    """`bench.py --check` tiering leg (docs/TIERING.md): tier a sealed
+    EC volume out to a local-dir backend (local shard files deleted),
+    serve a degraded read from the backend byte-identical, then tier
+    it back in — the recalled shards must pass the .ecc CRC gate and
+    reads must match the originals."""
+    import random
+    import tempfile
+
+    from seaweedfs_tpu.ec import ec_files
+    from seaweedfs_tpu.ec.codec import new_encoder
+    from seaweedfs_tpu.ec.ecc_sidecar import write_sidecar
+    from seaweedfs_tpu.storage import backend as bkend
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+    from seaweedfs_tpu.storage.volume import Volume
+    from seaweedfs_tpu.tier.ec_tier import tier_in_ec, tier_out_ec
+    from seaweedfs_tpu.util.crc import crc32c
+
+    with tempfile.TemporaryDirectory() as d:
+        vol_dir = os.path.join(d, "vols")
+        os.makedirs(vol_dir)
+        v = Volume(vol_dir, 11)
+        rng = random.Random(23)
+        payload = {}
+        for k in range(1, 13):
+            data = bytes(rng.randbytes(2000 + 97 * k))
+            payload[k] = data
+            v.write_needle(Needle(cookie=0xCAFE, id=k, data=data))
+        v.close()
+        base = os.path.join(vol_dir, "11")
+        ec_files.write_ec_files(base, rs=new_encoder(backend="cpu"))
+        ec_files.write_sorted_file_from_idx(base)
+        os.remove(base + ".dat")
+        os.remove(base + ".idx")
+        crcs = {}
+        for sid in range(14):
+            with open(base + ec_files.to_ext(sid), "rb") as f:
+                crcs[sid] = crc32c(f.read())
+        write_sidecar(base, crcs)
+        bdir = os.path.join(d, "backend")
+        os.makedirs(bdir)
+        bkend.ensure_builtin_factories()
+        inst = f"chk{os.getpid()}"
+        bkend.load_backend_config(
+            {"dir": {inst: {"enabled": True, "dir": bdir}}}
+        )
+        store = Store([vol_dir], ec_backend="cpu")
+        tier_out_ec(store, 11, f"dir.{inst}")
+        ev = store.find_ec_volume(11)
+        local_gone = not ev.shards and not any(
+            os.path.exists(base + ec_files.to_ext(s)) for s in range(14)
+        )
+        degraded_ok = all(
+            bytes(ev.read_needle(k).data) == data
+            for k, data in payload.items()
+        )
+        tier_in_ec(store, 11)
+        recalled = ev.remote is None and len(ev.shards) == 14
+        recall_ok = all(
+            bytes(ev.read_needle(k).data) == data
+            for k, data in payload.items()
+        )
+        store.close()
+    ok = local_gone and degraded_ok and recalled and recall_ok
+    print(json.dumps({
+        "metric": "tier_smoke",
+        "ok": ok,
+        "local_shards_released": local_gone,
+        "degraded_read_byte_identical": degraded_ok,
+        "recalled_fully_local": recalled,
+        "recall_byte_identical": recall_ok,
+    }))
+    return 0 if ok else 1
+
+
 def check_pipeline_identity() -> int:
     """`bench.py --check` streaming-pipeline leg (docs/CODEC.md): on
     the CPU backend, the pipelined single-volume driver, the pipelined
@@ -3830,6 +4081,7 @@ def main() -> None:
         rc = rc or check_telemetry_smoke()
         rc = rc or check_qos_smoke()
         rc = rc or check_degraded_smoke()
+        rc = rc or check_tier_smoke()
         rc = rc or check_pipeline_identity()
         rc = rc or check_chaos_smoke()
         if os.environ.get("WEED_BENCH_CHECK_INNER") != "1":

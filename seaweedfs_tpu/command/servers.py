@@ -286,6 +286,15 @@ class MasterCommand(Command):
             "/cluster/health, /cluster/alerts, /cluster/top; 0 disables)",
         )
         p.add_argument(
+            "-tierInterval",
+            type=float,
+            default=0.0,
+            help="seconds between lifecycle-tiering scans "
+            "(docs/TIERING.md): age/temperature rules move cold EC "
+            "volumes to the WEED_TIER_BACKEND object store and recall "
+            "hot ones; 0 disables — tiering stays manual (tier.move)",
+        )
+        p.add_argument(
             "-assignPolicy",
             default="p2c",
             choices=("p2c", "random"),
@@ -334,6 +343,7 @@ class MasterCommand(Command):
             repair_concurrency=args.repairConcurrency,
             repair_grace=args.repairGrace,
             telemetry_interval=args.telemetryInterval,
+            tier_interval=args.tierInterval,
             assign_policy=args.assignPolicy,
         )
         from seaweedfs_tpu.util.profiling import CpuProfile
@@ -998,6 +1008,11 @@ class ServerCommand(Command):
             "-telemetryInterval", type=float, default=10.0,
             help="seconds between collector scrape cycles (0 disables)",
         )
+        p.add_argument(
+            "-tierInterval", type=float, default=0.0,
+            help="seconds between lifecycle-tiering scans (0 disables; "
+            "docs/TIERING.md)",
+        )
         _add_trace_flags(p)
         p.add_argument(
             "-v", type=int, default=0,
@@ -1025,6 +1040,7 @@ class ServerCommand(Command):
             repair_concurrency=args.repairConcurrency,
             repair_grace=args.repairGrace,
             telemetry_interval=args.telemetryInterval,
+            tier_interval=args.tierInterval,
         )
         master.start()
         started.append(master)

@@ -22,6 +22,7 @@ quirk baked into locate.py (large rows recoverable from shard size).
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 from typing import Callable, Optional
@@ -60,6 +61,33 @@ class ShardTruncated(RuntimeError):
     """A local shard file is shorter than its nominal length (disk
     truncation/corruption). Reads treat the shard as lost and
     reconstruct from the survivors instead of serving zero-fill."""
+
+
+class RemoteEcAttachment:
+    """A tiered EC volume's remote half: which backend holds which
+    shards, persisted as the `.evf` sidecar next to the (local) .ecx.
+
+    Remote shards are deliberately NOT EcVolumeShard mounts: the
+    quarantine machinery is path/file-based and a transient backend
+    error must degrade to reconstruction, never permanently quarantine
+    a perfectly good remote object."""
+
+    def __init__(self, backend_name: str, shard_size: int, shards: dict[int, dict]):
+        self.backend_name = backend_name  # "dir.default" / "s3.default"
+        self.shard_size = int(shard_size)  # nominal per-shard length
+        # shard id -> {"key": str, "size": int}
+        self.shards = {int(k): dict(v) for k, v in shards.items()}
+
+    def to_json(self) -> dict:
+        return {
+            "backend": self.backend_name,
+            "shard_size": self.shard_size,
+            "shards": {str(k): v for k, v in sorted(self.shards.items())},
+        }
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "RemoteEcAttachment":
+        return cls(doc["backend"], doc["shard_size"], doc.get("shards", {}))
 
 
 class EcVolumeShard:
@@ -162,6 +190,9 @@ class EcVolume:
         # one hot uncached tile must not fan out N× k-shard gathers
         self._decode_inflight: dict[tuple[int, int], threading.Event] = {}
         self._decode_inflight_lock = threading.Lock()
+        # lifecycle tiering (docs/TIERING.md): shards this node moved to
+        # an object-store backend, readable via ranged sub-shard GETs
+        self.remote: RemoteEcAttachment | None = None
 
     # --- mounting (disk_location_ec.go) ---
     @classmethod
@@ -179,6 +210,7 @@ class EcVolume:
                 ev.mount_shard(shard_id)
         if not os.path.exists(ev.base_name + ".ecx"):
             raise FileNotFoundError(ev.base_name + ".ecx")
+        ev.load_remote()
         return ev
 
     def mount_shard(self, shard_id: int) -> None:
@@ -210,6 +242,115 @@ class EcVolume:
     def shard_ids(self) -> list[int]:
         return sorted(self.shards)
 
+    # --- remote tier attachment (docs/TIERING.md) ---
+    @property
+    def evf_path(self) -> str:
+        return self.base_name + ".evf"
+
+    def serving_shard_ids(self) -> list[int]:
+        """Shards this node can serve: local mounts plus tiered remote
+        shards. This is what rides the heartbeat's ec_index_bits — a
+        fully tiered volume must keep routing here (and must NOT look
+        missing to the repair scheduler)."""
+        ids = set(self.shards)
+        if self.remote is not None:
+            ids |= set(self.remote.shards)
+        return sorted(ids)
+
+    def load_remote(self) -> None:
+        """Adopt an existing .evf sidecar (startup / remount)."""
+        try:
+            with open(self.evf_path, "rb") as f:
+                self.remote = RemoteEcAttachment.from_json(json.load(f))
+        except FileNotFoundError:
+            self.remote = None
+        except (OSError, ValueError, KeyError) as e:
+            wlog.warning("ec vid %d: unreadable .evf (%s); ignoring", self.volume_id, e)
+            self.remote = None
+
+    def attach_remote(self, attachment: RemoteEcAttachment) -> None:
+        """Durably publish the .evf sidecar, then serve through it.
+        Crash ordering: before the publish, local shards are still the
+        only truth (remote copies are orphans a re-run re-uploads);
+        after it, reads resolve remotely even once local files go."""
+        tmp = self.evf_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(attachment.to_json(), indent=1).encode())
+        durable.publish(tmp, self.evf_path)
+        self.remote = attachment
+
+    def detach_remote(self) -> RemoteEcAttachment | None:
+        """Drop the .evf (tier-in complete: local shards are back).
+        Returns the old attachment so the caller can delete the remote
+        objects best-effort AFTER the detach is durable."""
+        old = self.remote
+        self.remote = None
+        try:
+            os.remove(self.evf_path)
+            durable.fsync_dir(self.directory)
+        except OSError:
+            pass
+        return old
+
+    def _remote_fetch(self, shard_id: int, offset: int, size: int) -> bytes | None:
+        """Ranged sub-shard read against the attached backend; None on
+        any failure (the caller falls through to peer fetch and
+        reconstruction — a flaky backend degrades, never faults)."""
+        remote = self.remote
+        if remote is None:
+            return None
+        info = remote.shards.get(shard_id)
+        if info is None:
+            return None
+        from seaweedfs_tpu.stats.metrics import (
+            TIER_REMOTE_READ_ERRORS,
+            TIER_REMOTE_READS,
+        )
+        from seaweedfs_tpu.storage import backend as bk
+
+        backend = bk.get_backend(remote.backend_name)
+        if backend is None:
+            TIER_REMOTE_READ_ERRORS.inc()
+            wlog.warning(
+                "ec vid %d: tier backend %s not configured",
+                self.volume_id, remote.backend_name,
+            )
+            return None
+        try:
+            data = backend.new_storage_file(
+                info["key"], int(info.get("size", remote.shard_size))
+            ).read_at(size, offset)
+        except Exception as e:  # noqa: BLE001 — any backend fault degrades
+            TIER_REMOTE_READ_ERRORS.inc()
+            wlog.warning(
+                "ec vid %d shard %d: tier read [%d,%d) failed: %s",
+                self.volume_id, shard_id, offset, offset + size, e,
+            )
+            return None
+        if len(data) != size:
+            TIER_REMOTE_READ_ERRORS.inc()
+            return None
+        TIER_REMOTE_READS.inc()
+        return data
+
+    def _with_remote(self, fetch: ShardFetcher | None) -> ShardFetcher | None:
+        """Interpose the tier backend ahead of the peer-fetch seam:
+        tiered shards resolve with one ranged backend GET; on a miss or
+        backend fault the original fetch (peer fan-in) still runs, and
+        reconstruction candidates go through the same wrapper."""
+        if self.remote is None:
+            return fetch
+
+        def wrapped(shard_id: int, offset: int, size: int) -> bytes | None:
+            data = self._remote_fetch(shard_id, offset, size)
+            if data is not None:
+                return data
+            if fetch is not None:
+                return fetch(shard_id, offset, size)
+            return None
+
+        return wrapped
+
     @property
     def rs(self) -> ReedSolomon:
         if self._rs is None:
@@ -240,10 +381,15 @@ class EcVolume:
         Uses the MAX across mounted shards: intact shards all share the
         nominal length, while a truncated one is shorter — deriving
         geometry from it would mis-split rows and corrupt the interval
-        mapping for every shard."""
+        mapping for every shard. A fully tiered volume has zero local
+        shards; its geometry comes from the .evf attachment."""
         if not self.shards:
-            raise NotEnoughShards("no local shards mounted")
-        shard_size = max(s.size for s in self.shards.values())
+            if self.remote is not None:
+                shard_size = self.remote.shard_size
+            else:
+                raise NotEnoughShards("no local shards mounted")
+        else:
+            shard_size = max(s.size for s in self.shards.values())
         large, small = locate.LARGE_BLOCK_SIZE, locate.SMALL_BLOCK_SIZE
         n_large = shard_size // large
         n_small = (shard_size - n_large * large) // small
@@ -262,6 +408,7 @@ class EcVolume:
     def read_span(
         self, offset: int, size: int, fetch: ShardFetcher | None = None
     ) -> bytes:
+        fetch = self._with_remote(fetch)
         dat_size = self.dat_file_size()
         out = bytearray()
         for iv in locate.locate_data(
@@ -414,6 +561,8 @@ class EcVolume:
         """Full per-shard byte length (every intact shard of a volume
         shares it — see dat_file_size)."""
         if not self.shards:
+            if self.remote is not None:
+                return self.remote.shard_size
             raise NotEnoughShards("no local shards mounted")
         return max(s.size for s in self.shards.values())
 
@@ -693,7 +842,7 @@ class EcVolume:
             for path in (p, p + ".bad"):  # .bad = quarantined forensic copy
                 if os.path.exists(path):
                     os.remove(path)
-        for ext in (".ecx", ".ecj"):
+        for ext in (".ecx", ".ecj", ".evf"):
             p = self.base_name + ext
             if os.path.exists(p):
                 os.remove(p)

@@ -9,9 +9,19 @@ import time
 from seaweedfs_tpu.replication.replicator import Replicator
 from seaweedfs_tpu.replication.sink import FilerSink, LocalSink, S3Sink
 from seaweedfs_tpu.replication.source import FilerSource
+from seaweedfs_tpu.scrub.arbiter import get_arbiter
+from seaweedfs_tpu.stats.metrics import REPLICATION_APPLIED, REPLICATION_LAG
 from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.util import durable
 from seaweedfs_tpu.util.config import load_config, Configuration
+
+
+def repl_enabled() -> bool:
+    """`WEED_REPL=0` kills the replication consumer wholesale: the
+    runner exits without draining. The durable queue keeps absorbing
+    filer events, so flipping the switch back on resumes from the
+    committed cursor — lag, not loss."""
+    return os.environ.get("WEED_REPL", "1") != "0"
 
 
 def build_replicator(repl_cfg: Configuration) -> Replicator:
@@ -106,6 +116,12 @@ def run_replicate(
     each event; offsets are checkpointed so restarts resume.
     stop_after_idle > 0 makes the loop exit after that many idle
     seconds (tests / one-shot drains)."""
+    if not repl_enabled():
+        # kill switch (docs/TIERING.md): events keep accumulating in
+        # the durable queue; re-enabling resumes from the committed
+        # cursor with nothing lost
+        wlog.warning("filer.replicate disabled (WEED_REPL=0); exiting")
+        return 0
     if config_path:
         from seaweedfs_tpu.util.config import tomllib  # 3.10 fallback parser
 
@@ -233,14 +249,34 @@ def _consume_logqueue(lq, replicator, poll_interval, stop_after_idle) -> int:
     group = "replicate"
     idle_since = time.time()
     retries: dict[tuple[int, int], int] = {}  # (partition, offset) → attempts
+
+    def _sample_lag() -> None:
+        # lag = events the producer wrote that this consumer hasn't
+        # committed past; surfaced on /metrics for the telemetry
+        # collector's RULE_REPL_LAG alert (the Kafka adapter has no
+        # cheap depth — it just doesn't report)
+        depth = getattr(lq, "depth", None)
+        if callable(depth):
+            try:
+                REPLICATION_LAG.set(depth(group), group)
+            except OSError:
+                pass
+
     while True:
         batch = lq.poll(group)
+        _sample_lag()
         if batch:
             high: dict[int, int] = {}
             stalled: set[int] = set()
             for part, offset, key, msg in batch:
                 if part in stalled:
                     continue  # order: nothing commits past the failure
+                # cross-cluster apply traffic pays the bandwidth
+                # arbiter: max-min share against rebuild/handoff/tier,
+                # yielding to foreground serving (docs/TIERING.md)
+                get_arbiter().take(
+                    "replication", max(msg.ByteSize(), 1)
+                )
                 try:
                     replicator.replicate(key, msg)
                 except Exception as e:  # noqa: BLE001 — redeliver next poll
@@ -252,6 +288,7 @@ def _consume_logqueue(lq, replicator, poll_interval, stop_after_idle) -> int:
                         )
                         retries.pop((part, offset), None)
                         high[part] = offset + 1  # give up: commit past it
+                        REPLICATION_APPLIED.labels("skipped").inc()
                     else:
                         wlog.error(
                             "replicate %s: %s (attempt %d; partition %d "
@@ -260,12 +297,15 @@ def _consume_logqueue(lq, replicator, poll_interval, stop_after_idle) -> int:
                         )
                         retries[(part, offset)] = attempts
                         stalled.add(part)
+                        REPLICATION_APPLIED.labels("error").inc()
                     continue
                 retries.pop((part, offset), None)
                 high[part] = offset + 1
+                REPLICATION_APPLIED.labels("ok").inc()
             for part, next_off in high.items():
                 lq.commit(group, part, next_off)
             lq.trim()
+            _sample_lag()
             if high:
                 idle_since = time.time()
             if stalled:

@@ -735,9 +735,29 @@ class S3ApiServer:
                     body,
                     "application/octet-stream",
                 )
-                self._send(
-                    200, headers={"ETag": f'"{hashlib.md5(body).hexdigest()}"'}
+                etag = hashlib.md5(body).hexdigest()
+                # remember the md5 on the staged entry so complete can
+                # validate the client's part manifest (the chunk e_tag
+                # the volume assigns is a needle etag, not this md5)
+                part_entry = server._lookup(
+                    f"{server._uploads_folder(bucket)}/{upload_id}",
+                    f"{part_num:04d}.part",
                 )
+                if part_entry is not None:
+                    part_entry.extended["s3-md5"] = etag.encode()
+                    try:
+                        server._stub().UpdateEntry(
+                            fpb.UpdateEntryRequest(
+                                directory=(
+                                    f"{server._uploads_folder(bucket)}"
+                                    f"/{upload_id}"
+                                ),
+                                entry=part_entry,
+                            )
+                        )
+                    except grpc.RpcError:
+                        pass  # validation degrades to existence-only
+                self._send(200, headers={"ETag": f'"{etag}"'})
 
             def _complete_multipart_upload(self, bucket, key, query, body):
                 upload_id = query["uploadId"][0]
@@ -753,6 +773,31 @@ class S3ApiServer:
                     e for e in entries
                     if e.name.endswith(".part") and not e.is_directory
                 ]
+                manifest = _parse_complete_body(body)
+                if manifest is not None:
+                    # client sent the CompleteMultipartUpload manifest:
+                    # validate it like real S3 before splicing —
+                    # ascending part order (InvalidPartOrder), every
+                    # listed part staged with a matching ETag
+                    # (InvalidPart) — so a client that lost a part PUT
+                    # gets a typed error, not a silently short object
+                    if [n for n, _ in manifest] != sorted(
+                        n for n, _ in manifest
+                    ):
+                        raise s3_error("InvalidPartOrder")
+                    staged = {int(e.name[:-5]): e for e in parts}
+                    chosen = []
+                    for num, etag in manifest:
+                        entry = staged.get(num)
+                        if entry is None:
+                            raise s3_error("InvalidPart")
+                        if etag:
+                            want = etag.strip('"')
+                            have = _entry_part_etag(entry)
+                            if have is not None and want != have:
+                                raise s3_error("InvalidPart")
+                        chosen.append(entry)
+                    parts = chosen
                 final_chunks = []
                 offset = 0
                 for entry in sorted(parts, key=lambda e: int(e.name[:-5])):
@@ -789,6 +834,13 @@ class S3ApiServer:
 
             def _abort_multipart_upload(self, bucket, key, query):
                 upload_id = query["uploadId"][0]
+                if server._lookup(server._uploads_folder(bucket), upload_id) is None:
+                    # unknown (or already aborted/completed) upload id
+                    # gets the typed error, not a silent 204
+                    raise s3_error("NoSuchUpload")
+                # delete_data=True: the staged part chunks are orphans
+                # once the staging dir goes — abort must reclaim them,
+                # not leak volume space until vacuum
                 server._rm(server._uploads_folder(bucket), upload_id, delete_data=True)
                 self._send(204)
 
@@ -839,6 +891,43 @@ def _http_date(epoch_sec: int) -> str:
     return time.strftime(
         "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(epoch_sec or 0)
     )
+
+
+def _parse_complete_body(body: bytes) -> list[tuple[int, str]] | None:
+    """Parse a CompleteMultipartUpload request body into
+    [(part_number, etag), ...] in document order, or None when the
+    client sent no manifest (legacy callers: assemble all staged
+    parts). A malformed manifest is a malformed request."""
+    if not body or not body.strip():
+        return None
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError:
+        raise s3_error("MalformedXML") from None
+    out: list[tuple[int, str]] = []
+    for part in root.iter():
+        if not part.tag.endswith("Part"):
+            continue
+        num, etag = None, ""
+        for child in part:
+            if child.tag.endswith("PartNumber"):
+                try:
+                    num = int((child.text or "").strip())
+                except ValueError:
+                    raise s3_error("MalformedXML") from None
+            elif child.tag.endswith("ETag"):
+                etag = (child.text or "").strip()
+        if num is not None:
+            out.append((num, etag))
+    return out or None
+
+
+def _entry_part_etag(entry) -> str | None:
+    """The md5 ETag the part PUT responded with, recorded on the
+    staged entry; None if the UpdateEntry that records it was lost
+    (validation then degrades to part existence + order)."""
+    raw = entry.extended.get("s3-md5", b"")
+    return raw.decode() if raw else None
 
 
 def _chunks_etag(chunks) -> str:

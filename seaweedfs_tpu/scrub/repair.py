@@ -38,6 +38,7 @@ import grpc
 
 from seaweedfs_tpu.ec.locate import DATA_SHARDS, TOTAL_SHARDS
 from seaweedfs_tpu.pb import rpc, volume_pb2
+from seaweedfs_tpu.scrub.arbiter import get_arbiter
 from seaweedfs_tpu.util import wlog
 
 
@@ -777,4 +778,8 @@ class RepairScheduler:
                 "Active": self._active,
                 "Tasks": [t.to_dict() for t in self.tasks.values()],
                 "History": list(self.history),
+                # bandwidth arbiter view: what the background planes
+                # (rebuild/replication/handoff/tier) are being paced at
+                # right now (docs/TIERING.md)
+                "Arbiter": get_arbiter().stats(),
             }

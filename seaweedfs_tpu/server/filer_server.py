@@ -653,6 +653,31 @@ class FilerServer:
             "filer", f"{self.host}:{self.port}", self.masters,
             interval=self.announce_interval,
         )
+        # replication plane (docs/TIERING.md): surface the producer's
+        # view of consumer lag on THIS filer's /metrics — depth of the
+        # "replicate" consumer group in the notification queue. The
+        # collector scrapes it and RULE_REPL_LAG alerts on it; sampled
+        # lazily at render time so an idle filer pays nothing.
+        from seaweedfs_tpu import notification
+        from seaweedfs_tpu.stats.metrics import (
+            DEFAULT_REGISTRY,
+            REPLICATION_LAG,
+        )
+
+        def _sample_repl_lag() -> None:
+            q = notification.queue
+            depth = getattr(q, "depth", None)
+            if callable(depth):
+                try:
+                    REPLICATION_LAG.set(depth("replicate"), "replicate")
+                except OSError:
+                    pass
+
+        # process-global registry + process-global notification.queue:
+        # one hook regardless of how many filers this process embeds
+        if not getattr(DEFAULT_REGISTRY, "_repl_lag_hooked", False):
+            DEFAULT_REGISTRY._repl_lag_hooked = True
+            DEFAULT_REGISTRY.add_prerender_hook(_sample_repl_lag)
 
     def stop(self) -> None:
         if self._announce is not None:

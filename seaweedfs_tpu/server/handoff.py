@@ -292,6 +292,17 @@ class HandoffAgent:
                     self.store.remove(path)
                     continue
                 head, body = parsed
+                # pace the replay through the shared bandwidth arbiter
+                # BEFORE moving the bytes: a big spool used to replay at
+                # full speed against a concurrent rebuild (the known gap
+                # ROADMAP named) — now it gets the handoff claimant's
+                # share and yields to foreground serving
+                from seaweedfs_tpu.scrub.arbiter import get_arbiter
+
+                if not get_arbiter().take(
+                    "handoff", max(len(body), 1), stop=self._stop
+                ):
+                    return delivered  # stopping: bytes were never sent
                 verdict = self._replay(head, body)
                 if verdict == "sick":
                     break  # target still sick: keep order, retry later

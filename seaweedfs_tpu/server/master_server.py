@@ -107,6 +107,8 @@ class MasterServer:
         repair_grace: float = 30.0,
         telemetry_interval: float = 0.0,
         telemetry_kwargs: dict | None = None,
+        tier_interval: float = 0.0,
+        tier_kwargs: dict | None = None,
         assign_policy: str = "p2c",
     ):
         # QoS plane (docs/QOS.md): "p2c" = queue-depth-aware
@@ -213,6 +215,17 @@ class MasterServer:
 
             self.telemetry = ClusterCollector(
                 self, interval=telemetry_interval, **(telemetry_kwargs or {})
+            )
+        # tiering plane (docs/TIERING.md): leader-only lifecycle
+        # scheduler driving tier-out/tier-in moves at the shard
+        # holders. tier_interval <= 0 leaves tiering manual (tier.move
+        # in the shell) — same opt-in contract as repair/telemetry.
+        self.tier = None
+        if tier_interval > 0:
+            from seaweedfs_tpu.tier import TierScheduler
+
+            self.tier = TierScheduler(
+                self, interval=tier_interval, **(tier_kwargs or {})
             )
         # gateway registration (/cluster/register): filer/S3/WebDAV
         # announce themselves here so the collector can scrape them —
@@ -937,6 +950,19 @@ class MasterServer:
                     snap = server.repair.queue_snapshot()
                     snap["Scrub"] = server.topology.scrub_summary()
                     return self._json(snap)
+                if path == "/cluster/tier":
+                    # tiering plane operator surface (tier.status shell
+                    # command): scheduler rules, in-flight moves, and
+                    # recent move history (docs/TIERING.md)
+                    if server.tier is None:
+                        return self._json(
+                            {
+                                "Disabled": True,
+                                "error": "tier scheduler disabled on "
+                                "this master (-tierInterval 0)",
+                            }
+                        )
+                    return self._json(server.tier.status_snapshot())
                 if path == "/stats/counter":
                     return self._json(server.request_counter.snapshot())
                 if path == "/stats/memory":
@@ -1369,6 +1395,8 @@ class MasterServer:
             self.repair.start()
         if self.telemetry is not None:
             self.telemetry.start()
+        if self.tier is not None:
+            self.tier.start()
         # continuous sampling profiler (telemetry/profiler.py): every
         # daemon serves /debug/profile; WEED_PROF=0 opts the process out
         from seaweedfs_tpu.telemetry import profiler
@@ -1377,6 +1405,8 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop_event.set()
+        if self.tier is not None:
+            self.tier.stop()
         if self.telemetry is not None:
             self.telemetry.stop()
         if self.repair is not None:

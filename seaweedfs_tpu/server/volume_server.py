@@ -35,6 +35,8 @@ from urllib.parse import parse_qs
 import grpc
 
 from seaweedfs_tpu import qos, trace
+from seaweedfs_tpu.scrub.arbiter import get_arbiter
+from seaweedfs_tpu.stats.metrics import VOLUME_READS
 from seaweedfs_tpu.util import deadline as _op_deadline
 from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.ec import ec_files
@@ -1168,6 +1170,11 @@ class VolumeServer:
 
         def make_reader(sid: int, urls: list[str]):
             def read(offset: int, size: int) -> bytes:
+                # rebuild traffic pays the bandwidth arbiter before
+                # pulling remote bytes — max-min share against
+                # replication/handoff/tier, yielding to foreground
+                # serving (docs/TIERING.md)
+                get_arbiter().take("rebuild", size, stop=self._stop)
                 last: Exception | None = None
                 t_o = 30 if factory_dl is None else factory_dl.cap(30)
                 # the hop HEADER rides too (re-stamped per read, the
@@ -1283,7 +1290,10 @@ class VolumeServer:
             if ev is None:
                 context.abort(grpc.StatusCode.NOT_FOUND, f"ec volume {req.volume_id} not found")
             shard = ev.shards.get(req.shard_id)
-            if shard is None:
+            remote = ev.remote
+            if shard is None and not (
+                remote is not None and req.shard_id in remote.shards
+            ):
                 context.abort(
                     grpc.StatusCode.NOT_FOUND,
                     f"ec shard {req.volume_id}.{req.shard_id} not mounted",
@@ -1298,6 +1308,29 @@ class VolumeServer:
                 except NeedleNotFound:
                     yield pb.VolumeEcShardReadResponse(is_deleted=True)
                     return
+            if shard is None:
+                # tiered-away shard: peers keep fetching through this
+                # node (the shard map still routes here — the heartbeat
+                # advertises serving_shard_ids), and this node streams
+                # the sub-range from its attached backend
+                info = remote.shards[req.shard_id]
+                size = int(info.get("size", remote.shard_size))
+                remaining = min(req.size, max(0, size - req.offset))
+                offset = req.offset
+                while remaining > 0:
+                    chunk = ev._remote_fetch(
+                        req.shard_id, offset, min(COPY_CHUNK, remaining)
+                    )
+                    if not chunk:
+                        context.abort(
+                            grpc.StatusCode.UNAVAILABLE,
+                            f"tier backend read failed for ec shard "
+                            f"{req.volume_id}.{req.shard_id}",
+                        )
+                    yield pb.VolumeEcShardReadResponse(data=chunk)
+                    offset += len(chunk)
+                    remaining -= len(chunk)
+                return
             # clamp the span to the shard: read_at treats past-EOF reads as
             # truncation (it guards the DEGRADED path, where short data must
             # never silently substitute), but a plain span read walking the
@@ -1756,6 +1789,13 @@ class VolumeServer:
                         )
                     server.scrub.trigger(vid)
                     return self._json({"triggered": True, "volumeId": vid})
+                if url_path == "/tier/status":
+                    # lifecycle tiering (docs/TIERING.md): per-volume
+                    # local/remote shard state + mtimes — the master's
+                    # TierScheduler polls this for its age signal
+                    from seaweedfs_tpu.tier.ec_tier import tier_status
+
+                    return self._json(tier_status(server.store))
                 if url_path == "/ec/quarantine":
                     # operator surface (and tests/faults.DeadShard): put
                     # one mounted EC shard out of service NOW — the
@@ -1841,6 +1881,12 @@ class VolumeServer:
                     if not server.watchdog.note_io_error(e):
                         raise
                     return self._json({"error": f"read failed: {e}"}, 500)
+                # serve-first: stamp the arbiter so background planes
+                # (rebuild/replication/handoff/tier) yield to foreground
+                # reads; the per-volume counter is the tier scheduler's
+                # access-temperature signal (scraped via /metrics)
+                get_arbiter().note_serve()
+                VOLUME_READS.labels(str(fid.volume_id)).inc()
                 if n.is_chunked_manifest():
                     return self._serve_chunked_manifest(n)
                 # conditional gets: If-Modified-Since (RFC 1123, like
@@ -2045,7 +2091,53 @@ class VolumeServer:
                 )
                 return True
 
+            def _tier_move(self):
+                """POST /tier/move?volumeId=&direction=out|in
+                [&destination=type.id] — the TierScheduler's (and
+                tier.move shell command's) verb. Runs the move inline
+                under the request's ambient deadline; the engine
+                charges the bandwidth arbiter's "tier" claimant as
+                bytes stream, so a scan-wide fan-in cannot stampede."""
+                from seaweedfs_tpu.tier import ec_tier
+                from seaweedfs_tpu.tier.rules import tier_enabled
+
+                if not tier_enabled():
+                    return self._json(
+                        {"error": "tiering disabled (WEED_TIER=0)"}, 403
+                    )
+                q = fast_query(self.path.partition("?")[2])
+                try:
+                    vid = int(q.get("volumeId", ""))
+                except ValueError:
+                    return self._json({"error": "bad volumeId"}, 400)
+                direction = q.get("direction", "out")
+                try:
+                    if direction == "out":
+                        dest = q.get("destination", "")
+                        if not dest:
+                            return self._json(
+                                {"error": "destination required"}, 400
+                            )
+                        result = ec_tier.tier_out_ec(
+                            server.store, vid, dest, stop=server._stop
+                        )
+                    elif direction == "in":
+                        result = ec_tier.tier_in_ec(
+                            server.store, vid, stop=server._stop
+                        )
+                    else:
+                        return self._json(
+                            {"error": f"bad direction {direction!r}"}, 400
+                        )
+                except (ValueError, KeyError) as e:
+                    return self._json({"error": str(e)}, 404)
+                except (OSError, RuntimeError) as e:
+                    return self._json({"error": str(e)}, 500)
+                return self._json(result)
+
             def do_POST(self):
+                if self.path.partition("?")[0] == "/tier/move":
+                    return self._tier_move()
                 fid, q, url_filename, _url_ext = self._parse_fid()
                 if fid is None:
                     return self._json({"error": "invalid file id"}, 400)
@@ -2053,6 +2145,10 @@ class VolumeServer:
                     return
                 if self._shed_unwritable():
                     return
+                # serve-first: foreground writes also push background
+                # planes (rebuild/replication/handoff/tier) into their
+                # yield window
+                get_arbiter().note_serve()
                 length = int(self.headers.get("content-length", "0"))
                 body = self.rfile.read(length)
                 if server.shard_writes:

@@ -1794,3 +1794,226 @@ class ProfileCapture(Command):
                 # print the innermost frames; full stacks via -folded
                 leaf = ";".join(stack.split(";")[-3:])
                 print(f"  {count:6d}  {leaf}", file=out)
+
+
+# ----------------------------------------------------------------------
+# tiering + replication plane operator surface (docs/TIERING.md)
+
+
+def _http_json_post(url: str, timeout: float = 10.0) -> dict:
+    import json as _json
+    import urllib.request
+
+    req = urllib.request.Request(url, method="POST", data=b"")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return _json.loads(r.read())
+
+
+def _http_text(url: str, timeout: float = 10.0) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode("utf-8", "replace")
+
+
+@register
+class TierStatus(Command):
+    name = "tier.status"
+    help = (
+        "tier.status [-json] — scheduler rules + recent moves from the "
+        "master, and per-node tiered-volume state from every holder"
+    )
+
+    def run(self, env, args, out):
+        import json as _json
+
+        try:
+            sched = _http_json(f"http://{env.master}/cluster/tier")
+        except (OSError, ValueError) as e:
+            sched = {"error": str(e)}
+        nodes = {}
+        dump = env.collect_topology()
+        for n in dump.nodes:
+            try:
+                nodes[n.url] = _http_json(f"http://{n.url}/tier/status")
+            except (OSError, ValueError) as e:
+                nodes[n.url] = {"error": str(e)}
+        if _has_flag(args, "json"):
+            print(_json.dumps({"Scheduler": sched, "Nodes": nodes}), file=out)
+            return
+        if sched.get("Disabled"):
+            print(
+                "tier scheduler disabled on this master (-tierInterval 0); "
+                "tiering is manual (tier.move)",
+                file=out,
+            )
+        elif "error" in sched:
+            print(f"master unreachable: {sched['error']}", file=out)
+        else:
+            rules = sched.get("Rules") or {}
+            print(
+                f"scheduler: every {sched.get('IntervalSeconds')}s, "
+                f"backend '{rules.get('Backend', '')}', "
+                f"min age {rules.get('MinAgeSeconds')}s, "
+                f"cold <= {rules.get('ColdReadsPerSec')}/s, "
+                f"hot > {rules.get('HotReadsPerSec')}/s, "
+                f"active {sched.get('Active', 0)}, "
+                f"started {sched.get('MovesStarted', 0)}, "
+                f"failed {sched.get('MovesFailed', 0)}",
+                file=out,
+            )
+            for h in (sched.get("History") or [])[-10:]:
+                print(
+                    f"  {h['Direction']} vid {h['VolumeId']} @ {h['Holder']} "
+                    f"in {h['Seconds']}s"
+                    + (f" ERROR: {h['Error']}" if h.get("Error") else ""),
+                    file=out,
+                )
+        for url, st in sorted(nodes.items()):
+            if "error" in st:
+                print(f"{url}: unreachable ({st['error']})", file=out)
+                continue
+            rows = [
+                (int(vid), row) for vid, row in st.items()
+                if isinstance(row, dict)
+            ]
+            if not rows:
+                continue
+            print(f"{url}:", file=out)
+            for vid, row in sorted(rows):
+                if row.get("Tiered"):
+                    print(
+                        f"  vid {vid}: TIERED -> {row.get('Backend')} "
+                        f"(remote {row.get('RemoteShards')}, "
+                        f"local {row.get('LocalShards')})",
+                        file=out,
+                    )
+                else:
+                    print(
+                        f"  vid {vid}: local shards {row.get('LocalShards')}",
+                        file=out,
+                    )
+
+
+@register
+class TierMove(Command):
+    name = "tier.move"
+    help = (
+        "tier.move -volumeId vid -dest backend.name [-in] "
+        "[-node host:port] — move an EC volume's shards out to the "
+        "backend (or back in with -in) on every holder"
+    )
+
+    def run(self, env, args, out):
+        import json as _json
+
+        vid = _flag(args, "volumeId")
+        if not vid:
+            print("tier.move: -volumeId required", file=out)
+            return
+        direction = "in" if _has_flag(args, "in") else "out"
+        dest = _flag(args, "dest")
+        if direction == "out" and not dest:
+            print("tier.move: -dest backend.name required for tier-out", file=out)
+            return
+        node = _flag(args, "node")
+        if node:
+            urls = [node]
+        else:
+            # every node that holds shards of this volume (tier-out is
+            # per-holder: each node streams its OWN shards out)
+            urls = []
+            dump = env.collect_topology()
+            for n in dump.nodes:
+                try:
+                    st = _http_json(f"http://{n.url}/tier/status")
+                except (OSError, ValueError):
+                    continue
+                if vid in st:
+                    urls.append(n.url)
+        if not urls:
+            print(f"tier.move: no holder found for vid {vid}", file=out)
+            return
+        qs = f"volumeId={vid}&direction={direction}"
+        if direction == "out":
+            qs += f"&destination={dest}"
+        for url in urls:
+            try:
+                result = _http_json_post(
+                    f"http://{url}/tier/move?{qs}", timeout=600.0
+                )
+            except (OSError, ValueError) as e:
+                print(f"{url}: FAILED ({e})", file=out)
+                continue
+            print(f"{url}: {_json.dumps(result)}", file=out)
+
+
+@register
+class ReplicationLag(Command):
+    name = "replication.lag"
+    help = (
+        "replication.lag [-json] — cross-cluster replication consumer "
+        "lag as seen by the leader's telemetry rings (filer-exposed "
+        "weed_replication_lag_events), plus any firing lag alerts"
+    )
+
+    def run(self, env, args, out):
+        import json as _json
+
+        try:
+            alerts = _http_json(f"http://{env.master}/cluster/alerts")
+        except (OSError, ValueError) as e:
+            alerts = {"error": str(e)}
+        rows = {}
+        # scrape the registered filer gateways directly: the producer
+        # side's view of queue depth is authoritative for lag
+        try:
+            health = _http_json(f"http://{env.master}/cluster/health")
+        except (OSError, ValueError):
+            health = {}
+        for url, row in (health.get("Targets") or {}).items():
+            if row.get("Kind") != "filer":
+                continue
+            try:
+                text = _http_text(f"http://{url}/metrics")
+            except (OSError, ValueError) as e:
+                rows[url] = {"error": str(e)}
+                continue
+            lag = None
+            for line in text.splitlines():
+                if line.startswith("weed_replication_lag_events"):
+                    try:
+                        lag = float(line.rsplit(None, 1)[1])
+                    except (IndexError, ValueError):
+                        pass
+            rows[url] = {"LagEvents": lag}
+        firing = [
+            a for a in (alerts.get("Firing") or [])
+            if a.get("Alert") == "replication_lag"
+        ]
+        if _has_flag(args, "json"):
+            print(_json.dumps({"Filers": rows, "Alerts": firing}), file=out)
+            return
+        if not rows:
+            print(
+                "no filer gateways registered with the master "
+                "(is telemetry on, and did the filer announce?)",
+                file=out,
+            )
+        for url, row in sorted(rows.items()):
+            if "error" in row:
+                print(f"{url}: unreachable ({row['error']})", file=out)
+            elif row["LagEvents"] is None:
+                print(
+                    f"{url}: no lag metric (no notification queue "
+                    f"configured on this filer)",
+                    file=out,
+                )
+            else:
+                print(f"{url}: {row['LagEvents']:.0f} event(s) behind", file=out)
+        for a in firing:
+            print(
+                f"ALERT {a.get('Severity')}: {a.get('Target')} "
+                f"{a.get('Detail')}",
+                file=out,
+            )

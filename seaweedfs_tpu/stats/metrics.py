@@ -439,6 +439,59 @@ HANDOFF_HINTS = DEFAULT_REGISTRY.counter(
     ("event",),  # written | replayed | dropped
 )
 
+# --- lifecycle tiering + cross-cluster replication (docs/TIERING.md) --------
+VOLUME_READS = DEFAULT_REGISTRY.counter(
+    "weed_volume_read_total",
+    "needle GETs served, per volume — the tier scheduler's "
+    "access-temperature signal (scraped off the node by the collector)",
+    ("volume",),
+)
+TIER_MOVES = DEFAULT_REGISTRY.counter(
+    "weed_tier_moves_total",
+    "EC volume tier transitions completed on this node",
+    ("direction", "result"),  # direction: out | in; result: ok | error
+)
+TIER_BYTES = DEFAULT_REGISTRY.counter(
+    "weed_tier_bytes_total",
+    "shard bytes moved to/from the tier backend",
+    ("direction",),  # out | in
+)
+TIER_REMOTE_READS = DEFAULT_REGISTRY.counter(
+    "weed_tier_remote_read_total",
+    "ranged sub-shard reads served from the tier backend",
+)
+TIER_REMOTE_READ_ERRORS = DEFAULT_REGISTRY.counter(
+    "weed_tier_remote_read_errors_total",
+    "tier backend reads that failed (the read degraded to "
+    "peer-fetch/reconstruction instead)",
+)
+TIERED_VOLUMES = DEFAULT_REGISTRY.gauge(
+    "weed_tiered_volumes",
+    "EC volumes currently holding a remote tier attachment on this node",
+    ("server",),
+)
+REPLICATION_LAG = DEFAULT_REGISTRY.gauge(
+    "weed_replication_lag_events",
+    "filer mutation events published but not yet consumed by the "
+    "replication consumer group (logqueue depth)",
+    ("group",),
+)
+REPLICATION_APPLIED = DEFAULT_REGISTRY.counter(
+    "weed_replication_applied_total",
+    "replicated filer events applied to the sink cluster",
+    ("result",),  # ok | error | skipped
+)
+ARBITER_BYTES = DEFAULT_REGISTRY.counter(
+    "weed_arbiter_bytes_total",
+    "background bytes admitted by the bandwidth arbiter, per claimant",
+    ("claimant",),  # rebuild | replication | handoff | tier
+)
+ARBITER_WAIT_SECONDS = DEFAULT_REGISTRY.counter(
+    "weed_arbiter_wait_seconds_total",
+    "seconds background claimants spent blocked on their share",
+    ("claimant",),
+)
+
 
 # textual push-loop health (gauges can't carry the error STRING): job
 # -> {"last_success_unix", "last_error"}; /cluster/health surfaces it

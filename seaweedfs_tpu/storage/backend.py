@@ -163,6 +163,7 @@ def load_backend_config(cfg: dict) -> None:
 
 
 def _ensure_builtin_factories() -> None:
+    from seaweedfs_tpu.storage import backend_dir  # noqa: F401
     from seaweedfs_tpu.storage import backend_s3  # noqa: F401
 
 

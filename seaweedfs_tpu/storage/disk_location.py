@@ -89,6 +89,13 @@ class DiskLocation:
         shard_sets: dict[tuple[str, int], list[int]] = {}
         for name in sorted(os.listdir(self.directory)):
             parsed = parse_ec_shard_file_name(name)
+            if parsed is None and name.endswith(".evf"):
+                # fully tiered EC volume: zero local .ec?? files, but
+                # the .evf + .ecx are enough to serve from the backend
+                p = parse_volume_file_name(name[:-4] + ".dat")
+                if p is not None:
+                    shard_sets.setdefault((p[0], p[1]), [])
+                continue
             if parsed is None:
                 continue
             collection, vid, shard = parsed

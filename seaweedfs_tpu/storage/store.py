@@ -261,7 +261,7 @@ class Store:
             return
         for sid in shard_ids:
             ev.unmount_shard(sid)
-        if not ev.shards:
+        if not ev.shards and getattr(ev, "remote", None) is None:
             for loc in self.locations:
                 loc.ec_volumes.pop(vid, None)
             # the whole EC volume left this node: its local quarantine
@@ -313,7 +313,11 @@ class Store:
                 hb.volumes.append(VolumeInfo.from_volume(v))
             for vid, ev in list(loc.ec_volumes.items()):
                 bits = 0
-                for sid in ev.shard_ids():  # type: ignore[attr-defined]
+                # serving ids = local mounts ∪ tiered remote shards: a
+                # fully tiered volume must keep routing to this node
+                # and must not read as "missing shards" to the repair
+                # scheduler (docs/TIERING.md)
+                for sid in ev.serving_shard_ids():  # type: ignore[attr-defined]
                     bits |= 1 << sid
                 hb.ec_shards.append(
                     EcShardInfo(vid, ev.collection, bits)  # type: ignore[attr-defined]

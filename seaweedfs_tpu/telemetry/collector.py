@@ -60,6 +60,12 @@ RULE_ADMISSION = AlertRule(
     "above the sustained-rate bound — a tenant is over budget or the "
     "node is saturated (docs/QOS.md)",
 )
+RULE_REPL_LAG = AlertRule(
+    "replication_lag", "warning", 0.0,
+    "cross-cluster replication consumer lag (uncommitted filer events "
+    "in the notification queue) above the bound — the remote cluster "
+    "is falling behind the local one (docs/TIERING.md)",
+)
 
 
 class ClusterCollector:
@@ -76,6 +82,7 @@ class ClusterCollector:
         span_p99_threshold_s: float = 2.0,
         repair_depth_threshold: int = 8,
         admission_reject_threshold: float = 1.0,
+        repl_lag_threshold: float = 1000.0,
     ):
         self.master = master
         self.interval = interval
@@ -90,6 +97,7 @@ class ClusterCollector:
         self.span_p99_threshold_s = span_p99_threshold_s
         self.repair_depth_threshold = repair_depth_threshold
         self.admission_reject_threshold = admission_reject_threshold
+        self.repl_lag_threshold = repl_lag_threshold
         self.alerts = AlertManager()
         self.targets: dict[str, TargetStore] = {}
         self._targets_lock = threading.Lock()
@@ -240,6 +248,18 @@ class ClusterCollector:
                 shed > self.admission_reject_threshold, shed,
                 f"{shed:.2f}/s requests shed by admission control "
                 f"over {w:.0f}s",
+            ))
+            # replication plane: the producer (filer) exposes the
+            # consumer group's queue depth as a gauge — a consumer
+            # that stalled (or was killed with WEED_REPL=0 and
+            # forgotten) shows up as monotonically growing lag
+            lag = ts.last_value("weed_replication_lag_events")
+            conds.append((
+                RULE_REPL_LAG, ts.url,
+                lag is not None and lag > self.repl_lag_threshold,
+                lag or 0.0,
+                f"{0 if lag is None else lag:.0f} filer event(s) behind "
+                f"(bound {self.repl_lag_threshold:.0f})",
             ))
         # master-local: the repair scheduler's tracked-damage depth
         depth = 0

@@ -1,0 +1,187 @@
+"""BandwidthArbiter (scrub/arbiter.py): ONE budget for every
+background byte-mover — rebuild, replication, handoff replay, tier
+transfers — with weighted max-min shares over ACTIVE claimants and the
+serve-first yield.
+
+The regression that motivated it (ROADMAP "repair/handoff
+arbitration" gap): a big hinted-handoff replay used to run unpaced
+against an EC rebuild racing a second shard loss. The contention test
+here proves a rebuild keeps making progress at roughly its weighted
+share while a replay storm runs flat out.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.scrub.arbiter import (
+    BandwidthArbiter,
+    arbiter_enabled,
+    get_arbiter,
+    set_arbiter,
+)
+
+
+class TestBasics:
+    def test_disabled_admits_immediately_but_still_counts(self, monkeypatch):
+        monkeypatch.setenv("WEED_ARBITER", "0")
+        a = BandwidthArbiter(total_bytes_s=10.0)
+        assert not a.enabled
+        t0 = time.monotonic()
+        for _ in range(50):
+            assert a.take("rebuild", 10_000_000)
+        assert time.monotonic() - t0 < 1.0  # no pacing at all
+        st = a.stats()
+        assert st["Claimants"]["rebuild"]["Bytes"] == 50 * 10_000_000
+        assert st["Claimants"]["rebuild"]["Takes"] == 50
+
+    def test_env_kill_switch_helper(self, monkeypatch):
+        monkeypatch.setenv("WEED_ARBITER", "0")
+        assert not arbiter_enabled()
+        monkeypatch.delenv("WEED_ARBITER")
+        assert arbiter_enabled()
+
+    def test_lone_claimant_gets_whole_budget(self):
+        # 1 MB/s total; a lone claimant charging 100 KB chunks should
+        # sustain ~the full rate, NOT its 45% weighted slice
+        a = BandwidthArbiter(total_bytes_s=1_000_000.0)
+        moved = 0
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 1.0:
+            assert a.take("rebuild", 100_000)
+            moved += 100_000
+        # generous bound: well above the 450 KB/s a wrongly-applied
+        # 45% weighted slice would allow, below the exact 1 MB/s
+        assert moved >= 700_000, f"lone claimant starved: {moved} B/s"
+
+    def test_take_charges_full_n_beyond_burst(self):
+        # an item larger than burst admits on burst but charges fully:
+        # two oversized takes must take >= n/rate seconds in total
+        a = BandwidthArbiter(total_bytes_s=1_000_000.0)
+        # admits once ~1 s of budget (the burst cap) accrues, but the
+        # full 2 MB is charged — leaving ~1 MB of debt behind
+        assert a.take("tier", 2_000_000)
+        t0 = time.monotonic()
+        assert a.take("tier", 100_000)
+        # the debt must drain first (~1 s at 1 MB/s; tier is alone so
+        # it owns the whole budget)
+        assert time.monotonic() - t0 > 0.8
+
+    def test_stop_event_aborts_wait_and_refunds(self):
+        a = BandwidthArbiter(total_bytes_s=1000.0)
+        stop = threading.Event()
+        assert a.take("handoff", 500_000)  # drains the budget deep
+        result = {}
+
+        def blocked():
+            result["r"] = a.take("handoff", 500_000, stop=stop)
+
+        th = threading.Thread(target=blocked)
+        th.start()
+        time.sleep(0.2)
+        stop.set()
+        th.join(timeout=5)
+        assert not th.is_alive()
+        assert result["r"] is False
+        # the aborted take refunded its byte count
+        assert a.stats()["Claimants"]["handoff"]["Bytes"] == 500_000
+
+    def test_unknown_claimant_gets_default_weight(self):
+        a = BandwidthArbiter(total_bytes_s=1_000_000.0)
+        assert a.take("mystery", 1)
+        assert "mystery" in a.stats()["Claimants"]
+
+    def test_get_set_roundtrip(self):
+        mine = BandwidthArbiter(total_bytes_s=123.0)
+        prev = set_arbiter(mine)
+        try:
+            assert get_arbiter() is mine
+        finally:
+            set_arbiter(prev)
+
+
+class TestServeFirstYield:
+    def test_note_serve_throttles_background(self):
+        a = BandwidthArbiter(
+            total_bytes_s=1_000_000.0,
+            yield_window_s=10.0,
+            yield_factor=0.1,
+        )
+        a.note_serve()
+        st = a.stats()
+        assert st["Serving"]
+        # every rate is multiplied down by the yield factor
+        assert (
+            st["Claimants"]["rebuild"]["RateBytesPerSec"]
+            <= 0.1 * 1_000_000.0 + 1
+        )
+
+    def test_yield_expires(self):
+        a = BandwidthArbiter(
+            total_bytes_s=1_000_000.0,
+            yield_window_s=0.05,
+            yield_factor=0.1,
+        )
+        a.note_serve()
+        time.sleep(0.1)
+        assert not a.stats()["Serving"]
+
+
+class TestContention:
+    @pytest.mark.slow
+    def test_rebuild_progresses_during_handoff_storm(self):
+        """THE regression: a rebuild sharing the arbiter with a
+        flat-out handoff replay still moves at least its weighted
+        share of bytes — the replay cannot starve it."""
+        a = BandwidthArbiter(
+            total_bytes_s=2_000_000.0,
+            yield_window_s=0.0,  # no serving in this test
+        )
+        stop = threading.Event()
+        moved = {"rebuild": 0, "handoff": 0}
+        lock = threading.Lock()
+
+        def mover(name, chunk):
+            while not stop.is_set():
+                if not a.take(name, chunk, stop=stop):
+                    return
+                with lock:
+                    moved[name] += chunk
+
+        threads = [
+            threading.Thread(target=mover, args=("handoff", 64_000)),
+            threading.Thread(target=mover, args=("rebuild", 64_000)),
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        # weights: rebuild 0.45 vs handoff 0.20 → under contention the
+        # rebuild share is 0.45/0.65 ≈ 69%. Bursts blur the edges, so
+        # assert the structural property loosely: rebuild got MORE
+        # than handoff, and at least a third of the total.
+        total = moved["rebuild"] + moved["handoff"]
+        assert total > 0
+        assert moved["rebuild"] > moved["handoff"], moved
+        assert moved["rebuild"] >= total / 3, moved
+
+    @pytest.mark.slow
+    def test_inactive_claimant_leaves_no_hole(self):
+        """A claimant that stops charging drops out of the share
+        denominator within the active window — the survivor's rate
+        recovers to ~the whole budget."""
+        a = BandwidthArbiter(total_bytes_s=1_000_000.0, yield_window_s=0.0)
+        assert a.take("handoff", 1)  # becomes active
+        assert a.take("rebuild", 1)
+        # both active: rebuild's share is weighted
+        shared = a.stats()["Claimants"]["rebuild"]["RateBytesPerSec"]
+        assert shared < 900_000
+        time.sleep(2.2)  # handoff goes inactive (window = 2 s)
+        a.take("rebuild", 1)
+        solo = a.stats()["Claimants"]["rebuild"]["RateBytesPerSec"]
+        assert solo >= 900_000, (shared, solo)

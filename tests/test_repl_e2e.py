@@ -1,0 +1,333 @@
+"""Cross-cluster replication as a standing workload (ISSUE 17): S3
+writes on a source cluster flow through the partitioned logqueue into
+a SECOND live cluster that serves them byte-identical — then the chaos
+legs: a network partition mid-replication (bounded failure, heal →
+convergence, no acked-write loss), replication lag racing the vacuum,
+the replication-lag SLO alert + `replication.lag` shell verb, and the
+WEED_REPL kill switch.
+"""
+
+from __future__ import annotations
+
+import io
+import urllib.error
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu import notification
+from seaweedfs_tpu.analysis.chaos import ProxyPair
+from seaweedfs_tpu.notification.logqueue import PartitionedLogQueue
+from seaweedfs_tpu.replication.replicate_runner import (
+    _consume_logqueue,
+    repl_enabled,
+    run_replicate,
+)
+from seaweedfs_tpu.replication.replicator import Replicator
+from seaweedfs_tpu.replication.sink import FilerSink
+from seaweedfs_tpu.replication.source import FilerSource
+from seaweedfs_tpu.s3api import S3ApiServer
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.command_env import CommandEnv
+from seaweedfs_tpu.shell.commands import run_command
+from seaweedfs_tpu.util import deadline as _deadline
+from seaweedfs_tpu.util.availability import free_port
+
+from tests.chaos import wait_for
+
+GROUP = "replicate"
+
+
+class _Cluster:
+    def __init__(self, tmp, name, telemetry=False):
+        self.master = MasterServer(
+            port=free_port(),
+            volume_size_limit_mb=64,
+            vacuum_interval=0,
+            telemetry_interval=0.4 if telemetry else 0.0,
+            telemetry_kwargs=(
+                {"repl_lag_threshold": 2.0} if telemetry else None
+            ),
+        )
+        self.master.start()
+        maddr = f"127.0.0.1:{self.master.port}"
+        self.vs = VolumeServer(
+            [str(tmp.mktemp(f"{name}vol"))],
+            port=free_port(),
+            master=maddr,
+            heartbeat_interval=0.2,
+            max_volume_counts=[20],
+        )
+        self.vs.start()
+        fport = free_port()
+        self.filer = FilerServer(
+            [maddr], port=fport, store="memory", announce_interval=0.3
+        )
+        self.filer.start()
+        self.filer_addr = f"127.0.0.1:{fport}"
+        assert wait_for(lambda: self.master.topology.data_nodes(), 45)
+
+    def stop(self):
+        self.filer.stop()
+        self.vs.stop()
+        self.master.stop()
+
+
+@pytest.fixture(scope="module")
+def repl_world(tmp_path_factory):
+    """src cluster (telemetry master, S3 gateway, logqueue-armed filer
+    — armed per-test) + dst cluster + the shared durable queue."""
+    lq = PartitionedLogQueue(
+        str(tmp_path_factory.mktemp("replq")), partitions=4
+    )
+    # the filer snapshots whether a notification queue exists when it
+    # is constructed — arm it around the SOURCE build only, so just
+    # the source publishes (the sink cluster must not echo applies
+    # back into the queue)
+    notification.queue = lq
+    src = _Cluster(tmp_path_factory, "src", telemetry=True)
+    notification.queue = None
+    dst = _Cluster(tmp_path_factory, "dst")
+    s3 = S3ApiServer(filer=src.filer_addr, port=free_port())
+    s3.start()
+    notification.queue = None
+    try:
+        yield lq, src, dst, s3
+    finally:
+        notification.queue = None
+        s3.stop()
+        dst.stop()
+        src.stop()
+
+
+class _armed:
+    """Route src-filer mutations into the logqueue for the duration."""
+
+    def __init__(self, lq):
+        self.lq = lq
+
+    def __enter__(self):
+        notification.queue = self.lq
+
+    def __exit__(self, *exc):
+        notification.queue = None
+
+
+def _req(url, method="GET", data=None, headers=None, timeout=15):
+    r = urllib.request.Request(url, data=data, method=method)
+    for k, v in (headers or {}).items():
+        r.add_header(k, v)
+    return urllib.request.urlopen(r, timeout=timeout)
+
+
+def _replicator(src, dst_addr):
+    return Replicator(
+        FilerSource(src.filer_addr, directory="/buckets"),
+        FilerSink(dst_addr, directory="/backup"),
+    )
+
+
+def _drain(lq, replicator, idle=0.5):
+    return _consume_logqueue(
+        lq, replicator, poll_interval=0.05, stop_after_idle=idle
+    )
+
+
+class TestS3WriteToRemoteCluster:
+    def test_write_flows_and_remote_serves_byte_identical(self, repl_world):
+        lq, src, dst, s3 = repl_world
+        body = bytes((i * 37) & 0xFF for i in range(120_000))
+        with _armed(lq):
+            _req(f"http://127.0.0.1:{s3.port}/replbkt", "PUT").close()
+            _req(
+                f"http://127.0.0.1:{s3.port}/replbkt/pic.bin", "PUT", data=body
+            ).close()
+        assert lq.depth(GROUP) >= 1
+        assert _drain(lq, _replicator(src, dst.filer_addr)) == 0
+        assert lq.depth(GROUP) == 0
+        # the REMOTE filer serves the object from its OWN volumes
+        with _req(f"http://{dst.filer_addr}/backup/replbkt/pic.bin") as r:
+            assert r.read() == body
+        # …including through a remote S3 gateway over the mirror tree
+        s3r = S3ApiServer(
+            filer=dst.filer_addr, port=free_port(), buckets_path="/backup"
+        )
+        s3r.start()
+        try:
+            with _req(f"http://127.0.0.1:{s3r.port}/replbkt/pic.bin") as r:
+                assert r.read() == body
+            with _req(
+                f"http://127.0.0.1:{s3r.port}/replbkt/pic.bin",
+                headers={"Range": "bytes=100-299"},
+            ) as r:
+                assert r.status == 206
+                assert r.read() == body[100:300]
+        finally:
+            s3r.stop()
+
+    def test_delete_propagates(self, repl_world):
+        lq, src, dst, s3 = repl_world
+        with _armed(lq):
+            _req(
+                f"http://127.0.0.1:{s3.port}/replbkt/gone.bin",
+                "PUT",
+                data=b"to-be-deleted",
+            ).close()
+        assert _drain(lq, _replicator(src, dst.filer_addr)) == 0
+        with _req(f"http://{dst.filer_addr}/backup/replbkt/gone.bin") as r:
+            assert r.read() == b"to-be-deleted"
+        with _armed(lq):
+            _req(
+                f"http://127.0.0.1:{s3.port}/replbkt/gone.bin", "DELETE"
+            ).close()
+        assert _drain(lq, _replicator(src, dst.filer_addr)) == 0
+        with pytest.raises(urllib.error.HTTPError):
+            _req(f"http://{dst.filer_addr}/backup/replbkt/gone.bin").close()
+
+    def test_kill_switch_leaves_queue_intact(self, repl_world, monkeypatch):
+        lq, src, dst, s3 = repl_world
+        with _armed(lq):
+            _req(
+                f"http://127.0.0.1:{s3.port}/replbkt/later.bin",
+                "PUT",
+                data=b"after-reenable",
+            ).close()
+        depth = lq.depth(GROUP)
+        assert depth >= 1
+        monkeypatch.setenv("WEED_REPL", "0")
+        assert not repl_enabled()
+        # the consumer refuses to run — and consumes NOTHING, so
+        # re-enabling later resumes from the committed cursor
+        assert run_replicate(stop_after_idle=0.2) == 0
+        assert lq.depth(GROUP) == depth
+        monkeypatch.delenv("WEED_REPL")
+        assert _drain(lq, _replicator(src, dst.filer_addr)) == 0
+        with _req(f"http://{dst.filer_addr}/backup/replbkt/later.bin") as r:
+            assert r.read() == b"after-reenable"
+
+
+class TestPartitionMidReplication:
+    def test_partition_stalls_then_heals_without_loss(self, repl_world):
+        lq, src, dst, s3 = repl_world
+        pair = ProxyPair(dst.filer_addr)
+        try:
+            repl = _replicator(src, pair.addr)
+            payloads = {
+                f"part{i}.bin": (f"partition-payload-{i} ".encode() * 500)
+                for i in range(3)
+            }
+            with _armed(lq):
+                for name, body in payloads.items():
+                    _req(
+                        f"http://127.0.0.1:{s3.port}/replbkt/{name}",
+                        "PUT",
+                        data=body,
+                    ).close()
+            pair.partition()
+            # the sink's gRPC calls derive their timeout from the
+            # ambient deadline — without it a blackholed connection
+            # would park the drain forever
+            with _deadline.scope(_deadline.Deadline.after(2.0)):
+                rc = _consume_logqueue(
+                    lq, repl, poll_interval=0.2, stop_after_idle=0.6
+                )
+            assert rc == 1  # stuck on failures, NOT clean-idle
+            assert lq.depth(GROUP) > 0  # lag is visible, nothing lost
+            pair.heal()
+            assert _drain(lq, repl, idle=1.0) == 0
+            assert lq.depth(GROUP) == 0
+            # every acked write survived the partition
+            for name, body in payloads.items():
+                with _req(
+                    f"http://{dst.filer_addr}/backup/replbkt/{name}"
+                ) as r:
+                    assert r.read() == body
+        finally:
+            pair.stop()
+
+
+class TestLagVersusVacuum:
+    def test_vacuum_during_lag_converges_without_acked_loss(self, repl_world):
+        lq, src, dst, s3 = repl_world
+        keep = b"survivor " * 3000
+        with _armed(lq):
+            _req(
+                f"http://{src.filer_addr}/buckets/vac/keep.bin",
+                "POST",
+                data=keep,
+            ).close()
+            _req(
+                f"http://{src.filer_addr}/buckets/vac/drop.bin",
+                "POST",
+                data=b"doomed " * 3000,
+            ).close()
+            # the replica is LAGGING (nothing drained yet) when the
+            # source deletes drop.bin and vacuums its chunks away
+            _req(
+                f"http://{src.filer_addr}/buckets/vac/drop.bin", "DELETE"
+            ).close()
+        env = CommandEnv([f"127.0.0.1:{src.master.port}"])
+        out = io.StringIO()
+        run_command(env, "volume.vacuum -garbageThreshold 0.0001", out)
+        # drain through the backlog: keep.bin must replicate intact;
+        # drop.bin's create event can no longer fetch its vacuumed
+        # chunks — it poisons out after the retry budget, then its
+        # delete event applies, and BOTH clusters converge without it
+        rc = _consume_logqueue(
+            lq,
+            _replicator(src, dst.filer_addr),
+            poll_interval=0.05,
+            stop_after_idle=4.0,
+        )
+        assert rc == 0
+        assert lq.depth(GROUP) == 0
+        with _req(f"http://{dst.filer_addr}/backup/vac/keep.bin") as r:
+            assert r.read() == keep
+        for filer, root in ((src.filer_addr, "/buckets"), (dst.filer_addr, "/backup")):
+            with pytest.raises(urllib.error.HTTPError):
+                _req(f"http://{filer}{root}/vac/drop.bin").close()
+
+
+class TestLagAlertAndShell:
+    def test_lag_gauge_alert_and_verb(self, repl_world):
+        lq, src, dst, s3 = repl_world
+        # stay armed for the whole test: the filer's /metrics prerender
+        # hook samples notification.queue's consumer-group depth at
+        # RENDER time, and the leader's collector scrapes on its own
+        # schedule
+        with _armed(lq):
+            for i in range(4):
+                _req(
+                    f"http://127.0.0.1:{s3.port}/replbkt/lag{i}.bin",
+                    "PUT",
+                    data=b"backlog",
+                ).close()
+            assert lq.depth(GROUP) >= 3
+            with _req(f"http://{src.filer_addr}/metrics") as r:
+                metrics = r.read().decode()
+            line = next(
+                ln for ln in metrics.splitlines()
+                if ln.startswith("weed_replication_lag_events")
+            )
+            assert float(line.rsplit(" ", 1)[1]) >= 3, line
+            # the leader's collector trips RULE_REPL_LAG past the bound
+            def alert_fired():
+                alerts = src.master.telemetry.alerts.payload()
+                return any(
+                    a.get("Alert") == "replication_lag"
+                    for a in alerts.get("Firing", [])
+                )
+            assert wait_for(alert_fired, 30), (
+                src.master.telemetry.alerts.payload()
+            )
+            env = CommandEnv([f"127.0.0.1:{src.master.port}"])
+            out = io.StringIO()
+            run_command(env, "replication.lag", out)
+            text = out.getvalue()
+            assert "event(s) behind" in text, text
+            assert "ALERT warning" in text, text
+            # drain → lag falls to zero and the alert clears
+            assert _drain(lq, _replicator(src, dst.filer_addr)) == 0
+            assert wait_for(lambda: not alert_fired(), 30)

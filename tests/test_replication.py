@@ -818,6 +818,40 @@ class TestPartitionedLogQueue:
             assert names == sorted(names), f"key {k} out of order"
         q.close()
 
+    def test_cursor_commit_survives_every_crash_state(self, tmp_path):
+        """Crash-enumerator sweep of the consumer-cursor publish: a
+        crash anywhere inside commit() must leave the offsets file at
+        the OLD or the NEW cursor. The cursor rides util/durable
+        (write tmp → fsync → rename); a raw-rename regression would
+        surface an empty-file state here, which committed() parses as
+        0 — silently restarting the whole group."""
+        from seaweedfs_tpu.analysis import crash
+
+        q = self._mk(tmp_path, partitions=1)
+        for i in range(10):
+            q.send_message("/k", self._event(f"m{i}"))
+        assert len(q.poll("g", max_records=10)) == 10
+        q.commit("g", 0, 5)  # the settled cursor the crash must keep
+        assert q.committed("g", 0) == 5
+
+        offsets_dir = os.path.join(str(tmp_path / "q"), "p000", "offsets")
+        rec = crash.Recorder(offsets_dir)
+        with rec:
+            q.commit("g", 0, 9)
+        states, truncated, _n = crash.enumerate_states(rec.trace, budget=256)
+        assert not truncated
+        assert states
+        seen = set()
+        for s in states:
+            cur = s.files.get("g")
+            assert cur in (b"5", b"9"), (
+                f"torn cursor {cur!r} at crash index {s.crash_index}"
+            )
+            seen.add(cur)
+        # both the kept-old and published-new outcomes are reachable
+        assert seen == {b"5", b"9"}
+        q.close()
+
 
 class TestKafkaWireProtocol:
     """The library-free Kafka client (notification/kafka.py) against the

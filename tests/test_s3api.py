@@ -388,6 +388,146 @@ class TestS3EndToEnd:
         assert e.value.code == 404
 
 
+def _error_code(exc: urllib.error.HTTPError) -> str:
+    return xml_of(exc.read()).findtext("Code")
+
+
+class TestMultipartHardening:
+    """Typed multipart errors (filer_multipart.go semantics): abort is
+    NoSuchUpload for unknown ids and reclaims staged chunks; complete
+    validates the client manifest — ascending order, staged parts,
+    matching ETags — instead of silently splicing whatever exists."""
+
+    def _initiate(self, base, bucket, key) -> str:
+        req(f"{base}/{bucket}", "PUT").close()
+        root = xml_of(
+            req(f"{base}/{bucket}/{key}?uploads=", "POST", data=b"").read()
+        )
+        return root.findtext("UploadId")
+
+    def _put_part(self, base, bucket, key, upload_id, num, data) -> str:
+        with req(
+            f"{base}/{bucket}/{key}?partNumber={num}&uploadId={upload_id}",
+            "PUT",
+            data=data,
+        ) as r:
+            return r.headers["ETag"]
+
+    @staticmethod
+    def _manifest(parts) -> bytes:
+        root = ET.Element("CompleteMultipartUpload")
+        for num, etag in parts:
+            p = ET.SubElement(root, "Part")
+            ET.SubElement(p, "PartNumber").text = str(num)
+            ET.SubElement(p, "ETag").text = etag
+        return ET.tostring(root)
+
+    def test_abort_unknown_upload_is_nosuchupload(self, s3stack):
+        _, base = s3stack
+        req(f"{base}/mph0", "PUT").close()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req(f"{base}/mph0/f?uploadId=deadbeef", "DELETE")
+        assert e.value.code == 404
+        assert _error_code(e.value) == "NoSuchUpload"
+
+    def test_abort_cleans_staged_parts(self, s3stack):
+        _, base = s3stack
+        uid = self._initiate(base, "mph1", "f.bin")
+        self._put_part(base, "mph1", "f.bin", uid, 1, b"staged" * 1000)
+        with req(f"{base}/mph1/f.bin?uploadId={uid}", "DELETE") as r:
+            assert r.status == 204
+        # staging dir is gone: the uploads listing is empty and the
+        # same id can be neither listed nor completed nor re-aborted
+        root = xml_of(req(f"{base}/mph1?uploads=").read())
+        assert list(root.iter("Upload")) == []
+        for method, path in (
+            ("GET", f"{base}/mph1/f.bin?uploadId={uid}"),
+            ("DELETE", f"{base}/mph1/f.bin?uploadId={uid}"),
+        ):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                req(path, method)
+            assert _error_code(e.value) == "NoSuchUpload"
+
+    def test_complete_manifest_happy_path(self, s3stack):
+        _, base = s3stack
+        uid = self._initiate(base, "mph2", "ok.bin")
+        p1, p2 = b"1" * 2048, b"2" * 1024
+        e1 = self._put_part(base, "mph2", "ok.bin", uid, 1, p1)
+        e2 = self._put_part(base, "mph2", "ok.bin", uid, 2, p2)
+        # the part PUT response carries the md5 ETag real clients echo
+        assert e1 == f'"{hashlib.md5(p1).hexdigest()}"'
+        root = xml_of(
+            req(
+                f"{base}/mph2/ok.bin?uploadId={uid}",
+                "POST",
+                data=self._manifest([(1, e1), (2, e2)]),
+            ).read()
+        )
+        assert root.tag == "CompleteMultipartUploadResult"
+        assert req(f"{base}/mph2/ok.bin").read() == p1 + p2
+
+    def test_complete_out_of_order_manifest_rejected(self, s3stack):
+        _, base = s3stack
+        uid = self._initiate(base, "mph3", "ooo.bin")
+        e1 = self._put_part(base, "mph3", "ooo.bin", uid, 1, b"a" * 100)
+        e2 = self._put_part(base, "mph3", "ooo.bin", uid, 2, b"b" * 100)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req(
+                f"{base}/mph3/ooo.bin?uploadId={uid}",
+                "POST",
+                data=self._manifest([(2, e2), (1, e1)]),
+            )
+        assert e.value.code == 400
+        assert _error_code(e.value) == "InvalidPartOrder"
+
+    def test_complete_missing_part_rejected(self, s3stack):
+        _, base = s3stack
+        uid = self._initiate(base, "mph4", "gap.bin")
+        e1 = self._put_part(base, "mph4", "gap.bin", uid, 1, b"x" * 100)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req(
+                f"{base}/mph4/gap.bin?uploadId={uid}",
+                "POST",
+                data=self._manifest([(1, e1), (7, '"feedface"')]),
+            )
+        assert _error_code(e.value) == "InvalidPart"
+
+    def test_complete_wrong_etag_rejected(self, s3stack):
+        _, base = s3stack
+        uid = self._initiate(base, "mph5", "etag.bin")
+        self._put_part(base, "mph5", "etag.bin", uid, 1, b"y" * 100)
+        wrong = f'"{hashlib.md5(b"other bytes").hexdigest()}"'
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req(
+                f"{base}/mph5/etag.bin?uploadId={uid}",
+                "POST",
+                data=self._manifest([(1, wrong)]),
+            )
+        assert _error_code(e.value) == "InvalidPart"
+
+    def test_complete_malformed_xml_rejected(self, s3stack):
+        _, base = s3stack
+        uid = self._initiate(base, "mph6", "bad.bin")
+        self._put_part(base, "mph6", "bad.bin", uid, 1, b"z" * 100)
+        for body in (b"<CompleteMultipartUpload><Part>", b"\x00\x01notxml"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                req(
+                    f"{base}/mph6/bad.bin?uploadId={uid}", "POST", data=body
+                )
+            assert e.value.code == 400
+            assert _error_code(e.value) == "MalformedXML"
+        # a non-integer PartNumber is malformed too
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req(
+                f"{base}/mph6/bad.bin?uploadId={uid}",
+                "POST",
+                data=b"<CompleteMultipartUpload><Part>"
+                b"<PartNumber>one</PartNumber></Part>"
+                b"</CompleteMultipartUpload>",
+            )
+        assert _error_code(e.value) == "MalformedXML"
+
+
 @pytest.fixture(scope="module")
 def secured_s3(tmp_path_factory):
     mport = free_port()

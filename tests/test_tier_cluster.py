@@ -1,0 +1,322 @@
+"""Live-cluster tiering acceptance (ISSUE 17 tentpole): a real
+master + 3 volume servers + filer + S3 gateway, EC-encoded keysets
+tiered out to the local-dir backend fake and recalled — degraded and
+range GETs served from the backend in between, every holder streaming
+its OWN shards, cross-holder fetches riding VolumeEcShardRead's
+remote fallback. Plus the WEED_TIER=0 kill switch, the master-side
+TierScheduler driving moves from rules, and the operator shell verbs.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.s3api import S3ApiServer
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.command_env import CommandEnv
+from seaweedfs_tpu.shell.commands import do_ec_encode, run_command
+from seaweedfs_tpu.tier import TierRules, TierScheduler
+from seaweedfs_tpu.util.availability import free_port, write_keyset
+
+from tests.chaos import wait_for
+
+BACKEND = "dir.clu"
+
+
+@pytest.fixture(scope="module")
+def tier_cluster(tmp_path_factory):
+    backend_dir = str(tmp_path_factory.mktemp("tierbk"))
+    storage_cfg = {"dir": {"clu": {"enabled": True, "dir": backend_dir}}}
+    master = MasterServer(
+        port=free_port(), volume_size_limit_mb=64, vacuum_interval=0
+    )
+    master.start()
+    maddr = f"127.0.0.1:{master.port}"
+    servers = []
+    for i in range(3):
+        vs = VolumeServer(
+            [str(tmp_path_factory.mktemp(f"tiervol{i}"))],
+            port=free_port(),
+            master=maddr,
+            rack=f"rack{i % 2}",
+            heartbeat_interval=0.2,
+            max_volume_counts=[100],
+            ec_codec="cpu",
+            storage_backends=storage_cfg,
+        )
+        vs.start()
+        servers.append(vs)
+    fport = free_port()
+    filer = FilerServer([maddr], port=fport, store="memory", max_mb=1)
+    filer.start()
+    s3 = S3ApiServer(filer=f"127.0.0.1:{fport}", port=free_port())
+    s3.start()
+    assert wait_for(lambda: len(master.topology.data_nodes()) == 3, 45)
+    yield master, servers, s3, backend_dir
+    s3.stop()
+    filer.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _post_json(url: str, timeout: float = 120.0) -> dict:
+    req = urllib.request.Request(url, method="POST", data=b"")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get_json(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _holders(servers, vid):
+    return [s for s in servers if s.store.find_ec_volume(vid) is not None]
+
+
+def _registered_shards(master, vid):
+    locs = master.topology.lookup_ec_shards(vid)
+    if locs is None:
+        return 0
+    return sum(1 for nodes in locs.locations if nodes)
+
+
+def _encode(master, collection, n=8):
+    vid, keys, _src = write_keyset(
+        master.port,
+        collection,
+        n=n,
+        payload_fn=lambda i: (f"{collection} {i} ".encode() * 2500)[: 15000 + i],
+    )
+    env = CommandEnv([f"127.0.0.1:{master.port}"])
+    do_ec_encode(env, vid, collection, io.StringIO())
+    assert wait_for(lambda: _registered_shards(master, vid) == 14, 30)
+    return vid, keys, env
+
+
+def _tier_out_everywhere(servers, vid):
+    moved = 0
+    for vs in _holders(servers, vid):
+        ev = vs.store.find_ec_volume(vid)
+        if not ev.shards:
+            continue
+        res = _post_json(
+            f"http://{vs.host}:{vs.port}/tier/move"
+            f"?volumeId={vid}&direction=out&destination={BACKEND}"
+        )
+        assert res.get("Backend") == BACKEND, res
+        moved += len(res.get("Shards") or [])
+    return moved
+
+
+def _read_all(master, collection, keys):
+    for fid, want in keys.items():
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{master.port}/{fid}?collection={collection}",
+            timeout=15,
+        ) as r:
+            assert r.read() == want, f"fid {fid} corrupt"
+
+
+class TestManualTierMoves:
+    def test_out_degraded_reads_then_in(self, tier_cluster):
+        master, servers, _s3, backend_dir = tier_cluster
+        vid, keys, env = _encode(master, "tiered")
+
+        assert _tier_out_everywhere(servers, vid) == 14
+        for vs in _holders(servers, vid):
+            ev = vs.store.find_ec_volume(vid)
+            assert ev.shards == {} and ev.remote is not None
+            st = _get_json(f"http://{vs.host}:{vs.port}/tier/status")
+            assert st[str(vid)]["Tiered"]
+        assert len(os.listdir(backend_dir)) >= 14
+        # the master still routes every shard (serving_shard_ids rides
+        # the heartbeat) — no repair stampede for a tiered volume
+        assert wait_for(lambda: _registered_shards(master, vid) == 14, 15)
+
+        # every GET is now a degraded read spliced out of backend
+        # sub-range fetches — local AND cross-holder (gRPC fallback)
+        _read_all(master, "tiered", keys)
+
+        # operator surface agrees
+        out = io.StringIO()
+        run_command(env, "tier.status", out)
+        assert "TIERED" in out.getvalue()
+        assert BACKEND in out.getvalue()
+
+        # recall through the shell verb; bytes identical, keys reclaimed
+        out = io.StringIO()
+        run_command(env, f"tier.move -volumeId {vid} -in", out)
+        assert "FAILED" not in out.getvalue()
+        for vs in _holders(servers, vid):
+            ev = vs.store.find_ec_volume(vid)
+            assert ev.remote is None and ev.shards
+        _read_all(master, "tiered", keys)
+
+    def test_kill_switch_forbids_moves(self, tier_cluster, monkeypatch):
+        master, servers, _s3, _bd = tier_cluster
+        vid, keys, _env = _encode(master, "killsw")
+        monkeypatch.setenv("WEED_TIER", "0")
+        vs = _holders(servers, vid)[0]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_json(
+                f"http://{vs.host}:{vs.port}/tier/move"
+                f"?volumeId={vid}&direction=out&destination={BACKEND}"
+            )
+        assert e.value.code == 403
+        # the scheduler is inert too
+        sched = TierScheduler(
+            master,
+            interval=3600,
+            rules=TierRules(backend=BACKEND, min_age_s=0.0,
+                            cold_reads_per_s=1e9),
+        )
+        assert sched.scan_once() == 0
+        monkeypatch.delenv("WEED_TIER")
+        # pre-tier behavior wholesale: plain local reads, nothing moved
+        for vs in _holders(servers, vid):
+            assert vs.store.find_ec_volume(vid).remote is None
+        _read_all(master, "killsw", keys)
+
+    def test_bad_requests_are_typed(self, tier_cluster):
+        master, servers, _s3, _bd = tier_cluster
+        vs = servers[0]
+        base = f"http://{vs.host}:{vs.port}/tier/move"
+        for qs, code in (
+            ("volumeId=abc&direction=out&destination=d", 400),
+            ("volumeId=123456&direction=sideways", 400),
+            ("volumeId=123456&direction=out", 400),  # no destination
+            ("volumeId=123456&direction=in", 404),  # unknown volume
+        ):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post_json(f"{base}?{qs}")
+            assert e.value.code == code, qs
+
+
+class TestTierScheduler:
+    def test_scan_tiers_cold_volume_and_reports(self, tier_cluster):
+        master, servers, _s3, _bd = tier_cluster
+        vid, keys, _env = _encode(master, "coldsched")
+        # every volume is "cold" under these rules (no telemetry →
+        # rate 0.0; min age 0) — the scheduler has no collection
+        # filter, so it sweeps EVERY volume in the shared cluster; the
+        # concurrency cap must cover all (holder, vid) pairs or the
+        # target vid's moves get deferred to a later scan
+        sched = TierScheduler(
+            master,
+            interval=3600,
+            rules=TierRules(
+                backend=BACKEND,
+                min_age_s=0.0,
+                cold_reads_per_s=1e9,
+                hot_reads_per_s=1e12,
+            ),
+            concurrency=32,
+            cooldown_s=0.0,
+        )
+        master.tier = sched
+        try:
+            launched = sched.scan_once()
+            assert launched >= 1
+            assert wait_for(
+                lambda: all(
+                    vs.store.find_ec_volume(vid).remote is not None
+                    and not vs.store.find_ec_volume(vid).shards
+                    for vs in _holders(servers, vid)
+                ),
+                60,
+            ), sched.status_snapshot()
+            assert wait_for(lambda: sched.status_snapshot()["Active"] == 0, 30)
+            snap = _get_json(
+                f"http://127.0.0.1:{master.port}/cluster/tier"
+            )
+            assert snap["MovesStarted"] >= 1
+            assert snap["Rules"]["Backend"] == BACKEND
+            assert any(h["Direction"] == "out" for h in snap["History"])
+            assert not any(h["Error"] for h in snap["History"]), snap
+            # reads still serve, now from the backend
+            _read_all(master, "coldsched", keys)
+            # scans converge: once everything cold is tiered, a fresh
+            # scan is a no-op (hysteresis holds tiered volumes put)
+            time.sleep(0.1)
+            assert wait_for(
+                lambda: sched.scan_once() == 0
+                and sched.status_snapshot()["Active"] == 0,
+                60,
+            ), sched.status_snapshot()
+        finally:
+            master.tier = None
+
+    def test_cluster_tier_endpoint_disabled_by_default(self, tier_cluster):
+        master, _servers, _s3, _bd = tier_cluster
+        snap = _get_json(f"http://127.0.0.1:{master.port}/cluster/tier")
+        assert snap.get("Disabled") is True
+
+
+class TestS3RangeOnTieredVolume:
+    def _req(self, url, method="GET", data=None, headers=None):
+        r = urllib.request.Request(url, data=data, method=method)
+        for k, v in (headers or {}).items():
+            r.add_header(k, v)
+        return urllib.request.urlopen(r, timeout=20)
+
+    def test_range_reads_206_through_tier_cycle(self, tier_cluster):
+        master, servers, s3, _bd = tier_cluster
+        base = f"http://127.0.0.1:{s3.port}"
+        body = bytes(
+            (i * 131 + (i >> 8)) & 0xFF for i in range(300_000)
+        )  # 300 KB → several filer chunks at max_mb=1? no — but >1 needle span
+        self._req(f"{base}/tierbkt", "PUT").close()
+        self._req(f"{base}/tierbkt/blob.bin", "PUT", data=body).close()
+
+        entry = s3._lookup(f"{s3.buckets_path}/tierbkt", "blob.bin")
+        assert entry is not None and entry.chunks
+        vids = {int(c.fid.split(",")[0]) for c in entry.chunks}
+        env = CommandEnv([f"127.0.0.1:{master.port}"])
+        for vid in vids:
+            do_ec_encode(env, vid, "", io.StringIO())
+            assert wait_for(lambda: _registered_shards(master, vid) == 14, 30)
+            assert _tier_out_everywhere(servers, vid) == 14
+
+        def check_ranges():
+            with self._req(
+                f"{base}/tierbkt/blob.bin",
+                headers={"Range": "bytes=1000-2999"},
+            ) as r:
+                assert r.status == 206
+                assert r.read() == body[1000:3000]
+                assert r.headers["Content-Range"] == (
+                    f"bytes 1000-2999/{len(body)}"
+                )
+            # a tail range crossing needle-chunk boundaries
+            with self._req(
+                f"{base}/tierbkt/blob.bin",
+                headers={"Range": f"bytes={len(body) - 5000}-"},
+            ) as r:
+                assert r.status == 206
+                assert r.read() == body[-5000:]
+            with self._req(f"{base}/tierbkt/blob.bin") as r:
+                assert r.status == 200
+                assert r.read() == body
+
+        check_ranges()  # served degraded, from the tier backend
+
+        for vid in vids:
+            for vs in _holders(servers, vid):
+                if vs.store.find_ec_volume(vid).remote is None:
+                    continue
+                _post_json(
+                    f"http://{vs.host}:{vs.port}/tier/move"
+                    f"?volumeId={vid}&direction=in"
+                )
+        check_ranges()  # byte-identical again after recall

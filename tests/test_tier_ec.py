@@ -1,0 +1,269 @@
+"""EC volume tiering (seaweedfs_tpu/tier/, docs/TIERING.md): lifecycle
+rules, the store-level tier-out/tier-in engine against the local-dir
+backend fake, crash/restart discovery through the durable ``.evf``
+sidecar, CRC verification against the ``.ecc`` scrub sidecar on
+recall, and chaos-backend degradation (an erroring backend must
+degrade reads, never quarantine local state).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import pytest
+
+from seaweedfs_tpu.ec import ec_files
+from seaweedfs_tpu.ec.codec import new_encoder
+from seaweedfs_tpu.ec.ec_volume import NotEnoughShards
+from seaweedfs_tpu.ec.ecc_sidecar import write_sidecar
+from seaweedfs_tpu.stats.metrics import (
+    TIER_REMOTE_READ_ERRORS,
+    TIER_REMOTE_READS,
+)
+from seaweedfs_tpu.storage import backend as bk
+from seaweedfs_tpu.storage.backend_chaos import BackendFault, ChaosBackendStorage
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.tier import TierRules, tier_enabled
+from seaweedfs_tpu.tier.ec_tier import (
+    tier_in_ec,
+    tier_out_ec,
+    tier_status,
+    tiered_volume_count,
+)
+from seaweedfs_tpu.util.crc import crc32c
+
+VID = 7
+
+
+def _file_crc(path):
+    with open(path, "rb") as f:
+        return crc32c(f.read())
+
+
+def _ec_store(tmp_path, n_needles=30, vid=VID, seed=11, with_ecc=True):
+    """Sealed EC volume on disk (no .dat/.idx), loaded into a Store —
+    the test_ec_degraded fixture pattern plus the .ecc sidecar the
+    tier-in CRC gate verifies against."""
+    d = str(tmp_path / "vols")
+    os.makedirs(d, exist_ok=True)
+    v = Volume(d, vid)
+    rng = random.Random(seed)
+    payload = {}
+    for k in range(1, n_needles + 1):
+        data = bytes(rng.randbytes(rng.randint(500, 4000)))
+        payload[k] = data
+        v.write_needle(Needle(cookie=0x12345678, id=k, data=data))
+    v.close()
+    base = os.path.join(d, str(vid))
+    ec_files.write_ec_files(base, rs=new_encoder(backend="cpu"))
+    ec_files.write_sorted_file_from_idx(base)
+    os.remove(base + ".dat")
+    os.remove(base + ".idx")
+    if with_ecc:
+        crcs = {
+            sid: _file_crc(base + ec_files.to_ext(sid)) for sid in range(14)
+        }
+        write_sidecar(base, crcs)
+    store = Store([d], ec_backend="cpu")
+    assert store.find_ec_volume(vid) is not None
+    return store, payload, base
+
+
+def _dir_backend(tmp_path, instance_id):
+    """Register a local-dir backend fake under a test-unique instance
+    id (BACKEND_STORAGES is process-global)."""
+    bdir = str(tmp_path / f"backend_{instance_id}")
+    os.makedirs(bdir, exist_ok=True)
+    bk.ensure_builtin_factories()
+    bk.load_backend_config({"dir": {instance_id: {"enabled": True, "dir": bdir}}})
+    return f"dir.{instance_id}", bdir
+
+
+# ---------------------------------------------------------------------------
+class TestTierRules:
+    def test_hysteresis(self):
+        r = TierRules(min_age_s=100.0, cold_reads_per_s=0.1, hot_reads_per_s=1.0)
+        # young or warm → stay put
+        assert r.decide(age_s=50.0, reads_per_s=0.0, tiered=False) is None
+        assert r.decide(age_s=500.0, reads_per_s=0.5, tiered=False) is None
+        # old AND cold → out
+        assert r.decide(age_s=500.0, reads_per_s=0.05, tiered=False) == "out"
+        # tiered stays tiered through the dead band…
+        assert r.decide(age_s=500.0, reads_per_s=0.5, tiered=True) is None
+        # …and only recalls once genuinely hot
+        assert r.decide(age_s=500.0, reads_per_s=2.0, tiered=True) == "in"
+
+    def test_no_backend_means_no_tier_out(self):
+        r = TierRules(backend="", min_age_s=0.0, cold_reads_per_s=10.0)
+        # decide() is pure policy; the scheduler refuses to act without
+        # a backend — mirror that contract here via from_env default
+        assert r.backend == ""
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("WEED_TIER_BACKEND", "dir.cold")
+        monkeypatch.setenv("WEED_TIER_MIN_AGE_S", "42")
+        monkeypatch.setenv("WEED_TIER_COLD_RPS", "0.5")
+        monkeypatch.setenv("WEED_TIER_HOT_RPS", "3")
+        r = TierRules.from_env()
+        assert r.backend == "dir.cold"
+        assert r.min_age_s == 42.0
+        assert r.cold_reads_per_s == 0.5
+        assert r.hot_reads_per_s == 3.0
+        assert r.to_dict()["Backend"] == "dir.cold"
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("WEED_TIER", "0")
+        assert not tier_enabled()
+        monkeypatch.delenv("WEED_TIER")
+        assert tier_enabled()
+
+
+# ---------------------------------------------------------------------------
+class TestEcTierRoundTrip:
+    def test_out_then_in_byte_identical(self, tmp_path):
+        store, payload, base = _ec_store(tmp_path)
+        name, bdir = _dir_backend(tmp_path, "rt1")
+        before = {
+            sid: _file_crc(base + ec_files.to_ext(sid)) for sid in range(14)
+        }
+
+        res = tier_out_ec(store, VID, name)
+        assert res["Shards"] == list(range(14))
+        assert res["Bytes"] > 0
+        ev = store.find_ec_volume(VID)
+        assert ev.remote is not None
+        assert ev.shards == {}  # local shard files gone…
+        assert not any(
+            os.path.exists(base + ec_files.to_ext(s)) for s in range(14)
+        )
+        assert os.path.exists(base + ".evf")  # …but the commit record
+        assert os.path.exists(base + ".ecx")  # and the index stayed
+        assert tiered_volume_count(store) == 1
+        st = tier_status(store)[str(VID)]
+        assert st["Tiered"] and st["Backend"] == name
+        assert st["LocalShards"] == [] and st["RemoteShards"] == list(range(14))
+        # the heartbeat keeps advertising every shard
+        assert ev.serving_shard_ids() == list(range(14))
+
+        # reads now stream sub-ranges from the backend
+        r0 = TIER_REMOTE_READS.value()
+        for k, data in payload.items():
+            assert bytes(ev.read_needle(k).data) == data
+        assert TIER_REMOTE_READS.value() > r0
+
+        res = tier_in_ec(store, VID)
+        assert sorted(res["Shards"]) == list(range(14))
+        assert ev.remote is None
+        assert not os.path.exists(base + ".evf")
+        for sid in range(14):
+            assert _file_crc(base + ec_files.to_ext(sid)) == before[sid]
+        # remote keys were reclaimed
+        assert [f for f in os.listdir(bdir) if not f.endswith(".part")] == []
+        for k, data in payload.items():
+            assert bytes(ev.read_needle(k).data) == data
+
+    def test_short_circuits_and_unknown_backend(self, tmp_path):
+        store, _, _ = _ec_store(tmp_path)
+        name, _ = _dir_backend(tmp_path, "sc1")
+        with pytest.raises(ValueError, match="not configured"):
+            tier_out_ec(store, VID, "dir.no-such-instance")
+        with pytest.raises(ValueError, match="not found"):
+            tier_out_ec(store, 999, name)
+        assert tier_in_ec(store, VID) == {"VolumeId": VID, "NotTiered": True}
+        tier_out_ec(store, VID, name)
+        assert tier_out_ec(store, VID, name) == {
+            "VolumeId": VID,
+            "AlreadyTiered": True,
+        }
+
+    def test_restart_discovers_tiered_volume(self, tmp_path):
+        store, payload, base = _ec_store(tmp_path)
+        name, _ = _dir_backend(tmp_path, "rs1")
+        tier_out_ec(store, VID, name)
+        # a fresh Store over the same directory (process restart) must
+        # adopt the .evf and keep serving from the backend
+        store2 = Store([os.path.dirname(base)], ec_backend="cpu")
+        ev2 = store2.find_ec_volume(VID)
+        assert ev2 is not None and ev2.remote is not None
+        assert ev2.remote.backend_name == name
+        for k, data in payload.items():
+            assert bytes(ev2.read_needle(k).data) == data
+        # and recall works from the adopted attachment too
+        tier_in_ec(store2, VID)
+        assert store2.find_ec_volume(VID).remote is None
+
+    def test_tier_in_rejects_corrupt_backend_copy(self, tmp_path):
+        store, _, base = _ec_store(tmp_path)
+        name, bdir = _dir_backend(tmp_path, "crc1")
+        tier_out_ec(store, VID, name)
+        ev = store.find_ec_volume(VID)
+        # rot one remote object behind the backend's back
+        key = ev.remote.shards[3]["key"]
+        path = os.path.join(bdir, key)
+        with open(path, "r+b") as f:
+            f.seek(100)
+            b = f.read(1)
+            f.seek(100)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(IOError, match="CRC mismatch"):
+            tier_in_ec(store, VID)
+        # the attachment survives the failed recall (remote copy is
+        # still the only copy of the healthy shards) and no .tierin
+        # temp files leak
+        assert ev.remote is not None
+        assert os.path.exists(base + ".evf")
+        assert not any(
+            f.endswith(".tierin")
+            for f in os.listdir(os.path.dirname(base))
+        )
+
+
+# ---------------------------------------------------------------------------
+class TestChaosBackend:
+    def test_erroring_backend_degrades_then_heals(self, tmp_path):
+        store, payload, _ = _ec_store(tmp_path)
+        name, _ = _dir_backend(tmp_path, "chaos1")
+        tier_out_ec(store, VID, name)
+        ev = store.find_ec_volume(VID)
+        inner = bk.get_backend(name)
+        chaos = ChaosBackendStorage(
+            inner, faults=[BackendFault("eio", ops=("read",))]
+        )
+        bk.register_backend(chaos)  # shim takes over the name
+        try:
+            k = next(iter(payload))
+            e0 = TIER_REMOTE_READ_ERRORS.value()
+            # zero local shards + no peer fetcher + EIO backend: the
+            # read degrades to NotEnoughShards — it must NOT quarantine
+            # or drop the volume
+            with pytest.raises(NotEnoughShards):
+                ev.read_needle(k)
+            assert TIER_REMOTE_READ_ERRORS.value() > e0
+            assert chaos.raised > 0
+            assert store.find_ec_volume(VID) is ev  # still mounted
+            assert ev.remote is not None  # attachment untouched
+            chaos.heal()
+            assert bytes(ev.read_needle(k).data) == payload[k]
+        finally:
+            bk.register_backend(inner)
+
+    def test_slow_backend_still_serves(self, tmp_path):
+        store, payload, _ = _ec_store(tmp_path, n_needles=5)
+        name, _ = _dir_backend(tmp_path, "chaos2")
+        tier_out_ec(store, VID, name)
+        ev = store.find_ec_volume(VID)
+        inner = bk.get_backend(name)
+        bk.register_backend(
+            ChaosBackendStorage(
+                inner,
+                faults=[BackendFault("slow", ops=("read",), delay_s=0.02)],
+            )
+        )
+        try:
+            for k, data in payload.items():
+                assert bytes(ev.read_needle(k).data) == data
+        finally:
+            bk.register_backend(inner)
