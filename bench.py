@@ -1854,6 +1854,328 @@ def bench_serve() -> None:
         )
 
 
+def bench_serve_floor() -> None:
+    """Syscall-floor serving edge (docs/SERVING.md, BENCH_r15).
+
+    Four metric families for the PR-15 acceptance:
+
+      serve_floor_hot / serve_floor_304 — syscalls per hot GET,
+          measured EXTERNALLY: an LD_PRELOAD shim (native/syscount.c)
+          counts every libc syscall wrapper in a quiet single-server
+          process while one keep-alive connection runs a closed-loop
+          window. The designed floor is 3 (epoll_wait + recv + one
+          writev'd reply — sendmsg — with the plan served from the C
+          fd/offset cache); the 304 window revalidates with
+          If-None-Match and must hit the same floor.
+      serve_cond_epoll/threaded — 50% conditional-GET mix through a
+          CLI cluster: ratio_304 plus the C fast-path hit ratio
+          scraped from /status ServeStats (>=90% required).
+      serve_flagged_epoll/threaded — mime-flagged keyset (pre-rendered
+          header path): same hit-ratio bar.
+      serve_adm_shared — volume lead + 2 SO_REUSEPORT workers charging
+          ONE mmap'd admission bucket: the measured global admitted
+          rate must sit within +/-10% of -admissionRate no matter how
+          the kernel spreads the connections.
+    """
+    import signal
+    import socket as _socket
+    import subprocess
+    import tempfile
+    import urllib.request as _rq
+
+    from seaweedfs_tpu.telemetry.weedload import run_get_fan, seed_keys
+
+    # ---------------- part A: syscalls per GET (LD_PRELOAD shim) ----
+    native_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "seaweedfs_tpu", "native"
+    )
+    workdir = tempfile.mkdtemp(prefix="weedfloor")
+    shim = os.path.join(workdir, "syscount.so")
+    try:
+        subprocess.run(
+            ["cc", "-O2", "-Wall", "-Wextra", "-Werror", "-shared",
+             "-fPIC", "-o", shim, os.path.join(native_dir, "syscount.c"),
+             "-ldl"],
+            check=True, capture_output=True,
+        )
+        srv_script = (
+            "import json, tempfile, threading, time\n"
+            "from seaweedfs_tpu.server.volume_server import VolumeServer\n"
+            "from seaweedfs_tpu.storage.file_id import"
+            " format_needle_id_cookie\n"
+            "from seaweedfs_tpu.storage.needle import Needle\n"
+            "from seaweedfs_tpu.util.httpd import WeedHTTPServer\n"
+            "d = tempfile.mkdtemp()\n"
+            "vs = VolumeServer([d], port=0, scrub_interval=0)\n"
+            "vs.store.add_volume(1, '', '000', '')\n"
+            "v = vs.store.find_volume(1)\n"
+            "n = Needle(cookie=0x11, id=1,"
+            " data=(b'weedload\\x00\\xff' * 103)[:1024])\n"
+            "v.write_needle(n)\n"
+            "srv = WeedHTTPServer(('127.0.0.1', 0),"
+            " vs._http_handler_class())\n"
+            "srv.trace_name = 'volume'\n"
+            "srv.trace_node = 'floor'\n"
+            "srv.fast_resolver = vs._make_fast_resolver()\n"
+            "srv.native_serve = True\n"
+            "threading.Thread(target=srv.serve_forever,"
+            " daemon=True).start()\n"
+            "print(json.dumps({'port': srv.server_address[1],"
+            " 'fid': '1,' + format_needle_id_cookie(1, 0x11),"
+            " 'etag': n.etag()}), flush=True)\n"
+            "while True:\n"
+            "    time.sleep(3600)\n"
+        )
+        out_path = os.path.join(workdir, "syscount.txt")
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu", LD_PRELOAD=shim,
+            WEED_SYSCOUNT_OUT=out_path,
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", srv_script],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        try:
+            info = json.loads(proc.stdout.readline())
+            port, fid, etag = info["port"], info["fid"], info["etag"]
+
+            def snapshot(prev_gen: int) -> tuple[int, dict]:
+                os.kill(proc.pid, signal.SIGUSR2)
+                deadline = time.time() + 5
+                while time.time() < deadline:
+                    try:
+                        with open(out_path, encoding="ascii") as f:
+                            lines = f.read().splitlines()
+                        gen = int(lines[0].split()[1])
+                        if gen > prev_gen:
+                            return gen, {
+                                k: int(v)
+                                for k, v in (
+                                    ln.split() for ln in lines[1:]
+                                )
+                            }
+                    except (OSError, ValueError, IndexError):
+                        pass
+                    time.sleep(0.01)
+                raise RuntimeError("syscount snapshot timed out")
+
+            def window(req: bytes, n_reqs: int, gen: int):
+                """Closed-loop: one keep-alive conn, n_reqs requests."""
+                s = _socket.create_connection(("127.0.0.1", port), 10)
+                s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+                try:
+                    def one():
+                        s.sendall(req)
+                        buf = b""
+                        while b"\r\n\r\n" not in buf:
+                            buf += s.recv(65536)
+                        head, _, rest = buf.partition(b"\r\n\r\n")
+                        cl = 0
+                        for ln in head.split(b"\r\n")[1:]:
+                            k, _, val = ln.partition(b":")
+                            if k.strip().lower() == b"content-length":
+                                cl = int(val.strip())
+                        while len(rest) < cl:
+                            rest += s.recv(65536)
+
+                    for _ in range(50):
+                        one()  # warm: plan cached, fd cached, conn up
+                    gen, before = snapshot(gen)
+                    for _ in range(n_reqs):
+                        one()
+                    gen, after = snapshot(gen)
+                finally:
+                    s.close()
+                delta = {
+                    k: after[k] - before.get(k, 0)
+                    for k in after
+                    if after[k] - before.get(k, 0) > 0
+                }
+                return gen, delta
+
+            n_reqs = 1000
+            gen, hot = window(
+                f"GET /{fid} HTTP/1.1\r\n\r\n".encode(), n_reqs, 0
+            )
+            gen, cond = window(
+                f"GET /{fid} HTTP/1.1\r\n"
+                f'If-None-Match: "{etag}"\r\n\r\n'.encode(),
+                n_reqs, gen,
+            )
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)
+        for name, delta in (("serve_floor_hot", hot),
+                            ("serve_floor_304", cond)):
+            per = sum(delta.values()) / n_reqs
+            _report(
+                name, per, "syscalls/req",
+                round(3.0 / per, 4) if per else 0.0,
+                breakdown={
+                    k: round(v / n_reqs, 3)
+                    for k, v in sorted(delta.items())
+                },
+                reqs=n_reqs,
+                target="<=3",
+            )
+    finally:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    # ---------------- parts B+C: CLI clusters -----------------------
+    def _free_port():
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def _spawn(env_extra, *args):
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu", WEED_EC_CODEC="cpu",
+            **env_extra,
+        )
+        return subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                "import jax; jax.config.update('jax_platforms', 'cpu');"
+                "from seaweedfs_tpu.__main__ import main; main()",
+                *args,
+            ],
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+
+    def _cluster(env_extra, *vol_args):
+        """master + one volume server; yields the master netloc."""
+        mport = _free_port()
+        m = f"127.0.0.1:{mport}"
+        d = tempfile.mkdtemp(prefix="weedfloorcli")
+        procs = [_spawn(env_extra, "master", "-port", str(mport),
+                        "-mdir", d)]
+        vdir = os.path.join(d, "v0")
+        os.mkdir(vdir)
+        procs.append(
+            _spawn(env_extra, "volume", "-port", str(_free_port()),
+                   "-dir", vdir, "-mserver", m, "-max", "20",
+                   "-scrubInterval", "0", *vol_args)
+        )
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                with _rq.urlopen(f"http://{m}/dir/status", timeout=2) as r:
+                    topo = json.load(r)["Topology"]
+                if any(
+                    rk["DataNodes"]
+                    for dc in topo.get("DataCenters", [])
+                    for rk in dc.get("Racks", [])
+                ):
+                    return m, procs, d
+            except OSError:
+                pass
+            time.sleep(0.3)
+        for p in procs:
+            p.kill()
+        raise RuntimeError("serve-floor cluster never came up")
+
+    def _teardown(procs, d):
+        import shutil
+
+        for p in procs:
+            p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        shutil.rmtree(d, ignore_errors=True)
+
+    payload = (b"weedload\x00\xff" * 103)[:1024]
+
+    # conditional + flagged mixes, epoll vs threaded A/B
+    arm_rows: dict = {}
+    for native in (True, False):
+        env_extra = {} if native else {"WEED_NATIVE_SERVE": "0"}
+        m, procs, d = _cluster(env_extra)
+        try:
+            etags: dict = {}
+            keys = seed_keys(m, 48, payload, etags=etags)
+            # image/png stores a mime flag WITHOUT tripping the write
+            # path's transparent gzip (text/* would be stored gzipped,
+            # which the fast path declines by design)
+            flagged = seed_keys(m, 48, payload, content_type="image/png")
+            common = dict(
+                master=m, duration_s=6.0, processes=2, conns_per_proc=64,
+            )
+            arm_rows[("cond", native)] = run_get_fan(
+                **common, keys=keys, etags=etags, cond_every=2
+            )
+            arm_rows[("flagged", native)] = run_get_fan(
+                **common, keys=flagged
+            )
+        finally:
+            _teardown(procs, d)
+    for mix in ("cond", "flagged"):
+        e, t = arm_rows[(mix, True)], arm_rows[(mix, False)]
+        ratio = (
+            e["req_per_sec"] / t["req_per_sec"] if t["req_per_sec"] else 0.0
+        )
+        fp = e.get("fast_path") or {}
+        for arm_name, row, vs in (
+            (f"serve_{mix}_epoll", e, round(ratio, 4)),
+            (f"serve_{mix}_threaded", t, 1.0),
+        ):
+            extra = dict(
+                p50_ms=row["p50_ms"],
+                p99_ms=row["p99_ms"],
+                ops=row["ops"],
+                errors=row["errors"],
+                ratio_304=row["ratio_304"],
+                connections=row["config"]["connections"],
+            )
+            if row is e and fp:
+                extra["fast_path_hit_ratio"] = fp.get("hit_ratio", 0.0)
+                extra["fast_path"] = fp
+            _report(arm_name, row["req_per_sec"], "req/s", vs, **extra)
+
+    # shared-bucket admission: lead + 2 workers, one mmap'd bucket.
+    # The rate sits well below what 128 clients can offer even when
+    # every shed reply parks them for the full 1 s retry floor —
+    # otherwise tokens go unclaimed and the measurement undershoots.
+    rate = 40.0
+    m, procs, d = _cluster(
+        {}, "-workers", "2", "-admissionRate", str(rate),
+        "-admissionBurst", str(rate),
+    )
+    try:
+        keys = seed_keys(m, 48, payload)
+        row = run_get_fan(
+            master=m, duration_s=15.0, processes=2, conns_per_proc=64,
+            keys=keys,
+        )
+        wall = row["ops"] / row["req_per_sec"] if row["req_per_sec"] else 15.0
+        # whatever burst survived the seed phase drains once at window
+        # start and contributes at most burst/wall = rate/15 ~ 6.7% on
+        # the high side — inside the +/-10% acceptance band, so the
+        # plain windowed rate is the honest measurement
+        measured = row["ops"] / wall
+        _report(
+            "serve_adm_shared", measured, "admitted/s",
+            round(measured / rate, 4),
+            configured_rate=rate,
+            ops=row["ops"],
+            shed=row["shed"],
+            errors=row["errors"],
+            connections=row["config"]["connections"],
+            target="vs_baseline in [0.9, 1.1]",
+        )
+    finally:
+        _teardown(procs, d)
+
+
 def bench_qos() -> None:
     """QoS plane A/Bs (docs/QOS.md, BENCH_r09).
 
@@ -3101,6 +3423,7 @@ CONFIGS = {
     "trace": bench_trace,
     "load": bench_load,
     "serve": bench_serve,
+    "serve-floor": bench_serve_floor,
     "qos": bench_qos,
     "degraded": bench_degraded,
     "chaos": bench_chaos,
@@ -3181,11 +3504,14 @@ def check_native_post() -> int:
 
 
 def check_native_serve() -> int:
-    """`bench.py --check` serve leg: one GET (and one Range GET)
-    through the C epoll loop and through the threaded mini loop must
-    produce identical bytes, and the C arm must have served it from
-    the zero-copy fast path (not via handoff). The full matrix lives
-    in tests/test_native_serve.py; the fuzzer in
+    """`bench.py --check` serve leg: plain, Range, conditional
+    (If-None-Match → 304, including INM-beats-Range), and flagged-
+    needle (writev'd pre-rendered header) GETs through the C epoll
+    loop and through the threaded mini loop must produce identical
+    bytes, with every one answered from the C fast path (the
+    served/not_modified counters move; handoffs do not). The full
+    matrix lives in tests/test_native_serve.py and
+    tests/test_serve_syscall_floor.py; the fuzzer in
     analysis/fuzz_serve.py."""
     import tempfile
 
@@ -3210,10 +3536,21 @@ def check_native_serve() -> int:
                 return plan
 
             pair.servers[0].fast_resolver = counting
-            for req in (
+            before = native_serve.serve_stats()
+            reqs = (
                 f"GET /{pair.fids['small']} HTTP/1.1\r\n\r\n",
                 f"GET /{pair.fids['big']} HTTP/1.1\r\nRange: bytes=-100\r\n\r\n",
-            ):
+                # conditional: exact validator revalidates as a 304
+                f"GET /{pair.fids['small']} HTTP/1.1\r\n"
+                'If-None-Match: "067c9745"\r\n\r\n',
+                # RFC 9110: If-None-Match beats Range — 304, not 206
+                f"GET /{pair.fids['small']} HTTP/1.1\r\nRange: bytes=0-9\r\n"
+                'If-None-Match: W/"067c9745"\r\n\r\n',
+                # flag-bearing needle: pre-rendered CT/CD header + small
+                # body collapse into one writev on the C arm
+                f"GET /{pair.fids['named']} HTTP/1.1\r\n\r\n",
+            )
+            for req in reqs:
                 case = {"fragments": [req.encode()]}
                 c = fuzz_serve.drive(pair.c_port, case)
                 py = fuzz_serve.drive(pair.py_port, case)
@@ -3224,17 +3561,33 @@ def check_native_serve() -> int:
                         "error": f"C/Python GET bytes diverge for {req!r}",
                     }))
                     return 1
-            if hits != [True, True]:
+            after = native_serve.serve_stats()
+            # a repeated fid may be answered from the C plan cache
+            # WITHOUT calling the Python resolver — those requests are
+            # cache_hits, the rest must all have resolved successfully
+            dcache = after["cache_hits"] - before["cache_hits"]
+            if not all(hits) or len(hits) + dcache != len(reqs):
                 print(json.dumps({
                     "check": "native_serve",
                     "ok": False,
-                    "error": f"fast path declined eligible GETs: {hits}",
+                    "error": f"fast path declined eligible GETs: "
+                             f"{hits} (+{dcache} cache hits)",
+                }))
+                return 1
+            d304 = after["not_modified"] - before["not_modified"]
+            dhand = after["handoffs"] - before["handoffs"]
+            if d304 < 2 or dhand > 0:
+                print(json.dumps({
+                    "check": "native_serve",
+                    "ok": False,
+                    "error": f"C arm left the fast path: "
+                             f"not_modified+{d304}, handoffs+{dhand}",
                 }))
                 return 1
         finally:
             pair.close()
     print(json.dumps({"check": "native_serve", "ok": True,
-                      "fast_path_hits": 2}))
+                      "fast_path_hits": len(reqs), "not_modified": d304}))
     return 0
 
 

@@ -43,6 +43,7 @@ _UNITS = (
     ("gf256.c", False),
     ("needle_ext.c", True),
     ("serve_ext.c", True),
+    ("syscount.c", False),
 )
 
 

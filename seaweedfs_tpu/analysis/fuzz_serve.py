@@ -85,7 +85,8 @@ class ServePair:
             "big": put(4, 0x44444444, rnd.randbytes(100_000)),
             "edge64k": put(5, 0x55555555, rnd.randbytes(65_530)),
         }
-        # shapes the fast path must DECLINE (flag-bearing needles)
+        # flag-bearing needles: the resolver pre-renders Content-Type /
+        # Content-Disposition so these stay on the C fast path too
         n = Needle(cookie=0x66666666, id=6, data=b"named blob")
         n.last_modified = 1_700_000_006
         n.set_has_last_modified_date()
@@ -93,6 +94,13 @@ class ServePair:
         n.set_has_name()
         v.write_needle(n)
         self.fids["named"] = f"1,{format_needle_id_cookie(6, 0x66666666)}"
+        n = Needle(cookie=0x88888888, id=8, data=b"<p>mime blob</p>")
+        n.last_modified = 1_700_000_008
+        n.set_has_last_modified_date()
+        n.mime = b"text/html"
+        n.set_has_mime()
+        v.write_needle(n)
+        self.fids["mime"] = f"1,{format_needle_id_cookie(8, 0x88888888)}"
         # a deleted needle (tombstone) and a never-written fid
         fid_gone = put(7, 0x77777777, b"doomed")
         v.delete_needle(Needle(cookie=0x77777777, id=7))
@@ -135,6 +143,12 @@ _RANGES = [
     "bytes=5-2", "bytes=abc", "bytes=", "bytes=1-2,5-6", "bits=0-1",
     "bytes= 0 - 9", "bytes=00000000000000000001-2", "bytes=-0",
     "bytes=0-99999999999999999999", "BYTES=0-1", "bytes=65529-",
+]
+
+_INM_VALUES = [
+    '"x"', "*", "", '"067c9745"', 'W/"067c9745"', 'W/"x"',
+    '"a", "067c9745"', '"a", "b", "c"', '"unterminated', "W/",
+    "067c9745", '  "067c9745"  ', '"067c9745",', ',"067c9745"',
 ]
 
 _JUNK_LINES = [
@@ -180,8 +194,12 @@ def gen_case(rng: random.Random, fids: dict) -> dict:
                 "Connection: " + rng.choice(["close", "keep-alive", "Close",
                                              "upgrade", ""])
             )
-        if rng.random() < 0.15:
-            lines.append("If-None-Match: " + rng.choice(['"x"', "*", ""]))
+        if rng.random() < 0.25:
+            # "067c9745" is the deterministic ETag of the `small` needle:
+            # against the live store these hit the C 304 arm for real
+            lines.append("If-None-Match: " + rng.choice(_INM_VALUES))
+        if rng.random() < 0.05:
+            lines.append("If-None-Match: " + rng.choice(_INM_VALUES))  # dup
         if rng.random() < 0.1:
             lines.append("If-Modified-Since: Thu, 01 Jan 1970 00:00:00 GMT")
         if rng.random() < 0.1:
@@ -356,6 +374,46 @@ def run(
     return report
 
 
+def _handcrafted_cases() -> list[dict]:
+    """Deterministic conditional-GET streams against the fixed ServePair
+    store: "067c9745" is the real ETag of `small` (1,0111111111) and
+    1,0666666666 is the name-flagged needle — these pin the C 304 arm,
+    If-None-Match-beats-Range, and pipelined-304 keep-alive accounting
+    as replayable corpus entries."""
+    small, named, mime = "1,0111111111", "1,0666666666", "1,0888888888"
+
+    def get(path, *headers):
+        head = f"GET /{path} HTTP/1.1\r\n"
+        head += "".join(h + "\r\n" for h in headers)
+        return (head + "\r\n").encode()
+
+    match = 'If-None-Match: "067c9745"'
+    cond_then_plain = get(small, match) + get(small)
+    inm_beats_range = (
+        get(small, "Range: bytes=0-9", 'If-None-Match: W/"067c9745"')
+        + get(small, "Range: bytes=0-9")
+    )
+    pipelined_304 = (
+        get(small, match)
+        + get(small, 'If-None-Match: "zz", "067c9745"')
+        + get(small, "If-None-Match: *")
+        + get(named, "If-None-Match: *")
+        + get(mime)
+        + get(small, 'If-None-Match: "zz"', "Connection: close")
+    )
+    # fragment the pipelined stream so a 304 head straddles recv() calls
+    cuts = [0, 7, 41, 42, len(pipelined_304) // 2, len(pipelined_304)]
+    fragmented = [
+        pipelined_304[a:b] for a, b in zip(cuts, cuts[1:]) if b > a
+    ]
+    return [
+        {"fragments": [cond_then_plain]},
+        {"fragments": [inm_beats_range]},
+        {"fragments": [pipelined_304]},
+        {"fragments": fragmented},
+    ]
+
+
 def seed_corpus(
     corpus_dir: str | None = None, seed: int = 20260803, target: int = 16
 ) -> list[str]:
@@ -364,11 +422,16 @@ def seed_corpus(
     rng = random.Random(seed)
     corpus_dir = corpus_dir or DEFAULT_CORPUS
     os.makedirs(corpus_dir, exist_ok=True)
-    fids = {  # shape stand-ins; real fids substituted at replay time
+    fids = {  # the ServePair store is deterministic: these fids are real
         "small": "1,0111111111",
         "big": "1,0444444444",
     }
     written: list[str] = []
+    for case in _handcrafted_cases():
+        name = _case_name(case, "cond")
+        with open(os.path.join(corpus_dir, name), "w", encoding="utf-8") as f:
+            f.write(case_to_json(case))
+        written.append(name)
     seen: set[tuple] = set()
     guard = 0
     while len(written) < target and guard < 10000:

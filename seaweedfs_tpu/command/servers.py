@@ -154,6 +154,35 @@ def _add_admission_flags(p: argparse.ArgumentParser) -> None:
         "spawned siblings, which re-run with -serveProcs 1 and would "
         "otherwise each enforce the FULL budget)",
     )
+    p.add_argument(
+        "-admissionShmPath",
+        default="",
+        help="mmap'd token-bucket file ALL the port's accept processes "
+        "charge (docs/QOS.md): the GLOBAL per-client rate holds under "
+        "any connection spread, and the C serving loop sheds natively. "
+        "Auto-created under $TMPDIR when -admissionRate is set with "
+        "-serveProcs/-workers > 1; empty with a single process = the "
+        "in-process bucket",
+    )
+
+
+def _admission_shm_path(args, group_size: int, port: int) -> str:
+    """Resolve the shared admission bucket file for a multi-process
+    port group: the operator's -admissionShmPath wins; otherwise one is
+    auto-created per (port, lead pid) so every sibling the lead spawns
+    attaches to the same bucket while two independent clusters on one
+    host never collide. Single-process groups (or rate 0) keep the
+    in-process bucket — no file, no mmap."""
+    if args.admissionShmPath:
+        return args.admissionShmPath
+    if args.admissionRate > 0 and group_size > 1:
+        import os
+        import tempfile
+
+        return os.path.join(
+            tempfile.gettempdir(), f"weed-adm-{port}-{os.getpid()}.tb"
+        )
+    return ""
 
 
 def _spawn_serve_procs(
@@ -508,6 +537,7 @@ class VolumeCommand(Command):
                 return 1
         guard = _load_guard()
         shard_writes = args.shardWrites and workers > 1
+        admission_shm = _admission_shm_path(args, workers, args.port)
         server = VolumeServer(
             dirs,
             host=args.ip,
@@ -538,11 +568,13 @@ class VolumeCommand(Command):
             admission_rate=args.admissionRate,
             admission_burst=args.admissionBurst,
             admission_inflight=args.admissionInflight,
-            # the read workers enforce admission too (each SO_REUSEPORT
-            # member sees ~1/workers of the connections), so the whole
-            # group divides the configured per-client budget by its
-            # size — the same convention -serveProcs siblings use
+            # the read workers enforce admission too: with a shm path
+            # all of them charge ONE shared bucket (global rate under
+            # any connection spread); without one the group divides
+            # the per-client budget by its size — the legacy
+            # -serveProcs sibling convention
             admission_procs=args.admissionProcs or workers,
+            admission_shm_path=admission_shm,
             announce=args.announce,
         )
         from seaweedfs_tpu.util.profiling import CpuProfile
@@ -567,6 +599,11 @@ class VolumeCommand(Command):
                     admission_burst=args.admissionBurst,
                     admission_inflight=args.admissionInflight,
                     admission_procs=args.admissionProcs or workers,
+                    admission_shm_path=admission_shm,
+                    commit_window_us=args.commitWindowUs,
+                    commit_bytes=args.commitBytes,
+                    commit_batch=args.commitBatch,
+                    commit_fsync=args.commitFsync,
                 )
             wlog.info(
                 "volume server %s:%d -> master %s (%d worker(s))",
@@ -592,6 +629,15 @@ class VolumeCommand(Command):
                     for pr in procs:
                         pr.terminate()
                     server.stop()
+                if admission_shm and not args.admissionShmPath:
+                    # auto-created bucket file: best-effort removal
+                    # (attached mmaps keep working; a crashed lead just
+                    # leaves a 8KiB tmp file behind)
+                    import contextlib
+                    import os
+
+                    with contextlib.suppress(OSError):
+                        os.unlink(admission_shm)
 
 
 @register
@@ -631,6 +677,23 @@ class VolumeWorkerCommand(Command):
             "-internalPort", type=int, default=0,
             help="loopback listener port for trusted worker hops",
         )
+        p.add_argument(
+            "-commitWindowUs", type=int, default=0,
+            help="group-commit window (µs) for vids this worker owns "
+            "under -shardWrites; 0 = write-per-POST (docs/QOS.md)",
+        )
+        p.add_argument(
+            "-commitBytes", type=int, default=4 << 20,
+            help="group-commit byte cap (commit early past this)",
+        )
+        p.add_argument(
+            "-commitBatch", type=int, default=64,
+            help="group-commit batch cap (commit early past this)",
+        )
+        p.add_argument(
+            "-commitFsync", action="store_true",
+            help="fsync the .dat at every owned-write commit point",
+        )
         _add_admission_flags(p)
         _add_trace_flags(p)
         p.add_argument(
@@ -663,6 +726,11 @@ class VolumeWorkerCommand(Command):
             # spawn passes the group size explicitly; a bare-launched
             # worker defaults to enforcing the full budget alone
             admission_procs=args.admissionProcs or 1,
+            admission_shm_path=args.admissionShmPath,
+            commit_window_us=args.commitWindowUs,
+            commit_bytes=args.commitBytes,
+            commit_batch=args.commitBatch,
+            commit_fsync=args.commitFsync,
         )
         worker.start()
         try:
@@ -805,6 +873,7 @@ class S3Command(Command):
             ]
             iam = IdentityAccessManagement(idents)
         procs = args.serveProcs
+        admission_shm = _admission_shm_path(args, procs, args.port)
         server = S3ApiServer(
             filer=args.filer,
             host=args.ip,
@@ -819,13 +888,17 @@ class S3Command(Command):
             admission_burst=args.admissionBurst,
             admission_inflight=args.admissionInflight,
             admission_procs=args.admissionProcs or procs,
+            admission_shm_path=admission_shm,
         )
         server.start()
         import sys
 
-        children = _spawn_serve_procs(
-            procs, sys.argv[1:], ["-admissionProcs", str(procs)]
-        )
+        extra = ["-admissionProcs", str(procs)]
+        if admission_shm:
+            # siblings must charge the SAME mmap'd bucket the lead
+            # created — the flag rides after argv, so it wins the parse
+            extra += ["-admissionShmPath", admission_shm]
+        children = _spawn_serve_procs(procs, sys.argv[1:], extra)
         wlog.info(
             "s3 gateway %s:%d -> filer %s (%d proc(s))",
             args.ip, args.port, args.filer, procs,
@@ -836,6 +909,12 @@ class S3Command(Command):
             for pr in children:
                 pr.terminate()
             server.stop()
+            if admission_shm and not args.admissionShmPath:
+                import contextlib
+                import os
+
+                with contextlib.suppress(OSError):
+                    os.unlink(admission_shm)
 
 
 @register
@@ -886,6 +965,7 @@ class WebDavCommand(Command):
         wlog.set_verbosity(args.v)
         _apply_trace_flags(args)
         procs = args.serveProcs
+        admission_shm = _admission_shm_path(args, procs, args.port)
         server = WebDavServer(
             filer=args.filer,
             host=args.ip,
@@ -898,13 +978,15 @@ class WebDavCommand(Command):
             admission_burst=args.admissionBurst,
             admission_inflight=args.admissionInflight,
             admission_procs=args.admissionProcs or procs,
+            admission_shm_path=admission_shm,
         )
         server.start()
         import sys
 
-        children = _spawn_serve_procs(
-            procs, sys.argv[1:], ["-admissionProcs", str(procs)]
-        )
+        extra = ["-admissionProcs", str(procs)]
+        if admission_shm:
+            extra += ["-admissionShmPath", admission_shm]
+        children = _spawn_serve_procs(procs, sys.argv[1:], extra)
         wlog.info(
             "webdav %s:%d -> filer %s (%d proc(s))",
             args.ip, args.port, args.filer, procs,
@@ -915,6 +997,12 @@ class WebDavCommand(Command):
             for pr in children:
                 pr.terminate()
             server.stop()
+            if admission_shm and not args.admissionShmPath:
+                import contextlib
+                import os
+
+                with contextlib.suppress(OSError):
+                    os.unlink(admission_shm)
 
 
 @register

@@ -47,8 +47,12 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/epoll.h>
+#include <sys/file.h>
+#include <sys/mman.h>
 #include <sys/sendfile.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/uio.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -64,6 +68,8 @@ typedef struct {
     const char *path;   size_t path_len;
     const char *range;  size_t range_len;   /* NULL when absent */
     const char *trace;  size_t trace_len;   /* x-weed-trace value    */
+    const char *inm;    size_t inm_len;     /* if-none-match value   */
+    int has_auth;                           /* Authorization present */
     int head_only;                          /* method == HEAD        */
 } weed_req;
 
@@ -75,6 +81,16 @@ typedef struct {
     int fd; int64_t off; size_t count;        /* sendfile body (fd>=0) */
     int close_fd;                             /* loop closes fd after  */
     int status;
+    /* conditional-GET arm: the needle's (strong) entity-tag plus the
+     * pre-rendered 304 prefix the Python arm would send for it; absent
+     * (len 0) on plans that have no validator (404s, legacy plans) */
+    const uint8_t *etag;      size_t etag_len;
+    const uint8_t *prefix304; size_t prefix304_len;
+    /* plan-cache admission: the resolver's generation snapshot, and
+     * whether this plan may be cached at all (single-process servers
+     * only — a sibling's writes can't bump this process's counter) */
+    uint64_t gen;
+    int cacheable;
 } weed_resp;
 
 typedef struct weed_serve_cbs {
@@ -125,12 +141,38 @@ typedef struct weed_conn {
     struct weed_conn *prev, *next;  /* idle LRU; most recent at tail */
 } weed_conn;
 
+/* ---- per-loop plan cache -------------------------------------------
+ * Direct-mapped, keyed by request path (the fid): a hit serves a hot
+ * GET without calling into Python at all.  Entries are stamped with
+ * the process-wide generation counter the storage layer bumps on any
+ * volume mutation (write/delete/vacuum-swap/remount); a stale stamp
+ * evicts on the next lookup, so the whole cache invalidates in O(1).
+ * Sendfile entries own ONE dup of the volume fd; each response dups it
+ * again so an eviction can never yank the fd from an in-flight
+ * sendfile. */
+#define WEED_SERVE_CACHE_SLOTS 512
+#define WEED_SERVE_CACHE_KEYMAX 64
+#define WEED_SERVE_CACHE_BODYMAX 16384
+
+typedef struct {
+    size_t key_len;            /* 0 = empty slot */
+    char key[WEED_SERVE_CACHE_KEYMAX];
+    uint64_t gen;
+    int status;
+    int fd;                    /* cache-owned dup for sendfile, or -1 */
+    int64_t off; size_t count;
+    uint8_t *buf;              /* prefix | body | etag | prefix304    */
+    size_t prefix_len, body_len, etag_len, p304_len;
+} weed_cache_slot;
+
 typedef struct weed_loop {
     int epfd, listen_fd, wake_fd;
     long idle_ms, max_reqs;
     weed_serve_cbs *cbs;
     weed_conn lru;  /* sentinel */
     int stop;
+    int use_adm;    /* shed via the shared-memory admission bucket */
+    weed_cache_slot *cache;  /* lazily allocated on first insert */
     int64_t listen_paused_until_ms;  /* 0 = listen fd armed; else the
                                         re-arm deadline after EMFILE
                                         (a level-triggered listen event
@@ -149,6 +191,201 @@ static int64_t weed_now_ms(void) {
     clock_gettime(CLOCK_MONOTONIC, &ts);
     return (int64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
 }
+
+static int64_t weed_now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (int64_t)ts.tv_sec * 1000000000ll + ts.tv_nsec;
+}
+
+/* ---- counters / generation ----------------------------------------- */
+
+/* process-wide fast-path counters (weedload scrapes these via /status
+ * to report the fast-path hit + 304 ratios); relaxed atomics because a
+ * process can run several loops (public + internal listeners) */
+static long weed_stat_served;        /* responses the C loop wrote    */
+static long weed_stat_304;           /* ... of which were 304s        */
+static long weed_stat_cache_hits;    /* served without calling Python */
+static long weed_stat_cache_inserts;
+static long weed_stat_shed;          /* 503s from the shared bucket   */
+static long weed_stat_handoffs;      /* connections left for Python   */
+
+/* plan-cache invalidation: the storage layer bumps this on ANY volume
+ * mutation (write, delete, vacuum fd-swap, remount); resolvers stamp
+ * plans with the value they observed before reading */
+static uint64_t weed_serve_gen_counter;
+
+static uint64_t weed_gen_get(void) {
+    return __atomic_load_n(&weed_serve_gen_counter, __ATOMIC_RELAXED);
+}
+
+static uint64_t weed_gen_bump(void) {
+    return __atomic_fetch_add(&weed_serve_gen_counter, 1, __ATOMIC_RELAXED) + 1;
+}
+
+static uint64_t weed_hash(const char *s, size_t n) {
+    uint64_t h = 1469598103934665603ull;  /* FNV-1a */
+    size_t i;
+    for (i = 0; i < n; i++) {
+        h ^= (uint8_t)s[i];
+        h *= 1099511628211ull;
+    }
+    return h;
+}
+
+/* ---- If-None-Match --------------------------------------------------
+ * RFC 9110 §13.1.2 against the resolver's entity-tag: `*` matches any,
+ * otherwise a quote-aware scan of the comma-separated list with WEAK
+ * comparison (W/ ignored on both sides).  This is the exact scanner
+ * util/httpd.etag_matches implements — keep the two in lockstep; the
+ * C-vs-Python identity matrix in tests/ diffs them. */
+static int weed_etag_match(const char *hdr, size_t hn,
+                           const uint8_t *etag, size_t en) {
+    while (hn > 0 && (hdr[0] == ' ' || hdr[0] == '\t')) { hdr++; hn--; }
+    while (hn > 0 && (hdr[hn - 1] == ' ' || hdr[hn - 1] == '\t')) hn--;
+    if (hn == 0) return 0;
+    if (hn == 1 && hdr[0] == '*') return 1;
+    const uint8_t *target = etag;
+    size_t tn = en;
+    if (en >= 2 && etag[0] == 'W' && etag[1] == '/') { target += 2; tn -= 2; }
+    size_t i = 0;
+    while (i < hn) {
+        while (i < hn && (hdr[i] == ' ' || hdr[i] == '\t' || hdr[i] == ','))
+            i++;
+        if (i >= hn) break;
+        if (i + 1 < hn && hdr[i] == 'W' && hdr[i + 1] == '/') i += 2;
+        if (i < hn && hdr[i] == '"') {
+            const char *q = memchr(hdr + i + 1, '"', hn - i - 1);
+            if (q == NULL) return 0;
+            size_t clen = (size_t)(q - (hdr + i)) + 1;
+            if (clen == tn && memcmp(hdr + i, target, tn) == 0) return 1;
+            i += clen;
+        } else {
+            const char *cm = memchr(hdr + i, ',', hn - i);
+            if (cm == NULL) return 0;
+            i = (size_t)(cm - hdr) + 1;
+        }
+    }
+    return 0;
+}
+
+/* ---- shared-memory admission ----------------------------------------
+ * One token bucket per client key, shared by every `-serveProcs` /
+ * `-workers` sibling through an mmap'd file, replacing the rate/N
+ * per-process split (exact only under uniform connection spread).
+ * Each slot is a single int64 GCRA theoretical-arrival-time in
+ * CLOCK_MONOTONIC ns — the token bucket (rate r, burst b) expressed
+ * as virtual time, so admit is ONE lock-free CAS: crash-safe (a
+ * sibling killed mid-check holds no lock) where a shm mutex is not.
+ * Key collisions merge budgets toward the conservative side
+ * (documented in docs/QOS.md). */
+#define WEED_SHM_MAGIC 0x5745454441444d31ull /* "WEEDADM1" */
+
+typedef struct {
+    uint64_t magic;
+    uint32_t nslots;
+    uint32_t pad_;
+    double rate;        /* tokens/second, GLOBAL across siblings */
+    double burst;       /* bucket size */
+    double retry_floor; /* minimum Retry-After seconds */
+} weed_shm_hdr;
+
+static struct {
+    weed_shm_hdr *hdr;
+    int64_t *tat;
+} weed_shm;
+
+static int weed_shm_active(void) { return weed_shm.hdr != NULL; }
+
+/* attach (creating + initializing when first): flock serializes the
+ * header init race between siblings; first writer's parameters win */
+static int weed_shm_attach(const char *path, double rate, double burst,
+                           double retry_floor, uint32_t nslots) {
+    if (weed_shm.hdr != NULL) return 0;  /* process-global, attach once */
+    if (nslots == 0) nslots = 1024;
+    int fd = open(path, O_RDWR | O_CREAT | O_CLOEXEC, 0600);
+    if (fd < 0) return -errno;
+    if (flock(fd, LOCK_EX) != 0) {
+        int e = errno;
+        close(fd);
+        return -e;
+    }
+    struct stat st;
+    weed_shm_hdr init;
+    size_t need;
+    if (fstat(fd, &st) != 0) goto fail_errno;
+    if (st.st_size < (off_t)sizeof(weed_shm_hdr)) {
+        need = sizeof(weed_shm_hdr) + (size_t)nslots * sizeof(int64_t);
+        if (ftruncate(fd, (off_t)need) != 0) goto fail_errno;
+        memset(&init, 0, sizeof(init));
+        init.magic = WEED_SHM_MAGIC;
+        init.nslots = nslots;
+        init.rate = rate;
+        init.burst = burst;
+        init.retry_floor = retry_floor;
+        if (pwrite(fd, &init, sizeof(init), 0) != (ssize_t)sizeof(init))
+            goto fail_errno;
+    } else {
+        if (pread(fd, &init, sizeof(init), 0) != (ssize_t)sizeof(init) ||
+            init.magic != WEED_SHM_MAGIC || init.nslots == 0) {
+            flock(fd, LOCK_UN);
+            close(fd);
+            return -EINVAL;
+        }
+        need = sizeof(weed_shm_hdr) + (size_t)init.nslots * sizeof(int64_t);
+    }
+    flock(fd, LOCK_UN);
+    void *m = mmap(NULL, need, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);  /* the mapping keeps the file alive */
+    if (m == MAP_FAILED) return -errno;
+    weed_shm.tat = (int64_t *)((uint8_t *)m + sizeof(weed_shm_hdr));
+    weed_shm.hdr = (weed_shm_hdr *)m;
+    return 0;
+fail_errno:
+    {
+        int e = errno;
+        flock(fd, LOCK_UN);
+        close(fd);
+        return -e;
+    }
+}
+
+static void weed_shm_detach(void) {
+    if (weed_shm.hdr == NULL) return;
+    size_t need = sizeof(weed_shm_hdr) +
+                  (size_t)weed_shm.hdr->nslots * sizeof(int64_t);
+    weed_shm.hdr = NULL;
+    munmap((void *)((uint8_t *)weed_shm.tat - sizeof(weed_shm_hdr)), need);
+    weed_shm.tat = NULL;
+}
+
+/* 0.0 = admitted (one token consumed); > 0 = shed, the Retry-After
+ * seconds (same formula as the Python gate: time until one token). */
+static double weed_shm_admit(const char *key, size_t klen) {
+    weed_shm_hdr *h = weed_shm.hdr;
+    if (h == NULL || h->rate <= 0.0) return 0.0;
+    int64_t T = (int64_t)(1e9 / h->rate);
+    if (T < 1) T = 1;
+    double b = h->burst < 1.0 ? 1.0 : h->burst;
+    int64_t tau = (int64_t)((b - 1.0) * 1e9 / h->rate);
+    int64_t *slot = &weed_shm.tat[weed_hash(key, klen) % h->nslots];
+    for (;;) {
+        int64_t now = weed_now_ns();
+        int64_t tat = __atomic_load_n(slot, __ATOMIC_RELAXED);
+        if (tat - now > tau) {
+            double retry = (double)(tat - now - tau) / 1e9;
+            return retry < h->retry_floor ? h->retry_floor : retry;
+        }
+        int64_t base = tat > now ? tat : now;
+        if (__atomic_compare_exchange_n(slot, &tat, base + T, 0,
+                                        __ATOMIC_RELAXED, __ATOMIC_RELAXED))
+            return 0.0;
+    }
+}
+
+/* byte-for-byte the Python gate's shed body (qos/admission._shed) */
+static const char weed_shed_body[] =
+    "{\"error\": \"admission control: over per-client budget\"}";
 
 /* ---- idle LRU ------------------------------------------------------ */
 
@@ -195,6 +432,7 @@ static void weed_conn_destroy(weed_loop *lp, weed_conn *c, int close_fd) {
  * and the unconsumed bytes (the current head onward) */
 static void weed_conn_handoff(weed_loop *lp, weed_conn *c) {
     int fd = c->fd;
+    __atomic_fetch_add(&weed_stat_handoffs, 1, __ATOMIC_RELAXED);
     /* detach BEFORE the callback: the embedder may start reading from
      * another thread immediately */
     epoll_ctl(lp->epfd, EPOLL_CTL_DEL, fd, NULL);
@@ -251,6 +489,89 @@ static int weed_wbuf_append(weed_conn *c, const void *data, size_t n) {
     memcpy(c->wbuf + c->wlen, data, n);
     c->wlen += n;
     return 0;
+}
+
+/* ---- plan cache ---------------------------------------------------- */
+
+static void weed_cache_slot_clear(weed_cache_slot *s) {
+    if (s->fd >= 0) close(s->fd);
+    free(s->buf);
+    memset(s, 0, sizeof(*s));
+    s->fd = -1;
+}
+
+static weed_cache_slot *weed_cache_get(weed_loop *lp, const char *path,
+                                       size_t plen) {
+    if (lp->cache == NULL || plen == 0 || plen > WEED_SERVE_CACHE_KEYMAX)
+        return NULL;
+    weed_cache_slot *s =
+        &lp->cache[weed_hash(path, plen) % WEED_SERVE_CACHE_SLOTS];
+    if (s->key_len != plen || memcmp(s->key, path, plen) != 0) return NULL;
+    if (s->gen != weed_gen_get()) {
+        weed_cache_slot_clear(s);  /* the storage layer bumped: stale */
+        return NULL;
+    }
+    return s;
+}
+
+static void weed_cache_put(weed_loop *lp, const weed_req *req,
+                           const weed_resp *resp) {
+    if (!resp->cacheable || resp->status != 200 || req->range != NULL)
+        return;
+    if (req->path_len == 0 || req->path_len > WEED_SERVE_CACHE_KEYMAX)
+        return;
+    if (resp->fd < 0 && resp->body_len > WEED_SERVE_CACHE_BODYMAX)
+        return;
+    if (resp->gen != weed_gen_get())
+        return;  /* raced an invalidation during the resolve */
+    if (lp->cache == NULL) {
+        lp->cache = calloc(WEED_SERVE_CACHE_SLOTS, sizeof(weed_cache_slot));
+        if (lp->cache == NULL) return;
+        for (size_t i = 0; i < WEED_SERVE_CACHE_SLOTS; i++)
+            lp->cache[i].fd = -1;
+    }
+    weed_cache_slot *s =
+        &lp->cache[weed_hash(req->path, req->path_len) %
+                   WEED_SERVE_CACHE_SLOTS];
+    weed_cache_slot_clear(s);
+    size_t blen = resp->fd >= 0 ? 0 : resp->body_len;
+    size_t total =
+        resp->prefix_len + blen + resp->etag_len + resp->prefix304_len;
+    uint8_t *buf = malloc(total ? total : 1);
+    if (buf == NULL) return;
+    uint8_t *w = buf;
+    memcpy(w, resp->prefix, resp->prefix_len); w += resp->prefix_len;
+    if (blen) { memcpy(w, resp->body, blen); w += blen; }
+    if (resp->etag_len) { memcpy(w, resp->etag, resp->etag_len); w += resp->etag_len; }
+    if (resp->prefix304_len) memcpy(w, resp->prefix304, resp->prefix304_len);
+    if (resp->fd >= 0) {
+        int dfd = fcntl(resp->fd, F_DUPFD_CLOEXEC, 0);
+        if (dfd < 0) {
+            free(buf);
+            return;
+        }
+        s->fd = dfd;
+        s->off = resp->off;
+        s->count = resp->count;
+    }
+    memcpy(s->key, req->path, req->path_len);
+    s->key_len = req->path_len;
+    s->gen = resp->gen;
+    s->status = resp->status;
+    s->buf = buf;
+    s->prefix_len = resp->prefix_len;
+    s->body_len = blen;
+    s->etag_len = resp->etag_len;
+    s->p304_len = resp->prefix304_len;
+    __atomic_fetch_add(&weed_stat_cache_inserts, 1, __ATOMIC_RELAXED);
+}
+
+static void weed_cache_free(weed_loop *lp) {
+    if (lp->cache == NULL) return;
+    for (size_t i = 0; i < WEED_SERVE_CACHE_SLOTS; i++)
+        if (lp->cache[i].key_len) weed_cache_slot_clear(&lp->cache[i]);
+    free(lp->cache);
+    lp->cache = NULL;
 }
 
 /* ---- parsing ------------------------------------------------------- */
@@ -339,15 +660,24 @@ static int weed_parse_head(const uint8_t *head, size_t head_len,
                 if (!(vn == 1 && v[0] == '0')) return 0;
             } else if (weed_token_eq_ci(k, kn, "transfer-encoding") ||
                        weed_token_eq_ci(k, kn, "expect") ||
-                       weed_token_eq_ci(k, kn, "if-none-match") ||
                        weed_token_eq_ci(k, kn, "if-modified-since") ||
                        weed_token_eq_ci(k, kn, "etag-md5") ||
                        weed_token_eq_ci(k, kn, "x-weed-deadline")) {
-                /* conditional / framing / deadline semantics live in
-                 * Python (the mini loop parses the budget, 504-fast-
-                 * rejects expired ones, and scopes the ambient
-                 * deadline around dispatch — docs/CHAOS.md) */
+                /* date-conditional / framing / deadline semantics live
+                 * in Python (the mini loop parses the budget, 504-
+                 * fast-rejects expired ones, and scopes the ambient
+                 * deadline around dispatch — docs/CHAOS.md).
+                 * If-None-Match stays HERE: the resolver supplies the
+                 * entity-tag and the loop answers 304 itself. */
                 return 0;
+            } else if (weed_token_eq_ci(k, kn, "if-none-match")) {
+                if (req->inm != NULL) return 0;  /* duplicate header */
+                req->inm = v;
+                req->inm_len = vn;
+            } else if (weed_token_eq_ci(k, kn, "authorization")) {
+                /* admission keys authenticated requests by access key,
+                 * which only the Python gate parses */
+                req->has_auth = 1;
             } else if (weed_token_eq_ci(k, kn, "range")) {
                 if (req->range != NULL) return 0;  /* duplicate Range */
                 req->range = v;
@@ -399,6 +729,78 @@ static int weed_conn_flush(weed_conn *c) {
     return 1;
 }
 
+/* First flush of a staged response: ONE gathering sendmsg over the
+ * head pieces + inline body (the writev reply — no memcpy into wbuf
+ * unless the kernel leaves a remainder), then the shared flush for any
+ * sendfile body.  The staged buffers are only borrowed for the
+ * duration of this call: a blocked remainder is copied into wbuf
+ * before returning, so resolver-token and cache-slot lifetimes never
+ * extend into the EPOLLOUT machinery.
+ * Returns 0 = fully sent (connection stays, pipeline may continue),
+ *         1 = blocked (EPOLLOUT armed, caller must return),
+ *        -1 = connection left the loop. */
+static int weed_conn_send_staged(weed_loop *lp, weed_conn *c,
+                                 const struct iovec *iov, int niov) {
+    c->writing = 1;
+    c->t_send0 = weed_now_s();
+    size_t total = 0;
+    for (int i = 0; i < niov; i++) total += iov[i].iov_len;
+    struct msghdr mh;
+    memset(&mh, 0, sizeof(mh));
+    mh.msg_iov = (struct iovec *)iov;
+    mh.msg_iovlen = (size_t)niov;
+    ssize_t sent;
+    do {
+        sent = sendmsg(c->fd, &mh, MSG_NOSIGNAL);
+    } while (sent < 0 && errno == EINTR);
+    if (sent < 0) {
+        if (errno != EAGAIN && errno != EWOULDBLOCK) {
+            weed_conn_destroy(lp, c, 1);
+            return -1;
+        }
+        sent = 0;
+    }
+    c->wlen = c->wpos = 0;
+    if ((size_t)sent < total) {
+        size_t skip = (size_t)sent;
+        int oom = 0;
+        for (int i = 0; i < niov && !oom; i++) {
+            if (skip >= iov[i].iov_len) {
+                skip -= iov[i].iov_len;
+                continue;
+            }
+            oom = weed_wbuf_append(
+                c, (const uint8_t *)iov[i].iov_base + skip,
+                iov[i].iov_len - skip);
+            skip = 0;
+        }
+        if (oom) {
+            weed_conn_destroy(lp, c, 1);
+            return -1;
+        }
+    }
+    int wr = weed_conn_flush(c);
+    if (wr < 0) {
+        weed_conn_destroy(lp, c, 1);
+        return -1;
+    }
+    if (wr == 0) {
+        if (weed_conn_interest(lp, c, EPOLLOUT) < 0) {
+            weed_conn_destroy(lp, c, 1);
+            return -1;
+        }
+        return 1;
+    }
+    weed_conn_release_resp(lp, c, 1);
+    c->writing = 0;
+    c->wlen = c->wpos = 0;
+    if (c->closing) {
+        weed_conn_destroy(lp, c, 1);
+        return -1;
+    }
+    return 0;
+}
+
 /* process buffered requests until blocked.  Returns 0 to keep the
  * connection in the loop, -1 when it left (destroyed or handed off). */
 static int weed_conn_process(weed_loop *lp, weed_conn *c) {
@@ -435,20 +837,68 @@ static int weed_conn_process(weed_loop *lp, weed_conn *c) {
         }
         c->t_parse = weed_now_s() - tp0;
 
+        int use_adm = lp->use_adm && weed_shm_active();
+        if (use_adm && req.has_auth) {
+            /* Authorization must be keyed by access key; only the
+             * Python gate parses it — the handoff re-gates there */
+            weed_conn_handoff(lp, c);
+            return -1;
+        }
+
         weed_resp resp;
         memset(&resp, 0, sizeof(resp));
         resp.fd = -1;
         void *token = NULL;
+        int from_cache = 0;
         double tr0 = weed_now_s();
-        int rc = lp->cbs->resolve(lp->cbs->ctx, &req, &resp, &token);
-        c->t_resolve = weed_now_s() - tr0;
-        if (rc == 0) {
-            weed_conn_handoff(lp, c);
-            return -1;
+        if (req.range == NULL) {
+            weed_cache_slot *s = weed_cache_get(lp, req.path, req.path_len);
+            if (s != NULL) {
+                resp.status = s->status;
+                resp.prefix = s->buf;
+                resp.prefix_len = s->prefix_len;
+                resp.body = s->buf + s->prefix_len;
+                resp.body_len = s->body_len;
+                resp.etag = s->buf + s->prefix_len + s->body_len;
+                resp.etag_len = s->etag_len;
+                resp.prefix304 =
+                    s->buf + s->prefix_len + s->body_len + s->etag_len;
+                resp.prefix304_len = s->p304_len;
+                from_cache = 1;
+                if (s->fd >= 0) {
+                    /* per-response dup: an eviction must never yank
+                     * the fd out of an in-flight sendfile */
+                    int dfd = fcntl(s->fd, F_DUPFD_CLOEXEC, 0);
+                    if (dfd < 0) {
+                        from_cache = 0;  /* fall back to the resolver */
+                        memset(&resp, 0, sizeof(resp));
+                        resp.fd = -1;
+                    } else {
+                        resp.fd = dfd;
+                        resp.off = s->off;
+                        resp.count = s->count;
+                        resp.close_fd = 1;
+                    }
+                }
+                if (from_cache)
+                    __atomic_fetch_add(&weed_stat_cache_hits, 1,
+                                       __ATOMIC_RELAXED);
+            }
         }
-        if (rc < 0) {
-            weed_conn_destroy(lp, c, 1);
-            return -1;
+        if (!from_cache) {
+            int rc = lp->cbs->resolve(lp->cbs->ctx, &req, &resp, &token);
+            c->t_resolve = weed_now_s() - tr0;
+            if (rc == 0) {
+                weed_conn_handoff(lp, c);
+                return -1;
+            }
+            if (rc < 0) {
+                weed_conn_destroy(lp, c, 1);
+                return -1;
+            }
+            weed_cache_put(lp, &req, &resp);
+        } else {
+            c->t_resolve = weed_now_s() - tr0;
         }
 
         c->rpos += head_len;
@@ -458,28 +908,98 @@ static int weed_conn_process(weed_loop *lp, weed_conn *c) {
             !keep_alive || (lp->max_reqs > 0 && c->nreqs >= lp->max_reqs);
         c->closing = close_now;
 
+        if (use_adm) {
+            double retry = weed_shm_admit(c->ip, strlen(c->ip));
+            if (retry > 0.0) {
+                /* shared-bucket shed, entirely in C: drop the plan,
+                 * reply the exact bytes the Python gate's _shed sends */
+                if (resp.fd >= 0 && resp.close_fd) close(resp.fd);
+                if (token != NULL) {
+                    /* releases the resolver token and records the 503
+                     * on the request counter like the threaded arm */
+                    lp->cbs->complete(lp->cbs->ctx, token, 503, 0,
+                                      c->t_parse, c->t_resolve, 0.0, 1);
+                    token = NULL;
+                }
+                __atomic_fetch_add(&weed_stat_shed, 1, __ATOMIC_RELAXED);
+                char shed_head[192];
+                int sn = snprintf(
+                    shed_head, sizeof(shed_head),
+                    "HTTP/1.1 503 Service Unavailable\r\n"
+                    "Content-Type: application/json\r\n"
+                    "Retry-After: %.3f\r\n"
+                    "%s"
+                    "Content-Length: %zu\r\n\r\n",
+                    retry, close_now ? "Connection: close\r\n" : "",
+                    sizeof(weed_shed_body) - 1);
+                struct iovec siov[2];
+                int sniov = 0;
+                siov[sniov].iov_base = shed_head;
+                siov[sniov++].iov_len = (size_t)sn;
+                if (!req.head_only) {
+                    siov[sniov].iov_base = (void *)weed_shed_body;
+                    siov[sniov++].iov_len = sizeof(weed_shed_body) - 1;
+                }
+                c->token = NULL;
+                c->status = 503;
+                c->resp_bytes = (size_t)sn +
+                    (req.head_only ? 0 : sizeof(weed_shed_body) - 1);
+                int sr = weed_conn_send_staged(lp, c, siov, sniov);
+                if (sr < 0) return -1;
+                if (sr > 0) return 0;
+                if (c->rpos == c->rlen)
+                    c->rpos = c->rlen = c->scan = 0;
+                continue;
+            }
+        }
+
         /* assemble head exactly as fast_reply does: resolver prefix
          * (status line + headers), optional Connection: close, then
-         * Content-Length last */
-        size_t body_total = resp.fd >= 0 ? resp.count : resp.body_len;
+         * Content-Length last.  If-None-Match beats Range (the Python
+         * arm checks it before range handling): a validator match
+         * answers 304 from the pre-rendered prefix and drops the plan
+         * body, whatever the plan's status was. */
+        int not_modified =
+            req.inm != NULL && resp.etag_len > 0 && resp.prefix304_len > 0 &&
+            weed_etag_match(req.inm, req.inm_len, resp.etag, resp.etag_len);
         char tail[64];
-        int tn = snprintf(tail, sizeof(tail), "Content-Length: %zu\r\n\r\n",
-                          body_total);
-        c->wlen = c->wpos = 0;
-        int oom = weed_wbuf_append(c, resp.prefix, resp.prefix_len);
-        if (!oom && close_now)
-            oom = weed_wbuf_append(c, "Connection: close\r\n", 19);
-        if (!oom) oom = weed_wbuf_append(c, tail, (size_t)tn);
-        if (!oom && !req.head_only && resp.fd < 0 && resp.body_len > 0)
-            oom = weed_wbuf_append(c, resp.body, resp.body_len);
-        c->token = token;
-        c->status = resp.status;
-        c->resp_bytes = c->wlen + (req.head_only ? 0 : (resp.fd >= 0 ? resp.count : 0));
-        if (oom) {
-            weed_conn_destroy(lp, c, 1);
-            return -1;
+        int tn;
+        struct iovec iov[4];
+        int niov = 0;
+        if (not_modified) {
+            if (resp.fd >= 0 && resp.close_fd) close(resp.fd);
+            resp.fd = -1;
+            tn = snprintf(tail, sizeof(tail), "Content-Length: 0\r\n\r\n");
+            iov[niov].iov_base = (void *)resp.prefix304;
+            iov[niov++].iov_len = resp.prefix304_len;
+            __atomic_fetch_add(&weed_stat_304, 1, __ATOMIC_RELAXED);
+        } else {
+            size_t body_total = resp.fd >= 0 ? resp.count : resp.body_len;
+            tn = snprintf(tail, sizeof(tail),
+                          "Content-Length: %zu\r\n\r\n", body_total);
+            iov[niov].iov_base = (void *)resp.prefix;
+            iov[niov++].iov_len = resp.prefix_len;
         }
-        if (!req.head_only && resp.fd >= 0 && resp.count > 0) {
+        if (close_now) {
+            iov[niov].iov_base = (void *)"Connection: close\r\n";
+            iov[niov++].iov_len = 19;
+        }
+        iov[niov].iov_base = tail;
+        iov[niov++].iov_len = (size_t)tn;
+        if (!not_modified && !req.head_only && resp.fd < 0 &&
+            resp.body_len > 0) {
+            iov[niov].iov_base = (void *)resp.body;
+            iov[niov++].iov_len = resp.body_len;
+        }
+        size_t head_bytes = 0;
+        for (int i = 0; i < niov; i++) head_bytes += iov[i].iov_len;
+        c->token = token;
+        c->status = not_modified ? 304 : resp.status;
+        c->resp_bytes = head_bytes +
+            ((req.head_only || not_modified) ? 0
+                 : (resp.fd >= 0 ? resp.count : 0));
+        if (!not_modified && !req.head_only && resp.fd >= 0 &&
+            resp.count > 0) {
             c->body_fd = resp.fd;
             c->body_off = resp.off;
             c->body_left = resp.count;
@@ -487,27 +1007,10 @@ static int weed_conn_process(weed_loop *lp, weed_conn *c) {
         } else if (resp.fd >= 0 && resp.close_fd) {
             close(resp.fd);  /* HEAD / empty body: nothing to send */
         }
-        c->writing = 1;
-        c->t_send0 = weed_now_s();
-        int wr = weed_conn_flush(c);
-        if (wr < 0) {
-            weed_conn_destroy(lp, c, 1);
-            return -1;
-        }
-        if (wr == 0) {
-            if (weed_conn_interest(lp, c, EPOLLOUT) < 0) {
-                weed_conn_destroy(lp, c, 1);
-                return -1;
-            }
-            return 0;
-        }
-        weed_conn_release_resp(lp, c, 1);
-        c->writing = 0;
-        c->wlen = c->wpos = 0;
-        if (c->closing) {
-            weed_conn_destroy(lp, c, 1);
-            return -1;
-        }
+        __atomic_fetch_add(&weed_stat_served, 1, __ATOMIC_RELAXED);
+        int sr = weed_conn_send_staged(lp, c, iov, niov);
+        if (sr < 0) return -1;
+        if (sr > 0) return 0;
         if (c->rpos == c->rlen) {
             c->rpos = c->rlen = c->scan = 0;  /* cheap full reset */
         }
@@ -676,7 +1179,7 @@ static int weed_tag_wake;
  * shutdown, -errno when setup fails.  listen_fd and wake_fd are NOT
  * closed (the embedder owns them); every connection fd is. */
 static int weed_serve_loop(int listen_fd, int wake_fd, weed_serve_cbs *cbs,
-                           long idle_ms, long max_reqs) {
+                           long idle_ms, long max_reqs, int use_adm) {
     weed_loop lp;
     memset(&lp, 0, sizeof(lp));
     lp.listen_fd = listen_fd;
@@ -684,6 +1187,7 @@ static int weed_serve_loop(int listen_fd, int wake_fd, weed_serve_cbs *cbs,
     lp.cbs = cbs;
     lp.idle_ms = idle_ms;
     lp.max_reqs = max_reqs;
+    lp.use_adm = use_adm;
     lp.lru.next = lp.lru.prev = &lp.lru;
     lp.epfd = epoll_create1(EPOLL_CLOEXEC);
     if (lp.epfd < 0) return -errno;
@@ -761,6 +1265,7 @@ static int weed_serve_loop(int listen_fd, int wake_fd, weed_serve_cbs *cbs,
     }
 
     while (lp.lru.next != &lp.lru) weed_conn_destroy(&lp, lp.lru.next, 1);
+    weed_cache_free(&lp);
     close(lp.epfd);
     return 0;
 }

@@ -62,6 +62,7 @@ class S3ApiServer:
         admission_burst: float = 0.0,
         admission_inflight: int = 0,
         admission_procs: int = 1,
+        admission_shm_path: str = "",
     ):
         self.filer = filer
         self.host = host
@@ -93,6 +94,7 @@ class S3ApiServer:
                 max_inflight=admission_inflight,
                 procs=admission_procs,
                 label="s3",
+                shm_path=admission_shm_path,
             )
         self._announce: threading.Thread | None = None
         self._http_server: WeedHTTPServer | None = None

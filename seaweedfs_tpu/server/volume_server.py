@@ -38,6 +38,7 @@ from seaweedfs_tpu import qos, trace
 from seaweedfs_tpu.scrub.arbiter import get_arbiter
 from seaweedfs_tpu.stats.metrics import VOLUME_READS
 from seaweedfs_tpu.util import deadline as _op_deadline
+from seaweedfs_tpu.util import native_serve as _native_serve
 from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.ec import ec_files
 from seaweedfs_tpu.ec.ec_volume import EcVolume, NotEnoughShards
@@ -46,6 +47,7 @@ from seaweedfs_tpu.util.httpd import (
     JSON_HDR as _JSON_HDR,
     FastHandler,
     WeedHTTPServer,
+    etag_matches,
     fast_query,
 )
 
@@ -99,6 +101,212 @@ def _parse_manifest_chunks(data: bytes) -> list[dict] | None:
         return None
 
 
+def make_needle_plan_core():
+    """Build the per-needle fast-path plan closure shared by the lead's
+    resolver and every worker's (docs/SERVING.md) — ONE implementation
+    of "map a live needle record to a pre-rendered response", so the
+    lead, the SO_REUSEPORT read workers, and the threaded do_GET arm
+    can never drift apart on bytes.
+
+    plan(v, fid, rng, head_only, gen, cacheable) takes a storage
+    Volume `v` whose map view the caller has already refreshed, and
+    returns:
+
+      None          decline — semantics only the threaded handler has
+                    (gzip/ttl/pairs/manifest flags, torn records,
+                    .idx/.dat disagreement, remote-tier volumes)
+      ("notfound",) missing/tombstoned needle — the caller maps it to
+                    ITS 404 body (lead: empty, workers: JSON)
+      ("cookie",)   cookie mismatch; distinct because the workers'
+                    threaded arm serves a different 404 body for it
+                    (the lead serves the same empty 404 for both)
+      ("plan", t)   a widened 10-tuple (status, prefix, body, fd, off,
+                    count, etag, prefix304, gen, cacheable) ready for
+                    the C loop: etag/prefix304 let it answer
+                    If-None-Match with a 304, gen/cacheable feed the
+                    fd/offset plan cache
+
+    Eligibility is wide (this PR): name/mime/last-modified flagged
+    needles render Content-Type / Content-Disposition / Last-Modified
+    exactly as do_GET does for a bare /<vid>,<fid> URL (no query
+    string reaches here, so dl= and resize params can't)."""
+    import os as _os
+    from mimetypes import types_map as _types_map
+    from os.path import splitext as _splitext
+
+    from seaweedfs_tpu.storage import types as t
+    from seaweedfs_tpu.storage.needle import (
+        FLAG_HAS_LAST_MODIFIED_DATE as _F_LM,
+        FLAG_HAS_MIME as _F_MIME,
+        FLAG_HAS_NAME as _F_NAME,
+        get_actual_size as _actual_size,
+    )
+    from seaweedfs_tpu.util.crc import crc32c as _crc32c, masked_value as _masked
+    from seaweedfs_tpu.util.http_range import (
+        RangeNotSatisfiable,
+        parse_range,
+    )
+    from seaweedfs_tpu.util.httpd import reply_prefix
+
+    tomb = t.TOMBSTONE_FILE_SIZE
+    pread = _os.pread
+    dup = _os.dup
+    # records at or under this take the one-pread in-memory path
+    # (CRC verified, no fd duplication); larger go sendfile
+    small = 65536
+    octet_prefix = b"application/octet-stream"
+    allowed = _F_NAME | _F_MIME | _F_LM
+    prefix_304 = reply_prefix(304)
+
+    def plan(v, fid, rng, head_only, gen, cacheable):
+        with v._lock:
+            fd = v._fd
+            if fd is None:
+                return None  # remote-tier volume
+            nv = v.nm.get(fid.key)
+            if nv is None or nv.offset == 0 or nv.size == tomb:
+                return ("notfound",)
+            size = nv.size
+            if size < 5:
+                return None  # v2/v3 body is at least data_size+flags
+            off0 = nv.actual_offset
+            rec_len = _actual_size(size, v.version)
+            body_fd = -1
+            if rec_len <= small:
+                blob = pread(fd, rec_len, off0)
+                if len(blob) < 20 + size + 4:
+                    return None  # torn record: Python raises loudly
+            else:
+                blob = pread(fd, 20, off0)
+                if len(blob) < 20:
+                    return None
+                body_fd = fd  # dup'd below once the record checks out
+            if blob[12:16] != size.to_bytes(4, "big"):
+                return None  # .idx/.dat disagree: Python path decides
+            if int.from_bytes(blob[0:4], "big") != fid.cookie:
+                return ("cookie",)  # CookieMismatch serves 404
+            data_len = int.from_bytes(blob[16:20], "big")
+            meta_len = size - 4 - data_len
+            if meta_len < 1:
+                return None
+            if body_fd < 0:
+                tail = blob[20 + data_len : 16 + size + 4]
+            else:
+                tail = pread(fd, meta_len + 4, off0 + 20 + data_len)
+                if len(tail) < meta_len + 4:
+                    return None
+            flags = tail[0]
+            if flags & ~allowed:
+                return None  # gzip/ttl/pairs/manifest
+            # incremental meta walk mirroring needle._parse_body_v2;
+            # every meta byte must be accounted for, or this record is
+            # not what the parser thinks it is
+            pos = 1
+            name = mime = b""
+            lm = 0
+            if flags & _F_NAME:
+                if pos >= meta_len:
+                    return None
+                ln = tail[pos]
+                pos += 1
+                if pos + ln > meta_len:
+                    return None
+                name = bytes(tail[pos : pos + ln])
+                pos += ln
+            if flags & _F_MIME:
+                if pos >= meta_len:
+                    return None
+                ln = tail[pos]
+                pos += 1
+                if pos + ln > meta_len:
+                    return None
+                mime = bytes(tail[pos : pos + ln])
+                pos += ln
+            if flags & _F_LM:
+                if pos + 5 > meta_len:
+                    return None
+                lm = int.from_bytes(tail[pos : pos + 5], "big")
+                pos += 5
+            if pos != meta_len:
+                return None
+            stored = int.from_bytes(tail[meta_len : meta_len + 4], "big")
+            if body_fd < 0:
+                data = blob[20 : 20 + data_len]
+                crc = _crc32c(data)
+                if _masked(crc) != stored:
+                    return None  # corrupt: the Python read raises
+            else:
+                data = None
+                # ETag is the RAW crc; the trailer stores the
+                # LevelDB-masked value — rotl17+const, so invert
+                rot = (stored - 0xA282EAD8) & 0xFFFFFFFF
+                crc = ((rot >> 17) | (rot << 15)) & 0xFFFFFFFF
+                body_fd = dup(fd)
+                # the dup keeps the CURRENT .dat alive for the
+                # sendfile even if a vacuum commit swaps the
+                # volume's fd before the response drains
+        etag = f'"{crc:08x}"'
+        headers = {"ETag": etag, "Content-Type": "application/octet-stream"}
+        # header assembly order mirrors do_GET's dict insertion for a
+        # bare fid URL: Content-Type override, Content-Disposition,
+        # Last-Modified, Accept-Ranges, then a Content-Range
+        fname = name.decode("latin-1") if name else ""
+        if mime and not mime.startswith(octet_prefix):
+            headers["Content-Type"] = mime.decode("latin-1")
+        elif fname:
+            ext = _splitext(fname)[1]
+            guessed = _types_map.get(ext.lower()) if ext else None
+            if guessed:
+                headers["Content-Type"] = guessed
+        if fname:
+            escaped = fname.replace("\\", "\\\\").replace('"', '\\"')
+            headers["Content-Disposition"] = f'inline; filename="{escaped}"'
+        if flags & _F_LM:
+            headers["Last-Modified"] = _http_date(lm)
+        headers["Accept-Ranges"] = "bytes"
+        etag_b = etag.encode()
+        if rng:
+            try:
+                span = parse_range(rng.strip(), data_len)
+            except RangeNotSatisfiable:
+                if body_fd >= 0:
+                    _os.close(body_fd)
+                return ("plan", (
+                    416,
+                    reply_prefix(
+                        416, {"Content-Range": f"bytes */{data_len}"}
+                    ),
+                    b"", -1, 0, 0,
+                    etag_b, prefix_304, gen, 0,
+                ))
+            if span is not None:
+                start, end = span
+                headers["Content-Range"] = f"bytes {start}-{end}/{data_len}"
+                if data is not None:
+                    return ("plan", (
+                        206, reply_prefix(206, headers),
+                        data[start : end + 1], -1, 0, 0,
+                        etag_b, prefix_304, gen, 0,
+                    ))
+                return ("plan", (
+                    206, reply_prefix(206, headers), None,
+                    body_fd, off0 + 20 + start, end - start + 1,
+                    etag_b, prefix_304, gen, 0,
+                ))
+        if data is not None:
+            return ("plan", (
+                200, reply_prefix(200, headers), data, -1, 0, 0,
+                etag_b, prefix_304, gen, cacheable,
+            ))
+        return ("plan", (
+            200, reply_prefix(200, headers), None,
+            body_fd, off0 + 20, data_len,
+            etag_b, prefix_304, gen, cacheable,
+        ))
+
+    return plan
+
+
 class VolumeServer:
     def __init__(
         self,
@@ -133,6 +341,7 @@ class VolumeServer:
         admission_burst: float = 0.0,
         admission_inflight: int = 0,
         admission_procs: int = 1,
+        admission_shm_path: str = "",
         announce: str = "",
     ):
         # `ec.codec` config: "cpu" | "native" | "tpu" | "" (auto: tpu
@@ -296,6 +505,7 @@ class VolumeServer:
                 max_inflight=admission_inflight,
                 procs=admission_procs,
                 label="volume",
+                shm_path=admission_shm_path,
             )
         self.shard_writes = shard_writes
         self.n_writers = max(1, n_writers)
@@ -1768,6 +1978,10 @@ class VolumeServer:
                                 if images.resizing_enabled()
                                 else "disabled"
                             ),
+                            # C serving-edge counters (docs/SERVING.md):
+                            # weedload scrapes these for its fast-path
+                            # hit / 304 / plan-cache ratios
+                            "ServeStats": _native_serve.serve_stats(),
                         }
                     )
                 if url_path == "/scrub/status":
@@ -1913,7 +2127,11 @@ class VolumeServer:
                     etag = f'"{hashlib.md5(data).hexdigest()}"'
                 else:
                     etag = f'"{n.etag()}"'
-                if self.headers.get("If-None-Match") == etag:
+                # RFC 9110 §13.1.2: weak validators (W/"…"), comma
+                # lists, and `*` all revalidate — not just the exact
+                # strong match (the C fast path's weed_etag_match runs
+                # the same scanner; the identity tests diff them)
+                if etag_matches(self.headers.get("If-None-Match", ""), etag):
                     return self._reply(304)
                 headers = {"ETag": etag, "Content-Type": "application/octet-stream"}
                 # URL filename wins; else the stored name; ext feeds the
@@ -2300,39 +2518,34 @@ class VolumeServer:
     # identical for everything this path does serve (the shared
     # reply_prefix/parse_range helpers make that true by construction).
     def _make_fast_resolver(self):
-        import os as _os
-
-        from seaweedfs_tpu.storage import types as t
-        from seaweedfs_tpu.storage.needle import (
-            FLAG_HAS_LAST_MODIFIED_DATE as _F_LM,
-            get_actual_size as _actual_size,
-        )
-        from seaweedfs_tpu.util.crc import crc32c as _crc32c, masked_value as _masked
-        from seaweedfs_tpu.util.http_range import (
-            RangeNotSatisfiable,
-            parse_range,
-        )
         from seaweedfs_tpu.util.httpd import reply_prefix
+        from seaweedfs_tpu.util.native_serve import generation as _generation
 
         find_volume = self.store.find_volume
         shard_refresh = self._shard_refresh
-        tomb = t.TOMBSTONE_FILE_SIZE
-        prefix_404 = reply_prefix(404)
-        not_found = (404, prefix_404, b"", -1, 0, 0)
-        pread = _os.pread
-        dup = _os.dup
-        # records at or under this take the one-pread in-memory path
-        # (CRC verified, no fd duplication); larger go sendfile
-        small = 65536
-        octet = "application/octet-stream"
+        plan_core = make_needle_plan_core()
+        prefix_304 = reply_prefix(304)
+        # a 404 carries no validator (etag None): the C loop can never
+        # answer a conditional against it, matching do_GET (which 404s
+        # before the ETag compare)
+        not_found = (404, reply_prefix(404), b"", -1, 0, 0,
+                     None, prefix_304, 0, 0)
+        # plan caching is sound only while EVERY .dat mutation happens
+        # in THIS process (the generation hooks in storage/volume.py
+        # are process-local atomics): -shardWrites workers append from
+        # sibling processes the lead only notices inside the resolve
+        # path — which a cache hit skips — so they disable it. Plain
+        # -workers read processes never write, so the lead stays
+        # cacheable under them.
+        cacheable = 0 if self.shard_writes else 1
 
         def resolver(path, rng, head_only):
-            if self.admission is not None:
-                # admission control runs in the mini loop's dispatch
-                # funnel; declining here routes every request through it
-                # (the C loop can't run the token bucket) — only when an
-                # admission controller is actually configured, so the
-                # zero-copy fast path keeps its default speed
+            adm = self.admission
+            if adm is not None and not getattr(adm, "shared", False):
+                # a per-process token bucket runs in the mini loop's
+                # dispatch funnel only; declining routes every request
+                # through it. The SHARED (shm) bucket is enforced by
+                # the C loop itself, so the fast path stays native.
                 return None
             if "?" in path:
                 return None
@@ -2348,109 +2561,17 @@ class VolumeServer:
                 return None  # EC / redirect lookup: Python path
             if v.version not in (2, 3):
                 return None
+            # generation BEFORE the map read: a write landing between
+            # here and the pread bumps past `gen`, so the C loop
+            # refuses to cache the (now possibly stale) plan
+            gen = _generation()
             shard_refresh(v)
-            with v._lock:
-                fd = v._fd
-                if fd is None:
-                    return None  # remote-tier volume
-                nv = v.nm.get(fid.key)
-                if nv is None or nv.offset == 0 or nv.size == tomb:
-                    return not_found
-                size = nv.size
-                if size < 5:
-                    return None  # v2/v3 body is at least data_size+flags
-                off0 = nv.actual_offset
-                rec_len = _actual_size(size, v.version)
-                body_fd = -1
-                if rec_len <= small:
-                    blob = pread(fd, rec_len, off0)
-                    if len(blob) < 20 + size + 4:
-                        return None  # torn record: Python raises loudly
-                else:
-                    blob = pread(fd, 20, off0)
-                    if len(blob) < 20:
-                        return None
-                    body_fd = fd  # dup'd below once the record checks out
-                if blob[12:16] != size.to_bytes(4, "big"):
-                    return None  # .idx/.dat disagree: Python path decides
-                if int.from_bytes(blob[0:4], "big") != fid.cookie:
-                    return not_found  # CookieMismatch serves 404
-                data_len = int.from_bytes(blob[16:20], "big")
-                meta_len = size - 4 - data_len
-                if meta_len < 1:
-                    return None
-                if body_fd < 0:
-                    tail = blob[20 + data_len : 16 + size + 4]
-                else:
-                    tail = pread(fd, meta_len + 4, off0 + 20 + data_len)
-                    if len(tail) < meta_len + 4:
-                        return None
-                flags = tail[0]
-                if flags & ~_F_LM:
-                    return None  # gzip/name/mime/ttl/pairs/manifest
-                if meta_len != (6 if flags & _F_LM else 1):
-                    return None
-                stored = int.from_bytes(tail[meta_len : meta_len + 4], "big")
-                if body_fd < 0:
-                    data = blob[20 : 20 + data_len]
-                    crc = _crc32c(data)
-                    if _masked(crc) != stored:
-                        return None  # corrupt: the Python read raises
-                else:
-                    data = None
-                    # ETag is the RAW crc; the trailer stores the
-                    # LevelDB-masked value — rotl17+const, so invert
-                    rot = (stored - 0xA282EAD8) & 0xFFFFFFFF
-                    crc = ((rot >> 17) | (rot << 15)) & 0xFFFFFFFF
-                    body_fd = dup(fd)
-                    # the dup keeps the CURRENT .dat alive for the
-                    # sendfile even if a vacuum commit swaps the
-                    # volume's fd before the response drains
-            headers = {"ETag": f'"{crc:08x}"', "Content-Type": octet}
-            if flags & _F_LM:
-                headers["Last-Modified"] = _http_date(
-                    int.from_bytes(tail[1:6], "big")
-                )
-            headers["Accept-Ranges"] = "bytes"
-            if rng:
-                try:
-                    span = parse_range(rng.strip(), data_len)
-                except RangeNotSatisfiable:
-                    if body_fd >= 0:
-                        _os.close(body_fd)
-                    return (
-                        416,
-                        reply_prefix(
-                            416, {"Content-Range": f"bytes */{data_len}"}
-                        ),
-                        b"",
-                        -1,
-                        0,
-                        0,
-                    )
-                if span is not None:
-                    start, end = span
-                    headers["Content-Range"] = f"bytes {start}-{end}/{data_len}"
-                    if data is not None:
-                        return (
-                            206,
-                            reply_prefix(206, headers),
-                            data[start : end + 1],
-                            -1,
-                            0,
-                            0,
-                        )
-                    return (
-                        206,
-                        reply_prefix(206, headers),
-                        None,
-                        body_fd,
-                        off0 + 20 + start,
-                        end - start + 1,
-                    )
-            if data is not None:
-                return (200, reply_prefix(200, headers), data, -1, 0, 0)
-            return (200, reply_prefix(200, headers), None, body_fd, off0 + 20, data_len)
+            out = plan_core(v, fid, rng, head_only, gen, cacheable)
+            if out is None:
+                return None
+            if out[0] in ("notfound", "cookie"):
+                return not_found  # do_GET 404s both with an empty body
+            return out[1]
 
         return resolver
 

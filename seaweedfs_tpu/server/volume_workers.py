@@ -54,6 +54,7 @@ from seaweedfs_tpu.util.httpd import (
     JSON_HDR,
     FastHandler,
     WeedHTTPServer,
+    etag_matches,
     fast_query,
 )
 
@@ -206,6 +207,22 @@ class SharedReadVolume:
             self._replayed = self._vol.nm.index_file_size()
             return size, unchanged
 
+    def write_needles(
+        self, entries, precheck=None, durable: bool = False
+    ) -> list:
+        """Batch counterpart of write_needle for the worker-side group
+        commit window (qos/group_commit.py): ONE ownership precheck and
+        refresh cover the whole batch — the release ack drains this
+        lock, so the batch either lands wholly before the handback or
+        aborts wholesale and re-routes to the new owner."""
+        with self._lock:
+            if precheck is not None and not precheck():
+                raise VolumeReleased(self.vid)
+            self._refresh()
+            results = self._vol.write_needles(entries, durable=durable)
+            self._replayed = self._vol.nm.index_file_size()
+            return results
+
     def delete_needle(self, n: Needle, precheck=None) -> int:
         with self._lock:
             if precheck is not None and not precheck():
@@ -246,6 +263,38 @@ class SharedReadVolume:
         self._vol.close()
 
 
+class _CommitVolume:
+    """The lead-Volume surface qos.group_commit.GroupCommitter expects
+    (`.id`, write_needle → (offset, size, unchanged), write_needles,
+    commit) over a SharedReadVolume plus its ownership precheck.
+    Commit windows key on `.id`, so concurrent owned writes against
+    one vid coalesce no matter which request built the facade."""
+
+    __slots__ = ("_srv", "_precheck")
+
+    def __init__(self, srv: SharedReadVolume, precheck):
+        self._srv = srv
+        self._precheck = precheck
+
+    @property
+    def id(self):  # noqa: A003 — mirrors storage.Volume.id
+        return self._srv.vid
+
+    def write_needle(self, n, stages=None):
+        size, unchanged = self._srv.write_needle(
+            n, precheck=self._precheck, stages=stages
+        )
+        return 0, size, unchanged
+
+    def write_needles(self, entries, durable: bool = False):
+        return self._srv.write_needles(
+            entries, precheck=self._precheck, durable=durable
+        )
+
+    def commit(self):
+        self._srv.volume.commit()
+
+
 class VolumeReadWorker:
     """One worker process: shared-port listener + blob read fast path."""
 
@@ -266,18 +315,24 @@ class VolumeReadWorker:
         admission_burst: float = 0.0,
         admission_inflight: int = 0,
         admission_procs: int = 1,
+        admission_shm_path: str = "",
+        commit_window_us: int = 0,
+        commit_bytes: int = 4 << 20,
+        commit_batch: int = 64,
+        commit_fsync: bool = False,
     ):
         self.directories = directories
         self.host = host
         self.port = port
         self.lead = lead  # host:port of the lead's internal listener
-        # QoS admission control (docs/QOS.md): workers share the
-        # configured per-client budget the same way -admissionProcs
-        # splits it for SO_REUSEPORT gateway siblings — the kernel
-        # spreads accepted connections uniformly across the group, so
-        # each member enforces rate/N. Before this, only the lead
-        # gated and N-1 of every N connections bypassed admission
-        # entirely (ROADMAP tail-latency follow-on).
+        # QoS admission control (docs/QOS.md): with -admissionShmPath
+        # every SO_REUSEPORT sibling (lead included) charges ONE
+        # mmap'd bucket per client key, so the GLOBAL budget holds no
+        # matter how the kernel spreads connections — and the C epoll
+        # loop sheds natively. Without it, each member enforces rate/N
+        # (exact only under uniform connection spread). Before either,
+        # only the lead gated and N-1 of every N connections bypassed
+        # admission entirely (ROADMAP tail-latency follow-on).
         self.admission = None
         if admission_rate > 0 or admission_inflight > 0:
             from seaweedfs_tpu.qos.admission import AdmissionController
@@ -288,6 +343,23 @@ class VolumeReadWorker:
                 max_inflight=admission_inflight,
                 procs=admission_procs,
                 label=f"volume-worker-{writer_index}",
+                shm_path=admission_shm_path,
+            )
+        # QoS group commit on the worker-owned write path (-shardWrites
+        # + -commitWindowUs/-commitFsync): concurrent POSTs for vids
+        # this worker owns coalesce into one pwritev + at most one
+        # fsync, same as the lead's (qos/group_commit.py). The C POST
+        # fast path declines while a committer is installed, exactly
+        # like the lead's do_POST.
+        self.group_commit = None
+        if shard_writes and (commit_window_us > 0 or commit_fsync):
+            from seaweedfs_tpu.qos.group_commit import GroupCommitter
+
+            self.group_commit = GroupCommitter(
+                window_us=commit_window_us,
+                max_bytes=commit_bytes,
+                max_batch=commit_batch,
+                fsync=commit_fsync,
             )
         self.worker_port = worker_port  # optional private listener (tests)
         # -shardWrites: this worker OWNS writes for vids with
@@ -469,22 +541,30 @@ class VolumeReadWorker:
                 # tail (same shape as the lead's do_POST)
                 req_span = getattr(self, "_trace_span", None)
                 stages = {} if req_span is not None else None
-                try:
-                    reply = v.native_post(
-                        fid, q, body, self.headers, url_filename,
-                        precheck=still_owned, stages=stages,
-                    )
-                except VolumeReleased:
-                    return False  # re-route to the lead (new owner)
-                except (CookieMismatch, ValueError) as e:
-                    # same contract as the Python branch below: a
-                    # refresh/reopen failure (CorruptNeedle is a
-                    # ValueError) answers 409, never a dropped socket
-                    self._json({"error": str(e)}, 409)
-                    return True
-                except OSError:
-                    worker._drop_volume(vid)
-                    return False
+                if worker.group_commit is not None:
+                    # QoS group commit (docs/QOS.md): the C one-call
+                    # append can't join a commit window (and fsync-only
+                    # mode needs the post-write flush), so the fast
+                    # path declines wholesale while a committer is
+                    # installed — same policy as the lead's do_POST
+                    reply = None
+                else:
+                    try:
+                        reply = v.native_post(
+                            fid, q, body, self.headers, url_filename,
+                            precheck=still_owned, stages=stages,
+                        )
+                    except VolumeReleased:
+                        return False  # re-route to the lead (new owner)
+                    except (CookieMismatch, ValueError) as e:
+                        # same contract as the Python branch below: a
+                        # refresh/reopen failure (CorruptNeedle is a
+                        # ValueError) answers 409, never a dropped socket
+                        self._json({"error": str(e)}, 409)
+                        return True
+                    except OSError:
+                        worker._drop_volume(vid)
+                        return False
                 if reply is None:
                     n, fname, err = write_path.build_upload_needle(
                         fid, q, body, self.headers, url_filename,
@@ -494,9 +574,15 @@ class VolumeReadWorker:
                         self._json({"error": err}, 400)
                         return True
                     try:
-                        size, unchanged = v.write_needle(
-                            n, precheck=still_owned, stages=stages
-                        )
+                        if worker.group_commit is not None:
+                            _, size, unchanged = worker.group_commit.write(
+                                _CommitVolume(v, still_owned), n,
+                                stages=stages,
+                            )
+                        else:
+                            size, unchanged = v.write_needle(
+                                n, precheck=still_owned, stages=stages
+                            )
                     except VolumeReleased:
                         return False  # re-route to the lead (new owner)
                     except (CookieMismatch, ValueError) as e:
@@ -627,24 +713,53 @@ class VolumeReadWorker:
                             self.fast_reply(304)
                             return True
                 etag = f'"{n.etag()}"'
-                if self.headers.get("if-none-match") == etag:
+                # RFC 9110 §13.1.2: weak compare over a quote-aware
+                # comma list (W/"…", multiple members, `*`) — the same
+                # scanner the lead's do_GET and the C loop run
+                if etag_matches(self.headers.get("if-none-match", ""), etag):
                     self.fast_reply(304)
                     return True
+                # header assembly mirrors the lead's do_GET for a bare
+                # fid URL (and the shared plan core the C arm serves
+                # from) — octet-stream mimes stay implicit, extension
+                # fallback, escaped filename — so a worker's threaded
+                # reply is byte-identical to the lead's and to the C
+                # fast path for the same needle
                 headers = {
                     "ETag": etag,
                     "Content-Type": "application/octet-stream",
-                    "Accept-Ranges": "bytes",
                 }
-                if n.has_mime() and n.mime:
+                fname = (
+                    n.name.decode("latin-1")
+                    if n.has_name() and n.name
+                    else ""
+                )
+                if (
+                    n.has_mime()
+                    and n.mime
+                    and not n.mime.startswith(b"application/octet-stream")
+                ):
                     headers["Content-Type"] = n.mime.decode("latin-1")
-                if n.has_name() and n.name:
+                elif fname:
+                    import mimetypes
+                    from os.path import splitext
+
+                    ext = splitext(fname)[1]
+                    guessed = (
+                        mimetypes.types_map.get(ext.lower()) if ext else None
+                    )
+                    if guessed:
+                        headers["Content-Type"] = guessed
+                if fname:
+                    escaped = fname.replace("\\", "\\\\").replace('"', '\\"')
                     headers["Content-Disposition"] = (
-                        f'inline; filename="{n.name.decode("latin-1")}"'
+                        f'inline; filename="{escaped}"'
                     )
                 if n.has_last_modified_date():
                     from seaweedfs_tpu.server.volume_server import _http_date
 
                     headers["Last-Modified"] = _http_date(n.last_modified)
+                headers["Accept-Ranges"] = "bytes"
                 data = n.data
                 from seaweedfs_tpu.util.http_range import (
                     RangeNotSatisfiable,
@@ -736,6 +851,72 @@ class VolumeReadWorker:
 
         return Handler
 
+    # --- zero-copy GET fast path (docs/SERVING.md) -----------------------
+    # Workers previously left every GET on the threaded arm: only the
+    # lead's listener carried a resolver, so under `-workers N` just
+    # 1-in-N connections could be served from C. This resolver runs the
+    # SAME shared plan core against the worker's SharedReadVolume view
+    # (idx-tail refresh first, same as _serve_blob), so every
+    # SO_REUSEPORT sibling answers hot GETs — and If-None-Match 304s —
+    # without leaving its C epoll loop.
+    def _make_fast_resolver(self):
+        from seaweedfs_tpu.server.volume_server import make_needle_plan_core
+        from seaweedfs_tpu.util.httpd import reply_prefix
+
+        plan_core = make_needle_plan_core()
+        prefix_304 = reply_prefix(304)
+        json_404 = reply_prefix(404) + JSON_HDR
+        # the worker's threaded arm 404s with JSON bodies (unlike the
+        # lead's empty 404), and distinguishes cookie mismatch — the C
+        # arm must serve those exact bytes. No etag on either: a 404
+        # can never answer a conditional, matching _serve_blob.
+        not_found = (404, json_404, b'{"error": "not found"}',
+                     -1, 0, 0, None, prefix_304, 0, 0)
+        cookie_404 = (404, json_404, b'{"error": "cookie mismatch"}',
+                      -1, 0, 0, None, prefix_304, 0, 0)
+        worker = self
+
+        def resolver(path, rng, head_only):
+            adm = worker.admission
+            if adm is not None and not getattr(adm, "shared", False):
+                # per-process rate/N buckets live in the dispatch
+                # funnel only; declining routes every request through
+                # it. The SHARED (shm) bucket is charged by the C loop
+                # itself, so the fast path stays native.
+                return None
+            if "?" in path:
+                return None
+            fid_part = path.lstrip("/")
+            if "," not in fid_part or "/" in fid_part:
+                return None  # UI/status/admin surface proxies the lead
+            try:
+                fid = FileId.parse(fid_part)
+            except ValueError:
+                return None
+            srv = worker._find_volume(fid.volume_id)
+            if srv is None:
+                return None  # unknown/EC/mid-commit: proxy decides
+            try:
+                with srv._lock:
+                    srv._refresh()
+                # plans are NEVER cacheable here (gen 0, cacheable 0):
+                # the lead (and shard siblings) append from other
+                # processes, invisible to this process's generation
+                # counter — every request must re-run the refresh
+                out = plan_core(srv._vol, fid, rng, head_only, 0, 0)
+            except (OSError, ValueError, RuntimeError):
+                return None  # reopen straddling a vacuum commit:
+                # the threaded arm retries with a fresh pair
+            if out is None:
+                return None
+            if out[0] == "notfound":
+                return not_found
+            if out[0] == "cookie":
+                return cookie_404
+            return out[1]
+
+        return resolver
+
     # --- lifecycle --------------------------------------------------------
     def start(self) -> None:
         from seaweedfs_tpu.util.httpd import ReusePortWeedHTTPServer
@@ -758,6 +939,15 @@ class VolumeReadWorker:
             self._servers.append(
                 WeedHTTPServer((self.host, self.worker_port), handler)
             )
+        # zero-copy GET fast path on every public listener: without
+        # this only the lead's 1-in-N share of SO_REUSEPORT accepts
+        # ever reached serve.c (docs/SERVING.md). The internal
+        # release/control listener stays resolver-less — it is a
+        # lead↔worker write/admin hop, never a data-plane GET.
+        fast_resolver = self._make_fast_resolver()
+        for s in self._servers:
+            if s is not self._internal_server:
+                s.fast_resolver = fast_resolver
         for s in self._servers:
             # tracing plane: worker hops are spans too, labeled so a
             # shard-hop write reads worker→lead→replica in one trace
@@ -836,6 +1026,11 @@ def spawn_read_workers(
     admission_burst: float = 0.0,
     admission_inflight: int = 0,
     admission_procs: int = 1,
+    admission_shm_path: str = "",
+    commit_window_us: int = 0,
+    commit_bytes: int = 4 << 20,
+    commit_batch: int = 64,
+    commit_fsync: bool = False,
 ) -> list:
     """Lead-side helper: launch n worker subprocesses sharing host:port
     (writer indices 1..n; the lead is writer 0). Returns the Popen
@@ -862,14 +1057,17 @@ def spawn_read_workers(
         if worker_port_base:
             cmd += ["-workerPort", str(worker_port_base + k)]
         if admission_rate > 0 or admission_inflight > 0:
-            # each group member (lead included) enforces 1/procs of the
-            # per-client budget — the SO_REUSEPORT sibling convention
+            # with a shm path every member charges ONE shared bucket;
+            # without it each enforces 1/procs of the per-client
+            # budget — the legacy SO_REUSEPORT sibling convention
             cmd += [
                 "-admissionRate", str(admission_rate),
                 "-admissionBurst", str(admission_burst),
                 "-admissionInflight", str(admission_inflight),
                 "-admissionProcs", str(admission_procs),
             ]
+            if admission_shm_path:
+                cmd += ["-admissionShmPath", admission_shm_path]
         if shard_writes:
             cmd += [
                 "-shardWrites",
@@ -879,5 +1077,13 @@ def spawn_read_workers(
             ]
             if master:
                 cmd += ["-mserver", master]
+            if commit_window_us > 0 or commit_fsync:
+                cmd += [
+                    "-commitWindowUs", str(commit_window_us),
+                    "-commitBytes", str(commit_bytes),
+                    "-commitBatch", str(commit_batch),
+                ]
+                if commit_fsync:
+                    cmd += ["-commitFsync"]
         procs.append(subprocess.Popen(cmd))
     return procs

@@ -40,6 +40,17 @@ from seaweedfs_tpu.storage.super_block import CURRENT_VERSION, SuperBlock
 from seaweedfs_tpu.storage.ttl import TTL
 from seaweedfs_tpu.util import durable, wlog
 
+try:
+    # Invalidates the C serving loop's plan cache (fd/offset/headers
+    # keyed by path) on any mutation; no-op import cycle risk: the
+    # util module only pulls os/socket/threading at top level.
+    from seaweedfs_tpu.util.native_serve import (
+        bump_generation as _serve_cache_bump,
+    )
+except Exception:  # pragma: no cover - stripped install
+    def _serve_cache_bump():  # type: ignore[misc]
+        return 0
+
 
 class NeedleNotFound(KeyError):
     pass
@@ -667,6 +678,9 @@ class Volume:
 
             if existing is None or existing.actual_offset < offset:
                 self.nm.put(n.id, t.offset_to_units(offset), n.size)
+            # after the record and map entry are visible: a plan stamped
+            # with the pre-bump generation can no longer be inserted
+            _serve_cache_bump()
             return offset, n.size, False
 
     def commit(self) -> None:
@@ -799,6 +813,8 @@ class Volume:
                     results[i] = e
             if durable and blobs:
                 self._flush_locked()
+            if blobs:
+                _serve_cache_bump()  # deferred entries bump via write_needle
         return results
 
     def _is_file_unchanged(self, n: Needle) -> bool:
@@ -839,6 +855,7 @@ class Volume:
             offset = self._append_blob(blob)
             self.last_append_at_ns = n.append_at_ns
             self.nm.delete(n.id, t.offset_to_units(offset))
+            _serve_cache_bump()
             return freed
 
     # --- read path (volume_read_write.go:139 readNeedle) ---
@@ -1022,6 +1039,9 @@ class Volume:
             # table was removed inside the marker window above)
             self.nm = self._load_needle_map()
             self._followed = self.nm.index_file_size()
+            # the fd-swap is THE plan-cache hazard: any cached
+            # (fd, offset) pair now points into the pre-compaction file
+            _serve_cache_bump()
 
     def refresh_from_idx(self) -> None:
         """Catch this process's map (and append offset) up with .idx
@@ -1051,6 +1071,8 @@ class Volume:
             for key, offset, entry_size in idx_codec.iter_entries(tail[:usable]):
                 self.nm._replay(key, offset, entry_size)
             self._followed = pos + usable
+            if usable:
+                _serve_cache_bump()
             # the other process also grew the .dat: re-arm the pwrite
             # append cursor so a post-handback write lands at the tail
             # instead of overwriting the owner's records
@@ -1076,6 +1098,7 @@ class Volume:
         with self._lock:
             self.nm.close()
             self._dat.close()
+            _serve_cache_bump()  # unmount: cached fds are now stale
 
     def destroy(self) -> None:
         with self._lock:

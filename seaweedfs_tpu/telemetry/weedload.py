@@ -32,6 +32,7 @@ import http.client
 import json
 import multiprocessing
 import time
+import urllib.error
 import urllib.request
 
 from seaweedfs_tpu.stats.quantile import histogram_quantile
@@ -263,8 +264,21 @@ def _worker(spec: dict, out_q, barrier=None) -> None:
 # parent
 
 
-def seed_keys(master: str, n: int, payload: bytes) -> list[tuple[str, str]]:
-    """Write n blobs for the GET workers to hammer; returns (fid, url)."""
+def seed_keys(
+    master: str,
+    n: int,
+    payload: bytes,
+    etags: dict | None = None,
+    content_type: str = "application/octet-stream",
+) -> list[tuple[str, str]]:
+    """Write n blobs for the GET workers to hammer; returns (fid, url).
+    Pass `etags` (a dict) to also capture each upload's ETag — the
+    validators the conditional-GET mix revalidates against. The default
+    octet-stream content type stores no mime flag (urllib's implicit
+    x-www-form-urlencoded would); pass e.g. "image/png" to seed
+    FLAGGED needles for the pre-rendered-header fast-path mix. Beware
+    text/* and json/xml types: the write path gzips those uploads
+    transparently, and gzipped needles sit OFF the C fast path."""
     keys: list[tuple[str, str]] = []
     for _ in range(n):
         with urllib.request.urlopen(
@@ -273,17 +287,28 @@ def seed_keys(master: str, n: int, payload: bytes) -> list[tuple[str, str]]:
             a = json.loads(r.read())
         if "error" in a:
             raise RuntimeError(f"seed assign: {a['error']}")
-        urllib.request.urlopen(
-            urllib.request.Request(
-                f"http://{a['url']}/{a['fid']}", data=payload, method="POST",
-                # explicit octet-stream: urllib's default
-                # x-www-form-urlencoded would store a mime flag on the
-                # needle, and flagged needles decline the zero-copy GET
-                # fast path the serve bench exists to measure
-                headers={"Content-Type": "application/octet-stream"},
-            ),
-            timeout=10,
-        ).close()
+        req = urllib.request.Request(
+            f"http://{a['url']}/{a['fid']}", data=payload, method="POST",
+            headers={"Content-Type": content_type},
+        )
+        # an admission-armed server sheds seed writes once the cold
+        # burst drains — honor its Retry-After instead of dying
+        for attempt in range(20):
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    if etags is not None:
+                        etags[a["fid"]] = json.loads(r.read()).get(
+                            "eTag", ""
+                        )
+                break
+            except urllib.error.HTTPError as e:
+                if e.code != 503 or attempt == 19:
+                    raise
+                try:
+                    delay = float(e.headers.get("Retry-After", "0.5"))
+                except (TypeError, ValueError):
+                    delay = 0.5
+                time.sleep(min(max(delay, 0.05), 2.0))
         keys.append((a["fid"], a["url"]))
     return keys
 
@@ -341,8 +366,14 @@ def _get_fan_worker(spec: dict, out_q, barrier=None) -> None:
     admission A/B measures the designed backpressure loop, not a
     client that spams the server it was just refused by.
 
+    A `cond_every` of N makes every Nth request on a connection carry
+    an If-None-Match with the blob's real ETag (from `etags`): the
+    conditional-GET mix, where the server revalidates with a 304 out
+    of the C fast path instead of moving the body. 304s count as
+    successful ops and separately as `not_modified`.
+
     spec: mode='get_fan', duration_s, keys, conns, rate, index,
-    range_every, ranges."""
+    range_every, ranges, cond_every, etags."""
     import selectors
     import socket as _socket
 
@@ -355,10 +386,12 @@ def _get_fan_worker(spec: dict, out_q, barrier=None) -> None:
     nconns = spec["conns"]
     range_every = spec.get("range_every", 0)
     ranges = spec.get("ranges") or ["bytes=0-127"]
+    cond_every = spec.get("cond_every", 0)
+    etags = spec.get("etags") or {}
     interval = (1.0 / rate) if rate > 0 else 0.0
     hist = LogHistogram()
     shed_hist = LogHistogram()
-    ops = errors = nbytes = shed = 0
+    ops = errors = nbytes = shed = not_modified = 0
     err_samples: list[str] = []
     sel = selectors.DefaultSelector()
     start = time.perf_counter()
@@ -382,6 +415,12 @@ def _get_fan_worker(spec: dict, out_q, barrier=None) -> None:
         hdr = b""
         if range_every and c.nreq % range_every == 0:
             hdr = b"Range: " + ranges[c.nreq % len(ranges)].encode() + b"\r\n"
+        if cond_every and c.nreq % cond_every == 0:
+            etag = etags.get(fid, "")
+            if etag:
+                hdr += (
+                    b'If-None-Match: "' + etag.encode() + b'"\r\n'
+                )
         req = b"GET /" + fid.encode() + b" HTTP/1.1\r\n" + hdr + b"\r\n"
         c.t_ref = c.scheduled if interval else now
         c.buf = b""
@@ -466,9 +505,11 @@ def _get_fan_worker(spec: dict, out_q, barrier=None) -> None:
                 c.buf += chunk
                 if c.inflight and _complete(c, now):
                     status = c.buf[9:12]
-                    if status in (b"200", b"206"):
+                    if status in (b"200", b"206", b"304"):
                         ops += 1
                         nbytes += c.need
+                        if status == b"304":
+                            not_modified += 1
                         hist.record(now - c.t_ref)
                     elif status == b"503":
                         # admission-control shed (docs/QOS.md): refused
@@ -531,12 +572,29 @@ def _get_fan_worker(spec: dict, out_q, barrier=None) -> None:
         "ops": ops,
         "errors": errors,
         "shed": shed,
+        "not_modified": not_modified,
         "err_samples": err_samples,
         "bytes": nbytes,
         "hist": hist.to_row(),
         "shed_hist": shed_hist.to_row(),
         "wall_s": time.perf_counter() - start,
     })
+
+
+def _scrape_serve_stats(urls: set[str]) -> dict:
+    """Sum the C fast-path counters (/status ServeStats) across the
+    distinct volume servers in `urls`; {} when none answer."""
+    total: dict = {}
+    for url in urls:
+        try:
+            with urllib.request.urlopen(f"http://{url}/status", timeout=5) as r:
+                stats = json.loads(r.read()).get("ServeStats") or {}
+        except (OSError, ValueError):
+            continue
+        for k, v in stats.items():
+            if isinstance(v, (int, float)):
+                total[k] = total.get(k, 0) + v
+    return total
 
 
 def run_get_fan(
@@ -549,20 +607,29 @@ def run_get_fan(
     seed_n: int = 64,
     range_every: int = 0,
     ranges: list[str] | None = None,
+    cond_every: int = 0,
     keys: list[tuple[str, str]] | None = None,
+    etags: dict | None = None,
     mp_start: str = "spawn",
 ) -> dict:
     """GET-heavy connection-scale load: `processes` × `conns_per_proc`
     keep-alive connections in closed loop against the cluster at
     `master`. `rate` is per-CONNECTION req/s (0 = unpaced
     max-throughput probe; >0 = coordinated-omission-safe pacing).
-    Returns the same report shape as run_load (mode 'get')."""
+    `cond_every` = N sends every Nth request per connection as a
+    conditional GET (If-None-Match with the seeded ETag → 304).
+    Returns the same report shape as run_load (mode 'get'), plus
+    `ratio_304` and a `fast_path` block (the served/handoff counter
+    deltas scraped from each volume server's /status ServeStats)."""
     payload = (b"weedload\x00\xff" * ((payload_bytes // 10) + 1))[:payload_bytes]
     if keys is None:
-        keys = seed_keys(master, seed_n, payload)
+        etags = {} if etags is None else etags
+        keys = seed_keys(master, seed_n, payload, etags=etags)
     ctx = multiprocessing.get_context(mp_start)
     out_q = ctx.Queue()
     barrier = ctx.Barrier(processes)
+    vol_urls = {url for _, url in keys}
+    stats_before = _scrape_serve_stats(vol_urls)
     procs = []
     for i in range(processes):
         spec = {
@@ -574,6 +641,8 @@ def run_get_fan(
             "index": i * 13,
             "range_every": range_every,
             "ranges": ranges or [],
+            "cond_every": cond_every,
+            "etags": etags or {},
         }
         p = ctx.Process(
             target=_get_fan_worker, args=(spec, out_q, barrier), daemon=True
@@ -601,7 +670,7 @@ def run_get_fan(
         )
     hist = LogHistogram()
     shed_hist = LogHistogram()
-    ops = errors = nbytes = shed = 0
+    ops = errors = nbytes = shed = not_modified = 0
     samples: list[str] = []
     for r in rows:
         hist.merge(LogHistogram.from_row(r["hist"]))
@@ -610,6 +679,7 @@ def run_get_fan(
         ops += r["ops"]
         errors += r["errors"]
         shed += r.get("shed", 0)
+        not_modified += r.get("not_modified", 0)
         nbytes += r["bytes"]
         samples.extend(r["err_samples"])
     wall = max(r["wall_s"] for r in rows)
@@ -617,6 +687,22 @@ def run_get_fan(
     report["shed"] = shed
     if shed:
         report["shed_p99_ms"] = round(shed_hist.quantile(0.99) * 1e3, 3)
+    report["not_modified"] = not_modified
+    report["ratio_304"] = round(not_modified / ops, 4) if ops else 0.0
+    # C fast-path accounting over the run: served/handoffs deltas from
+    # every volume server the keyset touches (hit ratio = the fraction
+    # of requests that never left the C loop)
+    stats_after = _scrape_serve_stats(vol_urls)
+    if stats_after:
+        delta = {
+            k: stats_after.get(k, 0) - stats_before.get(k, 0)
+            for k in ("served", "not_modified", "cache_hits", "handoffs")
+        }
+        denom = delta["served"] + delta["handoffs"]
+        delta["hit_ratio"] = (
+            round(delta["served"] / denom, 4) if denom else 0.0
+        )
+        report["fast_path"] = delta
     report["err_samples"] = samples[:5]
     report["config"] = {
         "master": master,
@@ -627,6 +713,7 @@ def run_get_fan(
         "payload_bytes": payload_bytes,
         "rate_per_conn": rate,
         "range_every": range_every,
+        "cond_every": cond_every,
         "coordinated_omission_safe": rate > 0,
     }
     return report

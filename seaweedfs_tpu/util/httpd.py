@@ -109,6 +109,46 @@ def reply_prefix(status: int, headers: dict | None = None) -> bytes:
     return bytes(buf)
 
 
+def etag_matches(header_value, etag: str) -> bool:
+    """RFC 9110 §13.1.2 If-None-Match evaluation: `*` matches any
+    current representation, otherwise the value is a comma-separated
+    list of entity-tags compared WEAKLY (a `W/` prefix on either side
+    is ignored). The scanner is quote-aware — the etagc grammar allows
+    commas inside a quoted tag, so a naive split would mis-tokenize.
+    Malformed members (unterminated quote, bare token) never match.
+
+    The C serving core (native/serve.c weed_etag_match) implements
+    this exact scanner over the same bytes; keep the two in lockstep —
+    the C-vs-Python identity matrix in tests/ diffs them."""
+    if not header_value:
+        return False
+    v = header_value.strip()
+    if v == "*":
+        return True
+    target = etag[2:] if etag.startswith("W/") else etag
+    i, n = 0, len(v)
+    while i < n:
+        while i < n and v[i] in " \t,":
+            i += 1
+        if i >= n:
+            break
+        if v.startswith("W/", i):
+            i += 2
+        if i < n and v[i] == '"':
+            j = v.find('"', i + 1)
+            if j < 0:
+                return False
+            if v[i : j + 1] == target:
+                return True
+            i = j + 1
+        else:
+            j = v.find(",", i)
+            if j < 0:
+                return False
+            i = j + 1
+    return False
+
+
 class FastRequestMixin:
     """Marks a handler as data-plane: WeedHTTPServer drives it through
     the mini request loop (serve_connection) instead of the stdlib
@@ -137,6 +177,14 @@ class FastRequestMixin:
             buf += b"Connection: close\r\n"
         buf += b"Content-Length: %d\r\n\r\n" % len(body)
         if body and self.command != "HEAD":
+            if len(body) >= 65536:
+                # big bodies skip the header+body concat copy: one
+                # gathering sendmsg (same bytes on the wire) — the
+                # threaded twin of the C loop's writev first flush
+                wv = getattr(self.wfile, "writev", None)
+                if wv is not None:
+                    wv((bytes(buf), body))
+                    return
             buf += body
         self.wfile.write(buf)
 
@@ -337,6 +385,29 @@ class _SockWriter:
                 pos += sent
                 stalled = False
         return n
+
+    def writev(self, bufs) -> int:
+        """Gathering write: header + body land in ONE sendmsg syscall
+        (the threaded path's twin of the C loop's writev reply).
+        Whatever the kernel didn't take drains through the chunked
+        write() loop above, preserving its stall semantics."""
+        total = 0
+        for b in bufs:
+            total += len(b)
+        try:
+            sent = self._sock.sendmsg(bufs)
+        except TimeoutError:
+            sent = 0
+        if sent >= total:
+            return total
+        for b in bufs:
+            blen = len(b)
+            if sent >= blen:
+                sent -= blen
+                continue
+            self.write(memoryview(b)[sent:] if sent else b)
+            sent = 0
+        return total
 
     def flush(self) -> None:
         pass

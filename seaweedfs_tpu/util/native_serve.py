@@ -36,10 +36,16 @@ try:
     from seaweedfs_tpu.native import serve_ext as _serve_ext
 except ImportError:  # pragma: no cover - no compiler on host
     _serve_ext = None
-if _serve_ext is not None and not hasattr(_serve_ext, "loop"):
-    _serve_ext = None  # stale artifact without the loop entry
+if _serve_ext is not None and not (
+    hasattr(_serve_ext, "loop") and hasattr(_serve_ext, "shm_admit")
+):
+    _serve_ext = None  # stale artifact without the current entry points
 
 NATIVE_SERVE_ENABLED = os.environ.get("WEED_NATIVE_SERVE", "1") != "0"
+# C-side plan cache (fd/offset/prefix keyed by path). Independent kill
+# switch: WEED_SERVE_CACHE=0 forces every plan non-cacheable so each
+# request re-resolves, while the rest of the fast path stays native.
+SERVE_CACHE_ENABLED = os.environ.get("WEED_SERVE_CACHE", "1") != "0"
 
 # Stage names attached to a fast-path GET span — the serving-loop
 # counterpart of write_path.WRITE_STAGES (docs/TRACING.md): parse is
@@ -51,6 +57,64 @@ SERVE_STAGES = ("parse", "resolve", "send")
 def available() -> bool:
     """True when the epoll serving core can run in this process."""
     return _serve_ext is not None and NATIVE_SERVE_ENABLED
+
+
+def bump_generation() -> int:
+    """Advance the plan-cache generation counter (process-global).
+
+    The storage layer calls this on ANY mutation that could invalidate
+    a cached (fd, offset, size, headers) plan: needle write, delete,
+    vacuum fd-swap, remount.  Cheap (one relaxed atomic add) and safe
+    to call with the extension missing."""
+    if _serve_ext is None:
+        return 0
+    return _serve_ext.gen_bump()
+
+
+def generation() -> int:
+    """Current plan-cache generation (0 when the extension is absent)."""
+    if _serve_ext is None:
+        return 0
+    return _serve_ext.gen_get()
+
+
+def serve_stats() -> dict:
+    """Process-wide C fast-path counters (empty dict when absent)."""
+    if _serve_ext is None:
+        return {}
+    return _serve_ext.serve_stats()
+
+
+def admission_shm_attach(
+    path: str,
+    rate: float,
+    burst: float,
+    retry_floor: float = 0.0,
+    nslots: int = 1024,
+) -> bool:
+    """Map the shared admission token-bucket file (creating it when
+    first).  Process-global and idempotent; False when the extension is
+    missing (caller keeps the per-process bucket)."""
+    if _serve_ext is None:
+        return False
+    _serve_ext.shm_attach(path, float(rate), float(burst),
+                          float(retry_floor), int(nslots))
+    return True
+
+
+def admission_shm_admit(key: str) -> float:
+    """Charge one request against the shared bucket for `key`.
+
+    0.0 = admitted; positive = rejected, value is the suggested
+    Retry-After in seconds.  Raises RuntimeError when not attached."""
+    if _serve_ext is None:
+        raise RuntimeError("admission shm not attached")
+    return _serve_ext.shm_admit(key)
+
+
+def admission_shm_detach() -> None:
+    if _serve_ext is not None:
+        _serve_ext.shm_detach()
 
 
 def try_serve_forever(server) -> bool:
@@ -83,6 +147,19 @@ def try_serve_forever(server) -> bool:
     server._serve_wake_w = wake_w
     server._serve_done = done
     resolve, handoff, complete = _callbacks(server)
+    # C-side shared-bucket admission: only when this listener is gated
+    # by a SHARED controller (internal listeners have no admission and
+    # must never be charged; a per-process bucket stays in Python)
+    adm = getattr(server, "admission", None)
+    use_adm = 0
+    if adm is not None and getattr(adm, "shared", False):
+        from seaweedfs_tpu import qos as _qos
+
+        # kill-switch parity: WEED_QOS_ADMISSION=0 set at start keeps
+        # the C loop from shedding, like the Python gate (the Python
+        # side re-reads the env per request; the native loop latches
+        # it here — flipping it mid-run needs a restart)
+        use_adm = 1 if _qos.enabled("admission") else 0
     try:
         _serve_ext.loop(
             server.socket.fileno(),
@@ -92,6 +169,7 @@ def try_serve_forever(server) -> bool:
             complete,
             int(getattr(server, "serve_idle_ms", 0) or 0),
             int(getattr(server, "serve_max_reqs", 0) or 0),
+            use_adm,
         )
     except (OSError, ValueError):
         # loop setup failed (epoll exhausted, listen fd gone): fall
@@ -170,7 +248,9 @@ def _callbacks(server):
 
     clock = _time.perf_counter
 
-    def resolve(path, rng, head_only, trace_hdr):
+    cache_on = SERVE_CACHE_ENABLED
+
+    def resolve(path, rng, head_only, trace_hdr, inm):
         # `fast_resolver` is re-read per request: the volume server
         # installs it before serve_forever, but a daemon that never
         # does simply declines everything (gateways)
@@ -180,7 +260,19 @@ def _callbacks(server):
         plan = fr(path, rng, head_only)
         if plan is None:
             return None
-        status, prefix, body, fd, off, count = plan
+        if len(plan) == 6:
+            # legacy plan: carries no validator, so a conditional GET
+            # must fall through to the threaded arm for the 304 check
+            if inm is not None:
+                return None
+            status, prefix, body, fd, off, count = plan
+            etag = prefix304 = None
+            gen = cacheable = 0
+        else:
+            (status, prefix, body, fd, off, count,
+             etag, prefix304, gen, cacheable) = plan
+            if not cache_on:
+                cacheable = 0
         sp = None
         if trace_enabled() and (trace_hdr or sample_hit()):
             sp = open_span(
@@ -201,6 +293,10 @@ def _callbacks(server):
             count,
             fd >= 0,  # the loop closes the per-request dup'd fd
             (sp, "HEAD" if head_only else "GET"),
+            etag,
+            prefix304,
+            gen,
+            1 if cacheable else 0,
         )
 
     def handoff(fd, pending, ip, port, nreqs):

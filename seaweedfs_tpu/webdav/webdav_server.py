@@ -45,6 +45,7 @@ class WebDavServer:
         admission_burst: float = 0.0,
         admission_inflight: int = 0,
         admission_procs: int = 1,
+        admission_shm_path: str = "",
     ):
         self.filer = filer
         self.host = host
@@ -72,6 +73,7 @@ class WebDavServer:
                 max_inflight=admission_inflight,
                 procs=admission_procs,
                 label="webdav",
+                shm_path=admission_shm_path,
             )
         self._announce: threading.Thread | None = None
         self._http_server: WeedHTTPServer | None = None

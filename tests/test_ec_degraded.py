@@ -479,7 +479,7 @@ class TestFastPathLoadSignal:
         srv.fast_resolver = srv.fast_resolver.__get__(srv)
         resolve, _handoff, complete = native_serve._callbacks(srv)
         assert srv.load_tracker.inflight() == 0
-        plan = resolve("/1,abc", None, False, "")
+        plan = resolve("/1,abc", None, False, "", None)
         assert plan is not None
         assert srv.load_tracker.inflight() == 1, (
             "fast-path GET invisible to the heartbeat load signal"
@@ -488,7 +488,11 @@ class TestFastPathLoadSignal:
         complete(ctx, 200, 2, 0.0, 0.0, 0.0, 1)
         assert srv.load_tracker.inflight() == 0
         # a declined resolve must not touch the counter
-        assert resolve("/miss", None, False, "") is None
+        assert resolve("/miss", None, False, "", None) is None
+        assert srv.load_tracker.inflight() == 0
+        # a legacy 6-tuple plan cannot validate If-None-Match: a
+        # conditional GET must decline to the threaded arm
+        assert resolve("/1,abc", None, False, "", '"x"') is None
         assert srv.load_tracker.inflight() == 0
 
 
