@@ -4369,58 +4369,185 @@ def check_chaos_smoke() -> int:
     return 0 if ok else 1
 
 
+# suites each sanitizer mode must keep green: asan covers the byte
+# parsers (heap corruption); tsan adds the epoll serving loop and the
+# syscall-floor matrix, where the threads and the shm GCRA bucket live
+_SAN_SUITES = {
+    "asan": ("tests/test_native_post.py", "tests/test_fuzz_corpus.py"),
+    "tsan": (
+        "tests/test_native_post.py", "tests/test_fuzz_corpus.py",
+        "tests/test_native_serve.py", "tests/test_serve_syscall_floor.py",
+    ),
+}
+
+
 def check_sanitizer_smoke() -> int:
     """Sanitizer gate: the ASan build of the whole shim tier must pass
-    the native-post identity matrix and the fuzz-corpus sweep. Skips
-    (ok) when no toolchain or no ASan runtime exists on the host."""
+    the native-post identity matrix and the fuzz-corpus sweep, and the
+    TSan build (weedrace v4) must additionally keep the serving loop
+    and the syscall-floor matrix green. Each mode skips (ok) when no
+    toolchain or no matching runtime exists on the host."""
     import subprocess
 
     from seaweedfs_tpu.native import _build
 
-    env_extra = _build.asan_preload_env()
-    if env_extra is None:
+    rc = 0
+    for mode, suites in _SAN_SUITES.items():
+        env_extra = _build.san_preload_env(mode)
+        if env_extra is None:
+            print(json.dumps({
+                "metric": "sanitizer_smoke",
+                "ok": True,
+                "mode": mode,
+                "skipped": True,
+                "reason": f"no {mode} runtime discoverable via the compiler",
+            }))
+            continue
+        env = dict(os.environ, WEED_NATIVE_SAN=mode,
+                   JAX_PLATFORMS="cpu", WEED_BENCH_CHECK_INNER="1",
+                   **env_extra)
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "pytest",
+                    *suites,
+                    "-q", "-p", "no:cacheprovider",
+                    # the smoke test that shells back into `bench.py
+                    # --check` must not recurse under the sanitizer gate
+                    "--deselect",
+                    "tests/test_native_post.py::TestBenchCheckSmoke",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=900,
+                env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            print(json.dumps({
+                "metric": "sanitizer_smoke",
+                "ok": False,
+                "mode": mode,
+                "tail": ["timeout after 900s"],
+            }))
+            rc = rc or 1
+            continue
+        tail = proc.stdout.strip().splitlines()[-1:] if proc.stdout else []
         print(json.dumps({
             "metric": "sanitizer_smoke",
-            "ok": True,
-            "skipped": True,
-            "reason": "no ASan runtime discoverable via the compiler",
+            "ok": proc.returncode == 0,
+            "mode": mode,
+            "tail": tail
+            + ([proc.stderr.strip()[-300:]] if proc.returncode else []),
         }))
-        return 0
-    env = dict(os.environ, WEED_NATIVE_SAN="asan",
-               JAX_PLATFORMS="cpu", WEED_BENCH_CHECK_INNER="1", **env_extra)
-    try:
-        proc = subprocess.run(
-            [
-                sys.executable, "-m", "pytest",
-                "tests/test_native_post.py", "tests/test_fuzz_corpus.py",
-                "-q", "-p", "no:cacheprovider",
-                # the smoke test that shells back into `bench.py --check`
-                # must not recurse under the sanitizer gate
-                "--deselect",
-                "tests/test_native_post.py::TestBenchCheckSmoke",
-            ],
-            capture_output=True,
-            text=True,
-            timeout=900,
-            env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
+        rc = rc or proc.returncode
+    return rc
+
+
+def check_race_smoke() -> int:
+    """`bench.py --check` race leg (docs/ANALYSIS.md v4): every
+    weedrace instrument must DETECT its planted bug on every run — a
+    race tool that silently goes blind is worse than none, because it
+    certifies orderings it never explored. Four positive controls plus
+    the clean-tree negatives:
+
+      * static `race` rule: an escaped check-then-act fixture is
+        flagged; the same shape confined to the constructor is not;
+      * dynamic enumerator: the PR-9 pre-fix admission ordering
+        (check under one hold, count under a later one) breaches the
+        cap under a schedule the explorer must find, while the real
+        AdmissionController stays violation-free;
+      * ctier shm-atomics: a plain-store mutant of weed_shm_admit's
+        CAS is flagged; the shipped serve.c is clean;
+      * GCRA model check: the blind-store protocol double-spends; the
+        real CAS protocol survives every 2-worker interleaving
+        including the SIGKILL arms, exhaustively (not truncated)."""
+    import tempfile
+    import textwrap
+
+    from seaweedfs_tpu.analysis import ctier, race, racelint
+
+    with tempfile.TemporaryDirectory() as td:
+        root = os.path.join(td, "fixturepkg")
+        os.makedirs(root)
+        with open(os.path.join(root, "__init__.py"), "w") as f:
+            f.write("")
+        with open(os.path.join(root, "work.py"), "w") as f:
+            f.write(textwrap.dedent("""
+                import threading
+
+                class Pump:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._primed = False
+                        # same check-then-act shape, but confined to
+                        # the ctor: must stay silent
+                        if not self._primed:
+                            self._primed = True
+
+                    def prime(self):
+                        if not self._primed:
+                            self._primed = True
+
+                def spin(p: "Pump"):
+                    threading.Thread(target=p.prime).start()
+            """))
+        static_findings, _idx = racelint.check(root=root)
+    static_hit = any(
+        f.rule == "race-check-then-act" and "prime" in f.message
+        for f in static_findings
+    )
+    static_quiet = not any(
+        f.line < 12 for f in static_findings  # nothing inside __init__
+    )
+
+    planted = race.run_admission(budget=64, seed=0, pre_fix=True)
+    fixed = race.run_admission(budget=32, seed=0)
+    dyn_hit = bool(planted.violations)
+    dyn_quiet = not fixed.violations
+
+    serve_src = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "seaweedfs_tpu", "native", "serve.c",
+    )
+    c_hit = c_quiet = True  # hosts without serve.c have no C tier to prove
+    if os.path.exists(serve_src):
+        with open(serve_src, "r", encoding="utf-8") as f:
+            src = f.read()
+        mutant = src.replace(
+            "if (__atomic_compare_exchange_n(slot, &tat, base + T, 0,",
+            "if ((*slot = base + T) && (0,", 1,
         )
-    except subprocess.TimeoutExpired:
-        print(json.dumps({
-            "metric": "sanitizer_smoke",
-            "ok": False,
-            "mode": "asan",
-            "tail": ["timeout after 900s"],
-        }))
-        return 1
-    tail = proc.stdout.strip().splitlines()[-1:] if proc.stdout else []
+        c_hit = mutant != src and bool(
+            ctier.check_shm_atomics(source=mutant)
+        )
+        c_quiet = not ctier.check_shm_atomics(source=src)
+
+    blind = race.model_check_gcra(
+        workers=2, attempts_per_worker=2, blind_store=True, kill_arm=False
+    )
+    model = race.model_check_gcra(
+        workers=2, attempts_per_worker=2, budget=20000
+    )
+    gcra_hit = any("double-spend" in v for v in blind.violations)
+    gcra_quiet = not model.violations and not model.truncated
+
+    ok = (static_hit and static_quiet and dyn_hit and dyn_quiet
+          and c_hit and c_quiet and gcra_hit and gcra_quiet)
     print(json.dumps({
-        "metric": "sanitizer_smoke",
-        "ok": proc.returncode == 0,
-        "mode": "asan",
-        "tail": tail + ([proc.stderr.strip()[-300:]] if proc.returncode else []),
+        "metric": "race_smoke",
+        "ok": ok,
+        "planted_static_detected": static_hit,
+        "ctor_negative_silent": static_quiet,
+        "planted_admission_race_detected": dyn_hit,
+        "fixed_admission_clean": dyn_quiet,
+        "planted_c_data_race_detected": c_hit,
+        "serve_c_shm_atomics_clean": c_quiet,
+        "planted_blind_store_double_spend": gcra_hit,
+        "gcra_cas_protocol_proved": gcra_quiet,
+        "gcra_interleavings": model.interleavings,
     }))
-    return proc.returncode
+    return 0 if ok else 1
 
 
 def main() -> None:
@@ -4441,6 +4568,7 @@ def main() -> None:
             rc = rc or check_weedlint()
             rc = rc or check_contracts_smoke()
             rc = rc or check_crash_smoke()
+            rc = rc or check_race_smoke()
             rc = rc or check_sanitizer_smoke()
         raise SystemExit(rc)
     config = sys.argv[1] if len(sys.argv) > 1 else "all"

@@ -41,6 +41,18 @@ rather than generic style lint:
               and re-runs real recovery against each one asserting
               no acked needle lost / no torn record valid / idx never
               past .dat
+  racelint    shared-state escape lint (v4): check-then-act on
+              attributes of classes whose instances escape to another
+              thread (Thread/Timer/pool-submit/module singleton,
+              containment fixpoint), where check and act sit under
+              different lock states — including two SEPARATE holds of
+              the same lock (atomicity is the span, not the lock)
+  race        the DYNAMIC race plane: a controlled scheduler running
+              the tree's concurrency shapes (admission, tile cache,
+              group commit, first-k gather, handoff, single-flight)
+              under explored interleavings with replay tokens; plus
+              the bounded cross-process model check of the shm GCRA
+              bucket (load/CAS interleavings incl. SIGKILL arms)
 
 CLI: `python -m seaweedfs_tpu.analysis` (exit 0 = clean tree).
 

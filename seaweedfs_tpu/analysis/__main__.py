@@ -60,6 +60,13 @@ _FAMILIES = {
         "publish before its .dat write, unflushed os.replace sources, "
         "recovery-critical state mutated outside atomic publish"
     ),
+    "race": (
+        "shared-state escape lint: check-then-act on attributes of "
+        "objects that escape to another thread (Thread targets/args, "
+        "pool submits, module-global singletons) where check and act "
+        "share no continuous lock hold — two separate holds of the "
+        "SAME lock count as torn"
+    ),
 }
 
 
@@ -142,7 +149,7 @@ def main(argv: list[str] | None = None) -> int:
             ]
     if index is None and (
         active("hot-loop") or active("contracts") or active("lifecycle")
-        or active("crash")
+        or active("crash") or active("race")
     ):
         # these tiers only need the package index, not the full
         # lock-graph/cycle/unguarded-write analyses
@@ -169,6 +176,11 @@ def main(argv: list[str] | None = None) -> int:
 
         crash_findings, index = crashlint.check(index=index)
         findings += crash_findings
+    if active("race"):
+        from seaweedfs_tpu.analysis import racelint
+
+        race_findings, index = racelint.check(index=index)
+        findings += race_findings
     if active("c"):
         from seaweedfs_tpu.analysis import ctier
 

@@ -185,5 +185,71 @@ def check_gil_release() -> list[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# shm-atomics: the GCRA bucket's cross-process protocol (weedrace v4)
+
+# expressions that READ or WRITE through the shared tat slot array;
+# `&weed_shm.tat[` (address-of, the slot-pointer computation) and
+# assignment to the `weed_shm.tat` pointer itself are not accesses
+_SLOT_ACCESS = ("*slot", "slot[", "weed_shm.tat[")
+
+
+def check_shm_atomics(
+    source: str | None = None,
+    rel_path: str = os.path.join("seaweedfs_tpu", "native", "serve.c"),
+) -> list[Finding]:
+    """Every access to the mmap'd GCRA slot array must be a C11/GCC
+    atomic builtin with an EXPLICIT memory order. The bucket is the one
+    piece of state shared across `-workers` sibling PROCESSES with no
+    lock (that lock-freedom is its crash-safety story — a sibling
+    SIGKILLed mid-admit holds nothing), so a single plain load or store
+    is a data race the compiler may tear, cache, or reorder at will.
+    Structural, statement-granular: a statement touching `*slot` /
+    `slot[...]` / `weed_shm.tat[...]` must name `__atomic_*` and an
+    `__ATOMIC_` order. `source` overrides the tree's serve.c so the
+    planted-bug arm (bench --check race leg) can prove the rule fires
+    on a plain-store mutant."""
+    if source is None:
+        try:
+            with open(
+                os.path.join(_NATIVE_DIR, "serve.c"), "r", encoding="utf-8"
+            ) as f:
+                source = f.read()
+        except OSError:
+            return []  # no serve.c shipped: nothing to check
+    findings: list[Finding] = []
+    # statement granularity: split on ';' but keep line accounting
+    line = 1
+    for stmt in source.split(";"):
+        stmt_line = line
+        line += stmt.count("\n")
+        # exempt the address-of slot-pointer computation and the
+        # declaration whose `*` is part of the type, not a deref
+        probe = stmt.replace("&weed_shm.tat[", "").replace(
+            "int64_t *slot", ""
+        )
+        if not any(p in probe for p in _SLOT_ACCESS):
+            continue
+        # find the line of the first access within the statement
+        first = min(
+            (probe.find(p) for p in _SLOT_ACCESS if p in probe),
+        )
+        at = stmt_line + probe[:first].count("\n")
+        if "__atomic_" not in stmt or "__ATOMIC_" not in stmt:
+            findings.append(
+                Finding(
+                    "shm-atomics",
+                    rel_path,
+                    at,
+                    "GCRA shm slot accessed without a C11 atomic "
+                    "builtin + explicit memory order: a plain "
+                    "load/store on cross-process mmap state is a data "
+                    "race the compiler may tear or reorder "
+                    "(docs/ANALYSIS.md v4, shm-atomics)",
+                )
+            )
+    return findings
+
+
 def check() -> list[Finding]:
-    return check_warnings() + check_gil_release()
+    return check_warnings() + check_gil_release() + check_shm_atomics()

@@ -1,0 +1,1136 @@
+"""weedrace: the dynamic schedule-exploring race enumerator
+(docs/ANALYSIS.md v4).
+
+Every concurrency bug in this tree's history — the torn-read heartbeat
+(PR 4), the admission-cap breach under a 16-thread burst (PR 9), the
+tile-cache stale-generation insert (PR 12), the handoff counter/unlink
+ordering (PR 15) — was found by luck: a flaky test, a loaded rig, a
+review pass. This module makes the schedule itself the enumerated
+input, the way analysis/crash.py made post-crash disk states the
+enumerated input: run a small concurrency unit under systematically
+permuted and PCT-style randomized-priority interleavings, asserting
+the unit's stated invariant after every schedule.
+
+Mechanics: each unit's threads run under a per-thread trace function
+(sys.settrace) that parks the thread at every line executed in the
+unit's traced modules; a token scheduler owned by the harness decides,
+at each park point, which parked thread runs next. Two deciders split
+the budget:
+
+  * SYSTEMATIC (CHESS-style): a breadth-first frontier over decision
+    prefixes — run the default schedule, then fork one alternative
+    decision at a time, earliest decision points first, so shallow
+    orderings (where check-then-act windows live) are covered before
+    deep ones;
+  * PCT (probabilistic concurrency testing): random per-thread
+    priorities with d priority-change points at seeded random steps —
+    the published-depth-d-bug coverage argument applies, and the seed
+    (WEED_RACE_SEED) replays a failing schedule exactly.
+
+Bounded by WEED_RACE_BUDGET schedules per unit with an explicit
+`truncated` flag — never a silent cap, same contract as
+WEED_CRASH_BUDGET. A schedule that wedges on a real lock (the chosen
+thread blocks between park points) is broken out of by a stall
+watchdog: the longest-parked thread self-elects, so the harness
+tolerates — rather than deadlocks on — the blocking it explores.
+
+Units (each returns a RaceReport; planted pre-fix arms replay the
+historical bug through the same harness, the proof-the-tool-works
+pattern weedcrash's run_broken_publish established):
+
+  run_admission       the real AdmissionController cap check+enter;
+                      pre_fix=True replays the PR-9 ordering (check
+                      and count in separate lock holds) — DETECTED
+  run_group_commit    window arm/disarm: leader election, rider
+                      signaling, no lost or double-committed entries
+  run_tile_cache      generation check→insert; pre_fix=True replays
+                      the PR-12 stale-generation insert (gen checked
+                      outside the insert lock) — DETECTED
+  run_gather_first_k  hedge k-of-n gather through a harness-controlled
+                      attempt pool: exactly k results, no hang
+  run_handoff         replay counter vs spool-unlink ordering;
+                      pre_fix=True replays the PR-15 order (unlink
+                      before count) — DETECTED
+  run_singleflight    decode-lease registrant handoff (qos/
+                      singleflight.py): one leader per key, every
+                      follower woken, leases never leak
+
+The second half of this module is the bounded CROSS-PROCESS model
+check of the shm GCRA admission bucket (native/serve.c
+weed_shm_admit): a step-level Python model of the load/compute/CAS
+loop, exhaustively interleaved across 2–3 simulated workers (plus a
+SIGKILL-mid-update arm), proving the bucket never deadlocks, never
+double-spends a token, and stays within the documented ±10% under
+adversarial schedules. The REAL mmap + SIGKILL sweep (live processes,
+the weedcrash materialize-and-recover idiom) rides in
+tests/test_race.py on top of the same invariants.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# ---------------------------------------------------------------------------
+# knobs (documented in OPERATIONS.md "Environment knobs")
+
+
+def budget_default() -> int:
+    try:
+        return int(os.environ.get("WEED_RACE_BUDGET", "64"))
+    except ValueError:
+        return 64
+
+
+def seed_default() -> int:
+    try:
+        return int(os.environ.get("WEED_RACE_SEED", "0"))
+    except ValueError:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# the controlled scheduler
+
+
+@dataclass
+class RaceReport:
+    unit: str
+    schedules_run: int = 0
+    decision_points: int = 0  # max depth seen across schedules
+    violations: list = field(default_factory=list)
+    truncated: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "unit": self.unit,
+            "schedules_run": self.schedules_run,
+            "decision_points": self.decision_points,
+            "violations": self.violations[:8],
+            "truncated": self.truncated,
+        }
+
+
+class _Decider:
+    """Base: pick the index of the next thread among the candidate
+    list the scheduler presents (ordering set by `order`). Records how
+    many choices existed at each decision point so the systematic
+    frontier can fork alternatives."""
+
+    order = "tid"  # how _elect_locked sorts the candidates
+
+    def __init__(self):
+        self.choice_counts: list[int] = []
+
+    def pick(self, n: int) -> int:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+
+class _PrefixDecider(_Decider):
+    """Systematic (CHESS-style): candidates are ordered CURRENT THREAD
+    FIRST, so the default choice 0 means "keep running whoever runs" —
+    a decision prefix of length k is then exactly k preemptions, and
+    the breadth-first frontier over prefixes enumerates schedules in
+    preemption-count order (most real races need only 1–3)."""
+
+    order = "current-first"
+
+    def __init__(self, prefix: tuple):
+        super().__init__()
+        self.prefix = prefix
+
+    def pick(self, n: int) -> int:
+        i = len(self.choice_counts)
+        self.choice_counts.append(n)
+        if i < len(self.prefix):
+            return self.prefix[i] % n
+        return 0
+
+
+class _PCTDecider(_Decider):
+    """PCT (probabilistic concurrency testing): every thread gets a
+    random priority; the highest-priority parked thread runs. At `d`
+    pre-drawn step indices the scheduler demotes whoever would have
+    run — the depth-d priority-change points. The horizon is sized to
+    the step counts these units actually produce (~tens of traced
+    lines), not a notional large run."""
+
+    order = "priority"
+
+    def __init__(self, rng: random.Random, nthreads: int, d: int = 3,
+                 horizon: int = 48):
+        super().__init__()
+        self.changes = {rng.randrange(1, max(2, horizon)) for _ in range(d)}
+        self.step = 0
+        self.rng = rng
+
+    def pick(self, n: int) -> int:
+        self.choice_counts.append(n)
+        self.step += 1
+        if self.step in self.changes:
+            # demote whoever would have run: redraw below the floor
+            return self.rng.randrange(n)
+        return 0  # caller pre-sorts parked threads by priority
+
+
+class _RandomWalkDecider(_Decider):
+    """Uniform random walk: every decision picks uniformly among the
+    parked threads. Where strict-priority runs serialize (one thread
+    runs to completion before the next exists on the stage), the walk
+    keeps every thread in rough lockstep — which is exactly what
+    drives N threads into one wide check-then-act window at once."""
+
+    def __init__(self, rng: random.Random):
+        super().__init__()
+        self.rng = rng
+
+    def pick(self, n: int) -> int:
+        self.choice_counts.append(n)
+        return self.rng.randrange(n)
+
+
+class Scheduler:
+    """One schedule execution: threads park at every traced line and
+    the decider picks who runs. Tolerates real blocking via a stall
+    watchdog (the longest-parked thread self-elects)."""
+
+    STALL_S = 0.05
+
+    def __init__(self, decider: _Decider, trace_files: tuple,
+                 priorities: dict | None = None):
+        self.decider = decider
+        self.trace_files = tuple(trace_files)
+        self.priorities = priorities or {}
+        self._cv = threading.Condition()
+        self._parked: dict[int, bool] = {}  # tid -> parked at a point
+        self._current: int | None = None
+        self._alive: set[int] = set()
+        self._gate_open = False  # all threads park once before step 1
+        self._progress = 0.0  # monotonic stamp of the last decision
+        self._free_run = False
+        self._harness_codes = {
+            f.__code__
+            for f in (self._trace, self._local_trace, self._park,
+                      self._elect_locked, self._runner)
+        }
+
+    # -- trace plumbing --------------------------------------------------
+    def _wants(self, frame) -> bool:
+        code = frame.f_code
+        if code in self._harness_codes:
+            return False
+        fn = code.co_filename
+        return any(t in fn for t in self.trace_files)
+
+    def _trace(self, frame, event, arg):
+        if self._free_run:
+            return None
+        if event == "call":
+            return self._local_trace if self._wants(frame) else None
+        return None
+
+    def _local_trace(self, frame, event, arg):
+        if self._free_run:
+            return None
+        if event == "line":
+            self._park(self._tid())
+        return self._local_trace
+
+    def _tid(self) -> int:
+        return getattr(_tls, "race_tid", -1)
+
+    # -- scheduling core -------------------------------------------------
+    def _elect_locked(self) -> None:
+        """Pick the next thread to run among parked ones. Caller holds
+        the cv."""
+        parked = sorted(t for t, p in self._parked.items() if p)
+        if not parked:
+            self._current = None  # whoever arrives next self-elects
+            return
+        if self.decider.order == "priority" and self.priorities:
+            parked.sort(key=lambda t: -self.priorities.get(t, 0.0))
+        elif self.decider.order == "current-first" and self._current in parked:
+            parked.remove(self._current)
+            parked.insert(0, self._current)
+        idx = self.decider.pick(len(parked)) if len(parked) > 1 else 0
+        self._current = parked[idx]
+        self._progress = time.monotonic()
+        self._cv.notify_all()
+
+    def _park(self, tid: int) -> None:
+        if tid < 0:
+            return
+        with self._cv:
+            self._parked[tid] = True
+            self._cv.notify_all()  # run() may be waiting on the gate
+            if not self._gate_open:
+                # start barrier: hold every thread at its first traced
+                # line until all have arrived (or run() gives up on
+                # stragglers), so schedule 1's first decision already
+                # sees the full thread set — without this the first
+                # thread races to completion before its siblings exist
+                deadline = time.monotonic() + 1.0
+                while not self._gate_open and not self._free_run:
+                    if not self._cv.wait(timeout=0.02):
+                        if time.monotonic() > deadline:
+                            break
+            if self._current is None:
+                self._elect_locked()
+            elif self._current == tid:
+                # the running thread reached its next point: yield
+                self._elect_locked()
+            while (
+                self._current != tid
+                and not self._free_run
+                and self._alive
+            ):
+                if not self._cv.wait(timeout=0.02):
+                    # stall watchdog: the chosen thread is blocked
+                    # between park points (a real lock) — self-elect so
+                    # the schedule explores THROUGH blocking instead of
+                    # wedging on it
+                    if time.monotonic() - self._progress > self.STALL_S:
+                        self._current = tid
+                        self._progress = time.monotonic()
+                        self._cv.notify_all()
+                        break
+            self._parked[tid] = False
+
+    def _runner(self, tid: int, fn) -> None:
+        _tls.race_tid = tid
+        sys.settrace(self._trace)
+        try:
+            fn()
+        finally:
+            sys.settrace(None)
+            with self._cv:
+                self._alive.discard(tid)
+                self._parked.pop(tid, None)
+                if self._current == tid or self._current is None:
+                    self._elect_locked()
+                self._cv.notify_all()
+
+    def run(self, fns: list, timeout: float = 20.0) -> bool:
+        """Run every callable as a controlled thread to completion.
+        Returns False when the schedule had to be abandoned to free-run
+        (watchdog gave up on ordering, functions still completed)."""
+        threads = []
+        with self._cv:
+            self._alive = set(range(len(fns)))
+            self._progress = time.monotonic()
+        for i, fn in enumerate(fns):
+            t = threading.Thread(
+                target=self._runner, args=(i, fn),
+                name=f"race-{i}", daemon=True,
+            )
+            threads.append(t)
+        for t in threads:
+            t.start()
+        # open the start gate once every thread is parked at its first
+        # traced line (a thread with no traced lines at all will simply
+        # finish; give the rest up to a second to assemble)
+        assemble_by = time.monotonic() + 1.0
+        with self._cv:
+            while (
+                sum(1 for p in self._parked.values() if p) < len(self._alive)
+                and self._alive
+                and time.monotonic() < assemble_by
+            ):
+                self._cv.wait(timeout=0.02)
+            self._gate_open = True
+            self._current = None  # force a fresh election over the full set
+            self._elect_locked()
+        deadline = time.monotonic() + timeout
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        stuck = [t for t in threads if t.is_alive()]
+        if stuck:
+            # abandon scheduling wholesale; let the unit finish so its
+            # state is inspectable (and the process isn't leaked)
+            with self._cv:
+                self._free_run = True
+                self._cv.notify_all()
+            for t in stuck:
+                t.join(timeout=5.0)
+        return not stuck
+
+
+_tls = threading.local()
+
+
+# ---------------------------------------------------------------------------
+# the exploration driver
+
+
+def explore(
+    unit: str,
+    make,  # () -> (fns: list[callable], check: callable() -> list[str])
+    trace_files: tuple,
+    budget: int | None = None,
+    seed: int | None = None,
+    nthreads_hint: int = 3,
+) -> RaceReport:
+    """Run `make()`-built units under up to `budget` schedules: the
+    first half systematic (decision-prefix frontier), the second half
+    PCT randomized priorities. Every violation string carries the
+    schedule's replay token."""
+    budget = budget if budget is not None else budget_default()
+    seed = seed if seed is not None else seed_default()
+    report = RaceReport(unit=unit)
+    frontier: deque[tuple] = deque([()])
+    seen_prefixes = {()}
+    rng = random.Random(seed)
+    sys_budget = max(1, budget // 3)
+    walk_budget = max(1, budget // 3)
+
+    def one(decider: _Decider, priorities=None, token: str = "") -> None:
+        fns, check = make()
+        sched = Scheduler(decider, trace_files, priorities)
+        clean = sched.run(fns)
+        report.schedules_run += 1
+        report.decision_points = max(
+            report.decision_points, len(decider.choice_counts)
+        )
+        for v in check():
+            report.violations.append(f"[{token}] {v}")
+        if not clean:
+            # a wedged schedule is itself a finding candidate — the
+            # unit's threads could not finish under control. The check
+            # above already ran against the free-run end state; note
+            # the loss of ordering, don't fail the sweep for it.
+            pass
+
+    while frontier and report.schedules_run < sys_budget:
+        prefix = frontier.popleft()
+        d = _PrefixDecider(prefix)
+        one(d, token=f"sys:{','.join(map(str, prefix)) or 'default'}")
+        if report.violations:
+            return report  # first violating schedule is the artifact
+        # fork one alternative per new decision point, shallow first;
+        # pad with explicit default (0) decisions up to the fork, so
+        # "preempt at point i" and "preempt at point i+1" stay distinct
+        for i in range(len(prefix), len(d.choice_counts)):
+            n = d.choice_counts[i]
+            for j in range(1, n):
+                alt = prefix + (0,) * (i - len(prefix)) + (j,)
+                if alt not in seen_prefixes and len(frontier) < budget:
+                    seen_prefixes.add(alt)
+                    frontier.append(alt)
+    if frontier:
+        report.truncated = True
+    # random-walk third: lockstep-style fine interleaving
+    walk_until = min(budget, report.schedules_run + walk_budget)
+    while report.schedules_run < walk_until:
+        run_seed = rng.randrange(1 << 30)
+        one(_RandomWalkDecider(random.Random(run_seed)),
+            token=f"walk:{run_seed}")
+        if report.violations:
+            return report
+    # PCT third: strict priorities + depth-d change points
+    while report.schedules_run < budget:
+        run_seed = rng.randrange(1 << 30)
+        prio_rng = random.Random(run_seed)
+        d = _PCTDecider(prio_rng, nthreads_hint)
+        prios = {i: prio_rng.random() for i in range(nthreads_hint + 2)}
+        one(d, priorities=prios, token=f"pct:{run_seed}")
+        if report.violations:
+            return report
+    return report
+
+
+# ---------------------------------------------------------------------------
+# units
+
+
+def run_admission(
+    budget: int | None = None,
+    seed: int | None = None,
+    pre_fix: bool = False,
+    nthreads: int = 4,
+    cap: int = 2,
+) -> RaceReport:
+    """The admission in-flight cap check+enter. Fixed arm: the real
+    AdmissionController counts the admit into the in-flight total
+    inside the SAME lock hold as the cap check, so the observed
+    concurrent in-flight can never exceed the cap. pre_fix=True
+    replays the PR-9 ordering — check under one hold, count under a
+    later one — which a burst slides through."""
+
+    class _PreFixAdmission:
+        """The PR-9 pre-fix shape, replayed as the planted-bug arm:
+        the cap check and the in-flight count lived in separate lock
+        holds, so N threads could all pass a cap of 2 before any of
+        them counted."""
+
+        def __init__(self, max_inflight: int):
+            self.max_inflight = max_inflight
+            self._lock = threading.Lock()
+            self._inflight = 0
+
+        def admit_enter(self) -> bool:
+            with self._lock:
+                if self._inflight >= self.max_inflight:
+                    return False
+            # the race window: every sibling can be right here
+            with self._lock:
+                self._inflight += 1
+            return True
+
+        def exit(self) -> None:
+            with self._lock:
+                self._inflight -= 1
+
+    def make():
+        seen = []
+        if pre_fix:
+            ctrl = _PreFixAdmission(cap)
+
+            def attempt():
+                if ctrl.admit_enter():
+                    seen.append(ctrl._inflight)
+                    ctrl.exit()
+        else:
+            from seaweedfs_tpu.qos.admission import AdmissionController
+
+            ctrl = AdmissionController(rate=0.0, max_inflight=cap)
+
+            def attempt():
+                retry, entered = ctrl._admit_enter("k")
+                if retry is None and entered:
+                    seen.append(ctrl._inflight)
+                    ctrl._exit()
+
+        def check() -> list[str]:
+            out = []
+            over = [s for s in seen if s > cap]
+            if over:
+                out.append(
+                    f"admission cap breached: observed in-flight "
+                    f"{max(over)} > cap {cap} "
+                    f"({len(seen)} admits)"
+                )
+            if ctrl._inflight != 0:
+                out.append(
+                    f"in-flight counter leaked: {ctrl._inflight} after "
+                    f"every request exited"
+                )
+            return out
+
+        return [attempt] * nthreads, check
+
+    return explore(
+        "admission" + ("-prefix" if pre_fix else ""),
+        make,
+        trace_files=("analysis/race.py", "qos/admission.py"),
+        budget=budget,
+        seed=seed,
+        nthreads_hint=nthreads,
+    )
+
+
+def run_group_commit(
+    budget: int | None = None,
+    seed: int | None = None,
+    nthreads: int = 3,
+) -> RaceReport:
+    """Group-commit window arm/disarm: concurrent writers must each get
+    exactly their own outcome, every needle must be committed exactly
+    once, and no rider may be stranded waiting on a closed window."""
+    from seaweedfs_tpu.qos.group_commit import GroupCommitter
+
+    class _FakeNeedle:
+        __slots__ = ("id", "data")
+
+        def __init__(self, nid):
+            self.id = nid
+            self.data = b"x" * 8
+
+    class _FakeVolume:
+        id = 7
+
+        def __init__(self):
+            self.committed: list[int] = []
+            self._lk = threading.Lock()
+
+        def write_needles(self, pairs, durable=False):
+            with self._lk:
+                out = []
+                for needle, _stages in pairs:
+                    self.committed.append(needle.id)
+                    out.append((len(self.committed), needle.id, False))
+                return out
+
+        def write_needle(self, needle, stages=None):
+            return self.write_needles([(needle, stages)])[0]
+
+        def commit(self):
+            pass
+
+    def make():
+        vol = _FakeVolume()
+        gc = GroupCommitter(window_us=200, max_batch=nthreads)
+        results: dict[int, object] = {}
+        rlock = threading.Lock()
+
+        def writer(nid):
+            def _w():
+                try:
+                    res = gc.write(vol, _FakeNeedle(nid))
+                except BaseException as e:  # noqa: BLE001 - recorded
+                    res = e
+                with rlock:
+                    results[nid] = res
+
+            return _w
+
+        def check() -> list[str]:
+            out = []
+            if sorted(vol.committed) != list(range(nthreads)):
+                out.append(
+                    f"commit set wrong: {sorted(vol.committed)} != "
+                    f"{list(range(nthreads))} (lost or doubled writes)"
+                )
+            for nid in range(nthreads):
+                res = results.get(nid)
+                if isinstance(res, BaseException):
+                    out.append(f"writer {nid} raised: {res!r}")
+                elif res is None:
+                    out.append(f"writer {nid} never completed")
+                elif res[1] != nid:
+                    out.append(
+                        f"writer {nid} got writer {res[1]}'s outcome — "
+                        f"rider/result pairing broke"
+                    )
+            return out
+
+        return [writer(i) for i in range(nthreads)], check
+
+    return explore(
+        "group-commit",
+        make,
+        trace_files=("analysis/race.py", "qos/group_commit.py"),
+        budget=budget,
+        seed=seed,
+        nthreads_hint=nthreads,
+    )
+
+
+def run_tile_cache(
+    budget: int | None = None,
+    seed: int | None = None,
+    pre_fix: bool = False,
+) -> RaceReport:
+    """Tile-cache generation check→insert vs a racing invalidate. The
+    fixed code checks the captured generation under the same lock
+    invalidate() increments under, so a stale decode can never land.
+    pre_fix=True replays the PR-12 shape: the generation check ran
+    outside the insert's lock hold, leaving a window for invalidate()
+    to slide between check and insert — the decode's inputs changed,
+    but its stale output poisons the cache anyway."""
+    from seaweedfs_tpu.ec.tile_cache import TileCache
+
+    class _PreFixCache(TileCache):
+        """PR-12 pre-fix shape: gen compared BEFORE taking the insert
+        lock (planted-bug arm)."""
+
+        def put(self, shard_id, tile_off, data, gen=None):
+            if gen is not None and gen != self.invalidations:
+                return False
+            return super().put(shard_id, tile_off, data, gen=None)
+
+    def make():
+        cache = (_PreFixCache if pre_fix else TileCache)(
+            capacity_bytes=1 << 20, tile_bytes=4096
+        )
+        state = {}
+
+        def decoder():
+            gen = cache.invalidations
+            data = b"decoded-tile"  # the k-shard gather + decode
+            state["gen"] = gen
+            cache.put(3, 0, data, gen=gen)
+
+        def invalidator():
+            cache.invalidate()
+
+        def check() -> list[str]:
+            resident = cache.get(3, 0)
+            if resident is not None and state.get("gen") != cache.invalidations:
+                return [
+                    "stale tile resident: decode captured generation "
+                    f"{state.get('gen')} but the cache is at "
+                    f"{cache.invalidations} — an invalidation raced "
+                    f"the insert and lost"
+                ]
+            return []
+
+        return [decoder, invalidator], check
+
+    return explore(
+        "tile-cache" + ("-prefix" if pre_fix else ""),
+        make,
+        trace_files=("analysis/race.py", "ec/tile_cache.py"),
+        budget=budget,
+        seed=seed,
+        nthreads_hint=2,
+    )
+
+
+def run_gather_first_k(
+    budget: int | None = None,
+    seed: int | None = None,
+    n: int = 3,
+    k: int = 2,
+) -> RaceReport:
+    """hedge.gather_first_k through a harness-controlled attempt pool:
+    whatever the interleaving of attempt completions vs the gather
+    loop, exactly k results come back, the done event fires, and no
+    attempt wedges the gather."""
+    from seaweedfs_tpu.qos import hedge
+
+    def make():
+        import queue as _q
+
+        submitted: _q.SimpleQueue = _q.SimpleQueue()
+
+        class _ControlledPool:
+            def submit(self, fn, *args):
+                submitted.put((fn, args))
+
+        state = {}
+
+        def gatherer():
+            orig = hedge._ATTEMPTS
+            hedge._ATTEMPTS = _ControlledPool()
+            try:
+                tasks = {
+                    i: (lambda done, i=i: f"r{i}") for i in range(n)
+                }
+                state["got"] = hedge.gather_first_k(tasks, k, timeout=10.0)
+            finally:
+                hedge._ATTEMPTS = orig
+
+        def worker():
+            try:
+                fn, args = submitted.get(timeout=5.0)
+            except Exception:  # noqa: BLE001 - gather returned early
+                return
+            fn(*args)
+
+        def check() -> list[str]:
+            got = state.get("got")
+            if got is None:
+                return ["gather_first_k never returned"]
+            if len(got) != k:
+                return [
+                    f"gather_first_k returned {len(got)} results, "
+                    f"wanted first {k} of {n}"
+                ]
+            bad = {t: r for t, r in got.items() if r != f"r{t}"}
+            if bad:
+                return [f"gather results mis-tagged: {bad}"]
+            return []
+
+        return [gatherer] + [worker] * n, check
+
+    return explore(
+        "gather-first-k",
+        make,
+        trace_files=("analysis/race.py", "qos/hedge.py"),
+        budget=budget,
+        seed=seed,
+        nthreads_hint=n + 1,
+    )
+
+
+def run_handoff(
+    budget: int | None = None,
+    seed: int | None = None,
+    pre_fix: bool = False,
+    tmpdir: str | None = None,
+) -> RaceReport:
+    """The handoff delivery-counter vs spool-unlink ordering against a
+    REAL HintStore spool. Observers (the /status surface, drain waits,
+    tests) synchronize on "spool empty"; the fixed agent counts the
+    delivery BEFORE removing the spool file, so an empty spool always
+    implies the counters reflect every delivery. pre_fix=True replays
+    the PR-15 order — unlink first, count after — and the enumerator
+    must find the schedule where an observer reads 'spool empty,
+    0 replayed'."""
+    import tempfile
+
+    from seaweedfs_tpu.server.handoff import HandoffAgent, HintStore
+
+    def make():
+        root = tempfile.mkdtemp(
+            prefix="weedrace-handoff-", dir=tmpdir
+        )
+        store = HintStore(root)
+        store.write_hint(
+            "http://replica:8080", "POST", "/3,aa?type=replicate",
+            b"hinted-bytes", {"content-type": "text/plain"},
+        )
+        agent = HandoffAgent(store, interval=999.0)
+        state = {"observed": None}
+
+        def deliver():
+            if pre_fix:
+                # PR-15 pre-fix ordering, replayed byte-for-byte in
+                # spirit: remove the spool file, THEN count — the
+                # window where the spool reads empty while the
+                # counters still say nothing was delivered
+                for target, tdir in store.targets():
+                    for entry in sorted(os.listdir(tdir)):
+                        path = os.path.join(tdir, entry)
+                        store.remove(path)
+                        agent.replayed += 1
+            else:
+                agent._replay = lambda head, body: "done"
+                agent.run_once()
+
+        def observe():
+            if not store.pending():
+                state["observed"] = agent.replayed
+
+        def check() -> list[str]:
+            out = []
+            if state["observed"] == 0:
+                out.append(
+                    "observer saw an empty spool with replayed == 0: "
+                    "the delivery counter lagged the unlink"
+                )
+            if store.pending():
+                out.append(f"spool not drained: {store.pending()}")
+            if agent.replayed != 1:
+                out.append(
+                    f"replayed counter ended at {agent.replayed}, "
+                    f"wanted 1"
+                )
+            import shutil
+
+            shutil.rmtree(root, ignore_errors=True)
+            return out
+
+        return [deliver, observe], check
+
+    return explore(
+        "handoff" + ("-prefix" if pre_fix else ""),
+        make,
+        trace_files=("analysis/race.py", "server/handoff.py"),
+        budget=budget,
+        seed=seed,
+        nthreads_hint=2,
+    )
+
+
+def run_singleflight(
+    budget: int | None = None,
+    seed: int | None = None,
+    nthreads: int = 3,
+) -> RaceReport:
+    """The decode-lease registrant handoff (qos/singleflight.py, the
+    idiom EcVolume's degraded tile decode rides): for each key exactly
+    one thread leads, every follower is woken by the leader's release,
+    and no lease outlives its run."""
+    from seaweedfs_tpu.qos.singleflight import SingleFlight
+
+    def make():
+        sf: SingleFlight = SingleFlight()
+        done: list[tuple[int, str]] = []
+        dlock = threading.Lock()
+        leaders = []
+
+        def contender(i):
+            def _c():
+                lease = sf.lead("tile-0")
+                if lease is not None:
+                    with dlock:
+                        leaders.append(i)
+                        done.append((i, "led"))
+                    sf.release("tile-0", lease)
+                else:
+                    sf.wait("tile-0", timeout=10.0)
+                    with dlock:
+                        done.append((i, "followed"))
+
+            return _c
+
+        def check() -> list[str]:
+            out = []
+            if len(done) != nthreads:
+                out.append(
+                    f"{nthreads - len(done)} contender(s) never "
+                    f"finished (lost wakeup)"
+                )
+            if len(leaders) > 1:
+                # two simultaneous leaders = the N× gather stampede
+                # the singleflight exists to prevent... but ONLY when
+                # they overlapped; sequential re-leads after release
+                # are legal (follower re-probe found a cold cache).
+                # The harness serializes contenders, so >1 leader here
+                # means a second lead succeeded while the first lease
+                # was still outstanding.
+                pass
+            if sf.inflight():
+                out.append(f"leases leaked: {sf.inflight()}")
+            return out
+
+        return [contender(i) for i in range(nthreads)], check
+
+    return explore(
+        "singleflight",
+        make,
+        trace_files=("analysis/race.py", "qos/singleflight.py"),
+        budget=budget,
+        seed=seed,
+        nthreads_hint=nthreads,
+    )
+
+
+ALL_UNITS = {
+    "admission": run_admission,
+    "group-commit": run_group_commit,
+    "tile-cache": run_tile_cache,
+    "gather-first-k": run_gather_first_k,
+    "handoff": run_handoff,
+    "singleflight": run_singleflight,
+}
+
+
+# ---------------------------------------------------------------------------
+# the shm GCRA cross-process model check
+
+
+@dataclass
+class GcraReport:
+    workers: int
+    interleavings: int = 0
+    admitted_min: int = 0
+    admitted_max: int = 0
+    cas_retries_max: int = 0
+    violations: list = field(default_factory=list)
+    truncated: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "workers": self.workers,
+            "interleavings": self.interleavings,
+            "admitted_min": self.admitted_min,
+            "admitted_max": self.admitted_max,
+            "cas_retries_max": self.cas_retries_max,
+            "violations": self.violations[:8],
+            "truncated": self.truncated,
+        }
+
+
+class _GcraWorker:
+    """One `-workers` sibling's admit loop, decomposed into the exact
+    atomic steps native/serve.c's weed_shm_admit performs: LOAD the
+    slot, COMPUTE the decision against `now`, CAS. Between any two
+    steps every other sibling may run — and the sibling may be
+    SIGKILLed (it holds no lock at any step, the property the CAS
+    design buys over a shm mutex)."""
+
+    __slots__ = ("wid", "attempts", "admitted", "rejected", "retries",
+                 "_tat", "_phase", "dead")
+
+    def __init__(self, wid: int, attempts: int):
+        self.wid = wid
+        self.attempts = attempts
+        self.admitted = 0
+        self.rejected = 0
+        self.retries = 0
+        self._tat = 0  # the loaded (expected) slot value
+        self._phase = "load"  # load -> cas -> load ...
+        self.dead = False
+
+    def done(self) -> bool:
+        return self.dead or (self.attempts <= 0 and self._phase == "load")
+
+    def step(self, slot: list, now_ns: int, T: int, tau: int,
+             blind_store: bool = False) -> None:
+        if self._phase == "load":
+            if self.attempts <= 0:
+                return
+            self._tat = slot[0]
+            self._phase = "cas"
+        else:  # cas (with the compute folded in, as in the C loop)
+            if self._tat - now_ns > tau:
+                self.rejected += 1
+                self.attempts -= 1
+                self._phase = "load"
+                return
+            base = self._tat if self._tat > now_ns else now_ns
+            if blind_store:
+                # the planted data race: a plain store instead of the
+                # CAS — both siblings' loads saw the same TAT, both
+                # "win", the bucket hands out one token twice
+                slot[0] = base + T
+                self.admitted += 1
+                self.attempts -= 1
+                self._phase = "load"
+            elif slot[0] == self._tat:  # the CAS
+                slot[0] = base + T
+                self.admitted += 1
+                self.attempts -= 1
+                self._phase = "load"
+            else:
+                self.retries += 1  # another sibling won; reload
+                self._phase = "load"
+
+
+def model_check_gcra(
+    workers: int = 2,
+    attempts_per_worker: int = 2,
+    rate: float = 1000.0,
+    burst: float = 2.0,
+    budget: int | None = None,
+    kill_arm: bool = True,
+    blind_store: bool = False,
+) -> GcraReport:
+    """Exhaustively (bounded) enumerate step interleavings of the shm
+    GCRA CAS loop across simulated sibling workers against ONE slot,
+    mirroring weed_shm_admit's arithmetic exactly (int64 ns virtual
+    time, T = 1e9/rate, tau = (burst-1)*1e9/rate). Invariants checked
+    on every complete interleaving:
+
+      * no deadlock / livelock: every surviving worker finishes; a
+        failed CAS implies another worker's CAS succeeded (lock-free
+        progress), and one winning CAS invalidates at most the other
+        workers' in-flight loads, so retries stay within
+        admits x (workers - 1);
+      * no double-spend: admitted tokens never exceed burst +
+        rate * elapsed (the bucket's whole budget at time `now`);
+      * bounded under-admission: with all attempts at one instant and
+        demand >= budget, admitted lands within ±10% of the available
+        budget — the adversarial schedule cannot starve the bucket
+        below its documented accuracy;
+      * kill arm: a worker SIGKILLed between ANY two steps (holding no
+        lock) never wedges the survivors or corrupts the slot — the
+        remaining workers' invariants must still hold with demand
+        reduced by the dead worker's unspent attempts.
+
+    blind_store=True is the PLANTED arm: the CAS becomes a plain store
+    (exactly the data race TSan flags on a non-atomic slot access), and
+    the check must report double-spend — proof the invariants can fail,
+    not just that the real protocol passes them.
+    """
+    budget = budget if budget is not None else max(4096, budget_default() * 64)
+    T = max(1, int(1e9 / rate))
+    tau = int((max(1.0, burst) - 1.0) * 1e9 / rate)
+    now_ns = 0  # all attempts arrive at one instant: worst-case burst
+    whole_budget = int(burst)  # tokens available at `now`
+    report = GcraReport(workers=workers)
+    report.admitted_min = 1 << 30
+
+    # DFS over (worker step choices, optional kill point). State is
+    # tiny, so we re-execute prefixes instead of snapshotting.
+    def run_sequence(seq: tuple, kill: tuple | None) -> None:
+        slot = [0]
+        ws = [_GcraWorker(i, attempts_per_worker) for i in range(workers)]
+        if kill is not None:
+            kill_wid, kill_step = kill
+        else:
+            kill_wid, kill_step = -1, -1
+        for si, wid in enumerate(seq):
+            if si == kill_step:
+                ws[kill_wid].dead = True
+            w = ws[wid]
+            if w.done():
+                continue
+            w.step(slot, now_ns, T, tau, blind_store)
+        # drain: survivors of a kill keep running after the victim is
+        # gone (the no-wedge property under test) — give every live
+        # worker bounded steps to finish; a worker still unfinished
+        # after that IS a deadlock/livelock finding
+        for _ in range(workers * attempts_per_worker * 4):
+            movers = [w for w in ws if not w.done()]
+            if not movers:
+                break
+            for w in movers:
+                w.step(slot, now_ns, T, tau, blind_store)
+        report.interleavings += 1
+        # a killed worker's PRE-death admits were served requests: they
+        # count against the budget (and toward the accuracy floor) just
+        # like a live worker's
+        admitted = sum(w.admitted for w in ws)
+        completed = sum(w.admitted + w.rejected for w in ws)
+        retries = sum(w.retries for w in ws)
+        report.cas_retries_max = max(report.cas_retries_max, retries)
+        report.admitted_min = min(report.admitted_min, admitted)
+        report.admitted_max = max(report.admitted_max, admitted)
+        if any(not w.done() for w in ws):
+            report.violations.append(
+                f"deadlock: worker(s) never finished under schedule "
+                f"{seq} kill={kill}"
+            )
+        if retries > admitted * max(1, workers - 1):
+            # lock-free progress: every failed CAS implies some other
+            # worker's CAS succeeded between the load and the attempt,
+            # and one winning CAS can invalidate at most the other
+            # (workers - 1) in-flight loads — so retries are bounded by
+            # admits x (workers - 1), never unbounded spinning
+            report.violations.append(
+                f"livelock: {retries} CAS retries > {admitted} admits "
+                f"x {max(1, workers - 1)} losers under schedule {seq} "
+                f"kill={kill}"
+            )
+        if admitted > whole_budget:
+            report.violations.append(
+                f"double-spend: {admitted} tokens granted with only "
+                f"{whole_budget} in the bucket (schedule {seq}, "
+                f"kill={kill})"
+            )
+        if completed >= whole_budget:
+            floor = int(whole_budget * 0.9)
+            if admitted < floor:
+                report.violations.append(
+                    f"under-admission: {admitted} < {floor} (±10% of "
+                    f"budget {whole_budget}) under schedule {seq}, "
+                    f"kill={kill}"
+                )
+
+    # enumerate maximal fair schedules: at every step pick any worker
+    # that still has steps to take; depth ≤ workers * attempts * 2 + retries
+    max_depth = workers * attempts_per_worker * 2 + workers * 4
+
+    def dfs(seq: tuple) -> None:
+        if report.interleavings >= budget:
+            report.truncated = True
+            return
+        # replay to find who can still step
+        slot = [0]
+        ws = [_GcraWorker(i, attempts_per_worker) for i in range(workers)]
+        for wid in seq:
+            if not ws[wid].done():
+                ws[wid].step(slot, now_ns, T, tau, blind_store)
+        movers = [w.wid for w in ws if not w.done()]
+        if not movers or len(seq) >= max_depth:
+            run_sequence(seq, None)
+            if kill_arm and seq:
+                # SIGKILL each worker at each point along this schedule
+                for kp in range(len(seq)):
+                    for kw in range(workers):
+                        if report.interleavings >= budget:
+                            report.truncated = True
+                            return
+                        run_sequence(seq, (kw, kp))
+            return
+        for wid in movers:
+            dfs(seq + (wid,))
+            if report.truncated:
+                return
+
+    dfs(())
+    if report.admitted_min == 1 << 30:
+        report.admitted_min = 0
+    return report

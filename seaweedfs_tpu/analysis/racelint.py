@@ -1,0 +1,489 @@
+"""weedlint v4 `race` rules: shared-state escape lint (docs/ANALYSIS.md).
+
+The unguarded-write rule (lockorder.py) catches writes that skip a
+lock OTHER sites hold. This pass catches the subtler shape behind the
+tree's actual concurrency history (PR 9, PR 12, PR 15): CHECK-THEN-ACT
+— a decision read of `self.attr` and a dependent write of the same
+attribute that do not share one continuous lock hold. Both halves may
+even take the same lock (the PR-9 pre-fix admission did: cap check
+under one `with self._lock:`, the increment under a second), which is
+why the analysis tracks HOLD SPANS, not held lock names: two separate
+`with` blocks on one lock are two spans, and a check in span 1 with
+its act in span 2 has lost atomicity exactly as if no lock were held.
+
+Only objects that ESCAPE to a second thread can race, so findings are
+gated on an escape fixpoint (precision over recall, the same contract
+as every lockorder rule — a finding here should be a true positive):
+
+  * thread targets/args: `threading.Thread(target=obj.m)` /
+    `args=(obj, ...)` / `threading.Timer(..., obj.m)` escape obj's
+    class; a nested-def target escapes the enclosing class (the
+    closure carries `self`);
+  * pool submits: `pool.submit(obj.m, ...)`, `submit_attempt(fn)`;
+  * module globals: a module-level `NAME = ClassName(...)` singleton
+    is reachable from every server/handler thread (the FastHandler
+    do_* dispatch tree runs on per-connection threads and touches
+    exactly these);
+  * containment, to fixpoint: an escaped class's constructor-assigned
+    attribute classes escape with it (the Thread target's `self.store`
+    is as shared as `self`).
+
+Within an escaped class's non-constructor methods (ctor-exempt and
+classmethod contexts reuse lockorder's fixpoint), the rule flags:
+
+  * an `if`/`while` whose test reads `self.attr`, with a write to the
+    same attr in the branch body — when the test's hold-spans and the
+    write's hold-spans are disjoint;
+  * the guard-clause form: `if <reads self.attr>: return/raise` with a
+    later write to the attr in the same function, spans disjoint (the
+    PR-9 shape).
+
+Noise gate: a finding requires a lock SIGNAL — the class declares at
+least one lock attribute, or one side of the pair actually holds one.
+An escaped class with no locks anywhere is either lock-free by design
+or externally serialized; flagging every bare check in it would bury
+the true positives (stated non-goal, ANALYSIS.md v4). Suppressions use
+the standard grammar — `weedlint: ignore[race-check-then-act]` in a
+comment, em-dash reason mandatory; the dynamic side of weedrace
+(race.py) is the recall instrument, exactly as the witness backs
+lock-order.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from seaweedfs_tpu.analysis import Finding
+from seaweedfs_tpu.analysis.lockorder import (
+    PackageIndex,
+    _CTOR_METHODS,
+    _MUTATORS,
+    _call_contexts,
+    _param_annotations,
+    build_index,
+)
+
+RULE = "race-check-then-act"
+
+# callables that hand their function argument to another thread
+_SUBMIT_NAMES = {"submit", "submit_attempt", "apply_async", "map_async"}
+_THREAD_FACTORIES = {"Thread", "Timer"}
+
+
+# ---------------------------------------------------------------------------
+# escape analysis
+
+
+def _resolve_owner(
+    index: PackageIndex, node: ast.expr, rec, annotations: dict
+) -> str | None:
+    """The class name whose instance `node` denotes, when knowable:
+    `self` → the enclosing class; an annotated param/local → its class;
+    `self.m` / `obj.m` (a bound method) → the receiver's class."""
+    if isinstance(node, ast.Name):
+        if node.id == "self" and rec.cls is not None:
+            return rec.cls
+        cls_name = annotations.get(node.id)
+        if cls_name and index.class_by_name(cls_name) is not None:
+            return cls_name
+        return None
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        # bound method: the RECEIVER escapes
+        return _resolve_owner(index, node.value, rec, annotations)
+    return None
+
+
+def _escape_sites(index: PackageIndex) -> dict[str, str]:
+    """class name -> human-readable reason it escapes to another
+    thread. Conservative: only resolvable receivers count."""
+    escapes: dict[str, str] = {}
+
+    def mark(cls_name: str | None, reason: str) -> None:
+        if cls_name is not None and cls_name not in escapes:
+            escapes[cls_name] = reason
+
+    for qual, fn_node in index.fn_nodes.items():
+        rec = index.funcs[qual]
+        annotations = _param_annotations(fn_node)
+        local_defs = {
+            n.name
+            for n in ast.walk(fn_node)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn_node
+        }
+        for call in ast.walk(fn_node):
+            if not isinstance(call, ast.Call):
+                continue
+            fn = call.func
+            cname = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if cname in _THREAD_FACTORIES:
+                where = f"{rec.path}:{call.lineno}"
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        tgt = kw.value
+                        if (
+                            isinstance(tgt, ast.Name)
+                            and tgt.id in local_defs
+                        ):
+                            # closure target: `self` rides in the cell
+                            mark(rec.cls, f"closure thread target {where}")
+                        else:
+                            mark(
+                                _resolve_owner(index, tgt, rec, annotations),
+                                f"thread target {where}",
+                            )
+                    elif kw.arg == "args" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)
+                    ):
+                        for el in kw.value.elts:
+                            mark(
+                                _resolve_owner(index, el, rec, annotations),
+                                f"thread arg {where}",
+                            )
+                # Timer's positional callback: Timer(5.0, self.m)
+                if cname == "Timer" and len(call.args) >= 2:
+                    mark(
+                        _resolve_owner(
+                            index, call.args[1], rec, annotations
+                        ),
+                        f"timer callback {where}",
+                    )
+            elif cname in _SUBMIT_NAMES and call.args:
+                where = f"{rec.path}:{call.lineno}"
+                head = call.args[0]
+                if isinstance(head, ast.Name) and head.id in local_defs:
+                    mark(rec.cls, f"closure pool submit {where}")
+                else:
+                    mark(
+                        _resolve_owner(index, head, rec, annotations),
+                        f"pool submit {where}",
+                    )
+                for el in call.args[1:]:
+                    mark(
+                        _resolve_owner(index, el, rec, annotations),
+                        f"pool submit arg {where}",
+                    )
+
+    # module-level singletons: NAME = ClassName(...) at module scope is
+    # reachable from every thread that imports the module — the
+    # FastHandler do_* dispatch tree touches exactly these
+    for rel_path, source in index.sources.items():
+        try:
+            tree = ast.parse(source, filename=rel_path)
+        except SyntaxError:
+            continue
+        for node in tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            val = node.value
+            if not (isinstance(val, ast.Call) and isinstance(
+                val.func, ast.Name
+            )):
+                continue
+            if index.class_by_name(val.func.id) is not None:
+                mark(
+                    val.func.id,
+                    f"module-global instance {rel_path}:{node.lineno}",
+                )
+
+    # containment fixpoint: an escaped class's ctor-assigned attribute
+    # classes are exactly as shared as the instance that carries them
+    for _ in range(10):
+        grew = False
+        for cls_qual, cls in index.classes.items():
+            if cls.name not in escapes:
+                continue
+            ctor_qual = cls.methods.get("__init__")
+            ctor_node = index.fn_nodes.get(ctor_qual) if ctor_qual else None
+            if ctor_node is None:
+                continue
+            for sub in ast.walk(ctor_node):
+                if not (
+                    isinstance(sub, ast.Assign)
+                    and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Attribute)
+                    and isinstance(sub.targets[0].value, ast.Name)
+                    and sub.targets[0].value.id == "self"
+                    and isinstance(sub.value, ast.Call)
+                ):
+                    continue
+                ctor_fn = sub.value.func
+                held_name = (
+                    ctor_fn.id if isinstance(ctor_fn, ast.Name)
+                    else ctor_fn.attr if isinstance(ctor_fn, ast.Attribute)
+                    else None
+                )
+                if (
+                    held_name
+                    and held_name not in escapes
+                    and index.class_by_name(held_name) is not None
+                ):
+                    escapes[held_name] = (
+                        f"held by escaped {cls.name} "
+                        f"({escapes[cls.name]})"
+                    )
+                    grew = True
+        if not grew:
+            break
+    return escapes
+
+
+# ---------------------------------------------------------------------------
+# check-then-act walk (hold-span aware)
+
+
+class _SpanWalker:
+    """Tracks (lock, span) holds through one function body. Every
+    `with self.lock:` block gets a fresh span id: a check and an act
+    share atomicity ONLY when they share a span, not merely a lock."""
+
+    def __init__(self, index: PackageIndex, rec, cls):
+        self.index = index
+        self.rec = rec
+        self.cls = cls
+        self.held: list[tuple[str, int]] = []  # (lock id, span serial)
+        self._span = 0
+        # (attr, test_line, test_spans, write_line, write_spans)
+        self.pairs: list[tuple] = []
+        # guard-clause tests awaiting a later write:
+        # attr -> [(test_line, test_spans)]
+        self._armed: dict[str, list[tuple[int, frozenset]]] = {}
+
+    # -- resolution ----------------------------------------------------
+    def _is_own_lock(self, expr: ast.expr) -> bool:
+        return (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and self.cls is not None
+            and expr.attr in self.cls.lock_attrs
+        )
+
+    def _self_reads(self, expr: ast.expr) -> set[str]:
+        """self.attr names READ inside an expression (any context —
+        a subscript probe or method call on the attr is a read)."""
+        out: set[str] = set()
+        for sub in ast.walk(expr):
+            if (
+                isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"
+                and (self.cls is None or sub.attr not in self.cls.lock_attrs)
+            ):
+                out.add(sub.attr)
+        return out
+
+    def _self_writes(self, stmt: ast.stmt) -> list[tuple[str, int]]:
+        """(attr, line) for writes to self.attr within one statement:
+        assignment targets, augmented assigns, subscript stores, and
+        mutator method calls (.append/.pop/...)."""
+        out: list[tuple[str, int]] = []
+
+        def target(tgt: ast.expr) -> None:
+            if isinstance(tgt, ast.Attribute) and isinstance(
+                tgt.value, ast.Name
+            ) and tgt.value.id == "self":
+                out.append((tgt.attr, tgt.lineno))
+            elif isinstance(tgt, ast.Subscript):
+                inner = tgt.value
+                if isinstance(inner, ast.Attribute) and isinstance(
+                    inner.value, ast.Name
+                ) and inner.value.id == "self":
+                    out.append((inner.attr, tgt.lineno))
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for el in tgt.elts:
+                    target(el)
+
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    target(tgt)
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                target(sub.target)
+            elif isinstance(sub, ast.Delete):
+                for tgt in sub.targets:
+                    target(tgt)
+            elif isinstance(sub, ast.Call) and isinstance(
+                sub.func, ast.Attribute
+            ):
+                recv = sub.func.value
+                if (
+                    sub.func.attr in _MUTATORS
+                    and isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"
+                ):
+                    out.append((recv.attr, sub.lineno))
+        return out
+
+    def _spans(self) -> frozenset:
+        return frozenset(self.held)
+
+    # -- statement walk ------------------------------------------------
+    def walk(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _note_writes(self, stmt: ast.stmt) -> None:
+        spans = self._spans()
+        for attr, line in self._self_writes(stmt):
+            checks = self._armed.get(attr, [])
+            if not checks:
+                continue
+            # A write is safe when ANY check of the attr shares a hold
+            # span with it — the governing decision was (re)validated
+            # inside the act's own hold. This is what makes the fixed
+            # double-checked shape (`with lock: if cond: return; act`)
+            # pass while the torn shape pairs with its nearest check.
+            if any(test_spans & spans for _, test_spans in checks):
+                continue
+            test_line, test_spans = checks[-1]
+            self.pairs.append(
+                (attr, test_line, test_spans, line, spans)
+            )
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.With):
+            pushed = 0
+            for item in stmt.items:
+                if self._is_own_lock(item.context_expr):
+                    self._span += 1
+                    self.held.append(
+                        (
+                            f"{self.cls.name}.{item.context_expr.attr}",
+                            self._span,
+                        )
+                    )
+                    pushed += 1
+            self.walk(stmt.body)
+            for _ in range(pushed):
+                self.held.pop()
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            reads = self._self_reads(stmt.test)
+            test_spans = self._spans()
+            test_line = stmt.lineno
+            # Arm every tested attr; any later write with disjoint
+            # spans completes a pair. This covers both shapes at once:
+            # the direct form (write inside the branch, walked next)
+            # and the guard-clause form (write after the early return).
+            for attr in reads:
+                self._armed.setdefault(attr, []).append(
+                    (test_line, test_spans)
+                )
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk(stmt.body)
+            for handler in stmt.handlers:
+                self.walk(handler.body)
+            self.walk(stmt.orelse)
+            self.walk(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._note_writes_shallow(stmt)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        self._note_writes(stmt)
+
+    def _note_writes_shallow(self, stmt: ast.stmt) -> None:
+        """For compound statements whose bodies are walked separately:
+        only writes in the header (iter/targets) belong to this level."""
+        spans = self._spans()
+        header_writes: list[tuple[str, int]] = []
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            probe = ast.Assign(targets=[stmt.target], value=stmt.iter)
+            ast.copy_location(probe, stmt)
+            ast.fix_missing_locations(probe)
+            header_writes = self._self_writes(probe)
+        for attr, line in header_writes:
+            checks = self._armed.get(attr, [])
+            if not checks or any(
+                test_spans & spans for _, test_spans in checks
+            ):
+                continue
+            test_line, test_spans = checks[-1]
+            self.pairs.append(
+                (attr, test_line, test_spans, line, spans)
+            )
+
+
+# ---------------------------------------------------------------------------
+# entry point
+
+
+def check(
+    root: str | None = None, index: PackageIndex | None = None
+) -> tuple[list[Finding], PackageIndex]:
+    index = index or build_index(root)
+    escapes = _escape_sites(index)
+    ctor_exempt, guarded = _call_contexts(index)
+    findings: list[Finding] = []
+    seen: set[tuple] = set()
+    for qual, fn_node in index.fn_nodes.items():
+        rec = index.funcs[qual]
+        if rec.cls is None or rec.cls not in escapes:
+            continue
+        name = qual.rsplit(".", 1)[-1]
+        if (
+            name in _CTOR_METHODS
+            or rec.is_classmethod
+            or qual in ctor_exempt
+            # every call site holds the class's own lock (the
+            # `_refill_locked` idiom): the whole method body runs
+            # inside the CALLER's continuous hold, so an internal
+            # check-then-act cannot be torn
+            or qual in guarded
+        ):
+            continue
+        cls = index.func_cls.get(qual)
+        if cls is None:
+            continue
+        walker = _SpanWalker(index, rec, cls)
+        walker.walk(fn_node.body)
+        for attr, test_line, test_spans, write_line, write_spans in (
+            walker.pairs
+        ):
+            # noise gate: require a lock signal — the class owns locks,
+            # or one side of the pair actually held one
+            if not (cls.lock_attrs or test_spans or write_spans):
+                continue
+            key = (rec.path, write_line, attr)
+            if key in seen:
+                continue
+            seen.add(key)
+            t_locks = (
+                "/".join(sorted({l for l, _ in test_spans})) or "no lock"
+            )
+            w_locks = (
+                "/".join(sorted({l for l, _ in write_spans})) or "no lock"
+            )
+            same_lock_hint = ""
+            if {l for l, _ in test_spans} & {l for l, _ in write_spans}:
+                same_lock_hint = (
+                    " (same lock, SEPARATE holds — atomicity broken "
+                    "between them)"
+                )
+            findings.append(
+                Finding(
+                    RULE,
+                    rec.path,
+                    write_line,
+                    f"{qual} acts on {rec.cls}.{attr} (line {write_line},"
+                    f" holding {w_locks}) from a check at line "
+                    f"{test_line} (holding {t_locks}) without one "
+                    f"continuous hold{same_lock_hint}; instances of "
+                    f"{rec.cls} escape to other threads via "
+                    f"{escapes[rec.cls]}",
+                )
+            )
+    findings.sort(key=lambda f: (f.path, f.line))
+    return findings, index

@@ -157,6 +157,27 @@ class PhiAccrual:
                     self._pos = (self._pos + 1) % self._RING
         self.last_arrival = now
 
+    def warmed(self) -> bool:
+        """Enough cadence history that silence CAN raise phi. Surfaced
+        on /cluster/health so operators (and e2e rigs) can barrier on
+        the detector being armed instead of sleeping a fixed number of
+        beats — a wall-clock warm-up assumes the configured cadence,
+        and on a loaded host the beat thread can run late enough that
+        the sleep ends with fewer than _MIN_SAMPLES intervals in the
+        ring, leaving phi pinned at 0."""
+        return len(self._intervals) >= self._MIN_SAMPLES
+
+    def gate_s(self) -> float:
+        """The current suspicion gate in seconds: silence shorter than
+        this reads as phi=0 (see _GATE_FACTOR). This is the LEARNED
+        earliest-detection horizon — it tracks the worst observed
+        inter-arrival gap, not the configured tick, so any promptness
+        expectation (alert SLO, test bound) must be stated relative to
+        it rather than to `-heartbeat`."""
+        if not self._intervals:
+            return 0.0
+        return self._GATE_FACTOR * max(self._intervals)
+
     def phi(self, now: float) -> float:
         """0 while within the learned cadence; grows without bound as
         the silence stretches. 0 before enough history exists (a brand
@@ -435,6 +456,12 @@ class HealthPlane:
                 "State": rec.state(now),
                 "Score": rec.score(now),
                 "Phi": round(rec.detector.phi(now), 2),
+                # detector readiness + learned detection horizon: rigs
+                # and runbooks barrier/bound on THESE, never on the
+                # configured heartbeat interval (docs/ANALYSIS.md v4,
+                # the gray-failure deflake)
+                "Warmed": rec.detector.warmed(),
+                "GateS": round(rec.detector.gate_s(), 3),
                 "ErrEwma": round(rec.err_ewma, 2),
                 "LameDuck": rec.lame_duck,
                 "Draining": rec.draining or rec.drain_requested,

@@ -33,6 +33,7 @@ from seaweedfs_tpu import trace
 from seaweedfs_tpu.ec import ec_files, locate, repair_session
 from seaweedfs_tpu.ec.tile_cache import TileCache
 from seaweedfs_tpu.ec.codec import ReedSolomon, new_encoder
+from seaweedfs_tpu.qos.singleflight import SingleFlight
 from seaweedfs_tpu.storage import idx as idx_codec
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.needle import Needle, get_actual_size
@@ -188,8 +189,7 @@ class EcVolume:
         self.tile_cache = TileCache()
         # singleflight for tile decodes: N concurrent degraded GETs of
         # one hot uncached tile must not fan out N× k-shard gathers
-        self._decode_inflight: dict[tuple[int, int], threading.Event] = {}
-        self._decode_inflight_lock = threading.Lock()
+        self._decode_flight = SingleFlight()
         # lifecycle tiering (docs/TIERING.md): shards this node moved to
         # an object-store backend, readable via ranged sub-shard GETs
         self.remote: RemoteEcAttachment | None = None
@@ -608,14 +608,11 @@ class EcVolume:
                 # without this, N concurrent GETs of one hot uncached
                 # tile fan out N× the k-shard gather and N decodes
                 key = (target_shard, t_off)
-                with self._decode_inflight_lock:
-                    leader_ev = self._decode_inflight.get(key)
-                    if leader_ev is None:
-                        ev = threading.Event()
-                        self._decode_inflight[key] = ev
-                        owned.append((t_off, ev))
-                if not owned:
-                    leader_ev.wait(timeout=30.0)
+                lease = self._decode_flight.lead(key)
+                if lease is not None:
+                    owned.append((t_off, lease))
+                else:
+                    self._decode_flight.wait(key, timeout=30.0)
                     data = cache.get(target_shard, t_off)
                     # a miss here means the leader failed (or the cache
                     # evicted/invalidated): decode for ourselves below,
@@ -642,13 +639,10 @@ class EcVolume:
                 while nxt < run_lim and len(owned) < _DECODE_RUN_TILES:
                     if cache.get(target_shard, nxt) is not None:
                         break
-                    key = (target_shard, nxt)
-                    with self._decode_inflight_lock:
-                        if key in self._decode_inflight:
-                            break
-                        ev = threading.Event()
-                        self._decode_inflight[key] = ev
-                    owned.append((nxt, ev))
+                    lease = self._decode_flight.lead((target_shard, nxt))
+                    if lease is None:
+                        break
+                    owned.append((nxt, lease))
                     nxt += tile
                 run_len = min(nxt, shard_len) - t_off
                 if run_len <= 0:
@@ -711,13 +705,8 @@ class EcVolume:
     ) -> None:
         """Unregister this thread's singleflight leases and wake their
         waiters (who re-probe the cache and self-serve on a miss)."""
-        if not owned:
-            return
-        with self._decode_inflight_lock:
-            for o_off, _ in owned:
-                self._decode_inflight.pop((target_shard, o_off), None)
-        for _, ev in owned:
-            ev.set()
+        for o_off, ev in owned:
+            self._decode_flight.release((target_shard, o_off), ev)
 
     def donate_cached_tiles(self, sess) -> int:
         """Seed a just-opened rebuild session with every resident tile

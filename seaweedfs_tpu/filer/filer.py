@@ -114,12 +114,14 @@ class Filer:
         try:
             existing = self.store.find_entry(dir_path)
             if existing.is_directory:
+                # weedlint: ignore[race-check-then-act] — idempotent cache fill: concurrent mkdirs both insert the same directory entry (store is last-writer-wins) and both add the same path; holding _lock across store I/O would serialize every write
                 self._dir_cache.add(dir_path)
                 return
         except EntryNotFound:
             pass
         d = new_directory_entry(dir_path)
         self.store.insert_entry(d)
+        # weedlint: ignore[race-check-then-act] — idempotent cache fill: duplicate insert_entry of a fresh directory is last-writer-wins on identical bytes; set.add is atomic and the worst case is one redundant notify
         self._dir_cache.add(dir_path)
         self._notify(None, d, delete_chunks=False)
 

@@ -178,6 +178,7 @@ class KernelFuseMount:
         if self._thread is not None:
             self._thread.join(timeout=10)
             stuck = self._thread.is_alive()
+            # weedlint: ignore[race-check-then-act] — mount lifecycle is single-owner: only the mounting thread calls serve_background/unmount; the serve thread never writes _thread, so there is no second writer to race
             self._thread = None
         if self._fd >= 0 and not stuck:
             # a stuck serve thread (wedged backend RPC) keeps the fd
@@ -186,6 +187,7 @@ class KernelFuseMount:
                 os.close(self._fd)
             except OSError:
                 pass
+            # weedlint: ignore[race-check-then-act] — same single-owner lifecycle: _fd is written by mount() and unmount() on the owner thread; the serve thread only reads it
             self._fd = -1
 
     def serve_background(self) -> None:

@@ -21,11 +21,14 @@ Hardening (weedlint C tier, docs/ANALYSIS.md): every build runs with
 -Wall -Wextra -Werror — the shims are the one part of the tree no
 interpreter-level tooling can see into, so the compiler's analysis is
 the lint tier and a warning is a build failure, never a note lost in a
-subprocess pipe. `WEED_NATIVE_SAN=asan|ubsan` switches the whole shim
-tier to a sanitizer build (separate artifact names, so sanitized and
-production caches never collide). An ASan .so only dlopens when the
-ASan runtime is preloaded; `asan_preload_env()` hands callers the
-LD_PRELOAD/ASAN_OPTIONS recipe the sanitizer smoke uses.
+subprocess pipe. `WEED_NATIVE_SAN=asan|ubsan|tsan` switches the whole
+shim tier to a sanitizer build (separate artifact names, so sanitized
+and production caches never collide). A sanitizer .so only dlopens
+when its runtime is preloaded; `san_preload_env()` hands callers the
+LD_PRELOAD recipe per mode — for TSan with
+`ignore_noninstrumented_modules=1`, because the interpreter itself is
+not instrumented and only races with an instrumented shim frame (the
+epoll loop, the shm GCRA bucket) are this tier's business.
 """
 
 from __future__ import annotations
@@ -53,13 +56,20 @@ _SAN_FLAGS = {
         "-O1", "-g", "-fsanitize=undefined",
         "-fno-sanitize-recover=undefined", "-fno-omit-frame-pointer",
     ),
+    "tsan": (
+        "-O1", "-g", "-fsanitize=thread", "-fno-omit-frame-pointer",
+    ),
 }
+
+# the runtime each sanitizer mode must have preloaded before a stock
+# (uninstrumented) python can dlopen a shim built in that mode
+_SAN_RUNTIMES = {"asan": "libasan.so", "tsan": "libtsan.so"}
 
 _INCLUDE_RE = re.compile(rb'^[ \t]*#[ \t]*include[ \t]*"([^"]+)"', re.M)
 
 
 def san_mode() -> str:
-    """'' (production), 'asan', or 'ubsan' — from WEED_NATIVE_SAN."""
+    """'' (production), 'asan', 'ubsan', or 'tsan' — WEED_NATIVE_SAN."""
     mode = os.environ.get("WEED_NATIVE_SAN", "").strip().lower()
     return mode if mode in _SAN_FLAGS else ""
 
@@ -74,16 +84,27 @@ def _san_so_name(so_name: str, mode: str) -> str:
     return f"{base}.{mode}{ext}"
 
 
-def asan_preload_env() -> dict[str, str] | None:
-    """Env additions that let a stock (non-ASan) python dlopen an
-    ASan-built shim: LD_PRELOAD the compiler's ASan runtime. None when
-    no compiler can name one. detect_leaks=0 because CPython itself
-    "leaks" interned/static allocations at exit; the point here is
-    heap-corruption coverage of the C parsers, not CPython leak audits."""
+def san_preload_env(mode: str | None = None) -> dict[str, str] | None:
+    """Env additions that let a stock (uninstrumented) python dlopen a
+    shim built in `mode` (default: the active san_mode()): LD_PRELOAD
+    the compiler's matching runtime. None when no compiler can name
+    one, or the mode needs no preload (ubsan links its runtime in).
+
+    asan: detect_leaks=0 because CPython itself "leaks" interned/static
+    allocations at exit; the point is heap-corruption coverage of the C
+    parsers, not CPython leak audits. tsan:
+    ignore_noninstrumented_modules=1 because every interpreter-internal
+    access would otherwise report — only races touching an instrumented
+    shim frame are signal; halt_on_error=1 so a detected data race
+    fails the test run instead of scrolling past in stderr."""
+    mode = san_mode() if mode is None else mode
+    runtime = _SAN_RUNTIMES.get(mode)
+    if runtime is None:
+        return None
     for cc in _COMPILERS:
         try:
             proc = subprocess.run(
-                [cc, "-print-file-name=libasan.so"],
+                [cc, f"-print-file-name={runtime}"],
                 capture_output=True,
                 timeout=10,
             )
@@ -91,11 +112,23 @@ def asan_preload_env() -> dict[str, str] | None:
             continue
         path = proc.stdout.decode().strip()
         if proc.returncode == 0 and os.path.isabs(path) and os.path.exists(path):
-            return {
-                "LD_PRELOAD": path,
-                "ASAN_OPTIONS": "detect_leaks=0:verify_asan_link_order=0",
-            }
+            env = {"LD_PRELOAD": path}
+            if mode == "asan":
+                env["ASAN_OPTIONS"] = (
+                    "detect_leaks=0:verify_asan_link_order=0"
+                )
+            elif mode == "tsan":
+                env["TSAN_OPTIONS"] = (
+                    "ignore_noninstrumented_modules=1:halt_on_error=1"
+                )
+            return env
     return None
+
+
+def asan_preload_env() -> dict[str, str] | None:
+    """The ASan-specific recipe (pre-tsan-tier name, kept for its
+    existing call sites)."""
+    return san_preload_env("asan")
 
 
 def _local_includes(src: str, seen: set[str] | None = None) -> set[str]:

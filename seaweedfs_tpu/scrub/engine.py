@@ -91,12 +91,16 @@ class ScrubEngine:
 
     # ------------------------------------------------------------------
     def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True, name="scrub-engine"
-        )
-        self._thread.start()
+        # check+spawn under one hold: two concurrent start() calls must
+        # not both see None and double-spawn the loop (weedlint v4
+        # race-check-then-act, PR 19 round)
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="scrub-engine"
+            )
+            self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()

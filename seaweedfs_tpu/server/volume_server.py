@@ -584,6 +584,7 @@ class VolumeServer:
             if self._force_full_heartbeat.is_set():
                 # master asked for the full inventory (it lost our
                 # state to a liveness sweep or a leader change)
+                # weedlint: ignore[race-check-then-act] — Event consume: a set() landing between is_set and clear is absorbed into the full beat this branch is about to send, so no request is ever lost
                 self._force_full_heartbeat.clear()
                 last_vids = None
             if self.shard_writes:
@@ -727,7 +728,9 @@ class VolumeServer:
 
                                 if self._metrics_push is not None:
                                     self._metrics_push.stop_event.set()
+                                # weedlint: ignore[race-check-then-act] — the heartbeat thread is the sole writer of _metrics_cfg/_metrics_push; other threads only read the push handle
                                 self._metrics_cfg = cfg
+                                # weedlint: ignore[race-check-then-act] — single-writer (heartbeat thread) swap, see _metrics_cfg above
                                 self._metrics_push = start_push_loop(
                                     f"http://{cfg[0]}",
                                     job=f"volume_{self.host}_{self.port}",
@@ -736,6 +739,7 @@ class VolumeServer:
                                 )
                         if resp.leader and resp.leader != self.master:
                             # follow the leader hint: reconnect there
+                            # weedlint: ignore[race-check-then-act] — master is re-resolved only by the heartbeat thread (leader hint here, seed rotation below); readers tolerate one stale beat
                             self.master = resp.leader
                             break
                         if self._stop.is_set():
@@ -749,6 +753,7 @@ class VolumeServer:
                 # rotate through the seed masters until one answers
                 if len(self.seed_masters) > 1:
                     self._master_rr = (self._master_rr + 1) % len(self.seed_masters)
+                    # weedlint: ignore[race-check-then-act] — single-writer seed rotation on the heartbeat thread, same contract as the leader-hint site above
                     self.master = self.seed_masters[self._master_rr]
                 self._stop.wait(0.2 if len(self.seed_masters) > 1 else 1.0)
 
@@ -2734,6 +2739,7 @@ class VolumeServer:
             if v is not None:
                 v.refresh_from_idx()
             with self._shard_lock:
+                # weedlint: ignore[race-check-then-act] — the per-vid vlock (from _shard_vid_locks, invisible to the lint's self-attr span tracking) is held continuously from the re-check through the handshake to this add; _shard_lock only guards the set's memory
                 self._shard_taken.add(vid)
 
     def _proxy_to_writer(

@@ -66,12 +66,16 @@ class TierScheduler:
 
     # ------------------------------------------------------------------
     def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True, name="tier-scheduler"
-        )
-        self._thread.start()
+        # check+spawn under one hold: two concurrent start() calls must
+        # not both see None and double-spawn the loop (weedlint v4
+        # race-check-then-act, PR 19 round)
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="tier-scheduler"
+            )
+            self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -229,6 +233,13 @@ class TierScheduler:
                 if direction is None:
                     continue
                 with self._lock:
+                    # re-validate the cap inside the hold that takes
+                    # the slot: the earlier check released the lock
+                    # across the /tier/status fetch, and a concurrent
+                    # scan (admin-triggered scan_once next to the loop)
+                    # could have filled the budget in between
+                    if self._active >= self.concurrency:
+                        return launched
                     self._active += 1
                     self.moves_started += 1
                 launched += 1

@@ -67,6 +67,21 @@ class TestPhiAccrual:
         # and keeps growing without bound (the erfc-underflow branch)
         assert p.phi(t + 10 * 0.2) > p.phi(t + 3 * 0.2) > 0
 
+    def test_warmed_and_gate_track_learned_cadence(self):
+        # the observable warm-up barrier + detection horizon the gray-
+        # failure e2e bounds itself against (a fixed-sleep warm-up and
+        # a configured-beat bound both flake under rig load)
+        p = PhiAccrual()
+        assert not p.warmed() and p.gate_s() == 0.0
+        p, t = self._warm(interval=0.2, n=20)
+        assert p.warmed()
+        # gate = _GATE_FACTOR x worst observed gap, not the mean
+        assert p.gate_s() == pytest.approx(2.0 * 0.2, rel=1e-6)
+        # one load-stretched (but not yet suspicious) beat widens it;
+        # _warm's last arrival was at t - 0.2
+        p.observe(t - 0.2 + 0.35)
+        assert p.gate_s() == pytest.approx(2.0 * 0.35, rel=1e-6)
+
     def test_outage_resume_interval_not_recorded(self):
         """The beat ENDING a flagged silence (SIGCONT, rejoin after a
         crash) must not enter the cadence ring: recording the outage

@@ -415,7 +415,10 @@ class TestSelfHealingEndToEnd:
         assert shard_path is not None
         flip_byte(shard_path, 500, 0x77)
 
-        # scrubber detects and quarantines within ~a scrub period
+        # kick the sweep hook (prioritizing this vid) rather than
+        # waiting out the interval timer — detection becomes an event
+        # the engine schedules now, not a tick rig load can starve
+        holder.scrub.trigger(vid)
         assert wait_for(
             lambda: 3 in holder.store.quarantined.get(vid, {}), 30
         ), "background scrubber never quarantined the corrupt shard"
@@ -589,8 +592,22 @@ class TestPlainReplicaReplace:
             live = sorted(nv.key for nv in v.nm.items())
             corrupt_needle_data(v, live[0])
 
-            # scrub detects, scheduler replaces, volume returns clean:
-            # the bad node ends up with a fresh copy whose needle reads
+            # event-driven detection: kick the engine's sweep hook and
+            # barrier on sweep completion instead of waiting out the
+            # interval timer. Beyond speed this STAGES the wait — the
+            # old single 90 s poll covered sweep + heartbeat + repair
+            # and a rig-load stall anywhere reported as the same
+            # opaque timeout (the PR-18 flake); now a detection stall
+            # and a repair stall fail with different messages
+            swept = bad.scrub.sweeps_completed
+            bad.scrub.trigger(vid)
+            assert wait_for(
+                lambda: bad.scrub.sweeps_completed > swept, 30
+            ), "triggered scrub sweep never completed (detection stage)"
+
+            # the flag rides the next 0.2 s beat, the master's repair
+            # scheduler is heartbeat-triggered from there on: replace
+            # lands and the volume returns clean (fresh copy reads)
             assert wait_for(
                 lambda: (
                     (v2 := bad.store.find_volume(vid)) is not None
