@@ -1383,6 +1383,11 @@ def bench_trace() -> None:
     Line 2 — `trace_stage_breakdown`: per-stage p50/p99 microseconds
     across the traced arm's volume.post spans — the stage attribution
     future perf PRs cite instead of end-to-end guesses.
+
+    The `noscope` arm is the weedscope recorder A/B (ISSUE-20): tracing
+    on but the blackbox flight recorder and histogram exemplars off —
+    exactly what `WEED_SCOPE=0` boots into. The recorder must stay
+    inside the trace plane's bound: vs_scope_off >= 0.98.
     """
     import json as _json
     import statistics
@@ -1392,13 +1397,16 @@ def bench_trace() -> None:
     from seaweedfs_tpu import trace
     from seaweedfs_tpu.client.operation import _drop_conn, _pooled_conn
     from seaweedfs_tpu.command.servers import _tune_gc
+    from seaweedfs_tpu.stats import metrics as metrics_mod
+    from seaweedfs_tpu.trace import blackbox
     from seaweedfs_tpu.util.availability import start_cluster
 
     _tune_gc()
     n_writes, warmup, sample_n = 6000, 200, 16
     payload = b"\x00\x01trace-bench-payload\xff" * 50  # ~1 KB, not gzippable
-    # arm per write, round-robin: off / on (full) / on (sampled 1-in-sample_n)
-    arms = ("off", "on", "sampled")
+    # arm per write, round-robin: off / on (full) / on (sampled
+    # 1-in-sample_n) / noscope (tracing on, weedscope recorder off)
+    arms = ("off", "on", "sampled", "noscope")
     with tempfile.TemporaryDirectory() as d:
         master, servers = start_cluster([tempfile.mkdtemp(dir=d)])
         m = f"127.0.0.1:{master.port}"
@@ -1417,6 +1425,8 @@ def bench_trace() -> None:
                     trace.set_sample_every(
                         sample_n if arm == "sampled" else 1
                     )
+                    blackbox.set_enabled(arm != "noscope")
+                    metrics_mod.set_exemplars_enabled(arm != "noscope")
                     fid = f"{base_fid}_{i}" if i else base_fid
                     t0 = time.perf_counter()
                     c.send_request(
@@ -1435,6 +1445,8 @@ def bench_trace() -> None:
                 _drop_conn(addr)
                 trace.set_enabled(True)
                 trace.set_sample_every(1)
+                blackbox.set_enabled(True)
+                metrics_mod.set_exemplars_enabled(True)
             # stage attribution: the in-process volume server shares
             # this process's ring, so read it directly
             stage_samples: dict[str, list[float]] = {}
@@ -1460,6 +1472,11 @@ def bench_trace() -> None:
         wall_sampled_us=round(med["sampled"], 1),
         vs_baseline_sampled=round(
             med["off"] / med["sampled"] if med["sampled"] > 0 else 1.0, 4
+        ),
+        wall_noscope_us=round(med["noscope"], 1),
+        scope_overhead_us=round(med["on"] - med["noscope"], 2),
+        vs_scope_off=round(
+            med["noscope"] / med["on"] if med["on"] > 0 else 1.0, 4
         ),
         sample_every=sample_n,
         writes_per_arm=(n_writes - warmup) // len(arms),
@@ -2826,22 +2843,52 @@ def bench_chaos_soak(minutes: float) -> None:
     far (no acked-write loss), retry amplification ≤ 1.15×, and a
     bounded time-to-recover probe after each heal. One JSON line per
     cycle; a cycle that breaks an invariant fails the run immediately
-    (a soak that only reports at the end hides which fault did it)."""
+    (a soak that only reports at the end hides which fault did it).
+
+    weedscope rides the soak as the standing SLO gate: the master runs
+    a telemetry collector with seconds-scale burn windows, the run ends
+    with the `chaos_soak_slo_scorecard` line (availability, accepted
+    p99.9, retry amplification, MTTR, per-objective burn verdicts), and
+    a deterministically FORCED breach (synthetic slow observations into
+    the shared in-process registry every cycle) must fire the burn-rate
+    alert and produce an alert-triggered capsule on >= 2 distinct
+    nodes — the cross-node incident-capsule acceptance check."""
     import tempfile
     import threading as _threading
+
+    # read at capsule-module import (inside the MasterServer ctor below):
+    # a short cooldown lets the end-of-soak re-drive capture evidence
+    # even if the alert's one firing edge landed mid-fault
+    os.environ.setdefault("WEED_CAPSULE_COOLDOWN_S", "5")
 
     from seaweedfs_tpu.analysis.chaos import ProxyPair
     from seaweedfs_tpu.client import operation as op
     from seaweedfs_tpu.client import retry as retry_mod
     from seaweedfs_tpu.server.master_server import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.stats.metrics import HTTP_REQUEST_HISTOGRAM
+    from seaweedfs_tpu.telemetry import capsule as capsule_mod
+    from seaweedfs_tpu.telemetry import slo as slo_mod
     from seaweedfs_tpu.util import deadline as dl_mod
     from seaweedfs_tpu.util.availability import free_port
 
     deadline_wall = time.time() + minutes * 60.0
     with tempfile.TemporaryDirectory() as d:
+        capsule_mod.set_dir(tempfile.mkdtemp(dir=d))
         master = MasterServer(
-            port=free_port(), volume_size_limit_mb=64, vacuum_interval=0
+            port=free_port(), volume_size_limit_mb=64, vacuum_interval=0,
+            telemetry_interval=1.0,
+            telemetry_kwargs={
+                "slo_fast_s": 10.0,
+                "slo_slow_s": 30.0,
+                "slo_objectives": list(slo_mod.DEFAULT_OBJECTIVES) + [
+                    slo_mod.SLOObjective(
+                        "soak-forced-breach", "latency", 0.999,
+                        family="weed_http_request_seconds",
+                        threshold_s=0.5,
+                    )
+                ],
+            },
         )
         master.start()
         maddr = f"127.0.0.1:{master.port}"
@@ -2937,6 +2984,12 @@ def bench_chaos_soak(minutes: float) -> None:
                 arm()
                 time.sleep(min(10.0, max(2.0, deadline_wall - time.time())))
                 pair.heal()
+                # forced SLO breach (weedscope acceptance): synthetic
+                # slow observations into the shared in-process registry
+                # keep soak-forced-breach burning in every scrape window
+                # without touching the real serving path
+                for _ in range(5):
+                    HTTP_REQUEST_HISTOGRAM.observe(8.0, "volume", "GET")
                 # time-to-recover: first clean replicated write after heal
                 t_heal = time.perf_counter()
                 recovered = None
@@ -2997,6 +3050,72 @@ def bench_chaos_soak(minutes: float) -> None:
                     raise SystemExit(
                         f"chaos soak cycle {cycle} ({name}) failed: {row}"
                     )
+            # --- weedscope soak gate: scorecard + cross-node capsule ---
+            tel = master.telemetry
+            if cycle == 0:  # sub-cycle soak: still force the breach
+                for _ in range(5):
+                    HTTP_REQUEST_HISTOGRAM.observe(8.0, "volume", "GET")
+
+            def _forced_row():
+                return next(
+                    (
+                        a for a in tel.alerts.firing()
+                        if a["Alert"] == "slo_burn_rate"
+                        and a["Target"] == "soak-forced-breach"
+                    ),
+                    None,
+                )
+
+            t_wait = time.time() + 30.0
+            while time.time() < t_wait and _forced_row() is None:
+                time.sleep(0.5)  # collector scrapes every 1 s
+            forced = _forced_row()
+
+            def _alert_nodes() -> set:
+                return {
+                    c.get("Node", "")
+                    for c in capsule_mod.list_capsules()
+                    if c.get("Trigger") == "alert"
+                }
+
+            # the one pending->firing edge may have landed mid-fault
+            # (remote captures through a blackholed proxy fail): with
+            # everything healed, re-drive the coordinator on the still-
+            # firing row once the capture cooldown has lapsed
+            if forced is not None and len(_alert_nodes()) < 3 \
+                    and tel.alerts.on_fire is not None:
+                time.sleep(6.0)
+                tel.alerts.on_fire(forced)
+            t_caps = time.time() + 20.0
+            nodes = _alert_nodes()
+            while time.time() < t_caps and len(nodes) < 3:
+                time.sleep(0.5)
+                nodes = _alert_nodes()
+            cross_node = len(nodes) >= 2
+            slo = tel.slo_payload()
+            card = slo.get("Scorecard") or {}
+            print(json.dumps({
+                "metric": "chaos_soak_slo_scorecard",
+                "window_s": card.get("WindowSeconds"),
+                "availability_pct": card.get("AvailabilityPct"),
+                "accepted_p999_ms": card.get("AcceptedP999Ms"),
+                "retry_amplification": card.get("RetryAmplification"),
+                "mttr_s": card.get("MTTRSeconds"),
+                "objectives": {
+                    r["Objective"]: r["Verdict"]
+                    for r in card.get("Objectives", [])
+                },
+                "breaching": slo.get("Breaching", []),
+                "forced_breach_fired": forced is not None,
+                "capsule_nodes": sorted(nodes),
+                "cross_node_capsule": cross_node,
+                "pass": bool(forced is not None and cross_node),
+            }), flush=True)
+            if forced is None or not cross_node:
+                raise SystemExit(
+                    "chaos soak: forced SLO breach did not fire or did "
+                    f"not produce a cross-node capsule (nodes={sorted(nodes)})"
+                )
             print(json.dumps({
                 "metric": "chaos_soak",
                 "minutes": minutes,
@@ -3010,6 +3129,7 @@ def bench_chaos_soak(minutes: float) -> None:
             vs_b.stop()
             vs_a.stop()
             master.stop()
+            capsule_mod.set_dir("")
 
 
 def bench_chaos() -> None:
@@ -3694,6 +3814,120 @@ def check_telemetry_smoke() -> int:
         "targets_up": health_ok,
         "profiler_folded_stacks": prof_ok,
         "targets": len(health["Targets"]),
+    }))
+    return 0 if ok else 1
+
+
+def check_capsule_smoke() -> int:
+    """`bench.py --check` capsule leg (weedscope): force the SLO
+    burn-rate rule to fire on a live cluster and assert the alert-
+    triggered incident capsule lands DURABLY on every implicated node —
+    manifest published last, blackbox wide-events, folded stacks, the
+    /metrics exposition, and the leader-only TSDB window + cluster
+    verdict sections. The breach is forced deterministically: the
+    in-process cluster shares this process's metric registry, so one
+    synthetic 10 s observation between two scrape cycles burns both
+    windows of a seconds-scale latency objective."""
+    import tempfile
+    import urllib.request as _rq
+
+    from seaweedfs_tpu.stats.metrics import HTTP_REQUEST_HISTOGRAM
+    from seaweedfs_tpu.telemetry import ClusterCollector
+    from seaweedfs_tpu.telemetry import capsule as capsule_mod
+    from seaweedfs_tpu.telemetry import slo as slo_mod
+    from seaweedfs_tpu.util.availability import start_cluster
+
+    with tempfile.TemporaryDirectory() as d:
+        capsule_mod.set_dir(tempfile.mkdtemp(dir=d))
+        master, servers = start_cluster([tempfile.mkdtemp(dir=d)])
+        lead_node = f"{master.host}:{master.port}"
+        try:
+            forced = slo_mod.SLOObjective(
+                "check-forced-breach", "latency", 0.999,
+                family="weed_http_request_seconds", threshold_s=0.5,
+            )
+            collector = ClusterCollector(
+                master, interval=0.5,
+                slo_objectives=[forced], slo_fast_s=30.0, slo_slow_s=60.0,
+            )
+            master.telemetry = collector
+            master._wire_capsules()
+            # light real traffic so blackbox/trace sections have events
+            with _rq.urlopen(
+                f"http://127.0.0.1:{servers[0].port}/debug/traces?n=8",
+                timeout=10,
+            ) as r:
+                r.read()
+            # cycle 1's own /metrics GET births the request-histogram
+            # series; cycle 2 rings their baseline; the synthetic slow
+            # observation then shows as an increase in cycle 3 -> fires
+            collector.collect_once()
+            collector.collect_once()
+            HTTP_REQUEST_HISTOGRAM.observe(10.0, "volume", "GET")
+            collector.collect_once()
+            fired_ok = any(
+                a["Alert"] == "slo_burn_rate"
+                and a["Target"] == "check-forced-breach"
+                for a in collector.alerts.firing()
+            )
+            # the CaptureCoordinator runs off-thread: local capture on
+            # the leader plus /capsule/capture on every up peer
+            caps: list[dict] = []
+            deadline = time.time() + 20.0
+            while time.time() < deadline:
+                caps = [
+                    c for c in capsule_mod.list_capsules()
+                    if c.get("Trigger") == "alert"
+                ]
+                if len({c.get("Node") for c in caps}) >= 2:
+                    break
+                time.sleep(0.25)
+            nodes = sorted({c.get("Node", "") for c in caps})
+            cross_node_ok = len(nodes) >= 2
+            lead = next(
+                (c for c in caps if c.get("Node") == lead_node), None
+            )
+            files_ok = spans_ok = metrics_ok = tsdb_ok = False
+            if lead is not None:
+                ok_names = {
+                    f["Name"] for f in lead["Files"] if f.get("Ok")
+                }
+                files_ok = {
+                    "blackbox.json", "traces.json", "profile.txt",
+                    "metrics.txt", "tsdb.json", "cluster.json",
+                } <= ok_names
+                bb = json.loads(
+                    capsule_mod.read_file(lead["Id"], "blackbox.json")
+                    or b"{}"
+                )
+                spans_ok = bool(bb.get("tail") or bb.get("ok"))
+                mtxt = (
+                    capsule_mod.read_file(lead["Id"], "metrics.txt") or b""
+                ).decode()
+                metrics_ok = "weed_slo_burn_rate" in mtxt
+                tsdb = json.loads(
+                    capsule_mod.read_file(lead["Id"], "tsdb.json") or b"{}"
+                )
+                tsdb_ok = bool(tsdb.get("Targets"))
+        finally:
+            for vs in servers:
+                vs.stop()
+            master.stop()
+            capsule_mod.set_dir("")
+    ok = bool(
+        fired_ok and cross_node_ok and lead is not None
+        and files_ok and spans_ok and metrics_ok and tsdb_ok
+    )
+    print(json.dumps({
+        "metric": "capsule_check",
+        "ok": ok,
+        "slo_alert_fired": fired_ok,
+        "cross_node": cross_node_ok,
+        "capsule_nodes": nodes,
+        "leader_files_durable": files_ok,
+        "blackbox_events": spans_ok,
+        "metrics_window": metrics_ok,
+        "tsdb_window": tsdb_ok,
     }))
     return 0 if ok else 1
 
@@ -4559,6 +4793,7 @@ def main() -> None:
         rc = rc or check_native_serve()
         rc = rc or check_trace_smoke()
         rc = rc or check_telemetry_smoke()
+        rc = rc or check_capsule_smoke()
         rc = rc or check_qos_smoke()
         rc = rc or check_degraded_smoke()
         rc = rc or check_tier_smoke()

@@ -468,6 +468,12 @@ def http_call(
     # drift the amplification cap from ~1+r toward 1/(1-k·r)
     if not _retry.in_retry():
         _retry.DEFAULT_BUDGET.note_request()
+    else:
+        # weedscope hop marker: the serving side's flight recorder
+        # flags this wide-event as a retried attempt (the x-weed-hedge
+        # twin lives in qos/hedge — trace/blackbox.request_flags parses
+        # both)
+        headers["x-weed-retry"] = "1"
     hops = 0
     while hops <= max_redirects:
         netloc, slash, rest = url.partition("/")

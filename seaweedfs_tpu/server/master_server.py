@@ -216,6 +216,7 @@ class MasterServer:
             self.telemetry = ClusterCollector(
                 self, interval=telemetry_interval, **(telemetry_kwargs or {})
             )
+            self._wire_capsules()
         # tiering plane (docs/TIERING.md): leader-only lifecycle
         # scheduler driving tier-out/tier-in moves at the shard
         # holders. tier_interval <= 0 leaves tiering manual (tier.move
@@ -237,6 +238,40 @@ class MasterServer:
         # heartbeats. Always on (cheap); WEED_HEALTH=0 makes every
         # verdict read healthy, restoring pre-health behavior wholesale.
         self.health = health_mod.HealthPlane()
+
+    def _wire_capsules(self) -> None:
+        """weedscope (docs/TELEMETRY.md): leader-side capsule wiring.
+        Firing alerts trigger a local capture plus remote captures on
+        every implicated node, and the master's capsules grow the
+        leader-only sections: the relevant TSDB window, the alert/SLO
+        verdicts, and the health-plane snapshot."""
+        from seaweedfs_tpu.telemetry import capsule
+        from seaweedfs_tpu.trace import blackbox
+
+        tel = self.telemetry
+
+        def peers_for(alert_row: dict) -> list[str]:
+            target = alert_row.get("Target", "")
+            if ":" in target:  # node-scoped alert: that node is enough
+                return [target]
+            # cluster-scoped (SLO objective, repair depth): everyone
+            # currently serving is implicated — fan the capture out
+            return tel.up_targets()
+
+        tel.alerts.on_fire = capsule.CaptureCoordinator(
+            node=f"{self.host}:{self.port}",
+            peers_fn=peers_for,
+            enabled_fn=blackbox.enabled,
+        )
+        capsule.add_provider("tsdb", tel.window_payload)
+        capsule.add_provider(
+            "cluster",
+            lambda: {
+                "Alerts": tel.alerts.payload(),
+                "SLO": tel.slo_payload(),
+                "Health": tel.health_payload(),
+            },
+        )
 
     # gateways silent for this long stop being offered to the collector
     # (its own sticky-target window keeps their staleness alert alive
@@ -897,7 +932,12 @@ class MasterServer:
                             "repairScheduler": server.repair is not None,
                         }
                     )
-                if path in ("/cluster/health", "/cluster/alerts", "/cluster/top"):
+                if path in (
+                    "/cluster/health",
+                    "/cluster/alerts",
+                    "/cluster/top",
+                    "/cluster/slo",
+                ):
                     if not server.is_leader:
                         # followers hold no topology and run no
                         # collector cycles (their local collector may
@@ -934,6 +974,11 @@ class MasterServer:
                         )
                     if path == "/cluster/alerts":
                         return self._json(server.telemetry.alerts.payload())
+                    if path == "/cluster/slo":
+                        # weedscope (docs/TELEMETRY.md): per-objective
+                        # burn rates, budget remaining, and the soak
+                        # scorecard — the cluster.slo shell surface
+                        return self._json(server.telemetry.slo_payload())
                     try:
                         n = int(q.get("n", "10"))
                     except ValueError:

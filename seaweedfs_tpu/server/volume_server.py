@@ -2049,9 +2049,40 @@ class VolumeServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     return self.wfile.write(body)
+                # stage timings for the traced threaded GET arm, named
+                # identically to the C fast path's SERVE_STAGES
+                # (parse/resolve/send) so a blackbox wide-event reads
+                # the same whichever arm served it (the weedscope twin
+                # of the POST arm's parse/assemble/crc/pwrite/reply)
+                req_span = getattr(self, "_trace_span", None)
+                stages = {} if req_span is not None else None
+                t_stage = time.perf_counter() if stages is not None else 0.0
                 fid, q, url_filename, url_ext = self._parse_fid()
+                if stages is not None:
+                    now_pc = time.perf_counter()
+                    stages["parse"] = now_pc - t_stage
+                    t_stage = now_pc
+
+                def _staged_exit(status, body=b"", headers=None, obj=None):
+                    # error/redirect/not-modified exits carry the same
+                    # stage fields as the C fast path (resolve ends at
+                    # the verdict, send covers the reply write): a 404
+                    # wide-event reads identically on both arms
+                    if stages is None:
+                        if obj is not None:
+                            return self._json(obj, status)
+                        return self._reply(status, body, headers)
+                    t_send = time.perf_counter()
+                    stages["resolve"] = t_send - t_stage
+                    if obj is not None:
+                        self._json(obj, status)
+                    else:
+                        self._reply(status, body, headers)
+                    stages["send"] = time.perf_counter() - t_send
+                    req_span.add_stages(stages)
+
                 if fid is None:
-                    return self._json({"error": "invalid file id"}, 400)
+                    return _staged_exit(400, obj={"error": "invalid file id"})
                 if self.headers.get(qos.HEDGE_HEADER):
                     # QoS plane: a tied (hedged) read — count it and tag
                     # the span so trace.dump shows which arm this was;
@@ -2076,30 +2107,34 @@ class VolumeServer:
                             # node (volume_server_handlers_read.go:60-77)
                             target = server._redirect_target(fid.volume_id)
                             if target:
-                                return self._reply(
+                                return _staged_exit(
                                     302,
                                     b"",
                                     {"Location": f"http://{target}{self.path}"},
                                 )
-                            return self._json({"error": "volume not found"}, 404)
+                            return _staged_exit(
+                                404, obj={"error": "volume not found"}
+                            )
                         n = ev.read_needle(
                             fid.key, fetch=server._remote_shard_fetcher(ev)
                         )
                         if n.cookie != fid.cookie:
                             raise CookieMismatch("cookie mismatch")
                 except NeedleNotFound:
-                    return self._reply(404)
+                    return _staged_exit(404)
                 except CookieMismatch:
-                    return self._reply(404)
+                    return _staged_exit(404)
                 except NotEnoughShards as e:
-                    return self._json({"error": str(e)}, 500)
+                    return _staged_exit(500, obj={"error": str(e)})
                 except OSError as e:
                     # disk watchdog (docs/HEALTH.md): EIO on the read
                     # path strikes toward lame-duck mode; a 500 beats a
                     # silently torn connection either way
                     if not server.watchdog.note_io_error(e):
                         raise
-                    return self._json({"error": f"read failed: {e}"}, 500)
+                    return _staged_exit(
+                        500, obj={"error": f"read failed: {e}"}
+                    )
                 # serve-first: stamp the arbiter so background planes
                 # (rebuild/replication/handoff/tier) yield to foreground
                 # reads; the per-volume counter is the tier scheduler's
@@ -2121,7 +2156,7 @@ class VolumeServer:
                         except (TypeError, ValueError):
                             t = None
                         if t is not None and t >= n.last_modified:
-                            return self._reply(304)
+                            return _staged_exit(304)
                 data = bytes(n.data)
                 if self.headers.get("etag-md5") == "True":
                     # opt-in md5 validator (crc.go:33 n.MD5 + ETag-MD5);
@@ -2137,7 +2172,7 @@ class VolumeServer:
                 # strong match (the C fast path's weed_etag_match runs
                 # the same scanner; the identity tests diff them)
                 if etag_matches(self.headers.get("If-None-Match", ""), etag):
-                    return self._reply(304)
+                    return _staged_exit(304)
                 headers = {"ETag": etag, "Content-Type": "application/octet-stream"}
                 # URL filename wins; else the stored name; ext feeds the
                 # mime guess and the resizer (read handler :138-150)
@@ -2218,7 +2253,13 @@ class VolumeServer:
                     if images.is_image_ext(rext):
                         data, _, _ = images.resized(rext, data, width, height, q.get("mode", ""))
                         headers.pop("ETag", None)  # derived variant
+                if stages is None:
+                    return self._serve_maybe_ranged(data, headers)
+                now_pc = time.perf_counter()
+                stages["resolve"] = now_pc - t_stage
                 self._serve_maybe_ranged(data, headers)
+                stages["send"] = time.perf_counter() - now_pc
+                req_span.add_stages(stages)
 
             def _serve_maybe_ranged(self, data: bytes, headers: dict):
                 """Full 200 or single-range 206 per the Range header

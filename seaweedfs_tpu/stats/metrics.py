@@ -14,6 +14,7 @@ pushgateway-style URL on an interval.
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 import time
 import urllib.request
@@ -21,6 +22,23 @@ import urllib.request
 DEFAULT_BUCKETS = (
     0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0
 )
+
+# weedscope (docs/TELEMETRY.md): histogram bucket exemplars — each
+# bucket remembers the LAST trace id observed into it, rendered
+# OpenMetrics-style (`... # {trace_id="..."} v`) so a burning SLO links
+# straight to a concrete trace. WEED_SCOPE=0 kills recording AND
+# rendering (the exposition reverts to plain 0.0.4 text).
+_EXEMPLARS_ENABLED = os.environ.get("WEED_SCOPE", "1") != "0"
+
+
+def exemplars_enabled() -> bool:
+    return _EXEMPLARS_ENABLED
+
+
+def set_exemplars_enabled(on: bool) -> None:
+    """Runtime toggle (bench A/B arms and tests flip this in-process)."""
+    global _EXEMPLARS_ENABLED
+    _EXEMPLARS_ENABLED = bool(on)
 
 
 def _fmt_labels(labels: dict[str, str]) -> str:
@@ -90,6 +108,14 @@ class Gauge:
     def value(self, *label_values: str) -> float:
         return self._values.get(tuple(label_values), 0.0)
 
+    def remove(self, *label_values: str) -> None:
+        """Drop one label row entirely. Gauges keyed by node/target URL
+        grow a row per member ever seen; a departed node must DISAPPEAR
+        from /metrics (telemetry/collector.py's dead-node TTL), not
+        linger as a frozen 0.0 row forever on autoscaled fleets."""
+        with self._lock:
+            self._values.pop(tuple(label_values), None)
+
     def render(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         with self._lock:
@@ -114,6 +140,11 @@ class Histogram:
         self.buckets = tuple(sorted(buckets))
         self._counts: dict[tuple[str, ...], list[int]] = {}
         self._sums: dict[tuple[str, ...], float] = {}
+        # (label key, bucket idx) -> (trace_id, observed value): the
+        # last exemplar per bucket (weedscope). Written only through
+        # put_exemplar — observe() itself never pays for it, so the
+        # untraced hot path is byte-identical to the pre-exemplar one.
+        self._exemplars: dict[tuple[tuple[str, ...], int], tuple[str, float]] = {}
         self._lock = threading.Lock()
 
     def observe(self, value: float, *label_values: str) -> None:
@@ -123,6 +154,22 @@ class Histogram:
             counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
             counts[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def put_exemplar(
+        self, value: float, trace_id: str, *label_values: str
+    ) -> None:
+        """Remember `trace_id` as the latest exemplar for the bucket
+        `value` falls into. Callers that already hold a trace id (the
+        dispatch funnel's traced branch, the span-ring drain, the C
+        fast-path complete callback) call this AFTER observe(); it is
+        deliberately not folded into observe() so untraced requests pay
+        nothing."""
+        if not _EXEMPLARS_ENABLED or not trace_id:
+            return
+        key = tuple(label_values)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._exemplars[(key, idx)] = (trace_id, value)
 
     def time(self, *label_values: str) -> "_Timer":
         return _Timer(self, label_values)
@@ -143,16 +190,33 @@ class Histogram:
                 (key, list(counts)) for key, counts in self._counts.items()
             )
             sums = dict(self._sums)
+            exemplars = dict(self._exemplars) if _EXEMPLARS_ENABLED else {}
         for key, counts in items:
             labels = dict(zip(self.label_names, key))
             cum = 0
-            for bound, c in zip(self.buckets, counts):
+            for i, (bound, c) in enumerate(zip(self.buckets, counts)):
                 cum += c
                 lb = dict(labels, le=repr(bound))
-                lines.append(f"{self.name}_bucket{_fmt_labels(lb)} {cum}")
+                ex = exemplars.get((key, i))
+                lines.append(
+                    f"{self.name}_bucket{_fmt_labels(lb)} {cum}"
+                    + (
+                        f' # {{trace_id="{ex[0]}"}} {ex[1]:.6f}'
+                        if ex is not None
+                        else ""
+                    )
+                )
             cum += counts[-1]
             lb = dict(labels, le="+Inf")
-            lines.append(f"{self.name}_bucket{_fmt_labels(lb)} {cum}")
+            ex = exemplars.get((key, len(self.buckets)))
+            lines.append(
+                f"{self.name}_bucket{_fmt_labels(lb)} {cum}"
+                + (
+                    f' # {{trace_id="{ex[0]}"}} {ex[1]:.6f}'
+                    if ex is not None
+                    else ""
+                )
+            )
             lines.append(f"{self.name}_sum{_fmt_labels(labels)} {sums.get(key, 0.0)}")
             lines.append(f"{self.name}_count{_fmt_labels(labels)} {cum}")
         return lines
@@ -251,7 +315,7 @@ HTTP_REQUEST_HISTOGRAM = DEFAULT_REGISTRY.histogram(
 )
 SPAN_HISTOGRAM = DEFAULT_REGISTRY.histogram(
     "weed_span_seconds",
-    "trace span durations by span name and plane (serve|scrub|repair)",
+    "trace span durations by span name and plane (serve|scrub|repair|tier)",
     ("name", "plane"),
 )
 
@@ -490,6 +554,28 @@ ARBITER_WAIT_SECONDS = DEFAULT_REGISTRY.counter(
     "weed_arbiter_wait_seconds_total",
     "seconds background claimants spent blocked on their share",
     ("claimant",),
+)
+
+# --- weedscope: SLO burn-rate engine + incident capsules --------------------
+# Set by the leader's SLO engine (telemetry/slo.py) every collector
+# cycle: multi-window burn rate per objective (window: fast | slow) and
+# the fraction of the slow window's error budget still unspent.
+SLO_BURN_RATE = DEFAULT_REGISTRY.gauge(
+    "weed_slo_burn_rate",
+    "error-budget burn rate per SLO objective and evaluation window "
+    "(1.0 = burning exactly the sustainable budget)",
+    ("objective", "window"),
+)
+SLO_BUDGET_REMAINING = DEFAULT_REGISTRY.gauge(
+    "weed_slo_budget_remaining",
+    "fraction of the SLO error budget left over the slow window "
+    "(1.0 = untouched, 0.0 = fully burned)",
+    ("objective",),
+)
+CAPSULE_CAPTURES = DEFAULT_REGISTRY.counter(
+    "weed_capsule_captures_total",
+    "incident capsules captured on this node",
+    ("trigger",),  # trigger: alert | manual | error
 )
 
 

@@ -62,10 +62,14 @@ class AlertState:
 
 
 class AlertManager:
-    def __init__(self):
+    def __init__(self, on_fire=None):
         self._lock = threading.Lock()
         self._active: dict[tuple[str, str], AlertState] = {}
         self._history: list[dict] = []  # resolved alerts, newest last
+        # called with each alert row on the pending→firing edge, AFTER
+        # the state lock is released (the capsule coordinator captures
+        # evidence from here; it must be free to read alert state)
+        self.on_fire = on_fire
 
     def evaluate(
         self,
@@ -77,6 +81,7 @@ class AlertManager:
         the list is treated as inactive (its alert resolves)."""
         now = time.time() if now is None else now
         seen: set[tuple[str, str]] = set()
+        fired: list[dict] = []
         with self._lock:
             for rule, target, active, value, detail in conditions:
                 key = (rule.name, target)
@@ -96,17 +101,26 @@ class AlertManager:
                             "alert FIRING %s target=%s value=%.4g %s",
                             rule.name, target, value, detail,
                         )
+                        fired.append(st.to_dict())
                 else:
                     self._resolve(key, now)
             # rule×target pairs that vanished entirely (target forgotten)
             for key in [k for k in self._active if k not in seen]:
                 self._resolve(key, now)
+        if fired and self.on_fire is not None:
+            for row in fired:
+                try:
+                    self.on_fire(row)
+                except Exception as e:  # noqa: BLE001 — hook never breaks eval
+                    wlog.warning("alert on_fire hook failed: %r", e)
 
     def _resolve(self, key: tuple[str, str], now: float) -> None:
         st = self._active.pop(key, None)
         if st is None:
             return
-        ALERT_FIRING.set(0.0, st.rule.name, st.target)
+        # drop the row outright: a resolved alert for a forgotten target
+        # must not linger as a 0-valued gauge on /metrics forever
+        ALERT_FIRING.remove(st.rule.name, st.target)
         if st.state == "firing":
             wlog.info(
                 "alert resolved %s target=%s after %.1fs",

@@ -24,6 +24,7 @@ import time
 import urllib.request
 
 from seaweedfs_tpu.stats.metrics import SCRAPE_STALENESS, SCRAPE_UP
+from seaweedfs_tpu.telemetry import slo as slo_mod
 from seaweedfs_tpu.telemetry.alerts import AlertManager, AlertRule
 from seaweedfs_tpu.telemetry.parse import parse_prometheus_text
 from seaweedfs_tpu.telemetry.ring import TargetStore
@@ -77,12 +78,16 @@ class ClusterCollector:
         ring_cap: int = 240,
         window_s: float = 120.0,
         stale_factor: float = 3.0,
-        forget_after: float = 600.0,
+        forget_after: float = 3600.0,
         error_rate_threshold: float = 0.05,
         span_p99_threshold_s: float = 2.0,
         repair_depth_threshold: int = 8,
         admission_reject_threshold: float = 1.0,
         repl_lag_threshold: float = 1000.0,
+        slo_objectives=None,
+        slo_fast_s: float | None = None,
+        slo_slow_s: float | None = None,
+        slo_burn_threshold: float | None = None,
     ):
         self.master = master
         self.interval = interval
@@ -92,13 +97,27 @@ class ClusterCollector:
         # increase() math always has >= 2 samples at steady state
         self.window_s = max(window_s, 3.0 * interval)
         self.stale_after = max(stale_factor * interval, interval + 1.0)
-        self.forget_after = forget_after
+        # dead-node TTL (the NodeHealth 1h prune, mirrored): the floor
+        # guarantees the staleness alert gets its full firing window
+        # before the target — and with it the alert's rule×target pair —
+        # is forgotten and auto-resolved
+        self.forget_after = max(forget_after, self.stale_after + 2.0 * interval)
         self.error_rate_threshold = error_rate_threshold
         self.span_p99_threshold_s = span_p99_threshold_s
         self.repair_depth_threshold = repair_depth_threshold
         self.admission_reject_threshold = admission_reject_threshold
         self.repl_lag_threshold = repl_lag_threshold
         self.alerts = AlertManager()
+        self.slo = (
+            slo_mod.SLOEngine(
+                objectives=slo_objectives,
+                fast_s=slo_fast_s,
+                slow_s=slo_slow_s,
+                burn_threshold=slo_burn_threshold,
+            )
+            if slo_mod.enabled()
+            else None
+        )
         self.targets: dict[str, TargetStore] = {}
         self._targets_lock = threading.Lock()
         self._stop = threading.Event()
@@ -148,8 +167,14 @@ class ClusterCollector:
             for url in [u for u in self.targets if u not in seen]:
                 if self.targets[url].staleness(now) > self.forget_after:
                     del self.targets[url]
-                    SCRAPE_STALENESS.set(0.0, url)
-                    SCRAPE_UP.set(0.0, url)
+                    # remove, don't zero: a forgotten node must vanish
+                    # from /metrics, not haunt it as a 0-valued row
+                    SCRAPE_STALENESS.remove(url)
+                    SCRAPE_UP.remove(url)
+                    wlog.info(
+                        "telemetry: forgot dead target %s after %.0fs",
+                        url, self.forget_after,
+                    )
 
     # ------------------------------------------------------------------
     # scrape
@@ -274,6 +299,8 @@ class ClusterCollector:
             f"{depth} damage task(s) tracked "
             f"(bound {self.repair_depth_threshold})",
         ))
+        if self.slo is not None:
+            conds.extend(self.slo.evaluate(targets, now))
         self.alerts.evaluate(conds, now)
 
     # ------------------------------------------------------------------
@@ -300,6 +327,61 @@ class ClusterCollector:
             "PendingAlerts": len(alerts["Pending"]),
             "Push": push_status(),
         }
+
+    def slo_payload(self) -> dict:
+        """/cluster/slo body: engine config + latest per-objective burn
+        rows + the soak-gate scorecard over the slow window."""
+        if self.slo is None:
+            return {"Enabled": False}
+        with self._targets_lock:
+            targets = list(self.targets.values())
+        body = self.slo.payload()
+        body["Enabled"] = True
+        body["Scorecard"] = self.slo.scorecard(targets)
+        return body
+
+    # series families worth freezing into an incident capsule — the
+    # request/span signals every objective reads, plus the alert/SLO
+    # state itself; everything else stays out so a capsule of a
+    # many-node cluster stays megabytes, not the whole TSDB
+    _CAPSULE_FAMILIES = (
+        "weed_http_request",
+        "weed_span_seconds",
+        "weed_scrape_",
+        "weed_slo_",
+        "weed_alert_firing",
+        "weed_retry_total",
+        "weed_time_to_repair_seconds",
+        "weed_admission_rejected_total",
+        "weed_scrub_corruptions_found_total",
+    )
+
+    def window_payload(self, window_s: float | None = None) -> dict:
+        """The capsule's TSDB section: the relevant families' raw
+        samples over the SLO slow window (or `window_s`), per target."""
+        w = window_s or (self.slo.slow_s if self.slo is not None
+                         else 4.0 * self.window_s)
+        now = time.time()
+        with self._targets_lock:
+            targets = list(self.targets.values())
+        return {
+            "WindowSeconds": w,
+            "Targets": {
+                ts.url: ts.dump_window(self._CAPSULE_FAMILIES, w, now)
+                for ts in targets
+            },
+        }
+
+    def up_targets(self) -> list[str]:
+        """Scrape targets currently considered up — the capsule
+        coordinator's fan-out set for cluster-scoped alerts."""
+        now = time.time()
+        with self._targets_lock:
+            return [
+                ts.url
+                for ts in self.targets.values()
+                if ts.last_success and ts.staleness(now) < self.stale_after
+            ]
 
     def top_payload(self, n: int = 10) -> dict:
         """Busiest nodes by req/s (with 5xx rate and http p99) and

@@ -12,6 +12,29 @@ from __future__ import annotations
 Sample = tuple[str, tuple[tuple[str, str], ...], float]
 
 
+def _close_brace(line: str, start: int) -> int:
+    """Index of the first UNQUOTED '}' at/after `start`, or -1.
+
+    rfind('}') is wrong since weedscope: a bucket line may carry an
+    exemplar suffix (`... {trace_id="..."} 0.09`) whose closing brace
+    sits AFTER the value — rfind would swallow the sample value into
+    the label body and drop the line. Quote-aware forward scan instead
+    (a label VALUE may legally contain '}')."""
+    i, n = start, len(line)
+    in_quotes = False
+    while i < n:
+        c = line[i]
+        if c == "\\" and in_quotes:
+            i += 2
+            continue
+        if c == '"':
+            in_quotes = not in_quotes
+        elif c == "}" and not in_quotes:
+            return i
+        i += 1
+    return -1
+
+
 def _parse_labels(body: str) -> tuple[tuple[str, str], ...]:
     """`k="v",k2="v2"` → sorted ((k, v), ...) with \\" \\\\ \\n unescaped."""
     labels: list[tuple[str, str]] = []
@@ -54,11 +77,12 @@ def parse_prometheus_text(text: str) -> list[Sample]:
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        # name{labels} value [timestamp]   |   name value [timestamp]
+        # name{labels} value [timestamp] [# {exemplar labels} ev]
+        #   |   name value [timestamp]
         if "{" in line:
             brace = line.index("{")
             name = line[:brace]
-            close = line.rfind("}")
+            close = _close_brace(line, brace + 1)
             if close < brace:
                 continue
             label_body = line[brace + 1 : close]
@@ -70,6 +94,8 @@ def parse_prometheus_text(text: str) -> list[Sample]:
                 continue
             name, rest = parts
             labels = ()
+        # an exemplar suffix (`# {...} v`) is not part of the sample
+        rest = rest.partition("#")[0].strip()
         value_str = rest.split()[0] if rest else ""
         try:
             value = float(value_str)  # handles +Inf/-Inf/NaN spellings

@@ -24,6 +24,29 @@ LabelTuple = tuple[tuple[str, str], ...]
 SeriesKey = tuple[str, LabelTuple]
 
 
+def quantile_from_buckets(
+    by_le: dict[float, float], q: float
+) -> float | None:
+    """histogram_quantile over a {le bound: cumulative count} map (the
+    shape bucket_increases returns — possibly pooled across several
+    TargetStores by the SLO engine). None when the map saw nothing."""
+    if not by_le:
+        return None
+    bounds = sorted(by_le)
+    cum = [by_le[b] for b in bounds]
+    # cumulative → per-bucket counts
+    counts = [cum[0]] + [
+        max(0.0, cum[i] - cum[i - 1]) for i in range(1, len(cum))
+    ]
+    if sum(counts) <= 0:
+        return None
+    finite_bounds = [b for b in bounds if b != float("inf")]
+    if len(finite_bounds) < len(bounds):
+        # fold the +Inf bucket into the overflow slot
+        counts = counts[: len(finite_bounds)] + [counts[-1]]
+    return histogram_quantile(finite_bounds, counts, q)
+
+
 class SeriesRing:
     """Preallocated (t, v) ring; append overwrites the oldest sample."""
 
@@ -191,22 +214,20 @@ class TargetStore:
                 total += ring.increase(window_s, now)
         return total
 
-    def quantile(
+    def bucket_increases(
         self,
         family: str,
-        q: float,
         window_s: float,
         now: float | None = None,
         label_filter=None,
-    ) -> float | None:
-        """Quantile estimate from a Prometheus histogram family's
-        `<family>_bucket` series over the trailing window.
-
-        Buckets arrive CUMULATIVE per scrape; the windowed increase per
-        `le` is itself cumulative across les, so adjacent-le differences
-        yield the per-bucket counts histogram_quantile wants. Aggregates
-        across all non-`le` label splits (optionally filtered). Returns
-        None when the window saw no observations."""
+    ) -> dict[float, float]:
+        """Windowed increases of a histogram family's `<family>_bucket`
+        series, keyed by `le` bound (cumulative, Prometheus-style) and
+        aggregated across all non-`le` label splits (optionally
+        filtered). The shared primitive under quantile() and the SLO
+        engine's latency objectives (telemetry/slo.py): the entry at a
+        bound is how many observations landed at-or-under it in the
+        window, the `+Inf` entry is the window's total count."""
         bucket_name = family + "_bucket"
         by_le: dict[float, float] = {}
         with self._lock:
@@ -223,21 +244,53 @@ class TargetStore:
                 by_le[bound] = by_le.get(bound, 0.0) + ring.increase(
                     window_s, now
                 )
-        if not by_le:
-            return None
-        bounds = sorted(by_le)
-        cum = [by_le[b] for b in bounds]
-        # cumulative → per-bucket counts
-        counts = [cum[0]] + [
-            max(0.0, cum[i] - cum[i - 1]) for i in range(1, len(cum))
-        ]
-        if sum(counts) <= 0:
-            return None
-        finite_bounds = [b for b in bounds if b != float("inf")]
-        if len(finite_bounds) < len(bounds):
-            # fold the +Inf bucket into the overflow slot
-            counts = counts[: len(finite_bounds)] + [counts[-1]]
-        return histogram_quantile(finite_bounds, counts, q)
+        return by_le
+
+    def quantile(
+        self,
+        family: str,
+        q: float,
+        window_s: float,
+        now: float | None = None,
+        label_filter=None,
+    ) -> float | None:
+        """Quantile estimate from a Prometheus histogram family's
+        `<family>_bucket` series over the trailing window.
+
+        Buckets arrive CUMULATIVE per scrape; the windowed increase per
+        `le` is itself cumulative across les, so adjacent-le differences
+        yield the per-bucket counts histogram_quantile wants. Returns
+        None when the window saw no observations."""
+        return quantile_from_buckets(
+            self.bucket_increases(family, window_s, now, label_filter), q
+        )
+
+    def dump_window(
+        self,
+        prefixes: tuple[str, ...],
+        window_s: float,
+        now: float | None = None,
+    ) -> dict[str, list[list[float]]]:
+        """Raw [t, v] samples within the trailing window for every
+        series whose family name starts with one of `prefixes` —
+        the incident capsule's TSDB section (telemetry/capsule.py).
+        Keyed by the Prometheus-rendered series identity so the dump
+        round-trips through any promtext tooling."""
+        out: dict[str, list[list[float]]] = {}
+        with self._lock:
+            for (n, lt), ring in self.series.items():
+                if not n.startswith(prefixes):
+                    continue
+                pts = ring.window(window_s, now)
+                if not pts:
+                    continue
+                if lt:
+                    rendered = ",".join(f'{k}="{v}"' for k, v in lt)
+                    key = f"{n}{{{rendered}}}"
+                else:
+                    key = n
+                out[key] = [[round(t, 3), v] for t, v in pts]
+        return out
 
     def health_row(
         self, now: float | None = None, stale_after: float | None = None

@@ -26,7 +26,7 @@ Design constraints, in order:
 
 Wire format (`X-Weed-Trace`): `trace_id:parent_span_id:plane`, all
 ASCII hex / lowercase tokens. The plane tag (`serve` | `scrub` |
-`repair`) travels with the trace so a volume server can see that an EC
+`repair` | `tier`) travels with the trace so a volume server can see that an EC
 shard read was rebuild traffic, not a user read — the cross-plane
 interference the Facebook warehouse study (PAPERS.md, arXiv:1309.0186)
 shows is otherwise invisible.
@@ -347,6 +347,7 @@ def drain() -> None:
     drain (sustained > ring-size/interval load) are skipped; the exact
     per-request counters don't lose them."""
     global _drained
+    put_exemplar = SPAN_HISTOGRAM.put_exemplar
     with _lock:
         cur = _peek()
         lo = max(_drained, cur - _RING_SIZE)
@@ -354,6 +355,10 @@ def drain() -> None:
             sp = _ring[i & _RING_MASK]
             if sp is not None:
                 SPAN_HISTOGRAM.observe(sp.duration, sp.name, sp.plane)
+                # weedscope exemplars: each bucket remembers the last
+                # trace that landed in it — off the request path, here
+                # in the drain, where the span is already in hand
+                put_exemplar(sp.duration, sp.trace_id, sp.name, sp.plane)
         _drained = cur
 
 
@@ -667,7 +672,7 @@ def parse_header(value: str) -> tuple[str, str, str] | None:
     # be able to smuggle '%' or control characters through the header
     if not _ishex(trace_id) or (parent_id and not _ishex(parent_id)):
         return None
-    if plane not in ("serve", "scrub", "repair"):
+    if plane not in ("serve", "scrub", "repair", "tier"):
         plane = PLANE_SERVE
     return trace_id, parent_id, plane
 

@@ -24,6 +24,7 @@ from seaweedfs_tpu.stats.metrics import (
     HTTP_REQUEST_COUNTER,
     HTTP_REQUEST_HISTOGRAM,
 )
+from seaweedfs_tpu.trace import blackbox as _blackbox
 from seaweedfs_tpu.util import deadline as _deadline
 
 
@@ -184,9 +185,20 @@ class FastRequestMixin:
                 wv = getattr(self.wfile, "writev", None)
                 if wv is not None:
                     wv((bytes(buf), body))
+                    self._note_sent(len(buf) + len(body))
                     return
             buf += body
         self.wfile.write(buf)
+        self._note_sent(len(buf))
+
+    def _note_sent(self, n: int) -> None:
+        # wire-byte accounting for the flight recorder: the C fast path
+        # reports bytes actually sent, so the threaded arm's wide-event
+        # matches (only when the handler didn't already stamp a size —
+        # the write path records the uploaded needle size instead)
+        sp = getattr(self, "_trace_span", None)
+        if sp is not None and not sp.nbytes:
+            sp.nbytes = n
 
     # the stdlib slow paths (filer/master streaming replies) pass
     # through here — recording the code keeps span status and the
@@ -549,7 +561,14 @@ def serve_connection(
     trace_hdr_key = _trace.TRACE_HEADER
     clock = _time.perf_counter
     hist_observe = HTTP_REQUEST_HISTOGRAM.observe
+    put_exemplar = HTTP_REQUEST_HISTOGRAM.put_exemplar
     counter_labels = HTTP_REQUEST_COUNTER.labels
+    # weedscope flight recorder (trace/blackbox.py): one wide-event per
+    # completed request on BOTH dispatch arms; the closure holds every
+    # object the record path touches (WEED_SCOPE=0 → one global check)
+    bb_record = _blackbox.recorder(trace_label, trace_node)
+    bb_flags = _blackbox.request_flags
+    peer = addr[0] if isinstance(addr, tuple) else str(addr)
     span_names: dict[str, str] = {}  # method -> span name, per-conn
     try:
         while True:
@@ -667,7 +686,13 @@ def serve_connection(
             if (
                 command == "GET"
                 and (
-                    bare in ("/debug/traces", "/debug/requests", "/debug/profile")
+                    bare in (
+                        "/debug/traces",
+                        "/debug/requests",
+                        "/debug/profile",
+                        "/debug/blackbox",
+                    )
+                    or bare.startswith("/capsule/")
                     or (bare == "/metrics" and gateway_metrics)
                 )
                 # an auth-fronted gateway vetoes the interception
@@ -696,17 +721,29 @@ def serve_connection(
                 finally:
                     if sp:  # falsy when the tracer flipped off mid-open
                         span_close(sp, h._trace_status)
+                # a real span's duration IS the dispatch latency —
+                # reuse it instead of a second clock pair
+                dur = sp.duration if sp else clock() - t0
                 if trace_label:
-                    # a real span's duration IS the dispatch latency —
-                    # reuse it instead of a second clock pair
-                    hist_observe(
-                        sp.duration if sp else clock() - t0,
-                        trace_label,
-                        command,
-                    )
+                    hist_observe(dur, trace_label, command)
                     counter_labels(
                         trace_label, command, str(h._trace_status)
                     ).inc()
+                    if sp:
+                        # bucket exemplar: this trace id is the one an
+                        # operator can paste into /debug/traces
+                        put_exemplar(dur, sp.trace_id, trace_label, command)
+                bb_record(
+                    command,
+                    sp.trace_id if sp else "",
+                    sp.plane if sp else "serve",
+                    h._trace_status,
+                    dur,
+                    sp.nbytes if sp else 0,
+                    peer,
+                    bb_flags(headers, h._trace_status),
+                    sp.stages if sp else None,
+                )
             else:
                 h._trace_span = None
                 t0 = clock()
@@ -714,11 +751,23 @@ def serve_connection(
                     method(h)
                 else:
                     qos_dispatch(method, h)
+                dur = clock() - t0
                 if trace_label:
-                    hist_observe(clock() - t0, trace_label, command)
+                    hist_observe(dur, trace_label, command)
                     counter_labels(
                         trace_label, command, str(h._trace_status)
                     ).inc()
+                bb_record(
+                    command,
+                    "",
+                    "serve",
+                    h._trace_status,
+                    dur,
+                    0,
+                    peer,
+                    bb_flags(headers, h._trace_status),
+                    None,
+                )
 
             # health plane (docs/HEALTH.md): 5xx responses feed the
             # heartbeat request_errors counter the master's per-node
@@ -754,10 +803,12 @@ def _serve_debug(h, bare: str) -> None:
     """The tracing plane's operator endpoints, served uniformly on
     every daemon by the mini loop itself (no per-server routing to
     drift): `/debug/traces` (recent + slowest-N completed spans,
-    ?n= caps the recent list), `/debug/requests` (in-flight dump), and
-    — on servers that opt in via `server.gateway_metrics` (the S3 and
-    WebDAV gateways, whose handlers have no routing slot for it) —
-    `/metrics` Prometheus text exposition."""
+    ?n= caps the recent list), `/debug/requests` (in-flight dump),
+    `/debug/blackbox` (the weedscope flight recorder's tail + sampled-OK
+    rings), the `/capsule/*` incident-capsule surface, and — on servers
+    that opt in via `server.gateway_metrics` (the S3 and WebDAV
+    gateways, whose handlers have no routing slot for it) — `/metrics`
+    Prometheus text exposition."""
     if bare == "/metrics":
         from seaweedfs_tpu.stats.metrics import DEFAULT_REGISTRY
 
@@ -789,6 +840,17 @@ def _serve_debug(h, bare: str) -> None:
                 {"Content-Type": "text/plain; charset=utf-8"},
             )
         return h.fast_reply(200, _json.dumps(payload).encode(), JSON_HDR)
+    if bare == "/debug/blackbox":
+        q = fast_query(h.path.partition("?")[2])
+        try:
+            n = int(q.get("n", "256"))
+        except ValueError:
+            n = 256
+        return h.fast_reply(
+            200, _json.dumps(_blackbox.snapshot(n)).encode(), JSON_HDR
+        )
+    if bare.startswith("/capsule/"):
+        return _serve_capsule(h, bare)
     if bare == "/debug/requests":
         payload = _trace.inflight_payload()
     else:
@@ -799,6 +861,44 @@ def _serve_debug(h, bare: str) -> None:
             n = 64
         payload = _trace.debug_payload(n)
     h.fast_reply(200, _json.dumps(payload).encode(), JSON_HDR)
+
+
+def _serve_capsule(h, bare: str) -> None:
+    """Per-node incident-capsule surface (telemetry/capsule.py), served
+    by every daemon: `/capsule/capture?reason=R` snapshots the node's
+    evidence NOW (the leader's CaptureCoordinator dials this on every
+    implicated peer when an alert fires), `/capsule/list` returns the
+    valid manifests, `/capsule/get?id=I&file=F` streams one capsule
+    file for leader-side `capsule.collect` merging."""
+    from seaweedfs_tpu.telemetry import capsule
+
+    q = fast_query(h.path.partition("?")[2])
+    if bare == "/capsule/capture":
+        trigger = q.get("trigger", "manual")
+        if trigger not in ("manual", "alert"):  # bound the label set
+            trigger = "manual"
+        manifest = capsule.capture(
+            q.get("reason", "http"),
+            trigger=trigger,
+            node=getattr(h.server, "trace_node", ""),
+        )
+        return h.fast_reply(200, _json.dumps(manifest).encode(), JSON_HDR)
+    if bare == "/capsule/list":
+        return h.fast_reply(
+            200,
+            _json.dumps({"Capsules": capsule.list_capsules()}).encode(),
+            JSON_HDR,
+        )
+    if bare == "/capsule/get":
+        data = capsule.read_file(q.get("id", ""), q.get("file", ""))
+        if data is None:
+            return h.fast_reply(
+                404, b'{"error": "no such capsule file"}', JSON_HDR
+            )
+        return h.fast_reply(
+            200, data, {"Content-Type": "application/octet-stream"}
+        )
+    return h.fast_reply(404, b'{"error": "unknown capsule route"}', JSON_HDR)
 
 
 def _bad_request(h, msg: str) -> None:

@@ -226,6 +226,7 @@ def _callbacks(server):
         HTTP_REQUEST_COUNTER,
         HTTP_REQUEST_HISTOGRAM,
     )
+    from seaweedfs_tpu.trace import blackbox as _blackbox
     from seaweedfs_tpu.util.httpd import serve_connection
 
     handler_cls = server.RequestHandlerClass
@@ -241,7 +242,12 @@ def _callbacks(server):
     open_span, close_span, sample_hit = _trace.loop_tracer(trace_node)
     trace_enabled = _trace.enabled
     hist_observe = HTTP_REQUEST_HISTOGRAM.observe
+    put_exemplar = HTTP_REQUEST_HISTOGRAM.put_exemplar
     counter_labels = HTTP_REQUEST_COUNTER.labels
+    # weedscope flight recorder: fast-path completions record the SAME
+    # wide-event the threaded funnel records — stage names and status
+    # identity across arms is tested (tests/test_native_serve.py)
+    bb_record = _blackbox.recorder(trace_label, trace_node)
     get_name = f"{trace_label or 'http'}.get"
     head_name = f"{trace_label or 'http'}.head"
     import time as _time
@@ -341,19 +347,29 @@ def _callbacks(server):
         if load_tracker is not None:
             load_tracker.exit()
         sp, cmd = ctx
+        stages = {"parse": t_parse, "resolve": t_resolve, "send": t_send}
         if sp is not None:
-            sp.add_stages(
-                {"parse": t_parse, "resolve": t_resolve, "send": t_send}
-            )
+            sp.add_stages(stages)  # adopts the dict; blackbox shares it
             if not ok and not sp.error:
                 sp.error = "connection lost mid-response"
             close_span(sp, status)
+        dur = sp.duration if sp is not None else t_parse + t_resolve + t_send
         if trace_label:
-            hist_observe(
-                sp.duration if sp is not None else t_resolve + t_send,
-                trace_label,
-                cmd,
-            )
+            hist_observe(dur, trace_label, cmd)
             counter_labels(trace_label, cmd, str(status)).inc()
+            if sp is not None:
+                put_exemplar(dur, sp.trace_id, trace_label, cmd)
+        bb_record(
+            cmd,
+            sp.trace_id if sp is not None else "",
+            sp.plane if sp is not None else "serve",
+            status,
+            dur,
+            nbytes,
+            "",  # the C loop doesn't surface the peer address here
+            _blackbox.FLAG_SHED if status == 503
+            else _blackbox.FLAG_DEADLINE if status == 504 else 0,
+            stages,
+        )
 
     return resolve, handoff, complete

@@ -422,3 +422,84 @@ class TestExpectValidationOrder:
         c = _roundtrip(pair.c_port, payload)
         py = _roundtrip(pair.py_port, payload)
         assert c == py
+
+
+class TestBlackboxIdentity:
+    """Satellite: wide events from the C fast path must be
+    indistinguishable from the threaded arm's — same record name, same
+    stage fields, same status — so capsules read identically whichever
+    arm served the request (docs/TRACING.md flight recorder)."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_recorder(self):
+        # other tests toggle the tracer/recorder globals; identity
+        # needs both planes on and full-fidelity sampling
+        from seaweedfs_tpu.trace import blackbox, tracer
+
+        blackbox.reset()
+        blackbox.set_enabled(True)
+        tracer.set_enabled(True)
+        tracer.set_sample_every(1)
+        yield
+
+    def _records(self, want):
+        """Poll the flight recorder (fast-path drain is asynchronous)."""
+        from seaweedfs_tpu.trace import blackbox
+
+        end = time.monotonic() + 5.0
+        while time.monotonic() < end:
+            snap = blackbox.snapshot(256)
+            rows = [r for r in snap["tail"] + snap["ok"] if want(r)]
+            if rows:
+                return rows
+            time.sleep(0.05)
+        return []
+
+    def _get(self, port: int, fid: str) -> bytes:
+        return _roundtrip(
+            port, f"GET /{fid} HTTP/1.1\r\n\r\n".encode("ascii")
+        )
+
+    @pytest.mark.parametrize("arm", ["c", "py"])
+    def test_ok_record_has_identical_stage_fields(self, pair, arm):
+        from seaweedfs_tpu.trace import blackbox
+
+        blackbox.reset()
+        port = pair.c_port if arm == "c" else pair.py_port
+        ok_every = blackbox.snapshot(0)["ok_every"]
+        for i in range(4 * ok_every):
+            # unique query per request: a C-loop plan-cache hit is
+            # served without re-entering Python, so repeated GETs of
+            # one path would record only the first resolution
+            out = self._get(port, f"{pair.fids['small']}?i={i}")
+            assert b"200 OK" in out.split(b"\r\n", 1)[0]
+        rows = self._records(
+            lambda r: r["name"] == "volume.GET" and r["status"] == 200
+        )
+        assert rows, f"no volume.GET records drained on {arm} arm"
+        staged = [r for r in rows if r.get("stages_ms")]
+        assert staged, f"no staged records on {arm} arm"
+        for r in staged:
+            assert set(r["stages_ms"]) == set(native_serve.SERVE_STAGES)
+            assert r["plane"] == "serve"
+            assert r["bytes"] > 0
+
+    def test_error_kept_in_tail_on_both_arms(self, pair):
+        from seaweedfs_tpu.trace import blackbox
+
+        for port in (pair.c_port, pair.py_port):
+            blackbox.reset()
+            out = self._get(port, pair.fids["missing"])
+            assert b"404" in out.split(b"\r\n", 1)[0]
+            rows = self._records(
+                lambda r: r["name"] == "volume.GET" and r["status"] == 404
+            )
+            # errors are never sampled away: the tail ring keeps them,
+            # and a 404 wide-event stages identically on both arms
+            assert rows
+            assert all(r in blackbox.snapshot(256)["tail"] for r in rows)
+            staged = [r for r in rows if r.get("stages_ms")]
+            assert staged
+            for r in staged:
+                assert set(r["stages_ms"]) == set(native_serve.SERVE_STAGES)
+                assert r["bytes"] > 0
